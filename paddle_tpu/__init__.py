@@ -1,0 +1,85 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the PaddlePaddle
+API surface, built from scratch on JAX/XLA/Pallas.
+
+Functional core (pure jnp/lax ops, jit/pjit/shard_map for execution) with
+an imperative paddle-shaped shell (Tensor + tape autograd + nn.Layer).
+"""
+from __future__ import annotations
+
+import os as _os
+
+import jax as _jax
+
+# int64/float64 are part of the paddle dtype contract; f64 is CPU/test-only
+# (TPU emulates it) — models use fp32/bf16 explicitly.
+_jax.config.update("jax_enable_x64", True)
+
+from ._core import dtypes as _dtypes
+from ._core.dtypes import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, finfo, iinfo,
+)
+from ._core.tensor import Tensor, Parameter  # noqa: F401
+from ._core.state import seed, get_rng_state  # noqa: F401
+from ._core import state as _state
+
+from .tensor import *  # noqa: F401,F403
+from .tensor import creation as _creation
+from .tensor.logic import is_tensor  # noqa: F401
+from .tensor.attribute import rank, is_complex, is_floating_point, is_integer  # noqa: F401
+
+from .autograd import no_grad, enable_grad, grad  # noqa: F401
+from .framework import dtype, in_dynamic_mode, set_grad_enabled  # noqa: F401
+
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import vision  # noqa: F401
+from . import distributed  # noqa: F401
+from . import metric  # noqa: F401
+from . import distribution  # noqa: F401
+from . import device  # noqa: F401
+from . import linalg  # noqa: F401
+from . import fft  # noqa: F401
+from . import sparse  # noqa: F401
+from . import incubate  # noqa: F401
+from . import static  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import hub  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import version  # noqa: F401
+from . import profiler  # noqa: F401
+from . import utils  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi import summary  # noqa: F401
+from .device import (  # noqa: F401
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_xpu,
+    is_compiled_with_rocm, is_compiled_with_custom_device, CPUPlace, TPUPlace,
+    CUDAPlace, synchronize,
+)
+from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .jit.api import disable_static, enable_static  # noqa: F401
+
+# random-key context for compiled training steps (tpu-native extension)
+random_key_context = _state.prng.key_ctx
+
+__version__ = "0.1.0"
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch parity (python/paddle/batch.py)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched

@@ -1,0 +1,103 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference dtype surface (paddle/phi/common/data_type.h,
+python/paddle/framework/dtype.py) on top of numpy/jax dtypes. TPU-native
+notes: bfloat16 is the first-class reduced precision type (MXU-native);
+float64 exists for CPU-side numerics/tests but is emulated on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects are numpy dtypes (jax-compatible).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_STR2DTYPE = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128, "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle legacy VarDesc-style names
+    "BOOL": bool_, "UINT8": uint8, "INT8": int8, "INT16": int16,
+    "INT32": int32, "INT64": int64, "FP16": float16, "BF16": bfloat16,
+    "FP32": float32, "FP64": float64,
+}
+
+FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+INTEGER = {uint8, int8, int16, int32, int64}
+COMPLEX = {complex64, complex128}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype-ish (str, np.dtype, jnp dtype, Tensor dtype) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.split(".")[-1]
+        if key in _STR2DTYPE:
+            return _STR2DTYPE[key]
+        return np.dtype(key)
+    if isinstance(dtype, np.dtype):
+        return dtype
+    return np.dtype(dtype)
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports float dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def is_floating_point_dtype(d):
+    return convert_dtype(d) in FLOATING
+
+
+def is_integer_dtype(d):
+    return convert_dtype(d) in INTEGER
+
+
+def is_complex_dtype(d):
+    return convert_dtype(d) in COMPLEX
+
+
+def finfo(dtype):
+    return ml_dtypes.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return np.iinfo(convert_dtype(dtype))
+
+
+def promote_types(a, b):
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))
+
+
+def dtype_name(d):
+    d = convert_dtype(d)
+    if d == bfloat16:
+        return "bfloat16"
+    return d.name

@@ -1,0 +1,146 @@
+"""Tape backward engine.
+
+Replaces the reference's C++ autograd engine
+(paddle/fluid/imperative/basic_engine.cc): topological walk over recorded
+TapeNodes, per-node VJP from jax.vjp, cotangent accumulation into leaf
+.grad. Gradient math itself is JAX's — there is no hand-written grad-op
+registry to maintain.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor, TapeNode, _float0_like
+
+
+def _topo_order(root_nodes):
+    """Return nodes in reverse-topological (output→input) order."""
+    visited = set()
+    order = []
+
+    for root in root_nodes:
+        if root is None or id(root) in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for t in node.input_tensors:
+                if t is not None and t._node is not None and id(t._node) not in visited:
+                    stack.append((t._node, False))
+    order.reverse()
+    return order
+
+
+def _run_backward(outputs, out_grads, inputs=None, accumulate_into_leaves=True,
+                  retain_graph=False):
+    """Core reverse pass.
+
+    outputs: list[Tensor]; out_grads: list[array] seed cotangents.
+    inputs: optional list[Tensor] — if given, return their grads (paddle.grad
+    semantics); leaves still get .grad accumulated iff accumulate_into_leaves.
+    """
+    cotangents: dict[int, list] = {}
+    nodes: dict[int, TapeNode] = {}
+    # direct input grads (for tensors requested in `inputs` that are also outputs
+    # or leaves)
+    direct: dict[int, object] = {}
+    input_ids = {id(t) for t in inputs} if inputs else set()
+
+    def seed(t: Tensor, g):
+        if t._node is None:
+            _accum_tensor(t, g)
+            return
+        key = id(t._node)
+        nodes[key] = t._node
+        lst = cotangents.setdefault(key, [None] * len(t._node.raw_outputs))
+        lst[t._out_idx] = g if lst[t._out_idx] is None else lst[t._out_idx] + g
+
+    def _accum_tensor(t: Tensor, g):
+        if _float0_like(g):
+            return
+        if g.shape != tuple(t._value.shape):
+            g = jnp.reshape(jnp.broadcast_to(g, t._value.shape), t._value.shape) \
+                if g.size == t.size else g
+        if id(t) in input_ids:
+            direct[id(t)] = g if id(t) not in direct else direct[id(t)] + g
+        if accumulate_into_leaves and (t.is_leaf or t._retain_grads):
+            if t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._value + g, stop_gradient=True)
+
+    for t, g in zip(outputs, out_grads):
+        if t.stop_gradient:
+            continue
+        seed(t, g)
+
+    order = _topo_order([t._node for t in outputs if t._node is not None])
+
+    for node in order:
+        key = id(node)
+        cts = cotangents.get(key)
+        if cts is None or all(c is None for c in cts):
+            continue
+        in_grads = node.vjp(cts)
+        for t, g in zip(node.input_tensors, in_grads):
+            if t is None or t.stop_gradient or _float0_like(g):
+                continue
+            if t._node is not None:
+                nkey = id(t._node)
+                nodes[nkey] = t._node
+                lst = cotangents.setdefault(nkey, [None] * len(t._node.raw_outputs))
+                lst[t._out_idx] = g if lst[t._out_idx] is None else lst[t._out_idx] + g
+                if t._retain_grads or id(t) in input_ids:
+                    _accum_tensor(t, g)
+            else:
+                _accum_tensor(t, g)
+        if not retain_graph:
+            cotangents[key] = None
+
+    return direct
+
+
+def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
+    if tensor.stop_gradient:
+        raise RuntimeError(
+            "Tensor has stop_gradient=True; nothing to backpropagate.")
+    if grad_tensor is None:
+        g = jnp.ones_like(tensor._value)
+    else:
+        g = grad_tensor._value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    _run_backward([tensor], [g], retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad parity (python/paddle/autograd/autograd.py)."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        gouts = [jnp.ones_like(o._value) for o in outs]
+    else:
+        gl = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs]
+        gouts = [jnp.ones_like(o._value) if g is None else
+                 (g._value if isinstance(g, Tensor) else jnp.asarray(g))
+                 for o, g in zip(outs, gl)]
+    direct = _run_backward(outs, gouts, inputs=ins, accumulate_into_leaves=False,
+                           retain_graph=True)
+    result = []
+    for t in ins:
+        g = direct.get(id(t))
+        if g is None:
+            if not allow_unused:
+                result.append(Tensor(jnp.zeros_like(t._value), stop_gradient=True))
+            else:
+                result.append(None)
+        else:
+            result.append(Tensor(g, stop_gradient=not create_graph))
+    return result

@@ -1,0 +1,411 @@
+"""Tensor: imperative shell over jax.Array, with tape autograd.
+
+Architecture (tpu-first, NOT a port):
+  * Every op has a *pure functional core* (jnp/lax) — that is what runs
+    under jit/pjit and what XLA fuses onto the MXU.
+  * Eager mode wraps results in `Tensor` and records a lightweight tape
+    node `(fn, raw_inputs, kwargs)`. `backward()` walks the tape and gets
+    each node's VJP from `jax.vjp` on the pure core — so the "gradient op
+    registry" of the reference (paddle/fluid/imperative/ + ops_autogen)
+    is replaced wholesale by JAX's AD.
+  * Under `jax.jit` tracing the tape is bypassed (inputs are tracers);
+    compiled training uses `jax.value_and_grad` over functional_call.
+
+Reference parity: python/paddle/tensor/tensor.py (method surface),
+paddle/fluid/imperative/tracer.cc + basic_engine.cc (tape + engine).
+"""
+from __future__ import annotations
+
+import functools
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtypes as _dt
+from .state import grad_enabled
+
+Tracer = jax.core.Tracer
+
+
+def _is_tracer(x):
+    return isinstance(x, Tracer)
+
+
+class Place:
+    def __init__(self, kind: str, idx: int = 0):
+        self._kind, self._idx = kind, idx
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._idx})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and (self._kind, self._idx) == (other._kind, other._idx)
+
+    def is_tpu_place(self):
+        return self._kind == "tpu"
+
+    def is_cpu_place(self):
+        return self._kind == "cpu"
+
+    def is_gpu_place(self):  # parity shim: no CUDA in this framework
+        return False
+
+
+class TapeNode:
+    """One recorded op. VJP is derived lazily via jax.vjp on the pure fn."""
+
+    __slots__ = ("fn", "kwargs", "raw_inputs", "input_tensors", "raw_outputs",
+                 "multi", "name")
+
+    def __init__(self, fn, kwargs, raw_inputs, input_tensors, raw_outputs, multi, name):
+        self.fn = fn
+        self.kwargs = kwargs
+        self.raw_inputs = raw_inputs
+        self.input_tensors = input_tensors
+        self.raw_outputs = raw_outputs
+        self.multi = multi
+        self.name = name
+
+    def vjp(self, cotangents):
+        """cotangents: list aligned with raw_outputs (None → zeros)."""
+        fn, kw = self.fn, self.kwargs
+        closed = (lambda *a: fn(*a, **kw)) if kw else fn
+        _, vjp_fn = jax.vjp(closed, *self.raw_inputs)
+        if self.multi:
+            ct = tuple(
+                jnp.zeros_like(o) if c is None else c
+                for o, c in zip(self.raw_outputs, cotangents)
+            )
+        else:
+            ct = cotangents[0]
+            if ct is None:
+                ct = jnp.zeros_like(self.raw_outputs[0])
+        return vjp_fn(ct)
+
+
+def _float0_like(g):
+    return g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
+
+
+class Tensor:
+    """paddle_tpu Tensor: value + autograd metadata.
+
+    `_value` is a jax.Array (or a tracer during jit tracing). `_node` /
+    `_out_idx` link to the producing TapeNode for backward.
+    """
+
+    __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "_retain_grads", "persistable", "dist_spec",
+                 "__weakref__")
+
+    def __init__(self, value, stop_gradient=True, name=None):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self._retain_grads = False
+        self.persistable = False
+        self.dist_spec = None  # PartitionSpec over the global mesh (GSPMD)
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        if _is_tracer(self._value):
+            return Place("tpu", 0)
+        try:
+            dev = list(self._value.devices())[0]
+            return Place(dev.platform, dev.id)
+        except Exception:
+            return Place("cpu", 0)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        from .. import tensor as _t
+        return _t.linalg.t(self)
+
+    @property
+    def mT(self):
+        return _apply(lambda x: jnp.swapaxes(x, -1, -2), {}, self, name="mT")
+
+    @property
+    def real(self):
+        return _apply(jnp.real, {}, self, name="real")
+
+    @property
+    def imag(self):
+        return _apply(jnp.imag, {}, self, name="imag")
+
+    def numel(self):
+        return Tensor(jnp.asarray(self.size, dtype=jnp.int64), stop_gradient=True)
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def element_size(self):
+        return self.dtype.itemsize
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return np.asarray(self._value).item(*args)
+        return np.asarray(self._value).item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype):
+        d = _dt.convert_dtype(dtype)
+        return _apply(lambda x: x.astype(d), {}, self, name="cast")
+
+    cast = astype
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._value), self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                continue  # single logical device space under jit
+            try:
+                d = _dt.convert_dtype(a)
+                out = out.astype(d)
+            except Exception:
+                pass
+        return out
+
+    def clone(self):
+        return _apply(lambda x: x + jnp.zeros((), x.dtype), {}, self, name="clone")
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- autograd -----------------------------------------------------------
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    @requires_grad.setter
+    def requires_grad(self, v):
+        self.stop_gradient = not v
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .engine import backward as _backward
+        _backward(self, grad_tensor, retain_graph)
+
+    # -- mutation (functional under the hood) --------------------------------
+    def _replace(self, new_value, node=None, out_idx=0):
+        self._value = new_value
+        self._node = node
+        self._out_idx = out_idx
+
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        v = v.astype(self.dtype) if v.dtype != self.dtype else v
+        self._replace(v)
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        self._replace(jnp.full(self._value.shape, v, self.dtype))
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    # -- indexing -----------------------------------------------------------
+    def _convert_index(self, idx):
+        def conv(i):
+            if isinstance(i, Tensor):
+                return i._value
+            if isinstance(i, (list, np.ndarray)):
+                return jnp.asarray(i)
+            return i
+        if isinstance(idx, tuple):
+            return tuple(conv(i) for i in idx)
+        return conv(idx)
+
+    def __getitem__(self, idx):
+        idx = self._convert_index(idx)
+        return _apply(lambda x: x[idx], {}, self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = self._convert_index(idx)
+        if isinstance(value, Tensor):
+            out = _apply(lambda x, v: x.at[idx].set(v.astype(x.dtype)), {}, self, value,
+                         name="setitem")
+        else:
+            out = _apply(lambda x: x.at[idx].set(jnp.asarray(value).astype(x.dtype)), {},
+                         self, name="setitem")
+        self._replace(out._value, out._node, out._out_idx)
+        self.stop_gradient = out.stop_gradient
+
+    def __iter__(self):
+        for i in range(self.shape[0] if self.ndim else 0):
+            yield self[i]
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    # -- python scalar protocol ---------------------------------------------
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __index__(self):
+        return int(self._value)
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if _is_tracer(self._value):
+            return f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, traced)"
+        return (f"Tensor(shape={self.shape}, dtype={_dt.dtype_name(self.dtype)}, "
+                f"place={self.place}, stop_gradient={sg},\n{np.asarray(self._value)})")
+
+    __str__ = __repr__
+
+    # Arithmetic operators are attached by paddle_tpu.tensor.math (monkey
+    # patch pattern, mirroring python/paddle/tensor/__init__.py which stitches
+    # methods onto the C++ Tensor).
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def wrap(value, stop_gradient=True, name=None):
+    return Tensor(value, stop_gradient=stop_gradient, name=name)
+
+
+def _apply(fn, kwargs, *args, name=None, multi=False, nondiff=()):
+    """Run pure `fn` over (possibly Tensor) args; wrap outputs; record tape.
+
+    nondiff: indices of args to close over statically (never differentiated,
+    e.g. integer index arrays could stay positional — jax.vjp handles int
+    args via float0, so this is only needed for non-array statics).
+    """
+    raw = tuple(unwrap(a) for a in args)
+    out = fn(*raw, **kwargs) if kwargs else fn(*raw)
+    is_multi = multi or isinstance(out, (tuple, list))
+    outs = tuple(out) if is_multi else (out,)
+
+    requires = grad_enabled() and any(
+        isinstance(a, Tensor) and not a.stop_gradient for a in args
+    )
+    tensors_out = tuple(Tensor(o, stop_gradient=not requires) for o in outs)
+
+    if requires and not any(_is_tracer(r) for r in raw if r is not None):
+        in_tensors = tuple(a if isinstance(a, Tensor) else None for a in args)
+        node = TapeNode(fn, kwargs, raw, in_tensors, outs, is_multi, name or fn.__name__)
+        for i, t in enumerate(tensors_out):
+            t._node = node
+            t._out_idx = i
+    if is_multi:
+        return list(tensors_out) if isinstance(out, list) else tensors_out
+    return tensors_out[0]
+
+
+def apply(fn, *args, name=None, multi=False, **kwargs):
+    """Public op-dispatch entry: paddle_tpu ops call this."""
+    return _apply(fn, kwargs, *args, name=name, multi=multi)
+
+
+# Register Tensor as a pytree so it can cross jit/pjit boundaries directly.
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient, t.name)),
+    lambda aux, ch: Tensor(ch[0], stop_gradient=aux[0], name=aux[1]),
+)
+
+
+class Parameter(Tensor):
+    """Trainable leaf. stop_gradient defaults False (reference:
+    python/paddle/base/framework.py Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+        self.persistable = True
+
+    def __repr__(self):
+        return "Parameter " + super().__repr__()
+
+
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._value,), (t.name, t.trainable)),
+    lambda aux, ch: Parameter(ch[0], name=aux[0], trainable=aux[1]),
+)

@@ -1,0 +1,125 @@
+"""AMP (reference: python/paddle/amp/*).
+
+TPU-native: bf16 is the native mixed-precision dtype (no loss scaling
+needed); fp16 + dynamic GradScaler kept for API/behavior parity. The
+white/black lists mirror amp_lists.py: matmul/conv run in low precision,
+reductions/norms/softmax stay fp32.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.state import amp_state
+from .._core.tensor import Tensor
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+WHITE_LIST = {"matmul", "mm", "bmm", "conv1d", "conv2d", "conv3d", "linear",
+              "einsum", "flash_attention", "scaled_dot_product_attention"}
+BLACK_LIST = {"softmax", "log_softmax", "layer_norm", "batch_norm", "rms_norm",
+              "cross_entropy", "mean", "sum", "exp", "log", "logsumexp",
+              "group_norm", "instance_norm"}
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="float16", use_promote=True):
+    st = amp_state()
+    prev = (st.amp_dtype, st.amp_level, st.amp_custom_white, st.amp_custom_black)
+    if enable:
+        st.amp_dtype = _dt.convert_dtype(dtype)
+        st.amp_level = level
+        st.amp_custom_white = set(custom_white_list or ())
+        st.amp_custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        st.amp_dtype, st.amp_level, st.amp_custom_white, st.amp_custom_black = prev
+
+
+autocast = auto_cast
+
+
+def is_auto_cast_enabled():
+    return amp_state().amp_dtype is not None
+
+
+def get_amp_dtype():
+    d = amp_state().amp_dtype
+    return _dt.dtype_name(d) if d is not None else "float32"
+
+
+def amp_cast_inputs(name, args):
+    """Dispatch-time cast used by the op layer when autocast is active."""
+    st = amp_state()
+    if st.amp_dtype is None:
+        return args
+    white = (WHITE_LIST | st.amp_custom_white) - st.amp_custom_black
+    if st.amp_level == "O2":
+        target = st.amp_dtype if name not in (BLACK_LIST | st.amp_custom_black) \
+            else _dt.float32
+    elif name in white:
+        target = st.amp_dtype
+    elif name in (BLACK_LIST | st.amp_custom_black):
+        target = _dt.float32
+    else:
+        return args
+    out = []
+    for a in args:
+        if isinstance(a, Tensor) and _dt.is_floating_point_dtype(a.dtype) and \
+                a.dtype != target:
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """paddle.amp.decorate: O2 casts model params to the amp dtype."""
+    d = _dt.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        excluded = excluded_layers or []
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm, _InstanceNormBase
+        norm_types = (_BatchNormBase, LayerNorm, _InstanceNormBase)
+        for m in model_list:
+            for _, layer in m.named_sublayers(include_self=True):
+                if isinstance(layer, norm_types) or \
+                        any(isinstance(layer, e) for e in excluded
+                            if isinstance(e, type)):
+                    continue
+                for pname, p in layer._parameters.items():
+                    if p is not None and _dt.is_floating_point_dtype(p.dtype):
+                        p._replace(p._value.astype(d))
+    if optimizers is None:
+        return models if not isinstance(models, (list, tuple)) else model_list
+    opt_list = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+    for o in opt_list:
+        o._multi_precision = True
+    if isinstance(models, (list, tuple)) or isinstance(optimizers, (list, tuple)):
+        return model_list, opt_list
+    return models, optimizers
+
+
+class debugging:
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+        v = tensor._value if isinstance(tensor, Tensor) else tensor
+        finite = bool(jnp.all(jnp.isfinite(v.astype(jnp.float32))))
+        if not finite:
+            raise FloatingPointError(
+                f"check_numerics failed: non-finite values in {op_type}:{var_name}")
+        return tensor
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        pass
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        pass

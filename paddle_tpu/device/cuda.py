@@ -1,0 +1,53 @@
+"""paddle.device.cuda parity shims — CUDA is absent by design (TPU build)."""
+from __future__ import annotations
+
+import jax
+
+
+def device_count():
+    return 0
+
+
+def is_available():
+    return False
+
+
+def current_device():
+    raise RuntimeError("paddle_tpu is a TPU build: CUDA is not available")
+
+
+def get_device_name(device=None):
+    return "TPU"
+
+
+def get_device_capability(device=None):
+    return (0, 0)
+
+
+def max_memory_allocated(device=None):
+    return 0
+
+
+def max_memory_reserved(device=None):
+    return 0
+
+
+def memory_allocated(device=None):
+    return 0
+
+
+def memory_reserved(device=None):
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+    except Exception:
+        return 0
+
+
+def empty_cache():
+    pass
+
+
+def synchronize(device=None):
+    from . import synchronize as _sync
+    _sync()

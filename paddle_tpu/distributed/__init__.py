@@ -1,0 +1,36 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/__init__.py)."""
+from . import env  # noqa: F401
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather,
+    all_gather_object, reduce_scatter, broadcast, broadcast_object_list,
+    scatter, alltoall, alltoall_single, send, recv, barrier, reduce,
+    get_backend, is_available, destroy_process_group, wait, p2p_ppermute,
+)
+from . import fleet  # noqa: F401
+from .parallel_wrappers import DataParallel  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Placement, Replicate, Shard, Partial, shard_tensor, reshard,
+    shard_layer, dtensor_from_local,
+)
+from ..parallel.mesh import create_mesh, get_mesh  # noqa: F401
+from ..parallel.ring import ring_attention  # noqa: F401
+
+
+def launch():
+    raise RuntimeError(
+        "paddle_tpu uses the single-controller JAX runtime: run one python "
+        "process per host (multi-host: set JAX_COORDINATOR_ADDRESS & co, "
+        "then init_parallel_env()); no launcher daemon is needed.")
+
+
+def spawn(func, args=(), nprocs=-1, **options):
+    """Single-controller: the mesh already spans local devices; run inline."""
+    func(*args)
+
+
+def get_device_count():
+    return env.device_count()

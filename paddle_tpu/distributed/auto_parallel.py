@@ -1,0 +1,96 @@
+"""auto_parallel API (reference: python/paddle/distributed/auto_parallel/
+api.py — shard_tensor/reshard/dtensor).
+
+Direct mapping onto jax.sharding: ProcessMesh ≡ Mesh, Placement ≡
+PartitionSpec entries, shard_tensor ≡ device_put with NamedSharding,
+reshard ≡ device_put to a new sharding (XLA emits the collective).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .._core.tensor import Tensor, Parameter, unwrap
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type=None):
+        self.reduce_type = reduce_type
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.process_ids = arr.reshape(-1).tolist()
+        self.dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devs = np.asarray(jax.devices())[arr.reshape(-1)].reshape(arr.shape)
+        self._jax_mesh = Mesh(devs, tuple(self.dim_names))
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+
+def _spec_from_placements(ndim, placements, mesh):
+    spec = [None] * ndim
+    for axis_i, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            spec[pl.dim] = mesh.axis_names[axis_i] if hasattr(mesh, "axis_names") \
+                else mesh.dim_names[axis_i]
+    return P(*spec)
+
+
+def shard_tensor(data, mesh, placements, dtype=None, place=None,
+                 stop_gradient=None):
+    t = data if isinstance(data, Tensor) else Tensor(jax.numpy.asarray(data))
+    jmesh = mesh.mesh if isinstance(mesh, ProcessMesh) else mesh
+    spec = _spec_from_placements(t.ndim, placements, jmesh)
+    sharded = jax.device_put(t._value, NamedSharding(jmesh, spec))
+    out = Parameter(sharded, name=t.name) if isinstance(t, Parameter) \
+        else Tensor(sharded, stop_gradient=t.stop_gradient if stop_gradient is None
+                    else stop_gradient)
+    out.dist_spec = spec
+    return out
+
+
+def reshard(dist_tensor, mesh, placements):
+    jmesh = mesh.mesh if isinstance(mesh, ProcessMesh) else mesh
+    spec = _spec_from_placements(dist_tensor.ndim, placements, jmesh)
+    out = Tensor(jax.device_put(dist_tensor._value, NamedSharding(jmesh, spec)),
+                 stop_gradient=dist_tensor.stop_gradient)
+    out.dist_spec = spec
+    return out
+
+
+def dtensor_from_local(local_tensor, mesh, placements):
+    return shard_tensor(local_tensor, mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=None):
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    return layer
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    raise NotImplementedError("use paddle_tpu.parallel.Trainer (round 2: facade)")

@@ -1,0 +1,221 @@
+"""Collective communication API (reference: python/paddle/distributed/
+collective.py + communication/*).
+
+TPU-native: collectives are XLA ops over mesh axes (psum/all_gather/
+ppermute/all_to_all riding ICI), not NCCL calls. Inside shard_map the
+paddle API maps 1:1 onto lax collectives via the `group` → axis-name
+mapping. Outside SPMD regions (pure eager, single process) they act on
+replicated values (identity semantics), matching world_size==1 behavior.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._core.tensor import Tensor, apply, unwrap
+from . import env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Process-group parity object: names a mesh axis."""
+
+    def __init__(self, axis_name=None, ranks=None, id=0):
+        self.axis_name = axis_name
+        self.ranks = ranks or []
+        self.id = id
+
+    @property
+    def nranks(self):
+        if self.axis_name is None:
+            return env.get_world_size()
+        return len(self.ranks) if self.ranks else env.device_count()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return env.get_rank()
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def process_group(self):
+        return self
+
+
+_default_group = Group()
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    return Group(axis_name=axis_name, ranks=ranks, id=np.random.randint(1 << 30))
+
+
+def get_group(gid=0):
+    return _default_group
+
+
+def _axis(group):
+    if group is None:
+        return None
+    if isinstance(group, str):
+        return group
+    return getattr(group, "axis_name", None)
+
+
+def _in_spmd(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    raw = unwrap(tensor)
+    if ax is not None and _in_spmd(raw):
+        fn = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+              ReduceOp.MIN: lax.pmin,
+              ReduceOp.AVG: lambda v, a: lax.pmean(v, a)}.get(op, lax.psum)
+        out = fn(raw, ax)
+        if isinstance(tensor, Tensor):
+            tensor._replace(out)
+            return tensor
+        return out
+    return tensor  # replicated / world_size==1: identity
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    raw = unwrap(tensor)
+    if ax is not None and _in_spmd(raw):
+        out = lax.all_gather(raw, ax)
+        if isinstance(tensor_list, list):
+            n = out.shape[0]
+            tensor_list.extend(Tensor(out[i]) for i in range(n))
+            return tensor_list
+        return out
+    if isinstance(tensor_list, list):
+        tensor_list.append(tensor.clone() if isinstance(tensor, Tensor) else tensor)
+        return tensor_list
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    ax = _axis(group)
+    raw = unwrap(tensor)
+    if ax is not None and _in_spmd(raw):
+        out = lax.psum_scatter(raw, ax, scatter_dimension=0, tiled=True)
+        if isinstance(tensor, Tensor):
+            tensor._replace(out)
+            return tensor
+        return out
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor  # replicated semantics
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        v = tensor_list[env.get_rank() if env.get_rank() < len(tensor_list) else 0]
+        if isinstance(tensor, Tensor):
+            tensor._replace(unwrap(v))
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    ax = _axis(group)
+    if isinstance(in_tensor_list, Tensor) or (
+            not isinstance(in_tensor_list, (list, tuple))):
+        raw = unwrap(in_tensor_list)
+        if ax is not None and _in_spmd(raw):
+            n = lax.axis_size(ax)
+            out = lax.all_to_all(raw.reshape((n, -1) + raw.shape[1:]), ax, 0, 0,
+                                 tiled=False)
+            return Tensor(out.reshape(raw.shape)) if isinstance(in_tensor_list,
+                                                                Tensor) else out
+        return in_tensor_list
+    if out_tensor_list is not None:
+        out_tensor_list.extend(t.clone() for t in in_tensor_list)
+        return out_tensor_list
+    return list(in_tensor_list)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis(group)
+    raw = unwrap(in_tensor)
+    if ax is not None and _in_spmd(raw):
+        n = lax.axis_size(ax)
+        out = lax.all_to_all(raw, ax, split_axis=0, concat_axis=0, tiled=True)
+        if out_tensor is not None and isinstance(out_tensor, Tensor):
+            out_tensor._replace(out)
+            return out_tensor
+        return out
+    if out_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._replace(raw)
+        return out_tensor
+    return in_tensor
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise RuntimeError("point-to-point send/recv outside shard_map is not a "
+                       "TPU primitive; use ppermute inside shard_map "
+                       "(paddle_tpu.distributed.p2p_ppermute)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise RuntimeError("use ppermute inside shard_map (p2p_ppermute)")
+
+
+def p2p_ppermute(x, perm, axis_name):
+    """Ring/point-to-point transfer inside shard_map: lax.ppermute."""
+    return lax.ppermute(unwrap(x), axis_name, perm)
+
+
+def barrier(group=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def get_backend(group=None):
+    return "xla"  # ICI/DCN via XLA collectives; NCCL does not exist here
+
+
+def is_available():
+    return True
+
+
+def destroy_process_group(group=None):
+    pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    raw = unwrap(tensor)
+    if hasattr(raw, "block_until_ready"):
+        raw.block_until_ready()
+    return tensor

@@ -1,0 +1,87 @@
+"""Distributed environment state (reference: python/paddle/distributed/
+parallel.py env + fleet topology).
+
+Single-controller JAX model: one python process drives all local TPU
+chips; multi-host uses jax.distributed. "rank" = process index (for data
+sharding); intra-process parallelism is expressed on the global mesh.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+_state = threading.local()
+_global_mesh = None
+_hybrid_topology = None
+
+
+def init_parallel_env():
+    """reference: paddle.distributed.init_parallel_env. Multi-host init is
+    driven by env vars (COORDINATOR_ADDRESS etc.) via jax.distributed."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            pass
+    return get_rank()
+
+
+def get_rank(group=None):
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group=None):
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def is_initialized():
+    return True
+
+
+def device_count():
+    return jax.device_count()
+
+
+def local_device_count():
+    return jax.local_device_count()
+
+
+def set_global_mesh(mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_global_mesh():
+    return _global_mesh
+
+
+def set_topology(topo):
+    global _hybrid_topology
+    _hybrid_topology = topo
+
+
+def get_topology():
+    return _hybrid_topology
+
+
+def inside_shard_map():
+    """True when executing under shard_map/pjit manual axes (collectives
+    with axis names are legal)."""
+    try:
+        from jax.core import get_axis_env  # may vary across jax versions
+    except Exception:
+        get_axis_env = None
+    try:
+        frame = jax.core.unsafe_get_axis_names() if \
+            hasattr(jax.core, "unsafe_get_axis_names") else []
+        return bool(frame)
+    except Exception:
+        return False

@@ -1,0 +1,236 @@
+"""fleet facade (reference: python/paddle/distributed/fleet/fleet.py).
+
+fleet.init(strategy) builds the global TPU mesh from
+hybrid_configs degrees; distributed_model/distributed_optimizer return
+mesh-aware wrappers. The NCCL HybridCommunicateGroup becomes axis-name
+bookkeeping over one jax.sharding.Mesh.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .. import env as _env
+from ...parallel.mesh import create_mesh
+
+
+class DistributedStrategy:
+    """reference: paddle.distributed.fleet.DistributedStrategy (protobuf);
+    here a plain config object with the same field names."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.dgc = False
+        self.find_unused_parameters = False
+
+
+class HybridCommunicateGroup:
+    """Topology parity (reference: fleet/base/topology.py), backed by mesh
+    axis bookkeeping instead of NCCL comm groups."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._shape = dict(mesh.shape)
+
+    def _axis(self, name, default=1):
+        return self._shape.get(name, default)
+
+    def get_data_parallel_world_size(self):
+        return self._axis("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._axis("tp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._axis("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._axis("dp")  # sharding rides the dp axis
+
+    def get_data_parallel_rank(self):
+        return 0  # single-controller: ranks are mesh coordinates, not processes
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="dp")
+
+    def get_model_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="tp")
+
+    def get_pipe_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="pp")
+
+    def get_sharding_parallel_group(self):
+        from ..collective import Group
+        return Group(axis_name="dp")
+
+    def get_check_parallel_group(self, *a):
+        from ..collective import Group
+        return Group()
+
+    def topology(self):
+        return self._shape
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._mesh = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        n = jax.device_count()
+        dp = int(hc.get("dp_degree", 1) or 1)
+        tp = int(hc.get("mp_degree", 1) or 1)
+        pp = int(hc.get("pp_degree", 1) or 1)
+        used = dp * tp * pp
+        if used != n:
+            if n % (tp * pp) == 0:
+                dp = n // (tp * pp)
+            else:
+                tp = pp = 1
+                dp = n
+        axes = {}
+        if pp > 1:
+            axes["pp"] = pp
+        axes["dp"] = dp
+        if tp > 1:
+            axes["tp"] = tp
+        if len(axes) == 1 and "dp" in axes:
+            axes = {"dp": dp}
+        self._mesh = create_mesh(axes)
+        self._hcg = HybridCommunicateGroup(self._mesh)
+        _env.set_topology(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return _env.get_rank() == 0
+
+    def worker_index(self):
+        return _env.get_rank()
+
+    def worker_num(self):
+        return _env.get_world_size()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    def get_mesh(self):
+        return self._mesh
+
+    def distributed_model(self, model):
+        from ..parallel_wrappers import DataParallel
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return optimizer
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+    @property
+    def worker_endpoints(self):
+        return [f"proc{i}" for i in range(_env.get_world_size())]
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, *a, **k):
+        pass
+
+    def save_persistables(self, *a, **k):
+        pass
+
+
+fleet = _Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **k):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **k):
+        self.is_collective = is_collective
+
+
+from ...parallel.pp import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: E402,F401
+from ...parallel.tp import (  # noqa: E402,F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+
+
+class meta_parallel:
+    """Namespace parity: fleet.meta_parallel.* layers."""
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ParallelCrossEntropy = ParallelCrossEntropy
+    LayerDesc = LayerDesc
+    SharedLayerDesc = SharedLayerDesc
+    PipelineLayer = PipelineLayer
+
+
+def recompute(function, *args, **kwargs):
+    """reference: fleet.recompute — activation rematerialization. On TPU
+    this is jax.checkpoint over the pure functional core."""
+    import jax as _jax
+    from ..._core.tensor import Tensor, unwrap
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+    def pure(*raws):
+        it = iter(raws)
+        rebuilt = [Tensor(next(it), stop_gradient=a.stop_gradient)
+                   if isinstance(a, Tensor) else a for a in args]
+        out = function(*rebuilt, **kwargs)
+        return _jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    from ..._core.tensor import apply
+    ck = _jax.checkpoint(pure)
+    return apply(ck, *tensor_args, name="recompute")

@@ -1,0 +1,63 @@
+"""DataParallel wrapper (reference: python/paddle/distributed/parallel.py).
+
+On TPU, data parallelism is batch sharding over the 'dp' mesh axis; the
+grad allreduce the reference does via NCCL hooks is inserted by GSPMD
+when the Trainer's batch in_sharding is P('dp'). This wrapper keeps the
+paddle API shape and annotates batch inputs.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .._core.tensor import Tensor, apply
+from ..nn.layer.layers import Layer
+from . import env
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+
+    def forward(self, *inputs, **kwargs):
+        mesh = env.get_global_mesh()
+        if mesh is not None and "dp" in mesh.shape:
+            def shard_batch(t):
+                if isinstance(t, Tensor) and t.ndim >= 1:
+                    def fn(a):
+                        try:
+                            spec = [None] * a.ndim
+                            spec[0] = "dp"
+                            return jax.lax.with_sharding_constraint(
+                                a, NamedSharding(mesh, P(*spec)))
+                        except Exception:
+                            return a
+                    return apply(fn, t, name="dp_shard")
+                return t
+            inputs = tuple(shard_batch(i) for i in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass  # GSPMD inserts the grad psum
+
+    @property
+    def _layers_attr(self):
+        return self._layers
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)

@@ -1,0 +1,29 @@
+"""group_sharded_parallel — ZeRO stages (reference: python/paddle/
+distributed/sharding/group_sharded.py).
+
+TPU-native: ZeRO is a sharding-spec choice, not a runtime system —
+stage 1/2 shard optimizer slots over dp; stage 3 shards params (GSPMD
+all-gathers on use / reduce-scatters grads). The Trainer consumes the
+stage; this wrapper keeps paddle's API.
+"""
+from __future__ import annotations
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}.get(level, 2)
+    optimizer._sharding_stage = stage
+    model._sharding_stage = stage
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ..framework.io import save
+    save(model.state_dict(), output + ".pdmodel.state")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt.state")

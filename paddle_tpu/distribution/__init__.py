@@ -1,0 +1,424 @@
+"""Probability distributions (reference: python/paddle/distribution/*).
+
+Sampling uses explicit PRNG keys; log_prob/entropy are pure jnp.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.state import prng
+from .._core.tensor import Tensor, apply, unwrap
+
+
+def _t(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_t(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def _shape(self, shape):
+        if isinstance(shape, (int, np.integer)):
+            shape = (int(shape),)
+        return tuple(int(s) for s in shape)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        sh = self._shape(shape) + self._batch_shape
+        z = jax.random.normal(prng.next_key(), sh, jnp.float32)
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        v = _t(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) +
+                      jnp.zeros(self._batch_shape))
+
+    def cdf(self, value):
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (_t(value) - self.loc) / (self.scale * math.sqrt(2)))))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(np.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        sh = self._shape(shape) + self._batch_shape
+        u = jax.random.uniform(prng.next_key(), sh, jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _t(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low) + jnp.zeros(self._batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = _t(logits)
+        else:
+            self.logits = jnp.log(jnp.maximum(_t(probs), 1e-30))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape)
+        out = jax.random.categorical(prng.next_key(), self.logits, -1,
+                                     shape=sh + self._batch_shape)
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        v = _t(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(logp, v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _t(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            prng.next_key(), self.probs_, sh).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(np.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(jax.random.beta(prng.next_key(), self.alpha, self.beta, sh))
+
+    def log_prob(self, value):
+        v = _t(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha) +
+                 jax.scipy.special.gammaln(self.beta) -
+                 jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v) +
+                      (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(np.broadcast_shapes(self.concentration.shape,
+                                             self.rate.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(jax.random.gamma(prng.next_key(), self.concentration, sh) /
+                      self.rate)
+
+    def log_prob(self, value):
+        v = _t(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v -
+                      jax.scipy.special.gammaln(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(jax.random.dirichlet(prng.next_key(), self.concentration, sh))
+
+    def log_prob(self, value):
+        v = _t(value)
+        a = self.concentration
+        norm = jnp.sum(jax.scipy.special.gammaln(a), -1) - \
+            jax.scipy.special.gammaln(jnp.sum(a, -1))
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - norm)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale *
+                      jax.random.laplace(prng.next_key(), sh))
+
+    def log_prob(self, value):
+        return Tensor(-jnp.abs(_t(value) - self.loc) / self.scale -
+                      jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale) + jnp.zeros(self._batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        z = jax.random.normal(prng.next_key(), sh)
+        return Tensor(jnp.exp(self.loc + self.scale * z))
+
+    def log_prob(self, value):
+        v = _t(value)
+        logv = jnp.log(v)
+        return Tensor(-((logv - self.loc) ** 2) / (2 * self.scale ** 2) -
+                      logv - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_ = _t(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        sh = self._shape(shape)
+        logits = jnp.log(jnp.maximum(self.probs_, 1e-30))
+        draws = jax.random.categorical(
+            prng.next_key(), logits, -1,
+            shape=(self.total_count,) + sh + self._batch_shape)
+        k = self.probs_.shape[-1]
+        return Tensor(jnp.sum(jax.nn.one_hot(draws, k), axis=0))
+
+    def log_prob(self, value):
+        v = _t(value)
+        logp = jnp.log(jnp.maximum(self.probs_, 1e-30))
+        coef = (jax.scipy.special.gammaln(jnp.sum(v, -1) + 1) -
+                jnp.sum(jax.scipy.special.gammaln(v + 1), -1))
+        return Tensor(coef + jnp.sum(v * logp, -1))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(prng.next_key(), sh))
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs_ = _t(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        u = jax.random.uniform(prng.next_key(), sh, jnp.float32, 1e-7, 1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(v * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(prng.next_key(), sh))
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(prng.next_key(), sh) / self.rate)
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(self.rate) - self.rate * _t(value))
+
+    def entropy(self):
+        return Tensor(1 - jnp.log(self.rate))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(prng.next_key(), self.rate, sh)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate -
+                      jax.scipy.special.gammaln(v + 1))
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = transforms if isinstance(transforms, (list, tuple)) \
+            else [transforms]
+        super().__init__(base._batch_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        x = value
+        ld = 0.0
+        for t in reversed(self.transforms):
+            xi = t.inverse(x)
+            ld = ld + _t(t.forward_log_det_jacobian(xi))
+            x = xi
+        return Tensor(_t(self.base.log_prob(x)) - ld)
+
+
+class AffineTransform:
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return Tensor(self.loc + self.scale * _t(x))
+
+    def inverse(self, y):
+        return Tensor((_t(y) - self.loc) / self.scale)
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), _t(x).shape))
+
+
+class ExpTransform:
+    def forward(self, x):
+        return Tensor(jnp.exp(_t(x)))
+
+    def inverse(self, y):
+        return Tensor(jnp.log(_t(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(_t(x))
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        logp = jax.nn.log_softmax(p.logits, -1)
+        logq = jax.nn.log_softmax(q.logits, -1)
+        return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        pp = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+        qq = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(pp * (jnp.log(pp) - jnp.log(qq)) +
+                      (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+    # generic MC fallback
+    x = p.sample((256,))
+    return Tensor(jnp.mean(_t(p.log_prob(x)) - _t(q.log_prob(x)), axis=0))

@@ -1,0 +1,87 @@
+"""FFT ops (reference: python/paddle/fft.py) → jnp.fft (XLA FFT HLO)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._core.tensor import apply
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+           "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "hfft2",
+           "ihfft2", "hfftn", "ihfftn", "fftfreq", "rfftfreq", "fftshift",
+           "ifftshift"]
+
+
+def _norm(norm):
+    return norm if norm in ("ortho", "forward") else "backward"
+
+
+def _mk1(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name_arg=None):
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)), x, name=name)
+    op.__name__ = name
+    return op
+
+
+fft = _mk1(jnp.fft.fft, "fft")
+ifft = _mk1(jnp.fft.ifft, "ifft")
+rfft = _mk1(jnp.fft.rfft, "rfft")
+irfft = _mk1(jnp.fft.irfft, "irfft")
+hfft = _mk1(jnp.fft.hfft, "hfft")
+ihfft = _mk1(jnp.fft.ihfft, "ihfft")
+
+
+def _mk2(jfn, name):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name_arg=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x, name=name)
+    op.__name__ = name
+    return op
+
+
+fft2 = _mk2(jnp.fft.fft2, "fft2")
+ifft2 = _mk2(jnp.fft.ifft2, "ifft2")
+rfft2 = _mk2(jnp.fft.rfft2, "rfft2")
+irfft2 = _mk2(jnp.fft.irfft2, "irfft2")
+
+
+def _mkn(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name_arg=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x, name=name)
+    op.__name__ = name
+    return op
+
+
+fftn = _mkn(jnp.fft.fftn, "fftn")
+ifftn = _mkn(jnp.fft.ifftn, "ifftn")
+rfftn = _mkn(jnp.fft.rfftn, "rfftn")
+irfftn = _mkn(jnp.fft.irfftn, "irfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.hfft(jnp.fft.ifft(a, axis=axes[0]), axis=axes[1],
+                                        norm=_norm(norm)), x, name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply(lambda a: jnp.fft.ihfft(a, axis=axes[1], norm=_norm(norm)), x, name="ihfft2")
+
+
+hfftn = hfft2
+ihfftn = ihfft2
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from ._core.tensor import Tensor
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from ._core.tensor import Tensor
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x, name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x, name="ifftshift")

@@ -1,0 +1,51 @@
+"""Framework glue (reference: python/paddle/framework/__init__.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .._core import dtypes as _dt
+from .._core import state as _state
+from .._core.tensor import Tensor, Parameter
+from . import random  # noqa: F401
+from .io import save, load  # noqa: F401
+
+# paddle.framework.dtype — dtype constructor/alias
+dtype = _dt.convert_dtype
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_pir_mode():
+    return False
+
+
+def in_dynamic_or_pir_mode():
+    return True
+
+
+def use_pir_api():
+    return False
+
+
+def set_grad_enabled(mode):
+    from ..autograd import set_grad_enabled_ctx
+    return set_grad_enabled_ctx(mode)
+
+
+def is_grad_enabled():
+    return _state.grad_enabled()
+
+
+_global_flags = {}
+
+
+def set_flags(flags):
+    _global_flags.update(flags)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _global_flags.get(f) for f in flags}

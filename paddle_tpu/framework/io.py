@@ -1,0 +1,69 @@
+"""paddle.save / paddle.load (reference: python/paddle/framework/io.py).
+
+Serialization: numpy-backed pickle for arbitrary nested state
+(state_dicts, optimizer state, plain tensors). Sharded/async checkpoint
+for training lives in paddle_tpu.utils.checkpoint (orbax-style).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .._core.tensor import Tensor, Parameter
+
+
+class _TensorPayload:
+    __slots__ = ("array", "is_param", "name", "stop_gradient")
+
+    def __init__(self, array, is_param, name, stop_gradient):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), isinstance(obj, Parameter),
+                              obj.name, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    import jax.numpy as jnp
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        arr = jnp.asarray(obj.array)
+        t = Parameter(arr, name=obj.name) if obj.is_param else Tensor(arr, name=obj.name)
+        t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = str(path) + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+    os.replace(tmp, path)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

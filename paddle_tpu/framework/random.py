@@ -1,0 +1,21 @@
+"""RNG state management (reference: python/paddle/framework/random.py)."""
+from __future__ import annotations
+
+from .._core import state as _state
+
+
+def get_rng_state(device=None):
+    return [_state.get_rng_state()]
+
+
+def set_rng_state(state_list, device=None):
+    st = state_list[0] if isinstance(state_list, (list, tuple)) else state_list
+    _state.set_rng_state(st)
+
+
+def get_cuda_rng_state():
+    return [_state.get_rng_state()]
+
+
+def set_cuda_rng_state(state_list):
+    set_rng_state(state_list)

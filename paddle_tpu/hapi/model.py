@@ -1,0 +1,228 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from ..io import DataLoader
+from .. import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+
+    def _loss_value(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        loss = self._loss(*outs, *labs)
+        if isinstance(loss, (list, tuple)):
+            from ..tensor.math import add_n
+            loss = add_n([l for l in loss])
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*ins)
+        loss = self._loss_value(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = [float(np.asarray(loss._value))]
+        for m in self._metrics:
+            res = m.update(*_to_metric_args(m, outputs, labels))
+            metrics.append(res)
+        return metrics if len(metrics) > 1 else metrics[0]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd import no_grad
+        with no_grad():
+            ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            outputs = self.network(*ins)
+            loss = self._loss_value(outputs, labels) if self._loss else None
+        metrics = [float(np.asarray(loss._value))] if loss is not None else []
+        for m in self._metrics:
+            res = m.update(*_to_metric_args(m, outputs, labels))
+            metrics.append(res)
+        return metrics if len(metrics) > 1 else (metrics[0] if metrics else None)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd import no_grad
+        with no_grad():
+            ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            out = self.network(*ins)
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle,
+            drop_last=drop_last, num_workers=num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbs = cb_mod.config_callbacks(callbacks, model=self, epochs=epochs,
+                                      steps=steps, verbose=verbose,
+                                      batch_size=batch_size,
+                                      metrics=self._metric_names())
+        cbs.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            self.stop_training = False
+            cbs.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, data in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                inputs, labels = _split_data(data)
+                res = self.train_batch(inputs, labels,
+                                       update=(it + 1) % accumulate_grad_batches == 0)
+                logs = self._pack_logs(res)
+                cbs.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            cbs.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=0,
+                              num_workers=num_workers)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training or (num_iters is not None and it >= num_iters):
+                break
+        cbs.on_train_end()
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        total_loss, n = 0.0, 0
+        for data in loader:
+            inputs, labels = _split_data(data)
+            res = self.eval_batch(inputs, labels)
+            if res is not None:
+                first = res[0] if isinstance(res, list) else res
+                total_loss += float(first)
+                n += 1
+        logs = {}
+        if self._loss and n:
+            logs["loss"] = total_loss / n
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, (list, tuple)) else [vals]
+            logs.update(dict(zip(names, vals)))
+        if verbose:
+            print("Eval:", {k: round(float(v), 5) for k, v in logs.items()})
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers)
+        outputs = []
+        for data in loader:
+            inputs, _ = _split_data(data)
+            out = self.predict_batch(inputs)
+            outputs.append(out)
+        if stack_outputs and outputs:
+            import jax.numpy as jnp
+            firsts = [o if isinstance(o, Tensor) else o[0] for o in outputs]
+            return [Tensor(jnp.concatenate([f._value for f in firsts]))]
+        return [outputs]
+
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _pack_logs(self, res):
+        names = self._metric_names()
+        vals = res if isinstance(res, list) else [res]
+        return dict(zip(names, [float(np.mean(v)) if not isinstance(v, list)
+                                else float(np.mean(v[0])) for v in vals]))
+
+    def save(self, path, training=True):
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fsave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as fload
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _split_data(data):
+    if isinstance(data, (list, tuple)):
+        if len(data) >= 2:
+            return data[:-1] if len(data) > 2 else [data[0]], data[-1]
+        return [data[0]], None
+    return [data], None
+
+
+def _to_metric_args(metric, outputs, labels):
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    labs = labels if isinstance(labels, (list, tuple)) else [labels]
+    try:
+        pre = metric.compute(*outs, *labs)
+        return pre if isinstance(pre, (list, tuple)) else (pre,)
+    except Exception:
+        return (*outs, *labs)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter-count summary (reference: python/paddle/hapi/model_summary.py)."""
+    rows = []
+    total, trainable = 0, 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<24}{'Param #':>12}"]
+    lines.append("-" * (width + 36))
+    for name, shape, n in rows:
+        lines.append(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    out = "\n".join(lines)
+    print(out)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,33 @@
+"""paddle.hub parity (offline: local-dir sources only; zero egress)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, "hubconf.py")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def list(repo_dir, source="local"):
+    if source != "local":
+        raise RuntimeError("paddle_tpu.hub supports source='local' only (no network)")
+    mod = _load_hubconf(repo_dir)
+    return [n for n in dir(mod) if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local"):
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, *args, source="local", **kwargs):
+    if source != "local":
+        raise RuntimeError("paddle_tpu.hub supports source='local' only (no network)")
+    mod = _load_hubconf(repo_dir)
+    return getattr(mod, model)(*args, **kwargs)

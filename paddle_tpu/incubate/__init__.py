@@ -1,0 +1,16 @@
+"""paddle.incubate parity (reference: python/paddle/incubate/*)."""
+from . import nn  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    from ..nn.functional import softmax
+    from ..tensor.creation import triu, full_like
+    from ..tensor.manipulation import where
+    import jax.numpy as jnp
+    from .._core.tensor import apply
+    def fn(a):
+        import jax
+        s, k = a.shape[-2], a.shape[-1]
+        mask = jnp.tril(jnp.ones((s, k), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
+    return apply(fn, x, name="softmax_mask_fuse_upper_triangle")

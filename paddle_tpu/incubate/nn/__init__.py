@@ -1,0 +1,189 @@
+"""incubate.nn fused layers/functionals (reference: python/paddle/incubate/
+nn/{layer,functional}).
+
+On TPU "fused" means: expressed as one XLA graph (fusion by compiler) or
+a pallas kernel (attention). These wrappers match the reference call
+signatures over our kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, apply
+from ...nn.layer.layers import Layer
+from ...nn import functional as NF
+from ...ops import fused as _fused
+from ...ops.flash_attention import flash_attention as _flash
+from ...ops.rope import rope_cos_sin, apply_rotary_emb
+
+
+class functional:
+    @staticmethod
+    def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                                   pre_ln_scale=None, pre_ln_bias=None,
+                                   ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                                   qkv_bias=None, linear_bias=None, cache_kv=None,
+                                   attn_mask=None, dropout_rate=0.0,
+                                   attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                                   training=True, num_heads=None, **kw):
+        def fn(xr, qkv_w, lin_w, *rest):
+            rest = list(rest)
+            qkv_b = rest.pop(0) if qkv_bias is not None else None
+            lin_b = rest.pop(0) if linear_bias is not None else None
+            b, s, d = xr.shape
+            # qkv_w: (3, H, Dh, D) reference layout
+            three, h, dh, _ = qkv_w.shape
+            qkv = jnp.einsum("bsd,thed->bsthe", xr, qkv_w)
+            if qkv_b is not None:
+                qkv = qkv + qkv_b[None, None]
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            out, _ = _flash(q, k, v, dropout=attn_dropout_rate, causal=False,
+                            training=training)
+            out = out.reshape(b, s, h * dh)
+            out = out @ lin_w
+            if lin_b is not None:
+                out = out + lin_b
+            return out
+        args = [x, qkv_weight, linear_weight]
+        if qkv_bias is not None:
+            args.append(qkv_bias)
+        if linear_bias is not None:
+            args.append(linear_bias)
+        return apply(fn, *args, name="fused_multi_head_attention")
+
+    @staticmethod
+    def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                          linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                          ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                          dropout2_rate=0.5, activation="relu",
+                          ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                          pre_layer_norm=False, training=True, **kw):
+        def fn(xr, w1, w2, *rest):
+            rest = list(rest)
+            b1 = rest.pop(0) if linear1_bias is not None else None
+            b2 = rest.pop(0) if linear2_bias is not None else None
+            h = xr @ w1
+            if b1 is not None:
+                h = h + b1
+            h = getattr(jax.nn, activation)(h) if hasattr(jax.nn, activation) \
+                else jax.nn.relu(h)
+            out = h @ w2
+            if b2 is not None:
+                out = out + b2
+            return xr + out
+        args = [x, linear1_weight, linear2_weight]
+        if linear1_bias is not None:
+            args.append(linear1_bias)
+        if linear2_bias is not None:
+            args.append(linear2_bias)
+        return apply(fn, *args, name="fused_feedforward")
+
+    @staticmethod
+    def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                       begin_norm_axis=-1, **kw):
+        def fn(a, w):
+            return _fused.fused_rms_norm(a, w, epsilon)
+        return apply(fn, x, norm_weight, name="fused_rms_norm")
+
+    @staticmethod
+    def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
+        return NF.layer_norm(x, [x.shape[-1]], norm_weight, norm_bias, epsilon)
+
+    @staticmethod
+    def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                        position_ids=None, use_neox_rotary_style=True,
+                                        **kw):
+        def fn(qr, kr, c, s):
+            qo, ko = apply_rotary_emb(qr, kr, c, s)
+            return qo, ko
+        out = apply(fn, q, k, cos, sin, name="fused_rope", multi=True)
+        return (out[0], out[1], v)
+
+    @staticmethod
+    def fused_linear(x, weight, bias=None, transpose_weight=False):
+        if transpose_weight:
+            from ...tensor.linalg import matmul
+            out = matmul(x, weight, transpose_y=True)
+            if bias is not None:
+                out = out + bias
+            return out
+        return NF.linear(x, weight, bias)
+
+    @staticmethod
+    def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                          name=None):
+        from ..._core.state import prng
+        key = prng.next_key()
+        return apply(lambda a, b: _fused.fused_dropout_add(a, b, p, key, training),
+                     x, y, name="fused_dropout_add")
+
+    @staticmethod
+    def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                               ln_scale=None, ln_bias=None,
+                                               dropout_rate=0.5, ln_epsilon=1e-5,
+                                               training=True, **kw):
+        h = x if bias is None else x + bias
+        h = NF.dropout(h, dropout_rate, training=training)
+        h = h + residual
+        return NF.layer_norm(h, [h.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None, normalize_before=False,
+                 need_weights=False, qkv_weight_attr=None, **kw):
+        super().__init__()
+        from ...nn.layer.transformer import MultiHeadAttention
+        self.inner = MultiHeadAttention(embed_dim, num_heads,
+                                        dropout=attn_dropout_rate)
+        self.dropout_rate = dropout_rate
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        return self.inner(query, key, value, attn_mask, cache)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-05,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 **kw):
+        super().__init__()
+        from ...nn.layer.common import Linear, Dropout
+        from ...nn.layer.norm import LayerNorm
+        self.linear1 = Linear(d_model, dim_feedforward)
+        self.linear2 = Linear(dim_feedforward, d_model)
+        self.norm = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = Dropout(act_dropout_rate if act_dropout_rate is not None
+                               else dropout_rate)
+        self.activation = activation
+        self.normalize_before = normalize_before
+
+    def forward(self, src):
+        residual = src
+        if self.normalize_before:
+            src = self.norm(src)
+        src = self.linear2(self.dropout(
+            getattr(NF, self.activation)(self.linear1(src))))
+        out = residual + src
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False, **kw):
+        super().__init__()
+        from ...nn.layer.transformer import TransformerEncoderLayer
+        self.inner = TransformerEncoderLayer(
+            d_model, nhead, dim_feedforward, dropout_rate, activation,
+            attn_dropout_rate, act_dropout_rate, normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.inner(src, src_mask)
+
+
+from ...parallel.moe import MoELayer as FusedMoE  # noqa: E402
+
+flash_attention = _flash

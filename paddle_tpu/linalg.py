@@ -1,0 +1,9 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py)."""
+from .tensor.linalg import (  # noqa: F401
+    matmul, mm, bmm, dot, norm, dist, cross, cholesky, cholesky_solve, inv,
+    qr, svd, svdvals, eig, eigh, eigvals, eigvalsh, solve, lstsq, matrix_power,
+    matrix_rank, triangular_solve, pinv, slogdet, det, mv, multi_dot, cov,
+    corrcoef, lu, lu_unpack, householder_product, matrix_exp, vecdot, cdist,
+    matrix_transpose, ormqr,
+)
+from .tensor.math import vander  # noqa: F401

@@ -1,0 +1,52 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from .layer.layers import Layer, ParamAttr  # noqa: F401
+from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .layer.common import (  # noqa: F401
+    Identity, Linear, Bilinear, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    FeatureAlphaDropout, Embedding, Flatten, Unflatten, Upsample,
+    UpsamplingNearest2D, UpsamplingBilinear2D, Pad1D, Pad2D, Pad3D, ZeroPad1D,
+    ZeroPad2D, ZeroPad3D, CosineSimilarity, PairwiseDistance, Unfold, Fold,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.pooling import (  # noqa: F401
+    AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    AdaptiveMaxPool2D, AdaptiveMaxPool3D, LPPool1D, LPPool2D, MaxUnPool1D,
+    MaxUnPool2D, MaxUnPool3D,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, SiLU, Swish, Sigmoid, LogSigmoid, Tanh, Tanhshrink, Softsign,
+    Mish, Hardswish, ELU, CELU, SELU, GELU, Hardshrink, Hardsigmoid, Hardtanh,
+    LeakyReLU, PReLU, RReLU, Softplus, Softshrink, ThresholdedReLU, Softmax,
+    Softmax2D, LogSoftmax, Maxout, GLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, HuberLoss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, TripletMarginWithDistanceLoss,
+    MultiLabelSoftMarginLoss, SoftMarginLoss, MultiMarginLoss, CTCLoss,
+    PoissonNLLLoss, GaussianNLLLoss,
+)
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, SimpleRNN, LSTM, GRU,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.vision import PixelShuffle, PixelUnshuffle, ChannelShuffle  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm, clip_grad_norm_,
+    clip_grad_value_,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+from .layer import layers  # noqa: F401

@@ -1,0 +1,225 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+Pure jnp/jax.nn cores — XLA fuses these into adjacent matmuls/convs on
+TPU, replacing phi's hand-written activation CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import apply
+
+__all__ = [
+    "relu", "relu_", "relu6", "elu", "elu_", "celu", "selu", "gelu", "silu",
+    "swish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "thresholded_relu", "leaky_relu", "prelu",
+    "rrelu", "log_sigmoid", "maxout", "softmax", "softmax_", "log_softmax",
+    "softplus", "softsign", "tanh", "tanh_", "mish", "glu", "gumbel_softmax",
+    "sigmoid_focal_loss_act",
+]
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._replace(out._value, out._node, out._out_idx)
+    return x
+
+
+def relu6(x, name=None):
+    return apply(lambda a: jnp.clip(a, 0.0, 6.0), x, name="relu6")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha=alpha), x, name="elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._replace(out._value, out._node, out._out_idx)
+    return x
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha=alpha), x, name="celu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                 x, name="selu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=bool(approximate)),
+                 x, name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x, name="silu")
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, x, name="swish")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, name="sigmoid")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x, name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x,
+                 name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold, 0.0)),
+                 x, name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda a: a - jnp.tanh(a), x, name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, value), x,
+                 name="thresholded_relu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope=negative_slope),
+                 x, name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape = [1] * a.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply(fn, x, weight, name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=False, name=None):
+    from ..._core.state import prng
+    if training:
+        key = prng.next_key()
+        def fn(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32, lower, upper)
+            return jnp.where(a >= 0, a, slope.astype(a.dtype) * a)
+        return apply(fn, x, name="rrelu")
+    mid = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, mid * a), x, name="rrelu")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, name="log_sigmoid")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply(fn, x, name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ..._core import dtypes as _dt
+            a = a.astype(_dt.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=int(axis))
+    return apply(fn, x, name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._replace(out._value, out._node, out._out_idx)
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        if dtype is not None:
+            from ..._core import dtypes as _dt
+            a = a.astype(_dt.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=int(axis))
+    return apply(fn, x, name="log_softmax")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda a: jnp.where(a * beta > threshold, a,
+                                     jnp.log1p(jnp.exp(beta * a)) / beta),
+                 x, name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, name="softsign")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, name="tanh")
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._replace(out._value, out._node, out._out_idx)
+    return x
+
+
+def mish(x, name=None):
+    return apply(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, name="mish")
+
+
+def glu(x, axis=-1, name=None):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=int(axis))
+        return a1 * jax.nn.sigmoid(a2)
+    return apply(fn, x, name="glu")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ..._core.state import prng
+    key = prng.next_key()
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype if
+                              jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False) \
+                if hasattr(jnp, "put_along_axis") else \
+                y_hard.at[jnp.broadcast_to(idx, y.shape) ==
+                          jnp.arange(y.shape[axis]).reshape(
+                              [-1 if i == axis % y.ndim else 1 for i in range(y.ndim)])].set(1.0)
+            onehot = jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis,
+                                    dtype=y.dtype)
+            return onehot + jax.lax.stop_gradient(-y) + y
+        return y
+    return apply(fn, x, name="gumbel_softmax")
+
+
+def sigmoid_focal_loss_act(x):
+    return sigmoid(x)

@@ -1,0 +1,76 @@
+"""Attention functionals (reference: python/paddle/nn/functional/
+flash_attention.py, scaled_dot_product_attention)."""
+from __future__ import annotations
+
+import contextlib
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, apply, unwrap
+from ...ops import flash_attention as _fa_op
+
+
+@contextlib.contextmanager
+def sdp_kernel(enable_flash=True, enable_math=True, enable_mem_efficient=True):
+    yield
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """q,k,v: (B, S, H, D) paddle layout. Uses pallas flash attention when
+    no explicit mask is given; masked path is a fused XLA softmax graph.
+    """
+    if attn_mask is None:
+        def fn(q, k, v):
+            out, _ = _fa_op.flash_attention(q, k, v, dropout=dropout_p,
+                                            causal=is_causal, training=training)
+            return out
+        return apply(fn, query, key, value, name="scaled_dot_product_attention")
+
+    def fn(q, k, v, m):
+        qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+        kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+        vh = jnp.swapaxes(v, 1, 2)
+        hq, hk = qh.shape[1], kh.shape[1]
+        if hk != hq:
+            kh = jnp.repeat(kh, hq // hk, axis=1)
+            vh = jnp.repeat(vh, hq // hk, axis=1)
+        scale = 1.0 / math.sqrt(qh.shape[-1])
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        if m.dtype == jnp.bool_:
+            s = jnp.where(m, s, -1e30)
+        else:
+            s = s + m.astype(jnp.float32)
+        if is_causal:
+            sq, sk = s.shape[-2], s.shape[-1]
+            cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            s = jnp.where(cm, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, vh.astype(jnp.float32))
+        return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+    return apply(fn, query, key, value, attn_mask,
+                 name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    def fn(q, k, v):
+        out, _ = _fa_op.flash_attention(q, k, v, dropout=dropout, causal=causal,
+                                        training=training)
+        return out
+    out = apply(fn, query, key, value, name="flash_attention")
+    return (out, None)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False, training=True,
+                        name=None):
+    """Varlen parity shim: runs dense flash attention per segment boundaries
+    encoded by cu_seqlens (static python ints expected)."""
+    raise NotImplementedError(
+        "flash_attn_unpadded: use paged/ragged attention (round 2)")

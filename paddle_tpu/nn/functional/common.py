@@ -1,0 +1,307 @@
+"""Common functionals: linear/dropout/pad/interpolate/embedding/etc.
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core import dtypes as _dt
+from ..._core.state import prng
+from ..._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "linear", "bilinear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "feature_alpha_dropout", "pad", "zeropad2d", "cosine_similarity",
+    "pairwise_distance", "interpolate", "upsample", "one_hot", "embedding",
+    "label_smooth", "unfold", "fold", "class_center_sample",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b. W stored (in, out) → direct MXU dot, no transpose."""
+    if bias is not None:
+        return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias,
+                     name="linear")
+    return apply(jnp.matmul, x, weight, name="linear")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, bb=None):
+        # w: (out, in1, in2)
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out + bb if bb is not None else out
+    if bias is not None:
+        return apply(fn, x1, x2, weight, bias, name="bilinear")
+    return apply(fn, x1, x2, weight, name="bilinear")
+
+
+def _dropout_impl(x, p, training, mode, broadcast_dims=None, name="dropout"):
+    if not training or p == 0.0:
+        return x.clone() if isinstance(x, Tensor) else x
+    if p == 1.0:
+        return apply(lambda a: jnp.zeros_like(a) if mode == "upscale_in_train"
+                     else jnp.zeros_like(a), x, name=name)
+    key = prng.next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if broadcast_dims:
+            for d in broadcast_dims:
+                shape[d] = 1
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return apply(fn, x, name=name)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    bdims = None
+    if axis is not None:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        bdims = [d for d in range(x.ndim) if d not in [a % x.ndim for a in axes]]
+    return _dropout_impl(x, float(p), training, mode, broadcast_dims=bdims)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    bdims = [2, 3] if data_format == "NCHW" else [1, 2]
+    return _dropout_impl(x, float(p), training, "upscale_in_train",
+                         broadcast_dims=bdims, name="dropout2d")
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    bdims = [2, 3, 4] if data_format == "NCDHW" else [1, 2, 3]
+    return _dropout_impl(x, float(p), training, "upscale_in_train",
+                         broadcast_dims=bdims, name="dropout3d")
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x.clone()
+    key = prng.next_key()
+
+    def fn(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, jnp.asarray(alpha_p, a.dtype)) + b_coef
+    return apply(fn, x, name="alpha_dropout")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    return alpha_dropout(x, p, training)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True,
+        name=None):
+    pad_list = [int(unwrap(p)) for p in (pad.tolist() if isinstance(pad, Tensor) else pad)] \
+        if not isinstance(pad, int) else [int(pad)]
+
+    def fn(a):
+        nd = a.ndim
+        if len(pad_list) == 2 * nd:
+            if pad_from_left_axis:
+                widths = [(pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)]
+            else:
+                widths = [(pad_list[2 * (nd - 1 - i)], pad_list[2 * (nd - 1 - i) + 1])
+                          for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last-k spatial dims per data_format
+            k = len(pad_list) // 2
+            widths = [(0, 0)] * nd
+            if data_format.endswith("C") and nd >= 3:  # NLC/NHWC/NDHWC
+                spatial = list(range(1, 1 + k))
+            else:
+                spatial = list(range(nd - k, nd))
+            for j, d in enumerate(spatial):
+                widths[d] = (pad_list[2 * j], pad_list[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode="constant",
+                           constant_values=jnp.asarray(value, a.dtype))
+        return jnp.pad(a, widths, mode=jmode)
+    return apply(fn, x, name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format,
+               pad_from_left_axis=False)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        d1 = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        d2 = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(d1 * d2, eps)
+    return apply(fn, x1, x2, name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1, keepdims=keepdim),
+                         1.0 / p)
+    return apply(fn, x, y, name="pairwise_distance")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(a, int(num_classes),
+                                          dtype=_dt.get_default_dtype()),
+                 x, name="one_hot")
+
+
+def embedding(x, weight, padding_idx=None, max_norm=None, norm_type=2.0,
+              sparse=False, scale_grad_by_freq=False, name=None):
+    def fn(idx, w):
+        if max_norm is not None:
+            norms = jnp.linalg.norm(w, ord=norm_type, axis=-1, keepdims=True)
+            w = w * jnp.minimum(1.0, max_norm / (norms + 1e-7))
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+    return apply(fn, x, weight, name="embedding")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, pd=None):
+        k = l.shape[-1]
+        uniform = pd if pd is not None else jnp.full((k,), 1.0 / k, l.dtype)
+        return (1.0 - epsilon) * l + epsilon * uniform
+    if prior_dist is not None:
+        return apply(fn, label, prior_dist, name="label_smooth")
+    return apply(fn, label, name="label_smooth")
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (NCHW in/out like reference)."""
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = _pair(paddings, 4) if isinstance(paddings, (list, tuple)) and len(paddings) == 4 \
+        else _pair(paddings) * 2
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[2] if len(p) == 4 else p[0]),
+                          (p[1], p[3] if len(p) == 4 else p[1])))
+        hp = a_p.shape[2]
+        wp = a_p.shape[3]
+        oh = (hp - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (wp - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            a_p, filter_shape=k, window_strides=s, padding="VALID",
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: (n, c*k0*k1, oh, ow)
+        return patches.reshape(n, c * k[0] * k[1], oh * ow)
+    return apply(fn, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    out_hw = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    p = _pair(paddings)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_hw[0] + 2 * p[0] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (out_hw[1] + 2 * p[1] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a_r = a.reshape(n, c, k[0], k[1], oh, ow)
+        hp, wp = out_hw[0] + 2 * p[0], out_hw[1] + 2 * p[1]
+        out = jnp.zeros((n, c, hp, wp), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wi = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0], wi:wi + ow * s[1]:s[1]].add(
+                    a_r[:, :, i, j])
+        return out[:, :, p[0]:hp - p[0], p[1]:wp - p[1]]
+    return apply(fn, x, name="fold")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format=None, name=None):
+    if data_format is None:
+        data_format = {3: "NCW", 4: "NCHW", 5: "NCDHW"}[x.ndim]
+    channel_last = data_format[-1] == "C"
+    nsp = x.ndim - 2
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in np.asarray(size._value)]
+        out_sp = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nsp
+        in_sp = x.shape[1:-1] if channel_last else x.shape[2:]
+        out_sp = [int(s * float(unwrap(f))) for s, f in zip(in_sp, sf)]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(a):
+        if channel_last:
+            tgt = (a.shape[0],) + tuple(out_sp) + (a.shape[-1],)
+        else:
+            tgt = a.shape[:2] + tuple(out_sp)
+        if mode == "nearest":
+            return jax.image.resize(a, tgt, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate via explicit grid
+            sp_axes = builtins_range(1, 1 + nsp) if channel_last else builtins_range(2, 2 + nsp)
+            out = a
+            for ax, o in zip(sp_axes, out_sp):
+                n_in = out.shape[ax]
+                if o == 1 or n_in == 1:
+                    idx = jnp.zeros((o,), jnp.float32)
+                else:
+                    idx = jnp.linspace(0.0, n_in - 1.0, o)
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, n_in - 1)
+                wgt = (idx - lo).astype(a.dtype)
+                sl_lo = jnp.take(out, lo, axis=ax)
+                sl_hi = jnp.take(out, hi, axis=ax)
+                shape = [1] * out.ndim
+                shape[ax] = o
+                w = wgt.reshape(shape)
+                out = sl_lo * (1 - w) + sl_hi * w
+            return out
+        return jax.image.resize(a, tgt, method=jmode)
+    builtins_range = range
+    return apply(fn, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format=None, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    lab = np.asarray(unwrap(label))
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rest[: num_samples - len(pos)]
+        sampled = np.concatenate([pos, extra])
+    remap = -np.ones(num_classes, dtype=np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lab])), Tensor(jnp.asarray(sampled)))

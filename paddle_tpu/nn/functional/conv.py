@@ -1,0 +1,171 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py).
+
+TPU-native design: all convs lower to a single lax.conv_general_dilated
+with explicit dimension_numbers — XLA:TPU tiles these onto the MXU.
+Kernel storage layout is (*spatial, in/groups, out) (HWIO-style), the
+layout XLA prefers; NCHW/NHWC input is handled by dimension numbers, not
+transposes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..._core.tensor import apply, unwrap
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+           "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        return tuple(int(x) for x in v) * (n // len(v))
+    return (int(v),) * n
+
+
+def _dim_numbers(nsp, channel_last):
+    if nsp == 1:
+        lhs = "NWC" if channel_last else "NCW"
+        out = lhs
+        rhs = "WIO"
+    elif nsp == 2:
+        lhs = "NHWC" if channel_last else "NCHW"
+        out = lhs
+        rhs = "HWIO"
+    else:
+        lhs = "NDHWC" if channel_last else "NCDHW"
+        out = lhs
+        rhs = "DHWIO"
+    return (lhs, rhs, out)
+
+
+def _padding_arg(padding, nsp, channel_last):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nsp
+    padding = [int(unwrap(p)) for p in padding]
+    if len(padding) == nsp:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nsp)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nsp, name):
+    channel_last = data_format[-1] == "C"
+    dn = _dim_numbers(nsp, channel_last)
+    s = _tuple(stride, nsp)
+    d = _tuple(dilation, nsp)
+    pad_arg = _padding_arg(padding, nsp, channel_last)
+
+    def fn(a, w, b=None):
+        out = lax.conv_general_dilated(
+            a, w, window_strides=s, padding=pad_arg, rhs_dilation=d,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b is not None:
+            bshape = [1] * out.ndim
+            bshape[out.ndim - 1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+    if bias is not None:
+        return apply(fn, x, weight, bias, name=name)
+    return apply(fn, x, weight, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, fmt, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2,
+                 "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3,
+                 "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, data_format, nsp, output_size, name):
+    channel_last = data_format[-1] == "C"
+    dn = _dim_numbers(nsp, channel_last)
+    s = _tuple(stride, nsp)
+    d = _tuple(dilation, nsp)
+    op = _tuple(output_padding, nsp) if output_padding is not None else (0,) * nsp
+
+    if isinstance(padding, str):
+        pad_pairs = None
+        pad_str = padding.upper()
+    else:
+        pad_str = None
+        pad_pairs = _padding_arg(padding, nsp, channel_last)
+
+    def fn_with_flip(a, w, b=None):
+        # transposed conv = conv with lhs_dilation + spatially-flipped kernel,
+        # with in/out swapped: w stored (*spatial, out_c, in_c/groups)
+        wf = jnp.flip(w, axis=tuple(range(nsp)))
+        wf = jnp.swapaxes(wf, -1, -2)  # → (*spatial, in/groups, out)
+        k = w.shape[:nsp]
+        if pad_pairs is not None:
+            pads = []
+            for i in range(nsp):
+                eff_k = d[i] * (k[i] - 1) + 1
+                lo = eff_k - 1 - pad_pairs[i][0]
+                hi = eff_k - 1 - pad_pairs[i][1] + op[i]
+                pads.append((lo, hi))
+        else:
+            if pad_str == "VALID":
+                pads = [(d[i] * (k[i] - 1), d[i] * (k[i] - 1) + op[i]) for i in range(nsp)]
+            else:  # SAME
+                pads = []
+                for i in range(nsp):
+                    eff_k = d[i] * (k[i] - 1) + 1
+                    total = eff_k - s[i] if eff_k > s[i] else 0
+                    lo = eff_k - 1 - total // 2
+                    hi = eff_k - 1 - (total - total // 2) + op[i]
+                    pads.append((lo, hi))
+        out = lax.conv_general_dilated(
+            a, wf, window_strides=(1,) * nsp, padding=pads, lhs_dilation=s,
+            rhs_dilation=d, dimension_numbers=dn, feature_group_count=groups)
+        if b is not None:
+            bshape = [1] * out.ndim
+            bshape[out.ndim - 1 if channel_last else 1] = b.shape[0]
+            out = out + b.reshape(bshape)
+        return out
+
+    if bias is not None:
+        return apply(fn_with_flip, x, weight, bias, name=name)
+    return apply(fn_with_flip, x, weight, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL",
+                     name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, fmt, 1, output_size, "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 2, output_size, "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 3, output_size, "conv3d_transpose")

@@ -1,0 +1,57 @@
+"""Extension functionals (reference: python/paddle/nn/functional/extension.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core import dtypes as _dt
+from ..._core.tensor import apply, unwrap
+
+__all__ = ["sequence_mask", "temporal_shift", "diag_embed", "gather_tree"]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    ml = int(unwrap(maxlen)) if maxlen is not None else \
+        int(np.asarray(unwrap(x)).max())
+    d = _dt.convert_dtype(dtype)
+    return apply(lambda a: (jnp.arange(ml) < a[..., None]).astype(d), x,
+                 name="sequence_mask")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], 1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], 1)
+        mid = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, mid], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(fn, x, name="temporal_shift")
+
+
+from ...tensor.creation import diag_embed  # noqa: E402,F401
+
+
+def gather_tree(ids, parents):
+    def fn(idv, par):
+        T, B, W = idv.shape
+
+        def step(carry, t):
+            beams = carry  # (B, W) current beam indices
+            tok = jnp.take_along_axis(idv[t], beams, axis=1)
+            newbeams = jnp.take_along_axis(par[t], beams, axis=1)
+            return newbeams, tok
+
+        last = jnp.broadcast_to(jnp.arange(W)[None, :], (B, W))
+        _, toks = jax.lax.scan(step, last, jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, axis=0)
+    return apply(fn, ids, parents, name="gather_tree")

@@ -1,0 +1,428 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py).
+
+cross_entropy fuses log_softmax+gather into one XLA graph (the
+reference's softmax_with_cross_entropy fused CUDA kernel is just the
+natural lowering here).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "square_error_cost",
+    "mse_loss", "l1_loss", "smooth_l1_loss", "nll_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "ctc_loss", "huber_loss",
+    "poisson_nll_loss", "gaussian_nll_loss", "sigmoid_focal_loss", "dice_loss",
+    "log_loss", "npair_loss", "multi_label_soft_margin_loss", "soft_margin_loss",
+    "multi_margin_loss", "margin_cross_entropy", "rnnt_loss", "adaptive_log_softmax_with_loss",
+]
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def fn(logits, lab, w=None):
+        ax = axis % logits.ndim
+        nclass = logits.shape[ax]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax) if use_softmax \
+            else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape):
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                soft = (1 - label_smoothing) * soft + label_smoothing / nclass
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if w is not None:
+                wt = jnp.sum(soft * w.reshape((-1,) if ax == logits.ndim - 1 else None),
+                             axis=ax)
+                loss = loss * wt
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logits.ndim:  # trailing 1 dim
+            lab_i = jnp.squeeze(lab_i, axis=ax)
+        valid = lab_i != ignore_index
+        safe_lab = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_lab, ax), axis=ax)
+        picked = jnp.squeeze(picked, axis=ax)
+        if label_smoothing > 0.0:
+            loss = -((1 - label_smoothing) * picked +
+                     label_smoothing * jnp.mean(logp, axis=ax))
+        else:
+            loss = -picked
+        loss = jnp.where(valid, loss, 0.0)
+        if w is not None:
+            wt = jnp.where(valid, jnp.take(w, safe_lab), 0.0)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            cnt = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / cnt
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(fn, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False,
+                               axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+    loss = apply(lambda l: jnp.expand_dims(l, axis), loss, name="unsqueeze_loss")
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label, name="square_error_cost")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+                 name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+                 name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle's smooth_l1_loss multiplies by delta
+        return _reduce(loss * delta, reduction)
+    return apply(fn, input, label, name="smooth_l1_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply(fn, input, label, name="huber_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def fn(logp, lab, w=None):
+        ax = 1 if logp.ndim > 1 else 0
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax), axis=ax)
+        loss = -jnp.squeeze(picked, axis=ax)
+        wt = jnp.take(w, safe) if w is not None else jnp.ones_like(loss)
+        wt = jnp.where(valid, wt, 0.0)
+        loss = loss * wt
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(fn, *args, name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, t, w=None):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(fn, *args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, t, *rest):
+        w = rest[0] if weight is not None else None
+        pw = rest[-1] if pos_weight is not None else None
+        # stable: max(z,0) - z*t + log(1+exp(-|z|)), with pos_weight on positive term
+        if pw is not None:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            loss = -(pw * t * log_sig + (1 - t) * log_sig_neg)
+        else:
+            loss = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(fn, *args, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(logp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - logp)
+        else:
+            loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-30)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(fn, input, label, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, t):
+        return _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction)
+    return apply(fn, input, other, label, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def fn(a, t):
+        loss = jnp.where(t == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply(fn, input, label, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def fn(a, b, t):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply(fn, input1, input2, label, name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), -1), 1 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        return _reduce(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+    return apply(fn, input, positive, negative, name="triplet_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative, distance_function=None,
+                                      margin=1.0, swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin, swap=swap,
+                                   reduction=reduction)
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        d_an_v = apply(lambda x, y: jnp.minimum(x, y), d_an, d_pn, name="min")
+    else:
+        d_an_v = d_an
+    return apply(lambda a, b: _reduce(jnp.maximum(0.0, a - b + margin), reduction),
+                 d_ap, d_an_v, name="triplet_distance_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean", name=None):
+    def fn(z, t, w=None):
+        loss = -(t * jax.nn.log_sigmoid(z) + (1 - t) * jax.nn.log_sigmoid(-z))
+        loss = jnp.mean(loss, axis=-1)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(fn, *args, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply(lambda z, t: _reduce(jnp.log1p(jnp.exp(-t * z)), reduction),
+                 input, label, name="soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None, reduction="mean",
+                      name=None):
+    def fn(z, t, w=None):
+        n, c = z.shape
+        correct = jnp.take_along_axis(z, t[:, None].astype(jnp.int32), axis=1)
+        diff = jnp.maximum(0.0, margin - correct + z)
+        diff = jnp.power(diff, p)
+        if w is not None:
+            diff = diff * jnp.take(w, t.astype(jnp.int32))[:, None]
+        mask = jax.nn.one_hot(t.astype(jnp.int32), c, dtype=z.dtype)
+        loss = jnp.sum(diff * (1 - mask), axis=1) / c
+        return _reduce(loss, reduction)
+    args = [input, label]
+    if weight is not None:
+        args.append(weight)
+    return apply(fn, *args, name="multi_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC via optax-style forward algorithm (logsumexp DP over lax.scan)."""
+    def fn(lp, lab, in_len, lab_len):
+        # lp: (T, B, C) paddle layout
+        T, B, C = lp.shape
+        logp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        S = lab.shape[1]
+        # extended label seq: blank, l1, blank, l2, ... blank → length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        ext_valid = jnp.arange(2 * S + 1)[None, :] < (2 * lab_len[:, None] + 1)
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, logp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((B, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = logp[t][jnp.arange(B)[:, None], ext]
+            new_alpha = merged + emit
+            new_alpha = jnp.where(t < in_len[:, None], new_alpha, alpha)
+            new_alpha = jnp.where(ext_valid, new_alpha, neg_inf)
+            return new_alpha, None
+
+        alphaT, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        last = 2 * lab_len
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alphaT, last[:, None].astype(jnp.int32), 1)[:, 0],
+            jnp.take_along_axis(alphaT, jnp.maximum(last - 1, 0)[:, None].astype(jnp.int32), 1)[:, 0])
+        loss = -ll
+        if norm_by_times:
+            loss = loss / in_len.astype(jnp.float32)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+    return apply(fn, log_probs, labels, input_lengths, label_lengths, name="ctc_loss")
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0, fastemit_lambda=0.001,
+              reduction="mean", name=None):
+    raise NotImplementedError("rnnt_loss: planned (round 2)")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(z, t):
+        if log_input:
+            loss = jnp.exp(z) - t * z
+        else:
+            loss = z - t * jnp.log(z + epsilon)
+        if full:
+            stirling = t * jnp.log(t + 1e-12) - t + 0.5 * jnp.log(2 * np.pi * (t + 1e-12))
+            loss = loss + jnp.where(t > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply(fn, input, label, name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(mu - t) / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return _reduce(loss, reduction)
+    return apply(fn, input, label, variance, name="gaussian_nll_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, t, nrm=None):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        mod = jnp.power(1 - p_t, gamma)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * mod * ce
+        if nrm is not None:
+            loss = loss / nrm
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if normalizer is not None:
+        args.append(normalizer)
+    return apply(fn, *args, name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, t):
+        t_oh = jax.nn.one_hot(jnp.squeeze(t, -1).astype(jnp.int32), p.shape[-1],
+                              dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * t_oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(t_oh, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(fn, input, label, name="dice_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(lambda p, t: -t * jnp.log(p + epsilon) -
+                 (1 - t) * jnp.log(1 - p + epsilon), input, label, name="log_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, lab):
+        sim = a @ p.T
+        eq = (lab[:, None] == lab[None, :]).astype(jnp.float32)
+        eq = eq / jnp.sum(eq, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(-eq * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+    return apply(fn, anchor, positive, labels, name="npair_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    def fn(z, t):
+        ti = t.astype(jnp.int32).reshape(-1)
+        theta = jnp.arccos(jnp.clip(jnp.take_along_axis(z, ti[:, None], 1), -1, 1))
+        target_logit = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(ti, z.shape[-1], dtype=z.dtype)
+        new_z = scale * (z * (1 - onehot) + target_logit * onehot)
+        logp = jax.nn.log_softmax(new_z, 1)
+        loss = -jnp.take_along_axis(logp, ti[:, None], 1)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jax.nn.softmax(new_z, 1)
+        return loss
+    if return_softmax:
+        return apply(fn, logits, label, name="margin_cross_entropy", multi=True)
+    return apply(fn, logits, label, name="margin_cross_entropy")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    raise NotImplementedError("adaptive_log_softmax_with_loss: planned (round 2)")

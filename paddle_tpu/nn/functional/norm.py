@@ -1,0 +1,218 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+All norms are expressed as fusable jnp graphs; XLA fuses
+mean/var/rsqrt/scale into one or two HBM passes on TPU (what the
+reference needs hand-written phi kernels for). SyncBatchNorm's
+cross-device reduction uses psum over the data-parallel mesh axis.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, apply, unwrap
+
+__all__ = ["batch_norm", "layer_norm", "instance_norm", "group_norm",
+           "local_response_norm", "normalize", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               sync_axis=None, name=None):
+    """Functional BN. In training mode updates running stats in-place
+    (imperative parity); compiled training uses Layer's functional path.
+    sync_axis: mesh axis name for SyncBatchNorm psum (tpu-native).
+    """
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    if use_global_stats is None:
+        use_global_stats = not training
+
+    ch_axis = (x.ndim - 1) if channel_last else (1 if x.ndim > 1 else 0)
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    def bshape(ndim):
+        s = [1] * ndim
+        s[ch_axis] = -1
+        return s
+
+    if use_global_stats:
+        def fn(a, rm, rv, w=None, b=None):
+            inv = jax.lax.rsqrt(rv.astype(jnp.float32) + epsilon)
+            out = (a.astype(jnp.float32) - rm.reshape(bshape(a.ndim))) * \
+                inv.reshape(bshape(a.ndim))
+            if w is not None:
+                out = out * w.reshape(bshape(a.ndim))
+            if b is not None:
+                out = out + b.reshape(bshape(a.ndim))
+            return out.astype(a.dtype)
+        args = [x, running_mean, running_var]
+        if weight is not None:
+            args.append(weight)
+        if bias is not None:
+            args.append(bias)
+        return apply(fn, *args, name="batch_norm")
+
+    # training: compute batch stats (optionally psum across dp axis)
+    def fn(a, w=None, b=None):
+        af = a.astype(jnp.float32)
+        if sync_axis is not None:
+            cnt = jax.lax.psum(jnp.asarray(np.prod([a.shape[i] for i in red_axes]),
+                                           jnp.float32), sync_axis)
+            s = jax.lax.psum(jnp.sum(af, axis=red_axes), sync_axis)
+            ss = jax.lax.psum(jnp.sum(af * af, axis=red_axes), sync_axis)
+            mean = s / cnt
+            var = ss / cnt - mean * mean
+        else:
+            mean = jnp.mean(af, axis=red_axes)
+            var = jnp.var(af, axis=red_axes)
+        inv = jax.lax.rsqrt(var + epsilon)
+        out = (af - mean.reshape(bshape(a.ndim))) * inv.reshape(bshape(a.ndim))
+        if w is not None:
+            out = out * w.reshape(bshape(a.ndim))
+        if b is not None:
+            out = out + b.reshape(bshape(a.ndim))
+        return out.astype(a.dtype), mean, var
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    out, mean_t, var_t = apply(fn, *args, name="batch_norm", multi=True)
+
+    if running_mean is not None and isinstance(running_mean, Tensor):
+        m = float(momentum) if not isinstance(momentum, Tensor) else momentum._value
+        rm_new = running_mean._value * m + mean_t._value.astype(running_mean.dtype) * (1 - m)
+        rv_new = running_var._value * m + var_t._value.astype(running_var.dtype) * (1 - m)
+        running_mean._replace(rm_new)
+        running_var._replace(rv_new)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
+        else [normalized_shape]
+    naxes = tuple(range(-len(ns), 0))
+
+    def fn(a, w=None, b=None):
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=naxes, keepdims=True)
+        var = jnp.var(af, axis=naxes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(a.dtype)
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (Llama-family). fp32 accumulation, bf16 in/out on TPU."""
+    def fn(a, w=None):
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(af * af, axis=-1, keepdims=True)
+        out = af * jax.lax.rsqrt(ms + epsilon)
+        if w is not None:
+            out = out * w.astype(jnp.float32)
+        return out.astype(a.dtype)
+    if weight is not None:
+        return apply(fn, x, weight, name="rms_norm")
+    return apply(fn, x, name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+    ch_axis = (x.ndim - 1) if channel_last else 1
+    red_axes = tuple(i for i in range(2, x.ndim)) if not channel_last else \
+        tuple(i for i in range(1, x.ndim - 1))
+
+    def fn(a, w=None, b=None):
+        af = a.astype(jnp.float32)
+        mean = jnp.mean(af, axis=red_axes, keepdims=True)
+        var = jnp.var(af, axis=red_axes, keepdims=True)
+        out = (af - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            s = [1] * a.ndim
+            s[ch_axis] = -1
+            out = out * w.reshape(s)
+        if b is not None:
+            s = [1] * a.ndim
+            s[ch_axis] = -1
+            out = out + b.reshape(s)
+        return out.astype(a.dtype)
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+
+    def fn(a, w=None, b=None):
+        if channel_last:
+            perm_in = list(range(a.ndim))
+            a_nchw = jnp.moveaxis(a, -1, 1)
+        else:
+            a_nchw = a
+        n, c = a_nchw.shape[0], a_nchw.shape[1]
+        g = int(num_groups)
+        af = a_nchw.astype(jnp.float32).reshape(n, g, c // g, -1)
+        mean = jnp.mean(af, axis=(2, 3), keepdims=True)
+        var = jnp.var(af, axis=(2, 3), keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a_nchw.shape)
+        s = [1, c] + [1] * (a_nchw.ndim - 2)
+        if w is not None:
+            out = out * w.reshape(s)
+        if b is not None:
+            out = out + b.reshape(s)
+        out = out.astype(a.dtype)
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+
+    def fn(a):
+        ch_axis = a.ndim - 1 if channel_last else 1
+        sq = jnp.square(a.astype(jnp.float32))
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        half = size // 2
+        padded = jnp.pad(moved, [(0, 0)] * (moved.ndim - 1) + [(half, size - 1 - half)])
+        windows = jnp.stack([padded[..., i:i + moved.shape[-1]] for i in range(size)],
+                            axis=0)
+        summed = jnp.sum(windows, axis=0)
+        denom = jnp.power(k + alpha * summed, beta)
+        out = a.astype(jnp.float32) / jnp.moveaxis(denom, -1, ch_axis)
+        return out.astype(a.dtype)
+    return apply(fn, x, name="local_response_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=True))
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True),
+                          1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply(fn, x, name="normalize")

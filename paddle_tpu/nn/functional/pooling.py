@@ -1,0 +1,351 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+
+All pooling lowers to lax.reduce_window — XLA's native windowed
+reduction, fused and MXU-adjacent on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "max_unpool1d",
+    "max_unpool2d", "max_unpool3d",
+]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(unwrap(x)) for x in v) if len(v) == n else \
+            tuple(int(unwrap(x)) for x in v) * n
+    return (int(unwrap(v)),) * n
+
+
+def _pad_pairs(padding, nsp):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(int(padding),) * 2] * nsp
+    padding = [int(unwrap(p)) for p in padding]
+    if len(padding) == nsp:
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nsp)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _window(nsp, channel_last, k, s):
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    return dims, strides
+
+
+def _full_pads(nsp, channel_last, pads):
+    if isinstance(pads, str):
+        return pads
+    if channel_last:
+        return [(0, 0)] + list(pads) + [(0, 0)]
+    return [(0, 0), (0, 0)] + list(pads)
+
+
+def _pool(x, kernel, stride, padding, nsp, data_format, kind, ceil_mode=False,
+          exclusive=True, name="pool"):
+    channel_last = data_format[-1] == "C"
+    k = _tuple(kernel, nsp)
+    s = _tuple(stride if stride is not None else kernel, nsp)
+    pads = _pad_pairs(padding, nsp)
+    dims, strides = _window(nsp, channel_last, k, s)
+
+    def fn(a):
+        full_pads = _full_pads(nsp, channel_last, pads)
+        if isinstance(full_pads, str):
+            pad_cfg = full_pads
+        else:
+            pad_cfg = full_pads
+            if ceil_mode:
+                # extend upper pads so that ceil-division windows fit
+                pad_cfg = list(pad_cfg)
+                sp_axes = range(1, 1 + nsp) if channel_last else range(2, 2 + nsp)
+                for i, ax in enumerate(sp_axes):
+                    size = a.shape[ax] + pad_cfg[ax][0] + pad_cfg[ax][1]
+                    rem = (size - k[i]) % s[i]
+                    if rem != 0:
+                        pad_cfg[ax] = (pad_cfg[ax][0], pad_cfg[ax][1] + s[i] - rem)
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+                jnp.iinfo(a.dtype).min
+            return lax.reduce_window(a, jnp.asarray(init, a.dtype), lax.max,
+                                     dims, strides, pad_cfg)
+        summed = lax.reduce_window(a, jnp.asarray(0, a.dtype), lax.add, dims,
+                                   strides, pad_cfg)
+        if exclusive and not isinstance(pad_cfg, str):
+            ones = jnp.ones_like(a)
+            counts = lax.reduce_window(ones, jnp.asarray(0, a.dtype), lax.add,
+                                       dims, strides, pad_cfg)
+            return summed / counts
+        denom = float(np.prod(k))
+        return summed / denom
+    return apply(fn, x, name=name)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _pool(x, kernel_size, stride, padding, 1, fmt, "avg", ceil_mode,
+                 exclusive, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", ceil_mode,
+                 exclusive, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", ceil_mode,
+                 exclusive, "avg_pool3d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    out = _pool(x, kernel_size, stride, padding, 1, fmt, "max", ceil_mode,
+                name="max_pool1d")
+    if return_mask:
+        return out, _pool_argmax(x, kernel_size, stride, padding, 1, fmt, ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode,
+                name="max_pool2d")
+    if return_mask:
+        return out, _pool_argmax(x, kernel_size, stride, padding, 2, data_format,
+                                 ceil_mode)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode,
+                name="max_pool3d")
+    if return_mask:
+        return out, _pool_argmax(x, kernel_size, stride, padding, 3, data_format,
+                                 ceil_mode)
+    return out
+
+
+def _pool_argmax(x, kernel, stride, padding, nsp, data_format, ceil_mode):
+    """Indices of max within each window (flattened spatial index)."""
+    channel_last = data_format[-1] == "C"
+    k = _tuple(kernel, nsp)
+    s = _tuple(stride if stride is not None else kernel, nsp)
+    pads = _pad_pairs(padding, nsp)
+    dims, strides = _window(nsp, channel_last, k, s)
+
+    def fn(a):
+        sp_shape = a.shape[1:-1] if channel_last else a.shape[2:]
+        flat_idx = np.arange(int(np.prod(sp_shape))).reshape(sp_shape)
+        if channel_last:
+            idx = jnp.asarray(flat_idx)[None, ..., None]
+        else:
+            idx = jnp.asarray(flat_idx)[None, None]
+        idx = jnp.broadcast_to(idx, a.shape).astype(jnp.int32)
+        full_pads = _full_pads(nsp, channel_last, pads)
+
+        def reducer(xv, yv):
+            xa, xi = xv
+            ya, yi = yv
+            take_y = ya > xa
+            return jnp.where(take_y, ya, xa), jnp.where(take_y, yi, xi)
+
+        init_a = jnp.asarray(-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+                             else jnp.iinfo(a.dtype).min, a.dtype)
+        _, out_idx = lax.reduce_window((a, idx), (init_a, jnp.asarray(0, jnp.int32)),
+                                       reducer, dims, strides, full_pads)
+        return out_idx.astype(jnp.int64)
+    return apply(fn, x, name="max_pool_mask")
+
+
+def _adaptive_axes(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-((np.arange(out_size) + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, nsp, data_format, kind, return_mask=False,
+                   name="adaptive_pool"):
+    channel_last = data_format[-1] == "C"
+    out_sp = _tuple(output_size, nsp) if not isinstance(output_size, int) \
+        else (int(output_size),) * nsp
+    out_sp = tuple(o if o is not None else -1 for o in out_sp)
+
+    def fn(a):
+        sp_axes = list(range(1, 1 + nsp)) if channel_last else list(range(2, 2 + nsp))
+        in_sp = [a.shape[ax] for ax in sp_axes]
+        tgt = [o if o != -1 else i for o, i in zip(out_sp, in_sp)]
+        out = a
+        for ax, (i_sz, o_sz) in zip(sp_axes, zip(in_sp, tgt)):
+            if i_sz == o_sz:
+                continue
+            if i_sz % o_sz == 0:
+                f = i_sz // o_sz
+                moved = jnp.moveaxis(out, ax, -1)
+                moved = moved.reshape(moved.shape[:-1] + (o_sz, f))
+                red = jnp.max(moved, -1) if kind == "max" else jnp.mean(moved, -1)
+                out = jnp.moveaxis(red, -1, ax)
+            else:
+                starts, ends = _adaptive_axes(i_sz, o_sz)
+                slices = []
+                for st, en in zip(starts, ends):
+                    piece = lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                    red = jnp.max(piece, axis=ax, keepdims=True) if kind == "max" \
+                        else jnp.mean(piece, axis=ax, keepdims=True)
+                    slices.append(red)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+    result = apply(fn, x, name=name)
+    if return_mask:
+        mask = _adaptive_argmax(x, out_sp, nsp, channel_last)
+        return result, mask
+    return result
+
+
+def _adaptive_argmax(x, out_sp, nsp, channel_last):
+    def fn(a):
+        sp_axes = list(range(1, 1 + nsp)) if channel_last else list(range(2, 2 + nsp))
+        in_sp = [a.shape[ax] for ax in sp_axes]
+        sp_shape = tuple(in_sp)
+        flat_idx = np.arange(int(np.prod(sp_shape))).reshape(sp_shape)
+        idx = jnp.asarray(flat_idx)
+        idx = idx[None, ..., None] if channel_last else idx[None, None]
+        idx = jnp.broadcast_to(idx, a.shape)
+        out_v = a
+        out_i = idx
+        for ax, (i_sz, o_sz) in zip(sp_axes, zip(in_sp, out_sp)):
+            o_sz = o_sz if o_sz != -1 else i_sz
+            starts, ends = _adaptive_axes(i_sz, o_sz)
+            vs, is_ = [], []
+            for st, en in zip(starts, ends):
+                pv = lax.slice_in_dim(out_v, int(st), int(en), axis=ax)
+                pi = lax.slice_in_dim(out_i, int(st), int(en), axis=ax)
+                am = jnp.argmax(pv, axis=ax, keepdims=True)
+                vs.append(jnp.take_along_axis(pv, am, axis=ax))
+                is_.append(jnp.take_along_axis(pi, am, axis=ax))
+            out_v = jnp.concatenate(vs, axis=ax)
+            out_i = jnp.concatenate(is_, axis=ax)
+        return out_i.astype(jnp.int64)
+    return apply(fn, x, name="adaptive_argmax")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg", name="adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg",
+                          name="adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg",
+                          name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "max", return_mask,
+                          name="adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max", return_mask,
+                          name="adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max", return_mask,
+                          name="adaptive_max_pool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCL", name=None):
+    from ..functional import pooling as _p
+    p = float(norm_type)
+    xp = apply(lambda a: jnp.power(jnp.abs(a), p), x, name="lp_pow")
+    pooled = avg_pool1d(xp, kernel_size, stride, padding, exclusive=False,
+                        ceil_mode=ceil_mode, data_format=data_format)
+    k = kernel_size if isinstance(kernel_size, int) else int(np.prod(kernel_size))
+    return apply(lambda a: jnp.power(a * k, 1.0 / p), pooled, name="lp_root")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    p = float(norm_type)
+    xp = apply(lambda a: jnp.power(jnp.abs(a), p), x, name="lp_pow")
+    pooled = avg_pool2d(xp, kernel_size, stride, padding, ceil_mode=ceil_mode,
+                        exclusive=False, data_format=data_format)
+    ks = _tuple(kernel_size, 2)
+    k = int(np.prod(ks))
+    return apply(lambda a: jnp.power(a * k, 1.0 / p), pooled, name="lp_root")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size, nsp,
+                data_format, name):
+    channel_last = data_format[-1] == "C"
+
+    def fn(a, idx):
+        k = _tuple(kernel_size, nsp)
+        s = _tuple(stride if stride is not None else kernel_size, nsp)
+        p = _tuple(padding, nsp)
+        sp_in = a.shape[1:-1] if channel_last else a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(unwrap(o)) for o in output_size)[-nsp:]
+        else:
+            out_sp = tuple((i - 1) * st - 2 * pp + kk
+                           for i, st, pp, kk in zip(sp_in, s, p, k))
+        if channel_last:
+            n, c = a.shape[0], a.shape[-1]
+            flat = a.reshape(n, -1, c)
+            fidx = idx.reshape(n, -1, c)
+            out = jnp.zeros((n, int(np.prod(out_sp)), c), a.dtype)
+            out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v),
+                                    in_axes=(-1, -1, -1), out_axes=-1))(out, fidx, flat)
+            return out.reshape((n,) + out_sp + (c,))
+        n, c = a.shape[0], a.shape[1]
+        flat = a.reshape(n, c, -1)
+        fidx = idx.reshape(n, c, -1)
+        out = jnp.zeros((n, c, int(np.prod(out_sp))), a.dtype)
+        out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(out, fidx, flat)
+        return out.reshape((n, c) + out_sp)
+    return apply(fn, x, indices, name=name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 1,
+                       "NCW", "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 2,
+                       data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="NCDHW",
+                 output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3,
+                       data_format, "max_unpool3d")

@@ -1,0 +1,136 @@
+"""Vision functionals (reference: python/paddle/nn/functional/vision.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import apply
+
+__all__ = ["pixel_shuffle", "pixel_unshuffle", "channel_shuffle", "affine_grid",
+           "grid_sample"]
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oc = c // (r * r)
+            out = a.reshape(n, oc, r, r, h, w)
+            out = out.transpose(0, 1, 4, 2, 5, 3)
+            return out.reshape(n, oc, h * r, w * r)
+        n, h, w, c = a.shape
+        oc = c // (r * r)
+        out = a.reshape(n, h, w, r, r, oc)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h * r, w * r, oc)
+    return apply(fn, x, name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, c, h // r, r, w // r, r)
+            out = out.transpose(0, 1, 3, 5, 2, 4)
+            return out.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h // r, r, w // r, r, c)
+        out = out.transpose(0, 1, 3, 2, 4, 5)
+        return out.reshape(n, h // r, w // r, c * r * r)
+    return apply(fn, x, name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    g = int(groups)
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            out = a.reshape(n, g, c // g, h, w)
+            return out.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        out = a.reshape(n, h, w, g, c // g)
+        return out.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply(fn, x, name="channel_shuffle")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    shape = [int(s) for s in (out_shape.tolist() if hasattr(out_shape, "tolist")
+                              else out_shape)]
+
+    def fn(th):
+        n, _, h, w = shape[0], shape[1], shape[-2], shape[-1]
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+        grid = jnp.einsum("bij,bkj->bki", th.astype(jnp.float32),
+                          jnp.broadcast_to(base, (n, h * w, 3)))
+        return grid.reshape(n, h, w, 2).astype(th.dtype)
+    return apply(fn, theta, name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0].astype(jnp.float32), g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(px, py):
+            if padding_mode == "border":
+                px = jnp.clip(px, 0, w - 1)
+                py = jnp.clip(py, 0, h - 1)
+                valid = jnp.ones_like(px, bool)
+            elif padding_mode == "reflection":
+                def reflect(v, size):
+                    if align_corners:
+                        span = 2 * (size - 1)
+                        v = jnp.abs(jnp.mod(v + span, span) * 0 + v)
+                        v = jnp.mod(jnp.abs(v), span) if size > 1 else v * 0
+                        return jnp.where(v >= size, span - v, v)
+                    span = 2 * size
+                    v = jnp.mod(jnp.abs(v + 0.5), span)
+                    return jnp.where(v >= size, span - v, v) - 0.5
+                px = jnp.clip(reflect(px, w), 0, w - 1)
+                py = jnp.clip(reflect(py, h), 0, h - 1)
+                valid = jnp.ones_like(px, bool)
+            else:
+                valid = (px >= 0) & (px <= w - 1) & (py >= 0) & (py <= h - 1)
+                px = jnp.clip(px, 0, w - 1)
+                py = jnp.clip(py, 0, h - 1)
+            pxi = px.astype(jnp.int32)
+            pyi = py.astype(jnp.int32)
+            batch_idx = jnp.arange(n).reshape(n, 1, 1)
+            vals = a[batch_idx, :, pyi, pxi]  # (n, gh, gw, c)
+            return jnp.where(valid[..., None], vals, 0.0)
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx), jnp.round(fy))
+            return jnp.moveaxis(out, -1, 1).astype(a.dtype)
+
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (fx - x0) * (y1 - fy)
+        wc = (x1 - fx) * (fy - y0)
+        wd = (fx - x0) * (fy - y0)
+        out = (sample(x0, y0) * wa[..., None] + sample(x1, y0) * wb[..., None] +
+               sample(x0, y1) * wc[..., None] + sample(x1, y1) * wd[..., None])
+        return jnp.moveaxis(out, -1, 1).astype(a.dtype)
+    return apply(fn, x, grid, name="grid_sample")

@@ -1,0 +1,211 @@
+"""Parameter initializers (reference: python/paddle/nn/initializer/*).
+
+Each initializer generates a concrete jax array from the global PRNG —
+initialization is host-side and explicit, so distributed init can shard
+deterministically (same seed → same params on every host).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core import dtypes as _dt
+from ..._core.state import prng
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Dirac", "Orthogonal", "calculate_gain",
+    "set_global_initializer",
+]
+
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "conv1d_transpose": 1.0, "conv2d_transpose": 1.0,
+        "conv3d_transpose": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4.0,
+    }
+    return gains[nonlinearity]
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels stored NHWC-native: (out, *spatial, in) or paddle (out,in,*sp);
+    # we store (spatial..., in, out) for lax.conv — see nn/layer/conv.py
+    receptive = int(np.prod(shape[:-2])) if len(shape) > 2 else 1
+    fan_in = shape[-2] * receptive
+    fan_out = shape[-1] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, param, block=None):
+        new = self._generate(tuple(param.shape), param.dtype)
+        param._replace(new)
+        return param
+
+    def _generate(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self._value = value
+
+    def _generate(self, shape, dtype):
+        return jnp.full(shape, self._value, _dt.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self._mean, self._std = mean, std
+
+    def _generate(self, shape, dtype):
+        z = jax.random.normal(prng.next_key(), shape, jnp.float32)
+        return (self._mean + self._std * z).astype(_dt.convert_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self._mean, self._std, self._a, self._b = mean, std, a, b
+
+    def _generate(self, shape, dtype):
+        lo = (self._a - 0.0)
+        hi = (self._b - 0.0)
+        z = jax.random.truncated_normal(prng.next_key(), lo, hi, shape, jnp.float32)
+        return (self._mean + self._std * z).astype(_dt.convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self._low, self._high = low, high
+
+    def _generate(self, shape, dtype):
+        u = jax.random.uniform(prng.next_key(), shape, jnp.float32,
+                               self._low, self._high)
+        return u.astype(_dt.convert_dtype(dtype))
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self._gain * math.sqrt(2.0 / (fi + fo))
+        z = jax.random.normal(prng.next_key(), shape, jnp.float32) * std
+        return z.astype(_dt.convert_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self._fan_in, self._fan_out, self._gain = fan_in, fan_out, gain
+
+    def _generate(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self._gain * math.sqrt(6.0 / (fi + fo))
+        u = jax.random.uniform(prng.next_key(), shape, jnp.float32, -limit, limit)
+        return u.astype(_dt.convert_dtype(dtype))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self._fan_in = fan_in
+        self._slope = negative_slope
+        self._nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self._nonlinearity, self._slope)
+        std = gain / math.sqrt(fi)
+        z = jax.random.normal(prng.next_key(), shape, jnp.float32) * std
+        return z.astype(_dt.convert_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu", name=None):
+        self._fan_in = fan_in
+        self._slope = negative_slope
+        self._nonlinearity = nonlinearity
+
+    def _generate(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = calculate_gain(self._nonlinearity, self._slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        u = jax.random.uniform(prng.next_key(), shape, jnp.float32, -limit, limit)
+        return u.astype(_dt.convert_dtype(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self._np = np.asarray(value)
+
+    def _generate(self, shape, dtype):
+        a = self._np.reshape(shape)
+        return jnp.asarray(a).astype(_dt.convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self._groups = groups
+
+    def _generate(self, shape, dtype):
+        # kernel layout (spatial..., in, out)
+        a = np.zeros(shape, dtype=np.float32)
+        out_ch, in_ch = shape[-1], shape[-2]
+        centers = tuple(s // 2 for s in shape[:-2])
+        per = out_ch // self._groups
+        for g in range(self._groups):
+            for i in range(min(per, in_ch)):
+                a[centers + (i, g * per + i)] = 1.0
+        return jnp.asarray(a).astype(_dt.convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self._gain = gain
+
+    def _generate(self, shape, dtype):
+        rows = shape[-1]
+        cols = int(np.prod(shape)) // rows
+        flat = jax.random.normal(prng.next_key(), (max(rows, cols), min(rows, cols)),
+                                 jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diag(r))
+        if rows < cols:
+            q = q.T
+        return (self._gain * q[:rows, :cols].T.reshape(shape)).astype(
+            _dt.convert_dtype(dtype))
+
+
+# paddle.nn.initializer module-level aliases used by reference code
+constant = Constant
+normal = Normal
+uniform = Uniform

@@ -1,0 +1,2 @@
+from . import layers  # noqa: F401
+from .layers import Layer  # noqa: F401

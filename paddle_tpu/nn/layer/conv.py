@@ -1,0 +1,135 @@
+"""Conv layers (reference: python/paddle/nn/layer/conv.py).
+
+Kernel weights are stored (*spatial, in/groups, out) — HWIO, the layout
+XLA:TPU wants — instead of the reference's OIHW. state_dict keys match
+the reference; shapes are the TPU-native layout (documented divergence).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import functional as F
+from ..initializer import KaimingUniform, Uniform
+from .layers import Layer
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v) if len(v) == n else tuple(v) * n
+    return (int(v),) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nsp, transposed=False,
+                 stride=1, padding=0, output_padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NCHW"):
+        super().__init__()
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _ntuple(kernel_size, nsp)
+        self._stride = _ntuple(stride, nsp)
+        self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = _ntuple(dilation, nsp)
+        self._groups = groups
+        self._padding_mode = padding_mode
+        self._data_format = data_format
+        self._nsp = nsp
+        if transposed:
+            # (*spatial, out, in/groups)
+            shape = self._kernel_size + (out_channels, in_channels // groups)
+        else:
+            # (*spatial, in/groups, out)
+            shape = self._kernel_size + (in_channels // groups, out_channels)
+        fan_in = in_channels // groups * int(np.prod(self._kernel_size))
+        self.weight = self.create_parameter(
+            shape=shape, attr=weight_attr,
+            default_initializer=KaimingUniform(fan_in=fan_in,
+                                               negative_slope=np.sqrt(5.0),
+                                               nonlinearity="leaky_relu"))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.bias = self.create_parameter(
+            shape=[out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, False, stride,
+                         padding, 0, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, False, stride,
+                         padding, 0, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, False, stride,
+                         padding, 0, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride, self._padding,
+                        self._dilation, self._groups, self._data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, True, stride,
+                         padding, output_padding, dilation, groups, "zeros",
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding, self._groups,
+                                  self._dilation, output_size, self._data_format)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, True, stride,
+                         padding, output_padding, dilation, groups, "zeros",
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding, self._groups,
+                                  self._dilation, output_size, self._data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, groups=1, dilation=1, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, True, stride,
+                         padding, output_padding, dilation, groups, "zeros",
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding, self._groups,
+                                  self._dilation, output_size, self._data_format)

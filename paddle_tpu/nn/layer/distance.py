@@ -1,0 +1,2 @@
+"""Distance layers (reference: python/paddle/nn/layer/distance.py)."""
+from .common import PairwiseDistance, CosineSimilarity  # noqa: F401

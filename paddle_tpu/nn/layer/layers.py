@@ -1,0 +1,416 @@
+"""nn.Layer base class (reference: python/paddle/nn/layer/layers.py).
+
+Paddle-shaped module system with a functional escape hatch:
+`functional_state()` / `functional_call()` turn any Layer tree into a
+pure (params, buffers, inputs) → outputs function — the form jit /
+value_and_grad / pjit consume. That bridge replaces the reference's
+dy2static program translation (python/paddle/jit/dy2static) wholesale.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core import dtypes as _dt
+from ..._core.state import no_grad_ctx
+from ..._core.tensor import Parameter, Tensor, unwrap
+from ..initializer import Constant, XavierUniform, Initializer
+
+
+class ParamAttr:
+    """reference: python/paddle/base/param_attr.py."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, Initializer):
+            return ParamAttr(initializer=attr)
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return False
+        return ParamAttr()
+
+
+_name_counter = {}
+
+
+def _unique_name(prefix):
+    i = _name_counter.get(prefix, 0)
+    _name_counter[prefix] = i + 1
+    return f"{prefix}_{i}"
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+        self._parameters = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._sub_layers = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._full_name = _unique_name(self._name_scope)
+        self._casted_by_pure_fp16 = False
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        elif params is not None and name in params:
+            if value is None:
+                params[name] = None
+            elif isinstance(value, Tensor):
+                params[name] = Parameter(value._value, name=params[name].name)
+            else:
+                raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        elif layers is not None and name in layers:
+            if value is None:
+                layers.pop(name)
+                object.__setattr__(self, name, None)
+            else:
+                object.__setattr__(self, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        if "_parameters" in self.__dict__ and name in self.__dict__["_parameters"]:
+            return self.__dict__["_parameters"][name]
+        if "_sub_layers" in self.__dict__ and name in self.__dict__["_sub_layers"]:
+            return self.__dict__["_sub_layers"][name]
+        if "_buffers" in self.__dict__ and name in self.__dict__["_buffers"]:
+            return self.__dict__["_buffers"][name]
+        raise AttributeError(
+            f"'{self.__class__.__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for d in (self._parameters, self._buffers, self._sub_layers):
+            if name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # -- parameter creation -------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        d = _dt.convert_dtype(dtype) if dtype is not None else self._dtype
+        if default_initializer is None:
+            init = attr.initializer if attr.initializer is not None else \
+                (Constant(0.0) if is_bias else XavierUniform())
+        else:
+            init = attr.initializer if attr.initializer is not None else \
+                default_initializer
+        value = init._generate(tuple(int(s) for s in shape), d)
+        p = Parameter(value, name=attr.name or _unique_name("param"),
+                      trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        d = _dt.convert_dtype(dtype) if dtype is not None else self._dtype
+        t = Tensor(jnp.zeros((), d), name=name)
+        t.persistable = bool(persistable)
+        return t
+
+    create_tensor = create_variable
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        return tensor
+
+    # -- traversal ----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            if not include_sublayers and layer is not self:
+                continue
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def children(self):
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self):
+        seen = set()
+        for name, layer in self._sub_layers.items():
+            if layer is not None and id(layer) not in seen:
+                seen.add(id(layer))
+                yield name, layer
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None or id(layer) in layers_set:
+                continue
+            layers_set.add(id(layer))
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield sub_prefix, layer
+            yield from layer.named_sublayers(prefix=sub_prefix,
+                                             include_self=False,
+                                             layers_set=layers_set)
+
+    def apply(self, fn):
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            skip = False
+            for lname, layer in self.named_sublayers(include_self=True):
+                bn = name.split(".")[-1]
+                if name == (f"{lname}.{bn}" if lname else bn) and \
+                        bn in layer._non_persistable_buffer_names_set:
+                    skip = True
+                    break
+            if not skip:
+                dest[structured_name_prefix + name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        matched = {}
+        for k, v in state_dict.items():
+            if k in own:
+                matched[k] = v
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in matched:
+                missing.append(k)
+        for k, v in matched.items():
+            target = own[k]
+            raw = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            if tuple(raw.shape) != tuple(target._value.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: {raw.shape} vs {target._value.shape}")
+            target._replace(raw.astype(target.dtype))
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- dtype / device -----------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._to_dtype(_dt.convert_dtype(dtype))
+        return self
+
+    def _to_dtype(self, d):
+        for _, p in self.named_parameters():
+            if _dt.is_floating_point_dtype(p.dtype):
+                p._replace(p._value.astype(d))
+        for _, b in self.named_buffers():
+            if b is not None and _dt.is_floating_point_dtype(b.dtype):
+                b._replace(b._value.astype(d))
+        for _, l in self.named_sublayers(include_self=True):
+            l._dtype = d
+        return self
+
+    def astype(self, dtype):
+        return self._to_dtype(_dt.convert_dtype(dtype))
+
+    def float(self):
+        return self._to_dtype(_dt.float32)
+
+    def bfloat16(self):
+        return self._to_dtype(_dt.bfloat16)
+
+    def half(self):
+        return self._to_dtype(_dt.float16)
+
+    def clear_gradients(self, set_to_zero=True):
+        for p in self.parameters():
+            p.grad = None
+
+    # -- functional bridge (tpu-native) -------------------------------------
+    def functional_state(self):
+        """→ (params: {name: raw array}, buffers: {name: raw array})."""
+        params = {n: p._value for n, p in self.named_parameters()}
+        buffers = {n: b._value for n, b in self.named_buffers() if b is not None}
+        return params, buffers
+
+    @contextlib.contextmanager
+    def _swapped_state(self, params=None, buffers=None):
+        saved = []
+        try:
+            if params:
+                own = dict(self.named_parameters())
+                for n, raw in params.items():
+                    p = own[n]
+                    saved.append((p, p._value))
+                    p._value = raw
+            if buffers:
+                ownb = dict(self.named_buffers())
+                for n, raw in buffers.items():
+                    if n in ownb and ownb[n] is not None:
+                        b = ownb[n]
+                        saved.append((b, b._value))
+                        b._value = raw
+            yield
+        finally:
+            for t, old in saved:
+                t._value = old
+
+    def functional_call(self, params, buffers, *args, return_buffers=False,
+                        **kwargs):
+        """Pure call: run forward with the given raw param/buffer arrays.
+
+        Tensors produced inside are unwrapped to raw arrays on return so
+        the result is a clean pytree for jit/grad.
+        """
+        with self._swapped_state(params, buffers):
+            out = self(*args, **kwargs)
+            result = jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            if return_buffers:
+                new_buffers = {n: b._value for n, b in self.named_buffers()
+                               if b is not None}
+                return result, new_buffers
+        return result

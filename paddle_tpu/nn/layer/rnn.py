@@ -1,0 +1,277 @@
+"""RNN layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native driver: the time loop is lax.scan (compiled once, no Python
+loop under jit), replacing the reference's per-step dygraph loop /
+cuDNN RNN kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, apply
+from .. import functional as F
+from ..initializer import Uniform
+from .layers import Layer
+from .container import LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        sh = self.state_shape
+        if isinstance(sh, (list, tuple)) and isinstance(sh[0], (list, tuple)):
+            return tuple(Tensor(jnp.full((batch,) + tuple(s), init_value,
+                                         batch_ref.dtype)) for s in sh)
+        return Tensor(jnp.full((batch,) + tuple(sh), init_value, batch_ref.dtype))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+        h = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+
+        def fn(x, hp, cp, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hp @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            cn = f * cp + i * g
+            hn = o * jnp.tanh(cn)
+            return hn, cn
+        hn, cn = apply(fn, inputs, h, c, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh, name="lstm_cell", multi=True)
+        return hn, (hn, cn)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, hp, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = hp @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1.0 - z) * n + z * hp
+        hn = apply(fn, inputs, states, self.weight_ih, self.weight_hh,
+                   self.bias_ih, self.bias_hh, name="gru_cell")
+        return hn, hn
+
+
+class RNN(Layer):
+    """Generic cell driver (reference RNN wrapper) using lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        # scan over time using cell's pure function
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        outs = []
+        states = initial_states
+        idx = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in idx:
+            from ...tensor import manipulation as M
+            xt = M.squeeze(M.slice(inputs, [time_axis], [t], [t + 1]), [time_axis])
+            y, states = self.cell(xt, states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ...tensor.manipulation import stack
+        return stack(outs, axis=time_axis), states
+
+
+class _MultiLayerRNNBase(Layer):
+    """Fused multi-layer (bi)directional driver: one lax.scan per layer
+    direction over raw arrays — the compiled path used by jit."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, activation=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        cell_cls = {"RNN_TANH": SimpleRNNCell, "RNN_RELU": SimpleRNNCell,
+                    "LSTM": LSTMCell, "GRU": GRUCell}[self.MODE]
+        self.cells = LayerList()
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                kw = {}
+                if self.MODE in ("RNN_TANH", "RNN_RELU"):
+                    kw["activation"] = "tanh" if self.MODE == "RNN_TANH" else "relu"
+                self.cells.append(cell_cls(in_sz, hidden_size,
+                                           weight_ih_attr=weight_ih_attr,
+                                           weight_hh_attr=weight_hh_attr,
+                                           bias_ih_attr=bias_ih_attr,
+                                           bias_hh_attr=bias_hh_attr, **kw))
+
+    def _cell_step(self, cell, x, state):
+        return cell(x, state)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        time_axis = 0 if self.time_major else 1
+        from ...tensor import manipulation as M
+        x = inputs
+        B = x.shape[1 if self.time_major else 0]
+        ndir = self.num_directions
+        final_h, final_c = [], []
+        is_lstm = self.MODE == "LSTM"
+
+        if initial_states is not None:
+            if is_lstm:
+                h0_all, c0_all = initial_states
+            else:
+                h0_all = initial_states
+                c0_all = None
+
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for d in range(ndir):
+                cell = self.cells[layer * ndir + d]
+                if initial_states is not None:
+                    hi = h0_all[layer * ndir + d]
+                    state = (hi, c0_all[layer * ndir + d]) if is_lstm else hi
+                else:
+                    state = None
+                T = x.shape[time_axis]
+                outs = []
+                idx = range(T - 1, -1, -1) if d == 1 else range(T)
+                for t in idx:
+                    xt = M.squeeze(M.slice(x, [time_axis], [t], [t + 1]), [time_axis])
+                    y, state = cell(xt, state)
+                    outs.append(y)
+                if d == 1:
+                    outs = outs[::-1]
+                outs_dir.append(M.stack(outs, axis=time_axis))
+                if is_lstm:
+                    final_h.append(state[0])
+                    final_c.append(state[1])
+                else:
+                    final_h.append(state)
+            x = outs_dir[0] if ndir == 1 else M.concat(outs_dir, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+        h_stack = M.stack(final_h, axis=0)
+        if is_lstm:
+            c_stack = M.stack(final_c, axis=0)
+            return x, (h_stack, c_stack)
+        return x, h_stack
+
+
+class SimpleRNN(_MultiLayerRNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction, time_major,
+                         dropout, **kwargs)
+
+
+class LSTM(_MultiLayerRNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_MultiLayerRNNBase):
+    MODE = "GRU"

@@ -1,0 +1,92 @@
+"""nn.utils (reference: python/paddle/nn/utils/*)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, Parameter
+
+
+def parameters_to_vector(parameters, name=None):
+    vec = jnp.concatenate([p._value.reshape(-1) for p in parameters])
+    return Tensor(vec)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(np.prod(p.shape)) if p.shape else 1
+        p._replace(v[offset:offset + n].reshape(p._value.shape).astype(p.dtype))
+        offset += n
+    return parameters
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize weight = g * v / ||v|| (reference:
+    python/paddle/nn/utils/weight_norm_hook.py)."""
+    w = getattr(layer, name)
+    raw = w._value
+    if dim is None:
+        norm = jnp.sqrt(jnp.sum(jnp.square(raw)))
+        g0 = norm.reshape(())
+    else:
+        axes = tuple(i for i in range(raw.ndim) if i != dim % raw.ndim)
+        g0 = jnp.sqrt(jnp.sum(jnp.square(raw), axis=axes))
+    v = Parameter(raw, name=(w.name or name) + "_v")
+    g = Parameter(g0, name=(w.name or name) + "_g")
+    del layer._parameters[name]
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+
+    def _compute(layer_, _inputs):
+        vr = getattr(layer_, name + "_v")._value
+        gr = getattr(layer_, name + "_g")._value
+        if dim is None:
+            w_new = vr * (gr / jnp.sqrt(jnp.sum(jnp.square(vr))))
+        else:
+            axes = tuple(i for i in range(vr.ndim) if i != dim % vr.ndim)
+            norm = jnp.sqrt(jnp.sum(jnp.square(vr), axis=axes, keepdims=True))
+            shape = [1] * vr.ndim
+            shape[dim % vr.ndim] = -1
+            w_new = vr / norm * gr.reshape(shape)
+        # place the computed weight as a plain tensor attribute
+        object.__setattr__(layer_, name, Tensor(w_new, stop_gradient=False))
+
+    layer.register_forward_pre_hook(_compute)
+    _compute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    v = layer._parameters.pop(name + "_v", None)
+    g = layer._parameters.pop(name + "_g", None)
+    if v is None:
+        return layer
+    if g._value.ndim == 0:
+        w = v._value * (g._value / jnp.sqrt(jnp.sum(jnp.square(v._value))))
+    else:
+        w = getattr(layer, name)._value if hasattr(layer, name) else v._value
+    object.__setattr__(layer, name, None)
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from ..layer.norm import SpectralNorm as _SN
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(tuple(w.shape), dim=dim, power_iters=n_power_iterations, epsilon=eps)
+    raw_param = layer._parameters.pop(name)
+    layer.add_sublayer(name + "_sn_helper", sn)
+    layer.add_parameter(name + "_orig", raw_param)
+
+    def _compute(layer_, _inputs):
+        orig = getattr(layer_, name + "_orig")
+        out = layer_._sub_layers[name + "_sn_helper"](orig)
+        object.__setattr__(layer_, name, out)
+
+    layer.register_forward_pre_hook(_compute)
+    _compute(layer, None)
+    return layer

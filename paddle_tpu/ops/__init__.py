@@ -1,0 +1,11 @@
+"""paddle_tpu.ops: pallas TPU kernels + fused ops.
+
+This package is the TPU analogue of the reference's hand-written CUDA
+kernel library (paddle/phi/kernels/fusion/*): only ops where XLA fusion
+isn't enough get custom kernels — attention family, MoE dispatch, RoPE.
+"""
+from .flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_bhsd, mha_reference,
+)
+from .rope import apply_rotary_emb, rope_cos_sin  # noqa: F401
+from .fused import fused_rms_norm, fused_swiglu, fused_dropout_add  # noqa: F401

@@ -1,0 +1,391 @@
+"""Flash attention for TPU — pallas kernels (fwd + bwd).
+
+Replaces the reference's CUDA flash-attn integration
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu,
+python/paddle/nn/functional/flash_attention.py) with a TPU-native
+blockwise online-softmax kernel:
+
+  * forward: grid (batch*heads, q_blocks, k_blocks); fp32 running
+    (m, l, acc) scratch in VMEM persists across the sequential k grid
+    dimension; saves per-row logsumexp L for the backward.
+  * backward: one pass for dQ (grid over q), one for dK/dV (grid over
+    k), both recomputing P = exp(QKᵀ·scale − L) block-wise — O(S) memory.
+  * causal masking skips fully-masked k blocks via @pl.when predication.
+
+Falls back to a pure-XLA reference implementation off-TPU (and for
+features the kernel doesn't cover: arbitrary masks, dropout).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference (pure XLA) implementation — correctness baseline + fallback.
+# ---------------------------------------------------------------------------
+def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
+    """q,k,v: (B, H, S, D). Returns (out, logsumexp)."""
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
+                scale, causal, block_q, block_k, n_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def body():
+        q = q_ref[0]  # (block_q, d)
+        k = k_ref[0]  # (block_k, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    if causal:
+        # skip blocks fully above the diagonal
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+
+
+def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = pl.cdiv(sq, block_q)
+    n_k = pl.cdiv(sk, block_k)
+    bh = b * h
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, n_k=n_k)
+    mem = pltpu.VMEM if _HAS_PLTPU else None
+    spec = lambda bs, im: pl.BlockSpec(bs, im, memory_space=mem) if mem else \
+        pl.BlockSpec(bs, im)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+        ],
+        out_specs=[
+            spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            spec((1, block_q), lambda bh_, qi, ki: (bh_, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ] if _HAS_PLTPU else [],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, n_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(ds, k.astype(jnp.float32),
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(ki == n_k - 1)
+    def _fin():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k, n_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q.astype(jnp.float32),
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            body()
+    else:
+        body()
+
+    @pl.when(qi == n_q - 1)
+    def _fin():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = pl.cdiv(sq, block_q)
+    n_k = pl.cdiv(sk, block_k)
+    bh = b * h
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    qr, kr, vr = (t.reshape(bh, -1, d) for t in (q, k, v))
+    dor = do.reshape(bh, sq, d)
+    lser = lse.reshape(bh, sq)
+    deltar = delta.reshape(bh, sq)
+
+    mem = pltpu.VMEM if _HAS_PLTPU else None
+    spec = lambda bs, im: pl.BlockSpec(bs, im, memory_space=mem) if mem else \
+        pl.BlockSpec(bs, im)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
+            spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
+            spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
+            spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
+            spec((1, block_q), lambda b_, qi, ki: (b_, qi)),
+            spec((1, block_q), lambda b_, qi, ki: (b_, qi)),
+        ],
+        out_specs=[spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)] if _HAS_PLTPU else [],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, 0)),
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
+            spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, 0)),
+            spec((1, block_q), lambda b_, ki, qi: (b_, qi)),
+            spec((1, block_q), lambda b_, ki, qi: (b_, qi)),
+        ],
+        out_specs=[
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ] if _HAS_PLTPU else [],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, deltar)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_mha(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_mha_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q,
+                             block_k, interpret)
+    return dq, dk, dv
+
+
+_flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+
+
+def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None,
+                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                         use_pallas=None, interpret=None):
+    """Core entry: q,k,v (B,H,S,D) → (B,H,S,D).
+
+    use_pallas defaults to True on TPU; off-TPU uses the XLA reference
+    (pallas interpret mode is available for kernel tests via interpret=True).
+    """
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not use_pallas:
+        o, _ = mha_reference(q, k, v, None, causal, scale)
+        return o
+    return _flash_mha(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, sm_scale=None, training=True,
+                    use_pallas=None, **kwargs):
+    """Paddle-compatible surface: q,k,v (B, S, H, D) like
+    python/paddle/nn/functional/flash_attention.py. Returns (out, None).
+    """
+    q = jnp.swapaxes(query, 1, 2)
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    # GQA: repeat kv heads if fewer than q heads
+    hq, hk = q.shape[1], k.shape[1]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    o = flash_attention_bhsd(q, k, v, causal=causal, sm_scale=sm_scale,
+                             use_pallas=use_pallas)
+    if dropout > 0.0 and training:
+        from .._core.state import prng
+        keep = jax.random.bernoulli(prng.next_key(), 1.0 - dropout, o.shape)
+        o = jnp.where(keep, o / (1.0 - dropout), 0.0)
+    out = jnp.swapaxes(o, 1, 2)
+    return (out, None) if not return_softmax else (out, None, None)

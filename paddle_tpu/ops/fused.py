@@ -1,0 +1,30 @@
+"""Fused-op building blocks (reference: paddle/phi/kernels/fusion/*).
+
+On TPU these are jnp expressions XLA fuses into single HBM passes; they
+exist as named ops so models/incubate map 1:1 to the reference surface.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_rms_norm(x, weight, epsilon=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(ms + epsilon) * weight.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def fused_swiglu(x, gate_w, up_w, down_w):
+    """SwiGLU MLP: down( silu(x@gate) * (x@up) ) — one fused XLA graph."""
+    g = jnp.matmul(x, gate_w)
+    u = jnp.matmul(x, up_w)
+    return jnp.matmul(jax.nn.silu(g) * u, down_w)
+
+
+def fused_dropout_add(x, residual, p, key, training=True):
+    if not training or p == 0.0:
+        return x + residual
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0) + residual

@@ -1,0 +1,42 @@
+"""Rotary position embedding (reference: paddle/phi/kernels/fusion/gpu/
+fused_rope_* and PaddleNLP's RotaryEmbedding).
+
+Pure-XLA implementation: on TPU the rotate-half + multiply fuses into
+the surrounding attention matmuls; a pallas kernel buys nothing here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(seq_len, head_dim, base=10000.0, dtype=jnp.float32,
+                 position_ids=None):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                               / head_dim))
+    if position_ids is None:
+        t = jnp.arange(seq_len, dtype=jnp.float32)
+    else:
+        t = position_ids.astype(jnp.float32)
+    freqs = jnp.einsum("...s,d->...sd", t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_emb(q, k, cos, sin):
+    """q,k: (..., S, H, D) or (..., H, S, D) with cos/sin (..., S, D):
+    caller aligns; S must broadcast along the -2 of cos/sin insertion."""
+    # cos/sin: (S, D) → broadcast over batch and heads at axis -2
+    while cos.ndim < q.ndim:
+        cos = cos[None]
+        sin = sin[None]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    q_out = qf * cos + _rotate_half(qf) * sin
+    k_out = kf * cos + _rotate_half(kf) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)

@@ -1,0 +1,7 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/__init__.py)."""
+from .optimizer import Optimizer  # noqa: F401
+from .rules import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
+    NAdam, RAdam, ASGD, Rprop, Lion, LBFGS,
+)
+from . import lr  # noqa: F401

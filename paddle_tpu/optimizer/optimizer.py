@@ -1,0 +1,193 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py).
+
+Every optimizer is defined by a *pure* per-parameter update rule
+`_rule(p, g, slots, lr) → (new_p, new_slots)` over raw jnp arrays.
+The imperative `step()` (paddle dygraph parity) and the functional
+`apply_gradients()` (compiled pjit training path) share that rule, so
+eager and compiled training are bit-identical.
+
+Multi-precision: bf16/fp16 params keep fp32 master weights in slots
+(reference: multi_precision flag on phi optimizer kernels).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.tensor import Parameter, Tensor
+from ..regularizer import L1Decay, L2Decay
+
+
+class Optimizer:
+    _slot_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False, **kwargs):
+        from .lr import LRScheduler
+        self._parameter_list = list(parameters) if parameters is not None else None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            # param groups
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        else:
+            self._param_groups = None
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float):
+            self._regularization = L2Decay(weight_decay)
+            self._weight_decay = weight_decay
+        elif isinstance(weight_decay, (L1Decay, L2Decay)):
+            self._regularization = weight_decay
+            self._weight_decay = weight_decay.coeff
+        else:
+            self._regularization = None
+            self._weight_decay = 0.0
+        self._accumulators: dict = {}
+        self._global_step = 0
+
+    # ------------------------------------------------------------------ lr
+    def get_lr(self):
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ------------------------------------------------------------- slots
+    def _create_slots(self, p):
+        """Default: zeros_like fp32 slot per name + step counter."""
+        slots = {name: jnp.zeros_like(p, dtype=jnp.float32)
+                 for name in self._slot_names}
+        slots["step"] = jnp.zeros((), jnp.int32)
+        if self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16):
+            slots["master"] = p.astype(jnp.float32)
+        return slots
+
+    def _rule(self, p, g, slots, lr):
+        raise NotImplementedError
+
+    def _apply_one(self, p_raw, g_raw, slots, lr, param_lr=1.0, regularizer=None):
+        """Shared pure update incl. master weights + l1/l2 decay-on-grad."""
+        reg = regularizer if regularizer is not None else self._regularization
+        work = slots.get("master", p_raw)
+        g32 = g_raw.astype(jnp.float32) if work.dtype == jnp.float32 else g_raw
+        if reg is not None and not isinstance(self, _DecoupledWeightDecayMixin):
+            g32 = reg(work.astype(g32.dtype), g32)
+        slots = dict(slots)
+        slots["step"] = slots["step"] + 1
+        new_work, slots = self._rule(work, g32, slots, lr * param_lr)
+        if "master" in slots:
+            slots["master"] = new_work
+            new_p = new_work.astype(p_raw.dtype)
+        else:
+            new_p = new_work.astype(p_raw.dtype)
+        return new_p, slots
+
+    # ------------------------------------------------------ imperative API
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if isinstance(p, Parameter) and not p.stop_gradient]
+        params_grads = [(p, p.grad) for p in params if p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            key = id(p)
+            if key not in self._accumulators:
+                self._accumulators[key] = self._create_slots(p._value)
+            param_lr = p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else 1.0
+            new_p, self._accumulators[key] = self._apply_one(
+                p._value, g._value, self._accumulators[key], lr, param_lr,
+                regularizer=getattr(p, "regularizer", None) or self._regularization)
+            p._replace(new_p)
+        self._global_step += 1
+
+    minimize_step = step
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list or []]
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # ------------------------------------------------------ functional API
+    def init_state(self, params_tree):
+        """params_tree: pytree of raw arrays → state pytree."""
+        return jax.tree_util.tree_map(lambda p: self._create_slots(p), params_tree)
+
+    def apply_gradients(self, params_tree, grads_tree, state_tree, lr=None):
+        """Pure update over pytrees; jit/pjit-safe. lr may be traced."""
+        lr = self.get_lr() if lr is None else lr
+
+        def upd(p, g, slots):
+            return self._apply_one(p, g, slots, lr)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params_tree)
+        flat_g = jax.tree_util.tree_flatten(grads_tree)[0]
+        flat_s = treedef.flatten_up_to(state_tree)
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = upd(p, g, s)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                jax.tree_util.tree_unflatten(treedef, new_s))
+
+    # -------------------------------------------------------- state dict
+    def state_dict(self):
+        sd = OrderedDict()
+        for i, p in enumerate(self._parameter_list or []):
+            acc = self._accumulators.get(id(p))
+            if acc is None:
+                continue
+            for k, v in acc.items():
+                sd[f"{p.name or i}_{k}"] = Tensor(v)
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["@global_step"] = self._global_step
+        return sd
+
+    def set_state_dict(self, state_dict):
+        from .lr import LRScheduler
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        self._global_step = state_dict.get("@global_step", 0)
+        for i, p in enumerate(self._parameter_list or []):
+            prefix = f"{p.name or i}_"
+            acc = {}
+            for k, v in state_dict.items():
+                if isinstance(k, str) and k.startswith(prefix):
+                    raw = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    acc[k[len(prefix):]] = raw
+            if acc:
+                self._accumulators[id(p)] = acc
+
+    load_state_dict = set_state_dict
+
+
+class _DecoupledWeightDecayMixin:
+    """Marker: weight decay applied in rule (AdamW/Lamb/Lion style)."""

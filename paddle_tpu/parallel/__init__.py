@@ -1,0 +1,17 @@
+"""paddle_tpu.parallel: TPU-native parallelism core.
+
+Mesh + GSPMD sharding (tp/dp/fsdp), shard_map pipelines (pp), ring
+attention (sp/context parallel), MoE expert parallel (ep), and the
+compiled hybrid-parallel Trainer. The paddle-compatible fleet API in
+paddle_tpu.distributed.fleet delegates here.
+"""
+from .mesh import create_mesh, get_mesh, sharding_for, replicated, fsdp_spec  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from .tp import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, annotate_module_tp, mark_sequence_parallel,
+)
+from .pp import pipeline_apply, stack_layer_params, group_stages, LayerDesc, \
+    PipelineLayer  # noqa: F401
+from .ring import ring_attention, ring_attention_local, sequence_shard  # noqa: F401
+from .moe import MoELayer, moe_ffn_apply, top_k_gating  # noqa: F401

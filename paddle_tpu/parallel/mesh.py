@@ -1,0 +1,79 @@
+"""Device mesh construction (replaces fleet's HybridCommunicateGroup
+topology over NCCL groups — reference: python/paddle/distributed/fleet/
+base/topology.py — with a jax.sharding.Mesh over ICI).
+
+Axis convention (outer→inner, matching ICI locality preferences):
+  pp (slowest, smallest traffic) → dp → fsdp/sharding → sp/ep → tp (fastest,
+  biggest collectives ride the innermost ICI ring).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+STANDARD_AXES = ("pp", "dp", "tp")
+
+
+def create_mesh(axes=None, devices=None, **axis_sizes):
+    """create_mesh({'dp': 2, 'tp': 4}) or create_mesh(dp=2, tp=4).
+
+    Unspecified leftover devices fold into 'dp'. -1 on one axis = infer.
+    """
+    if axes is None:
+        axes = dict(axis_sizes)
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    names = list(axes.keys())
+    sizes = [int(v) for v in axes.values()]
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        if n % total == 0:
+            names.insert(0, "dp") if "dp" not in names else None
+            if "dp" in axes:
+                raise ValueError(f"mesh {axes} does not cover {n} devices")
+            sizes.insert(0, n // total)
+        else:
+            raise ValueError(f"mesh sizes {axes} incompatible with {n} devices")
+    mesh = Mesh(devices.reshape(sizes), tuple(names))
+    from ..distributed import env
+    env.set_global_mesh(mesh)
+    return mesh
+
+
+def get_mesh():
+    from ..distributed import env
+    return env.get_global_mesh()
+
+
+def sharding_for(mesh, spec):
+    return NamedSharding(mesh, spec if isinstance(spec, P) else P(*spec))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def axis_size(mesh, name):
+    return mesh.shape[name] if name in mesh.shape else 1
+
+
+def fsdp_spec(shape, mesh, axis="dp", min_size=1024):
+    """FSDP/ZeRO-3 param spec: shard the largest axis divisible by the dp
+    axis size (XLA all-gathers on use — ZeRO semantics via GSPMD)."""
+    if axis not in mesh.shape:
+        return P()
+    n = mesh.shape[axis]
+    size = int(np.prod(shape)) if shape else 0
+    if size < min_size:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in dims:
+        if shape[d] % n == 0:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()

@@ -1,0 +1,137 @@
+"""Tensor-parallel layers (reference: python/paddle/distributed/fleet/
+layers/mpu/mp_layers.py — ColumnParallelLinear etc. over NCCL).
+
+TPU-native: layers carry PartitionSpec annotations on their weights; the
+GSPMD partitioner inserts the all-reduce/all-gather that megatron does
+by hand. No manual collectives, same math, and XLA can overlap them with
+compute on ICI. `gather_output`/`input_is_parallel` map onto output
+sharding constraints.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .._core.tensor import Tensor, apply
+from ..nn import functional as F
+from ..nn.initializer import Constant, XavierUniform, Normal
+from ..nn.layer.layers import Layer
+
+
+def _constrain(x, spec, mesh=None):
+    """sharding_constraint as a differentiable op (identity outside jit)."""
+    from .mesh import get_mesh
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return x
+
+    def fn(a):
+        try:
+            return jax.lax.with_sharding_constraint(
+                a, jax.sharding.NamedSharding(mesh, spec))
+        except Exception:
+            return a
+    return apply(fn, x, name="sharding_constraint")
+
+
+class ColumnParallelLinear(Layer):
+    """Weight (in, out) sharded over tp on the out axis."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None, tp_axis="tp"):
+        super().__init__()
+        self.gather_output = gather_output
+        self.tp_axis = tp_axis
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.dist_spec = P(None, tp_axis)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_spec = P(tp_axis)
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            out = _constrain(out, P(None, None, self.tp_axis) if out.ndim == 3
+                             else P(None, self.tp_axis))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight (in, out) sharded over tp on the in axis; GSPMD inserts the
+    psum megatron does explicitly."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None, tp_axis="tp"):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.tp_axis = tp_axis
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight.dist_spec = P(tp_axis, None)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.dist_spec = P()
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None, tp_axis="tp"):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 0.02))
+        self.weight.dist_spec = P(tp_axis, None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel softmax CE: with logits sharded over tp on the vocab
+    axis GSPMD partitions log_softmax's reductions automatically."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def mark_sequence_parallel(x, sp_axis="tp", seq_dim=1):
+    """Megatron-SP: shard activations' sequence dim over the tp axis
+    between attention/MLP blocks (norm/dropout run sequence-sharded)."""
+    spec = [None] * x.ndim
+    spec[seq_dim] = sp_axis
+    return _constrain(x, P(*spec))
+
+
+def annotate_module_tp(model, rules, tp_axis="tp"):
+    """Apply {param-name-glob: PartitionSpec} rules to a Layer tree
+    (auto-TP; reference: fleet.meta_parallel tensor_parallel mappings)."""
+    import fnmatch
+    for name, p in model.named_parameters():
+        for pattern, spec in rules.items():
+            if fnmatch.fnmatch(name, pattern):
+                p.dist_spec = spec if isinstance(spec, P) else P(*spec)
+                p.is_distributed = True
+                break
+    return model

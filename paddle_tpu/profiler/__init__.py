@@ -1,0 +1,153 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py).
+
+Wraps jax.profiler: traces are Perfetto/XPlane (TensorBoard-compatible),
+replacing the reference's CUPTI/nvprof collection. summary() reports
+host-side op timings from our dispatch-layer TraceEvent ring.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        total = closed + ready + record
+        if repeat and s >= total * repeat:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+    return handler
+
+
+export_protobuf = export_chrome_tracing
+
+
+class Profiler:
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self._dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/pt_profile")
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._active = False
+        self._step = 0
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        if not self._timer_only:
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._active = True
+            except Exception:
+                self._active = False
+        self._last = time.perf_counter()
+
+    def stop(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        ts = np.asarray(self._step_times[-10:])
+        return (f"avg step {ts.mean()*1000:.2f} ms, ips "
+                f"{1.0/ts.mean():.2f} steps/s")
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
+                thread_sep=False, time_unit="ms", views=None):
+        from ..utils.trace import summary as trace_summary
+        print(trace_summary())
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class RecordEvent:
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError("load XPlane dumps with TensorBoard")

@@ -1,0 +1,24 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py)."""
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (applied in optimizer update)."""
+
+    def __call__(self, param_raw, grad_raw):
+        return grad_raw + self._coeff * param_raw
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __call__(self, param_raw, grad_raw):
+        import jax.numpy as jnp
+        return grad_raw + self._coeff * jnp.sign(param_raw)

@@ -1,0 +1,72 @@
+"""Sparse tensor subset (reference: python/paddle/sparse).
+
+COO support via jax.experimental.sparse.BCOO. TPU note: XLA prefers
+dense compute; sparse here targets API parity + embedding-style use.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from .._core.tensor import Tensor, unwrap
+
+
+class SparseCooTensor(Tensor):
+    def __init__(self, bcoo, stop_gradient=True):
+        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+        self._bcoo = bcoo
+
+    def indices(self):
+        return Tensor(jnp.asarray(self._bcoo.indices.T))
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = jnp.asarray(unwrap(indices)).T
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from .._core import dtypes as _dt
+        vals = vals.astype(_dt.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(idx).max(axis=0))
+    b = jsparse.BCOO((vals, idx), shape=tuple(shape))
+    return SparseCooTensor(b, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows_np = np.asarray(unwrap(crows))
+    cols_np = np.asarray(unwrap(cols))
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    return sparse_coo_tensor(idx, values, shape, dtype, place, stop_gradient)
+
+
+def matmul(x, y, name=None):
+    a = x._bcoo if isinstance(x, SparseCooTensor) else unwrap(x)
+    b = y._bcoo if isinstance(y, SparseCooTensor) else unwrap(y)
+    out = a @ b
+    if isinstance(out, jsparse.BCOO):
+        return SparseCooTensor(out)
+    return Tensor(out)
+
+
+def add(x, y, name=None):
+    return Tensor(unwrap(x) + unwrap(y))
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)

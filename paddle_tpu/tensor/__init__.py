@@ -1,0 +1,138 @@
+"""paddle_tpu.tensor: op namespace + Tensor method stitching.
+
+Mirrors python/paddle/tensor/__init__.py, which monkey-patches the op
+surface onto the C++ Tensor; here we patch the same surface onto the
+pure-python Tensor.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, Parameter, apply, unwrap, wrap
+from . import creation, math, linalg, manipulation, logic, random, search, stat, \
+    einsum as _einsum_mod, attribute
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .attribute import is_complex, is_floating_point, is_integer, rank  # noqa: F401
+
+
+def _coerce(other):
+    if isinstance(other, Tensor):
+        return other
+    return other  # scalars stay raw: jnp handles weak-typed promotion
+
+
+# ---------------------------------------------------------------- operators
+def _binop(fn, swap=False):
+    def op(self, other):
+        other = _coerce(other)
+        if swap:
+            return fn(other if isinstance(other, Tensor) else creation.to_tensor(other), self)
+        return fn(self, other) if isinstance(other, Tensor) else \
+            apply(lambda a: _raw_bin(fn, a, other), self, name=fn.__name__)
+    return op
+
+
+def _raw_bin(fn, a, other):
+    # scalar fast path: keep python scalars weakly typed for paddle-like promotion
+    jf = {"add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+          "divide": jnp.true_divide, "floor_divide": jnp.floor_divide,
+          "mod": jnp.mod, "pow": jnp.power, "maximum": jnp.maximum,
+          "minimum": jnp.minimum}.get(fn.__name__)
+    if jf is None:
+        return unwrap(fn(wrap(a), other))
+    return jf(a, other)
+
+
+_cmp_table = [
+    ("__eq__", logic.equal), ("__ne__", logic.not_equal),
+    ("__lt__", logic.less_than), ("__le__", logic.less_equal),
+    ("__gt__", logic.greater_than), ("__ge__", logic.greater_equal),
+]
+
+Tensor.__add__ = _binop(math.add)
+Tensor.__radd__ = _binop(math.add, swap=True)
+Tensor.__sub__ = _binop(math.subtract)
+Tensor.__rsub__ = _binop(math.subtract, swap=True)
+Tensor.__mul__ = _binop(math.multiply)
+Tensor.__rmul__ = _binop(math.multiply, swap=True)
+Tensor.__truediv__ = _binop(math.divide)
+Tensor.__rtruediv__ = _binop(math.divide, swap=True)
+Tensor.__floordiv__ = _binop(math.floor_divide)
+Tensor.__rfloordiv__ = _binop(math.floor_divide, swap=True)
+Tensor.__mod__ = _binop(math.mod)
+Tensor.__rmod__ = _binop(math.mod, swap=True)
+Tensor.__pow__ = _binop(math.pow)
+Tensor.__rpow__ = _binop(math.pow, swap=True)
+Tensor.__matmul__ = lambda self, other: linalg.matmul(self, other)
+Tensor.__rmatmul__ = lambda self, other: linalg.matmul(creation.to_tensor(other), self)
+Tensor.__neg__ = lambda self: math.neg(self)
+Tensor.__abs__ = lambda self: math.abs(self)
+Tensor.__invert__ = lambda self: (logic.logical_not(self) if self.dtype == np.bool_
+                                  else logic.bitwise_not(self))
+Tensor.__and__ = lambda self, o: (logic.logical_and(self, o) if self.dtype == np.bool_
+                                  else logic.bitwise_and(self, o))
+Tensor.__or__ = lambda self, o: (logic.logical_or(self, o) if self.dtype == np.bool_
+                                 else logic.bitwise_or(self, o))
+Tensor.__xor__ = lambda self, o: (logic.logical_xor(self, o) if self.dtype == np.bool_
+                                  else logic.bitwise_xor(self, o))
+Tensor.__lshift__ = lambda self, o: logic.bitwise_left_shift(self, o)
+Tensor.__rshift__ = lambda self, o: logic.bitwise_right_shift(self, o)
+
+for _name, _fn in _cmp_table:
+    def _mk(f=_fn):
+        def op(self, other):
+            if other is None:
+                return False if f is logic.equal else True
+            return f(self, other)
+        return op
+    setattr(Tensor, _name, _mk())
+
+
+# ------------------------------------------------------- method stitching
+_METHOD_SOURCES = [creation, math, linalg, manipulation, logic, random, search,
+                   stat, _einsum_mod, attribute]
+_SKIP = {"to_tensor", "tensor", "zeros", "ones", "full", "empty", "arange",
+         "linspace", "logspace", "eye", "meshgrid", "rand", "randn", "randint",
+         "randperm", "uniform", "normal", "seed", "get_rng_state",
+         "set_rng_state", "tril_indices", "triu_indices", "create_parameter",
+         "assign", "broadcast_shape", "einsum", "scatter_nd", "block_diag",
+         "standard_normal", "log_normal", "shape", "numel"}
+
+for _mod in _METHOD_SOURCES:
+    for _fname in getattr(_mod, "__all__", []):
+        if _fname in _SKIP or hasattr(Tensor, _fname):
+            continue
+        _f = getattr(_mod, _fname, None)
+        if callable(_f):
+            setattr(Tensor, _fname, _f)
+
+# In-place `op_` aliases used widely in paddle code.
+def _inplace_from(fname):
+    f = getattr(Tensor, fname)
+    def op(self, *args, **kwargs):
+        out = f(self, *args, **kwargs)
+        self._replace(out._value, out._node, out._out_idx)
+        self.stop_gradient = out.stop_gradient and self.stop_gradient
+        return self
+    return op
+
+
+for _fname in ["add", "subtract", "multiply", "divide", "clip", "scale", "floor",
+               "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round", "abs",
+               "tanh", "squeeze", "unsqueeze", "flatten", "clip"]:
+    if hasattr(Tensor, _fname):
+        setattr(Tensor, _fname + "_", _inplace_from(_fname))
+
+Tensor.mean = stat.mean
+Tensor.pow = math.pow
+Tensor.remainder_ = _inplace_from("remainder")

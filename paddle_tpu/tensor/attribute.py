@@ -1,0 +1,35 @@
+"""Tensor attribute queries (reference: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.tensor import Tensor, unwrap
+
+__all__ = ["shape", "is_complex", "is_floating_point", "is_integer", "rank",
+           "real", "imag", "numel"]
+
+
+def shape(input):
+    return Tensor(jnp.asarray(np.asarray(input.shape, dtype=np.int32)))
+
+
+def is_complex(x):
+    return _dt.is_complex_dtype(x.dtype)
+
+
+def is_floating_point(x):
+    return _dt.is_floating_point_dtype(x.dtype)
+
+
+def is_integer(x):
+    return _dt.is_integer_dtype(x.dtype)
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim))
+
+
+from .creation import real, imag  # noqa: E402,F401
+from .stat import numel  # noqa: E402,F401

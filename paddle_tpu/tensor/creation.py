@@ -1,0 +1,274 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.tensor import Tensor, Parameter, apply, unwrap, wrap
+
+__all__ = [
+    "to_tensor", "tensor", "zeros", "ones", "full", "empty", "zeros_like",
+    "ones_like", "full_like", "empty_like", "arange", "linspace", "logspace",
+    "eye", "tril", "triu", "tril_indices", "triu_indices", "meshgrid",
+    "diag", "diagflat", "diag_embed", "diagonal", "assign", "clone",
+    "complex", "real", "imag", "create_parameter", "one_hot", "polar",
+    "cauchy_", "geometric_",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._value)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in shape]
+
+
+def _infer_dtype(data, dtype):
+    if dtype is not None:
+        return _dt.convert_dtype(dtype)
+    if isinstance(data, Tensor):
+        return data.dtype
+    a = np.asarray(data)
+    if a.dtype == np.float64:
+        return _dt.get_default_dtype()
+    if a.dtype == np.int64:
+        return _dt.int64
+    return np.dtype(a.dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else data.clone()
+        out.stop_gradient = stop_gradient
+        return out
+    if isinstance(data, (jnp.ndarray, jax.Array)):
+        v = data
+        if dtype is not None:
+            v = v.astype(_dt.convert_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    d = _infer_dtype(data, dtype)
+    arr = np.asarray(data)
+    if arr.dtype != d:
+        arr = arr.astype(d)
+    return Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+
+
+tensor = to_tensor
+
+
+def zeros(shape, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    return Tensor(jnp.zeros(_shape_list(shape), d))
+
+
+def ones(shape, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    return Tensor(jnp.ones(_shape_list(shape), d))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            d = _dt.bool_
+        elif isinstance(fill_value, int):
+            d = _dt.int64
+        elif isinstance(fill_value, float):
+            d = _dt.get_default_dtype()
+        else:
+            d = np.asarray(fill_value).dtype
+            if d == np.float64:
+                d = _dt.get_default_dtype()
+    else:
+        d = _dt.convert_dtype(dtype)
+    return Tensor(jnp.full(_shape_list(shape), fill_value, d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=d))
+
+
+def ones_like(x, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.ones_like(unwrap(x), dtype=d))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.full_like(unwrap(x), unwrap(fill_value), dtype=d))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) or (hasattr(v, "dtype") and np.issubdtype(np.dtype(v.dtype), np.floating))
+               for v in (start, end, step)):
+            dtype = _dt.get_default_dtype()
+        else:
+            dtype = _dt.int64
+    d = _dt.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=d))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=unwrap(base), dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=d))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=int(diagonal)), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=int(diagonal)), x, name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    d = _dt.convert_dtype(dtype)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(d)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    d = _dt.convert_dtype(dtype)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(d)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = apply(lambda *xs: jnp.meshgrid(*xs, indexing="ij"), *args,
+                 name="meshgrid", multi=True)
+    return list(outs)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def fn(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(int(offset))
+            base = jnp.full((n, n), padding_value, a.dtype)
+            idx = jnp.arange(a.shape[0])
+            if offset >= 0:
+                return base.at[idx, idx + offset].set(a)
+            return base.at[idx - offset, idx].set(a)
+        return jnp.diag(a, k=int(offset))
+    return apply(fn, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=int(offset)), x, name="diagflat")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def fn(a):
+        out = jnp.zeros(a.shape[:-1] + (a.shape[-1] + abs(offset),) * 2, a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        if offset >= 0:
+            out = out.at[..., idx, idx + offset].set(a)
+        else:
+            out = out.at[..., idx - offset, idx].set(a)
+        perm = list(range(out.ndim))
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        src1, src2 = out.ndim - 2, out.ndim - 1
+        if (d1, d2) != (src1, src2):
+            perm.remove(src1); perm.remove(src2)
+            lo, hi = sorted([d1, d2])
+            perm.insert(lo, src1 if d1 < d2 else src2)
+            perm.insert(hi, src2 if d1 < d2 else src1)
+            out = jnp.transpose(out, perm)
+        return out
+    return apply(fn, input, name="diag_embed")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=int(offset), axis1=int(axis1),
+                                        axis2=int(axis2)), x, name="diagonal")
+
+
+def assign(x, output=None):
+    v = to_tensor(x) if not isinstance(x, Tensor) else x.clone()
+    if output is not None:
+        output._replace(v._value, v._node, v._out_idx)
+        output.stop_gradient = v.stop_gradient
+        return output
+    return v
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag, name="complex")
+
+
+def real(x, name=None):
+    return apply(jnp.real, x, name="real")
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x, name="imag")
+
+
+def polar(abs, angle, name=None):
+    return apply(lambda a, t: jax.lax.complex(a * jnp.cos(t), a * jnp.sin(t)),
+                 abs, angle, name="polar")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(a, int(num_classes),
+                                          dtype=_dt.get_default_dtype()), x, name="one_hot")
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.initializer import Constant, XavierUniform
+    init = default_initializer or (Constant(0.0) if is_bias else XavierUniform())
+    d = _dt.convert_dtype(dtype)
+    value = init._generate(tuple(shape), d)
+    return Parameter(value, name=name)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    from .._core.state import prng
+    u = jax.random.uniform(prng.next_key(), x._value.shape, jnp.float32)
+    v = loc + scale * jnp.tan(np.pi * (u - 0.5))
+    x._replace(v.astype(x.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    from .._core.state import prng
+    u = jax.random.uniform(prng.next_key(), x._value.shape, jnp.float32, 1e-7, 1.0)
+    v = jnp.ceil(jnp.log(u) / jnp.log1p(-probs))
+    x._replace(v.astype(x.dtype))
+    return x

@@ -1,0 +1,12 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) → XLA dot_general."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .._core.tensor import apply
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    return apply(lambda *ops: jnp.einsum(equation, *ops), *operands, name="einsum")

@@ -1,0 +1,336 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+matmul lowers to XLA dot_general → TPU MXU. Decompositions (qr/svd/eig…)
+lower to XLA's linalg custom calls (CPU LAPACK / TPU expander passes).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "transpose", "norm", "dist", "cross",
+    "cholesky", "cholesky_solve", "inv", "qr", "svd", "eig", "eigh", "eigvals",
+    "eigvalsh", "solve", "lstsq", "matrix_power", "matrix_rank", "triangular_solve",
+    "pinv", "slogdet", "det", "mv", "multi_dot", "cov", "corrcoef", "lu",
+    "lu_unpack", "householder_product", "matrix_exp", "vecdot", "svdvals",
+    "cdist", "histogram", "histogramdd", "bincount", "matrix_transpose", "ormqr",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(fn, x, y, name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return apply(jnp.matmul, input, mat2, name="mm")
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=axis), x, y, name="vecdot")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, name="mv")
+
+
+def t(input, name=None):
+    def fn(a):
+        if a.ndim < 2:
+            return a
+        return jnp.swapaxes(a, 0, 1)
+    return apply(fn, input, name="t")
+
+
+def transpose(x, perm, name=None):
+    return apply(lambda a: jnp.transpose(a, tuple(int(p) for p in perm)), x,
+                 name="transpose")
+
+
+def matrix_transpose(x, name=None):
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), x, name="matrix_transpose")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(a):
+        ax = axis
+        if isinstance(ax, (list, tuple)):
+            ax = tuple(int(v) for v in ax)
+        pp = p
+        if pp is None:
+            pp = "fro" if (ax is None or isinstance(ax, tuple)) and a.ndim >= 2 else 2
+        if ax is None:
+            flat = a.reshape(-1)
+            if pp == "fro" or pp == 2:
+                r = jnp.sqrt(jnp.sum(jnp.square(jnp.abs(flat))))
+            elif pp == np.inf or pp == float("inf"):
+                r = jnp.max(jnp.abs(flat))
+            elif pp == -np.inf or pp == float("-inf"):
+                r = jnp.min(jnp.abs(flat))
+            elif pp == 0:
+                r = jnp.sum((flat != 0).astype(a.dtype))
+            elif pp == 1:
+                r = jnp.sum(jnp.abs(flat))
+            else:
+                r = jnp.power(jnp.sum(jnp.power(jnp.abs(flat), pp)), 1.0 / pp)
+            if keepdim:
+                r = r.reshape((1,) * a.ndim)
+            return r
+        if pp == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(a)), axis=ax, keepdims=keepdim))
+        if pp == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        if pp == np.inf or pp == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == -np.inf or pp == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), pp), axis=ax, keepdims=keepdim),
+                         1.0 / pp)
+    return apply(fn, x, name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(a.dtype))
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+    return apply(fn, x, y, name="dist")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def fn(a, b):
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == float("inf"):
+            return jnp.max(diff, axis=-1)
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        return jnp.power(jnp.sum(jnp.power(diff, p), axis=-1), 1.0 / p)
+    return apply(fn, x, y, name="cdist")
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(fn, x, y, name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply(fn, x, name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return apply(fn, x, y, name="cholesky_solve")
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inv")
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, name="qr", multi=True)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                 x, name="svd", multi=True)
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x, name="svdvals")
+
+
+def eig(x, name=None):
+    def fn(a):
+        w, v = np.linalg.eig(np.asarray(a))
+        return jnp.asarray(w), jnp.asarray(v)
+    a = unwrap(x)
+    w, v = fn(a)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    a = np.asarray(unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, name="eigh", multi=True)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, name="eigvalsh")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(fn, x, y, name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, jnp.asarray(rank), sv
+    return apply(fn, x, y, name="lstsq", multi=True)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, int(n)), x, name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    tol_v = unwrap(tol) if tol is not None else None
+    return apply(lambda a: jnp.linalg.matrix_rank(a, rtol=tol_v), x, name="matrix_rank")
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, x, name="matrix_exp")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                 x, name="pinv")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply(fn, x, name="slogdet")
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *x, name="multi_dot")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = unwrap(fweights) if fweights is not None else None
+    aw = unwrap(aweights) if aweights is not None else None
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), x, name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(a):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(a)
+        if get_infos:
+            return lu_mat, piv.astype(jnp.int32) + 1, jnp.zeros((), jnp.int32)
+        return lu_mat, piv.astype(jnp.int32) + 1
+    return apply(fn, x, name="lu", multi=True)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True, name=None):
+    def fn(lu_mat, piv):
+        n = lu_mat.shape[-2]
+        L = jnp.tril(lu_mat, -1) + jnp.eye(n, lu_mat.shape[-1], dtype=lu_mat.dtype)
+        L = L[..., :, :n] if lu_mat.shape[-1] > n else L
+        U = jnp.triu(lu_mat)[..., :n, :]
+        perm = np.arange(n)
+        pv = np.asarray(piv) - 1
+        for i, p in enumerate(pv[: n]):
+            perm[i], perm[p] = perm[p], perm[i]
+        P = jnp.eye(n, dtype=lu_mat.dtype)[perm].T
+        return P, L, U
+    return apply(fn, lu_data, lu_pivots, name="lu_unpack", multi=True)
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        Q = jnp.eye(m, dtype=a.dtype)
+        Q = jnp.broadcast_to(Q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else Q
+        for i in range(n):
+            v = jnp.zeros(a.shape[:-2] + (m,), a.dtype)
+            v = v.at[..., i].set(1.0)
+            v = v.at[..., i + 1:].set(a[..., i + 1:, i])
+            H = jnp.eye(m, dtype=a.dtype) - t[..., i, None, None] * \
+                (v[..., :, None] @ v[..., None, :])
+            Q = Q @ H
+        return Q[..., :, :n] if m >= n else Q
+    return apply(fn, x, tau, name="householder_product")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    q = householder_product(x, tau)
+    from . import linalg as _l
+    qm = q if not transpose else _l.matrix_transpose(q)
+    return matmul(qm, other) if left else matmul(other, qm)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def fn(a, w=None):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (jnp.min(a), jnp.max(a))
+        h, _ = jnp.histogram(a.reshape(-1), bins=int(bins), range=(lo, hi),
+                             weights=None if w is None else w.reshape(-1),
+                             density=density)
+        return h if (density or w is not None) else h.astype(_dt.int64)
+    if weight is not None:
+        return apply(fn, input, weight, name="histogram")
+    return apply(fn, input, name="histogram")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    a = np.asarray(unwrap(x))
+    w = np.asarray(unwrap(weights)) if weights is not None else None
+    h, edges = np.histogramdd(a, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(h)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    def fn(a, w=None):
+        n = int(np.asarray(unwrap(x)).max()) + 1 if not isinstance(unwrap(x), jax.core.Tracer) else minlength
+        length = builtins_max(n, minlength) if n else minlength
+        out = jnp.bincount(a, weights=None if w is None else w, length=length)
+        return out.astype(_dt.int64) if w is None else out
+    builtins_max = __builtins__["max"] if isinstance(__builtins__, dict) else __builtins__.max
+    if weights is not None:
+        return apply(fn, x, weights, name="bincount")
+    return apply(fn, x, name="bincount")

@@ -1,0 +1,104 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift", "allclose", "isclose",
+    "equal_all", "is_empty", "is_tensor", "isin",
+]
+
+
+def _cmp(jfn, n):
+    def op(x, y, name=None):
+        from .creation import to_tensor
+        if not isinstance(y, Tensor):
+            y = to_tensor(y)
+        if not isinstance(x, Tensor):
+            x = to_tensor(x)
+        return apply(lambda a, b: jfn(a, jnp.asarray(b, a.dtype) if b.ndim == 0 else b),
+                     x, y, name=n)
+    op.__name__ = n
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+
+
+def _logical(jfn, n):
+    def op(x, y=None, out=None, name=None):
+        if y is None:
+            return apply(lambda a: jfn(a), x, name=n)
+        return apply(jfn, x, y, name=n)
+    op.__name__ = n
+    return op
+
+
+logical_and = _logical(jnp.logical_and, "logical_and")
+logical_or = _logical(jnp.logical_or, "logical_or")
+logical_xor = _logical(jnp.logical_xor, "logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return apply(jnp.logical_not, x, name="logical_not")
+
+
+bitwise_and = _logical(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _logical(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _logical(jnp.bitwise_xor, "bitwise_xor")
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply(jnp.bitwise_not, x, name="bitwise_not")
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return apply(jnp.left_shift, x, y, name="bitwise_left_shift")
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    def fn(a, b):
+        if is_arithmetic:
+            return jnp.right_shift(a, b)
+        ua = a.view(jnp.dtype(f"uint{a.dtype.itemsize * 8}"))
+        return jnp.right_shift(ua, b.astype(ua.dtype)).view(a.dtype)
+    return apply(fn, x, y, name="bitwise_right_shift")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=float(unwrap(rtol)),
+                                           atol=float(unwrap(atol)), equal_nan=equal_nan),
+                 x, y, name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=float(unwrap(rtol)),
+                                          atol=float(unwrap(atol)), equal_nan=equal_nan),
+                 x, y, name="isclose")
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y, name="equal_all")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(lambda a, t: jnp.isin(a, t, invert=invert), x, test_x, name="isin")

@@ -1,0 +1,584 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py).
+
+All reshape/transpose/gather-style ops are pure metadata or XLA
+gather/scatter — static shapes keep them fusable on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "concat", "stack",
+    "split", "tensor_split", "vsplit", "hsplit", "dsplit", "chunk", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "gather",
+    "gather_nd", "scatter", "scatter_", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "index_fill",
+    "masked_select", "masked_fill", "masked_scatter", "roll", "flip", "rot90",
+    "take_along_axis", "put_along_axis", "repeat_interleave", "unbind",
+    "unstack", "slice", "strided_slice", "crop", "moveaxis", "swapaxes",
+    "tensordot", "as_complex", "as_real", "view", "view_as", "unfold",
+    "flip", "fliplr", "flipud", "take", "select_scatter", "unflatten",
+    "atleast_1d", "atleast_2d", "atleast_3d", "rad2deg", "block_diag",
+    "hstack", "vstack", "dstack", "column_stack", "row_stack", "as_strided",
+    "shard_index", "slice_scatter", "where", "bucketize", "searchsorted",
+    "top_p_sampling",
+]
+
+
+def _resolve_shape(shape):
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(np.asarray(s._value)))
+        else:
+            out.append(int(s))
+    return out
+
+
+def reshape(x, shape, name=None):
+    sh = _resolve_shape(shape) if not isinstance(shape, Tensor) else \
+        [int(v) for v in np.asarray(shape._value)]
+    def fn(a):
+        # paddle semantics: 0 means copy dim from input
+        final = [a.shape[i] if (s == 0 and i < a.ndim) else s for i, s in enumerate(sh)]
+        return jnp.reshape(a, final)
+    return apply(fn, x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._replace(out._value, out._node, out._out_idx)
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = _dt.convert_dtype(shape_or_dtype)
+    return apply(lambda a: a.view(d) if hasattr(a, "view") else
+                 jax.lax.bitcast_convert_type(a, d), x, name="view_dtype")
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = list(a.shape[:s]) + [-1] + list(a.shape[e + 1:])
+        return jnp.reshape(a, new_shape)
+    return apply(fn, x, name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply(fn, x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    def fn(a):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        out = a
+        for ax in sorted([ax % (out.ndim + len(axes)) if ax >= 0 else ax + out.ndim + len(axes)
+                          for ax in [int(unwrap(v)) for v in axes]]):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply(fn, x, name="unsqueeze")
+
+
+def concat(x, axis=0, name=None):
+    ax = int(unwrap(axis))
+    return apply(lambda *xs: jnp.concatenate(xs, axis=ax), *x, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *xs: jnp.stack(xs, axis=int(axis)), *x, name="stack")
+
+
+def hstack(x, name=None):
+    return apply(lambda *xs: jnp.hstack(xs), *x, name="hstack")
+
+
+def vstack(x, name=None):
+    return apply(lambda *xs: jnp.vstack(xs), *x, name="vstack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *xs: jnp.dstack(xs), *x, name="dstack")
+
+
+def column_stack(x, name=None):
+    return apply(lambda *xs: jnp.column_stack(xs), *x, name="column_stack")
+
+
+row_stack = vstack
+
+
+def block_diag(inputs, name=None):
+    return apply(lambda *xs: jax.scipy.linalg.block_diag(*xs), *inputs, name="block_diag")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(unwrap(axis))
+    def fn(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        secs = [int(unwrap(s)) for s in num_or_sections]
+        total = a.shape[ax]
+        known = sum(s for s in secs if s != -1)
+        secs = [s if s != -1 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1]
+        return tuple(jnp.split(a, idx, axis=ax))
+    return list(apply(fn, x, name="split", multi=True))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def fn(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=int(axis))) \
+            if isinstance(num_or_indices, int) else \
+            tuple(jnp.split(a, [int(i) for i in num_or_indices], axis=int(axis)))
+    return list(apply(fn, x, name="tensor_split", multi=True))
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return list(apply(lambda a: tuple(jnp.array_split(a, int(chunks), axis=int(axis))),
+                      x, name="chunk", multi=True))
+
+
+def tile(x, repeat_times, name=None):
+    reps = tuple(int(unwrap(r)) for r in repeat_times) \
+        if isinstance(repeat_times, (list, tuple)) else int(unwrap(repeat_times))
+    return apply(lambda a: jnp.tile(a, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    sh = _resolve_shape(shape)
+    def fn(a):
+        target = list(sh)
+        off = len(target) - a.ndim
+        for i in range(a.ndim):
+            if target[off + i] == -1:
+                target[off + i] = a.shape[i]
+        return jnp.broadcast_to(a, target)
+    return apply(fn, x, name="expand")
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda a, b: jnp.broadcast_to(a, b.shape), x, y, name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, _resolve_shape(shape)), x,
+                 name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(apply(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *inputs,
+                      name="broadcast_tensors", multi=True))
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(unwrap(axis))
+    def fn(a, idx):
+        idx = idx.reshape(-1) if idx.ndim > 1 else idx
+        return jnp.take(a, idx, axis=ax)
+    return apply(fn, x, index, name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def fn(a, idx):
+        if idx.shape[-1] == 0:
+            return jnp.broadcast_to(a, idx.shape[:-1] + a.shape)
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a[comps]
+    return apply(fn, x, index, name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def fn(a, idx, upd):
+        idx = idx.reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return apply(fn, x, index, updates, name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._replace(out._value, out._node, out._out_idx)
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def fn(idx, upd):
+        out = jnp.zeros(_resolve_shape(shape), upd.dtype)
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return out.at[comps].add(upd)
+    return apply(fn, index, updates, name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def fn(a, idx, upd):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a.at[comps].add(upd)
+    return apply(fn, x, index, updates, name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda a, idx: jnp.take(a, idx.reshape(-1), axis=int(axis)),
+                 x, index, name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda a, idx: jnp.take_along_axis(a, idx, axis=1), x, index,
+                 name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def fn(a, idx, v):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        vmoved = jnp.moveaxis(v, int(axis), 0)
+        out = moved.at[idx].add(vmoved)
+        return jnp.moveaxis(out, 0, int(axis))
+    return apply(fn, x, index, value, name="index_add")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def fn(a, idx):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        out = moved.at[idx].set(jnp.asarray(unwrap(value), a.dtype))
+        return jnp.moveaxis(out, 0, int(axis))
+    return apply(fn, x, index, name="index_fill")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idxs = tuple(unwrap(i) for i in indices)
+    def fn(a, v):
+        return a.at[idxs].add(v) if accumulate else a.at[idxs].set(v)
+    return apply(fn, x, value, name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    a, m = np.asarray(unwrap(x)), np.asarray(unwrap(mask))
+    return Tensor(jnp.asarray(a[m]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = unwrap(value)
+    return apply(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask,
+                 name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    a = np.asarray(unwrap(x)).copy()
+    m = np.asarray(unwrap(mask))
+    m = np.broadcast_to(m, a.shape)
+    v = np.asarray(unwrap(value)).reshape(-1)
+    a[m] = v[: int(m.sum())]
+    return Tensor(jnp.asarray(a))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else int(unwrap(shifts))
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.roll(a, sh, axis=ax), x, name="roll")
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else int(axis)
+    return apply(lambda a: jnp.flip(a, axis=ax), x, name="flip")
+
+
+def fliplr(x, name=None):
+    return apply(jnp.fliplr, x, name="fliplr")
+
+
+def flipud(x, name=None):
+    return apply(jnp.flipud, x, name="flipud")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=int(k), axes=tuple(axes)), x, name="rot90")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def fn(a, idx):
+        if broadcast:
+            tgt = list(a.shape)
+            tgt[axis] = idx.shape[axis]
+            idx = jnp.broadcast_to(idx, tgt)
+        return jnp.take_along_axis(a, idx, axis=int(axis))
+    return apply(fn, arr, indices, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    def fn(a, idx, v):
+        v = jnp.broadcast_to(jnp.asarray(v, a.dtype), idx.shape)
+        dims = tuple(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij"))
+        full_idx = tuple(idx if d == axis % a.ndim else dims[d] for d in range(a.ndim))
+        if reduce == "assign":
+            return a.at[full_idx].set(v)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v)
+        if reduce == "amax":
+            return a.at[full_idx].max(v)
+        if reduce == "amin":
+            return a.at[full_idx].min(v)
+        if reduce == "mean":
+            cnt = jnp.zeros_like(a).at[full_idx].add(jnp.ones_like(v))
+            summed = a.at[full_idx].add(v)
+            return jnp.where(cnt > 0, summed / (cnt + (include_self and 1 or 0)), a)
+        raise ValueError(reduce)
+    return apply(fn, arr, indices, values, name="put_along_axis")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        sl = [builtins_slice(None)] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v)
+    builtins_slice = __builtins__["slice"] if isinstance(__builtins__, dict) else __builtins__.slice
+    return apply(fn, x, values, name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def fn(a, v):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = builtins_slice(int(s), int(e), int(st))
+        return a.at[tuple(sl)].set(v)
+    builtins_slice = __builtins__["slice"] if isinstance(__builtins__, dict) else __builtins__.slice
+    return apply(fn, x, value, name="slice_scatter")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        total = int(np.asarray(repeats._value).sum())
+        return apply(lambda a, r: jnp.repeat(a, r, axis=axis, total_repeat_length=total),
+                     x, repeats, name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, int(repeats), axis=axis), x,
+                 name="repeat_interleave")
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[int(axis)]
+    def fn(a):
+        return tuple(jnp.squeeze(s, axis=int(axis))
+                     for s in jnp.split(a, n, axis=int(axis)))
+    return list(apply(fn, input, name="unbind", multi=True))
+
+
+unstack = unbind
+
+
+def slice(input, axes, starts, ends, name=None):
+    def fn(a):
+        sl = [py_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            s, e = int(unwrap(s)), int(unwrap(e))
+            sl[int(ax)] = py_slice(s, e)
+        return a[tuple(sl)]
+    py_slice = __builtins__["slice"] if isinstance(__builtins__, dict) else __builtins__.slice
+    return apply(fn, input, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(a):
+        sl = [py_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[int(ax)] = py_slice(int(s), int(e), int(st))
+        return a[tuple(sl)]
+    py_slice = __builtins__["slice"] if isinstance(__builtins__, dict) else __builtins__.slice
+    return apply(fn, x, name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    sh = _resolve_shape(shape)
+    offs = [int(unwrap(o)) for o in offsets] if offsets is not None else [0] * len(sh)
+    def fn(a):
+        sl = tuple(py_slice(o, o + (s if s != -1 else a.shape[i] - o))
+                   for i, (o, s) in enumerate(zip(offs, sh)))
+        return a[sl]
+    py_slice = __builtins__["slice"] if isinstance(__builtins__, dict) else __builtins__.slice
+    return apply(fn, x, name="crop")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def fn(a):
+        flat = a.reshape(-1)[offset:]
+        idx = np.zeros(tuple(shape), dtype=np.int64)
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx += r.reshape((-1,) + (1,) * (len(shape) - d - 1))
+        return flat[jnp.asarray(idx)]
+    return apply(fn, x, name="as_strided")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x, name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, int(axis0), int(axis1)), x, name="swapaxes")
+
+
+transpose_ = swapaxes
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(int(v) for v in (a if isinstance(a, (list, tuple)) else [a]))
+                   for a in ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, name="tensordot")
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x, name="as_real")
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            idx = jnp.mod(idx, flat.shape[0])
+        elif mode == "clip":
+            idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+        else:
+            idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+        return flat[idx]
+    return apply(fn, x, index, name="take")
+
+
+def unflatten(x, axis, shape, name=None):
+    def fn(a):
+        ax = int(axis) % a.ndim
+        sh = _resolve_shape(shape)
+        return jnp.reshape(a, a.shape[:ax] + tuple(sh) + a.shape[ax + 1:])
+    return apply(fn, x, name="unflatten")
+
+
+def unfold(x, axis, size, step, name=None):
+    def fn(a):
+        ax = int(axis) % a.ndim
+        n = (a.shape[ax] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(a, ax, 0)
+        out = moved[idx]  # (n, size, ...)
+        out = jnp.moveaxis(out, (0, 1), (ax, a.ndim))
+        return out
+    return apply(fn, x, name="unfold")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, x, name="atleast_1d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, x, name="atleast_2d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, x, name="atleast_3d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def rad2deg(x, name=None):
+    return apply(jnp.rad2deg, x, name="rad2deg")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(idx):
+        size = index_num // nshards
+        lo = shard_id * size
+        ok = (idx >= lo) & (idx < lo + size)
+        return jnp.where(ok, idx - lo, ignore_value)
+    return apply(fn, input, name="shard_index")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        nz = np.nonzero(np.asarray(unwrap(condition)))
+        return tuple(Tensor(jnp.asarray(i)[:, None]) for i in nz) if len(nz) > 1 \
+            else Tensor(jnp.asarray(nz[0])[:, None])
+    def fn(c, a, b):
+        if a.dtype != b.dtype:
+            d = jnp.promote_types(a.dtype, b.dtype)
+            a, b = a.astype(d), b.astype(d)
+        return jnp.where(c, a, b)
+    from .creation import to_tensor
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    if not isinstance(y, Tensor):
+        y = to_tensor(y)
+    return apply(fn, condition, x, y, name="where")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    d = _dt.int32 if out_int32 else _dt.int64
+    return apply(lambda a, s: jnp.searchsorted(s, a, side="right" if right else "left")
+                 .astype(d), x, sorted_sequence, name="bucketize")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    d = _dt.int32 if out_int32 else _dt.int64
+    def fn(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side="right" if right else "left").astype(d)
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side="right" if right else "left"))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(d)
+    return apply(fn, sorted_sequence, values, name="searchsorted")
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    from .._core.state import prng
+    key = prng.next_key() if seed is None else jax.random.key(int(seed))
+    def fn(logits, p):
+        probs = jax.nn.softmax(logits, axis=-1)
+        sorted_idx = jnp.argsort(-probs, axis=-1)
+        sorted_probs = jnp.take_along_axis(probs, sorted_idx, axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        keep = cum - sorted_probs <= p[..., None]
+        filtered = jnp.where(keep, sorted_probs, 0.0)
+        filtered = filtered / jnp.sum(filtered, axis=-1, keepdims=True)
+        choice = jax.random.categorical(key, jnp.log(filtered + 1e-10), axis=-1)
+        tok = jnp.take_along_axis(sorted_idx, choice[..., None], axis=-1)
+        prob = jnp.take_along_axis(filtered, choice[..., None], axis=-1)
+        return prob, tok
+    return apply(fn, x, ps, name="top_p_sampling", multi=True)

@@ -1,0 +1,408 @@
+"""Elementwise & reduction math ops (reference: python/paddle/tensor/math.py).
+
+Every op is a thin paddle-shaped wrapper over a pure jnp core; XLA fuses
+these into surrounding matmuls on TPU, which is the whole performance
+story — no hand-written elementwise kernels needed (the reference's
+phi/kernels/elementwise_*.cu becomes jnp + XLA fusion).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .._core import dtypes as _dt
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = []
+
+
+def _export(name, fn):
+    globals()[name] = fn
+    __all__.append(name)
+
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------- unary ops
+_UNARY = {
+    "abs": jnp.abs, "acos": jnp.arccos, "acosh": jnp.arccosh,
+    "asin": jnp.arcsin, "asinh": jnp.arcsinh, "atan": jnp.arctan,
+    "atanh": jnp.arctanh, "ceil": jnp.ceil, "conj": jnp.conj,
+    "cos": jnp.cos, "cosh": jnp.cosh, "digamma": jax.scipy.special.digamma,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "exp": jnp.exp, "expm1": jnp.expm1, "floor": jnp.floor,
+    "lgamma": jax.scipy.special.gammaln, "log": jnp.log, "log10": jnp.log10,
+    "log1p": jnp.log1p, "log2": jnp.log2,
+    "neg": jnp.negative, "reciprocal": jnp.reciprocal,
+    "round": jnp.round, "rsqrt": lax.rsqrt, "sigmoid": jax.nn.sigmoid,
+    "sign": jnp.sign, "sgn": jnp.sign, "sin": jnp.sin, "sinc": jnp.sinc,
+    "sinh": jnp.sinh, "sqrt": jnp.sqrt, "square": jnp.square,
+    "tan": jnp.tan, "tanh": jnp.tanh, "trunc": jnp.trunc,
+    "deg2rad": jnp.deg2rad, "rad2deg": jnp.rad2deg, "angle": jnp.angle,
+    "i0": jax.scipy.special.i0, "i0e": jax.scipy.special.i0e,
+    "i1": jax.scipy.special.i1, "i1e": jax.scipy.special.i1e,
+    "signbit": jnp.signbit,
+}
+for _n, _f in _UNARY.items():
+    def _mk(f=_f, n=_n):
+        def op(x, name=None):
+            return apply(f, x, name=n)
+        op.__name__ = n
+        return op
+    _export(_n, _mk())
+
+
+def frac(x, name=None):
+    return apply(lambda a: a - jnp.trunc(a), x, name="frac")
+
+
+def frexp(x, name=None):
+    return apply(lambda a: jnp.frexp(a), x, name="frexp", multi=True)
+
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return apply(fn, x, name="logit")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, name="stanh")
+
+
+def multigammaln(x, p, name=None):
+    return apply(lambda a: jax.scipy.special.multigammaln(a, int(p)), x, name="multigammaln")
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda a: jax.scipy.special.polygamma(int(n), a), x, name="polygamma")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                 x, name="nan_to_num")
+
+
+for _n in ["frac", "logit", "stanh", "multigammaln", "polygamma", "nan_to_num", "frexp"]:
+    __all__.append(_n)
+
+
+# --------------------------------------------------------------- binary ops
+def _binary(jfn, n, int_to_float=False):
+    def op(x, y, name=None):
+        def fn(a, b):
+            if int_to_float:
+                if not jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact) and \
+                   not jnp.issubdtype(jnp.asarray(b).dtype, jnp.inexact):
+                    a = jnp.asarray(a, _dt.get_default_dtype())
+            return jfn(a, b)
+        return apply(fn, x, y, name=n)
+    op.__name__ = n
+    return op
+
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.true_divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp, "hypot": jnp.hypot,
+    "copysign": jnp.copysign, "nextafter": jnp.nextafter,
+    "ldexp": jnp.ldexp, "gcd": jnp.gcd, "lcm": jnp.lcm,
+    "heaviside": jnp.heaviside, "kron": jnp.kron,
+}
+for _n, _f in _BINARY.items():
+    _export(_n, _binary(_f, _n, int_to_float=_n == "divide"))
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    return apply(lambda *xs: sum(xs[1:], xs[0]), *inputs, name="add_n")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+    def fn(a):
+        out = a * jnp.asarray(s, a.dtype) + jnp.asarray(b, a.dtype) if bias_after_scale \
+            else (a + jnp.asarray(b, a.dtype)) * jnp.asarray(s, a.dtype)
+        return out
+    return apply(fn, x, name="scale")
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = unwrap(min) if min is not None else None
+    hi = unwrap(max) if max is not None else None
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return apply(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+def increment(x, value=1.0, name=None):
+    out = apply(lambda a: a + jnp.asarray(value, a.dtype), x, name="increment")
+    x._replace(out._value, out._node, out._out_idx)
+    return x
+
+
+def multiplex(inputs, index, name=None):
+    def fn(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))),
+                                   axis=0)[0]
+    return apply(fn, index, *inputs, name="multiplex")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.amax(a, axis=_axis_arg(axis), keepdims=keepdim), x, name="amax")
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.amin(a, axis=_axis_arg(axis), keepdims=keepdim), x, name="amin")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.max(a, axis=_axis_arg(axis), keepdims=keepdim), x, name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.min(a, axis=_axis_arg(axis), keepdims=keepdim), x, name="min")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    def fn(a):
+        out_d = d
+        if out_d is None and jnp.issubdtype(a.dtype, jnp.bool_):
+            out_d = _dt.int64
+        return jnp.sum(a, axis=_axis_arg(axis), dtype=out_d, keepdims=keepdim)
+    return apply(fn, x, name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.mean(a, axis=_axis_arg(axis), keepdims=keepdim), x, name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.prod(a, axis=_axis_arg(axis), dtype=d, keepdims=keepdim),
+                 x, name="prod")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmean(a, axis=_axis_arg(axis), keepdims=keepdim),
+                 x, name="nanmean")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.nansum(a, axis=_axis_arg(axis), dtype=d, keepdims=keepdim),
+                 x, name="nansum")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.count_nonzero(a, axis=_axis_arg(axis), keepdims=keepdim)
+                 .astype(_dt.int64), x, name="count_nonzero")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=_axis_arg(axis),
+                                                       keepdims=keepdim), x, name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.all(a, axis=_axis_arg(axis), keepdims=keepdim), x, name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.any(a, axis=_axis_arg(axis), keepdims=keepdim), x, name="any")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    def fn(a):
+        if axis is None:
+            return jnp.cumsum(a.reshape(-1), dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+    return apply(fn, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else None
+    return apply(lambda a: jnp.cumprod(a, axis=int(dim), dtype=d), x, name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def fn(a):
+        ax = -1 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        vals = lax.associative_scan(jnp.maximum, arr, axis=ax if axis is not None else 0)
+        idx = jnp.argmax(jnp.cumsum((arr == vals).astype(jnp.int32),
+                                    axis=ax if axis is not None else 0) *
+                         (arr == vals), axis=ax if axis is not None else 0)
+        # indices via scan of argmax-carrying pairs
+        n = arr.shape[ax if axis is not None else 0]
+        def combine(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take2 = v2 >= v1
+            return jnp.where(take2, v2, v1), jnp.where(take2, i2, i1)
+        ar = jnp.moveaxis(arr, ax if axis is not None else 0, 0)
+        ivals = jnp.arange(n).reshape((n,) + (1,) * (ar.ndim - 1))
+        ivals = jnp.broadcast_to(ivals, ar.shape)
+        v, i = lax.associative_scan(combine, (ar, ivals), axis=0)
+        v = jnp.moveaxis(v, 0, ax if axis is not None else 0)
+        i = jnp.moveaxis(i, 0, ax if axis is not None else 0)
+        return v, i.astype(_dt.convert_dtype(dtype))
+    return apply(fn, x, name="cummax", multi=True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def fn(a):
+        ax = 0 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        def combine(c1, c2):
+            v1, i1 = c1
+            v2, i2 = c2
+            take2 = v2 <= v1
+            return jnp.where(take2, v2, v1), jnp.where(take2, i2, i1)
+        ar = jnp.moveaxis(arr, ax, 0)
+        n = ar.shape[0]
+        ivals = jnp.broadcast_to(jnp.arange(n).reshape((n,) + (1,) * (ar.ndim - 1)), ar.shape)
+        v, i = lax.associative_scan(combine, (ar, ivals), axis=0)
+        return jnp.moveaxis(v, 0, ax), jnp.moveaxis(i, 0, ax).astype(_dt.convert_dtype(dtype))
+    return apply(fn, x, name="cummin", multi=True)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(a):
+        ax = 0 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        return lax.associative_scan(jnp.logaddexp, arr, axis=ax)
+    return apply(fn, x, name="logcumsumexp")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = unwrap(prepend) if prepend is not None else None
+    app = unwrap(append) if append is not None else None
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+                 x, name="diff")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply(lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
+                     y, x, name="trapezoid")
+    return apply(lambda yy: jax.scipy.integrate.trapezoid(yy, dx=dx or 1.0, axis=axis),
+                 y, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def fn(yy, xx=None):
+        d = jnp.diff(xx, axis=axis) if xx is not None else (dx or 1.0)
+        sl1 = [slice(None)] * yy.ndim
+        sl2 = [slice(None)] * yy.ndim
+        sl1[axis] = slice(1, None)
+        sl2[axis] = slice(None, -1)
+        avg = (yy[tuple(sl1)] + yy[tuple(sl2)]) / 2.0
+        return jnp.cumsum(avg * d, axis=axis)
+    if x is not None:
+        return apply(fn, y, x, name="cumulative_trapezoid")
+    return apply(fn, y, name="cumulative_trapezoid")
+
+
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, x, name="isfinite")
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, x, name="isinf")
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, x, name="isnan")
+
+
+def isneginf(x, name=None):
+    return apply(jnp.isneginf, x, name="isneginf")
+
+
+def isposinf(x, name=None):
+    return apply(jnp.isposinf, x, name="isposinf")
+
+
+def isreal(x, name=None):
+    return apply(jnp.isreal, x, name="isreal")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y, name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, name="addmm")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(a):
+        dims = tuple(i for i in range(a.ndim) if i != axis % a.ndim)
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=dims, keepdims=True),
+                          1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+    return apply(fn, x, name="renorm")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                 x, name="trace")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply(lambda a: jnp.vander(a, N=n, increasing=increasing), x, name="vander")
+
+
+def gammaln(x, name=None):
+    return apply(jax.scipy.special.gammaln, x, name="gammaln")
+
+
+def gammainc(x, y, name=None):
+    return apply(jax.scipy.special.gammainc, x, y, name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return apply(jax.scipy.special.gammaincc, x, y, name="gammaincc")
+
+
+for _n in ["add_n", "scale", "clip", "lerp", "increment", "multiplex", "amax", "amin",
+           "max", "min", "sum", "mean", "prod", "nanmean", "nansum", "count_nonzero",
+           "logsumexp", "all", "any", "cumsum", "cumprod", "cummax", "cummin",
+           "logcumsumexp", "diff", "trapezoid", "cumulative_trapezoid", "isfinite",
+           "isinf", "isnan", "isneginf", "isposinf", "isreal", "broadcast_shape",
+           "inner", "outer", "addmm", "renorm", "trace", "vander", "gammaln",
+           "gammainc", "gammaincc"]:
+    __all__.append(_n)

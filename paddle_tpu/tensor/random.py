@@ -1,0 +1,161 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+All randomness flows through the explicit PRNG state in
+paddle_tpu._core.state — eager calls advance a stateful key; compiled
+code pushes traced keys via `paddle_tpu.random_key_context`, which keeps
+dropout/noise reproducible under jit and across TPU mesh shards.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.state import prng
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "standard_normal", "poisson", "bernoulli", "multinomial",
+    "uniform_", "normal_", "exponential_", "binomial", "standard_gamma",
+    "log_normal", "seed", "get_rng_state", "set_rng_state",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def seed(s):
+    from .._core import state
+    state.seed(int(s))
+    return state.prng
+
+
+def get_rng_state():
+    from .._core import state
+    return state.get_rng_state()
+
+
+def set_rng_state(st):
+    from .._core import state
+    state.set_rng_state(st)
+
+
+def rand(shape, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    return Tensor(jax.random.uniform(prng.next_key(), _shape_list(shape), d))
+
+
+def randn(shape, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    return Tensor(jax.random.normal(prng.next_key(), _shape_list(shape), d))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt.convert_dtype(dtype) if dtype else _dt.int64
+    return Tensor(jax.random.randint(prng.next_key(), _shape_list(shape),
+                                     int(low), int(high)).astype(d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt.convert_dtype(dtype) if dtype else x.dtype
+    return Tensor(jax.random.randint(prng.next_key(), tuple(x.shape),
+                                     int(low), int(high)).astype(d))
+
+
+def randperm(n, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    return Tensor(jax.random.permutation(prng.next_key(), int(n)).astype(d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _dt.convert_dtype(dtype) if dtype else _dt.get_default_dtype()
+    key = jax.random.key(int(seed)) if seed else prng.next_key()
+    return Tensor(jax.random.uniform(key, _shape_list(shape), d,
+                                     float(unwrap(min)), float(unwrap(max))))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    v = uniform(tuple(x.shape), x.dtype, min, max, seed)
+    x._replace(v._value)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = unwrap(std) if isinstance(std, Tensor) else std
+        sh = np.broadcast_shapes(np.shape(m), np.shape(s))
+        z = jax.random.normal(prng.next_key(), sh, _dt.get_default_dtype())
+        return Tensor(m + s * z)
+    d = _dt.get_default_dtype()
+    z = jax.random.normal(prng.next_key(), _shape_list(shape), d)
+    return Tensor(float(mean) + float(std) * z)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    z = jax.random.normal(prng.next_key(), tuple(x.shape), jnp.float32)
+    x._replace((float(mean) + float(std) * z).astype(x.dtype))
+    return x
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    z = jax.random.normal(prng.next_key(), _shape_list(shape), _dt.get_default_dtype())
+    return Tensor(jnp.exp(float(mean) + float(std) * z))
+
+
+def poisson(x, name=None):
+    return apply(lambda lam: jax.random.poisson(prng.next_key(), lam).astype(lam.dtype),
+                 x, name="poisson")
+
+
+def bernoulli(x, name=None):
+    return apply(lambda p: jax.random.bernoulli(prng.next_key(), p).astype(p.dtype),
+                 x, name="bernoulli")
+
+
+def binomial(count, prob, name=None):
+    def fn(n, p):
+        return jax.random.binomial(prng.next_key(), n.astype(jnp.float32),
+                                   p.astype(jnp.float32)).astype(_dt.int64)
+    return apply(fn, count, prob, name="binomial")
+
+
+def standard_gamma(x, name=None):
+    return apply(lambda a: jax.random.gamma(prng.next_key(), a).astype(a.dtype),
+                 x, name="standard_gamma")
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(prng.next_key(), tuple(x.shape), jnp.float32, 1e-7, 1.0)
+    x._replace((-jnp.log(u) / float(lam)).astype(x.dtype))
+    return x
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def fn(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                prng.next_key(), logits, axis=-1,
+                shape=(num_samples,) + p.shape[:-1]).T.astype(_dt.int64) \
+                if p.ndim > 1 else jax.random.categorical(
+                    prng.next_key(), logits, axis=-1, shape=(num_samples,)).astype(_dt.int64)
+        # without replacement: gumbel top-k trick (TPU-friendly, no loop)
+        g = jax.random.gumbel(prng.next_key(), p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(_dt.int64)
+    return apply(fn, x, name="multinomial")

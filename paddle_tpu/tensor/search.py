@@ -1,0 +1,151 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core import dtypes as _dt
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "unique",
+    "unique_consecutive", "nonzero", "kthvalue", "mode", "masked_select",
+    "index_sample", "where",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    def fn(a):
+        if axis is None:
+            return jnp.argmax(a.reshape(-1)).astype(d)
+        out = jnp.argmax(a, axis=int(axis)).astype(d)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+    return apply(fn, x, name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = _dt.convert_dtype(dtype)
+    def fn(a):
+        if axis is None:
+            return jnp.argmin(a.reshape(-1)).astype(d)
+        out = jnp.argmin(a, axis=int(axis)).astype(d)
+        return jnp.expand_dims(out, int(axis)) if keepdim else out
+    return apply(fn, x, name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        idx = jnp.argsort(a, axis=int(axis), stable=True,
+                          descending=descending)
+        return idx.astype(_dt.int64)
+    return apply(fn, x, name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(a):
+        out = jnp.sort(a, axis=int(axis), stable=True, descending=descending)
+        return out
+    return apply(fn, x, name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    kk = int(unwrap(k))
+    def fn(a):
+        ax = -1 if axis is None else int(axis)
+        moved = jnp.moveaxis(a, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, kk)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(_dt.int64))
+    return apply(fn, x, name="topk", multi=True)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        ax = int(axis) % a.ndim
+        sorted_v = jnp.sort(a, axis=ax)
+        sorted_i = jnp.argsort(a, axis=ax, stable=True)
+        sl = [builtins_slice(None)] * a.ndim
+        sl[ax] = int(k) - 1
+        v, i = sorted_v[tuple(sl)], sorted_i[tuple(sl)].astype(_dt.int64)
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i
+    builtins_slice = __builtins__["slice"] if isinstance(__builtins__, dict) else __builtins__.slice
+    return apply(fn, x, name="kthvalue", multi=True)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def fn(a):
+        ax = int(axis) % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        sorted_v = jnp.sort(moved, axis=-1)
+        # count runs: mode = value with max run length in sorted order
+        n = sorted_v.shape[-1]
+        eq = sorted_v[..., 1:] == sorted_v[..., :-1]
+        run_id = jnp.concatenate([jnp.zeros_like(sorted_v[..., :1], dtype=jnp.int32),
+                                  jnp.cumsum(~eq, axis=-1, dtype=jnp.int32)], axis=-1)
+        counts = jax.nn.one_hot(run_id, n, dtype=jnp.int32).sum(axis=-2)
+        run_len = jnp.take_along_axis(counts, run_id, axis=-1)
+        best = jnp.argmax(run_len, axis=-1)
+        mode_v = jnp.take_along_axis(sorted_v, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax((moved == mode_v[..., None]) *
+                         jnp.arange(1, n + 1), axis=-1)
+        if keepdim:
+            return jnp.expand_dims(mode_v, ax), jnp.expand_dims(idx.astype(_dt.int64), ax)
+        return mode_v, idx.astype(_dt.int64)
+    return apply(fn, x, name="mode", multi=True)
+
+
+def nonzero(x, as_tuple=False, name=None):
+    nz = np.nonzero(np.asarray(unwrap(x)))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    d = _dt.convert_dtype(dtype)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(res[0]))]
+    for r in res[1:]:
+        outs.append(Tensor(jnp.asarray(r.astype(d))))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    a = np.asarray(unwrap(x))
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    sl = np.moveaxis(a, ax, 0)
+    keep = np.ones(sl.shape[0], dtype=bool)
+    keep[1:] = np.any(sl[1:] != sl[:-1], axis=tuple(range(1, sl.ndim))) if sl.ndim > 1 \
+        else sl[1:] != sl[:-1]
+    out = np.moveaxis(sl[keep], 0, ax)
+    outs = [Tensor(jnp.asarray(out))]
+    d = _dt.convert_dtype(dtype)
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(d))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, sl.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts.astype(d))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+# re-exported from manipulation for paddle namespace parity
+from .manipulation import masked_select, where  # noqa: E402,F401
+from .manipulation import index_sample  # noqa: E402,F401

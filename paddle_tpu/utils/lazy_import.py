@@ -1,0 +1,13 @@
+"""try_import (reference: python/paddle/utils/lazy_import.py)."""
+from __future__ import annotations
+
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or
+                          f"module {module_name} not found; it is optional "
+                          f"for paddle_tpu and not installed in this image")

@@ -1,0 +1,52 @@
+"""Op-level trace ring (aux subsystem: tracing).
+
+Lightweight host-side event ring the dispatch layer can feed; replaces
+the reference's host tracer (paddle/fluid/platform/profiler). Enable
+with PADDLE_TPU_TRACE=1 or trace.enable().
+"""
+from __future__ import annotations
+
+import collections
+import os
+import time
+
+_RING = collections.deque(maxlen=100_000)
+_ENABLED = os.environ.get("PADDLE_TPU_TRACE", "0") == "1"
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def enabled():
+    return _ENABLED
+
+
+def record(name, dur_s, shape=None):
+    _RING.append((name, dur_s, shape, time.time()))
+
+
+def clear():
+    _RING.clear()
+
+
+def events():
+    return list(_RING)
+
+
+def summary(top=30):
+    agg = {}
+    for name, dur, _, _ in _RING:
+        tot, cnt = agg.get(name, (0.0, 0))
+        agg[name] = (tot + dur, cnt + 1)
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
+    lines = [f"{'op':<32}{'calls':>8}{'total_ms':>12}{'avg_us':>12}"]
+    for name, (tot, cnt) in rows:
+        lines.append(f"{name:<32}{cnt:>8}{tot*1e3:>12.3f}{tot/cnt*1e6:>12.1f}")
+    return "\n".join(lines) if rows else "trace ring empty (PADDLE_TPU_TRACE=1)"

@@ -1,0 +1,29 @@
+"""Unique name generator (reference: python/paddle/utils/unique_name.py)."""
+from __future__ import annotations
+
+import contextlib
+
+_counters = {}
+
+
+def generate(key):
+    i = _counters.get(key, 0)
+    _counters[key] = i + 1
+    return f"{key}_{i}"
+
+
+def switch(new_generator=None):
+    global _counters
+    old = _counters
+    _counters = {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch()
+    try:
+        yield
+    finally:
+        global _counters
+        _counters = old

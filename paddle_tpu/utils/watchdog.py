@@ -1,0 +1,101 @@
+"""Failure detection (aux subsystem).
+
+Step-deadline hang watchdog + NaN/Inf monitors for training loops,
+mirroring the reference's fleet elastic/failure detection role
+(python/paddle/distributed/fleet/elastic) in a single-process TPU world.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+
+
+class HangWatchdog:
+    """Fires a callback (default: dump stacks) if no heartbeat within
+    `timeout_s`. Use around training steps to catch wedged collectives."""
+
+    def __init__(self, timeout_s=300.0, on_hang=None, name="train"):
+        self.timeout_s = timeout_s
+        self.on_hang = on_hang or self._default_on_hang
+        self.name = name
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread = None
+
+    def _default_on_hang(self):
+        import sys
+        frames = sys._current_frames()
+        print(f"[watchdog:{self.name}] no heartbeat for {self.timeout_s}s; "
+              f"dumping {len(frames)} thread stacks", flush=True)
+        for tid, frame in frames.items():
+            print(f"--- thread {tid} ---", flush=True)
+            traceback.print_stack(frame)
+
+    def _run(self):
+        while not self._stop.wait(min(self.timeout_s / 4, 10.0)):
+            if time.monotonic() - self._last_beat > self.timeout_s:
+                if not self._fired:
+                    self._fired = True
+                    self.on_hang()
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last_beat = time.monotonic()
+        self._fired = False
+
+    def stop(self):
+        self._stop.set()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def check_finite(tree, name="tensors"):
+    """Raise if any array in the pytree has NaN/Inf. One fused device
+    reduction per array; cheap enough to run every N steps."""
+    import jax
+    import jax.numpy as jnp
+    from .._core.tensor import Tensor
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda t: t._value if isinstance(t, Tensor) else t,
+                               tree, is_leaf=lambda t: isinstance(t, Tensor)))
+    bad = []
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            if not bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))):
+                bad.append(i)
+    if bad:
+        raise FloatingPointError(
+            f"non-finite values detected in {name} (leaf indices {bad})")
+    return True
+
+
+class StepHealthMonitor:
+    """Tracks loss trajectory; flags NaN loss or divergence."""
+
+    def __init__(self, window=50, explode_factor=10.0):
+        self.window = window
+        self.explode_factor = explode_factor
+        self.history = []
+
+    def update(self, loss_value):
+        import math
+        v = float(loss_value)
+        if math.isnan(v) or math.isinf(v):
+            raise FloatingPointError(f"loss became non-finite: {v}")
+        self.history.append(v)
+        if len(self.history) > self.window:
+            self.history.pop(0)
+            avg = sum(self.history[:-1]) / (len(self.history) - 1)
+            if avg > 0 and v > avg * self.explode_factor:
+                return {"status": "diverging", "loss": v, "avg": avg}
+        return {"status": "ok", "loss": v}

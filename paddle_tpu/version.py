@@ -1,0 +1,28 @@
+"""Version info (reference: python/paddle/version.py pattern)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"
+cudnn_version = "False"
+tpu = "True"
+with_pip_cuda_libraries = "OFF"
+commit = "tpu-native"
+istaged = False
+
+
+def show():
+    print(f"paddle_tpu {full_version} (tpu-native, XLA backend)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return "False"

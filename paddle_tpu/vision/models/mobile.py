@@ -1,0 +1,342 @@
+"""MobileNetV1/V2/V3 + ShuffleNetV2 (reference: python/paddle/vision/
+models/{mobilenetv1,mobilenetv2,mobilenetv3,shufflenetv2}.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.manipulation import flatten, concat, split, reshape
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNReLU(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1,
+                 activation=nn.ReLU6):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_c), activation())
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.dw = ConvBNReLU(in_c, in_c, 3, stride, groups=in_c,
+                             activation=nn.ReLU)
+        self.pw = ConvBNReLU(in_c, out_c, 1, 1, activation=nn.ReLU)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: int(c * scale)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               *[(512, 1)] * 5, (1024, 2), (1024, 1)]
+        layers = [ConvBNReLU(3, s(32), 3, 2, activation=nn.ReLU)]
+        in_c = s(32)
+        for c, st in cfg:
+            layers.append(_DepthwiseSeparable(in_c, s(c), st))
+            in_c = s(c)
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(ConvBNReLU(inp, hidden, 1))
+        layers.extend([
+            ConvBNReLU(hidden, hidden, 3, stride, groups=hidden),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup)])
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        input_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        layers = [ConvBNReLU(3, input_c, 3, 2)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(InvertedResidual(input_c, out_c,
+                                               s if i == 0 else 1, t))
+                input_c = out_c
+        layers.append(ConvBNReLU(input_c, last_c, 1))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+class SqueezeExcitation(nn.Layer):
+    def __init__(self, input_c, squeeze_c):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(input_c, squeeze_c, 1)
+        self.fc2 = nn.Conv2D(squeeze_c, input_c, 1)
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = nn.functional.relu(self.fc1(s))
+        s = nn.functional.hardsigmoid(self.fc2(s))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, use_hs):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        act = nn.Hardswish if use_hs else nn.ReLU
+        layers = []
+        if exp_c != in_c:
+            layers.append(ConvBNReLU(in_c, exp_c, 1, activation=act))
+        layers.append(ConvBNReLU(exp_c, exp_c, kernel, stride, groups=exp_c,
+                                 activation=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_c, _make_divisible(exp_c // 4)))
+        layers.extend([nn.Conv2D(exp_c, out_c, 1, bias_attr=False),
+                       nn.BatchNorm2D(out_c)])
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_MBV3_LARGE = [
+    # k, exp, out, se, hs, s
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1)]
+
+_MBV3_SMALL = [
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1)]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_c, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [ConvBNReLU(3, in_c, 3, 2, activation=nn.Hardswish)]
+        for k, exp, out, se, hs, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(_MBV3Block(in_c, exp_c, out_c, k, s, se, hs))
+            in_c = out_c
+        final_exp = _make_divisible(cfg[-1][1] * scale)
+        layers.append(ConvBNReLU(in_c, final_exp, 1, activation=nn.Hardswish))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(final_exp, last_c), nn.Hardswish(), nn.Dropout(0.2),
+                nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_LARGE, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_MBV3_SMALL, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def _channel_shuffle(x, groups):
+    from ...nn.functional import channel_shuffle
+    return channel_shuffle(x, groups)
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act=nn.ReLU):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), act())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act())
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        channels = {0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+                    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+                    1.5: [24, 176, 352, 704, 1024],
+                    2.0: [24, 244, 488, 976, 2048]}[scale]
+        act_layer = nn.ReLU if act == "relu" else nn.Swish
+        self.conv1 = nn.Sequential(
+            nn.Conv2D(3, channels[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(channels[0]), act_layer())
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        in_c = channels[0]
+        stages = []
+        for i, reps in enumerate(stage_repeats):
+            out_c = channels[i + 1]
+            units = [_ShuffleUnit(in_c, out_c, 2, act_layer)]
+            for _ in range(reps - 1):
+                units.append(_ShuffleUnit(out_c, out_c, 1, act_layer))
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.LayerList(stages)
+        self.conv_last = nn.Sequential(
+            nn.Conv2D(in_c, channels[-1], 1, bias_attr=False),
+            nn.BatchNorm2D(channels[-1]), act_layer())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        for s in self.stages:
+            x = s(x)
+        x = self.conv_last(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, act="swish", **kwargs)

@@ -1,0 +1,208 @@
+"""Vision ops (reference: python/paddle/vision/ops.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "yolo_loss",
+           "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
+           "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Host-side NMS (data-dependent output size → not jittable by design;
+    inference post-processing runs on host like the reference's CPU path)."""
+    b = np.asarray(unwrap(boxes))
+    s = np.asarray(unwrap(scores)) if scores is not None else None
+    order = np.argsort(-s) if s is not None else np.arange(len(b))
+    if category_idxs is not None:
+        cats = np.asarray(unwrap(category_idxs))
+    else:
+        cats = np.zeros(len(b), np.int64)
+    keep = []
+    suppressed = np.zeros(len(b), bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-9)
+        suppressed |= (iou > iou_threshold) & (cats == cats[i])
+        suppressed[i] = False
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    out_h, out_w = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+
+    def fn(feat, bxs):
+        n, c, h, w = feat.shape
+        nb = bxs.shape[0]
+        offset = 0.5 if aligned else 0.0
+        # assume all boxes on batch 0 unless boxes_num splits (host-side assign)
+        bn = np.asarray(unwrap(boxes_num))
+        batch_ids = np.repeat(np.arange(len(bn)), bn)
+        ys = []
+        for bi in range(nb):
+            x1, y1, x2, y2 = bxs[bi] * spatial_scale - offset
+            bh = jnp.maximum(y2 - y1, 1e-4)
+            bw = jnp.maximum(x2 - x1, 1e-4)
+            gy = y1 + (jnp.arange(out_h) + 0.5) * bh / out_h
+            gx = x1 + (jnp.arange(out_w) + 0.5) * bw / out_w
+            gyc = jnp.clip(gy, 0, h - 1)
+            gxc = jnp.clip(gx, 0, w - 1)
+            y0 = jnp.floor(gyc).astype(jnp.int32)
+            x0 = jnp.floor(gxc).astype(jnp.int32)
+            y1i = jnp.minimum(y0 + 1, h - 1)
+            x1i = jnp.minimum(x0 + 1, w - 1)
+            wy = (gyc - y0)[:, None]
+            wx = (gxc - x0)[None, :]
+            fm = feat[int(batch_ids[bi])]
+            v = (fm[:, y0][:, :, x0] * (1 - wy) * (1 - wx) +
+                 fm[:, y1i][:, :, x0] * wy * (1 - wx) +
+                 fm[:, y0][:, :, x1i] * (1 - wy) * wx +
+                 fm[:, y1i][:, :, x1i] * wy * wx)
+            ys.append(v)
+        return jnp.stack(ys)
+    return apply(fn, x, boxes, name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    out_h, out_w = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+
+    def fn(feat, bxs):
+        n, c, h, w = feat.shape
+        bn = np.asarray(unwrap(boxes_num))
+        batch_ids = np.repeat(np.arange(len(bn)), bn)
+        ys = []
+        for bi in range(bxs.shape[0]):
+            x1, y1, x2, y2 = (bxs[bi] * spatial_scale)
+            x1i = jnp.clip(jnp.floor(x1).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(jnp.floor(y1).astype(jnp.int32), 0, h - 1)
+            fm = feat[int(batch_ids[bi])]
+            bh = jnp.maximum((y2 - y1) / out_h, 1.0)
+            bw = jnp.maximum((x2 - x1) / out_w, 1.0)
+            grid = []
+            for oy in range(out_h):
+                row = []
+                for ox in range(out_w):
+                    ys_ = jnp.clip(y1i + jnp.arange(int(1)) + oy, 0, h - 1)
+                    sy = jnp.clip((y1 + oy * bh).astype(jnp.int32), 0, h - 1)
+                    ey = jnp.clip((y1 + (oy + 1) * bh).astype(jnp.int32) + 1, 0, h)
+                    sx = jnp.clip((x1 + ox * bw).astype(jnp.int32), 0, w - 1)
+                    ex = jnp.clip((x1 + (ox + 1) * bw).astype(jnp.int32) + 1, 0, w)
+                    patch = jax.lax.dynamic_slice(
+                        fm, (0, sy, sx),
+                        (c, 1, 1))
+                    row.append(jnp.max(patch, axis=(1, 2)))
+                grid.append(jnp.stack(row, -1))
+            ys.append(jnp.stack(grid, -2))
+        return jnp.stack(ys)
+    return apply(fn, x, boxes, name="roi_pool")
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    def fn(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            ox = (tcx[None] - pcx[:, None]) / pw[:, None] / pbv[:, 0:1]
+            oy = (tcy[None] - pcy[:, None]) / ph[:, None] / pbv[:, 1:2]
+            ow = jnp.log(tw[None] / pw[:, None]) / pbv[:, 2:3]
+            oh = jnp.log(th[None] / ph[:, None]) / pbv[:, 3:4]
+            return jnp.stack([ox, oy, ow, oh], axis=-1)
+        # decode
+        tcx = pbv[..., 0] * tb[..., 0] * pw[:, None] + pcx[:, None]
+        tcy = pbv[..., 1] * tb[..., 1] * ph[:, None] + pcy[:, None]
+        tw = jnp.exp(pbv[..., 2] * tb[..., 2]) * pw[:, None]
+        th = jnp.exp(pbv[..., 3] * tb[..., 3]) * ph[:, None]
+        return jnp.stack([tcx - tw / 2, tcy - th / 2, tcx + tw / 2,
+                          tcy + th / 2], axis=-1)
+    return apply(fn, prior_box, prior_box_var, target_box, name="box_coder")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    raise NotImplementedError("yolo_box: detection family planned (round 2)")
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, **kw):
+    raise NotImplementedError("yolo_loss: detection family planned (round 2)")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    raise NotImplementedError("deform_conv2d: planned (round 2; gather-based)")
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("DeformConv2D: planned (round 2)")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    rois = np.asarray(unwrap(fpn_rois))
+    scale = np.sqrt(np.maximum((rois[:, 2] - rois[:, 0]) *
+                               (rois[:, 3] - rois[:, 1]), 1e-9))
+    level = np.floor(np.log2(scale / refer_scale + 1e-9)) + refer_level
+    level = np.clip(level, min_level, max_level).astype(np.int64)
+    outs = []
+    restore = np.argsort(np.concatenate(
+        [np.where(level == l)[0] for l in range(min_level, max_level + 1)]))
+    for l in range(min_level, max_level + 1):
+        outs.append(Tensor(jnp.asarray(rois[level == l])))
+    return outs, Tensor(jnp.asarray(restore)), None
+
+
+def generate_proposals(*a, **k):
+    raise NotImplementedError("generate_proposals: planned (round 2)")
+
+
+class PSRoIPool:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("PSRoIPool: planned (round 2)")

@@ -1,0 +1,417 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/transforms.py).
+
+numpy-native (CHW/HWC ndarray pipeline; PIL optional) — the heavy lifting
+runs in the libptio C++ loader or numpy, keeping TPU host CPUs free.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+from ..._core.tensor import Tensor
+
+
+def _hwc(img):
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _resize_np(arr, size, interpolation="bilinear"):
+    import jax
+    import jax.numpy as jnp
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "lanczos": "linear", "box": "linear"}.get(interpolation, "linear")
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32), (oh, ow, arr.shape[2]),
+                           method=method)
+    return np.asarray(out).astype(arr.dtype if arr.dtype != np.uint8 else
+                                  np.float32).clip(0, 255).astype(arr.dtype) \
+        if arr.dtype == np.uint8 else np.asarray(out)
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        if arr.dtype == np.float32 and arr.max() > 1.5:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(arr))
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return _resize_np(_hwc(img), self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else [self.padding] * 4
+            arr = np.pad(arr, ((p[1], p[3]), (p[0], p[2]), (0, 0)),
+                         constant_values=self.fill)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed:
+            ph = max(th - h, 0)
+            pw = max(tw - w, 0)
+            if ph or pw:
+                arr = np.pad(arr, ((ph, ph), (pw, pw), (0, 0)),
+                             constant_values=self.fill)
+                h, w = arr.shape[:2]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _hwc(img)[:, ::-1].copy()
+        return _hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _hwc(img)[::-1].copy()
+        return _hwc(img)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        if isinstance(img, Tensor):
+            arr = np.asarray(img._value)
+        else:
+            arr = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m = self.mean.reshape(1, 1, -1)
+            s = self.std.reshape(1, 1, -1)
+        out = (arr - m) / s
+        if isinstance(img, Tensor):
+            import jax.numpy as jnp
+            return Tensor(jnp.asarray(out))
+        return out
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return _hwc(img).transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        f = 1 + random.uniform(-self.value, self.value)
+        return np.clip(arr * f, 0, 255).astype(np.asarray(img).dtype)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        f = 1 + random.uniform(-self.value, self.value)
+        mean = arr.mean()
+        return np.clip((arr - mean) * f + mean, 0, 255).astype(
+            np.asarray(img).dtype)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        f = 1 + random.uniform(-self.value, self.value)
+        gray = arr.mean(axis=2, keepdims=True)
+        return np.clip(gray + (arr - gray) * f, 0, 255).astype(
+            np.asarray(img).dtype)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        return _hwc(img)  # hue rotation: identity fallback (round 2: HSV path)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+
+    def _apply_image(self, img):
+        arr = img
+        for t in random.sample(self.ts, len(self.ts)):
+            arr = t(arr)
+        return arr
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else size
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                crop = arr[i:i + ch, j:j + cw]
+                return _resize_np(crop, self.size, self.interpolation)
+        return _resize_np(arr, self.size, self.interpolation)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False, center=None,
+                 fill=0, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if isinstance(degrees, numbers.Number) \
+            else degrees
+
+    def _apply_image(self, img):
+        from scipy import ndimage  # available via jax deps? fallback below
+        arr = _hwc(img)
+        angle = random.uniform(*self.degrees)
+        try:
+            out = ndimage.rotate(arr, angle, reshape=False, order=1)
+            return out.astype(arr.dtype)
+        except Exception:
+            k = int(round(angle / 90.0)) % 4
+            return np.rot90(arr, k).copy()
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else [padding] * 4
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        p = self.padding
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        if self.mode == "constant":
+            return np.pad(arr, ((p[1], p[3]), (p[0], p[2]), (0, 0)),
+                          constant_values=self.fill)
+        return np.pad(arr, ((p[1], p[3]), (p[0], p[2]), (0, 0)),
+                      mode={"edge": "edge", "reflect": "reflect",
+                            "symmetric": "symmetric"}[self.mode])
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype(np.float32)
+        gray = (arr * np.array([0.299, 0.587, 0.114])[:arr.shape[2]]
+                .reshape(1, 1, -1)).sum(2, keepdims=True)
+        out = np.repeat(gray, self.n, axis=2)
+        return out.astype(np.asarray(img).dtype)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3), value=0,
+                 inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if random.random() > self.prob:
+            return arr
+        out = arr.copy()
+        chw = out.ndim == 3 and out.shape[0] in (1, 3)
+        h, w = (out.shape[1], out.shape[2]) if chw else (out.shape[0], out.shape[1])
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = random.randint(0, h - eh)
+                j = random.randint(0, w - ew)
+                if chw:
+                    out[:, i:i + eh, j:j + ew] = self.value
+                else:
+                    out[i:i + eh, j:j + ew] = self.value
+                break
+        return out
+
+
+# functional API (reference: transforms/functional.py)
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_np(_hwc(img), size, interpolation)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _hwc(img)[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    return _hwc(img)[top:top + height, left:left + width]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _hwc(img).astype(np.float32)
+    return np.clip(arr * brightness_factor, 0, 255).astype(np.asarray(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _hwc(img).astype(np.float32)
+    mean = arr.mean()
+    return np.clip((arr - mean) * contrast_factor + mean, 0, 255).astype(
+        np.asarray(img).dtype)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    t = RandomRotation((angle, angle))
+    return t(img)

@@ -1,0 +1,24 @@
+"""Test harness config: force a virtual 8-device CPU mesh.
+
+The axon sitecustomize registers the TPU tunnel plugin at interpreter
+boot; we steer the backend choice to CPU *before any backend init* so
+tests are hermetic, fast, and can exercise 8-way sharding without chips.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as pt
+    pt.seed(42)
+    yield

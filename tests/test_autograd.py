@@ -1,0 +1,161 @@
+"""Autograd: tape vs finite differences & functional equivalence
+(SURVEY §4: gradient checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def fd_grad(f, x, eps=1e-4):
+    g = np.zeros_like(x)
+    for i in range(x.size):
+        xp = x.copy().reshape(-1)
+        xm = x.copy().reshape(-1)
+        xp[i] += eps
+        xm[i] -= eps
+        g.reshape(-1)[i] = (f(xp.reshape(x.shape)) - f(xm.reshape(x.shape))) / \
+            (2 * eps)
+    return g
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        a = pt.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+        loss = (a * a + 2 * a).sum()
+        loss.backward()
+        assert np.allclose(a.grad.numpy(), 2 * a.numpy() + 2)
+
+    def test_matmul_grad(self):
+        A = np.random.randn(3, 4).astype(np.float64)
+        B = np.random.randn(4, 2).astype(np.float64)
+        ta = pt.to_tensor(A, stop_gradient=False)
+        tb = pt.to_tensor(B, stop_gradient=False)
+        out = pt.matmul(ta, tb).sum()
+        out.backward()
+        assert np.allclose(ta.grad.numpy(),
+                           np.ones((3, 2)) @ B.T, atol=1e-8)
+        assert np.allclose(tb.grad.numpy(), A.T @ np.ones((3, 2)), atol=1e-8)
+
+    def test_broadcast_grad(self):
+        a = pt.to_tensor(np.random.randn(3, 1).astype(np.float64),
+                         stop_gradient=False)
+        b = pt.to_tensor(np.random.randn(1, 4).astype(np.float64),
+                         stop_gradient=False)
+        (a * b).sum().backward()
+        assert a.grad.shape == [3, 1]
+        assert np.allclose(a.grad.numpy(), b.numpy().sum(1, keepdims=True).T)
+
+    def test_grad_accumulation(self):
+        a = pt.to_tensor([1.0, 1.0], stop_gradient=False)
+        (a * 2).sum().backward()
+        (a * 3).sum().backward()
+        assert a.grad.numpy().tolist() == [5.0, 5.0]
+        a.clear_grad()
+        assert a.grad is None
+
+    def test_stop_gradient_blocks(self):
+        a = pt.to_tensor([1.0], stop_gradient=False)
+        b = a * 2
+        c = b.detach() * 3 + a
+        c.sum().backward()
+        assert a.grad.numpy().tolist() == [1.0]
+
+    def test_fd_check_composite(self):
+        x0 = np.random.randn(5).astype(np.float64)
+
+        def f_np(x):
+            return float(np.sum(np.tanh(x) * np.exp(-x * x) + x ** 3))
+
+        t = pt.to_tensor(x0, stop_gradient=False)
+        loss = (pt.tanh(t) * pt.exp(-t * t) + t ** 3).sum()
+        loss.backward()
+        assert np.allclose(t.grad.numpy(), fd_grad(f_np, x0), atol=1e-5)
+
+    def test_multi_output_op(self):
+        x = pt.to_tensor(np.random.randn(6).astype(np.float64),
+                         stop_gradient=False)
+        v, i = pt.topk(x, 3)
+        v.sum().backward()
+        g = x.grad.numpy()
+        top_idx = set(np.argsort(-x.numpy())[:3].tolist())
+        for j in range(6):
+            assert g[j] == (1.0 if j in top_idx else 0.0)
+
+    def test_getitem_grad(self):
+        x = pt.to_tensor(np.ones((3, 3)), stop_gradient=False)
+        y = x[1]
+        y.sum().backward()
+        g = x.grad.numpy()
+        assert g[1].tolist() == [1, 1, 1]
+        assert g[0].tolist() == [0, 0, 0]
+
+    def test_retain_grads_intermediate(self):
+        a = pt.to_tensor([2.0], stop_gradient=False)
+        b = a * 3
+        b.retain_grads()
+        (b * b).sum().backward()
+        assert np.allclose(b.grad.numpy(), 2 * b.numpy())
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x * x).sum()
+        (gx,) = pt.grad(y, x)
+        assert np.allclose(gx.numpy(), 2 * x.numpy())
+        assert x.grad is None  # paddle.grad does not populate .grad
+
+    def test_no_grad(self):
+        x = pt.to_tensor([1.0], stop_gradient=False)
+        with pt.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    @pt.no_grad()
+    def _helper(self, x):
+        return x * 2
+
+    def test_no_grad_decorator(self):
+        x = pt.to_tensor([1.0], stop_gradient=False)
+        assert self._helper(x).stop_gradient
+
+    def test_second_order_via_functional(self):
+        import jax
+        import jax.numpy as jnp
+        f = lambda x: jnp.sum(x ** 3)
+        hess = jax.hessian(f)(jnp.array([1.0, 2.0]))
+        assert np.allclose(np.diag(np.asarray(hess)), [6.0, 12.0])
+
+
+class TestPyLayer:
+    def test_custom_vjp(self):
+        class Double(pt.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, gy):
+                return gy * 10  # deliberately nonstandard
+
+        x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        assert np.allclose(y.numpy(), [2.0, 4.0])
+        y.sum().backward()
+        assert np.allclose(x.grad.numpy(), [10.0, 10.0])
+
+
+class TestTapeUnderJit:
+    def test_ops_traceable(self):
+        """Ops must be usable inside jax.jit (functional path)."""
+        import jax
+        import jax.numpy as jnp
+
+        def pure(xa):
+            t = pt.Tensor(xa)
+            out = (pt.tanh(t) * 2).sum()
+            return out._value
+
+        g = jax.grad(pure)(jnp.asarray(np.random.randn(4)))
+        assert g.shape == (4,)

@@ -1,0 +1,257 @@
+"""Tensor op numerics vs numpy (SURVEY §4: unit per op family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def np_of(t):
+    return np.asarray(t.numpy())
+
+
+class TestCreation:
+    def test_to_tensor_dtypes(self):
+        assert pt.to_tensor([1, 2]).dtype == np.dtype("int64")
+        assert pt.to_tensor([1.0, 2.0]).dtype == np.dtype("float32")
+        assert pt.to_tensor([True]).dtype == np.dtype("bool")
+        assert pt.to_tensor([1.0], dtype="float64").dtype == np.dtype("float64")
+        assert pt.to_tensor([1.0], dtype=pt.bfloat16).dtype == pt.bfloat16
+
+    def test_factories(self):
+        assert pt.zeros([2, 3]).shape == [2, 3]
+        assert float(pt.ones([2]).sum()) == 2.0
+        assert np.allclose(np_of(pt.full([2, 2], 7)), 7)
+        assert np_of(pt.arange(5)).tolist() == [0, 1, 2, 3, 4]
+        assert pt.arange(5).dtype == np.dtype("int64")
+        assert np.allclose(np_of(pt.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+        assert np.allclose(np_of(pt.eye(3)), np.eye(3))
+
+    def test_like_and_tri(self):
+        x = pt.randn([3, 3])
+        assert np.allclose(np_of(pt.zeros_like(x)), 0)
+        assert np.allclose(np_of(pt.tril(x)), np.tril(np_of(x)))
+        assert np.allclose(np_of(pt.triu(x, 1)), np.triu(np_of(x), 1))
+
+    def test_meshgrid_diag(self):
+        a, b = pt.meshgrid(pt.arange(3), pt.arange(4))
+        assert a.shape == [3, 4]
+        d = pt.diag(pt.to_tensor([1.0, 2.0, 3.0]))
+        assert np.allclose(np_of(d), np.diag([1, 2, 3]))
+
+
+class TestMath:
+    def test_binary_broadcast(self):
+        a = pt.to_tensor(np.random.randn(3, 1).astype(np.float32))
+        b = pt.to_tensor(np.random.randn(1, 4).astype(np.float32))
+        assert np.allclose(np_of(a + b), np_of(a) + np_of(b), atol=1e-6)
+        assert np.allclose(np_of(a * b), np_of(a) * np_of(b), atol=1e-6)
+        assert np.allclose(np_of(a / (b + 10)), np_of(a) / (np_of(b) + 10),
+                           atol=1e-6)
+
+    def test_scalar_promotion(self):
+        a = pt.to_tensor([1.0, 2.0])
+        assert (a + 1).dtype == np.dtype("float32")
+        assert (a * 2.5).dtype == np.dtype("float32")
+        i = pt.to_tensor([1, 2])
+        assert (i + 1).dtype == np.dtype("int64")
+
+    def test_unary(self):
+        x = np.abs(np.random.randn(10).astype(np.float32)) + 0.1
+        t = pt.to_tensor(x)
+        for name in ["sqrt", "exp", "log", "abs", "sin", "cos", "tanh",
+                     "floor", "ceil", "rsqrt", "square", "sign"]:
+            ours = np_of(getattr(pt, name)(t))
+            ref = getattr(np, name)(x) if hasattr(np, name) else None
+            if name == "rsqrt":
+                ref = 1.0 / np.sqrt(x)
+            if name == "square":
+                ref = x * x
+            assert np.allclose(ours, ref, atol=1e-5), name
+
+    def test_reductions(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        t = pt.to_tensor(x)
+        assert np.allclose(float(t.sum()), x.sum(), atol=1e-5)
+        assert np.allclose(np_of(pt.mean(t, axis=1)), x.mean(1), atol=1e-6)
+        assert np.allclose(np_of(pt.max(t, axis=0)), x.max(0))
+        assert np.allclose(np_of(pt.prod(t, axis=1)), x.prod(1), atol=1e-5)
+        assert np.allclose(np_of(pt.logsumexp(t)),
+                           np.log(np.exp(x).sum()), atol=1e-5)
+        assert np.allclose(np_of(pt.std(t, unbiased=False)),
+                           x.std(), atol=1e-6)
+
+    def test_cumulative(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        t = pt.to_tensor(x)
+        assert np.allclose(np_of(pt.cumsum(t, axis=1)), np.cumsum(x, 1), atol=1e-6)
+        assert np.allclose(np_of(pt.cumprod(t, dim=0)), np.cumprod(x, 0),
+                           atol=1e-6)
+        v, i = pt.cummax(t, axis=1)
+        assert np.allclose(np_of(v), np.maximum.accumulate(x, 1))
+
+    def test_clip_lerp(self):
+        x = pt.to_tensor([-2.0, 0.5, 3.0])
+        assert np_of(pt.clip(x, -1, 1)).tolist() == [-1.0, 0.5, 1.0]
+        a = pt.to_tensor([0.0, 0.0])
+        b = pt.to_tensor([10.0, 20.0])
+        assert np_of(pt.lerp(a, b, 0.5)).tolist() == [5.0, 10.0]
+
+
+class TestLinalg:
+    def test_matmul_transpose(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 5).astype(np.float32)
+        out = pt.matmul(pt.to_tensor(a), pt.to_tensor(b), transpose_x=True)
+        assert np.allclose(np_of(out), a.T @ b, atol=1e-5)
+
+    def test_solve_inv_det(self):
+        a = np.random.randn(4, 4).astype(np.float64) + 4 * np.eye(4)
+        b = np.random.randn(4, 2).astype(np.float64)
+        ta, tb = pt.to_tensor(a), pt.to_tensor(b)
+        assert np.allclose(np_of(pt.linalg.solve(ta, tb)), np.linalg.solve(a, b),
+                           atol=1e-8)
+        assert np.allclose(np_of(pt.linalg.inv(ta)), np.linalg.inv(a), atol=1e-8)
+        assert np.allclose(float(pt.linalg.det(ta)), np.linalg.det(a), rtol=1e-6)
+
+    def test_svd_qr_eigh(self):
+        a = np.random.randn(5, 3).astype(np.float64)
+        u, s, vt = pt.linalg.svd(pt.to_tensor(a))
+        assert np.allclose(np_of(u) @ np.diag(np_of(s)) @ np_of(vt), a, atol=1e-8)
+        q, r = pt.linalg.qr(pt.to_tensor(a))
+        assert np.allclose(np_of(q) @ np_of(r), a, atol=1e-8)
+        sym = a.T @ a
+        w, v = pt.linalg.eigh(pt.to_tensor(sym))
+        assert np.allclose(np_of(v) @ np.diag(np_of(w)) @ np_of(v).T, sym,
+                           atol=1e-8)
+
+    def test_norm(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        t = pt.to_tensor(x)
+        assert np.allclose(float(pt.norm(t)), np.linalg.norm(x), atol=1e-5)
+        assert np.allclose(np_of(pt.norm(t, p=1, axis=1)),
+                           np.abs(x).sum(1), atol=1e-5)
+
+    def test_einsum(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        out = pt.einsum("bij,bjk->bik", pt.to_tensor(a), pt.to_tensor(b))
+        assert np.allclose(np_of(out), np.einsum("bij,bjk->bik", a, b), atol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_zero_dim(self):
+        x = pt.randn([2, 3, 4])
+        assert pt.reshape(x, [0, -1]).shape == [2, 12]
+        assert pt.reshape(x, [-1]).shape == [24]
+
+    def test_concat_split_stack(self):
+        a = pt.randn([2, 3])
+        b = pt.randn([2, 3])
+        c = pt.concat([a, b], axis=0)
+        assert c.shape == [4, 3]
+        parts = pt.split(c, 2, axis=0)
+        assert np.allclose(np_of(parts[0]), np_of(a))
+        parts2 = pt.split(c, [1, -1], axis=0)
+        assert parts2[1].shape == [3, 3]
+        s = pt.stack([a, b], axis=1)
+        assert s.shape == [2, 2, 3]
+
+    def test_gather_scatter(self):
+        x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+        idx = pt.to_tensor(np.array([0, 2]))
+        g = pt.gather(x, idx, axis=0)
+        assert np_of(g).tolist() == [[0, 1, 2], [6, 7, 8]]
+        upd = pt.to_tensor(np.ones((2, 3), np.float32))
+        s = pt.scatter(x, idx, upd)
+        assert np_of(s)[0].tolist() == [1, 1, 1]
+        nd = pt.gather_nd(x, pt.to_tensor(np.array([[1, 2], [3, 0]])))
+        assert np_of(nd).tolist() == [5.0, 9.0]
+
+    def test_squeeze_expand_tile(self):
+        x = pt.randn([1, 3, 1])
+        assert pt.squeeze(x).shape == [3]
+        assert pt.squeeze(x, axis=0).shape == [3, 1]
+        assert pt.unsqueeze(x, [0, 2]).shape == [1, 1, 1, 3, 1]
+        assert pt.expand(pt.randn([1, 3]), [4, 3]).shape == [4, 3]
+        assert pt.tile(pt.randn([2]), [3]).shape == [6]
+
+    def test_take_put_along_axis(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        idx = np.argsort(x, axis=1)
+        out = pt.take_along_axis(pt.to_tensor(x), pt.to_tensor(idx), axis=1)
+        assert np.allclose(np_of(out), np.take_along_axis(x, idx, 1))
+
+    def test_flip_roll_indexing(self):
+        x = pt.to_tensor(np.arange(6).reshape(2, 3))
+        assert np_of(pt.flip(x, axis=1)).tolist() == [[2, 1, 0], [5, 4, 3]]
+        assert np_of(pt.roll(x, 1, axis=1)).tolist() == [[2, 0, 1], [5, 3, 4]]
+        assert np_of(x[0, 1:]).tolist() == [1, 2]
+        assert np_of(x[:, -1]).tolist() == [2, 5]
+
+    def test_setitem(self):
+        x = pt.zeros([3, 3])
+        x[1] = 5.0
+        assert np_of(x)[1].tolist() == [5, 5, 5]
+        x[0, 0] = pt.to_tensor(2.0)
+        assert float(x[0, 0]) == 2.0
+
+
+class TestLogicSearch:
+    def test_comparisons(self):
+        a = pt.to_tensor([1.0, 2.0, 3.0])
+        b = pt.to_tensor([2.0, 2.0, 2.0])
+        assert np_of(a < b).tolist() == [True, False, False]
+        assert np_of(a == b).tolist() == [False, True, False]
+        assert bool(pt.allclose(a, a))
+        assert bool(pt.equal_all(a, a))
+
+    def test_where_nonzero(self):
+        x = pt.to_tensor([-1.0, 0.0, 2.0])
+        w = pt.where(x > 0, x, pt.zeros_like(x))
+        assert np_of(w).tolist() == [0.0, 0.0, 2.0]
+        nz = pt.nonzero(x)
+        assert np_of(nz).reshape(-1).tolist() == [0, 2]
+
+    def test_sort_topk_unique(self):
+        x = pt.to_tensor([3.0, 1.0, 2.0])
+        assert np_of(pt.sort(x)).tolist() == [1.0, 2.0, 3.0]
+        assert np_of(pt.argsort(x)).tolist() == [1, 2, 0]
+        v, i = pt.topk(x, 2)
+        assert np_of(v).tolist() == [3.0, 2.0]
+        u = pt.unique(pt.to_tensor([1, 1, 2, 3, 3]))
+        assert np_of(u).tolist() == [1, 2, 3]
+
+    def test_argmax_median(self):
+        x = pt.to_tensor(np.array([[1.0, 5.0, 2.0], [7.0, 0.0, 3.0]]))
+        assert np_of(pt.argmax(x, axis=1)).tolist() == [1, 0]
+        assert float(pt.median(pt.to_tensor([1.0, 2.0, 3.0]))) == 2.0
+
+    def test_masked_select_searchsorted(self):
+        x = pt.to_tensor([1.0, 2.0, 3.0, 4.0])
+        m = x > 2
+        assert np_of(pt.masked_select(x, m)).tolist() == [3.0, 4.0]
+        ss = pt.searchsorted(x, pt.to_tensor([2.5]))
+        assert np_of(ss).tolist() == [2]
+
+
+class TestRandomFFT:
+    def test_random_shapes_reproducible(self):
+        pt.seed(7)
+        a = pt.rand([3, 3])
+        pt.seed(7)
+        b = pt.rand([3, 3])
+        assert np.allclose(np_of(a), np_of(b))
+        assert pt.randint(0, 10, [5]).dtype == np.dtype("int64")
+        assert sorted(np_of(pt.randperm(5)).tolist()) == [0, 1, 2, 3, 4]
+
+    def test_bernoulli_multinomial(self):
+        p = pt.full([100], 1.0)
+        assert float(pt.bernoulli(p).sum()) == 100.0
+        m = pt.multinomial(pt.to_tensor([0.0, 0.0, 1.0]), 3, replacement=True)
+        assert np_of(m).tolist() == [2, 2, 2]
+
+    def test_fft_roundtrip(self):
+        x = np.random.randn(16).astype(np.float32)
+        X = pt.fft.fft(pt.to_tensor(x).astype("complex64"))
+        back = pt.fft.ifft(X)
+        assert np.allclose(np_of(back).real, x, atol=1e-5)
