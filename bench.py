@@ -1,0 +1,89 @@
+"""Benchmark: Llama pretrain step on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: tokens/sec/chip on a Llama block-scaled pretrain step (bf16,
+flash attention, remat, AdamW w/ fp32 master) + estimated MFU vs chip
+peak. vs_baseline = MFU / 0.40 (BASELINE.json north-star: ≥40% MFU).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# chip peak bf16 FLOP/s (dense) by generation
+PEAK_FLOPS = {
+    "v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+    "cpu": 1e12,
+}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e") if on_tpu else "cpu"
+    peak = PEAK_FLOPS.get(gen, 197e12)
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models import llama_spmd as M
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048)
+        batch, seq, iters, dtype = 8, 2048, 10, jnp.bfloat16
+    else:  # CPU smoke fallback
+        cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4,
+                               kv_heads=2, ffn=256)
+        batch, seq, iters, dtype = 2, 128, 3, jnp.float32
+
+    from jax.sharding import Mesh
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    params = M.init_params(cfg, seed=0, dtype=dtype)
+    opt = M.init_opt_state(params)
+    step = M.make_train_step(cfg, mesh, n_micro=None, remat=True, lr=3e-4)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (batch, seq))
+    y = rng.randint(0, cfg.vocab_size, (batch, seq))
+
+    # compile + warmup
+    params, opt, loss = step(params, opt, jnp.asarray(0), (x, y))
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt, loss = step(params, opt, jnp.asarray(i + 1), (x, y))
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_step = batch * seq
+    tok_per_sec = tokens_per_step / dt
+
+    # model FLOPs per token: 6*N_matmul + attention 12*L*H_dim*S terms
+    H, L, F, V = (cfg.hidden_size, cfg.num_hidden_layers,
+                  cfg.intermediate_size, cfg.vocab_size)
+    kv = cfg.num_key_value_heads * (H // cfg.num_attention_heads)
+    n_matmul = L * (2 * H * H + 2 * H * kv + 3 * H * F) + 2 * V * H
+    flops_per_token = 6 * n_matmul + 12 * L * H * seq  # fwd+bwd incl. attn
+    mfu = flops_per_token * tok_per_sec / peak
+
+    print(json.dumps({
+        "metric": f"llama-{'2048x8' if on_tpu else 'tiny'} pretrain "
+                  f"tokens/sec/chip ({gen}, bf16, flash-attn, remat)",
+        "value": round(tok_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
+                  "loss": float(loss), "backend": backend},
+    }))
+
+
+if __name__ == "__main__":
+    main()

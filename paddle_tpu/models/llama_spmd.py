@@ -1,0 +1,244 @@
+"""Llama 4D-parallel pretrain step — the fleet-equivalent SPMD path.
+
+Replaces the reference's fleet hybrid-parallel Llama pretrain
+(python/paddle/distributed/fleet/meta_parallel/* + PaddleNLP llm/
+modeling_pp.py) with a single pure train-step program:
+
+  * layer params stacked (L, ...) → lax.scan over layers (pp=1) or
+    grouped (pp, L/pp, ...) and pipelined via shard_map+ppermute (pp>1).
+  * tp: megatron specs on weight axes (GSPMD inserts collectives).
+  * dp: batch sharding (grad psum from GSPMD).
+  * sp: optional ring attention over an 'sp' axis for long context.
+  * remat: jax.checkpoint around each decoder layer.
+  * AdamW with fp32 master weights; params bf16 on TPU.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.rope import rope_cos_sin, apply_rotary_emb
+from ..ops.flash_attention import flash_attention_bhsd
+from ..parallel.pp import pipeline_apply, group_stages
+from ..parallel.ring import ring_attention_local
+from .llama import LlamaConfig
+
+
+# ---------------------------------------------------------------- params
+def init_params(config: LlamaConfig, seed=0, dtype=jnp.float32):
+    c = config
+    key = jax.random.key(seed)
+    ks = jax.random.split(key, 12)
+    H, F_, V, L = c.hidden_size, c.intermediate_size, c.vocab_size, \
+        c.num_hidden_layers
+    KV = c.num_key_value_heads * (H // c.num_attention_heads)
+    std = c.initializer_range
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * std).astype(dtype)
+
+    params = {
+        "embed": w(ks[0], (V, H)),
+        "final_norm": jnp.ones((H,), dtype),
+        "lm_head": w(ks[1], (H, V)),
+        "layers": {
+            "ln1": jnp.ones((L, H), dtype),
+            "wq": w(ks[2], (L, H, H)),
+            "wk": w(ks[3], (L, H, KV)),
+            "wv": w(ks[4], (L, H, KV)),
+            "wo": w(ks[5], (L, H, H)),
+            "ln2": jnp.ones((L, H), dtype),
+            "w_gate": w(ks[6], (L, H, F_)),
+            "w_up": w(ks[7], (L, H, F_)),
+            "w_down": w(ks[8], (L, F_, H)),
+        },
+    }
+    return params
+
+
+def param_specs(config, mesh, pp=False, fsdp_axis=None):
+    """PartitionSpecs: megatron TP on weight axes; stacked layer axis over
+    'pp' when pipelining; optional fsdp sharding of the embed/lm_head."""
+    tp = "tp" if "tp" in mesh.shape else None
+    ppax = "pp" if (pp and "pp" in mesh.shape) else None
+    specs = {
+        "embed": P(tp, None),
+        "final_norm": P(),
+        "lm_head": P(None, tp),
+        "layers": {
+            "ln1": P(ppax, None),
+            "wq": P(ppax, None, tp),
+            "wk": P(ppax, None, tp),
+            "wv": P(ppax, None, tp),
+            "wo": P(ppax, tp, None),
+            "ln2": P(ppax, None),
+            "w_gate": P(ppax, None, tp),
+            "w_up": P(ppax, None, tp),
+            "w_down": P(ppax, tp, None),
+        },
+    }
+    return specs
+
+
+# ---------------------------------------------------------------- forward
+def _rms(x, g, eps):
+    xf = x.astype(jnp.float32)
+    out = xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return (out * g.astype(jnp.float32)).astype(x.dtype)
+
+
+def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
+    """One decoder layer, pure. h: (B, S, H). rope: (cos, sin)."""
+    c = config
+    cos, sin = rope
+    nh = c.num_attention_heads
+    nkv = c.num_key_value_heads
+    hd = c.hidden_size // nh
+    b, s, H = h.shape
+
+    x = _rms(h, lp["ln1"], c.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(b, s, nh, hd).swapaxes(1, 2)
+    k = (x @ lp["wk"]).reshape(b, s, nkv, hd).swapaxes(1, 2)
+    v = (x @ lp["wv"]).reshape(b, s, nkv, hd).swapaxes(1, 2)
+    q, k = apply_rotary_emb(q, k, cos[None, None], sin[None, None])
+    rep = nh // nkv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if sp_axis is not None:
+        o = ring_attention_local(q, k, v, axis_name=sp_axis, causal=True)
+    else:
+        o = flash_attention_bhsd(q, k, v, causal=True)
+    attn_out = o.swapaxes(1, 2).reshape(b, s, H) @ lp["wo"]
+    h = h + attn_out
+
+    x = _rms(h, lp["ln2"], c.rms_norm_eps)
+    mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    return h + mlp
+
+
+def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
+            remat=True, sp_axis=None):
+    """→ logits (B, S, V). Uses pipeline when mesh has pp>1, else scan."""
+    c = config
+    s = input_ids.shape[1]
+    cos, sin = rope_cos_sin(s, c.hidden_size // c.num_attention_heads,
+                            c.rope_theta, jnp.float32)
+    h = jnp.take(params["embed"], input_ids, axis=0)
+
+    layer = functools.partial(decoder_layer, config=c, sp_axis=sp_axis)
+    if remat:
+        layer = jax.checkpoint(layer)
+
+    use_pp = mesh is not None and mesh.shape.get("pp", 1) > 1
+    if use_pp:
+        n_stages = mesh.shape["pp"]
+        staged = group_stages(params["layers"], n_stages)
+        h = pipeline_apply(staged, h,
+                           lambda lp, hh, extra: layer(lp, hh, extra),
+                           mesh, pp_axis="pp", n_micro=n_micro,
+                           extra=(cos, sin))
+    else:
+        def body(hh, lp):
+            return layer(lp, hh, (cos, sin)), None
+        h, _ = lax.scan(body, h, params["layers"])
+
+    h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    return h @ params["lm_head"]
+
+
+def loss_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
+            sp_axis=None):
+    input_ids, labels = batch
+    logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                                 axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+# ---------------------------------------------------------------- training
+def init_opt_state(params):
+    return jax.tree_util.tree_map(
+        lambda p: {"m": jnp.zeros_like(p, dtype=jnp.float32),
+                   "v": jnp.zeros_like(p, dtype=jnp.float32),
+                   # copy=True: master must not alias the param buffer
+                   # (both pytrees are donated to the train step)
+                   "master": jnp.array(p, dtype=jnp.float32, copy=True)}, params)
+
+
+def adamw_update(params, grads, state, lr, step, b1=0.9, b2=0.95, eps=1e-8,
+                 wd=0.1):
+    t = step.astype(jnp.float32) + 1.0
+
+    def upd(p, g, s):
+        g = g.astype(jnp.float32)
+        m = b1 * s["m"] + (1 - b1) * g
+        v = b2 * s["v"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        master = s["master"] * (1 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return master.astype(p.dtype), {"m": m, "v": v, "master": master}
+
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = jax.tree_util.tree_flatten(grads)[0]
+    flat_s = treedef.flatten_up_to(state)
+    outs = [upd(p, g, s) for p, g, s in zip(flat_p, flat_g, flat_s)]
+    new_p = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+    new_s = jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs])
+    return new_p, new_s
+
+
+def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
+                    clip_norm=1.0, lr=3e-4, sp_axis=None, donate=True):
+    """Build the jitted 4D-parallel train step.
+
+    (params, opt_state, step, batch) → (params, opt_state, loss)
+    """
+    use_pp = mesh.shape.get("pp", 1) > 1
+    specs = param_specs(config, mesh, pp=use_pp)
+    pshard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
+                                    is_leaf=lambda x: isinstance(x, P))
+    sshard = jax.tree_util.tree_map(
+        lambda sh: {"m": sh, "v": sh, "master": sh}, pshard,
+        is_leaf=lambda x: isinstance(x, NamedSharding))
+    repl = NamedSharding(mesh, P())
+    bshard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), batch_spec,
+                                    is_leaf=lambda x: isinstance(x, P))
+
+    def step_fn(params, opt_state, step, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, batch, config, mesh if use_pp else None, n_micro, remat,
+            sp_axis)
+        if clip_norm is not None:
+            leaves = jax.tree_util.tree_leaves(grads)
+            gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                              for g in leaves))
+            scale = clip_norm / jnp.maximum(gn, clip_norm)
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        params, opt_state = adamw_update(params, grads, opt_state, lr, step)
+        return params, opt_state, loss
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(pshard, sshard, None, (bshard, bshard)),
+        out_shardings=(pshard, sshard, repl),
+        donate_argnums=(0, 1) if donate else ())
+
+
+def place_params(params, config, mesh, pp=None):
+    if pp is None:
+        pp = mesh.shape.get("pp", 1) > 1
+    specs = param_specs(config, mesh, pp=pp)
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_s = treedef.flatten_up_to(specs)
+    placed = [jax.device_put(p, NamedSharding(mesh, s))
+              for p, s in zip(flat_p, flat_s)]
+    return jax.tree_util.tree_unflatten(treedef, placed)

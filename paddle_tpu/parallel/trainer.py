@@ -133,8 +133,12 @@ class Trainer:
             lambda s: NamedSharding(self.mesh, s), self.state_specs,
             is_leaf=lambda x: isinstance(x, P))
         repl = NamedSharding(self.mesh, P())
-        bspec = NamedSharding(self.mesh, self.batch_spec) \
-            if self.batch_spec is not None else repl
+        if self.batch_spec is None:
+            bspec = repl
+        else:
+            bspec = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), self.batch_spec,
+                is_leaf=lambda x: isinstance(x, P))
 
         return jax.jit(
             train_step,
