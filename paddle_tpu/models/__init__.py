@@ -1,0 +1,9 @@
+"""Model families (flagship workloads from BASELINE.json configs)."""
+from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM  # noqa: F401
+from . import llama_spmd  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForSequenceClassification, BertForPretraining,
+)
+from .gpt2 import GPT2Config, GPT2Model, GPT2LMHeadModel  # noqa: F401
+from .moe_llm import MoEConfig, MoEForCausalLM  # noqa: F401
+from . import generation  # noqa: F401

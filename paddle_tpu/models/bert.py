@@ -1,0 +1,163 @@
+"""BERT (reference workload: PaddleNLP bert finetune — BASELINE config 3).
+
+Standard pre-LN-free BERT encoder built on paddle_tpu.nn primitives;
+attention path uses the fused scaled_dot_product_attention (flash kernel
+when unmasked).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply
+from .. import nn
+from ..nn import functional as F
+from ..nn.initializer import Normal, Constant
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+    @classmethod
+    def base(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=128,
+                   max_position_embeddings=128)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, c: BertConfig):
+        super().__init__()
+        init = nn.ParamAttr(initializer=Normal(0.0, c.initializer_range))
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size,
+                                            weight_attr=init)
+        self.position_embeddings = nn.Embedding(c.max_position_embeddings,
+                                                c.hidden_size, weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size,
+                                                  c.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            from ..tensor.creation import arange
+            position_ids = arange(s, dtype="int64")
+        if token_type_ids is None:
+            from ..tensor.creation import zeros
+            token_type_ids = zeros([input_ids.shape[0], s], dtype="int64")
+        emb = self.word_embeddings(input_ids) + \
+            self.position_embeddings(position_ids) + \
+            self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        c = config
+        self.embeddings = BertEmbeddings(c)
+        layer = nn.TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation=c.hidden_act,
+            attn_dropout=c.attention_probs_dropout_prob, act_dropout=0.0,
+            normalize_before=False, layer_norm_eps=c.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(layer, c.num_hidden_layers)
+        self.pooler = nn.Linear(c.hidden_size, c.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # (B, S) 1/0 → additive (B, 1, 1, S)
+            def fn(m):
+                return (1.0 - m.astype(jnp.float32))[:, None, None, :] * -1e4
+            attention_mask = apply(fn, attention_mask, name="bert_mask")
+        seq = self.encoder(x, attention_mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels), logits
+        return logits
+
+
+class BertLMHead(nn.Layer):
+    def __init__(self, config: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.decoder_weight = embedding_weights
+        self.decoder_bias = self.create_parameter(
+            [config.vocab_size], is_bias=True,
+            default_initializer=Constant(0.0))
+        self.act = config.hidden_act
+
+    def forward(self, hidden):
+        h = getattr(F, self.act)(self.transform(hidden))
+        h = self.layer_norm(h)
+        from ..tensor.linalg import matmul
+        return matmul(h, self.decoder_weight, transpose_y=True) + \
+            self.decoder_bias
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference: PaddleNLP BertForPretraining)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.cls_mlm = BertLMHead(config,
+                                  self.bert.embeddings.word_embeddings.weight)
+        self.cls_nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_label=None):
+        seq, pooled = self.bert(input_ids, token_type_ids,
+                                attention_mask=attention_mask)
+        mlm_logits = self.cls_mlm(seq)
+        nsp_logits = self.cls_nsp(pooled)
+        if masked_lm_labels is not None:
+            mlm_loss = F.cross_entropy(mlm_logits, masked_lm_labels,
+                                       ignore_index=-100)
+            loss = mlm_loss
+            if next_sentence_label is not None:
+                loss = loss + F.cross_entropy(nsp_logits, next_sentence_label)
+            return loss, mlm_logits, nsp_logits
+        return mlm_logits, nsp_logits

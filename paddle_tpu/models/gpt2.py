@@ -1,0 +1,163 @@
+"""GPT-2 style decoder with KV-cache generation (reference workload:
+PaddleNLP gpt; exercises learned positions + pre-LN + causal attention).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply
+from .. import nn
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops.flash_attention import flash_attention_bhsd
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.1
+    initializer_range: float = 0.02
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=128,
+                   max_position_embeddings=128, dropout=0.0)
+
+
+class GPT2Attention(nn.Layer):
+    def __init__(self, c: GPT2Config):
+        super().__init__()
+        attr = nn.ParamAttr(initializer=Normal(0.0, c.initializer_range))
+        self.n_head = c.num_attention_heads
+        self.head_dim = c.hidden_size // c.num_attention_heads
+        self.c_attn = nn.Linear(c.hidden_size, 3 * c.hidden_size,
+                                weight_attr=attr)
+        self.c_proj = nn.Linear(c.hidden_size, c.hidden_size, weight_attr=attr)
+        self.dropout = c.dropout
+
+    def forward(self, x, kv_cache=None, causal=True):
+        b, s, h = x.shape
+        nh, hd = self.n_head, self.head_dim
+
+        def fn(xr, w, bias, wo, bo, *cache):
+            qkv = xr @ w + bias
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(b, s, nh, hd).swapaxes(1, 2)
+            k = k.reshape(b, s, nh, hd).swapaxes(1, 2)
+            v = v.reshape(b, s, nh, hd).swapaxes(1, 2)
+            if cache:
+                k = jnp.concatenate([cache[0], k], axis=2)
+                v = jnp.concatenate([cache[1], v], axis=2)
+            o = flash_attention_bhsd(q, k, v, causal=causal)
+            o = o.swapaxes(1, 2).reshape(b, s, h)
+            return o @ wo + bo, k, v
+
+        args = [x, self.c_attn.weight, self.c_attn.bias, self.c_proj.weight,
+                self.c_proj.bias]
+        if kv_cache is not None:
+            args += list(kv_cache)
+        out, k, v = apply(fn, *args, name="gpt2_attention", multi=True)
+        return out, (k, v)
+
+
+class GPT2Block(nn.Layer):
+    def __init__(self, c: GPT2Config):
+        super().__init__()
+        attr = nn.ParamAttr(initializer=Normal(0.0, c.initializer_range))
+        self.ln_1 = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.attn = GPT2Attention(c)
+        self.ln_2 = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+        self.mlp_fc = nn.Linear(c.hidden_size, c.intermediate_size,
+                                weight_attr=attr)
+        self.mlp_proj = nn.Linear(c.intermediate_size, c.hidden_size,
+                                  weight_attr=attr)
+        self.drop = nn.Dropout(c.dropout)
+
+    def forward(self, x, kv_cache=None, causal=True):
+        a, new_cache = self.attn(self.ln_1(x), kv_cache, causal)
+        x = x + self.drop(a)
+        m = self.mlp_proj(F.gelu(self.mlp_fc(self.ln_2(x)), approximate=True))
+        return x + self.drop(m), new_cache
+
+
+class GPT2Model(nn.Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.config = config
+        c = config
+        attr = nn.ParamAttr(initializer=Normal(0.0, c.initializer_range))
+        self.wte = nn.Embedding(c.vocab_size, c.hidden_size, weight_attr=attr)
+        self.wpe = nn.Embedding(c.max_position_embeddings, c.hidden_size,
+                                weight_attr=attr)
+        self.drop = nn.Dropout(c.dropout)
+        self.h = nn.LayerList([GPT2Block(c) for _ in range(c.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
+
+    def forward(self, input_ids, position_offset=0, kv_caches=None):
+        from ..tensor.creation import arange
+        s = input_ids.shape[1]
+        pos = arange(position_offset, position_offset + s, dtype="int64")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        new_caches = []
+        causal = s > 1
+        for i, block in enumerate(self.h):
+            cache = kv_caches[i] if kv_caches is not None else None
+            x, nc = block(x, cache, causal=causal)
+            new_caches.append(nc)
+        return self.ln_f(x), new_caches
+
+
+class GPT2LMHeadModel(nn.Layer):
+    def __init__(self, config: GPT2Config):
+        super().__init__()
+        self.config = config
+        self.transformer = GPT2Model(config)
+
+    def forward(self, input_ids, labels=None, position_offset=0, kv_caches=None):
+        h, new_caches = self.transformer(input_ids, position_offset, kv_caches)
+        from ..tensor.linalg import matmul
+        logits = matmul(h, self.transformer.wte.weight, transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(logits, labels)
+            return loss, logits
+        if kv_caches is not None or position_offset:
+            return logits, new_caches
+        return logits
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+                 top_p=1.0, eos_token_id=None):
+        """KV-cached eager decode."""
+        from ..autograd import no_grad
+        from .generation import _sample_logits
+        from .._core.state import prng
+        ids = input_ids if isinstance(input_ids, Tensor) else \
+            Tensor(jnp.asarray(np.asarray(input_ids)))
+        with no_grad():
+            logits, caches = self.forward(ids, position_offset=1)  # prefill
+            toks = []
+            cur_len = ids.shape[1]
+            last = logits._value[:, -1]
+            for step in range(max_new_tokens):
+                tok = _sample_logits(last, temperature, top_k, top_p,
+                                     prng.next_key())
+                toks.append(np.asarray(tok))
+                if eos_token_id is not None and \
+                        (np.asarray(tok) == eos_token_id).all():
+                    break
+                cur = Tensor(tok[:, None])
+                logits, caches = self.forward(cur, position_offset=cur_len,
+                                              kv_caches=caches)
+                cur_len += 1
+                last = logits._value[:, -1]
+        gen = jnp.asarray(np.stack(toks, axis=1))
+        return Tensor(jnp.concatenate([ids._value, gen], axis=1))

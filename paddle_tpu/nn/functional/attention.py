@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 
 from ..._core.tensor import Tensor, apply, unwrap
-from ...ops import flash_attention as _fa_op
+from ...ops.flash_attention import flash_attention as _flash_fn
 
 
 @contextlib.contextmanager
@@ -24,7 +24,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """
     if attn_mask is None:
         def fn(q, k, v):
-            out, _ = _fa_op.flash_attention(q, k, v, dropout=dropout_p,
+            out, _ = _flash_fn(q, k, v, dropout=dropout_p,
                                             causal=is_causal, training=training)
             return out
         return apply(fn, query, key, value, name="scaled_dot_product_attention")
@@ -59,7 +59,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     training=True, name=None):
     """paddle.nn.functional.flash_attention.flash_attention parity."""
     def fn(q, k, v):
-        out, _ = _fa_op.flash_attention(q, k, v, dropout=dropout, causal=causal,
+        out, _ = _flash_fn(q, k, v, dropout=dropout, causal=causal,
                                         training=training)
         return out
     out = apply(fn, query, key, value, name="flash_attention")

@@ -1,0 +1,113 @@
+"""LLM model family tests (SURVEY §4: model fwd+loss+train step)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import (
+    LlamaConfig, LlamaForCausalLM, BertConfig, BertForSequenceClassification,
+    BertForPretraining, GPT2Config, GPT2LMHeadModel, MoEConfig, MoEForCausalLM,
+)
+
+
+def _ids(b, s, v, seed=0):
+    return pt.to_tensor(np.random.RandomState(seed).randint(0, v, (b, s)))
+
+
+class TestLlama:
+    def test_forward_and_train_step(self):
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        x = _ids(2, 16, cfg.vocab_size)
+        y = _ids(2, 16, cfg.vocab_size, seed=1)
+        logits = model(x)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+        opt = pt.optimizer.AdamW(1e-3, parameters=model.parameters())
+        losses = []
+        for _ in range(3):
+            loss, _ = model(x, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_gqa_heads(self):
+        cfg = LlamaConfig.tiny(heads=4, kv_heads=2)
+        model = LlamaForCausalLM(cfg)
+        assert model.llama.layers[0].self_attn.k_proj.weight.shape[1] == \
+            cfg.hidden_size // 2
+
+    def test_causality(self):
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        x = _ids(1, 16, cfg.vocab_size)
+        full = model(x).numpy()
+        x2 = np.array(x.numpy(), copy=True)
+        x2[0, 8:] = 7  # change future tokens
+        out2 = model(pt.to_tensor(x2)).numpy()
+        assert np.allclose(full[0, :8], out2[0, :8], atol=1e-4)
+
+
+class TestBert:
+    def test_classification_train(self):
+        cfg = BertConfig.tiny()
+        model = BertForSequenceClassification(cfg, num_classes=3)
+        x = _ids(2, 16, cfg.vocab_size)
+        y = pt.to_tensor(np.array([0, 2]))
+        mask = pt.to_tensor(np.ones((2, 16), np.int64))
+        loss, logits = model(x, attention_mask=mask, labels=y)
+        assert logits.shape == [2, 3]
+        loss.backward()
+        assert model.bert.embeddings.word_embeddings.weight.grad is not None
+
+    def test_pretraining_heads(self):
+        cfg = BertConfig.tiny()
+        model = BertForPretraining(cfg)
+        x = _ids(2, 16, cfg.vocab_size)
+        mlm_labels = _ids(2, 16, cfg.vocab_size, seed=2)
+        nsp = pt.to_tensor(np.array([0, 1]))
+        loss, mlm, nsp_logits = model(x, masked_lm_labels=mlm_labels,
+                                      next_sentence_label=nsp)
+        assert mlm.shape == [2, 16, cfg.vocab_size]
+        assert nsp_logits.shape == [2, 2]
+        assert np.isfinite(float(loss))
+
+
+class TestGPT2:
+    def test_train_step(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2LMHeadModel(cfg)
+        x = _ids(2, 16, cfg.vocab_size)
+        loss, _ = model(x, labels=x)
+        loss.backward()
+        assert np.isfinite(float(loss))
+
+    def test_generate_kv_cache_matches_full(self):
+        cfg = GPT2Config.tiny()
+        model = GPT2LMHeadModel(cfg)
+        model.eval()
+        x = _ids(1, 8, cfg.vocab_size)
+        out = model.generate(x, max_new_tokens=4, temperature=0.0)
+        assert out.shape == [1, 12]
+        # greedy with cache == greedy recompute-full
+        ids = np.asarray(x.numpy())
+        cur = ids
+        for _ in range(4):
+            logits = model(pt.to_tensor(cur))
+            nxt = np.argmax(np.asarray(logits.numpy())[:, -1], -1)
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        assert np.array_equal(np.asarray(out.numpy()), cur)
+
+
+class TestMoE:
+    def test_moe_train(self):
+        cfg = MoEConfig.tiny_moe()
+        model = MoEForCausalLM(cfg)
+        x = _ids(2, 16, cfg.vocab_size)
+        loss, logits = model(x, labels=x)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+        loss.backward()
+        gate = model.layers[0].mlp.gate_weight
+        assert gate.grad is not None
+        assert np.isfinite(float(loss))
