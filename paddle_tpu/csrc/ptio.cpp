@@ -1,0 +1,252 @@
+// libptio — native data-pipeline core for paddle_tpu.
+//
+// Replaces the reference's C++ DataLoader machinery
+// (paddle/fluid/operators/reader/blocking_queue.h + buffered_reader.cc):
+// an mmap'd fixed-record reader, epoch shuffling (xoshiro PRNG), a
+// multi-threaded batch-assembly pool, and a bounded prefetch queue the
+// Python DataLoader drains via ctypes. Keeps TPU host CPUs feeding HBM
+// without the GIL in the hot path.
+//
+// Build: make -C paddle_tpu/csrc  → libptio.so (ctypes, no pybind11).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ----------------------------------------------------------- PRNG
+struct Xoshiro256 {
+  uint64_t s[4];
+  explicit Xoshiro256(uint64_t seed) {
+    uint64_t z = seed + 0x9e3779b97f4a7c15ull;
+    for (auto& si : s) {
+      z += 0x9e3779b97f4a7c15ull;
+      uint64_t x = z;
+      x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+      x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+      si = x ^ (x >> 31);
+    }
+  }
+  static uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+  uint64_t next() {
+    uint64_t result = rotl(s[1] * 5, 7) * 9;
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+};
+
+// ----------------------------------------------------------- records
+struct RecordFile {
+  int fd = -1;
+  const uint8_t* data = nullptr;
+  size_t bytes = 0;
+  size_t record_bytes = 0;
+  size_t n_records = 0;
+};
+
+// ----------------------------------------------------------- pipeline
+struct Batch {
+  std::vector<uint8_t> buf;
+  int64_t n = 0;      // samples in batch
+  int64_t seq = 0;    // ordering key
+};
+
+struct Pipeline {
+  RecordFile* rf = nullptr;
+  int64_t batch_size = 0;
+  bool shuffle = false;
+  bool drop_last = true;
+  uint64_t seed = 0;
+  int64_t capacity = 4;
+
+  std::vector<uint64_t> order;       // shuffled indices for the epoch
+  std::atomic<int64_t> next_batch{0};
+  int64_t n_batches = 0;
+
+  std::deque<Batch> queue;           // completed batches (ordered pop)
+  int64_t next_emit = 0;             // next seq to hand to python
+  std::mutex mu;
+  std::condition_variable cv_room;   // producers wait for queue room
+  std::condition_variable cv_data;   // consumer waits for next_emit batch
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> workers;
+
+  ~Pipeline() { shutdown(); }
+
+  void shutdown() {
+    stop.store(true);
+    cv_room.notify_all();
+    cv_data.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    workers.clear();
+  }
+
+  void start_epoch(uint64_t epoch, int n_threads) {
+    shutdown();
+    stop.store(false);
+    size_t n = rf->n_records;
+    order.resize(n);
+    for (size_t i = 0; i < n; i++) order[i] = i;
+    if (shuffle) {
+      Xoshiro256 rng(seed * 2654435761ull + epoch + 1);
+      for (size_t i = n - 1; i > 0; i--) {
+        size_t j = rng.next() % (i + 1);
+        std::swap(order[i], order[j]);
+      }
+    }
+    n_batches = drop_last ? (int64_t)(n / batch_size)
+                          : (int64_t)((n + batch_size - 1) / batch_size);
+    next_batch.store(0);
+    next_emit = 0;
+    queue.clear();
+    for (int t = 0; t < n_threads; t++)
+      workers.emplace_back([this] { work(); });
+  }
+
+  void work() {
+    const size_t rb = rf->record_bytes;
+    while (!stop.load()) {
+      int64_t b = next_batch.fetch_add(1);
+      if (b >= n_batches) return;
+      int64_t lo = b * batch_size;
+      int64_t hi = std::min<int64_t>(lo + batch_size, (int64_t)order.size());
+      Batch out;
+      out.n = hi - lo;
+      out.seq = b;
+      out.buf.resize((size_t)(hi - lo) * rb);
+      for (int64_t i = lo; i < hi; i++)
+        std::memcpy(out.buf.data() + (size_t)(i - lo) * rb,
+                    rf->data + order[(size_t)i] * rb, rb);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_room.wait(lk, [this] {
+        return stop.load() || (int64_t)queue.size() < capacity;
+      });
+      if (stop.load()) return;
+      queue.push_back(std::move(out));
+      cv_data.notify_all();
+    }
+  }
+
+  // Returns samples copied (0 → epoch done), -1 on shutdown.
+  int64_t next(uint8_t* dst) {
+    std::unique_lock<std::mutex> lk(mu);
+    for (;;) {
+      if (next_emit >= n_batches) return 0;
+      // find batch with seq == next_emit
+      for (auto it = queue.begin(); it != queue.end(); ++it) {
+        if (it->seq == next_emit) {
+          std::memcpy(dst, it->buf.data(), it->buf.size());
+          int64_t n = it->n;
+          queue.erase(it);
+          next_emit++;
+          cv_room.notify_all();
+          return n;
+        }
+      }
+      if (stop.load()) return -1;
+      cv_data.wait(lk);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptio_open_records(const char* path, int64_t record_bytes) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, (size_t)st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (p == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  madvise(p, (size_t)st.st_size, MADV_WILLNEED);
+  auto* rf = new RecordFile();
+  rf->fd = fd;
+  rf->data = static_cast<const uint8_t*>(p);
+  rf->bytes = (size_t)st.st_size;
+  rf->record_bytes = (size_t)record_bytes;
+  rf->n_records = rf->bytes / rf->record_bytes;
+  return rf;
+}
+
+int64_t ptio_num_records(void* handle) {
+  return handle ? (int64_t)static_cast<RecordFile*>(handle)->n_records : -1;
+}
+
+void ptio_close_records(void* handle) {
+  if (!handle) return;
+  auto* rf = static_cast<RecordFile*>(handle);
+  munmap(const_cast<uint8_t*>(rf->data), rf->bytes);
+  ::close(rf->fd);
+  delete rf;
+}
+
+void* ptio_pipeline_create(void* records, int64_t batch_size, int shuffle,
+                           int drop_last, uint64_t seed, int64_t capacity) {
+  if (!records) return nullptr;
+  auto* p = new Pipeline();
+  p->rf = static_cast<RecordFile*>(records);
+  p->batch_size = batch_size;
+  p->shuffle = shuffle != 0;
+  p->drop_last = drop_last != 0;
+  p->seed = seed;
+  p->capacity = capacity > 0 ? capacity : 4;
+  return p;
+}
+
+void ptio_pipeline_start_epoch(void* pipeline, uint64_t epoch, int n_threads) {
+  if (!pipeline) return;
+  static_cast<Pipeline*>(pipeline)->start_epoch(
+      epoch, n_threads > 0 ? n_threads : 2);
+}
+
+int64_t ptio_pipeline_num_batches(void* pipeline) {
+  return pipeline ? static_cast<Pipeline*>(pipeline)->n_batches : -1;
+}
+
+int64_t ptio_pipeline_next(void* pipeline, uint8_t* dst) {
+  return pipeline ? static_cast<Pipeline*>(pipeline)->next(dst) : -1;
+}
+
+void ptio_pipeline_destroy(void* pipeline) {
+  delete static_cast<Pipeline*>(pipeline);
+}
+
+// ----------------------------------------------------------- staging pool
+// Page-aligned host staging buffers for H2D overlap (the reference keeps
+// pinned CUDA buffers; XLA TPU wants aligned host memory for fast DMA).
+void* ptio_alloc_staging(int64_t bytes) {
+  void* p = nullptr;
+  if (posix_memalign(&p, 4096, (size_t)bytes) != 0) return nullptr;
+  return p;
+}
+
+void ptio_free_staging(void* p) { free(p); }
+
+}  // extern "C"

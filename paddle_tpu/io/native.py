@@ -1,0 +1,136 @@
+"""ctypes bindings for libptio (C++ data-pipeline core) + RecordFile
+dataset/loader.
+
+The native path covers the byte-level hot loop (mmap read, shuffle,
+batch memcpy, prefetch) that the reference does in
+paddle/fluid/operators/reader; Python only sees finished batches.
+Builds lazily on first use (`make -C paddle_tpu/csrc`).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "csrc")
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    so = os.path.join(_CSRC, "libptio.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", _CSRC], check=True, capture_output=True)
+    lib = ctypes.CDLL(so)
+    lib.ptio_open_records.restype = ctypes.c_void_p
+    lib.ptio_open_records.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.ptio_num_records.restype = ctypes.c_int64
+    lib.ptio_num_records.argtypes = [ctypes.c_void_p]
+    lib.ptio_close_records.argtypes = [ctypes.c_void_p]
+    lib.ptio_pipeline_create.restype = ctypes.c_void_p
+    lib.ptio_pipeline_create.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                         ctypes.c_int, ctypes.c_int,
+                                         ctypes.c_uint64, ctypes.c_int64]
+    lib.ptio_pipeline_start_epoch.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                              ctypes.c_int]
+    lib.ptio_pipeline_num_batches.restype = ctypes.c_int64
+    lib.ptio_pipeline_num_batches.argtypes = [ctypes.c_void_p]
+    lib.ptio_pipeline_next.restype = ctypes.c_int64
+    lib.ptio_pipeline_next.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint8)]
+    lib.ptio_pipeline_destroy.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+def available():
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def write_record_file(path, array):
+    """Serialize a (N, ...) array as fixed-size raw records."""
+    arr = np.ascontiguousarray(array)
+    arr.tofile(path)
+    return arr.shape, arr.dtype
+
+
+class RecordFileDataset:
+    """Fixed-record binary dataset backed by mmap (native)."""
+
+    def __init__(self, path, record_shape, dtype):
+        self.record_shape = tuple(record_shape)
+        self.dtype = np.dtype(dtype)
+        self.record_bytes = int(np.prod(self.record_shape)) * self.dtype.itemsize
+        lib = _load()
+        self._h = lib.ptio_open_records(str(path).encode(), self.record_bytes)
+        if not self._h:
+            raise IOError(f"cannot open record file {path}")
+        self._n = lib.ptio_num_records(self._h)
+
+    def __len__(self):
+        return self._n
+
+    def close(self):
+        if self._h:
+            _load().ptio_close_records(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeDataLoader:
+    """Multithreaded prefetching loader over a RecordFileDataset.
+
+    Yields np arrays (batch, *record_shape); shuffle reshuffles per epoch
+    in C++ (deterministic from seed+epoch).
+    """
+
+    def __init__(self, dataset: RecordFileDataset, batch_size=1, shuffle=False,
+                 drop_last=True, seed=0, num_threads=2, capacity=8):
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.num_threads = num_threads
+        lib = _load()
+        self._p = lib.ptio_pipeline_create(dataset._h, batch_size,
+                                           1 if shuffle else 0,
+                                           1 if drop_last else 0, seed, capacity)
+        self._epoch = 0
+        self._buf = np.empty((batch_size,) + dataset.record_shape,
+                             dtype=dataset.dtype)
+
+    def __len__(self):
+        lib = _load()
+        lib.ptio_pipeline_start_epoch(self._p, self._epoch, 0)
+        return lib.ptio_pipeline_num_batches(self._p)
+
+    def __iter__(self):
+        lib = _load()
+        lib.ptio_pipeline_start_epoch(self._p, self._epoch, self.num_threads)
+        self._epoch += 1
+        ptr = self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        while True:
+            n = lib.ptio_pipeline_next(self._p, ptr)
+            if n <= 0:
+                break
+            yield np.array(self._buf[:n], copy=True)
+
+    def __del__(self):
+        try:
+            if self._p:
+                _load().ptio_pipeline_destroy(self._p)
+                self._p = None
+        except Exception:
+            pass
