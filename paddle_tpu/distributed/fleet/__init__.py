@@ -216,21 +216,33 @@ class meta_parallel:
 
 def recompute(function, *args, **kwargs):
     """reference: fleet.recompute — activation rematerialization. On TPU
-    this is jax.checkpoint over the pure functional core."""
+    this is jax.checkpoint over the pure functional core; when `function`
+    is a Layer its parameters are threaded through so grads flow."""
     import jax as _jax
-    from ..._core.tensor import Tensor, unwrap
+    from ..._core.tensor import Tensor, apply
+    from ...nn.layer.layers import Layer
 
     tensor_args = [a for a in args if isinstance(a, Tensor)]
+    n_args = len(tensor_args)
+    if isinstance(function, Layer):
+        pnames = [n for n, _ in function.named_parameters()]
+        ptensors = [p for _, p in function.named_parameters()]
+    else:
+        pnames, ptensors = [], []
 
     def pure(*raws):
-        it = iter(raws)
+        it = iter(raws[:n_args])
         rebuilt = [Tensor(next(it), stop_gradient=a.stop_gradient)
                    if isinstance(a, Tensor) else a for a in args]
-        out = function(*rebuilt, **kwargs)
+        param_map = dict(zip(pnames, raws[n_args:]))
+        if isinstance(function, Layer):
+            with function._swapped_state(param_map, None):
+                out = function(*rebuilt, **kwargs)
+        else:
+            out = function(*rebuilt, **kwargs)
         return _jax.tree_util.tree_map(
             lambda t: t._value if isinstance(t, Tensor) else t, out,
             is_leaf=lambda t: isinstance(t, Tensor))
 
-    from ..._core.tensor import apply
     ck = _jax.checkpoint(pure)
-    return apply(ck, *tensor_args, name="recompute")
+    return apply(ck, *(tensor_args + ptensors), name="recompute")

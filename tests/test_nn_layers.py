@@ -1,0 +1,289 @@
+"""nn layer tests (SURVEY §4: forward shape/value, train/eval,
+state_dict round-trip)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLinearConv:
+    def test_linear_values(self):
+        l = nn.Linear(4, 3)
+        x = pt.randn([2, 4])
+        out = l(x)
+        ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+        assert np.allclose(out.numpy(), ref, atol=1e-5)
+
+    def test_conv2d_matches_manual(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = pt.randn([1, 2, 8, 8])
+        out = conv(x)
+        assert out.shape == [1, 3, 8, 8]
+        # identity kernel check: conv with delta kernel ≈ passthrough
+        import jax.numpy as jnp
+        w = np.zeros((3, 3, 2, 3), np.float32)  # (kh, kw, in, out)
+        w[1, 1, 0, 0] = 1.0
+        conv.weight.set_value(pt.to_tensor(w))
+        conv.bias.set_value(pt.zeros([3]))
+        out2 = conv(x)
+        assert np.allclose(out2.numpy()[0, 0], x.numpy()[0, 0], atol=1e-6)
+
+    def test_conv_groups_strides(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        out = conv(pt.randn([2, 4, 16, 16]))
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv_transpose_shape(self):
+        deconv = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        out = deconv(pt.randn([1, 3, 8, 8]))
+        assert out.shape == [1, 6, 16, 16]
+
+    def test_conv1d_3d(self):
+        assert nn.Conv1D(2, 4, 3, padding=1)(pt.randn([2, 2, 10])).shape == \
+            [2, 4, 10]
+        assert nn.Conv3D(1, 2, 3, padding=1)(pt.randn([1, 1, 4, 4, 4])).shape == \
+            [1, 2, 4, 4, 4]
+
+    def test_conv_grad(self):
+        conv = nn.Conv2D(1, 1, 3)
+        out = conv(pt.randn([1, 1, 5, 5]))
+        out.sum().backward()
+        assert conv.weight.grad is not None
+        assert conv.weight.grad.shape == list(conv.weight.shape)
+
+
+class TestNorms:
+    def test_layernorm_stats(self):
+        ln = nn.LayerNorm(16)
+        x = pt.randn([4, 16]) * 5 + 3
+        out = ln(x).numpy()
+        assert np.allclose(out.mean(-1), 0, atol=1e-4)
+        assert np.allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = pt.randn([4, 3, 8, 8]) * 2 + 1
+        bn.train()
+        out = bn(x).numpy()
+        assert abs(out.mean()) < 1e-4
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out_eval = bn(x)
+        assert out_eval.shape == [4, 3, 8, 8]
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = pt.randn([2, 8])
+        out = rn(x).numpy()
+        rms = np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+        assert np.allclose(out, x.numpy() / rms, atol=1e-4)
+
+    def test_groupnorm_instancenorm(self):
+        assert nn.GroupNorm(2, 4)(pt.randn([2, 4, 5, 5])).shape == [2, 4, 5, 5]
+        assert nn.InstanceNorm2D(3)(pt.randn([2, 3, 5, 5])).shape == [2, 3, 5, 5]
+
+
+class TestActivationsPooling:
+    def test_activation_values(self):
+        x = pt.to_tensor([-1.0, 0.0, 1.0])
+        assert np.allclose(F.relu(x).numpy(), [0, 0, 1])
+        assert np.allclose(F.relu6(x * 10).numpy(), [0, 0, 6])
+        assert np.allclose(F.sigmoid(pt.zeros([1])).numpy(), [0.5])
+        assert np.allclose(F.softmax(pt.zeros([3])).numpy(), [1 / 3] * 3)
+        assert np.allclose(F.glu(pt.to_tensor([1.0, 0.0])).numpy(),
+                           [0.5], atol=1e-6)
+
+    def test_pooling(self):
+        x = pt.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        assert mp.numpy()[0, 0].tolist() == [[5, 7], [13, 15]]
+        ap = nn.AvgPool2D(2, 2)(x)
+        assert ap.numpy()[0, 0].tolist() == [[2.5, 4.5], [10.5, 12.5]]
+        ad = nn.AdaptiveAvgPool2D(1)(x)
+        assert float(ad.numpy()) == 7.5
+
+    def test_max_pool_return_mask(self):
+        x = pt.randn([1, 2, 4, 4])
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        assert out.shape == [1, 2, 2, 2]
+        assert mask.shape == [1, 2, 2, 2]
+
+
+class TestDropoutEmbedding:
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = pt.ones([1000])
+        d.train()
+        out = d(x).numpy()
+        assert (out == 0).any()
+        assert abs(out.mean() - 1.0) < 0.2  # upscale_in_train
+        d.eval()
+        assert np.allclose(d(x).numpy(), 1.0)
+
+    def test_embedding_padding_idx(self):
+        e = nn.Embedding(10, 4, padding_idx=0)
+        out = e(pt.to_tensor(np.array([0, 1])))
+        assert np.allclose(out.numpy()[0], 0)
+        assert not np.allclose(out.numpy()[1], 0)
+
+
+class TestRNN:
+    def test_lstm_shapes(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        out, (h, c) = lstm(pt.randn([3, 5, 8]))
+        assert out.shape == [3, 5, 16]
+        assert h.shape == [2, 3, 16]
+
+    def test_bidirectional_gru(self):
+        gru = nn.GRU(8, 16, direction="bidirect")
+        out, h = gru(pt.randn([2, 5, 8]))
+        assert out.shape == [2, 5, 32]
+        assert h.shape == [2, 2, 16]
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        y, (h, c) = cell(pt.randn([2, 4]))
+        assert y.shape == [2, 8]
+
+
+class TestTransformer:
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(32, 4, 64, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(pt.randn([2, 10, 32]))
+        assert out.shape == [2, 10, 32]
+
+    def test_mha_self_cross(self):
+        mha = nn.MultiHeadAttention(32, 4)
+        q = pt.randn([2, 5, 32])
+        kv = pt.randn([2, 7, 32])
+        assert mha(q).shape == [2, 5, 32]
+        assert mha(q, kv, kv).shape == [2, 5, 32]
+
+    def test_full_transformer(self):
+        t = nn.Transformer(d_model=32, nhead=4, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=64, dropout=0.0)
+        out = t(pt.randn([2, 6, 32]), pt.randn([2, 4, 32]))
+        assert out.shape == [2, 4, 32]
+
+
+class TestLayerInfra:
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = net.state_dict()
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net2.set_state_dict(sd)
+        x = pt.randn([2, 4])
+        assert np.allclose(net(x).numpy(), net2(x).numpy())
+
+    def test_named_parameters_hooks(self):
+        net = nn.Sequential(nn.Linear(2, 2))
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["0.weight", "0.bias"]
+        calls = []
+        h = net.register_forward_post_hook(lambda l, i, o: calls.append(1))
+        net(pt.randn([1, 2]))
+        assert calls
+        h.remove()
+        net(pt.randn([1, 2]))
+        assert len(calls) == 1
+
+    def test_containers(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(list(ll.parameters())) == 8
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+    def test_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.bfloat16()
+        assert net.weight.dtype == pt.bfloat16
+
+    def test_apply_and_modes(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_clip_grad(self):
+        p = pt.Parameter((pt.ones([4]) * 3)._value)
+        p.grad = pt.ones([4]) * 100
+        nn.clip_grad_norm_([p], max_norm=1.0)
+        assert np.linalg.norm(p.grad.numpy()) <= 1.0 + 1e-4
+
+    def test_weight_norm(self):
+        from paddle_tpu.nn.utils import weight_norm, parameters_to_vector
+        l = nn.Linear(3, 4)
+        weight_norm(l, "weight", dim=1)
+        out = l(pt.randn([2, 3]))
+        assert out.shape == [2, 4]
+        names = dict(l.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+
+    def test_parameters_to_vector(self):
+        from paddle_tpu.nn.utils import parameters_to_vector, \
+            vector_to_parameters
+        l = nn.Linear(2, 3)
+        vec = parameters_to_vector(l.parameters())
+        assert vec.shape == [9]
+        vector_to_parameters(vec * 0, l.parameters())
+        assert np.allclose(l.weight.numpy(), 0)
+
+
+class TestLosses:
+    def test_cross_entropy_modes(self):
+        logits = pt.randn([4, 5])
+        labels = pt.to_tensor(np.array([0, 1, 2, 3]))
+        ce = F.cross_entropy(logits, labels)
+        # vs manual
+        lp = np.log(np.exp(logits.numpy()) /
+                    np.exp(logits.numpy()).sum(-1, keepdims=True))
+        ref = -lp[np.arange(4), labels.numpy()].mean()
+        assert np.allclose(float(ce), ref, atol=1e-5)
+        # soft label
+        soft = F.softmax(pt.randn([4, 5]))
+        assert np.isfinite(float(F.cross_entropy(logits, soft, soft_label=True)))
+        # ignore index
+        labels2 = pt.to_tensor(np.array([0, -100, 2, -100]))
+        ce2 = F.cross_entropy(logits, labels2, ignore_index=-100)
+        ref2 = -lp[[0, 2], [0, 2]].mean()
+        assert np.allclose(float(ce2), ref2, atol=1e-5)
+
+    def test_mse_l1_smooth(self):
+        a, b = pt.to_tensor([1.0, 2.0]), pt.to_tensor([3.0, 2.0])
+        assert float(F.mse_loss(a, b)) == 2.0
+        assert float(F.l1_loss(a, b)) == 1.0
+        assert np.isfinite(float(F.smooth_l1_loss(a, b)))
+
+    def test_bce_paths(self):
+        p = pt.to_tensor([0.8, 0.2])
+        t = pt.to_tensor([1.0, 0.0])
+        assert np.allclose(float(F.binary_cross_entropy(p, t)),
+                           -np.log(0.8), atol=1e-5)
+        z = pt.to_tensor([0.0, 0.0])
+        assert np.allclose(float(F.binary_cross_entropy_with_logits(z, t)),
+                           np.log(2), atol=1e-5)
+
+    def test_kl_nll(self):
+        logp = F.log_softmax(pt.randn([3, 4]))
+        t = F.softmax(pt.randn([3, 4]))
+        assert float(F.kl_div(logp, t, reduction="sum")) >= -1e-5
+        labels = pt.to_tensor(np.array([0, 1, 2]))
+        assert np.isfinite(float(F.nll_loss(logp, labels)))
+
+    def test_ctc_loss_runs(self):
+        T, B, C, S = 12, 2, 5, 4
+        logp = pt.randn([T, B, C])
+        logp.stop_gradient = False
+        labels = pt.to_tensor(np.random.randint(1, C, (B, S)))
+        in_len = pt.to_tensor(np.array([T, T]))
+        lab_len = pt.to_tensor(np.array([S, S - 1]))
+        loss = F.ctc_loss(logp, labels, in_len, lab_len)
+        assert np.isfinite(float(loss))
+        loss.backward()

@@ -1,0 +1,185 @@
+"""Optimizer tests (SURVEY §4: single-step analytic updates +
+convergence smoke)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer as opt_mod
+
+
+def quad_problem():
+    """min ||x - t||² — every optimizer should reach t."""
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    p = pt.Parameter(pt.zeros([3])._value)
+    return p, target
+
+
+def run_opt(opt_cls, steps=300, lr=0.1, **kw):
+    p, target = quad_problem()
+    o = opt_cls(learning_rate=lr, parameters=[p], **kw)
+    t = pt.to_tensor(target)
+    for _ in range(steps):
+        loss = ((p - t) * (p - t)).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    return np.asarray(p.numpy()), target
+
+
+class TestRules:
+    def test_sgd_analytic(self):
+        p = pt.Parameter(pt.to_tensor([1.0])._value)
+        o = opt_mod.SGD(learning_rate=0.5, parameters=[p])
+        p.grad = pt.to_tensor([2.0])
+        o.step()
+        assert np.allclose(p.numpy(), [0.0])
+
+    def test_momentum_analytic(self):
+        p = pt.Parameter(pt.to_tensor([0.0])._value)
+        o = opt_mod.Momentum(learning_rate=1.0, momentum=0.9, parameters=[p])
+        p.grad = pt.to_tensor([1.0])
+        o.step()  # v=1 → p=-1
+        assert np.allclose(p.numpy(), [-1.0])
+        p.grad = pt.to_tensor([1.0])
+        o.step()  # v=1.9 → p=-2.9
+        assert np.allclose(p.numpy(), [-2.9], atol=1e-6)
+
+    def test_adam_first_step_is_lr(self):
+        p = pt.Parameter(pt.to_tensor([0.0])._value)
+        o = opt_mod.Adam(learning_rate=0.01, parameters=[p])
+        p.grad = pt.to_tensor([123.0])
+        o.step()
+        # bias-corrected adam first step ≈ -lr regardless of grad magnitude
+        assert np.allclose(p.numpy(), [-0.01], atol=1e-6)
+
+    def test_adamw_decoupled_decay(self):
+        p = pt.Parameter(pt.to_tensor([1.0])._value)
+        o = opt_mod.AdamW(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+        p.grad = pt.to_tensor([0.0])
+        o.step()
+        # pure decay: p *= (1 - lr*wd) → 0.95; adam update ~0 for zero grad
+        assert np.allclose(p.numpy(), [0.95], atol=1e-6)
+
+    @pytest.mark.parametrize("cls,kw", [
+        (opt_mod.SGD, {}), (opt_mod.Momentum, {"momentum": 0.9}),
+        (opt_mod.Adam, {}), (opt_mod.AdamW, {"weight_decay": 0.0}),
+        (opt_mod.Adamax, {}), (opt_mod.Adagrad, {}), (opt_mod.RMSProp, {}),
+        (opt_mod.Lamb, {"lamb_weight_decay": 0.0}), (opt_mod.NAdam, {}),
+        (opt_mod.RAdam, {}), (opt_mod.Adadelta, {}), (opt_mod.Lion, {}),
+    ])
+    def test_convergence(self, cls, kw):
+        lr = {"Adadelta": 5.0, "Lion": 0.05, "Adagrad": 1.0,
+              "RMSProp": 0.05, "Lamb": 0.02}.get(cls.__name__, 0.1)
+        steps = {"Adadelta": 500, "Lamb": 600}.get(cls.__name__, 300)
+        final, target = run_opt(cls, steps=steps, lr=lr, **kw)
+        assert np.allclose(final, target, atol=0.15), (cls.__name__, final)
+
+    def test_lbfgs_quadratic(self):
+        p, target = quad_problem()
+        o = opt_mod.LBFGS(learning_rate=0.5, parameters=[p])
+        t = pt.to_tensor(target)
+
+        def closure():
+            o.clear_grad()
+            loss = ((p - t) * (p - t)).sum()
+            loss.backward()
+            return loss
+        for _ in range(30):
+            o.step(closure)
+        assert np.allclose(p.numpy(), target, atol=1e-2)
+
+    def test_multi_precision_master_weights(self):
+        p = pt.Parameter(pt.ones([4]).astype(pt.bfloat16)._value)
+        o = opt_mod.Adam(learning_rate=1e-3, parameters=[p],
+                         multi_precision=True)
+        p.grad = pt.ones([4]).astype(pt.bfloat16)
+        o.step()
+        slots = o._accumulators[id(p)]
+        assert slots["master"].dtype == np.float32
+        assert p.dtype == pt.bfloat16
+
+    def test_grad_clip_in_optimizer(self):
+        from paddle_tpu.nn import ClipGradByGlobalNorm
+        p = pt.Parameter(pt.zeros([2])._value)
+        o = opt_mod.SGD(learning_rate=1.0, parameters=[p],
+                        grad_clip=ClipGradByGlobalNorm(1.0))
+        p.grad = pt.to_tensor([300.0, 400.0])
+        o.step()
+        assert np.allclose(np.linalg.norm(p.numpy()), 1.0, atol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        p = pt.Parameter(pt.zeros([2])._value, name="w")
+        o = opt_mod.Adam(learning_rate=0.1, parameters=[p])
+        p.grad = pt.ones([2])
+        o.step()
+        sd = o.state_dict()
+        o2 = opt_mod.Adam(learning_rate=0.1, parameters=[p])
+        o2.set_state_dict(sd)
+        assert np.allclose(o2._accumulators[id(p)]["moment1"],
+                           o._accumulators[id(p)]["moment1"])
+
+    def test_functional_matches_imperative(self):
+        import jax.numpy as jnp
+        p_i = pt.Parameter(pt.to_tensor([1.0, 2.0])._value)
+        o_i = opt_mod.Adam(learning_rate=0.1, parameters=[p_i])
+        g = np.array([0.5, -1.0], np.float32)
+        p_i.grad = pt.to_tensor(g)
+        o_i.step()
+        o_f = opt_mod.Adam(learning_rate=0.1)
+        params = {"w": jnp.asarray([1.0, 2.0])}
+        state = o_f.init_state(params)
+        new_p, _ = o_f.apply_gradients(params, {"w": jnp.asarray(g)}, state)
+        assert np.allclose(p_i.numpy(), np.asarray(new_p["w"]), atol=1e-6)
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = opt_mod.lr.StepDecay(1.0, step_size=2, gamma=0.1)
+        vals = []
+        for _ in range(5):
+            vals.append(s())
+            s.step()
+        assert np.allclose(vals, [1.0, 1.0, 0.1, 0.1, 0.01])
+
+    def test_linear_warmup_then_cosine(self):
+        base = opt_mod.lr.CosineAnnealingDecay(1.0, T_max=10)
+        s = opt_mod.lr.LinearWarmup(base, warmup_steps=5, start_lr=0.0,
+                                    end_lr=1.0)
+        vals = [s()]
+        for _ in range(5):
+            s.step()
+            vals.append(s())
+        assert vals[0] == 0.0
+        assert abs(vals[-1] - 1.0) < 1e-6
+
+    def test_noam(self):
+        s = opt_mod.lr.NoamDecay(d_model=512, warmup_steps=10,
+                                 learning_rate=1.0)
+        lrs = []
+        for _ in range(20):
+            lrs.append(s())
+            s.step()
+        assert np.argmax(lrs) in (9, 10, 11)
+
+    def test_reduce_on_plateau(self):
+        s = opt_mod.lr.ReduceOnPlateau(1.0, patience=1, factor=0.5)
+        for loss in [1.0, 1.0, 1.0, 1.0]:
+            s.step(loss)
+        assert s() < 1.0
+
+    def test_optimizer_with_scheduler(self):
+        sched = opt_mod.lr.ExponentialDecay(0.1, gamma=0.5)
+        p = pt.Parameter(pt.zeros([1])._value)
+        o = opt_mod.SGD(learning_rate=sched, parameters=[p])
+        assert o.get_lr() == 0.1
+        sched.step()
+        assert abs(o.get_lr() - 0.05) < 1e-9
+
+    def test_one_cycle(self):
+        s = opt_mod.lr.OneCycleLR(max_learning_rate=1.0, total_steps=10)
+        lrs = []
+        for _ in range(10):
+            lrs.append(s())
+            s.step()
+        assert max(lrs) <= 1.0 + 1e-6
+        assert lrs[3] > lrs[0]
