@@ -67,8 +67,15 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
+
+def _col_mask(start, block, total, d):
+    """(block, d) bool mask: rows of this block that are inside `total`."""
+    idx = start + jax.lax.broadcasted_iota(jnp.int32, (block, d), 0)
+    return idx < total
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-                scale, causal, block_q, block_k, n_k):
+                scale, causal, block_q, block_k, n_k, sq, sk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -81,14 +88,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     def body():
         q = q_ref[0]  # (block_q, d)
         k = k_ref[0]  # (block_k, d)
+        v = v_ref[0]
+        d = q.shape[-1]
+        if sk % block_k != 0:
+            km = _col_mask(ki * block_k, block_k, sk, d)
+            k = jnp.where(km, k, 0.0)
+            v = jnp.where(km, v, 0.0)
+        if sq % block_q != 0:
+            q = jnp.where(_col_mask(qi * block_q, block_q, sq, d), q, 0.0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < sk
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            valid = valid & (rows >= cols)
+        if causal or sk % block_k != 0:
+            s = jnp.where(valid, s, NEG_INF)
         m_prev = m_ref[:]
         l_prev = l_ref[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -97,7 +115,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
         l_ref[:] = l_new
@@ -119,6 +137,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
+    scale = float(scale)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -131,7 +150,8 @@ def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
     vr = v.reshape(bh, sk, d)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k, n_k=n_k)
+                               block_q=block_q, block_k=block_k, n_k=n_k,
+                               sq=sq, sk=sk)
     mem = pltpu.VMEM if _HAS_PLTPU else None
     spec = lambda bs, im: pl.BlockSpec(bs, im, memory_space=mem) if mem else \
         pl.BlockSpec(bs, im)
@@ -165,7 +185,7 @@ def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
 # Backward kernels
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, causal, block_q, block_k, n_k):
+                   dq_acc, *, scale, causal, block_q, block_k, n_k, sq, sk):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -177,15 +197,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
+        d = q.shape[-1]
+        if sk % block_k != 0:
+            km = _col_mask(ki * block_k, block_k, sk, d)
+            k = jnp.where(km, k, 0.0)
+            v = jnp.where(km, v, 0.0)
+        if sq % block_q != 0:
+            q = jnp.where(_col_mask(qi * block_q, block_q, sq, d), q, 0.0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = cols < sk
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.where(valid, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
@@ -209,7 +239,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, n_q):
+                    block_q, block_k, n_q, sq, sk):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -222,16 +252,30 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
+        d = q.shape[-1]
+        if sk % block_k != 0:
+            km = _col_mask(ki * block_k, block_k, sk, d)
+            k = jnp.where(km, k, 0.0)
+            v = jnp.where(km, v, 0.0)
+        qm = None
+        if sq % block_q != 0:
+            qm = _col_mask(qi * block_q, block_q, sq, d)
+            q = jnp.where(qm, q, 0.0)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = (cols < sk) & (rows < sq)
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            valid = valid & (rows >= cols)
+        s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, None])  # (bq, bk)
+        p = jnp.where(valid, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
+        if qm is not None:
+            do = jnp.where(qm, do, 0.0)
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
@@ -256,6 +300,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
+    scale = float(scale)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -276,7 +321,8 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_k=n_k),
+                          block_q=block_q, block_k=block_k, n_k=n_k,
+                          sq=sq, sk=sk),
         grid=(bh, n_q, n_k),
         in_specs=[
             spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
@@ -294,7 +340,8 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, n_q=n_q),
+                          block_q=block_q, block_k=block_k, n_q=n_q,
+                          sq=sq, sk=sk),
         grid=(bh, n_k, n_q),
         in_specs=[
             spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, 0)),

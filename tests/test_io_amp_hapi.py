@@ -1,0 +1,254 @@
+"""io / amp / hapi / checkpoint / metric tests."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import (DataLoader, TensorDataset, Dataset, BatchSampler,
+                           RandomSampler, DistributedBatchSampler, Subset,
+                           random_split, IterableDataset)
+
+
+class _SquareDS(Dataset):
+    def __len__(self):
+        return 20
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+
+class TestDataLoader:
+    def test_basic_batching(self):
+        dl = DataLoader(_SquareDS(), batch_size=6, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 4
+        x, y = batches[0]
+        assert x.shape == [6]
+        assert np.allclose(y.numpy(), x.numpy() ** 2)
+
+    def test_shuffle_covers_all(self):
+        dl = DataLoader(_SquareDS(), batch_size=5, shuffle=True)
+        xs = np.concatenate([b[0].numpy() for b in dl])
+        assert sorted(xs.tolist()) == list(range(20))
+
+    def test_num_workers_prefetch(self):
+        dl = DataLoader(_SquareDS(), batch_size=4, num_workers=2)
+        xs = np.concatenate([b[0].numpy() for b in dl])
+        assert sorted(xs.tolist()) == list(range(20))
+
+    def test_tensor_dataset_collate(self):
+        a = pt.randn([10, 3])
+        b = pt.arange(10)
+        ds = TensorDataset([a, b])
+        dl = DataLoader(ds, batch_size=5)
+        x, y = next(iter(dl))
+        assert x.shape == [5, 3]
+
+    def test_iterable_dataset(self):
+        class Iter(IterableDataset):
+            def __iter__(self):
+                yield from range(7)
+        dl = DataLoader(Iter(), batch_size=3, drop_last=False)
+        sizes = [len(b) if isinstance(b, list) else b.shape[0] for b in dl]
+        assert sizes == [3, 3, 1]
+
+    def test_samplers(self):
+        ds = _SquareDS()
+        bs = BatchSampler(ds, batch_size=7, drop_last=True)
+        assert len(bs) == 2
+        dbs = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=0)
+        idx = [i for batch in dbs for i in batch]
+        assert len(idx) == 10
+        splits = random_split(ds, [15, 5])
+        assert len(splits[0]) == 15 and len(splits[1]) == 5
+
+    def test_collate_dict(self):
+        class D(Dataset):
+            def __len__(self):
+                return 4
+
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.ones(2, np.float32)}
+        batch = next(iter(DataLoader(D(), batch_size=4)))
+        assert batch["a"].shape == [4]
+        assert batch["b"].shape == [4, 2]
+
+
+class TestNativeLoader:
+    def test_record_pipeline(self):
+        from paddle_tpu.io.native import (RecordFileDataset, NativeDataLoader,
+                                          write_record_file, available)
+        if not available():
+            pytest.skip("libptio build unavailable")
+        data = np.random.randn(64, 4).astype(np.float32)
+        path = tempfile.mktemp()
+        write_record_file(path, data)
+        ds = RecordFileDataset(path, (4,), np.float32)
+        dl = NativeDataLoader(ds, batch_size=8, shuffle=True, seed=1)
+        got = np.concatenate(list(dl))
+        assert np.allclose(np.sort(got.sum(1)), np.sort(data.sum(1)), atol=1e-5)
+        os.unlink(path)
+
+
+class TestAmp:
+    def test_autocast_white_black(self):
+        from paddle_tpu.amp import amp_cast_inputs, auto_cast
+        x = pt.randn([2, 2])
+        with auto_cast(True, dtype="bfloat16"):
+            args = amp_cast_inputs("matmul", [x, x])
+            assert args[0].dtype == pt.bfloat16
+            args2 = amp_cast_inputs("softmax", [x.astype(pt.bfloat16)])
+            assert args2[0].dtype == np.dtype("float32")
+        args3 = amp_cast_inputs("matmul", [x, x])
+        assert args3[0].dtype == np.dtype("float32")
+
+    def test_grad_scaler_dynamic(self):
+        scaler = pt.amp.GradScaler(init_loss_scaling=4.0,
+                                   decr_every_n_nan_or_inf=1)
+        p = pt.Parameter(pt.zeros([2])._value)
+        opt = pt.optimizer.SGD(1.0, parameters=[p])
+        loss = pt.to_tensor([1.0], stop_gradient=False)
+        p.grad = pt.to_tensor([4.0, 4.0])  # pretend scaled grads
+        scaler.step(opt)
+        scaler.update()
+        assert np.allclose(p.numpy(), [-1.0, -1.0])  # unscaled by 4
+        # inf grads skip step and shrink scale
+        p2 = pt.Parameter(pt.zeros([1])._value)
+        opt2 = pt.optimizer.SGD(1.0, parameters=[p2])
+        p2.grad = pt.to_tensor([np.inf])
+        s0 = scaler._scale
+        scaler.step(opt2)
+        scaler.update()
+        assert np.allclose(p2.numpy(), [0.0])
+        assert scaler._scale < s0
+
+    def test_decorate_o2(self):
+        net = pt.nn.Sequential(pt.nn.Linear(4, 4), pt.nn.LayerNorm(4))
+        opt = pt.optimizer.Adam(parameters=net.parameters())
+        net, opt = pt.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+        assert net[0].weight.dtype == pt.bfloat16
+        assert net[1].weight.dtype == np.dtype("float32")  # norm excluded
+
+
+class TestHapi:
+    def test_model_fit_evaluate(self):
+        ds = TensorDataset([pt.randn([32, 8]),
+                            pt.to_tensor(np.random.randint(0, 3, (32,)))])
+        net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                               pt.nn.Linear(16, 3))
+        model = pt.Model(net)
+        model.prepare(pt.optimizer.Adam(1e-2, parameters=net.parameters()),
+                      pt.nn.CrossEntropyLoss(),
+                      pt.metric.Accuracy())
+        model.fit(ds, epochs=2, batch_size=8, verbose=0)
+        logs = model.evaluate(ds, batch_size=8, verbose=0)
+        assert "loss" in logs and "acc" in logs
+
+    def test_summary(self):
+        net = pt.nn.Linear(10, 5)
+        info = pt.summary(net)
+        assert info["total_params"] == 55
+
+    def test_save_load(self, tmp_path):
+        net = pt.nn.Linear(4, 2)
+        model = pt.Model(net)
+        model.prepare(pt.optimizer.Adam(parameters=net.parameters()),
+                      pt.nn.CrossEntropyLoss())
+        p = str(tmp_path / "ckpt")
+        model.save(p)
+        w_orig = np.asarray(net.weight.numpy())
+        net.weight.set_value(pt.zeros([4, 2]))
+        model.load(p)
+        assert np.allclose(net.weight.numpy(), w_orig)
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = pt.metric.Accuracy(topk=(1, 2))
+        pred = pt.to_tensor(np.array([[0.9, 0.05, 0.05], [0.1, 0.5, 0.4]]))
+        label = pt.to_tensor(np.array([[0], [2]]))
+        correct = m.compute(pred, label)
+        m.update(correct)
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5 and top2 == 1.0
+
+    def test_precision_recall_auc(self):
+        p = pt.metric.Precision()
+        r = pt.metric.Recall()
+        preds = pt.to_tensor(np.array([0.9, 0.8, 0.2, 0.1]))
+        labels = pt.to_tensor(np.array([1, 0, 1, 0]))
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == 0.5
+        assert r.accumulate() == 0.5
+        auc = pt.metric.Auc()
+        auc.update(np.stack([1 - preds.numpy(), preds.numpy()], 1), labels)
+        assert 0.0 <= auc.accumulate() <= 1.0
+
+
+class TestCheckpointResume:
+    def test_full_train_state_roundtrip(self, tmp_path):
+        from paddle_tpu.utils.checkpoint import save_state, load_state, \
+            latest_checkpoint
+        net = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.Adam(1e-3, parameters=net.parameters())
+        sched = pt.optimizer.lr.StepDecay(1e-3, step_size=10)
+        out = net(pt.randn([2, 4]))
+        out.sum().backward()
+        opt.step()
+        ck = str(tmp_path / "step_5")
+        save_state(ck, net, opt, sched, step=5)
+        w = np.asarray(net.weight.numpy())
+        net.weight.set_value(pt.zeros([4, 4]))
+        step, _ = load_state(ck, net, opt, sched)
+        assert step == 5
+        assert np.allclose(net.weight.numpy(), w)
+        assert latest_checkpoint(str(tmp_path)) == ck
+
+    def test_async_save(self, tmp_path):
+        from paddle_tpu.utils.checkpoint import save_state
+        net = pt.nn.Linear(2, 2)
+        t = save_state(str(tmp_path / "async_ck"), net, step=1, async_save=True)
+        t.join()
+        assert os.path.exists(str(tmp_path / "async_ck/state.pkl"))
+
+
+class TestFailureDetection:
+    def test_check_finite_raises(self):
+        from paddle_tpu.utils.watchdog import check_finite, StepHealthMonitor
+        check_finite({"a": pt.ones([2])})
+        with pytest.raises(FloatingPointError):
+            check_finite({"a": pt.to_tensor([np.nan])})
+        mon = StepHealthMonitor(window=5)
+        for _ in range(5):
+            assert mon.update(1.0)["status"] == "ok"
+        with pytest.raises(FloatingPointError):
+            mon.update(float("nan"))
+
+    def test_watchdog_beats(self):
+        import time
+        from paddle_tpu.utils.watchdog import HangWatchdog
+        fired = []
+        with HangWatchdog(timeout_s=0.2, on_hang=lambda: fired.append(1)) as wd:
+            for _ in range(3):
+                wd.beat()
+                time.sleep(0.05)
+        assert not fired
+
+
+class TestSaveLoadFramework:
+    def test_paddle_save_load_nested(self, tmp_path):
+        obj = {"w": pt.randn([3, 3]), "step": 7, "nested": [pt.ones([2])]}
+        p = str(tmp_path / "obj.pd")
+        pt.save(obj, p)
+        loaded = pt.load(p)
+        assert np.allclose(loaded["w"].numpy(), obj["w"].numpy())
+        assert loaded["step"] == 7
+
+    def test_jit_save_load(self, tmp_path):
+        from paddle_tpu.jit import save as jsave
+        net = pt.nn.Linear(3, 3)
+        jsave(net, str(tmp_path / "m"))
+        assert os.path.exists(str(tmp_path / "m.pdiparams"))

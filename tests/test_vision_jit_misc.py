@@ -1,0 +1,244 @@
+"""Vision models / jit / distribution / sparse / incubate tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestVisionModels:
+    def test_lenet_shapes(self):
+        net = pt.vision.models.LeNet()
+        assert net(pt.randn([2, 1, 28, 28])).shape == [2, 10]
+
+    def test_resnet18_forward_backward(self):
+        net = pt.vision.models.resnet18(num_classes=10)
+        net.eval()
+        out = net(pt.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 10]
+
+    def test_resnet50_param_count(self):
+        net = pt.vision.models.resnet50()
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert abs(n - 25.557e6) / 25.557e6 < 0.01  # torchvision ~25.56M
+
+    def test_mobilenet_v2(self):
+        net = pt.vision.models.mobilenet_v2(num_classes=4)
+        net.eval()
+        assert net(pt.randn([1, 3, 64, 64])).shape == [1, 4]
+
+    def test_mobilenet_v3_small(self):
+        net = pt.vision.models.mobilenet_v3_small(num_classes=4)
+        net.eval()
+        assert net(pt.randn([1, 3, 64, 64])).shape == [1, 4]
+
+    def test_vgg11(self):
+        net = pt.vision.models.vgg11(num_classes=5)
+        net.eval()
+        assert net(pt.randn([1, 3, 224, 224])).shape == [1, 5]
+
+    def test_squeezenet(self):
+        net = pt.vision.models.squeezenet1_1(num_classes=7)
+        net.eval()
+        assert net(pt.randn([1, 3, 64, 64])).shape == [1, 7]
+
+    def test_shufflenet(self):
+        net = pt.vision.models.shufflenet_v2_x0_5(num_classes=6)
+        net.eval()
+        assert net(pt.randn([1, 3, 64, 64])).shape == [1, 6]
+
+    def test_densenet121(self):
+        net = pt.vision.models.densenet121(num_classes=3)
+        net.eval()
+        assert net(pt.randn([1, 3, 64, 64])).shape == [1, 3]
+
+    def test_googlenet(self):
+        net = pt.vision.models.googlenet(num_classes=4)
+        net.eval()
+        out, a1, a2 = net(pt.randn([1, 3, 64, 64]))
+        assert out.shape == [1, 4]
+
+    def test_alexnet(self):
+        net = pt.vision.models.alexnet(num_classes=5)
+        net.eval()
+        assert net(pt.randn([1, 3, 224, 224])).shape == [1, 5]
+
+
+class TestVisionTransformsDatasets:
+    def test_transform_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+        t = T.Compose([T.Resize(32), T.CenterCrop(28),
+                       T.RandomHorizontalFlip(0.5),
+                       T.ToTensor(), T.Normalize(0.5, 0.5)])
+        img = np.random.randint(0, 255, (40, 50, 3)).astype(np.uint8)
+        out = t(img)
+        assert list(out.shape) == [3, 28, 28]
+
+    def test_mnist_synthetic(self):
+        from paddle_tpu.vision.datasets import MNIST
+        ds = MNIST(mode="test")
+        img, label = ds[0]
+        assert img.shape[-2:] == (28, 28)
+        assert 0 <= int(label) < 10
+
+    def test_dataset_with_loader(self):
+        from paddle_tpu.vision.datasets import Cifar10
+        from paddle_tpu.vision import transforms as T
+        ds = Cifar10(mode="test", transform=T.Compose([T.ToTensor()]))
+        dl = pt.io.DataLoader(ds, batch_size=8)
+        x, y = next(iter(dl))
+        assert x.shape == [8, 3, 32, 32]
+
+    def test_nms(self):
+        from paddle_tpu.vision.ops import nms
+        boxes = pt.to_tensor(np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                                       [50, 50, 60, 60]], np.float32))
+        scores = pt.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = nms(boxes, iou_threshold=0.5, scores=scores)
+        assert keep.numpy().tolist() == [0, 2]
+
+
+class TestJit:
+    def test_to_static_function(self):
+        @pt.jit.to_static
+        def f(x):
+            return x * 2 + 1
+        out = f(pt.to_tensor([1.0, 2.0]))
+        assert out.numpy().tolist() == [3.0, 5.0]
+
+    def test_to_static_layer_matches_eager(self):
+        net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.GELU(),
+                               pt.nn.Linear(8, 2))
+        x = pt.randn([3, 4])
+        eager = net(x).numpy()
+        snet = pt.jit.to_static(net)
+        static = snet(x).numpy()
+        assert np.allclose(eager, static, atol=1e-6)
+
+    def test_static_cache_reuse(self):
+        calls = []
+
+        @pt.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x + 1
+        f(pt.randn([2, 2]))
+        f(pt.randn([2, 2]))  # same shape → no retrace
+        assert len(calls) == 1
+        f(pt.randn([3, 2]))  # new shape → retrace
+        assert len(calls) == 2
+
+
+class TestDistribution:
+    def test_normal(self):
+        d = pt.distribution.Normal(0.0, 1.0)
+        s = d.sample([1000])
+        assert abs(float(s.numpy().mean())) < 0.2
+        lp = d.log_prob(pt.to_tensor(0.0))
+        assert np.allclose(float(lp), -0.5 * np.log(2 * np.pi), atol=1e-5)
+        assert np.allclose(float(d.entropy()),
+                           0.5 * np.log(2 * np.pi * np.e), atol=1e-5)
+
+    def test_categorical_bernoulli(self):
+        c = pt.distribution.Categorical(logits=pt.to_tensor([0.0, 0.0, 10.0]))
+        assert int(c.sample([1]).numpy()[0]) == 2
+        b = pt.distribution.Bernoulli(pt.to_tensor(0.3))
+        assert np.allclose(float(b.log_prob(pt.to_tensor(1.0))),
+                           np.log(0.3), atol=1e-5)
+
+    def test_kl(self):
+        p = pt.distribution.Normal(0.0, 1.0)
+        q = pt.distribution.Normal(1.0, 1.0)
+        assert np.allclose(float(pt.distribution.kl_divergence(p, q)), 0.5,
+                           atol=1e-5)
+
+    def test_transformed(self):
+        base = pt.distribution.Normal(0.0, 1.0)
+        d = pt.distribution.TransformedDistribution(
+            base, [pt.distribution.ExpTransform()])
+        x = d.sample([10])
+        assert (x.numpy() > 0).all()
+        ln = pt.distribution.LogNormal(0.0, 1.0)
+        v = pt.to_tensor(2.0)
+        assert np.allclose(float(d.log_prob(v)), float(ln.log_prob(v)),
+                           atol=1e-4)
+
+    def test_gamma_beta_dirichlet(self):
+        g = pt.distribution.Gamma(2.0, 3.0)
+        assert np.isfinite(float(g.log_prob(pt.to_tensor(1.0))))
+        be = pt.distribution.Beta(2.0, 2.0)
+        assert np.allclose(float(be.mean), 0.5)
+        dr = pt.distribution.Dirichlet(pt.to_tensor([1.0, 1.0, 1.0]))
+        s = dr.sample()
+        assert np.allclose(s.numpy().sum(), 1.0, atol=1e-5)
+
+
+class TestSparseFFT:
+    def test_sparse_coo(self):
+        idx = pt.to_tensor(np.array([[0, 1], [1, 2]]))
+        vals = pt.to_tensor(np.array([3.0, 4.0], np.float32))
+        sp = pt.sparse.sparse_coo_tensor(idx, vals, [2, 3])
+        dense = sp.to_dense().numpy()
+        assert dense[0, 1] == 3.0 and dense[1, 2] == 4.0
+        y = pt.sparse.matmul(sp, pt.ones([3, 2]))
+        assert y.shape == [2, 2]
+
+    def test_fft_matches_numpy(self):
+        x = np.random.randn(32).astype(np.float32)
+        ours = pt.fft.rfft(pt.to_tensor(x)).numpy()
+        ref = np.fft.rfft(x)
+        assert np.allclose(ours, ref, atol=1e-4)
+
+
+class TestIncubate:
+    def test_fused_rms_norm(self):
+        from paddle_tpu.incubate.nn import functional as FI
+        x = pt.randn([2, 8])
+        w = pt.ones([8])
+        out = FI.fused_rms_norm(x, w)
+        ref = pt.nn.functional.rms_norm(x, w)
+        assert np.allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_fused_rope(self):
+        from paddle_tpu.incubate.nn import functional as FI
+        from paddle_tpu.ops.rope import rope_cos_sin
+        import jax.numpy as jnp
+        q = pt.randn([2, 4, 8, 16])  # B,H,S,D
+        cos, sin = rope_cos_sin(8, 16)
+        qo, ko, vo = FI.fused_rotary_position_embedding(
+            q, q, None, sin=pt.to_tensor(sin), cos=pt.to_tensor(cos))
+        assert qo.shape == [2, 4, 8, 16]
+
+    def test_fused_moe_layer(self):
+        from paddle_tpu.incubate.nn import FusedMoE
+        moe = FusedMoE(16, 32, num_experts=4, top_k=2)
+        out = moe(pt.randn([2, 6, 16]))
+        assert out.shape == [2, 6, 16]
+
+
+class TestProfilerTrace:
+    def test_profiler_steps(self):
+        prof = pt.profiler.Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            (pt.randn([10]) * 2).numpy()
+            prof.step()
+        prof.stop()
+        assert "avg step" in prof.step_info()
+
+    def test_trace_ring(self):
+        from paddle_tpu.utils import trace
+        trace.enable()
+        trace.clear()
+        trace.record("matmul", 0.001)
+        trace.record("matmul", 0.002)
+        assert "matmul" in trace.summary()
+        trace.disable()
+
+
+class TestStaticFacade:
+    def test_program_executor(self):
+        exe = pt.static.Executor()
+        x = pt.to_tensor([1.0, 2.0])
+        y = x * 3
+        out = exe.run(fetch_list=[y])
+        assert np.allclose(out[0], [3.0, 6.0])
