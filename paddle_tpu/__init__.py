@@ -53,9 +53,14 @@ from . import hub  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import version  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
+from . import text  # noqa: F401
+from . import audio  # noqa: F401
+from . import onnx  # noqa: F401
 from . import utils  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary  # noqa: F401
+from .hapi.flops import flops  # noqa: F401
 from .device import (  # noqa: F401
     set_device, get_device, is_compiled_with_cuda, is_compiled_with_xpu,
     is_compiled_with_rocm, is_compiled_with_custom_device, CPUPlace, TPUPlace,

@@ -1,0 +1,87 @@
+"""paddle.audio parity (reference: python/paddle/audio).
+
+Feature extractors (spectrogram/mel/MFCC) over our fft ops — TPU-ready
+jnp graphs. File I/O backends are gated (no soundfile in image).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply, unwrap
+from ..nn.layer.layers import Layer
+
+from . import functional  # noqa: E402,F401
+
+
+class features:
+    class Spectrogram(Layer):
+        def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                     window="hann", power=2.0, center=True, pad_mode="reflect",
+                     dtype="float32"):
+            super().__init__()
+            self.n_fft = n_fft
+            self.hop = hop_length or n_fft // 4
+            self.win_length = win_length or n_fft
+            self.power = power
+            self.center = center
+            self.pad_mode = pad_mode
+            self.window = functional.get_window(window, self.win_length)
+
+        def forward(self, x):
+            return functional.spectrogram(x, self.n_fft, self.hop,
+                                          self.window, self.power,
+                                          self.center, self.pad_mode)
+
+    class MelSpectrogram(Layer):
+        def __init__(self, sr=22050, n_fft=512, hop_length=None, n_mels=64,
+                     f_min=50.0, f_max=None, power=2.0, **kw):
+            super().__init__()
+            self.spec = features.Spectrogram(n_fft, hop_length, power=power)
+            self.fbank = functional.compute_fbank_matrix(
+                sr, n_fft, n_mels, f_min, f_max or sr / 2)
+
+        def forward(self, x):
+            s = self.spec(x)
+            return apply(lambda sp, fb: jnp.einsum("...ft,mf->...mt", sp, fb),
+                         s, Tensor(self.fbank), name="mel")
+
+    class LogMelSpectrogram(MelSpectrogram):
+        def forward(self, x):
+            mel = super().forward(x)
+            return apply(lambda m: 10.0 * jnp.log10(jnp.maximum(m, 1e-10)),
+                         mel, name="log_mel")
+
+    class MFCC(Layer):
+        def __init__(self, sr=22050, n_mfcc=40, n_fft=512, n_mels=64, **kw):
+            super().__init__()
+            self.logmel = features.LogMelSpectrogram(sr, n_fft, n_mels=n_mels)
+            self.n_mfcc = n_mfcc
+
+        def forward(self, x):
+            lm = self.logmel(x)
+            return functional.dct_ii(lm, self.n_mfcc)
+
+
+class backends:
+    @staticmethod
+    def list_available_backends():
+        return []
+
+    @staticmethod
+    def get_current_backend():
+        return None
+
+    @staticmethod
+    def set_backend(name):
+        raise RuntimeError("no audio I/O backend in this image; "
+                           "feed numpy waveforms directly")
+
+
+def load(path, **kw):
+    if str(path).endswith(".npy"):
+        return Tensor(jnp.asarray(np.load(path))), 16000
+    raise RuntimeError("audio file I/O requires soundfile (not in image); "
+                       "use .npy waveforms")

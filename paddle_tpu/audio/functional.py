@@ -1,0 +1,114 @@
+"""audio.functional (reference: python/paddle/audio/functional)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply, unwrap
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    n = np.arange(win_length)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / win_length)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / win_length)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / win_length) +
+             0.08 * np.cos(4 * np.pi * n / win_length))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(win_length)
+    else:
+        raise ValueError(f"unknown window {window}")
+    return jnp.asarray(w.astype(np.float32))
+
+
+def hz_to_mel(f, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+    f = np.asarray(f, np.float64)
+    f_sp = 200.0 / 3
+    mels = f / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) /
+                    logstep, mels)
+
+
+def mel_to_hz(m, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+    m = np.asarray(m, np.float64)
+    f_sp = 200.0 / 3
+    freqs = m * f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = np.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=50.0, f_max=None,
+                         htk=False, norm="slaney"):
+    f_max = f_max or sr / 2
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_bins)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fbank = np.zeros((n_mels, n_bins), np.float32)
+    for m in range(n_mels):
+        lo, c, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (fft_freqs - lo) / max(c - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - c, 1e-10)
+        fbank[m] = np.maximum(0, np.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fbank *= enorm[:, None]
+    return jnp.asarray(fbank)
+
+
+def spectrogram(x, n_fft, hop_length, window, power=2.0, center=True,
+                pad_mode="reflect"):
+    win = unwrap(window)
+
+    def fn(a):
+        wav = a
+        if center:
+            pad = n_fft // 2
+            wav = jnp.pad(wav, [(0, 0)] * (wav.ndim - 1) + [(pad, pad)],
+                          mode="reflect" if pad_mode == "reflect" else
+                          "constant")
+        n_frames = 1 + (wav.shape[-1] - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(n_fft)[None, :])
+        frames = wav[..., idx] * win
+        spec = jnp.fft.rfft(frames, axis=-1)
+        mag = jnp.abs(spec) ** power
+        return jnp.swapaxes(mag, -1, -2)  # (..., freq, time)
+    return apply(fn, x, name="spectrogram")
+
+
+def dct_ii(x, n_out):
+    def fn(a):
+        n_in = a.shape[-2]
+        k = np.arange(n_out)[:, None]
+        n = np.arange(n_in)[None, :]
+        basis = np.sqrt(2.0 / n_in) * np.cos(np.pi / n_in * (n + 0.5) * k)
+        basis[0] /= np.sqrt(2.0)
+        return jnp.einsum("...ft,kf->...kt", a, jnp.asarray(
+            basis.astype(np.float32)))
+    return apply(fn, x, name="dct")
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    k = np.arange(n_mfcc)[:, None]
+    n = np.arange(n_mels)[None, :]
+    basis = np.sqrt(2.0 / n_mels) * np.cos(np.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        basis[0] /= np.sqrt(2.0)
+    return Tensor(jnp.asarray(basis.T.astype(np.float32)))

@@ -1,0 +1,69 @@
+"""FLOPs counter (reference: python/paddle/hapi/dynamic_flops.py).
+
+Counts matmul/conv MACs by hooking layer forwards on a real run.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .._core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _conv_flops(layer, inp, out):
+    k = int(np.prod(layer._kernel_size))
+    cin = layer._in_channels // layer._groups
+    out_elems = int(np.prod(out.shape))
+    return out_elems * cin * k
+
+
+def _linear_flops(layer, inp, out):
+    return int(np.prod(out.shape)) * layer.weight.shape[0]
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import _ConvNd
+    from ..tensor.creation import zeros
+
+    total = [0]
+    rows = []
+    hooks = []
+
+    def make_hook(fn, layer, name):
+        def hook(l, i, o):
+            if isinstance(o, (tuple, list)):
+                o = o[0]
+            f = fn(l, i, o)
+            total[0] += f
+            rows.append((name, f))
+        return hook
+
+    for name, layer in net.named_sublayers(include_self=True):
+        if isinstance(layer, _ConvNd):
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(_conv_flops, layer, name)))
+        elif isinstance(layer, Linear):
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(_linear_flops, layer, name)))
+        if custom_ops and type(layer) in custom_ops:
+            fn = custom_ops[type(layer)]
+            hooks.append(layer.register_forward_post_hook(
+                make_hook(lambda l, i, o, fn=fn: fn(l, i, o), layer, name)))
+
+    was_training = net.training
+    net.eval()
+    x = zeros(list(input_size))
+    try:
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        for name, f in rows:
+            print(f"{name:<40}{f / 1e6:>12.2f} MMACs")
+    print(f"Total MACs: {total[0] / 1e9:.3f} G "
+          f"(≈ {2 * total[0] / 1e9:.3f} GFLOPs)")
+    return total[0]

@@ -1,0 +1,42 @@
+"""paddle.onnx parity shim.
+
+ONNX export is a GPU/CPU-deployment path; the TPU deployment story is
+XLA AOT (jax.export → StableHLO), exposed here as export_stablehlo.
+"""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not part of the TPU build; use "
+        "paddle_tpu.onnx.export_stablehlo for XLA-AOT deployment")
+
+
+def export_stablehlo(layer, path, example_inputs):
+    """Serialize the layer's forward as StableHLO via jax.export."""
+    import jax
+    from jax import export as jexport
+    from ._core.tensor import Tensor, unwrap
+
+    params, buffers = layer.functional_state()
+
+    def pure(params, *raws):
+        wrapped = [Tensor(r) for r in raws]
+        with layer._swapped_state(params, buffers):
+            out = layer(*wrapped)
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    raws = tuple(unwrap(a) for a in example_inputs)
+    exported = jexport.export(jax.jit(pure))(params, *raws)
+    data = exported.serialize()
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def load_stablehlo(path):
+    from jax import export as jexport
+    with open(path, "rb") as f:
+        return jexport.deserialize(f.read())

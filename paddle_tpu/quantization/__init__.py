@@ -1,0 +1,132 @@
+"""Quantization (reference: python/paddle/quantization + incubate
+weight-only quant).
+
+Round-1 scope: weight-only int8/int4 PTQ for inference matmuls —
+quantize to per-channel int8, dequantize inside the matmul (XLA fuses
+the dequant into the MXU feed). QAT API surface stubbed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply, unwrap
+from ..nn.layer.layers import Layer
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """→ (quantized int8 weights, per-out-channel fp scales).
+    Weight layout (in, out); scales over the out axis."""
+    w = unwrap(x).astype(jnp.float32)
+    if algo in ("weight_only_int8", "llm.int8"):
+        scale = jnp.max(jnp.abs(w), axis=0) / 127.0
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-10)), -127, 127) \
+            .astype(jnp.int8)
+        return Tensor(q), Tensor(scale)
+    if algo == "weight_only_int4":
+        scale = jnp.max(jnp.abs(w), axis=0) / 7.0
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-10)), -7, 7) \
+            .astype(jnp.int8)
+        return Tensor(q), Tensor(scale)
+    raise ValueError(f"unknown algo {algo}")
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8"):
+    return apply(lambda q, s: q.astype(jnp.float32) * s, x, scale,
+                 name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(Wq) + b (reference: incubate weight_only_linear)."""
+    def fn(a, q, s, *b):
+        w = q.astype(a.dtype) * s.astype(a.dtype)
+        out = a @ w
+        if b:
+            out = out + b[0]
+        return out
+    args = [x, weight, weight_scale]
+    if bias is not None:
+        args.append(bias)
+    return apply(fn, *args, name="weight_only_linear")
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer2config = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        self._layer2config[id(layer)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        pass
+
+    def add_name_config(self, names, activation=None, weight=None):
+        pass
+
+
+class QAT:
+    """Quantization-aware training scaffold (full fake-quant round 2)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        return model
+
+    def convert(self, model, inplace=False):
+        return model
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=False):
+        """Replace Linear weights with int8 + scale (weight-only)."""
+        from ..nn.layer.common import Linear
+        for _, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, Linear) and layer.weight is not None:
+                q, s = weight_quantize(layer.weight)
+                layer._quant_weight = q
+                layer._quant_scale = s
+                layer._orig_forward = layer.forward
+
+                def make_fwd(l):
+                    def fwd(inp):
+                        return weight_only_linear(inp, l._quant_weight, l.bias,
+                                                  l._quant_scale)
+                    return fwd
+                object.__setattr__(layer, "forward", make_fwd(layer))
+        return model
+
+    def convert(self, model, inplace=False):
+        return model
+
+
+class QuantizedLinear(Layer):
+    def __init__(self, in_features, out_features, weight_dtype="int8"):
+        super().__init__()
+        import jax.numpy as jnp
+        self.register_buffer("quant_weight", Tensor(
+            jnp.zeros((in_features, out_features), jnp.int8)))
+        self.register_buffer("quant_scale", Tensor(
+            jnp.ones((out_features,), jnp.float32)))
+        self.bias = self.create_parameter([out_features], is_bias=True)
+
+    @classmethod
+    def from_linear(cls, linear):
+        q = cls(linear.weight.shape[0], linear.weight.shape[1])
+        qw, s = weight_quantize(linear.weight)
+        q.quant_weight.set_value(qw)
+        q.quant_scale.set_value(s)
+        if linear.bias is not None:
+            q.bias.set_value(linear.bias)
+        return q
+
+    def forward(self, x):
+        return weight_only_linear(x, self.quant_weight, self.bias,
+                                  self.quant_scale)

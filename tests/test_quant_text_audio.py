@@ -1,0 +1,113 @@
+"""quantization / text / audio / flops / onnx-stablehlo tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestQuantization:
+    def test_weight_quant_roundtrip(self):
+        w = pt.randn([32, 16])
+        q, scale = pt.quantization.weight_quantize(w)
+        assert q.dtype == np.int8
+        deq = pt.quantization.weight_dequantize(q, scale)
+        err = np.abs(deq.numpy() - w.numpy()).max()
+        assert err < np.abs(w.numpy()).max() / 100
+
+    def test_weight_only_linear_close_to_fp(self):
+        x = pt.randn([4, 32])
+        lin = pt.nn.Linear(32, 8)
+        ref = lin(x).numpy()
+        q, s = pt.quantization.weight_quantize(lin.weight)
+        out = pt.quantization.weight_only_linear(x, q, lin.bias, s).numpy()
+        assert np.abs(out - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+
+    def test_ptq_model(self):
+        net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                               pt.nn.Linear(16, 4))
+        x = pt.randn([2, 8])
+        ref = net(x).numpy()
+        pt.quantization.PTQ().quantize(net)
+        out = net(x).numpy()
+        assert np.abs(out - ref).max() < 0.1 * np.abs(ref).max() + 0.1
+
+    def test_quantized_linear_layer(self):
+        lin = pt.nn.Linear(8, 4)
+        qlin = pt.quantization.QuantizedLinear.from_linear(lin)
+        x = pt.randn([2, 8])
+        assert np.abs(qlin(x).numpy() - lin(x).numpy()).max() < 0.1
+
+
+class TestText:
+    def test_byte_tokenizer_roundtrip(self):
+        tok = pt.text.ByteTokenizer()
+        ids = tok.encode("hello tpu", add_bos=True, add_eos=True)
+        assert ids[0] == tok.bos_token_id and ids[-1] == tok.eos_token_id
+        assert tok.decode(ids) == "hello tpu"
+
+    def test_tokenizer_padding(self):
+        tok = pt.text.ByteTokenizer()
+        out = tok(["ab", "abcd"], padding=True)
+        assert out["input_ids"].shape == (2, 4)
+        assert out["attention_mask"].sum() == 6
+
+    def test_lm_dataset(self):
+        ds = pt.text.LMDataset(np.arange(100), seq_len=10)
+        x, y = ds[0]
+        assert np.array_equal(y, x + 1)
+
+    def test_imdb_uci(self):
+        ds = pt.text.Imdb(mode="train")
+        ids, label = ds[0]
+        assert label in (0, 1)
+        uci = pt.text.UCIHousing(mode="test")
+        x, y = uci[0]
+        assert x.shape == (13,)
+
+
+class TestAudio:
+    def test_spectrogram_shapes(self):
+        wav = pt.randn([1, 4000])
+        spec = pt.audio.features.Spectrogram(n_fft=256, hop_length=128)(wav)
+        assert spec.shape[1] == 129  # n_fft//2+1
+
+    def test_mel_and_mfcc(self):
+        wav = pt.randn([1, 4000])
+        mel = pt.audio.features.LogMelSpectrogram(sr=16000, n_fft=256,
+                                                  n_mels=32)(wav)
+        assert mel.shape[1] == 32
+        mfcc = pt.audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                                      n_mels=32)(wav)
+        assert mfcc.shape[1] == 13
+
+    def test_parseval_energy(self):
+        # rect window, hop=n_fft → frame energies match (Parseval)
+        wav_np = np.random.randn(1, 1024).astype(np.float32)
+        spec = pt.audio.functional.spectrogram(
+            pt.to_tensor(wav_np), 256, 256,
+            pt.audio.functional.get_window("rect", 256), power=2.0,
+            center=False)
+        frame0 = wav_np[0, :256]
+        e_time = (frame0 ** 2).sum()
+        s = spec.numpy()[0, :, 0]
+        e_freq = (s[0] + 2 * s[1:-1].sum() + s[-1]) / 256
+        assert np.allclose(e_time, e_freq, rtol=1e-3)
+
+
+class TestFlops:
+    def test_lenet_flops(self):
+        net = pt.vision.models.LeNet()
+        macs = pt.flops(net, (1, 1, 28, 28))
+        assert 300_000 < macs < 600_000  # LeNet ≈ 0.42 MMACs
+
+
+class TestStableHLOExport:
+    def test_export_and_run(self, tmp_path):
+        net = pt.nn.Linear(4, 2)
+        x = pt.randn([1, 4])
+        path = str(tmp_path / "m.stablehlo")
+        pt.onnx.export_stablehlo(net, path, [x])
+        exported = pt.onnx.load_stablehlo(path)
+        params, _ = net.functional_state()
+        out = exported.call(params, x._value)
+        assert np.allclose(np.asarray(out), net(x).numpy(), atol=1e-6)
