@@ -1,0 +1,144 @@
+"""Compiled Llama decode: static-shape KV cache + lax.scan token loop.
+
+The TPU inference path (reference: PaddleNLP predictor/fused generation
+kernels): no dynamic shapes — the cache is a preallocated
+(L, 2, B, KVH, max_len, D) ring written at position `index` via
+dynamic_update_slice; attention masks keys beyond the current length.
+One jit for prefill, one for the whole decode scan.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.rope import rope_cos_sin, apply_rotary_emb
+from .llama import LlamaConfig
+
+
+def init_cache(config: LlamaConfig, batch, max_len, dtype=jnp.float32):
+    c = config
+    hd = c.hidden_size // c.num_attention_heads
+    return jnp.zeros((c.num_hidden_layers, 2, batch, c.num_key_value_heads,
+                      max_len, hd), dtype)
+
+
+def _layer_decode(lp, h, cache_layer, index, rope_full, config, prefill_len=None):
+    """h: (B, S, H) (S=prompt len at prefill, 1 at decode).
+    cache_layer: (2, B, KVH, max_len, D). index: write offset."""
+    c = config
+    nh, nkv = c.num_attention_heads, c.num_key_value_heads
+    hd = c.hidden_size // nh
+    b, s, H = h.shape
+    cos_f, sin_f = rope_full
+    cos = lax.dynamic_slice_in_dim(cos_f, index, s, axis=0) if s == 1 else \
+        cos_f[:s]
+    sin = lax.dynamic_slice_in_dim(sin_f, index, s, axis=0) if s == 1 else \
+        sin_f[:s]
+
+    xf = h.astype(jnp.float32)
+    x = (xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + c.rms_norm_eps)
+         * lp["ln1"]).astype(h.dtype)
+    q = (x @ lp["wq"]).reshape(b, s, nh, hd).swapaxes(1, 2)
+    k = (x @ lp["wk"]).reshape(b, s, nkv, hd).swapaxes(1, 2)
+    v = (x @ lp["wv"]).reshape(b, s, nkv, hd).swapaxes(1, 2)
+    q, k = apply_rotary_emb(q, k, cos[None, None], sin[None, None])
+
+    # write k/v into the ring at [index, index+s)
+    new_k = lax.dynamic_update_slice(cache_layer[0], k.astype(cache_layer.dtype),
+                                     (0, 0, index, 0))
+    new_v = lax.dynamic_update_slice(cache_layer[1], v.astype(cache_layer.dtype),
+                                     (0, 0, index, 0))
+    cache_layer = jnp.stack([new_k, new_v])
+
+    max_len = new_k.shape[-2]
+    rep = nh // nkv
+    kk = jnp.repeat(new_k, rep, axis=1) if rep > 1 else new_k
+    vv = jnp.repeat(new_v, rep, axis=1) if rep > 1 else new_v
+    scale = 1.0 / math.sqrt(hd)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        kk.astype(jnp.float32)) * scale
+    kpos = jnp.arange(max_len)[None, :]
+    qpos = index + jnp.arange(s)[:, None]
+    mask = kpos <= qpos
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs, vv.astype(jnp.float32))
+    h = h + (o.swapaxes(1, 2).reshape(b, s, H).astype(h.dtype) @ lp["wo"])
+
+    xf = h.astype(jnp.float32)
+    x = (xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + c.rms_norm_eps)
+         * lp["ln2"]).astype(h.dtype)
+    h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    return h, cache_layer
+
+
+def forward_with_cache(params, input_ids, cache, index, config: LlamaConfig):
+    """→ (logits_last (B, V), new_cache). index: current write offset."""
+    c = config
+    max_len = cache.shape[-2]
+    rope_full = rope_cos_sin(max_len, c.hidden_size // c.num_attention_heads,
+                             c.rope_theta, jnp.float32)
+    h = jnp.take(params["embed"], input_ids, axis=0)
+
+    def body(carry, xs):
+        hh = carry
+        lp, cache_layer = xs
+        hh, new_cl = _layer_decode(lp, hh, cache_layer, index, rope_full, c)
+        return hh, new_cl
+
+    h, new_cache = lax.scan(body, h, (params["layers"], cache))
+    hf = h.astype(jnp.float32)
+    h = (hf * lax.rsqrt(jnp.mean(hf * hf, -1, keepdims=True) + c.rms_norm_eps)
+         * params["final_norm"]).astype(h.dtype)
+    logits = h[:, -1, :] @ params["lm_head"]
+    return logits.astype(jnp.float32), new_cache
+
+
+def make_generate(config: LlamaConfig, max_len, max_new_tokens,
+                  temperature=0.0, top_k=0):
+    """Compiled greedy/sampled generation: prefill jit + decode-scan jit."""
+
+    prefill = jax.jit(functools.partial(forward_with_cache, config=config),
+                      static_argnames=())
+
+    def decode_all(params, first_tok, cache, start_index, key):
+        def step(carry, _):
+            tok, cache, idx, key = carry
+            logits, cache = forward_with_cache(params, tok[:, None], cache,
+                                               idx, config)
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                lg = logits / temperature
+                if top_k:
+                    kth = jax.lax.top_k(lg, top_k)[0][..., -1:]
+                    lg = jnp.where(lg < kth, -1e30, lg)
+                nxt = jax.random.categorical(sub, lg, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            return (nxt, cache, idx + 1, key), nxt
+
+        (_, cache, _, _), toks = lax.scan(
+            step, (first_tok, cache, start_index, key),
+            None, length=max_new_tokens - 1)
+        return jnp.concatenate([first_tok[:, None], toks.T], axis=1)
+
+    decode_jit = jax.jit(decode_all)
+
+    def generate(params, prompt_ids, seed=0):
+        b, plen = prompt_ids.shape
+        cache = init_cache(config, b, max_len,
+                           params["embed"].dtype)
+        logits, cache = prefill(params, prompt_ids, cache, 0)
+        first = jnp.argmax(logits, axis=-1) if temperature == 0.0 else \
+            jax.random.categorical(jax.random.key(seed), logits / temperature,
+                                   axis=-1)
+        out = decode_jit(params, first, cache, jnp.asarray(plen),
+                         jax.random.key(seed + 1))
+        return jnp.concatenate([prompt_ids, out], axis=1)
+
+    return generate
