@@ -57,6 +57,8 @@ from . import quantization  # noqa: F401
 from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from . import onnx  # noqa: F401
+from . import signal  # noqa: F401
+from . import geometric  # noqa: F401
 from . import utils  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary  # noqa: F401

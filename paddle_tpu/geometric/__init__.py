@@ -1,0 +1,136 @@
+"""paddle.geometric parity (reference: python/paddle/geometric):
+graph message passing via XLA segment ops — send_u_recv / send_ue_recv /
+segment reductions map to jax.ops.segment_* (one fused scatter on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "sample_neighbors",
+           "reindex_graph"]
+
+
+def _num_segments(dst, out_size):
+    if out_size is not None:
+        return int(out_size)
+    return int(np.asarray(dst).max()) + 1
+
+
+def _segment(x, ids, num, pool):
+    if pool == "sum":
+        return jax.ops.segment_sum(x, ids, num)
+    if pool == "mean":
+        s = jax.ops.segment_sum(x, ids, num)
+        c = jax.ops.segment_sum(jnp.ones_like(ids, x.dtype), ids, num)
+        return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (x.ndim - 1))
+    if pool == "max":
+        return jax.ops.segment_max(x, ids, num)
+    if pool == "min":
+        return jax.ops.segment_min(x, ids, num)
+    raise ValueError(pool)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    num = _num_segments(unwrap(dst_index), out_size)
+
+    def fn(a, src, dst):
+        msgs = jnp.take(a, src, axis=0)
+        return _segment(msgs, dst, num, reduce_op)
+    return apply(fn, x, src_index, dst_index, name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
+                 out_size=None, name=None):
+    num = _num_segments(unwrap(dst_index), out_size)
+
+    def fn(a, e, src, dst):
+        msgs = jnp.take(a, src, axis=0)
+        if message_op == "add":
+            msgs = msgs + e
+        elif message_op == "sub":
+            msgs = msgs - e
+        elif message_op == "mul":
+            msgs = msgs * e
+        elif message_op == "div":
+            msgs = msgs / e
+        return _segment(msgs, dst, num, reduce_op)
+    return apply(fn, x, y, src_index, dst_index, name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    def fn(a, b, src, dst):
+        u = jnp.take(a, src, axis=0)
+        v = jnp.take(b, dst, axis=0)
+        return {"add": u + v, "sub": u - v, "mul": u * v,
+                "div": u / v}[message_op]
+    return apply(fn, x, y, src_index, dst_index, name="send_uv")
+
+
+def segment_sum(data, segment_ids, name=None):
+    num = _num_segments(unwrap(segment_ids), None)
+    return apply(lambda d, i: jax.ops.segment_sum(d, i, num), data, segment_ids,
+                 name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    num = _num_segments(unwrap(segment_ids), None)
+    return apply(lambda d, i: _segment(d, i, num, "mean"), data, segment_ids,
+                 name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    num = _num_segments(unwrap(segment_ids), None)
+    return apply(lambda d, i: jax.ops.segment_max(d, i, num), data, segment_ids,
+                 name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    num = _num_segments(unwrap(segment_ids), None)
+    return apply(lambda d, i: jax.ops.segment_min(d, i, num), data, segment_ids,
+                 name="segment_min")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Host-side uniform neighbor sampling (data-dependent shapes)."""
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    nodes = np.asarray(unwrap(input_nodes))
+    out_n, out_count = [], []
+    rng = np.random.RandomState(0)
+    for v in nodes:
+        nbrs = r[cp[v]:cp[v + 1]]
+        if sample_size > 0 and len(nbrs) > sample_size:
+            nbrs = rng.choice(nbrs, sample_size, replace=False)
+        out_n.append(nbrs)
+        out_count.append(len(nbrs))
+    return (Tensor(jnp.asarray(np.concatenate(out_n) if out_n else
+                               np.zeros(0, r.dtype))),
+            Tensor(jnp.asarray(np.asarray(out_count, np.int64))))
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    xs = np.asarray(unwrap(x))
+    nb = np.asarray(unwrap(neighbors))
+    uniq, inv = np.unique(np.concatenate([xs, nb]), return_inverse=True)
+    # order: keep x first (paddle semantics: x nodes keep ids 0..len(x))
+    order = {v: i for i, v in enumerate(xs)}
+    nxt = len(xs)
+    remap = {}
+    for v in np.concatenate([xs, nb]):
+        if v not in order and v not in remap:
+            remap[v] = nxt
+            nxt += 1
+    full = {**order, **remap}
+    reindexed = np.asarray([full[v] for v in nb], np.int64)
+    out_nodes = np.asarray(sorted(full, key=full.get), np.int64)
+    return (Tensor(jnp.asarray(reindexed)),
+            Tensor(jnp.asarray(out_nodes)),
+            Tensor(jnp.asarray(np.asarray(unwrap(count)))))

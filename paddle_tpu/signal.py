@@ -1,0 +1,108 @@
+"""paddle.signal parity (reference: python/paddle/signal.py): frame,
+overlap_add, stft, istft — jnp graphs over our fft ops."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ._core.tensor import Tensor, apply, unwrap
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    def fn(a):
+        n = a.shape[axis]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(frame_length)[None, :])
+        moved = jnp.moveaxis(a, axis, -1)
+        out = moved[..., idx]  # (..., n_frames, frame_length)
+        out = jnp.swapaxes(out, -1, -2)  # (..., frame_length, n_frames)
+        if axis == 0:
+            out = jnp.moveaxis(out, (-2, -1), (0, 1))
+        return out
+    return apply(fn, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    def fn(a):
+        if axis == 0:
+            a = jnp.moveaxis(a, (0, 1), (-2, -1))
+        *batch, frame_length, n_frames = a.shape
+        out_len = (n_frames - 1) * hop_length + frame_length
+        out = jnp.zeros(tuple(batch) + (out_len,), a.dtype)
+        for f in range(n_frames):
+            out = out.at[..., f * hop_length:f * hop_length + frame_length].add(
+                a[..., f])
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+    return apply(fn, x, name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones((wl,), jnp.float32)
+
+    def fn(a, w=None):
+        wloc = w if w is not None else win
+        if wl < n_fft:
+            pad = (n_fft - wl) // 2
+            wloc = jnp.pad(wloc, (pad, n_fft - wl - pad))
+        wav = a
+        if center:
+            p = n_fft // 2
+            wav = jnp.pad(wav, [(0, 0)] * (wav.ndim - 1) + [(p, p)],
+                          mode="reflect" if pad_mode == "reflect" else "constant")
+        n_frames = 1 + (wav.shape[-1] - n_fft) // hop
+        idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+        frames = wav[..., idx] * wloc
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+            jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)  # (..., freq, time)
+    if window is not None:
+        return apply(fn, x, window, name="stft")
+    return apply(fn, x, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+    win = unwrap(window) if window is not None else jnp.ones((wl,), jnp.float32)
+
+    def fn(spec, w=None):
+        wloc = w if w is not None else win
+        if wl < n_fft:
+            pad = (n_fft - wl) // 2
+            wloc = jnp.pad(wloc, (pad, n_fft - wl - pad))
+        s = jnp.swapaxes(spec, -1, -2)  # (..., time, freq)
+        if normalized:
+            s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided else \
+            jnp.real(jnp.fft.ifft(s, axis=-1))
+        frames = frames * wloc
+        n_frames = frames.shape[-2]
+        out_len = (n_frames - 1) * hop + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
+        norm = jnp.zeros((out_len,), frames.dtype)
+        for f in range(n_frames):
+            sl = slice(f * hop, f * hop + n_fft)
+            out = out.at[..., sl].add(frames[..., f, :])
+            norm = norm.at[sl].add(wloc * wloc)
+        out = out / jnp.maximum(norm, 1e-8)
+        if center:
+            p = n_fft // 2
+            out = out[..., p:out_len - p]
+        if length is not None:
+            out = out[..., :length]
+        return out
+    if window is not None:
+        return apply(fn, x, window, name="istft")
+    return apply(fn, x, name="istft")

@@ -111,3 +111,35 @@ class TestStableHLOExport:
         params, _ = net.functional_state()
         out = exported.call(params, x._value)
         assert np.allclose(np.asarray(out), net(x).numpy(), atol=1e-6)
+
+
+class TestSignalGeometric:
+    def test_stft_istft_roundtrip(self):
+        wav = np.random.randn(2, 2048).astype(np.float32)
+        win = pt.audio.functional.get_window("hann", 256)
+        spec = pt.signal.stft(pt.to_tensor(wav), 256, 64, window=pt.Tensor(win))
+        rec = pt.signal.istft(spec, 256, 64, window=pt.Tensor(win),
+                              length=2048)
+        assert np.allclose(rec.numpy(), wav, atol=1e-4)
+
+    def test_frame_overlap_add(self):
+        x = pt.to_tensor(np.arange(10, dtype=np.float32))
+        f = pt.signal.frame(x, 4, 2)
+        assert f.shape == [4, 4]
+        back = pt.signal.overlap_add(f, 2)
+        # interior elements are double-counted by OLA with hop 2, frame 4
+        assert back.shape == [10]
+
+    def test_send_u_recv(self):
+        x = pt.to_tensor(np.array([[1.0], [2.0], [3.0]]))
+        src = pt.to_tensor(np.array([0, 1, 2, 0]))
+        dst = pt.to_tensor(np.array([1, 2, 1, 0]))
+        out = pt.geometric.send_u_recv(x, src, dst, "sum")
+        assert out.numpy().tolist() == [[1.0], [4.0], [2.0]]
+
+    def test_segment_ops(self):
+        data = pt.to_tensor(np.array([1.0, 2.0, 3.0, 4.0]))
+        ids = pt.to_tensor(np.array([0, 0, 1, 1]))
+        assert pt.geometric.segment_sum(data, ids).numpy().tolist() == [3.0, 7.0]
+        assert pt.geometric.segment_mean(data, ids).numpy().tolist() == [1.5, 3.5]
+        assert pt.geometric.segment_max(data, ids).numpy().tolist() == [2.0, 4.0]
