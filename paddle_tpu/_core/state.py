@@ -63,6 +63,7 @@ def amp_state():
 # ---------------------------------------------------------------------------
 class _PRNGState:
     def __init__(self, seed: int = 0):
+        self._np_lock = threading.Lock()
         self.seed(seed)
         self._ctx_stack = []  # list of [key, counter]
 
@@ -85,6 +86,14 @@ class _PRNGState:
             return k
         self._eager_counter += 1
         return jax.random.fold_in(self._key, self._eager_counter)
+
+    def next_np_seed(self) -> int:
+        """Derive a 32-bit seed for host-side numpy Generators (samplers,
+        dataset shuffles). Deterministic under seed(); each caller gets its
+        own Generator so no thread shares mutable numpy RNG state."""
+        with self._np_lock:
+            self._eager_counter += 1
+            return (self._seed * 1000003 + self._eager_counter) & 0xFFFFFFFF
 
     @contextlib.contextmanager
     def key_ctx(self, key):

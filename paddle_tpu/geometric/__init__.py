@@ -8,6 +8,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .._core import state as _state
 from .._core.tensor import Tensor, apply, unwrap
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
@@ -18,7 +19,12 @@ __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
 def _num_segments(dst, out_size):
     if out_size is not None:
         return int(out_size)
-    return int(np.asarray(dst).max()) + 1
+    if isinstance(dst, jax.core.Tracer):
+        raise ValueError(
+            "number of segments is data-dependent; pass out_size= when "
+            "calling this op under jit/to_static (XLA needs static shapes)")
+    d = np.asarray(dst)
+    return int(d.max()) + 1 if d.size else 0
 
 
 def _segment(x, ids, num, pool):
@@ -49,6 +55,10 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
                  out_size=None, name=None):
     num = _num_segments(unwrap(dst_index), out_size)
 
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"send_ue_recv: unknown message_op {message_op!r}; "
+                         "expected add/sub/mul/div")
+
     def fn(a, e, src, dst):
         msgs = jnp.take(a, src, axis=0)
         if message_op == "add":
@@ -57,13 +67,17 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add", reduce_op="sum",
             msgs = msgs - e
         elif message_op == "mul":
             msgs = msgs * e
-        elif message_op == "div":
+        else:
             msgs = msgs / e
         return _segment(msgs, dst, num, reduce_op)
     return apply(fn, x, y, src_index, dst_index, name="send_ue_recv")
 
 
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"send_uv: unknown message_op {message_op!r}; "
+                         "expected add/sub/mul/div")
+
     def fn(a, b, src, dst):
         u = jnp.take(a, src, axis=0)
         v = jnp.take(b, dst, axis=0)
@@ -72,26 +86,26 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     return apply(fn, x, y, src_index, dst_index, name="send_uv")
 
 
-def segment_sum(data, segment_ids, name=None):
-    num = _num_segments(unwrap(segment_ids), None)
+def segment_sum(data, segment_ids, name=None, *, out_size=None):
+    num = _num_segments(unwrap(segment_ids), out_size)
     return apply(lambda d, i: jax.ops.segment_sum(d, i, num), data, segment_ids,
                  name="segment_sum")
 
 
-def segment_mean(data, segment_ids, name=None):
-    num = _num_segments(unwrap(segment_ids), None)
+def segment_mean(data, segment_ids, name=None, *, out_size=None):
+    num = _num_segments(unwrap(segment_ids), out_size)
     return apply(lambda d, i: _segment(d, i, num, "mean"), data, segment_ids,
                  name="segment_mean")
 
 
-def segment_max(data, segment_ids, name=None):
-    num = _num_segments(unwrap(segment_ids), None)
+def segment_max(data, segment_ids, name=None, *, out_size=None):
+    num = _num_segments(unwrap(segment_ids), out_size)
     return apply(lambda d, i: jax.ops.segment_max(d, i, num), data, segment_ids,
                  name="segment_max")
 
 
-def segment_min(data, segment_ids, name=None):
-    num = _num_segments(unwrap(segment_ids), None)
+def segment_min(data, segment_ids, name=None, *, out_size=None):
+    num = _num_segments(unwrap(segment_ids), out_size)
     return apply(lambda d, i: jax.ops.segment_min(d, i, num), data, segment_ids,
                  name="segment_min")
 
@@ -102,35 +116,38 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
     r = np.asarray(unwrap(row))
     cp = np.asarray(unwrap(colptr))
     nodes = np.asarray(unwrap(input_nodes))
-    out_n, out_count = [], []
-    rng = np.random.RandomState(0)
+    e = np.asarray(unwrap(eids)) if eids is not None else None
+    if return_eids and e is None:
+        raise ValueError("sample_neighbors: return_eids=True requires eids")
+    out_i, out_count = [], []
+    rng = np.random.default_rng(_state.prng.next_np_seed())
     for v in nodes:
-        nbrs = r[cp[v]:cp[v + 1]]
-        if sample_size > 0 and len(nbrs) > sample_size:
-            nbrs = rng.choice(nbrs, sample_size, replace=False)
-        out_n.append(nbrs)
-        out_count.append(len(nbrs))
-    return (Tensor(jnp.asarray(np.concatenate(out_n) if out_n else
-                               np.zeros(0, r.dtype))),
-            Tensor(jnp.asarray(np.asarray(out_count, np.int64))))
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        pick = np.arange(lo, hi)
+        if 0 < sample_size < len(pick):
+            pick = rng.choice(pick, sample_size, replace=False)
+        out_i.append(pick)
+        out_count.append(len(pick))
+    idx = np.concatenate(out_i) if out_i else np.zeros(0, np.int64)
+    res = (Tensor(jnp.asarray(r[idx])),
+           Tensor(jnp.asarray(np.asarray(out_count, np.int64))))
+    if return_eids:
+        res = res + (Tensor(jnp.asarray(e[idx])),)
+    return res
 
 
 def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
                   name=None):
+    # paddle semantics: x nodes keep ids 0..len(x)-1; new nodes get ids in
+    # first-appearance order within neighbors. Vectorized via searchsorted.
     xs = np.asarray(unwrap(x))
     nb = np.asarray(unwrap(neighbors))
-    uniq, inv = np.unique(np.concatenate([xs, nb]), return_inverse=True)
-    # order: keep x first (paddle semantics: x nodes keep ids 0..len(x))
-    order = {v: i for i, v in enumerate(xs)}
-    nxt = len(xs)
-    remap = {}
-    for v in np.concatenate([xs, nb]):
-        if v not in order and v not in remap:
-            remap[v] = nxt
-            nxt += 1
-    full = {**order, **remap}
-    reindexed = np.asarray([full[v] for v in nb], np.int64)
-    out_nodes = np.asarray(sorted(full, key=full.get), np.int64)
-    return (Tensor(jnp.asarray(reindexed)),
+    fresh = nb[~np.isin(nb, xs)]
+    uniq, first = np.unique(fresh, return_index=True)
+    new_in_order = uniq[np.argsort(first)]
+    out_nodes = np.concatenate([xs, new_in_order]).astype(np.int64)
+    sort_idx = np.argsort(out_nodes, kind="stable")
+    reindexed = sort_idx[np.searchsorted(out_nodes[sort_idx], nb)]
+    return (Tensor(jnp.asarray(reindexed.astype(np.int64))),
             Tensor(jnp.asarray(out_nodes)),
             Tensor(jnp.asarray(np.asarray(unwrap(count)))))

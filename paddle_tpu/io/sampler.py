@@ -4,6 +4,24 @@ from __future__ import annotations
 
 import numpy as np
 
+from .._core import state as _state
+
+
+def _new_rng(generator=None) -> np.random.Generator:
+    """Per-iteration Generator: deterministic under paddle_tpu.seed() and
+    immune to cross-thread contention on numpy's legacy global RNG.
+
+    Accepts np.random.Generator / RandomState / int seeds; any other object
+    (e.g. a paddle-API Generator handle) falls back to the framework seed
+    stream rather than crashing."""
+    if isinstance(generator, np.random.Generator):
+        return generator
+    if isinstance(generator, np.random.RandomState):
+        return np.random.default_rng(generator.randint(0, 2**32))
+    if isinstance(generator, (int, np.integer)):
+        return np.random.default_rng(int(generator))
+    return np.random.default_rng(_state.prng.next_np_seed())
+
 
 class Sampler:
     def __init__(self, data_source=None):
@@ -36,10 +54,11 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = _new_rng(self.generator)
         if self.replacement:
-            yield from np.random.randint(0, n, self.num_samples).tolist()
+            yield from rng.integers(0, n, self.num_samples).tolist()
         else:
-            yield from np.random.permutation(n)[: self.num_samples].tolist()
+            yield from rng.permutation(n)[: self.num_samples].tolist()
 
     def __len__(self):
         return self.num_samples
@@ -54,8 +73,8 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        idx = np.random.choice(len(self.weights), self.num_samples,
-                               replace=self.replacement, p=p)
+        idx = _new_rng().choice(len(self.weights), self.num_samples,
+                                replace=self.replacement, p=p)
         yield from idx.tolist()
 
     def __len__(self):
@@ -68,7 +87,7 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        yield from np.random.permutation(self.indices).tolist()
+        yield from _new_rng().permutation(self.indices).tolist()
 
     def __len__(self):
         return len(self.indices)

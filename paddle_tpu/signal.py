@@ -31,10 +31,11 @@ def overlap_add(x, hop_length, axis=-1, name=None):
             a = jnp.moveaxis(a, (0, 1), (-2, -1))
         *batch, frame_length, n_frames = a.shape
         out_len = (n_frames - 1) * hop_length + frame_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length +
+               jnp.arange(frame_length)[None, :])
+        # one scatter-add: duplicate indices accumulate
         out = jnp.zeros(tuple(batch) + (out_len,), a.dtype)
-        for f in range(n_frames):
-            out = out.at[..., f * hop_length:f * hop_length + frame_length].add(
-                a[..., f])
+        out = out.at[..., idx].add(jnp.swapaxes(a, -1, -2))
         if axis == 0:
             out = jnp.moveaxis(out, -1, 0)
         return out
@@ -76,6 +77,10 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     hop = hop_length or n_fft // 4
     wl = win_length or n_fft
     win = unwrap(window) if window is not None else jnp.ones((wl,), jnp.float32)
+    if return_complex and onesided:
+        raise ValueError("istft: onesided must be False when "
+                         "return_complex=True (a onesided spectrum implies a "
+                         "real signal)")
 
     def fn(spec, w=None):
         wloc = w if w is not None else win
@@ -85,17 +90,20 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
         s = jnp.swapaxes(spec, -1, -2)  # (..., time, freq)
         if normalized:
             s = s * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
-        frames = jnp.fft.irfft(s, n=n_fft, axis=-1) if onesided else \
-            jnp.real(jnp.fft.ifft(s, axis=-1))
+        if onesided:
+            frames = jnp.fft.irfft(s, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(s, axis=-1)
+            if not return_complex:
+                frames = jnp.real(frames)
         frames = frames * wloc
         n_frames = frames.shape[-2]
         out_len = (n_frames - 1) * hop + n_fft
+        idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
         out = jnp.zeros(frames.shape[:-2] + (out_len,), frames.dtype)
-        norm = jnp.zeros((out_len,), frames.dtype)
-        for f in range(n_frames):
-            sl = slice(f * hop, f * hop + n_fft)
-            out = out.at[..., sl].add(frames[..., f, :])
-            norm = norm.at[sl].add(wloc * wloc)
+        out = out.at[..., idx].add(frames)
+        norm = jnp.zeros((out_len,), wloc.dtype)
+        norm = norm.at[idx].add(jnp.broadcast_to(wloc * wloc, idx.shape))
         out = out / jnp.maximum(norm, 1e-8)
         if center:
             p = n_fft // 2

@@ -19,6 +19,8 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _seed():
+    import numpy as np
     import paddle_tpu as pt
     pt.seed(42)
+    np.random.seed(42)
     yield
