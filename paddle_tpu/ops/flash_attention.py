@@ -34,6 +34,19 @@ except Exception:  # pragma: no cover
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+LANES = 128  # TPU lane width: per-row stats are stored replicated over lanes
+             # so every ref block keeps last-two dims (÷8, ÷128)-aligned
+
+
+def _fit_lanes(x128, n):
+    """(rows, 128) lane-replicated stat → (rows, n) for math against an
+    (rows, n) tile. Values are equal across lanes, so slice or tile."""
+    if n == LANES:
+        return x128
+    if n < LANES:
+        return x128[:, :n]
+    assert n % LANES == 0, f"block dim {n} must be a multiple of {LANES}"
+    return jnp.tile(x128, (1, n // LANES))
 
 
 def _on_tpu():
@@ -107,14 +120,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
             valid = valid & (rows >= cols)
         if causal or sk % block_k != 0:
             s = jnp.where(valid, s, NEG_INF)
-        m_prev = m_ref[:]
+        m_prev = m_ref[:]                       # (block_q, LANES) replicated
         l_prev = l_ref[:]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
+        m_cur = jnp.max(s, axis=1, keepdims=True)          # (block_q, 1)
+        m_new = jnp.maximum(m_prev, m_cur)                 # (block_q, LANES)
+        p = jnp.exp(s - _fit_lanes(m_new, s.shape[-1]))
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        acc_ref[:] = acc_ref[:] * _fit_lanes(alpha, d) + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_new
@@ -132,8 +145,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
     def _finalize():
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+        d = o_ref.shape[-1]
+        o_ref[0] = (acc_ref[:] / _fit_lanes(l_safe, d)).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)
 
 
 def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -165,20 +179,22 @@ def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            spec((1, block_q), lambda bh_, qi, ki: (bh_, qi)),
+            spec((1, block_q, LANES), lambda bh_, qi, ki: (bh_, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            # per-row logsumexp replicated over the lane dim (TPU block rule:
+            # last two dims of a block must be ÷8 / ÷128 or whole-array)
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-        ] if _HAS_PLTPU else [],
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
         interpret=interpret,
     )(qr, kr, vr)
-    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+    return o.reshape(b, h, sq, d), lse[..., 0].reshape(b, h, sq)
 
 
 # ---------------------------------------------------------------------------
@@ -214,13 +230,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             valid = valid & (rows >= cols)
         s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - _fit_lanes(lse_ref[0], s.shape[-1]))
         p = jnp.where(valid, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1])) * scale
         dq_acc[:] += jax.lax.dot_general(ds, k.astype(jnp.float32),
                                          (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -271,7 +287,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             valid = valid & (rows >= cols)
         s = jnp.where(valid, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])  # (bq, bk)
+        p = jnp.exp(s - _fit_lanes(lse_ref[0], s.shape[-1]))  # (bq, bk)
         p = jnp.where(valid, p, 0.0)
         do = do_ref[0].astype(jnp.float32)
         if qm is not None:
@@ -281,7 +297,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1])) * scale
         dk_acc[:] += jax.lax.dot_general(ds, q.astype(jnp.float32),
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -312,8 +328,9 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret)
 
     qr, kr, vr = (t.reshape(bh, -1, d) for t in (q, k, v))
     dor = do.reshape(bh, sq, d)
-    lser = lse.reshape(bh, sq)
-    deltar = delta.reshape(bh, sq)
+    # lane-replicate per-row stats so their blocks obey the TPU (÷8, ÷128) rule
+    lser = jnp.broadcast_to(lse.reshape(bh, sq)[..., None], (bh, sq, LANES))
+    deltar = jnp.broadcast_to(delta.reshape(bh, sq)[..., None], (bh, sq, LANES))
 
     mem = pltpu.VMEM if _HAS_PLTPU else None
     spec = lambda bs, im: pl.BlockSpec(bs, im, memory_space=mem) if mem else \
@@ -329,8 +346,8 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret)
             spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
             spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
             spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
-            spec((1, block_q), lambda b_, qi, ki: (b_, qi)),
-            spec((1, block_q), lambda b_, qi, ki: (b_, qi)),
+            spec((1, block_q, LANES), lambda b_, qi, ki: (b_, qi, 0)),
+            spec((1, block_q, LANES), lambda b_, qi, ki: (b_, qi, 0)),
         ],
         out_specs=[spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
@@ -348,8 +365,8 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret)
             spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
             spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
             spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, 0)),
-            spec((1, block_q), lambda b_, ki, qi: (b_, qi)),
-            spec((1, block_q), lambda b_, ki, qi: (b_, qi)),
+            spec((1, block_q, LANES), lambda b_, ki, qi: (b_, qi, 0)),
+            spec((1, block_q, LANES), lambda b_, ki, qi: (b_, qi, 0)),
         ],
         out_specs=[
             spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),

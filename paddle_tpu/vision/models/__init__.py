@@ -20,3 +20,8 @@ from .dense import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
     GoogLeNet, googlenet, InceptionV3, inception_v3,
 )
+from .transformer_vision import (  # noqa: F401
+    VisionTransformer, vit_s_16, vit_b_16, vit_b_32, vit_l_16,
+    SwinTransformer, swin_t, swin_s, swin_b,
+    ConvNeXt, convnext_tiny, convnext_small, convnext_base,
+)

@@ -1,0 +1,412 @@
+"""Transformer-era vision models: ViT, Swin, ConvNeXt.
+
+Reference parity: PaddleClas exposes these families on top of the
+reference framework (ppcls/arch/backbone/model_zoo/vision_transformer.py,
+swin_transformer.py, convnext.py); we provide them natively in the zoo.
+TPU notes: attention over patch tokens maps straight onto the MXU;
+window partitioning uses static reshapes only (jit-friendly), and all
+norms/activations fuse into the surrounding matmuls under XLA.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as _pt
+
+from ... import nn
+from ..._core.tensor import Tensor, apply
+
+
+__all__ = [
+    "VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16", "vit_s_16",
+    "SwinTransformer", "swin_t", "swin_s", "swin_b",
+    "ConvNeXt", "convnext_tiny", "convnext_small", "convnext_base",
+]
+
+
+# ---------------------------------------------------------------------------
+# ViT
+# ---------------------------------------------------------------------------
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                       # (B, E, H/p, W/p)
+        b, e = x.shape[0], x.shape[1]
+        x = x.reshape([b, e, -1])              # (B, E, N)
+        return x.transpose([0, 2, 1])          # (B, N, E)
+
+
+class MLP(nn.Layer):
+    def __init__(self, dim, hidden, drop=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.act = nn.GELU()
+        self.fc2 = nn.Linear(hidden, dim)
+        self.drop = nn.Dropout(drop)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(self.act(self.fc1(x)))))
+
+
+class Attention(nn.Layer):
+    """Token self-attention; one fused qkv matmul feeds the MXU."""
+
+    def __init__(self, dim, num_heads, qkv_bias=True, attn_drop=0.0,
+                 proj_drop=0.0):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.scale = self.head_dim ** -0.5
+        self.qkv = nn.Linear(dim, dim * 3, bias_attr=qkv_bias)
+        self.proj = nn.Linear(dim, dim)
+        self.attn_drop = nn.Dropout(attn_drop)
+        self.proj_drop = nn.Dropout(proj_drop)
+
+    def forward(self, x, rel_bias=None):
+        b, n, c = x.shape[0], x.shape[1], x.shape[2]
+        qkv = self.qkv(x).reshape([b, n, 3, self.num_heads, self.head_dim])
+        qkv = qkv.transpose([2, 0, 3, 1, 4])   # (3, B, H, N, d)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        attn = q.matmul(k.transpose([0, 1, 3, 2])) * self.scale
+        if rel_bias is not None:
+            attn = attn + rel_bias
+        attn = nn.functional.softmax(attn, axis=-1)
+        attn = self.attn_drop(attn)
+        out = attn.matmul(v).transpose([0, 2, 1, 3]).reshape([b, n, c])
+        return self.proj_drop(self.proj(out))
+
+
+class ViTBlock(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, qkv_bias=True,
+                 drop=0.0, attn_drop=0.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=1e-6)
+        self.attn = Attention(dim, num_heads, qkv_bias, attn_drop, drop)
+        self.norm2 = nn.LayerNorm(dim, epsilon=1e-6)
+        self.mlp = MLP(dim, int(dim * mlp_ratio), drop)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        return x + self.mlp(self.norm2(x))
+
+
+class VisionTransformer(nn.Layer):
+    """ViT (An Image is Worth 16x16 Words)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, qkv_bias=True, drop_rate=0.0,
+                 attn_drop_rate=0.0):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim], default_initializer=nn.initializer.Constant(0.0))
+        self.pos_embed = self.create_parameter(
+            [1, n + 1, embed_dim],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_drop = nn.Dropout(drop_rate)
+        self.blocks = nn.LayerList([
+            ViTBlock(embed_dim, num_heads, mlp_ratio, qkv_bias, drop_rate,
+                     attn_drop_rate) for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=1e-6)
+        self.head = nn.Linear(embed_dim, num_classes) if num_classes > 0 \
+            else nn.Identity()
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        b = x.shape[0]
+        cls = self.cls_token.expand([b, -1, -1])
+        x = _pt.concat([cls, x], axis=1) + self.pos_embed
+        x = self.pos_drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        return self.head(x[:, 0])
+
+
+def vit_s_16(**kw):
+    return VisionTransformer(patch_size=16, embed_dim=384, depth=12,
+                             num_heads=6, **kw)
+
+
+def vit_b_16(**kw):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12,
+                             num_heads=12, **kw)
+
+
+def vit_b_32(**kw):
+    return VisionTransformer(patch_size=32, embed_dim=768, depth=12,
+                             num_heads=12, **kw)
+
+
+def vit_l_16(**kw):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24,
+                             num_heads=16, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Swin
+# ---------------------------------------------------------------------------
+def _window_partition(x, ws):
+    """(B, H, W, C) → (B·nH·nW, ws·ws, C) with static reshapes only."""
+    def fn(a):
+        b, h, w, c = a.shape
+        a = a.reshape(b, h // ws, ws, w // ws, ws, c)
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(-1, ws * ws, c)
+    return apply(fn, x, name="window_partition")
+
+
+def _window_reverse(win, ws, h, w):
+    def fn(a):
+        c = a.shape[-1]
+        b = a.shape[0] // ((h // ws) * (w // ws))
+        a = a.reshape(b, h // ws, w // ws, ws, ws, c)
+        a = jnp.transpose(a, (0, 1, 3, 2, 4, 5))
+        return a.reshape(b, h, w, c)
+    return apply(fn, win, name="window_reverse")
+
+
+def _relative_position_index(ws):
+    coords = np.stack(np.meshgrid(np.arange(ws), np.arange(ws),
+                                  indexing="ij"))          # (2, ws, ws)
+    flat = coords.reshape(2, -1)
+    rel = flat[:, :, None] - flat[:, None, :]               # (2, N, N)
+    rel = rel.transpose(1, 2, 0) + (ws - 1)
+    return (rel[..., 0] * (2 * ws - 1) + rel[..., 1]).astype(np.int64)
+
+
+class WindowAttention(nn.Layer):
+    def __init__(self, dim, num_heads, window_size, qkv_bias=True):
+        super().__init__()
+        self.ws = window_size
+        self.attn = Attention(dim, num_heads, qkv_bias)
+        num_rel = (2 * window_size - 1) ** 2
+        self.rel_bias_table = self.create_parameter(
+            [num_rel, num_heads],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self._rel_index = Tensor(jnp.asarray(
+            _relative_position_index(window_size).reshape(-1)))
+
+    def rel_bias(self):
+        """(H, N, N) learned relative-position bias for one window."""
+        n = self.ws * self.ws
+        bias = self.rel_bias_table[self._rel_index]
+        return bias.reshape([n, n, -1]).transpose([2, 0, 1])
+
+    def forward(self, x, mask=None):
+        """x: (B·nW, N, C); mask: optional (nW, N, N) additive mask."""
+        bias = self.rel_bias().unsqueeze(0)       # (1, H, N, N)
+        if mask is not None:
+            nw, n = mask.shape[0], mask.shape[1]
+            b = x.shape[0] // nw
+            # (nW,1,N,N)+(1,H,N,N) → (nW,H,N,N), tiled batch-major
+            bias = (mask.unsqueeze(1) + bias).tile([b, 1, 1, 1])
+        return self.attn(x, rel_bias=bias)
+
+
+class SwinBlock(nn.Layer):
+    def __init__(self, dim, num_heads, window_size=7, shift=0, mlp_ratio=4.0,
+                 input_resolution=(56, 56)):
+        super().__init__()
+        self.dim = dim
+        self.ws = window_size
+        self.shift = shift
+        self.resolution = input_resolution
+        self.norm1 = nn.LayerNorm(dim, epsilon=1e-5)
+        self.attn = WindowAttention(dim, num_heads, window_size)
+        self.norm2 = nn.LayerNorm(dim, epsilon=1e-5)
+        self.mlp = MLP(dim, int(dim * mlp_ratio))
+        if shift > 0:
+            self._mask = Tensor(jnp.asarray(self._build_mask()))
+        else:
+            self._mask = None
+
+    def _build_mask(self):
+        h, w = self.resolution
+        img = np.zeros((1, h, w, 1), np.float32)
+        cnt = 0
+        ss = (slice(0, -self.ws), slice(-self.ws, -self.shift),
+              slice(-self.shift, None))
+        for hs in ss:
+            for wsl in ss:
+                img[:, hs, wsl, :] = cnt
+                cnt += 1
+        ws = self.ws
+        win = img.reshape(1, h // ws, ws, w // ws, ws, 1)
+        win = win.transpose(0, 1, 3, 2, 4, 5).reshape(-1, ws * ws)
+        diff = win[:, :, None] - win[:, None, :]
+        return np.where(diff != 0, -100.0, 0.0).astype(np.float32)
+
+    def forward(self, x):
+        h, w = self.resolution
+        b, n, c = x.shape[0], x.shape[1], x.shape[2]
+        shortcut = x
+        x = self.norm1(x).reshape([b, h, w, c])
+        if self.shift > 0:
+            x = _pt.roll(x, shifts=(-self.shift, -self.shift), axis=(1, 2))
+        win = _window_partition(x, self.ws)     # (B·nW, ws², C)
+        win = self.attn(win, mask=self._mask)
+        x = _window_reverse(win, self.ws, h, w)
+        if self.shift > 0:
+            x = _pt.roll(x, shifts=(self.shift, self.shift), axis=(1, 2))
+        x = shortcut + x.reshape([b, n, c])
+        return x + self.mlp(self.norm2(x))
+
+
+class PatchMerging(nn.Layer):
+    def __init__(self, dim, input_resolution):
+        super().__init__()
+        self.resolution = input_resolution
+        self.norm = nn.LayerNorm(4 * dim, epsilon=1e-5)
+        self.reduction = nn.Linear(4 * dim, 2 * dim, bias_attr=False)
+
+    def forward(self, x):
+        h, w = self.resolution
+        b, _, c = x.shape[0], x.shape[1], x.shape[2]
+        x = x.reshape([b, h, w, c])
+        x0 = x[:, 0::2, 0::2]
+        x1 = x[:, 1::2, 0::2]
+        x2 = x[:, 0::2, 1::2]
+        x3 = x[:, 1::2, 1::2]
+        x = _pt.concat([x0, x1, x2, x3], axis=-1)
+        x = x.reshape([b, (h // 2) * (w // 2), 4 * c])
+        return self.reduction(self.norm(x))
+
+
+class SwinTransformer(nn.Layer):
+    """Swin: hierarchical windows + shifted windows (static shapes only)."""
+
+    def __init__(self, img_size=224, patch_size=4, in_chans=3,
+                 num_classes=1000, embed_dim=96, depths=(2, 2, 6, 2),
+                 num_heads=(3, 6, 12, 24), window_size=7, mlp_ratio=4.0):
+        super().__init__()
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        res = img_size // patch_size
+        self.pos_drop = nn.Dropout(0.0)
+        stages = []
+        dim = embed_dim
+        for i, (depth, heads) in enumerate(zip(depths, num_heads)):
+            blocks = []
+            for j in range(depth):
+                blocks.append(SwinBlock(
+                    dim, heads, window_size,
+                    shift=0 if j % 2 == 0 else window_size // 2,
+                    mlp_ratio=mlp_ratio, input_resolution=(res, res)))
+            stages.append(nn.LayerList(blocks))
+            if i < len(depths) - 1:
+                stages.append(PatchMerging(dim, (res, res)))
+                dim *= 2
+                res //= 2
+        self.stages = nn.LayerList(stages)
+        self.norm = nn.LayerNorm(dim, epsilon=1e-5)
+        self.head = nn.Linear(dim, num_classes) if num_classes > 0 \
+            else nn.Identity()
+
+    def forward(self, x):
+        x = self.pos_drop(self.patch_embed(x))
+        for stage in self.stages:
+            if isinstance(stage, nn.LayerList):
+                for blk in stage:
+                    x = blk(x)
+            else:
+                x = stage(x)
+        x = self.norm(x)
+        return self.head(x.mean(axis=1))
+
+
+def swin_t(**kw):
+    return SwinTransformer(embed_dim=96, depths=(2, 2, 6, 2),
+                           num_heads=(3, 6, 12, 24), **kw)
+
+
+def swin_s(**kw):
+    return SwinTransformer(embed_dim=96, depths=(2, 2, 18, 2),
+                           num_heads=(3, 6, 12, 24), **kw)
+
+
+def swin_b(**kw):
+    return SwinTransformer(embed_dim=128, depths=(2, 2, 18, 2),
+                           num_heads=(4, 8, 16, 32), **kw)
+
+
+# ---------------------------------------------------------------------------
+# ConvNeXt
+# ---------------------------------------------------------------------------
+class ConvNeXtBlock(nn.Layer):
+    def __init__(self, dim, layer_scale=1e-6):
+        super().__init__()
+        self.dwconv = nn.Conv2D(dim, dim, 7, padding=3, groups=dim)
+        self.norm = nn.LayerNorm(dim, epsilon=1e-6)
+        self.pw1 = nn.Linear(dim, 4 * dim)
+        self.act = nn.GELU()
+        self.pw2 = nn.Linear(4 * dim, dim)
+        self.gamma = self.create_parameter(
+            [dim], default_initializer=nn.initializer.Constant(layer_scale))
+
+    def forward(self, x):
+        inp = x
+        x = self.dwconv(x)
+        x = x.transpose([0, 2, 3, 1])          # NCHW → NHWC (channels-last)
+        x = self.pw2(self.act(self.pw1(self.norm(x))))
+        x = (self.gamma * x).transpose([0, 3, 1, 2])
+        return inp + x
+
+
+class ConvNeXt(nn.Layer):
+    def __init__(self, in_chans=3, num_classes=1000,
+                 depths=(3, 3, 9, 3), dims=(96, 192, 384, 768)):
+        super().__init__()
+        downs = [nn.Sequential(
+            nn.Conv2D(in_chans, dims[0], 4, stride=4),
+            _ChannelFirstLayerNorm(dims[0]))]
+        for i in range(3):
+            downs.append(nn.Sequential(
+                _ChannelFirstLayerNorm(dims[i]),
+                nn.Conv2D(dims[i], dims[i + 1], 2, stride=2)))
+        self.downsample_layers = nn.LayerList(downs)
+        self.stages = nn.LayerList([
+            nn.Sequential(*[ConvNeXtBlock(dims[i]) for _ in range(depths[i])])
+            for i in range(4)])
+        self.norm = nn.LayerNorm(dims[-1], epsilon=1e-6)
+        self.head = nn.Linear(dims[-1], num_classes)
+
+    def forward(self, x):
+        for down, stage in zip(self.downsample_layers, self.stages):
+            x = stage(down(x))
+        x = x.mean(axis=[2, 3])                # global average pool (NCHW)
+        return self.head(self.norm(x))
+
+
+class _ChannelFirstLayerNorm(nn.Layer):
+    def __init__(self, dim, epsilon=1e-6):
+        super().__init__()
+        self.norm = nn.LayerNorm(dim, epsilon=epsilon)
+
+    def forward(self, x):
+        x = x.transpose([0, 2, 3, 1])
+        x = self.norm(x)
+        return x.transpose([0, 3, 1, 2])
+
+
+def convnext_tiny(**kw):
+    return ConvNeXt(depths=(3, 3, 9, 3), dims=(96, 192, 384, 768), **kw)
+
+
+def convnext_small(**kw):
+    return ConvNeXt(depths=(3, 3, 27, 3), dims=(96, 192, 384, 768), **kw)
+
+
+def convnext_base(**kw):
+    return ConvNeXt(depths=(3, 3, 27, 3), dims=(128, 256, 512, 1024), **kw)

@@ -64,6 +64,52 @@ class TestVisionModels:
 
 
 class TestVisionTransformsDatasets:
+    def test_vit_forward_backward(self):
+        net = pt.vision.models.VisionTransformer(
+            img_size=32, patch_size=8, embed_dim=32, depth=2, num_heads=4,
+            num_classes=5)
+        x = pt.randn([2, 3, 32, 32])
+        out = net(x)
+        assert out.shape == [2, 5]
+        loss = pt.nn.CrossEntropyLoss()(out, pt.to_tensor(np.array([0, 3])))
+        loss.backward()
+        g = net.patch_embed.proj.weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+
+    def test_vit_b16_param_count(self):
+        net = pt.vision.models.vit_b_16()
+        n = sum(int(np.prod(p.shape)) for p in net.parameters())
+        assert abs(n - 86.6e6) / 86.6e6 < 0.01  # ViT-B/16 ~86.6M
+
+    def test_swin_forward(self):
+        net = pt.vision.models.SwinTransformer(
+            img_size=56, patch_size=4, embed_dim=24, depths=(1, 1),
+            num_heads=(2, 4), window_size=7, num_classes=6)
+        out = net(pt.randn([2, 3, 56, 56]))
+        assert out.shape == [2, 6]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_swin_shifted_window_masks_cross_region(self):
+        # tokens moved together by the cyclic shift must not attend across
+        # original image regions: verify the additive mask blocks them
+        from paddle_tpu.vision.models.transformer_vision import SwinBlock
+        blk = SwinBlock(8, 2, window_size=4, shift=2, input_resolution=(8, 8))
+        m = blk._mask.numpy()   # (nW, N, N)
+        assert m.shape[0] == 4 and (m < 0).any()
+        # mask rows are symmetric: blocked pairs blocked both ways
+        assert np.allclose(m, np.swapaxes(m, 1, 2))
+
+    def test_convnext_forward_backward(self):
+        net = pt.vision.models.ConvNeXt(depths=(1, 1, 1, 1),
+                                        dims=(8, 16, 24, 32), num_classes=3)
+        x = pt.randn([2, 3, 32, 32])
+        out = net(x)
+        assert out.shape == [2, 3]
+        loss = out.sum()
+        loss.backward()
+        g = net.stages[0][0].dwconv.weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+
     def test_transform_pipeline(self):
         from paddle_tpu.vision import transforms as T
         t = T.Compose([T.Resize(32), T.CenterCrop(28),
