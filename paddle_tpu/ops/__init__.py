@@ -9,3 +9,6 @@ from .flash_attention import (  # noqa: F401
 )
 from .rope import apply_rotary_emb, rope_cos_sin  # noqa: F401
 from .fused import fused_rms_norm, fused_swiglu, fused_dropout_add  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_attention, paged_attention_reference, PagedKVCache,
+)

@@ -1,0 +1,239 @@
+"""Paged attention for TPU decode (serving path).
+
+Reference parity: the reference serves LLMs through paged/block KV caches
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention — block_tables,
+per-seq lengths). TPU-native redesign:
+
+  * KV lives in a page pool `(kv_heads, num_pages, page_size, head_dim)`.
+  * Each sequence owns a row of `page_table` (page indices) + a length.
+  * The decode kernel runs grid `(batch, kv_heads, pages_per_seq)`; the
+    page table and lengths ride scalar-prefetch (SMEM) so the BlockSpec
+    index_map DMAs exactly the page each step needs — no gather of the
+    whole cache. Online softmax (m/l lane-replicated scratch) accumulates
+    across the page grid dimension; fully-masked pages are skipped with
+    @pl.when (ragged batches don't pay for their padding).
+  * GQA: q is viewed (batch, kv_heads, group, head_dim); group is padded
+    to the sublane minimum (8) in the wrapper.
+
+Off-TPU the XLA reference path (gather pages → dense softmax) is used;
+the kernel also runs under pallas interpret mode for tests.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _fit_lanes
+
+NEG_INF = -1e30
+LANES = 128
+MIN_GROUP = 8  # TPU sublane minimum for the q-rows dim
+
+
+def _on_tpu():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Reference (XLA) implementation
+# ---------------------------------------------------------------------------
+def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
+                              sm_scale=None):
+    """q: (B, QH, D); pages: (KVH, P, page, D); page_table: (B, pages_per_seq);
+    lengths: (B,). Returns (B, QH, D)."""
+    b, qh, d = q.shape
+    kvh, _, page, _ = k_pages.shape
+    group = qh // kvh
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    # gather this batch's pages: (B, KVH, pages_per_seq*page, D)
+    k = jnp.swapaxes(k_pages[:, page_table], 0, 1).reshape(b, kvh, -1, d)
+    v = jnp.swapaxes(v_pages[:, page_table], 0, 1).reshape(b, kvh, -1, d)
+    qg = q.reshape(b, kvh, group, d).astype(jnp.float32)
+    s = jnp.einsum("bhgd,bhkd->bhgk", qg, k.astype(jnp.float32)) * scale
+    mask = jnp.arange(s.shape[-1])[None, None, None] < lengths[:, None, None,
+                                                               None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgk,bhkd->bhgd", p, v.astype(jnp.float32))
+    return o.reshape(b, qh, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+def _decode_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, page_size, n_pages):
+    del ptab_ref  # consumed by the index maps
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    seq_len = len_ref[bi]
+
+    @pl.when(pi * page_size < seq_len)  # skip fully-masked pages
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)   # (group, d)
+        k = k_ref[0, 0].astype(jnp.float32)   # (page, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < seq_len, s, NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - _fit_lanes(m_new, s.shape[-1]))
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * _fit_lanes(alpha, acc_ref.shape[-1]) + \
+            jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(pi == n_pages - 1)
+    def _fin():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] /
+                       _fit_lanes(l_safe, o_ref.shape[-1])).astype(o_ref.dtype)
+
+
+def _decode_pallas(q4, k_pages, v_pages, page_table, lengths, scale,
+                   interpret):
+    b, kvh, group, d = q4.shape
+    _, _, page_size, _ = k_pages.shape
+    n_pages = page_table.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, n_pages),
+        in_specs=[
+            # index maps receive grid indices first, then scalar-prefetch refs
+            pl.BlockSpec((1, 1, group, d),
+                         lambda bi, hi, pi, ptab, lens: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda bi, hi, pi, ptab, lens:
+                         (hi, ptab[bi, pi], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda bi, hi, pi, ptab, lens:
+                         (hi, ptab[bi, pi], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda bi, hi, pi, ptab, lens: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+            pltpu.VMEM((group, LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_kernel, scale=float(scale),
+                               page_size=page_size, n_pages=n_pages)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, group, d), q4.dtype),
+        interpret=interpret,
+    )(page_table, lengths, q4, k_pages, v_pages)
+
+
+def paged_attention(q, k_pages, v_pages, page_table, lengths, sm_scale=None,
+                    use_pallas=None, interpret=None):
+    """Single-token decode attention over a paged KV cache.
+
+    q: (B, QH, D); k_pages/v_pages: (KVH, num_pages, page_size, D);
+    page_table: (B, pages_per_seq) int32; lengths: (B,) int32.
+    """
+    b, qh, d = q.shape
+    kvh = k_pages.shape[0]
+    group = qh // kvh
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if interpret is None:
+        interpret = False
+    if not use_pallas and not interpret:
+        return paged_attention_reference(q, k_pages, v_pages, page_table,
+                                         lengths, scale)
+    q4 = q.reshape(b, kvh, group, d)
+    # q-rows block dim must be a multiple of the sublane tile (8)
+    pad = (-group) % MIN_GROUP
+    if pad:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    o = _decode_pallas(q4, k_pages, v_pages,
+                       page_table.astype(jnp.int32),
+                       lengths.astype(jnp.int32), scale, interpret)
+    if pad:
+        o = o[:, :, :group]
+    return o.reshape(b, qh, d)
+
+
+# ---------------------------------------------------------------------------
+# Page pool / cache manager (host-side bookkeeping, device-side pool)
+# ---------------------------------------------------------------------------
+class PagedKVCache:
+    """Per-layer paged KV pool with host-side free-list allocation.
+
+    The pool tensors are device arrays updated functionally (scatter into
+    pages); the page table / lengths / free list are host state — the
+    serving loop mutates them between jitted decode steps, mirroring how
+    the reference's BlockManager hands block_tables to the kernel.
+    """
+
+    def __init__(self, num_layers, kv_heads, head_dim, num_pages, page_size,
+                 max_seqs, pages_per_seq, dtype=jnp.bfloat16):
+        shape = (num_layers, kv_heads, num_pages, page_size, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.page_size = page_size
+        self.page_table = jnp.zeros((max_seqs, pages_per_seq), jnp.int32)
+        self.lengths = jnp.zeros((max_seqs,), jnp.int32)
+        self._free = list(range(num_pages - 1, -1, -1))
+        self._seq_pages = {}  # seq slot -> [page ids]
+
+    def alloc_seq(self, slot, prompt_len):
+        n = -(-max(prompt_len, 1) // self.page_size)
+        if len(self._free) < n:
+            raise RuntimeError("PagedKVCache: out of pages")
+        pages = [self._free.pop() for _ in range(n)]
+        self._seq_pages[slot] = pages
+        tbl = self.page_table.at[slot, :n].set(jnp.asarray(pages, jnp.int32))
+        self.page_table = tbl
+        self.lengths = self.lengths.at[slot].set(prompt_len)
+        return pages
+
+    def extend_seq(self, slot):
+        """Called before writing one more token; grabs a page on boundary."""
+        cur = int(self.lengths[slot])
+        if cur % self.page_size == 0 and cur > 0:
+            if not self._free:
+                raise RuntimeError("PagedKVCache: out of pages")
+            pg = self._free.pop()
+            idx = len(self._seq_pages[slot])
+            self._seq_pages[slot].append(pg)
+            self.page_table = self.page_table.at[slot, idx].set(pg)
+        self.lengths = self.lengths.at[slot].add(1)
+
+    def free_seq(self, slot):
+        self._free.extend(reversed(self._seq_pages.pop(slot, [])))
+        self.lengths = self.lengths.at[slot].set(0)
+
+    def write_token(self, layer, slot, k_tok, v_tok):
+        """k_tok/v_tok: (KVH, D) for the token at position lengths[slot]-1."""
+        pos = int(self.lengths[slot]) - 1
+        pg = self._seq_pages[slot][pos // self.page_size]
+        off = pos % self.page_size
+        self.k = self.k.at[layer, :, pg, off].set(k_tok.astype(self.k.dtype))
+        self.v = self.v.at[layer, :, pg, off].set(v_tok.astype(self.v.dtype))
