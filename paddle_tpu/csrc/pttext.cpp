@@ -1,0 +1,167 @@
+// libpttext: byte-level BPE tokenizer core.
+//
+// TPU-native framework runtime piece: tokenization is host-side, latency-
+// critical for serving (the reference ships C++ tokenizers through
+// paddlenlp/fast_tokenizer). This core does the encode hot loop in C++:
+// greedy lowest-rank pair merging over a doubly-linked token list with a
+// binary heap — O(n log n) per text. Python owns vocab construction and
+// file formats; only raw tables cross the boundary.
+//
+// C ABI (ctypes): create / add_token / add_merge / finalize / encode /
+// decode / destroy. Thread-safe after finalize (encode is read-only).
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+    return std::hash<uint64_t>()((uint64_t(uint32_t(p.first)) << 32) |
+                                 uint32_t(p.second));
+  }
+};
+
+struct Merge {
+  int32_t merged_id;
+  int32_t rank;
+};
+
+struct Tokenizer {
+  // vocab: id -> bytes; bytes -> id
+  std::vector<std::string> id_to_bytes;
+  std::unordered_map<std::string, int32_t> bytes_to_id;
+  // single-byte ids (initial segmentation)
+  int32_t byte_ids[256];
+  std::unordered_map<std::pair<int32_t, int32_t>, Merge, PairHash> merges;
+  bool finalized = false;
+};
+
+struct HeapItem {
+  int32_t rank;
+  int32_t pos;      // index of left element in the node array
+  uint64_t stamp;   // versioning: stale entries are skipped
+  bool operator>(const HeapItem& o) const {
+    return rank != o.rank ? rank > o.rank : pos > o.pos;
+  }
+};
+
+struct Node {
+  int32_t id;
+  int32_t prev, next;
+  uint64_t stamp;   // bumped on every mutation of this node
+  bool alive;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pttok_create() { return new Tokenizer(); }
+
+void pttok_destroy(void* h) { delete static_cast<Tokenizer*>(h); }
+
+// id must be dense-ish but any non-negative int works.
+int pttok_add_token(void* h, const uint8_t* bytes, int64_t len, int32_t id) {
+  auto* t = static_cast<Tokenizer*>(h);
+  if (t->finalized || id < 0) return -1;
+  std::string s(reinterpret_cast<const char*>(bytes), size_t(len));
+  if (size_t(id) >= t->id_to_bytes.size()) t->id_to_bytes.resize(id + 1);
+  t->id_to_bytes[id] = s;
+  t->bytes_to_id.emplace(std::move(s), id);
+  return 0;
+}
+
+int pttok_add_merge(void* h, int32_t left, int32_t right, int32_t merged,
+                    int32_t rank) {
+  auto* t = static_cast<Tokenizer*>(h);
+  if (t->finalized) return -1;
+  t->merges[{left, right}] = Merge{merged, rank};
+  return 0;
+}
+
+int pttok_finalize(void* h) {
+  auto* t = static_cast<Tokenizer*>(h);
+  for (int b = 0; b < 256; ++b) {
+    std::string s(1, char(b));
+    auto it = t->bytes_to_id.find(s);
+    t->byte_ids[b] = it == t->bytes_to_id.end() ? -1 : it->second;
+  }
+  t->finalized = true;
+  return 0;
+}
+
+// Encode UTF-8/raw bytes -> token ids. Returns count (<= max_out) or -1.
+int64_t pttok_encode(void* h, const uint8_t* text, int64_t len,
+                     int32_t* out_ids, int64_t max_out) {
+  auto* t = static_cast<Tokenizer*>(h);
+  if (!t->finalized) return -1;
+  if (len == 0) return 0;
+
+  std::vector<Node> nodes(static_cast<size_t>(len));
+  for (int64_t i = 0; i < len; ++i) {
+    int32_t id = t->byte_ids[text[i]];
+    if (id < 0) return -1;  // vocab must cover all bytes (byte-level BPE)
+    nodes[i] = Node{id, int32_t(i - 1), int32_t(i + 1), 0, true};
+  }
+  nodes.back().next = -1;
+
+  std::priority_queue<HeapItem, std::vector<HeapItem>, std::greater<HeapItem>>
+      heap;
+  auto push_pair = [&](int32_t pos) {
+    if (pos < 0) return;
+    const Node& a = nodes[pos];
+    if (!a.alive || a.next < 0) return;
+    auto it = t->merges.find({a.id, nodes[a.next].id});
+    if (it != t->merges.end())
+      heap.push(HeapItem{it->second.rank, pos, a.stamp});
+  };
+  for (int64_t i = 0; i + 1 < len; ++i) push_pair(int32_t(i));
+
+  while (!heap.empty()) {
+    HeapItem item = heap.top();
+    heap.pop();
+    Node& a = nodes[item.pos];
+    if (!a.alive || a.stamp != item.stamp || a.next < 0) continue;
+    Node& b = nodes[a.next];
+    auto it = t->merges.find({a.id, b.id});
+    if (it == t->merges.end() || it->second.rank != item.rank) continue;
+    // merge b into a
+    a.id = it->second.merged_id;
+    a.stamp++;
+    b.alive = false;
+    a.next = b.next;
+    if (b.next >= 0) nodes[b.next].prev = item.pos;
+    push_pair(item.pos);        // (merged, next)
+    push_pair(a.prev);          // (prev, merged)
+  }
+
+  // walk the list from the head (node 0 is always the left survivor)
+  int64_t n = 0;
+  for (int32_t i = 0; i >= 0; i = nodes[i].next) {
+    if (n >= max_out) return -2;
+    out_ids[n++] = nodes[i].id;
+  }
+  return n;
+}
+
+// Decode ids -> bytes. Returns byte count (<= max_out) or -1/-2.
+int64_t pttok_decode(void* h, const int32_t* ids, int64_t n, uint8_t* out,
+                     int64_t max_out) {
+  auto* t = static_cast<Tokenizer*>(h);
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (ids[i] < 0 || size_t(ids[i]) >= t->id_to_bytes.size()) return -1;
+    const std::string& s = t->id_to_bytes[ids[i]];
+    if (total + int64_t(s.size()) > max_out) return -2;
+    memcpy(out + total, s.data(), s.size());
+    total += int64_t(s.size());
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -12,6 +12,7 @@ import os
 import numpy as np
 
 from ..io.dataset import Dataset
+from .bpe import BPETokenizer, train_bpe  # noqa: F401
 
 
 class ByteTokenizer:

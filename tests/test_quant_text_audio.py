@@ -65,6 +65,60 @@ class TestText:
         assert x.shape == (13,)
 
 
+class TestBPE:
+    CORPUS = ["the quick brown fox jumps over the lazy dog",
+              "the lazy dog sleeps", "quick quick brown fox the the the",
+              "pack my box with five dozen liquor jugs"] * 3
+
+    def test_train_and_roundtrip(self):
+        from paddle_tpu.text import BPETokenizer
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=300)
+        assert tok.vocab_size <= 300
+        s = "the quick lazy fox"
+        ids = tok.encode(s)
+        assert tok.decode(ids) == s
+        # merges actually compress vs raw bytes
+        assert len(ids) < len(s.encode())
+
+    def test_native_matches_python(self):
+        from paddle_tpu.text import BPETokenizer
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=300)
+        if tok._native is None:
+            import pytest as _pt
+            _pt.skip("native lib unavailable")
+        for s in self.CORPUS + ["unseen zebra text!", "", "a",
+                                "ünïcodé ⚡ bytes"]:
+            native = tok.encode(s)
+            python = tok._encode_python(s.encode("utf-8"))
+            assert native == python, s
+            assert tok.decode(native) == s
+
+    def test_save_load(self, tmp_path):
+        from paddle_tpu.text import BPETokenizer
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=280)
+        p = str(tmp_path / "tok.json")
+        tok.save(p)
+        tok2 = BPETokenizer.from_files(p)
+        s = "the quick brown dog"
+        assert tok2.encode(s) == tok.encode(s)
+        assert tok2.vocab_size == tok.vocab_size
+
+    def test_padding_batch(self):
+        from paddle_tpu.text import BPETokenizer
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=280)
+        out = tok(["the dog", "the quick brown fox jumps"], padding=True)
+        ids, mask = out["input_ids"], out["attention_mask"]
+        assert ids.shape == mask.shape and (ids[mask == 0] ==
+                                            tok.pad_token_id).all()
+
+    def test_bos_eos(self):
+        from paddle_tpu.text import BPETokenizer
+        tok = BPETokenizer.train(self.CORPUS, vocab_size=270)
+        ids = tok.encode("fox", add_bos=True, add_eos=True)
+        assert ids[0] == tok.bos_token_id and ids[-1] == tok.eos_token_id
+        assert tok.decode(ids) == "fox"
+
+
 class TestAudio:
     def test_spectrogram_shapes(self):
         wav = pt.randn([1, 4000])
