@@ -1,15 +1,30 @@
-"""Sparse tensor subset (reference: python/paddle/sparse).
+"""paddle.sparse parity (reference: python/paddle/sparse).
 
-COO support via jax.experimental.sparse.BCOO. TPU note: XLA prefers
-dense compute; sparse here targets API parity + embedding-style use.
+COO/CSR over jax.experimental.sparse.BCOO. TPU design note: XLA:TPU is a
+dense compiler — sparse formats here exist for API/storage parity
+(embedding gradients, masks, point-cloud style data); value-wise compute
+runs on the nnz vector (dense VPU work), while matmuls densify unless the
+BCOO path lowers. `paddle.sparse.nn` activations operate on values only,
+matching the reference's semantics of "apply op to non-zero entries".
 """
 from __future__ import annotations
+
+import math
 
 import numpy as np
 import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
 from .._core.tensor import Tensor, unwrap
+
+__all__ = [
+    "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor", "matmul",
+    "masked_matmul", "addmm", "add", "subtract", "multiply", "divide",
+    "is_same_shape", "coalesce", "transpose", "reshape", "nnz",
+    "sin", "sinh", "asin", "asinh", "tan", "tanh", "atan", "atanh", "sqrt",
+    "square", "abs", "pow", "neg", "expm1", "log1p", "cast", "rad2deg",
+    "deg2rad", "relu", "relu6", "leaky_relu", "softmax", "nn",
+]
 
 
 class SparseCooTensor(Tensor):
@@ -31,6 +46,13 @@ class SparseCooTensor(Tensor):
 
     def is_sparse_coo(self):
         return True
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates(),
+                               stop_gradient=self.stop_gradient)
 
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
@@ -55,6 +77,81 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
     return sparse_coo_tensor(idx, values, shape, dtype, place, stop_gradient)
 
 
+def _rebuild(x: SparseCooTensor, new_vals):
+    b = jsparse.BCOO((new_vals, x._bcoo.indices), shape=x._bcoo.shape)
+    return SparseCooTensor(b, stop_gradient=x.stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# value-wise unary ops (zero-preserving, applied to nnz values)
+# ---------------------------------------------------------------------------
+def _unary(fn):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            return _rebuild(x, fn(x._bcoo.data))
+        return Tensor(fn(unwrap(x)))
+    return op
+
+
+sin = _unary(jnp.sin)
+sinh = _unary(jnp.sinh)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+tan = _unary(jnp.tan)
+tanh = _unary(jnp.tanh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sqrt = _unary(jnp.sqrt)
+square = _unary(jnp.square)
+abs = _unary(jnp.abs)  # noqa: A001 - paddle.sparse.abs parity
+neg = _unary(jnp.negative)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+rad2deg = _unary(lambda v: v * (180.0 / math.pi))
+deg2rad = _unary(lambda v: v * (math.pi / 180.0))
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    if isinstance(x, SparseCooTensor):
+        return _rebuild(x, jnp.power(x._bcoo.data, factor))
+    return Tensor(jnp.power(unwrap(x), factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from .._core import dtypes as _dt
+    b = x._bcoo
+    idx = b.indices.astype(_dt.convert_dtype(index_dtype)) if index_dtype \
+        else b.indices
+    vals = b.data.astype(_dt.convert_dtype(value_dtype)) if value_dtype \
+        else b.data
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=b.shape),
+                           stop_gradient=x.stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# binary / matmul family
+# ---------------------------------------------------------------------------
+def _binary(fn):
+    def op(x, y, name=None):
+        sx, sy = isinstance(x, SparseCooTensor), isinstance(y, SparseCooTensor)
+        if sx and sy and np.array_equal(np.asarray(x._bcoo.indices),
+                                        np.asarray(y._bcoo.indices)):
+            return _rebuild(x, fn(x._bcoo.data, y._bcoo.data))
+        a = x.to_dense().data if sx else unwrap(x)
+        b = y.to_dense().data if sy else unwrap(y)
+        out = fn(a, b)
+        if sx and sy:  # both sparse → sparse result
+            return SparseCooTensor(jsparse.BCOO.fromdense(out))
+        return Tensor(out)
+    return op
+
+
+add = _binary(jnp.add)
+subtract = _binary(jnp.subtract)
+multiply = _binary(jnp.multiply)
+divide = _binary(jnp.divide)
+
+
 def matmul(x, y, name=None):
     a = x._bcoo if isinstance(x, SparseCooTensor) else unwrap(x)
     b = y._bcoo if isinstance(y, SparseCooTensor) else unwrap(y)
@@ -64,8 +161,119 @@ def matmul(x, y, name=None):
     return Tensor(out)
 
 
-def add(x, y, name=None):
-    return Tensor(unwrap(x) + unwrap(y))
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense, evaluated only at `mask`'s sparsity pattern."""
+    xd, yd = unwrap(x), unwrap(y)
+    idx = mask._bcoo.indices                     # (nnz, 2)
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xd[rows, :], yd[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    xv = x.to_dense().data if isinstance(x, SparseCooTensor) else unwrap(x)
+    yv = y.to_dense().data if isinstance(y, SparseCooTensor) else unwrap(y)
+    iv = input.to_dense().data if isinstance(input, SparseCooTensor) \
+        else unwrap(input)
+    return Tensor(beta * iv + alpha * (xv @ yv))
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def nnz(x):
+    return x.nnz()
+
+
+def transpose(x, perm, name=None):
+    b = x._bcoo
+    new_idx = b.indices[:, jnp.asarray(perm)]
+    new_shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx), shape=new_shape),
+                           stop_gradient=x.stop_gradient)
+
+
+def reshape(x, shape, name=None):
+    b = x._bcoo
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = -int(np.prod([s for s in shape]))
+        shape = tuple(int(np.prod(b.shape)) // known if s == -1 else s
+                      for s in shape)
+    flat = jnp.ravel_multi_index(tuple(b.indices[:, i] for i in
+                                       range(b.indices.shape[1])),
+                                 b.shape, mode="clip")
+    new_idx = jnp.stack(jnp.unravel_index(flat, shape), axis=1)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx), shape=shape),
+                           stop_gradient=x.stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# sparse nn (values-only activations; reference: paddle/sparse/nn)
+# ---------------------------------------------------------------------------
+def relu(x, name=None):
+    return _rebuild(x, jnp.maximum(x._bcoo.data, 0)) \
+        if isinstance(x, SparseCooTensor) else Tensor(
+            jnp.maximum(unwrap(x), 0))
+
+
+def relu6(x, name=None):
+    return _rebuild(x, jnp.clip(x._bcoo.data, 0, 6)) \
+        if isinstance(x, SparseCooTensor) else Tensor(
+            jnp.clip(unwrap(x), 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    fn = lambda v: jnp.where(v >= 0, v, negative_slope * v)
+    return _rebuild(x, fn(x._bcoo.data)) if isinstance(x, SparseCooTensor) \
+        else Tensor(fn(unwrap(x)))
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the sparsity pattern (2D COO, axis=-1)."""
+    b = x._bcoo
+    if len(b.shape) != 2 or axis not in (-1, 1):
+        raise NotImplementedError("sparse softmax: 2D, last axis only")
+    rows = b.indices[:, 0]
+    v = b.data.astype(jnp.float32)
+    import jax
+    row_max = jax.ops.segment_max(v, rows, b.shape[0])
+    e = jnp.exp(v - row_max[rows])
+    denom = jax.ops.segment_sum(e, rows, b.shape[0])
+    return _rebuild(x, (e / denom[rows]).astype(b.data.dtype))
+
+
+class _SparseNN:
+    """paddle.sparse.nn namespace shim: layer-style wrappers."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class ReLU6:
+        def __call__(self, x):
+            return relu6(x)
+
+    class LeakyReLU:
+        def __init__(self, negative_slope=0.01):
+            self.negative_slope = negative_slope
+
+        def __call__(self, x):
+            return leaky_relu(x, self.negative_slope)
+
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            return softmax(x, self.axis)
+
+
+nn = _SparseNN()
 
 
 def is_same_shape(x, y):

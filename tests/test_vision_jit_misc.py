@@ -228,6 +228,44 @@ class TestSparseFFT:
         y = pt.sparse.matmul(sp, pt.ones([3, 2]))
         assert y.shape == [2, 2]
 
+    def test_sparse_unary_binary(self):
+        sp = pt.sparse
+        x = sp.sparse_coo_tensor([[0, 0, 1, 2], [0, 2, 1, 0]],
+                                 [1.0, -2.0, 3.0, -4.0], shape=[3, 3])
+        d = x.to_dense().numpy()
+        assert np.allclose(sp.abs(x).to_dense().numpy(), np.abs(d))
+        assert np.allclose(sp.tanh(x).to_dense().numpy(), np.tanh(d))
+        assert np.allclose(sp.relu(x).to_dense().numpy(), np.maximum(d, 0))
+        y = sp.sparse_coo_tensor([[0, 0, 1, 2], [0, 2, 1, 0]],
+                                 [1.0, 1.0, 1.0, 1.0], shape=[3, 3])
+        assert np.allclose(sp.add(x, y).to_dense().numpy(),
+                           d + y.to_dense().numpy())
+        assert sp.nnz(x) == 4
+
+    def test_sparse_masked_matmul_softmax(self):
+        sp = pt.sparse
+        x = sp.sparse_coo_tensor([[0, 0, 1, 2], [0, 2, 1, 0]],
+                                 [1.0, -2.0, 3.0, -4.0], shape=[3, 3])
+        y = sp.sparse_coo_tensor([[0, 0, 1, 2], [0, 2, 1, 0]],
+                                 [1.0, 1.0, 1.0, 1.0], shape=[3, 3])
+        rng = np.random.default_rng(1)
+        a = pt.to_tensor(rng.standard_normal((3, 4)).astype(np.float32))
+        b = pt.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+        mm = sp.masked_matmul(a, b, y)
+        full = a.numpy() @ b.numpy()
+        assert np.allclose(mm.to_dense().numpy(),
+                           np.where(y.to_dense().numpy() != 0, full, 0),
+                           atol=1e-5)
+        sm = sp.softmax(x)
+        row0 = np.exp(np.array([1.0, -2.0]) - 1.0)
+        row0 /= row0.sum()
+        assert np.allclose(sm.values().numpy()[:2], row0, atol=1e-6)
+        # transforms
+        d = x.to_dense().numpy()
+        assert np.allclose(sp.transpose(x, [1, 0]).to_dense().numpy(), d.T)
+        assert np.allclose(sp.reshape(x, [9]).to_dense().numpy(),
+                           d.reshape(9))
+
     def test_fft_matches_numpy(self):
         x = np.random.randn(32).astype(np.float32)
         ours = pt.fft.rfft(pt.to_tensor(x)).numpy()
