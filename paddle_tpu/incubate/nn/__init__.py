@@ -111,6 +111,22 @@ class functional:
         return NF.linear(x, weight, bias)
 
     @staticmethod
+    def fused_linear_cross_entropy(x, weight, labels, bias=None,
+                                   chunk_size=8192, reduction="mean",
+                                   ignore_index=-100, name=None):
+        """CE over x@weight without materializing (N, V) logits — the
+        LLM-vocab memory optimization (chunked online logsumexp fwd,
+        per-chunk softmax recompute bwd)."""
+        def fn(xr, w, lab, *rest):
+            b = rest[0] if rest else None
+            return _fused.fused_linear_cross_entropy(
+                xr.reshape(-1, xr.shape[-1]), w, lab.reshape(-1), bias=b,
+                chunk_size=chunk_size, reduction=reduction,
+                ignore_index=ignore_index)
+        args = (x, weight, labels) + ((bias,) if bias is not None else ())
+        return apply(fn, *args, name="fused_linear_cross_entropy")
+
+    @staticmethod
     def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                           name=None):
         from ..._core.state import prng

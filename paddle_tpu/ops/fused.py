@@ -28,3 +28,134 @@ def fused_dropout_add(x, residual, p, key, training=True):
         return x + residual
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
     return jnp.where(keep, x / (1.0 - p), 0.0) + residual
+
+
+# ---------------------------------------------------------------------------
+# fused linear + cross entropy: never materializes the (N, V) logits.
+# Reference: paddle/phi/kernels/fusion fused_linear_param_grad / PaddleNLP's
+# parallel_cross_entropy memory optimization. Chunked over vocab with an
+# online logsumexp; backward recomputes per-chunk softmax. HBM cost drops
+# from O(N·V) to O(N·chunk).
+# ---------------------------------------------------------------------------
+def _pad_vocab(weight, bias, chunk):
+    H, V = weight.shape
+    pad = (-V) % chunk
+    if pad:
+        weight = jnp.pad(weight, ((0, 0), (0, pad)))
+        if bias is not None:
+            bias = jnp.pad(bias, (0, pad))
+    return weight, bias, V + pad
+
+
+import functools
+import numpy as np
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flce(x, weight, bias, labels, chunk):
+    loss, _ = _flce_fwd_impl(x, weight, bias, labels, chunk)
+    return loss
+
+
+def _flce_fwd_impl(x, weight, bias, labels, chunk):
+    N, H = x.shape
+    wp, bp, Vp = _pad_vocab(weight, bias, chunk)
+    n_chunks = Vp // chunk
+    wc = wp.reshape(H, n_chunks, chunk).transpose(1, 0, 2)   # (C, H, chunk)
+    bc = bp.reshape(n_chunks, chunk) if bias is not None else None
+    xf = x.astype(jnp.float32)
+
+    V = weight.shape[1]
+
+    def body(carry, ci):
+        m, s, lab_logit = carry
+        w = wc[ci].astype(jnp.float32)
+        logits = xf @ w                                     # (N, chunk)
+        if bc is not None:
+            logits = logits + bc[ci]
+        base = ci * chunk
+        # padded vocab columns must not feed the logsumexp
+        logits = jnp.where(base + jnp.arange(chunk)[None, :] < V, logits,
+                           -1e30)
+        # pick out this chunk's label logits
+        in_chunk = (labels >= base) & (labels < base + chunk)
+        local = jnp.clip(labels - base, 0, chunk - 1)
+        picked = jnp.take_along_axis(logits, local[:, None], axis=1)[:, 0]
+        lab_logit = jnp.where(in_chunk, picked, lab_logit)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=1)
+        return (m_new, s, lab_logit), None
+
+    init = (jnp.full((N,), -1e30, jnp.float32),
+            jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32))
+    (m, s, lab_logit), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    return lse - lab_logit, lse
+
+
+def _flce_fwd(x, weight, bias, labels, chunk):
+    loss, lse = _flce_fwd_impl(x, weight, bias, labels, chunk)
+    return loss, (x, weight, bias, labels, lse)
+
+
+def _flce_bwd(chunk, res, g):
+    x, weight, bias, labels, lse = res
+    N, H = x.shape
+    wp, bp, Vp = _pad_vocab(weight, bias, chunk)
+    n_chunks = Vp // chunk
+    wc = wp.reshape(H, n_chunks, chunk).transpose(1, 0, 2)
+    bc = bp.reshape(n_chunks, chunk) if bias is not None else None
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+
+    V = weight.shape[1]
+
+    def body(dx, ci):
+        w = wc[ci].astype(jnp.float32)
+        logits = xf @ w
+        if bc is not None:
+            logits = logits + bc[ci]
+        base = ci * chunk
+        logits = jnp.where(base + jnp.arange(chunk)[None, :] < V, logits,
+                           -1e30)
+        p = jnp.exp(logits - lse[:, None])                  # softmax chunk
+        local = labels - base
+        onehot = (jnp.arange(chunk)[None, :] == local[:, None])
+        d_logits = (p - onehot) * gf[:, None]               # (N, chunk)
+        dx = dx + d_logits @ w.T
+        dw_c = xf.T @ d_logits                              # (H, chunk)
+        db_c = jnp.sum(d_logits, axis=0) if bc is not None else None
+        return dx, (dw_c, db_c)
+
+    dx0 = jnp.zeros((N, H), jnp.float32)
+    dx, (dw_chunks, db_chunks) = jax.lax.scan(body, dx0, jnp.arange(n_chunks))
+    V = weight.shape[1]
+    dw = dw_chunks.transpose(1, 0, 2).reshape(H, Vp)[:, :V]
+    db = db_chunks.reshape(Vp)[:V] if bias is not None else None
+    dlabels = np.zeros(labels.shape, dtype=jax.dtypes.float0)
+    return (dx.astype(x.dtype), dw.astype(weight.dtype),
+            db.astype(bias.dtype) if bias is not None else None, dlabels)
+
+
+_flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+def fused_linear_cross_entropy(x, weight, labels, bias=None, chunk_size=8192,
+                               reduction="mean", ignore_index=-100):
+    """CE(x @ weight + bias, labels) without materializing the logits.
+
+    x: (N, H) hidden states; weight: (H, V); labels: (N,) int.
+    """
+    labels = labels.astype(jnp.int32)
+    chunk = min(chunk_size, weight.shape[1])
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    loss = _flce(x, weight, bias, safe_labels, chunk)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid), 1)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

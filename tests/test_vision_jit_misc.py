@@ -292,6 +292,34 @@ class TestIncubate:
             q, q, None, sin=pt.to_tensor(sin), cos=pt.to_tensor(cos))
         assert qo.shape == [2, 4, 8, 16]
 
+    def test_fused_linear_cross_entropy(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.fused import fused_linear_cross_entropy
+        rng = np.random.default_rng(0)
+        N, H, V = 8, 16, 300
+        x = jnp.asarray(rng.standard_normal((N, H)).astype(np.float32))
+        w = jnp.asarray(rng.standard_normal((H, V)).astype(np.float32) * 0.1)
+        y = jnp.asarray(rng.integers(0, V, (N,)))
+
+        def ref(x, w):
+            logits = x @ w
+            lse = jax.scipy.special.logsumexp(logits, axis=1)
+            return jnp.mean(lse - logits[jnp.arange(N), y])
+
+        f = lambda x, w: fused_linear_cross_entropy(x, w, y, chunk_size=128)
+        assert abs(float(f(x, w) - ref(x, w))) < 1e-5
+        gf = jax.grad(f, argnums=(0, 1))(x, w)
+        gr = jax.grad(ref, argnums=(0, 1))(x, w)
+        for a, b in zip(gf, gr):
+            assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+        # incubate surface (eager Tensors)
+        from paddle_tpu.incubate.nn import functional as IF
+        out = IF.fused_linear_cross_entropy(
+            pt.to_tensor(np.asarray(x)), pt.to_tensor(np.asarray(w)),
+            pt.to_tensor(np.asarray(y)), chunk_size=64)
+        assert abs(float(out) - float(ref(x, w))) < 1e-5
+
     def test_fused_moe_layer(self):
         from paddle_tpu.incubate.nn import FusedMoE
         moe = FusedMoE(16, 32, num_experts=4, top_k=2)
