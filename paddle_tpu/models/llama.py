@@ -30,7 +30,7 @@ from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
 
 
-@dataclass
+@dataclass(unsafe_hash=True)  # hashable → usable as a static jit arg
 class LlamaConfig:
     vocab_size: int = 32000
     hidden_size: int = 4096

@@ -1,0 +1,290 @@
+"""Llama serving engine: continuous batching over a paged KV cache.
+
+Reference parity: the reference's serving stack (PaddleNLP predictor with
+block_multihead_attention + BlockManager) admits/evicts requests mid-
+flight, storing KV in fixed-size blocks. TPU-native redesign:
+
+  * one jitted `prefill` (dense causal flash attention, bucketed prompt
+    lengths to bound recompiles) that also returns per-layer K/V to be
+    scattered into the page pool;
+  * one jitted `decode_step` for the WHOLE active batch: lax.scan over
+    the stacked layer params, paged-attention pallas kernel per layer,
+    functional scatter of the new token's K/V into the pool (inactive
+    slots write to a reserved trash page);
+  * host-side PagedKVCache free-list bookkeeping between steps — slots
+    join/leave the batch without recompilation (page_table/lengths are
+    plain inputs).
+
+All shapes static: batch = max_seqs always; inactive slots are masked.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.rope import rope_cos_sin, apply_rotary_emb
+from ..ops.flash_attention import flash_attention_bhsd
+from ..ops.paged_attention import paged_attention
+from .llama import LlamaConfig
+
+
+def _rms(x, w, eps):
+    xf = x.astype(jnp.float32)
+    return (xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+            * w.astype(jnp.float32)).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# jitted compute
+# ---------------------------------------------------------------------------
+@functools.partial(jax.jit, static_argnames=("config", "use_pallas"))
+def prefill(params, input_ids, length, config: LlamaConfig, use_pallas=False):
+    """input_ids: (1, S_padded); length: () actual prompt length.
+    Returns (next_logits (V,), k_all, v_all: (L, KVH, S_padded, D))."""
+    c = config
+    nh, nkv = c.num_attention_heads, c.num_key_value_heads
+    hd = c.hidden_size // nh
+    b, s = input_ids.shape
+    cos, sin = rope_cos_sin(s, hd, base=c.rope_theta, dtype=jnp.float32)
+    h = jnp.take(params["embed"], input_ids, axis=0)
+
+    def layer(h, lp):
+        x = _rms(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(b, s, nh, hd).swapaxes(1, 2)
+        k = (x @ lp["wk"]).reshape(b, s, nkv, hd).swapaxes(1, 2)
+        v = (x @ lp["wv"]).reshape(b, s, nkv, hd).swapaxes(1, 2)
+        q, k = apply_rotary_emb(q, k, cos[None, None], sin[None, None])
+        rep = nh // nkv
+        kr = jnp.repeat(k, rep, axis=1) if rep > 1 else k
+        vr = jnp.repeat(v, rep, axis=1) if rep > 1 else v
+        o = flash_attention_bhsd(q, kr, vr, causal=True,
+                                 use_pallas=use_pallas)
+        h = h + o.swapaxes(1, 2).reshape(b, s, -1) @ lp["wo"]
+        x = _rms(h, lp["ln2"], c.rms_norm_eps)
+        mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return h + mlp, (k[0], v[0])
+
+    h, kv = jax.lax.scan(layer, h, params["layers"])
+    h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    logits = h[0, length - 1] @ params["lm_head"]
+    return logits, kv[0], kv[1]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "use_pallas", "page_size",
+                                    "interpret"))
+def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
+                active, config: LlamaConfig, page_size, use_pallas=False,
+                interpret=False):
+    """One token for every slot.
+
+    k_pool/v_pool: (L, KVH, P, page, D); tokens: (B,) current input token;
+    lengths: (B,) length INCLUDING the current token; active: (B,) bool.
+    Returns (k_pool, v_pool, logits (B, V)).
+    """
+    c = config
+    nh, nkv = c.num_attention_heads, c.num_key_value_heads
+    hd = c.hidden_size // nh
+    B = tokens.shape[0]
+    P = k_pool.shape[2]
+
+    pos = jnp.maximum(lengths - 1, 0)                       # (B,)
+    cos, sin = rope_cos_sin(None, hd, base=c.rope_theta,
+                            position_ids=pos[:, None])      # (B, 1, hd)
+    h = jnp.take(params["embed"], tokens[:, None], axis=0)  # (B, 1, H)
+
+    page_ids = page_table[jnp.arange(B), pos // page_size]
+    page_ids = jnp.where(active, page_ids, P - 1)           # trash page
+    off = pos % page_size
+
+    def layer(carry, xs):
+        h, kp, vp = carry
+        lp, li = xs
+        x = _rms(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, 1, nh, hd).swapaxes(1, 2)
+        k = (x @ lp["wk"]).reshape(B, 1, nkv, hd).swapaxes(1, 2)
+        v = (x @ lp["wv"]).reshape(B, 1, nkv, hd).swapaxes(1, 2)
+        q, k = apply_rotary_emb(q, k, cos[:, None], sin[:, None])
+        # write this token's K/V: (B, KVH, D) → pool[li][:, page_ids, off]
+        kl = jax.lax.dynamic_index_in_dim(kp, li, 0, keepdims=False)
+        vl = jax.lax.dynamic_index_in_dim(vp, li, 0, keepdims=False)
+        kt = k[:, :, 0].swapaxes(0, 1).astype(kp.dtype)     # (KVH, B, D)
+        vt = v[:, :, 0].swapaxes(0, 1).astype(vp.dtype)
+        kl = kl.at[:, page_ids, off].set(kt)
+        vl = vl.at[:, page_ids, off].set(vt)
+        kp = jax.lax.dynamic_update_index_in_dim(kp, kl, li, 0)
+        vp = jax.lax.dynamic_update_index_in_dim(vp, vl, li, 0)
+        o = paged_attention(q[:, :, 0], kl, vl, page_table, lengths,
+                            use_pallas=use_pallas,
+                            interpret=interpret)            # (B, QH, D)
+        h = h + o.reshape(B, 1, -1) @ lp["wo"]
+        x = _rms(h, lp["ln2"], c.rms_norm_eps)
+        mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return (h + mlp, kp, vp), None
+
+    L = k_pool.shape[0]
+    (h, k_pool, v_pool), _ = jax.lax.scan(
+        layer, (h, k_pool, v_pool), (params["layers"], jnp.arange(L)))
+    h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    logits = h[:, 0] @ params["lm_head"]
+    return k_pool, v_pool, logits
+
+
+# ---------------------------------------------------------------------------
+# engine (host-side orchestration)
+# ---------------------------------------------------------------------------
+class Request:
+    def __init__(self, rid, prompt_ids, max_new_tokens=64, eos_id=None):
+        self.rid = rid
+        self.prompt = list(prompt_ids)
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.output = []
+        self.slot = None
+        self.next_token = None
+
+    @property
+    def done(self):
+        return (len(self.output) >= self.max_new_tokens or
+                (self.eos_id is not None and self.output and
+                 self.output[-1] == self.eos_id))
+
+
+class ServingEngine:
+    """Continuous-batching decode loop over the paged cache."""
+
+    def __init__(self, params, config: LlamaConfig, max_seqs=4,
+                 max_seq_len=512, page_size=16, dtype=jnp.float32,
+                 use_pallas=None, interpret=False):
+        c = config
+        self.params = params
+        self.config = c
+        self.page_size = page_size
+        self.max_seqs = max_seqs
+        self.pages_per_seq = -(-max_seq_len // page_size)
+        # +1 trash page for masked writes of inactive slots
+        num_pages = max_seqs * self.pages_per_seq + 1
+        kvh = c.num_key_value_heads
+        hd = c.hidden_size // c.num_attention_heads
+        L = c.num_hidden_layers
+        self.k_pool = jnp.zeros((L, kvh, num_pages, page_size, hd), dtype)
+        self.v_pool = jnp.zeros((L, kvh, num_pages, page_size, hd), dtype)
+        self.page_table = jnp.zeros((max_seqs, self.pages_per_seq), jnp.int32)
+        self.lengths = jnp.zeros((max_seqs,), jnp.int32)
+        # trash page (last) never enters the free list
+        self._free = list(range(num_pages - 2, -1, -1))
+        self._seq_pages = {s: [] for s in range(max_seqs)}
+        self._slots = [None] * max_seqs          # slot -> Request
+        self._waiting = []
+        self.finished = []
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        self._use_pallas = use_pallas
+        self._interpret = interpret
+
+    # -- request admission ------------------------------------------------
+    def submit(self, req: Request):
+        self._waiting.append(req)
+
+    def _admit(self):
+        for slot in range(self.max_seqs):
+            if self._slots[slot] is not None or not self._waiting:
+                continue
+            req = self._waiting.pop(0)
+            self._prefill_into(slot, req)
+
+    def _alloc_pages(self, slot, n):
+        if len(self._free) < n:
+            raise RuntimeError("serving: out of KV pages")
+        if len(self._seq_pages[slot]) + n > self.pages_per_seq:
+            raise RuntimeError("serving: sequence exceeds max_seq_len")
+        pages = [self._free.pop() for _ in range(n)]
+        self._seq_pages[slot].extend(pages)
+        start = len(self._seq_pages[slot]) - n
+        for i, pg in enumerate(pages):
+            self.page_table = self.page_table.at[slot, start + i].set(pg)
+        return pages
+
+    def _prefill_into(self, slot, req: Request):
+        c = self.config
+        S = len(req.prompt)
+        bucket = max(self.page_size,
+                     1 << math.ceil(math.log2(max(S, 1))))
+        ids = np.zeros((1, bucket), np.int64)
+        ids[0, :S] = req.prompt
+        logits, k_all, v_all = prefill(self.params, jnp.asarray(ids),
+                                       jnp.asarray(S), c,
+                                       use_pallas=self._use_pallas)
+        # scatter prompt K/V into freshly-allocated pages
+        n_pages = -(-S // self.page_size)
+        self._seq_pages[slot] = []
+        pages = self._alloc_pages(slot, n_pages)
+        pos = np.arange(S)
+        pg = np.asarray(pages)[pos // self.page_size]
+        off = pos % self.page_size
+        kq = k_all[:, :, :S].astype(self.k_pool.dtype)  # (L, KVH, S, D)
+        vq = v_all[:, :, :S].astype(self.v_pool.dtype)
+        self.k_pool = self.k_pool.at[:, :, pg, off].set(kq)
+        self.v_pool = self.v_pool.at[:, :, pg, off].set(vq)
+        self.lengths = self.lengths.at[slot].set(S)
+        req.slot = slot
+        first = int(jnp.argmax(logits))
+        req.next_token = first
+        req.output.append(first)
+        self._slots[slot] = req
+        if req.done:  # e.g. max_new_tokens == 1
+            self.finished.append(req)
+            self._release(slot)
+
+    # -- decode loop ------------------------------------------------------
+    def step(self):
+        """One decode step for all active slots; returns #active."""
+        self._admit()
+        active_slots = [s for s, r in enumerate(self._slots) if r is not None]
+        if not active_slots:
+            return 0
+        tokens = np.zeros((self.max_seqs,), np.int64)
+        for s in active_slots:
+            req = self._slots[s]
+            # the token being fed needs a cache position: extend first
+            cur = int(self.lengths[s])
+            if cur % self.page_size == 0 and cur > 0 and \
+                    len(self._seq_pages[s]) * self.page_size <= cur:
+                self._alloc_pages(s, 1)
+            tokens[s] = req.next_token
+        active = np.zeros((self.max_seqs,), bool)
+        active[active_slots] = True
+        self.lengths = jnp.where(jnp.asarray(active), self.lengths + 1,
+                                 self.lengths)
+        self.k_pool, self.v_pool, logits = decode_step(
+            self.params, self.k_pool, self.v_pool, self.page_table,
+            self.lengths, jnp.asarray(tokens), jnp.asarray(active),
+            self.config, self.page_size, use_pallas=self._use_pallas,
+            interpret=self._interpret)
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        for s in active_slots:
+            req = self._slots[s]
+            req.output.append(int(nxt[s]))
+            req.next_token = int(nxt[s])
+            if req.done:
+                self.finished.append(req)
+                self._release(s)
+        return len(active_slots)
+
+    def _release(self, slot):
+        self._free.extend(reversed(self._seq_pages[slot]))
+        self._seq_pages[slot] = []
+        self.lengths = self.lengths.at[slot].set(0)
+        self._slots[slot] = None
+
+    def run(self, max_steps=10000):
+        steps = 0
+        while (any(r is not None for r in self._slots) or self._waiting) \
+                and steps < max_steps:
+            self.step()
+            steps += 1
+        return self.finished
