@@ -165,22 +165,172 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
 def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
              clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
              iou_aware_factor=0.5):
-    raise NotImplementedError("yolo_box: detection family planned (round 2)")
+    """YOLOv3 head decode (reference: paddle/phi/kernels/impl/yolo_box —
+    rebuilt as one fused XLA graph, no per-cell loops)."""
+    na = len(anchors) // 2
+    anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+
+    def fn(xr, imsz):
+        n, _, h, w = xr.shape
+        attrs = 5 + class_num
+        if iou_aware:
+            ious = jax.nn.sigmoid(xr[:, :na].reshape(n, na, 1, h, w))
+            xr = xr[:, na:]
+        p = xr.reshape(n, na, attrs, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        bx = (jax.nn.sigmoid(p[:, :, 0]) * alpha + beta + gx) / w
+        by = (jax.nn.sigmoid(p[:, :, 1]) * alpha + beta + gy) / h
+        input_sz = downsample_ratio * jnp.asarray([h, w], jnp.float32)
+        bw = jnp.exp(p[:, :, 2]) * anc[None, :, None, None, 0] / input_sz[1]
+        bh = jnp.exp(p[:, :, 3]) * anc[None, :, None, None, 1] / input_sz[0]
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                ious[:, :, 0] ** iou_aware_factor
+        cls = jax.nn.sigmoid(p[:, :, 5:]) * conf[:, :, None]
+        keep = conf > conf_thresh
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * imw
+        y1 = (by - bh / 2) * imh
+        x2 = (bx + bw / 2) * imw
+        y2 = (by + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)       # (N,na,h,w,4)
+        boxes = jnp.where(keep[..., None], boxes, 0.0)
+        scores = jnp.where(keep[..., None], jnp.moveaxis(cls, 2, -1), 0.0)
+        return (boxes.reshape(n, -1, 4),
+                scores.reshape(n, -1, class_num))
+    return apply(fn, x, img_size, name="yolo_box", multi=True)
 
 
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
               ignore_thresh, downsample_ratio, **kw):
-    raise NotImplementedError("yolo_loss: detection family planned (round 2)")
+    raise NotImplementedError(
+        "yolo_loss: use the generic detection losses; the fused CUDA "
+        "yolo_loss has no TPU counterpart yet")
 
 
-def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
-                  deformable_groups=1, groups=1, mask=None, name=None):
-    raise NotImplementedError("deform_conv2d: planned (round 2; gather-based)")
+def _bilinear_sample(img, py, px):
+    """img: (C, H, W); py/px: (...,) float sample grids (zero padding
+    outside). Returns (C, ...)."""
+    c, h, w = img.shape
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+    out = 0.0
+    for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
+                        (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
+        yy = y0 + dy
+        xx = x0 + dx
+        inside = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+        yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        v = img[:, yi, xi]                       # (C, ...)
+        out = out + jnp.where(inside, wgt, 0.0)[None] * v
+    return out
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference: phi deformable_conv kernels).
+    Gather-based: bilinear-sample every kernel tap at its offset position,
+    then contract with an einsum — both map onto TPU gathers + MXU."""
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    if groups != 1:
+        raise NotImplementedError("deform_conv2d: groups>1 TBD")
+
+    def fn(xr, off, wgt, *rest):
+        msk = rest[0] if mask is not None else None
+        n, c, h, w = xr.shape
+        cout, cin, kh, kw = wgt.shape
+        ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        wo = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        dg = deformable_groups
+        off = off.reshape(n, dg, kh * kw, 2, ho, wo)
+        base_y = (jnp.arange(ho) * sh - ph)[:, None]
+        base_x = (jnp.arange(wo) * sw - pw)[None, :]
+        ky = (jnp.arange(kh) * dh)[:, None].reshape(-1)
+        kxs = jnp.tile(jnp.arange(kw) * dw, kh)
+        kys = jnp.repeat(jnp.arange(kh) * dh, kw)
+        del ky
+        # sample positions: (dg, kh*kw, ho, wo)
+        py = base_y[None, None] + kys[None, :, None, None] + off[:, :, :, 0]
+        px = base_x[None, None] + kxs[None, :, None, None] + off[:, :, :, 1]
+
+        cg = c // dg
+
+        def per_image(img, py_i, px_i, msk_i):
+            # img (C,H,W); py_i (dg, K, ho, wo)
+            groups_out = []
+            for g in range(dg):
+                sampled = _bilinear_sample(img[g * cg:(g + 1) * cg],
+                                           py_i[g], px_i[g])  # (cg,K,ho,wo)
+                if msk_i is not None:
+                    sampled = sampled * msk_i[g][None]
+                groups_out.append(sampled)
+            return jnp.concatenate(groups_out, axis=0)        # (C,K,ho,wo)
+
+        msk_r = msk.reshape(n, dg, kh * kw, ho, wo) if msk is not None \
+            else None
+        sampled = jax.vmap(per_image)(
+            xr, py, px, msk_r) if msk_r is not None else jax.vmap(
+            lambda im, a, b: per_image(im, a, b, None))(xr, py, px)
+        # (N, C, K, ho, wo) × (Cout, C, K) → (N, Cout, ho, wo)
+        out = jnp.einsum("nckhw,ock->nohw", sampled,
+                         wgt.reshape(cout, cin, kh * kw))
+        if rest and bias is not None:
+            out = out + rest[-1].reshape(1, -1, 1, 1)
+        return out
+
+    args = (x, offset, weight)
+    if mask is not None:
+        args = args + (mask,)
+    if bias is not None:
+        args = args + (bias,)
+    return apply(fn, *args, name="deform_conv2d")
 
 
 class DeformConv2D:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("DeformConv2D: planned (round 2)")
+    """Layer wrapper (reference: python/paddle/vision/ops.py DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+        kh, kw = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        fan_in = in_channels * kh * kw
+        bound = 1.0 / np.sqrt(fan_in)
+        rng = np.random.default_rng(0)
+        from .._core.tensor import Tensor as _T
+        self.weight = _T(jnp.asarray(rng.uniform(
+            -bound, bound, (out_channels, in_channels // groups, kh, kw))
+            .astype(np.float32)), stop_gradient=False)
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = _T(jnp.zeros((out_channels,), jnp.float32),
+                           stop_gradient=False)
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
@@ -199,10 +349,98 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     return outs, Tensor(jnp.asarray(restore)), None
 
 
-def generate_proposals(*a, **k):
-    raise NotImplementedError("generate_proposals: planned (round 2)")
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (host-side; data-dependent sizes like the
+    reference's CPU/GPU kernel output). scores: (N, A, H, W);
+    bbox_deltas: (N, 4A, H, W); anchors/variances: (H, W, A, 4)."""
+    sc = np.asarray(unwrap(scores))
+    bd = np.asarray(unwrap(bbox_deltas))
+    ims = np.asarray(unwrap(img_size))
+    anc = np.asarray(unwrap(anchors)).reshape(-1, 4)
+    var = np.asarray(unwrap(variances)).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    all_rois, all_num, all_scores = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)            # HWA
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, an, vr = s[order], d[order], anc[order], var[order]
+        aw = an[:, 2] - an[:, 0] + (1 if pixel_offset else 0)
+        ah = an[:, 3] - an[:, 1] + (1 if pixel_offset else 0)
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = vr[:, 0] * d[:, 0] * aw + acx
+        cy = vr[:, 1] * d[:, 1] * ah + acy
+        bw = np.exp(np.minimum(vr[:, 2] * d[:, 2], 10.0)) * aw
+        bh = np.exp(np.minimum(vr[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                          cy + bh / 2], axis=1)
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, ims[i, 1] - 1)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ims[i, 0] - 1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+              (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[ok], s[ok]
+        keep = np.asarray(unwrap(nms(Tensor(jnp.asarray(boxes)),
+                                     nms_thresh,
+                                     Tensor(jnp.asarray(s)))))[:post_nms_top_n]
+        all_rois.append(boxes[keep])
+        all_scores.append(s[keep])
+        all_num.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois)
+                              if all_rois else np.zeros((0, 4), np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(all_scores)))
+    rnum = Tensor(jnp.asarray(np.asarray(all_num, np.int32)))
+    if return_rois_num:
+        return rois, rscores, rnum
+    return rois, rscores
 
 
 class PSRoIPool:
-    def __init__(self, *a, **k):
-        raise NotImplementedError("PSRoIPool: planned (round 2)")
+    """Position-sensitive RoI pooling (reference: phi psroi_pool kernel):
+    input channels C = out_channels·ph·pw; bin (i,j) pools only its own
+    channel slice — the R-FCN head."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = (output_size, output_size) \
+            if isinstance(output_size, int) else output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        out_h, out_w = self.output_size
+        scale = self.spatial_scale
+
+        def fn(feat, bxs):
+            n, c, h, w = feat.shape
+            oc = c // (out_h * out_w)
+            bn = np.asarray(unwrap(boxes_num))
+            batch_ids = np.repeat(np.arange(len(bn)), bn)
+            fm_bins = feat.reshape(n, oc, out_h, out_w, h, w)
+            ys = []
+            for bi in range(bxs.shape[0]):
+                x1, y1, x2, y2 = bxs[bi] * scale
+                bh = jnp.maximum(y2 - y1, 0.1) / out_h
+                bw = jnp.maximum(x2 - x1, 0.1) / out_w
+                fm = fm_bins[int(batch_ids[bi])]
+                rows = []
+                for oy in range(out_h):
+                    row = []
+                    for ox in range(out_w):
+                        # average over the bin via a mask (static shapes;
+                        # empty bins → 0)
+                        gy = jnp.arange(h, dtype=jnp.float32)
+                        gx = jnp.arange(w, dtype=jnp.float32)
+                        my = ((gy >= jnp.floor(y1 + oy * bh)) &
+                              (gy < jnp.ceil(y1 + (oy + 1) * bh)))
+                        mx = ((gx >= jnp.floor(x1 + ox * bw)) &
+                              (gx < jnp.ceil(x1 + (ox + 1) * bw)))
+                        m = my[:, None] & mx[None, :]
+                        cnt = jnp.maximum(jnp.sum(m), 1)
+                        v = jnp.sum(fm[:, oy, ox] * m[None], axis=(1, 2)) / cnt
+                        row.append(v)
+                    rows.append(jnp.stack(row, -1))
+                ys.append(jnp.stack(rows, -2))
+            return jnp.stack(ys)
+        return apply(fn, x, boxes, name="psroi_pool")

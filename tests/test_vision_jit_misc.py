@@ -134,6 +134,77 @@ class TestVisionTransformsDatasets:
         x, y = next(iter(dl))
         assert x.shape == [8, 3, 32, 32]
 
+    def test_yolo_box_decode(self):
+        from paddle_tpu.vision import ops as V
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((1, 2 * 8, 2, 2)).astype(np.float32)
+        img = np.array([[64, 64]], np.int64)
+        boxes, scores = V.yolo_box(pt.to_tensor(x), pt.to_tensor(img),
+                                   [10, 14, 23, 27], 3, 0.01, 32)
+        assert boxes.shape == [1, 8, 4] and scores.shape == [1, 8, 3]
+        p = x.reshape(2, 8, 2, 2)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        bx = sig(p[0, 0, 0, 0]) / 2
+        bw = np.exp(p[0, 2, 0, 0]) * 10 / 64
+        x1 = np.clip((bx - bw / 2) * 64, 0, 63)
+        got = boxes.numpy().reshape(2, 2, 2, 4)[0, 0, 0]
+        if sig(p[0, 4, 0, 0]) > 0.01:
+            assert abs(got[0] - x1) < 1e-4
+
+    def test_deform_conv_zero_offset_equals_conv(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.vision import ops as V
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+        w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+        off = np.zeros((2, 18, 8, 8), np.float32)
+        out = V.deform_conv2d(pt.to_tensor(x), pt.to_tensor(off),
+                              pt.to_tensor(w), padding=1)
+        ref = jax.lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        assert np.abs(out.numpy() - np.asarray(ref)).max() < 1e-3
+        # modulated (v2): constant 0.5 mask halves the output
+        msk = np.full((2, 9, 8, 8), 0.5, np.float32)
+        out2 = V.deform_conv2d(pt.to_tensor(x), pt.to_tensor(off),
+                               pt.to_tensor(w), padding=1,
+                               mask=pt.to_tensor(msk))
+        assert np.allclose(out2.numpy(), 0.5 * out.numpy(), atol=1e-4)
+
+    def test_psroi_pool(self):
+        from paddle_tpu.vision import ops as V
+        rng = np.random.default_rng(0)
+        feat = rng.standard_normal((1, 8, 8, 8)).astype(np.float32)
+        out = V.PSRoIPool(2, 1.0)(
+            pt.to_tensor(feat),
+            pt.to_tensor(np.array([[0., 0., 8., 8.]], np.float32)),
+            pt.to_tensor(np.array([1])))
+        f = feat.reshape(2, 2, 2, 8, 8)
+        assert np.allclose(out.numpy()[0, :, 0, 0],
+                           f[:, 0, 0, 0:4, 0:4].mean(axis=(1, 2)), atol=1e-5)
+
+    def test_generate_proposals(self):
+        from paddle_tpu.vision import ops as V
+        rng = np.random.default_rng(0)
+        A, H, W = 3, 4, 4
+        sc = rng.random((1, A, H, W)).astype(np.float32)
+        bd = (rng.standard_normal((1, 4 * A, H, W)) * 0.1).astype(np.float32)
+        anc = np.zeros((H, W, A, 4), np.float32)
+        for yy in range(H):
+            for xx in range(W):
+                for aa in range(A):
+                    anc[yy, xx, aa] = [xx * 8, yy * 8, xx * 8 + 16 + 8 * aa,
+                                       yy * 8 + 16 + 8 * aa]
+        var = np.full((H, W, A, 4), 1.0, np.float32)
+        rois, rsc, rn = V.generate_proposals(
+            pt.to_tensor(sc), pt.to_tensor(bd),
+            pt.to_tensor(np.array([[32., 32.]])), pt.to_tensor(anc),
+            pt.to_tensor(var), return_rois_num=True)
+        assert rois.shape[0] == int(rn.numpy()[0]) > 0
+        b = rois.numpy()
+        assert (b[:, 2] >= b[:, 0]).all() and (b[:, :2] >= 0).all()
+
     def test_nms(self):
         from paddle_tpu.vision.ops import nms
         boxes = pt.to_tensor(np.array([[0, 0, 10, 10], [1, 1, 11, 11],
