@@ -32,7 +32,7 @@ from .layer.loss import (  # noqa: F401
     BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, HingeEmbeddingLoss,
     CosineEmbeddingLoss, TripletMarginLoss, TripletMarginWithDistanceLoss,
     MultiLabelSoftMarginLoss, SoftMarginLoss, MultiMarginLoss, CTCLoss,
-    PoissonNLLLoss, GaussianNLLLoss,
+    RNNTLoss, PoissonNLLLoss, GaussianNLLLoss,
 )
 from .layer.rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, SimpleRNN, LSTM, GRU,

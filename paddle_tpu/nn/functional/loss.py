@@ -325,9 +325,62 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     return apply(fn, log_probs, labels, input_lengths, label_lengths, name="ctc_loss")
 
 
-def rnnt_loss(input, label, input_lengths, label_lengths, blank=0, fastemit_lambda=0.001,
-              reduction="mean", name=None):
-    raise NotImplementedError("rnnt_loss: planned (round 2)")
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: phi warprnnt kernel wrapper).
+
+    input: (B, Tmax, Umax+1, V) joint-network logits; label: (B, Umax).
+    Forward-variable lattice DP in the log semiring via lax.scan over T
+    (the in-row u-recurrence is a second scan) — static shapes, jittable.
+    """
+    def fn(logits, lab, t_len, u_len):
+        B, T, U1, V = logits.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+        bidx = jnp.arange(B)
+        # emission prob of label u at lattice node (t, u): (B, T, U)
+        lab_i = lab.astype(jnp.int32)
+        emit = jnp.take_along_axis(
+            logp[:, :, :U], lab_i[:, None, :, None], axis=3)[..., 0]
+        blank_p = logp[..., blank]                     # (B, T, U+1)
+        if fastemit_lambda:
+            emit = emit + jnp.log1p(jnp.asarray(fastemit_lambda, jnp.float32))
+
+        u_range = jnp.arange(U1)
+        u_valid = u_range[None, :] <= u_len[:, None]   # (B, U+1)
+
+        def row_scan(a_prev, t):
+            # A(u) = alpha(t-1, u) + blank(t-1, u)
+            A = a_prev + blank_p[:, t - 1]
+            # x_u = logaddexp(A_u, x_{u-1} + emit(t, u-1)): scan over u
+            def inner(x_prev, u):
+                e = jnp.where(u >= 1, emit[:, t, jnp.maximum(u - 1, 0)],
+                              neg_inf)
+                x = jnp.logaddexp(A[:, u], x_prev + e)
+                return x, x
+            x0 = jnp.full((B,), neg_inf)
+            # u = 0 row: only the vertical (blank) path
+            _, xs = jax.lax.scan(inner, A[:, 0], u_range[1:])
+            row = jnp.concatenate([A[:, 0][None], xs], axis=0).T  # (B, U+1)
+            row = jnp.where(u_valid, row, neg_inf)
+            row = jnp.where((t < t_len)[:, None], row, a_prev)
+            return row, None
+
+        # t = 0 row: alpha(0, u) = sum of emits along u
+        first = jnp.concatenate(
+            [jnp.zeros((B, 1)), jnp.cumsum(emit[:, 0], axis=1)], axis=1)
+        first = jnp.where(u_valid, first, neg_inf)
+        alpha, _ = jax.lax.scan(row_scan, first, jnp.arange(1, T))
+        # ll = alpha(T-1, U) + blank(T-1, U) at each sequence's true ends
+        a_final = alpha[bidx, u_len]
+        ll = a_final + blank_p[bidx, t_len - 1, u_len]
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return _reduce(loss, reduction)
+    return apply(fn, input, label, input_lengths, label_lengths,
+                 name="rnnt_loss")
 
 
 def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,

@@ -287,3 +287,37 @@ class TestLosses:
         loss = F.ctc_loss(logp, labels, in_len, lab_len)
         assert np.isfinite(float(loss))
         loss.backward()
+
+    def test_rnnt_loss_matches_dp_reference(self):
+        import scipy.special
+
+        def dp(logp, lab, T, U, blank=0):
+            alpha = np.full((T, U + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for u in range(1, U + 1):
+                alpha[0, u] = alpha[0, u - 1] + logp[0, u - 1, lab[u - 1]]
+            for t in range(1, T):
+                alpha[t, 0] = alpha[t - 1, 0] + logp[t - 1, 0, blank]
+                for u in range(1, U + 1):
+                    alpha[t, u] = np.logaddexp(
+                        alpha[t - 1, u] + logp[t - 1, u, blank],
+                        alpha[t, u - 1] + logp[t, u - 1, lab[u - 1]])
+            return alpha[T - 1, U] + logp[T - 1, U, blank]
+
+        rng = np.random.default_rng(0)
+        B, T, U, C = 2, 4, 3, 5
+        logits = rng.standard_normal((B, T, U + 1, C)).astype(np.float32)
+        lab = rng.integers(1, C, (B, U))
+        tl, ul = np.array([4, 3]), np.array([3, 2])
+        out = F.rnnt_loss(pt.to_tensor(logits), pt.to_tensor(lab),
+                          pt.to_tensor(tl), pt.to_tensor(ul),
+                          fastemit_lambda=0.0, reduction="none")
+        lp = scipy.special.log_softmax(logits, axis=-1)
+        refs = [-dp(lp[0, :4, :4], lab[0], 4, 3),
+                -dp(lp[1, :3, :3], lab[1, :2], 3, 2)]
+        assert np.allclose(out.numpy(), refs, atol=1e-4)
+        x = pt.to_tensor(logits, stop_gradient=False)
+        loss = pt.nn.RNNTLoss(fastemit_lambda=0.0)(
+            x, pt.to_tensor(lab), pt.to_tensor(tl), pt.to_tensor(ul))
+        loss.backward()
+        assert np.isfinite(x.grad.numpy()).all()
