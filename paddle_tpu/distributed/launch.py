@@ -1,0 +1,73 @@
+"""paddle.distributed.launch parity for TPU pods.
+
+Reference: python/paddle/distributed/launch (per-GPU process spawn, elastic
+restarts). TPU redesign: JAX is single-controller-per-host — one process
+drives all local chips — so "launch" means per-HOST process bootstrap:
+
+    python -m paddle_tpu.distributed.launch \
+        --nnodes 4 --node_rank $RANK --coordinator host0:8476 train.py ...
+
+sets the jax.distributed env (JAX_COORDINATOR_ADDRESS / NUM_PROCESSES /
+PROCESS_ID) and execs the script; `init_parallel_env()` inside the script
+completes the rendezvous. `--max_restarts N` gives elastic fault
+tolerance: a crashed trainer is relaunched (it resumes from its own
+checkpoints — see utils.checkpoint save_state/load_state).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+
+def build_env(nnodes, node_rank, coordinator, base_env=None):
+    env = dict(base_env if base_env is not None else os.environ)
+    if nnodes > 1:
+        env["JAX_COORDINATOR_ADDRESS"] = coordinator
+        env["JAX_NUM_PROCESSES"] = str(nnodes)
+        env["JAX_PROCESS_ID"] = str(node_rank)
+        # paddle-style aliases some user code expects
+        env["PADDLE_TRAINERS_NUM"] = str(nnodes)
+        env["PADDLE_TRAINER_ID"] = str(node_rank)
+    return env
+
+
+def run(script_argv, nnodes=1, node_rank=0, coordinator="127.0.0.1:8476",
+        max_restarts=0, restart_backoff=3.0, env=None):
+    """Run the training script; returns its final exit code."""
+    child_env = build_env(nnodes, node_rank, coordinator, env)
+    attempt = 0
+    while True:
+        proc = subprocess.run([sys.executable] + list(script_argv),
+                              env=child_env)
+        if proc.returncode == 0 or attempt >= max_restarts:
+            return proc.returncode
+        attempt += 1
+        print(f"[launch] trainer exited rc={proc.returncode}; "
+              f"restart {attempt}/{max_restarts} in {restart_backoff}s",
+              file=sys.stderr)
+        time.sleep(restart_backoff)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=int, default=int(
+        os.environ.get("PADDLE_TRAINERS_NUM", 1)))
+    p.add_argument("--node_rank", type=int, default=int(
+        os.environ.get("PADDLE_TRAINER_ID", 0)))
+    p.add_argument("--coordinator", "--master", dest="coordinator",
+                   default=os.environ.get("PADDLE_MASTER",
+                                          "127.0.0.1:8476"))
+    p.add_argument("--max_restarts", type=int, default=0)
+    p.add_argument("script", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.script:
+        p.error("no training script given")
+    return run(args.script, args.nnodes, args.node_rank, args.coordinator,
+               args.max_restarts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

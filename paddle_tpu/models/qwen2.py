@@ -1,0 +1,128 @@
+"""Qwen2 family (reference: PaddleNLP paddlenlp/transformers/qwen2).
+
+Architecturally Llama with QKV projection biases (and tied embeddings on
+the small variants) — we reuse the Llama stack and swap the attention
+projection construction, keeping the same TP dist_specs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from .._core.tensor import Tensor, apply
+from ..nn.initializer import Normal
+from ..ops.flash_attention import flash_attention_bhsd
+from ..ops.rope import apply_rotary_emb
+from jax.sharding import PartitionSpec as P
+
+from .llama import (LlamaConfig, LlamaMLP, LlamaModel, LlamaForCausalLM,
+                    LlamaDecoderLayer, LlamaAttention)
+
+
+@dataclass(unsafe_hash=True)
+class Qwen2Config(LlamaConfig):
+    attention_bias: bool = True
+    tie_word_embeddings: bool = True
+
+    @classmethod
+    def qwen2_7b(cls):
+        return cls(vocab_size=152064, hidden_size=3584,
+                   intermediate_size=18944, num_hidden_layers=28,
+                   num_attention_heads=28, num_key_value_heads=4,
+                   max_position_embeddings=32768, rope_theta=1e6,
+                   tie_word_embeddings=False)
+
+    @classmethod
+    def qwen2_0_5b(cls):
+        return cls(vocab_size=151936, hidden_size=896, intermediate_size=4864,
+                   num_hidden_layers=24, num_attention_heads=14,
+                   num_key_value_heads=2, max_position_embeddings=32768,
+                   rope_theta=1e6, tie_word_embeddings=True)
+
+
+class Qwen2Attention(LlamaAttention):
+    def __init__(self, config, tp_axis="tp"):
+        super().__init__(config, tp_axis)
+        if getattr(config, "attention_bias", True):
+            h = config.hidden_size
+            kv = self.num_kv_heads * self.head_dim
+            z = nn.initializer.Constant(0.0)
+            for name, width in (("q_proj", h), ("k_proj", kv), ("v_proj", kv)):
+                layer = getattr(self, name)
+                layer.bias = layer.create_parameter(
+                    [width], default_initializer=z, is_bias=True)
+
+    def forward(self, x, cos, sin, kv_cache=None, causal=True):
+        b, s, h = x.shape
+        has_bias = self.q_proj.bias is not None
+
+        def fn(xr, wq, wk, wv, wo, cosr, sinr, *rest):
+            if has_bias:
+                bq, bk, bv = rest[:3]
+                cache = rest[3:]
+            else:
+                bq = bk = bv = None
+                cache = rest
+            q = xr @ wq + (bq if bq is not None else 0.0)
+            k = xr @ wk + (bk if bk is not None else 0.0)
+            v = xr @ wv + (bv if bv is not None else 0.0)
+            q = q.reshape(b, s, self.num_heads, self.head_dim)
+            k = k.reshape(b, s, self.num_kv_heads, self.head_dim)
+            v = v.reshape(b, s, self.num_kv_heads, self.head_dim)
+            q, k = apply_rotary_emb(q.swapaxes(1, 2), k.swapaxes(1, 2),
+                                    cosr[None, None], sinr[None, None])
+            v = v.swapaxes(1, 2)
+            if cache:
+                ck, cv = cache
+                k = jnp.concatenate([ck, k], axis=2)
+                v = jnp.concatenate([cv, v], axis=2)
+            rep = self.num_heads // self.num_kv_heads
+            if rep > 1:
+                k = jnp.repeat(k, rep, axis=1)
+                v = jnp.repeat(v, rep, axis=1)
+            o = flash_attention_bhsd(q, k, v, causal=causal)
+            return o.swapaxes(1, 2).reshape(b, s, h) @ wo
+
+        args = [x, self.q_proj.weight, self.k_proj.weight, self.v_proj.weight,
+                self.o_proj.weight, Tensor(cos), Tensor(sin)]
+        if has_bias:
+            args += [self.q_proj.bias, self.k_proj.bias, self.v_proj.bias]
+        if kv_cache is not None:
+            args += [kv_cache[0], kv_cache[1]]
+        return apply(fn, *args, name="qwen2_attention")
+
+
+class Qwen2DecoderLayer(LlamaDecoderLayer):
+    def __init__(self, config):
+        nn.Layer.__init__(self)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = Qwen2Attention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+
+class Qwen2Model(LlamaModel):
+    def __init__(self, config):
+        super().__init__(config)
+        self.layers = nn.LayerList([Qwen2DecoderLayer(config)
+                                    for _ in range(config.num_hidden_layers)])
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    def __init__(self, config):
+        nn.Layer.__init__(self)
+        self.config = config
+        self.llama = Qwen2Model(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = nn.Linear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=nn.ParamAttr(
+                    initializer=Normal(0.0, config.initializer_range)),
+                bias_attr=False)
+            self.lm_head.weight.dist_spec = P(None, "tp")
+        else:
+            self.lm_head = None

@@ -74,6 +74,58 @@ class TestBert:
         assert np.isfinite(float(loss))
 
 
+class TestQwen2:
+    def test_forward_backward_with_bias(self):
+        from paddle_tpu.models import Qwen2Config, Qwen2ForCausalLM
+        cfg = Qwen2Config.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               kv_heads=2, ffn=64)
+        cfg.attention_bias = True
+        cfg.tie_word_embeddings = True
+        m = Qwen2ForCausalLM(cfg)
+        x = pt.to_tensor(np.random.randint(0, 64, (2, 10)))
+        loss, logits = m(x, labels=x)
+        assert logits.shape == [2, 10, 64] and np.isfinite(float(loss))
+        loss.backward()
+        g = m.llama.layers[0].self_attn.q_proj.bias.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+        # tied embeddings: no separate lm_head parameter
+        assert m.lm_head is None
+
+    def test_generate(self):
+        from paddle_tpu.models import Qwen2Config, Qwen2ForCausalLM
+        cfg = Qwen2Config.tiny(vocab=64, hidden=32, layers=1, heads=4,
+                               kv_heads=2, ffn=64)
+        m = Qwen2ForCausalLM(cfg)
+        out = m.generate(pt.to_tensor(np.random.randint(0, 64, (1, 4))),
+                         max_new_tokens=5)
+        assert out.shape[1] == 9
+
+
+class TestLaunch:
+    def test_env_construction(self):
+        from paddle_tpu.distributed.launch import build_env
+        env = build_env(4, 2, "host0:8476", base_env={})
+        assert env["JAX_NUM_PROCESSES"] == "4"
+        assert env["JAX_PROCESS_ID"] == "2"
+        assert env["PADDLE_TRAINER_ID"] == "2"
+        # single node: no distributed vars injected
+        assert "JAX_NUM_PROCESSES" not in build_env(1, 0, "x", base_env={})
+
+    def test_elastic_restart(self, tmp_path):
+        from paddle_tpu.distributed.launch import run
+        marker = tmp_path / "attempts"
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            "import sys, pathlib\n"
+            f"p = pathlib.Path({str(marker)!r})\n"
+            "n = int(p.read_text()) if p.exists() else 0\n"
+            "p.write_text(str(n + 1))\n"
+            "sys.exit(0 if n >= 1 else 1)\n")
+        rc = run([str(script)], max_restarts=2, restart_backoff=0.01)
+        assert rc == 0
+        assert marker.read_text() == "2"  # failed once, then succeeded
+
+
 class TestGPT2:
     def test_train_step(self):
         cfg = GPT2Config.tiny()
