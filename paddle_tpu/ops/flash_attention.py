@@ -49,11 +49,20 @@ def _fit_lanes(x128, n):
     return jnp.tile(x128, (1, n // LANES))
 
 
+import os
+
+
 def _on_tpu():
     try:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def pallas_disabled() -> bool:
+    """Escape hatch: PT_DISABLE_PALLAS=1 forces the XLA reference path
+    (e.g. when a new TPU generation rejects the kernel's block shapes)."""
+    return os.environ.get("PT_DISABLE_PALLAS", "0") == "1"
 
 
 # ---------------------------------------------------------------------------
@@ -421,7 +430,7 @@ def flash_attention_bhsd(q, k, v, causal=False, sm_scale=None,
     d = q.shape[-1]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     if use_pallas is None:
-        use_pallas = _on_tpu()
+        use_pallas = _on_tpu() and not pallas_disabled()
     if interpret is None:
         interpret = not _on_tpu()
     if not use_pallas:
