@@ -1,0 +1,232 @@
+// libptckpt: packed-checkpoint writer/reader.
+//
+// Replaces the reference's C++ checkpoint serialization (fluid
+// save/load_combine ops): many tensors packed into ONE file with an
+// index footer, written by a background thread so the trainer overlaps
+// device→host transfers of the next tensor with disk writes of the
+// previous one. Commit is atomic: write to <path>.tmp, fsync, rename.
+//
+// Layout: [u64 magic][blob bytes ...][index][u64 index_off][u64 magic]
+// index: u64 n, then per entry { u32 name_len, name bytes,
+//                                u64 offset, u64 nbytes }.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x70746b7074636b31ULL;  // "ptkptck1"
+
+struct Entry {
+  std::string name;
+  uint64_t offset;
+  uint64_t nbytes;
+};
+
+struct Chunk {
+  std::string name;
+  std::vector<uint8_t> data;
+};
+
+struct Writer {
+  std::string final_path, tmp_path;
+  FILE* f = nullptr;
+  uint64_t cursor = 0;
+  std::vector<Entry> index;
+  // background write queue
+  std::queue<Chunk> q;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::thread worker;
+  bool closing = false;
+  bool error = false;
+
+  void run() {
+    for (;;) {
+      Chunk c;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv.wait(lk, [&] { return closing || !q.empty(); });
+        if (q.empty()) {
+          if (closing) return;
+          continue;
+        }
+        c = std::move(q.front());
+        q.pop();
+      }
+      cv.notify_all();
+      if (!error) {
+        index.push_back(Entry{c.name, cursor, c.data.size()});
+        if (fwrite(c.data.data(), 1, c.data.size(), f) != c.data.size())
+          error = true;
+        cursor += c.data.size();
+      }
+    }
+  }
+};
+
+struct Reader {
+  int fd = -1;
+  uint8_t* map = nullptr;
+  size_t len = 0;
+  std::vector<Entry> index;
+};
+
+void put_u64(FILE* f, uint64_t v) { fwrite(&v, 8, 1, f); }
+
+}  // namespace
+
+extern "C" {
+
+void* ptckpt_writer_open(const char* path) {
+  auto* w = new Writer();
+  w->final_path = path;
+  w->tmp_path = w->final_path + ".tmp";
+  w->f = fopen(w->tmp_path.c_str(), "wb");
+  if (!w->f) { delete w; return nullptr; }
+  put_u64(w->f, kMagic);
+  w->cursor = 8;
+  w->worker = std::thread([w] { w->run(); });
+  return w;
+}
+
+// Enqueue one tensor blob; copies the buffer (caller may reuse it).
+int ptckpt_write(void* h, const char* name, const uint8_t* data,
+                 int64_t nbytes) {
+  auto* w = static_cast<Writer*>(h);
+  if (w->error) return -1;
+  Chunk c;
+  c.name = name;
+  c.data.assign(data, data + nbytes);
+  {
+    std::unique_lock<std::mutex> lk(w->mu);
+    // bound queue memory: at most 4 chunks in flight
+    w->cv.wait(lk, [&] { return w->q.size() < 4; });
+    w->q.push(std::move(c));
+  }
+  w->cv.notify_all();
+  return 0;
+}
+
+// Flush queue, write index, fsync, atomic rename. Returns 0 on success.
+int ptckpt_writer_close(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  {
+    std::lock_guard<std::mutex> lk(w->mu);
+    w->closing = true;
+  }
+  w->cv.notify_all();
+  w->worker.join();
+  int rc = -1;
+  if (!w->error) {
+    uint64_t index_off = w->cursor;
+    uint64_t n = w->index.size();
+    fwrite(&n, 8, 1, w->f);
+    for (const Entry& e : w->index) {
+      uint32_t nl = uint32_t(e.name.size());
+      fwrite(&nl, 4, 1, w->f);
+      fwrite(e.name.data(), 1, nl, w->f);
+      fwrite(&e.offset, 8, 1, w->f);
+      fwrite(&e.nbytes, 8, 1, w->f);
+    }
+    put_u64(w->f, index_off);
+    put_u64(w->f, kMagic);
+    fflush(w->f);
+    fsync(fileno(w->f));
+    fclose(w->f);
+    rc = rename(w->tmp_path.c_str(), w->final_path.c_str());
+  } else {
+    fclose(w->f);
+    remove(w->tmp_path.c_str());
+  }
+  delete w;
+  return rc;
+}
+
+void* ptckpt_reader_open(const char* path) {
+  auto* r = new Reader();
+  r->fd = open(path, O_RDONLY);
+  if (r->fd < 0) { delete r; return nullptr; }
+  struct stat st;
+  fstat(r->fd, &st);
+  r->len = size_t(st.st_size);
+  r->map = static_cast<uint8_t*>(
+      mmap(nullptr, r->len, PROT_READ, MAP_PRIVATE, r->fd, 0));
+  if (r->map == MAP_FAILED || r->len < 24) {
+    close(r->fd); delete r; return nullptr;
+  }
+  uint64_t magic_head, magic_tail, index_off;
+  memcpy(&magic_head, r->map, 8);
+  memcpy(&magic_tail, r->map + r->len - 8, 8);
+  memcpy(&index_off, r->map + r->len - 16, 8);
+  if (magic_head != kMagic || magic_tail != kMagic || index_off >= r->len) {
+    munmap(r->map, r->len); close(r->fd); delete r; return nullptr;
+  }
+  const uint8_t* p = r->map + index_off;
+  uint64_t n;
+  memcpy(&n, p, 8); p += 8;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint32_t nl;
+    memcpy(&nl, p, 4); p += 4;
+    Entry e;
+    e.name.assign(reinterpret_cast<const char*>(p), nl); p += nl;
+    memcpy(&e.offset, p, 8); p += 8;
+    memcpy(&e.nbytes, p, 8); p += 8;
+    r->index.push_back(std::move(e));
+  }
+  return r;
+}
+
+int64_t ptckpt_num_entries(void* h) {
+  return int64_t(static_cast<Reader*>(h)->index.size());
+}
+
+// Copies entry i's name into buf (cap bytes incl. NUL); returns name len.
+int64_t ptckpt_entry_name(void* h, int64_t i, char* buf, int64_t cap) {
+  auto& e = static_cast<Reader*>(h)->index[size_t(i)];
+  int64_t n = int64_t(e.name.size());
+  if (n + 1 > cap) return -1;
+  memcpy(buf, e.name.data(), n);
+  buf[n] = 0;
+  return n;
+}
+
+int64_t ptckpt_entry_size(void* h, const char* name) {
+  auto* r = static_cast<Reader*>(h);
+  for (auto& e : r->index)
+    if (e.name == name) return int64_t(e.nbytes);
+  return -1;
+}
+
+int64_t ptckpt_read(void* h, const char* name, uint8_t* out, int64_t cap) {
+  auto* r = static_cast<Reader*>(h);
+  for (auto& e : r->index) {
+    if (e.name == name) {
+      if (int64_t(e.nbytes) > cap) return -2;
+      memcpy(out, r->map + e.offset, e.nbytes);
+      return int64_t(e.nbytes);
+    }
+  }
+  return -1;
+}
+
+void ptckpt_reader_close(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  munmap(r->map, r->len);
+  close(r->fd);
+  delete r;
+}
+
+}  // extern "C"

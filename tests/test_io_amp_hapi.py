@@ -215,6 +215,54 @@ class TestCheckpointResume:
         assert os.path.exists(str(tmp_path / "async_ck/state.pkl"))
 
 
+class TestPackedCheckpoint:
+    def test_roundtrip_and_atomicity(self, tmp_path):
+        import os
+        from paddle_tpu.utils.packed_checkpoint import (save_packed,
+                                                        load_packed)
+        rng = np.random.default_rng(0)
+        tree = {"model": {"layer.0.weight":
+                          rng.standard_normal((16, 16)).astype(np.float32),
+                          "bias": rng.standard_normal((4,)).astype(np.float64)},
+                "step": 7, "lr": 1e-3, "tag": "x"}
+        p = str(tmp_path / "ck.pt")
+        save_packed(p, tree)
+        assert not os.path.exists(p + ".tmp")  # atomic rename happened
+        got = load_packed(p)
+        assert got["step"] == 7 and got["tag"] == "x"
+        assert np.array_equal(got["model"]["layer.0.weight"],
+                              tree["model"]["layer.0.weight"])
+        assert got["model"]["bias"].dtype == np.float64
+
+    def test_corrupt_file_rejected(self, tmp_path):
+        import pytest as _pt
+        from paddle_tpu.utils.packed_checkpoint import (save_packed,
+                                                        load_packed)
+        p = str(tmp_path / "ck.pt")
+        save_packed(p, {"a": np.zeros(3, np.float32)})
+        with open(p, "r+b") as f:
+            f.seek(-4, 2)
+            f.write(b"zzzz")
+        with _pt.raises(OSError):
+            load_packed(p)
+
+    def test_model_state_dict_roundtrip(self, tmp_path):
+        from paddle_tpu.utils.packed_checkpoint import (save_packed,
+                                                        load_packed)
+        net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                               pt.nn.Linear(8, 2))
+        sd = net.state_dict()
+        p = str(tmp_path / "m.pt")
+        save_packed(p, {"model": sd})
+        got = load_packed(p)["model"]
+        assert set(got) == set(sd)
+        net2 = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                                pt.nn.Linear(8, 2))
+        net2.set_state_dict({k: pt.to_tensor(v) for k, v in got.items()})
+        x = pt.randn([3, 4])
+        assert np.allclose(net(x).numpy(), net2(x).numpy(), atol=1e-6)
+
+
 class TestFailureDetection:
     def test_check_finite_raises(self):
         from paddle_tpu.utils.watchdog import check_finite, StepHealthMonitor
