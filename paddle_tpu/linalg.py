@@ -4,6 +4,7 @@ from .tensor.linalg import (  # noqa: F401
     qr, svd, svdvals, eig, eigh, eigvals, eigvalsh, solve, lstsq, matrix_power,
     matrix_rank, triangular_solve, pinv, slogdet, det, mv, multi_dot, cov,
     corrcoef, lu, lu_unpack, householder_product, matrix_exp, vecdot, cdist,
-    matrix_transpose, ormqr,
+    matrix_transpose, ormqr, vector_norm, matrix_norm, cond,
+    cholesky_inverse, svd_lowrank, pca_lowrank, histogram_bin_edges,
 )
 from .tensor.math import vander  # noqa: F401

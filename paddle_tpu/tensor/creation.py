@@ -32,11 +32,17 @@ def _infer_dtype(data, dtype):
     if isinstance(data, Tensor):
         return data.dtype
     a = np.asarray(data)
-    if a.dtype == np.float64:
+    if a.dtype == np.float64 and not _is_np_array(data):
+        # python floats / float lists follow the default dtype (paddle
+        # semantics); explicit float64 numpy arrays keep their precision
         return _dt.get_default_dtype()
     if a.dtype == np.int64:
         return _dt.int64
     return np.dtype(a.dtype)
+
+
+def _is_np_array(data):
+    return isinstance(data, np.ndarray)
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

@@ -334,3 +334,89 @@ def bincount(x, weights=None, minlength=0, name=None):
     if weights is not None:
         return apply(fn, x, weights, name="bincount")
     return apply(fn, x, name="bincount")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference: python/paddle/tensor/linalg.py vector_norm."""
+    def fn(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(a if ax is not None else a.reshape(-1),
+                               ord=p, axis=ax, keepdims=keepdim)
+    return apply(fn, x, name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference: python/paddle/tensor/linalg.py matrix_norm."""
+    def fn(a):
+        return jnp.linalg.norm(a, ord=p, axis=tuple(axis), keepdims=keepdim)
+    return apply(fn, x, name="matrix_norm")
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference: python/paddle/tensor/linalg.py cond)."""
+    def fn(a):
+        return jnp.linalg.cond(a, p=p)
+    return apply(fn, x, name="cond")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """inv(A) from its Cholesky factor (reference: cholesky_inverse)."""
+    def fn(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        Li = jax.scipy.linalg.solve_triangular(L, eye, lower=not upper)
+        return Li.T @ Li if not upper else Li @ Li.T
+    return apply(fn, x, name="cholesky_inverse")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference: svd_lowrank; Halko et al.).
+    Power iteration on a Gaussian sketch — all matmuls, MXU-friendly."""
+    def fn(a, *rest):
+        m = rest[0] if rest else None
+        if m is not None:
+            a = a - m
+        rows, cols = a.shape[-2], a.shape[-1]
+        k = int(builtins_min(q, rows, cols))
+        key = jax.random.key(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (cols, k), a.dtype)
+        y = a @ omega
+        for _ in range(int(niter)):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, jnp.swapaxes(vh, -1, -2)
+    import builtins
+    builtins_min = builtins.min
+    if M is not None:
+        return apply(fn, x, M, name="svd_lowrank", multi=True)
+    return apply(fn, x, name="svd_lowrank", multi=True)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: python/paddle/tensor/linalg.py pca_lowrank."""
+    def fn(a):
+        rows, cols = a.shape[-2], a.shape[-1]
+        import builtins
+        k = int(q) if q is not None else builtins.min(6, rows, cols)
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        key = jax.random.key(0)
+        omega = jax.random.normal(key, a.shape[:-2] + (cols, k), a.dtype)
+        y = a @ omega
+        for _ in range(int(niter)):
+            y = a @ (jnp.swapaxes(a, -1, -2) @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = jnp.swapaxes(qmat, -1, -2) @ a
+        u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u_b, s, jnp.swapaxes(vh, -1, -2)
+    return apply(fn, x, name="pca_lowrank", multi=True)
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    def fn(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else \
+            (jnp.min(a), jnp.max(a))
+        return jnp.linspace(lo, hi, int(bins) + 1)
+    return apply(fn, input, name="histogram_bin_edges")

@@ -101,6 +101,46 @@ class TestQwen2:
         assert out.shape[1] == 9
 
 
+class TestDeepSeekMLA:
+    def test_forward_backward_moe_layers(self):
+        from paddle_tpu.models import DeepSeekConfig, DeepSeekForCausalLM
+        cfg = DeepSeekConfig.tiny_mla()
+        m = DeepSeekForCausalLM(cfg)
+        x = pt.to_tensor(np.random.randint(0, 128, (2, 12)))
+        loss, logits = m(x, labels=x)
+        assert logits.shape == [2, 12, 128] and np.isfinite(float(loss))
+        loss.backward()
+        g = m.layers[0].self_attn.kv_down.weight.grad
+        assert g is not None and np.isfinite(g.numpy()).all()
+        # dense-then-MoE layer schedule (first_k_dense_replace=1)
+        assert not m.layers[0].is_moe and m.layers[1].is_moe
+
+    def test_mla_latent_is_compressed(self):
+        from paddle_tpu.models.deepseek import DeepSeekConfig, MLAttention
+        cfg = DeepSeekConfig.tiny_mla()
+        att = MLAttention(cfg)
+        # the cacheable latent (kv_down output) is much smaller than
+        # full per-head K/V: (r + d_rope) vs nh*(d_nope + d_v + d_rope)
+        latent_dim = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+        full_kv = cfg.num_attention_heads * (
+            cfg.qk_nope_head_dim + cfg.v_head_dim)
+        assert latent_dim < full_kv / 2
+        assert att.kv_down.weight.shape == [cfg.hidden_size, latent_dim]
+
+    def test_mla_causality(self):
+        # token t's output must not depend on tokens > t
+        from paddle_tpu.models import DeepSeekConfig, DeepSeekForCausalLM
+        cfg = DeepSeekConfig.tiny_mla(layers=1)
+        m = DeepSeekForCausalLM(cfg)
+        ids = np.random.randint(0, 128, (1, 8))
+        full = m(pt.to_tensor(ids)).numpy()
+        ids2 = ids.copy()
+        ids2[0, -1] = (ids2[0, -1] + 1) % 128
+        full2 = m(pt.to_tensor(ids2)).numpy()
+        assert np.allclose(full[0, :-1], full2[0, :-1], atol=1e-5)
+        assert not np.allclose(full[0, -1], full2[0, -1], atol=1e-5)
+
+
 class TestLaunch:
     def test_env_construction(self):
         from paddle_tpu.distributed.launch import build_env
