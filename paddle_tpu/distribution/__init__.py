@@ -354,6 +354,184 @@ class Poisson(Distribution):
                       jax.scipy.special.gammaln(v + 1))
 
 
+class MultivariateNormal(Distribution):
+    """reference: python/paddle/distribution/multivariate_normal.py."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 precision_matrix=None, name=None):
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self._tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_t(covariance_matrix))
+        elif precision_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                jnp.linalg.inv(_t(precision_matrix)))
+        else:
+            raise ValueError("need covariance_matrix/scale_tril/"
+                             "precision_matrix")
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self.loc.shape
+        z = jax.random.normal(prng.next_key(), sh, self.loc.dtype)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i", self._tril, z))
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = _t(value) - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self._tril, diff[..., None],
+                                                lower=True)[..., 0]
+        m = jnp.sum(sol * sol, -1)
+        logdet = 2 * jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), -1)
+        return Tensor(-0.5 * (m + d * math.log(2 * math.pi) + logdet))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = 2 * jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), -1)
+        return Tensor(0.5 * (d * (1 + math.log(2 * math.pi)) + logdet))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+
+class StudentT(Distribution):
+    """reference: python/paddle/distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(np.broadcast_shapes(self.df.shape, self.loc.shape,
+                                             self.scale.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        z = jax.random.t(prng.next_key(), jnp.broadcast_to(self.df, sh), sh)
+        return Tensor(self.loc + self.scale * z)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = self.df
+        y = (_t(value) - self.loc) / self.scale
+        return Tensor(gammaln((v + 1) / 2) - gammaln(v / 2) -
+                      0.5 * jnp.log(v * math.pi) - jnp.log(self.scale) -
+                      (v + 1) / 2 * jnp.log1p(y * y / v))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.where(self.df > 2,
+                                self.scale ** 2 * self.df / (self.df - 2),
+                                jnp.nan))
+
+
+class Chi2(Gamma):
+    """reference: python/paddle/distribution/chi2.py — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        super().__init__(self.df / 2.0, 0.5)
+
+
+class Binomial(Distribution):
+    """reference: python/paddle/distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs_ = _t(probs)
+        super().__init__(np.broadcast_shapes(self.total_count.shape,
+                                             self.probs_.shape))
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        n = jnp.broadcast_to(self.total_count, sh).astype(jnp.float32)
+        p = jnp.broadcast_to(self.probs_, sh)
+        return Tensor(jax.random.binomial(prng.next_key(), n, p, shape=sh))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        k = _t(value)
+        n = self.total_count
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return Tensor(gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1) +
+                      k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference: python/paddle/distribution/continuous_bernoulli.py."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_ = _t(probs)
+        self._lims = lims
+        super().__init__(self.probs_.shape)
+
+    def _log_norm(self):
+        p = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        c = jnp.log((2 * jnp.arctanh(1 - 2 * safe)) / (1 - 2 * safe))
+        return jnp.where(near_half, math.log(2.0), c)
+
+    def log_prob(self, value):
+        v = _t(value)
+        p = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) +
+                      self._log_norm())
+
+    def sample(self, shape=()):
+        sh = self._shape(shape) + self._batch_shape
+        u = jax.random.uniform(prng.next_key(), sh)
+        p = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        # inverse CDF
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        num = jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+        den = jnp.log(safe / (1 - safe))
+        return Tensor(jnp.where(near_half, u, num / den))
+
+
+class Independent(Distribution):
+    """Reinterprets batch dims as event dims (reference:
+    python/paddle/distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base._batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base._event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = _t(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = _t(self.base.entropy())
+        return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
 class TransformedDistribution(Distribution):
     def __init__(self, base, transforms):
         self.base = base
@@ -401,6 +579,115 @@ class ExpTransform:
 
     def forward_log_det_jacobian(self, x):
         return Tensor(_t(x))
+
+
+class TanhTransform:
+    def forward(self, x):
+        return Tensor(jnp.tanh(_t(x)))
+
+    def inverse(self, y):
+        return Tensor(jnp.arctanh(jnp.clip(_t(y), -1 + 1e-6, 1 - 1e-6)))
+
+    def forward_log_det_jacobian(self, x):
+        v = _t(x)
+        return Tensor(2.0 * (math.log(2.0) - v - jax.nn.softplus(-2.0 * v)))
+
+
+class SigmoidTransform:
+    def forward(self, x):
+        return Tensor(jax.nn.sigmoid(_t(x)))
+
+    def inverse(self, y):
+        v = jnp.clip(_t(y), 1e-6, 1 - 1e-6)
+        return Tensor(jnp.log(v) - jnp.log1p(-v))
+
+    def forward_log_det_jacobian(self, x):
+        v = _t(x)
+        return Tensor(-jax.nn.softplus(-v) - jax.nn.softplus(v))
+
+
+class PowerTransform:
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return Tensor(jnp.power(_t(x), self.power))
+
+    def inverse(self, y):
+        return Tensor(jnp.power(_t(y), 1.0 / self.power))
+
+    def forward_log_det_jacobian(self, x):
+        v = _t(x)
+        return Tensor(jnp.log(jnp.abs(self.power * jnp.power(v,
+                                                             self.power - 1))))
+
+
+class AbsTransform:
+    def forward(self, x):
+        return Tensor(jnp.abs(_t(x)))
+
+    def inverse(self, y):
+        return Tensor(_t(y))  # principal branch
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(jnp.zeros_like(_t(x)))
+
+
+class SoftmaxTransform:
+    def forward(self, x):
+        return Tensor(jax.nn.softmax(_t(x), -1))
+
+    def inverse(self, y):
+        v = jnp.log(jnp.clip(_t(y), 1e-12))
+        return Tensor(v - v.mean(-1, keepdims=True))
+
+
+class StickBreakingTransform:
+    """simplex parameterization: R^{K-1} → Δ^K."""
+
+    def forward(self, x):
+        v = _t(x)
+        k = v.shape[-1]
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=v.dtype))
+        z = jax.nn.sigmoid(v - offset)
+        zpad = jnp.concatenate([z, jnp.ones(z.shape[:-1] + (1,), z.dtype)],
+                               -1)
+        cum = jnp.cumprod(1 - z, -1)
+        cpad = jnp.concatenate([jnp.ones(z.shape[:-1] + (1,), z.dtype), cum],
+                               -1)
+        return Tensor(zpad * cpad)
+
+    def inverse(self, y):
+        v = _t(y)
+        k = v.shape[-1] - 1
+        cum = jnp.cumsum(v[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros(v.shape[:-1] + (1,), v.dtype), cum[..., :-1]], -1)
+        z = jnp.clip(v[..., :-1] / jnp.clip(rest, 1e-12), 1e-12, 1 - 1e-12)
+        offset = jnp.log(jnp.arange(k, 0, -1, dtype=v.dtype))
+        return Tensor(jnp.log(z) - jnp.log1p(-z) + offset)
+
+
+class ChainTransform:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        ld = 0.0
+        for t in self.transforms:
+            ld = ld + _t(t.forward_log_det_jacobian(x))
+            x = t.forward(x)
+        return Tensor(ld)
 
 
 def kl_divergence(p, q):

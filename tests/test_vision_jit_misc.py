@@ -279,6 +279,47 @@ class TestDistribution:
         assert np.allclose(float(d.log_prob(v)), float(ln.log_prob(v)),
                            atol=1e-4)
 
+    def test_mvn_studentt_chi2_binomial(self):
+        import scipy.stats as ss
+        D = pt.distribution
+        mu = np.array([1.0, -2.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        mvn = D.MultivariateNormal(pt.to_tensor(mu),
+                                   covariance_matrix=pt.to_tensor(cov))
+        x = np.array([0.5, -1.0], np.float32)
+        assert abs(float(mvn.log_prob(pt.to_tensor(x))) -
+                   ss.multivariate_normal(mu, cov).logpdf(x)) < 1e-4
+        st = D.StudentT(pt.to_tensor(5.0), pt.to_tensor(1.0),
+                        pt.to_tensor(2.0))
+        assert abs(float(st.log_prob(pt.to_tensor(0.5))) -
+                   ss.t(5, 1, 2).logpdf(0.5)) < 1e-5
+        c2 = D.Chi2(pt.to_tensor(4.0))
+        assert abs(float(c2.log_prob(pt.to_tensor(3.0))) -
+                   ss.chi2(4).logpdf(3.0)) < 1e-4
+        b = D.Binomial(pt.to_tensor(10.0), pt.to_tensor(0.3))
+        assert abs(float(b.log_prob(pt.to_tensor(4.0))) -
+                   ss.binom(10, 0.3).logpmf(4)) < 1e-5
+
+    def test_independent_and_transforms(self):
+        import scipy.stats as ss
+        D = pt.distribution
+        ind = D.Independent(
+            D.Normal(pt.to_tensor(np.zeros(3, np.float32)),
+                     pt.to_tensor(np.ones(3, np.float32))), 1)
+        lp = float(ind.log_prob(pt.to_tensor(np.zeros(3, np.float32))))
+        assert abs(lp - 3 * ss.norm.logpdf(0)) < 1e-5
+        td = D.TransformedDistribution(
+            D.Normal(pt.to_tensor(0.0), pt.to_tensor(1.0)),
+            [D.TanhTransform()])
+        y = 0.5
+        expect = ss.norm.logpdf(np.arctanh(y)) - np.log1p(-y * y)
+        assert abs(float(td.log_prob(pt.to_tensor(y))) - expect) < 1e-4
+        sb = D.StickBreakingTransform()
+        v = np.array([0.3, -0.7, 1.1], np.float32)
+        simplex = sb.forward(pt.to_tensor(v))
+        assert abs(float(simplex.numpy().sum()) - 1.0) < 1e-5
+        assert np.allclose(sb.inverse(simplex).numpy(), v, atol=1e-4)
+
     def test_gamma_beta_dirichlet(self):
         g = pt.distribution.Gamma(2.0, 3.0)
         assert np.isfinite(float(g.log_prob(pt.to_tensor(1.0))))
