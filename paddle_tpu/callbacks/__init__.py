@@ -164,26 +164,39 @@ class ModelCheckpoint(Callback):
 
 
 class VisualDL(Callback):
-    """Stub: VisualDL is not in the TPU image; logs to stdout/CSV."""
+    """VisualDL-parity metrics logging via utils.summary.LogWriter
+    (JSONL event stream; the visualdl wheel is not in the TPU image)."""
 
     def __init__(self, log_dir="vdl_log"):
         super().__init__()
         self.log_dir = log_dir
-        self._rows = []
+        self._writer = None
+        self._step = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..utils.summary import LogWriter
+            self._writer = LogWriter(logdir=self.log_dir)
+        return self._writer
 
     def on_train_batch_end(self, step, logs=None):
-        self._rows.append({"step": step, **(logs or {})})
+        self._step = step
+        for k, v in (logs or {}).items():
+            try:
+                self._w().add_scalar(f"train/{k}", float(v), step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._w().add_scalar(f"epoch/{k}", float(v), epoch)
+            except (TypeError, ValueError):
+                pass
 
     def on_train_end(self, logs=None):
-        os.makedirs(self.log_dir, exist_ok=True)
-        import csv
-        if not self._rows:
-            return
-        with open(os.path.join(self.log_dir, "scalars.csv"), "w", newline="") as f:
-            keys = sorted({k for r in self._rows for k in r})
-            w = csv.DictWriter(f, keys)
-            w.writeheader()
-            w.writerows(self._rows)
+        if self._writer is not None:
+            self._writer.close()
 
 
 class ReduceLROnPlateau(Callback):

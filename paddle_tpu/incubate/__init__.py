@@ -1,5 +1,9 @@
 """paddle.incubate parity (reference: python/paddle/incubate/*)."""
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import (  # noqa: F401
+    ExponentialMovingAverage, LookAhead, ModelAverage,
+)
 
 
 def softmax_mask_fuse_upper_triangle(x):

@@ -1,0 +1,156 @@
+"""paddle.incubate optimizer wrappers (reference: python/paddle/incubate/
+optimizer: LookAhead, ModelAverage; python/paddle/incubate/
+ExponentialMovingAverage).
+
+Pure pytree arithmetic over parameter values — TPU-friendly (each update
+is one fused elementwise XLA graph per parameter)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+
+
+class ExponentialMovingAverage:
+    """EMA of model parameters: shadow ← decay·shadow + (1−decay)·param.
+
+    usage:
+        ema = ExponentialMovingAverage(model.parameters(), decay=0.999)
+        ... optimizer.step() ...
+        ema.update()
+        with ema.apply(model):   # eval with averaged weights
+            evaluate()
+    """
+
+    def __init__(self, parameters, decay=0.999):
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self._decay = decay
+        self._shadow = [jnp.array(p._value) for p in self._params]
+        self._backup = None
+        self._step = 0
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        self._shadow = [d * s + (1.0 - d) * p._value
+                        for s, p in zip(self._shadow, self._params)]
+
+    def apply_shadow(self):
+        self._backup = [jnp.array(p._value) for p in self._params]
+        for p, s in zip(self._params, self._shadow):
+            p._replace(s.astype(p._value.dtype))
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._replace(b)
+        self._backup = None
+
+    class _Ctx:
+        def __init__(self, ema):
+            self.ema = ema
+
+        def __enter__(self):
+            self.ema.apply_shadow()
+            return self.ema
+
+        def __exit__(self, *a):
+            self.ema.restore()
+
+    def apply(self, model=None):
+        return self._Ctx(self)
+
+    def state_dict(self):
+        return {f"shadow_{i}": s for i, s in enumerate(self._shadow)}
+
+    def set_state_dict(self, st):
+        self._shadow = [jnp.asarray(st[f"shadow_{i}"])
+                        for i in range(len(self._shadow))]
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (Zhang et al. 2019): every k inner
+    steps, slow weights step toward fast weights by alpha and the fast
+    weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._step = 0
+        self._slow = None
+
+    def _params(self):
+        return [p for p in self.inner_optimizer._parameter_list
+                if not p.stop_gradient]
+
+    def step(self):
+        if self._slow is None:
+            self._slow = [jnp.array(p._value) for p in self._params()]
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            new_slow = []
+            for p, s in zip(self._params(), self._slow):
+                s = s + self.alpha * (p._value - s)
+                p._replace(s.astype(p._value.dtype))
+                new_slow.append(s)
+            self._slow = new_slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        st = {"inner": self.inner_optimizer.state_dict(),
+              "step": self._step}
+        if self._slow is not None:
+            st["slow"] = {str(i): s for i, s in enumerate(self._slow)}
+        return st
+
+
+class ModelAverage:
+    """Running average of parameters over a sliding window (reference:
+    incubate ModelAverage with min/max_average_window)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = [p for p in (parameters or [])
+                        if not p.stop_gradient]
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sum = [jnp.zeros_like(p._value) for p in self._params]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        self._count += 1
+        # exact running mean while count <= window, then sliding EMA:
+        # mean_t = mean_{t-1}·(n−1)/n + p/n with n = min(count, window)
+        n = min(self._count, self._max_w)
+        self._sum = [s * (n - 1.0) / n + p._value / n
+                     for s, p in zip(self._sum, self._params)]
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = [jnp.array(p._value) for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._replace(s.astype(p._value.dtype))
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._replace(b)
+        self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.restore()

@@ -1,0 +1,86 @@
+"""LogWriter: VisualDL-parity training metrics logger.
+
+Reference: the reference ecosystem logs through VisualDL's LogWriter
+(add_scalar/add_histogram/...). TPU image has no visualdl wheel, so we
+write an append-only JSONL event stream per run — trivially parseable,
+crash-safe (line-buffered appends), and convertible to any dashboard.
+A small read API (`SummaryReader`) covers test/tooling use.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+class LogWriter:
+    def __init__(self, logdir="./log", file_name="", display_name="",
+                 **kwargs):
+        os.makedirs(logdir, exist_ok=True)
+        name = file_name or f"events.{int(time.time())}.jsonl"
+        self.path = os.path.join(logdir, name)
+        self._f = open(self.path, "a", buffering=1)
+        self.logdir = logdir
+
+    def _emit(self, kind, tag, step, payload):
+        self._f.write(json.dumps(
+            {"kind": kind, "tag": tag, "step": int(step),
+             "wall_time": time.time(), **payload}) + "\n")
+
+    def add_scalar(self, tag, value, step, walltime=None):
+        self._emit("scalar", tag, step, {"value": float(value)})
+
+    def add_histogram(self, tag, values, step, buckets=10):
+        arr = np.asarray(values, np.float64).reshape(-1)
+        hist, edges = np.histogram(arr, bins=buckets)
+        self._emit("histogram", tag, step,
+                   {"counts": hist.tolist(), "edges": edges.tolist(),
+                    "min": float(arr.min()), "max": float(arr.max()),
+                    "mean": float(arr.mean())})
+
+    def add_text(self, tag, text_string, step):
+        self._emit("text", tag, step, {"text": str(text_string)})
+
+    def add_hparams(self, hparams_dict, metrics_list=None, **kw):
+        self._emit("hparams", "hparams", 0,
+                   {"hparams": {k: (v if isinstance(v, (int, float, str,
+                                                        bool)) else str(v))
+                                for k, v in hparams_dict.items()}})
+
+    def add_image(self, tag, img, step, **kw):
+        arr = np.asarray(img)
+        self._emit("image", tag, step,
+                   {"shape": list(arr.shape), "mean": float(arr.mean())})
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class SummaryReader:
+    def __init__(self, path):
+        if os.path.isdir(path):
+            files = sorted(f for f in os.listdir(path)
+                           if f.endswith(".jsonl"))
+            if not files:
+                raise FileNotFoundError(f"no event files in {path}")
+            path = os.path.join(path, files[-1])
+        with open(path) as f:
+            self.events = [json.loads(line) for line in f if line.strip()]
+
+    def scalars(self, tag):
+        return [(e["step"], e["value"]) for e in self.events
+                if e["kind"] == "scalar" and e["tag"] == tag]
+
+    def tags(self):
+        return sorted({e["tag"] for e in self.events})
