@@ -22,6 +22,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 try:  # pallas TPU backend is absent on some CPU-only builds
@@ -33,7 +34,12 @@ except Exception:  # pragma: no cover
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
-NEG_INF = -1e30
+# np.float32: a bare Python float lowers as an f64 constant inside Mosaic,
+# and v5e libtpu rejects 'tpu.truncf f64->f32' — keep all kernel consts f32.
+NEG_INF = np.float32(-1e30)
+# index-map constants likewise must be i32: under jax_enable_x64 a literal 0
+# traces as i64 and Mosaic fails to legalize the index-map func.return.
+Z = np.int32(0)
 LANES = 128  # TPU lane width: per-row stats are stored replicated over lanes
              # so every ref block keeps last-two dims (÷8, ÷128)-aligned
 
@@ -160,7 +166,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
 
 
 def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
-    scale = float(scale)
+    scale = np.float32(scale)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -182,13 +188,13 @@ def _fwd_pallas(q, k, v, causal, scale, block_q, block_k, interpret):
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
-            spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
-            spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, Z)),
+            spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, Z)),
+            spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, Z)),
         ],
         out_specs=[
-            spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            spec((1, block_q, LANES), lambda bh_, qi, ki: (bh_, qi, 0)),
+            spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, Z)),
+            spec((1, block_q, LANES), lambda bh_, qi, ki: (bh_, qi, Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -245,7 +251,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1])) * scale
+        # mask after the product: OOB rows of the ragged final q block read
+        # undefined lse/delta, and 0 * inf would poison the accumulator
+        ds = jnp.where(valid,
+                       p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1])) * scale,
+                       0.0)
         dq_acc[:] += jax.lax.dot_general(ds, k.astype(jnp.float32),
                                          (((1,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -306,7 +316,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1])) * scale
+        ds = jnp.where(valid,
+                       p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1])) * scale,
+                       0.0)
         dk_acc[:] += jax.lax.dot_general(ds, q.astype(jnp.float32),
                                          (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
@@ -325,7 +337,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret):
-    scale = float(scale)
+    scale = np.float32(scale)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     block_q = min(block_q, sq)
@@ -351,14 +363,14 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret)
                           sq=sq, sk=sk),
         grid=(bh, n_q, n_k),
         in_specs=[
-            spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
-            spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
-            spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, 0)),
-            spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0)),
-            spec((1, block_q, LANES), lambda b_, qi, ki: (b_, qi, 0)),
-            spec((1, block_q, LANES), lambda b_, qi, ki: (b_, qi, 0)),
+            spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, Z)),
+            spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, Z)),
+            spec((1, block_k, d), lambda b_, qi, ki: (b_, ki, Z)),
+            spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, Z)),
+            spec((1, block_q, LANES), lambda b_, qi, ki: (b_, qi, Z)),
+            spec((1, block_q, LANES), lambda b_, qi, ki: (b_, qi, Z)),
         ],
-        out_specs=[spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, 0))],
+        out_specs=[spec((1, block_q, d), lambda b_, qi, ki: (b_, qi, Z))],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)] if _HAS_PLTPU else [],
         interpret=interpret,
@@ -370,16 +382,16 @@ def _bwd_pallas(q, k, v, o, lse, do, causal, scale, block_q, block_k, interpret)
                           sq=sq, sk=sk),
         grid=(bh, n_k, n_q),
         in_specs=[
-            spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, 0)),
-            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
-            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
-            spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, 0)),
-            spec((1, block_q, LANES), lambda b_, ki, qi: (b_, qi, 0)),
-            spec((1, block_q, LANES), lambda b_, ki, qi: (b_, qi, 0)),
+            spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, Z)),
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, Z)),
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, Z)),
+            spec((1, block_q, d), lambda b_, ki, qi: (b_, qi, Z)),
+            spec((1, block_q, LANES), lambda b_, ki, qi: (b_, qi, Z)),
+            spec((1, block_q, LANES), lambda b_, ki, qi: (b_, qi, Z)),
         ],
         out_specs=[
-            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
-            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, 0)),
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, Z)),
+            spec((1, block_k, d), lambda b_, ki, qi: (b_, ki, Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),

@@ -23,13 +23,15 @@ from __future__ import annotations
 import functools
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import _fit_lanes
 
-NEG_INF = -1e30
+NEG_INF = np.float32(-1e30)  # f32: Mosaic rejects f64 consts under x64
+Z = np.int32(0)           # i32 index-map consts (x64 would make them i64)
 LANES = 128
 MIN_GROUP = 8  # TPU sublane minimum for the q-rows dim
 
@@ -123,23 +125,23 @@ def _decode_pallas(q4, k_pages, v_pages, page_table, lengths, scale,
         in_specs=[
             # index maps receive grid indices first, then scalar-prefetch refs
             pl.BlockSpec((1, 1, group, d),
-                         lambda bi, hi, pi, ptab, lens: (bi, hi, 0, 0)),
+                         lambda bi, hi, pi, ptab, lens: (bi, hi, Z, Z)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda bi, hi, pi, ptab, lens:
-                         (hi, ptab[bi, pi], 0, 0)),
+                         (hi, ptab[bi, pi], Z, Z)),
             pl.BlockSpec((1, 1, page_size, d),
                          lambda bi, hi, pi, ptab, lens:
-                         (hi, ptab[bi, pi], 0, 0)),
+                         (hi, ptab[bi, pi], Z, Z)),
         ],
         out_specs=pl.BlockSpec((1, 1, group, d),
-                               lambda bi, hi, pi, ptab, lens: (bi, hi, 0, 0)),
+                               lambda bi, hi, pi, ptab, lens: (bi, hi, Z, Z)),
         scratch_shapes=[
             pltpu.VMEM((group, d), jnp.float32),
             pltpu.VMEM((group, LANES), jnp.float32),
             pltpu.VMEM((group, LANES), jnp.float32),
         ],
     )
-    kernel = functools.partial(_decode_kernel, scale=float(scale),
+    kernel = functools.partial(_decode_kernel, scale=np.float32(scale),
                                page_size=page_size, n_pages=n_pages)
     return pl.pallas_call(
         kernel,
