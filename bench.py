@@ -21,17 +21,28 @@ PEAK_FLOPS = {
 }
 
 
-def _tpu_alive(timeout=180):
+def _tpu_alive():
     """Probe device init in a child so a wedged TPU tunnel can't hang the
-    bench; on failure we fall back to a CPU smoke number."""
+    bench. Retries with growing timeouts and logs the child's stderr —
+    a silent CPU fallback hides the only number that matters."""
     import subprocess
-    try:
-        r = subprocess.run([sys.executable, "-c",
-                            "import jax; jax.devices()"],
-                           timeout=timeout, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt, timeout in enumerate((120, 240, 360), 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); print(d[0].platform)"],
+                timeout=timeout, capture_output=True, text=True)
+        except subprocess.TimeoutExpired:
+            print(f"# TPU probe attempt {attempt} timed out after {timeout}s",
+                  file=sys.stderr)
+            continue
+        if r.returncode == 0:
+            return True
+        print(f"# TPU probe attempt {attempt} rc={r.returncode}; stderr tail:",
+              file=sys.stderr)
+        print("\n".join(r.stderr.strip().splitlines()[-10:]), file=sys.stderr)
+        time.sleep(10)
+    return False
 
 
 def main():
@@ -54,17 +65,22 @@ def main():
                           intermediate_size=5504, num_hidden_layers=8,
                           num_attention_heads=16, num_key_value_heads=8,
                           max_position_embeddings=2048)
-        batch, seq, iters, dtype = 8, 2048, 10, jnp.bfloat16
+        batch = int(os.environ.get("PT_BENCH_BATCH", "8"))
+        seq = int(os.environ.get("PT_BENCH_SEQ", "2048"))
+        iters, dtype = 10, jnp.bfloat16
+        remat = os.environ.get("PT_BENCH_REMAT", "true")
+        remat = {"true": True, "false": False}.get(remat, remat)
     else:  # CPU smoke fallback
         cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=4,
                                kv_heads=2, ffn=256)
         batch, seq, iters, dtype = 2, 128, 3, jnp.float32
+        remat = True
 
     from jax.sharding import Mesh
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
     params = M.init_params(cfg, seed=0, dtype=dtype)
     opt = M.init_opt_state(params)
-    step = M.make_train_step(cfg, mesh, n_micro=None, remat=True, lr=3e-4)
+    step = M.make_train_step(cfg, mesh, n_micro=None, remat=remat, lr=3e-4)
 
     rng = np.random.RandomState(0)
     x = rng.randint(0, cfg.vocab_size, (batch, seq))
@@ -81,7 +97,7 @@ def main():
         os.environ["PT_DISABLE_PALLAS"] = "1"
         params = M.init_params(cfg, seed=0, dtype=dtype)
         opt = M.init_opt_state(params)
-        step = M.make_train_step(cfg, mesh, n_micro=None, remat=True, lr=3e-4)
+        step = M.make_train_step(cfg, mesh, n_micro=None, remat=remat, lr=3e-4)
         params, opt, loss = step(params, opt, jnp.asarray(0), (x, y))
         jax.block_until_ready(loss)
 

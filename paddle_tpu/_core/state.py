@@ -69,8 +69,17 @@ class _PRNGState:
 
     def seed(self, s: int):
         self._seed = int(s)
-        self._key = jax.random.key(int(s))
+        # LAZY: creating the key here would initialize the jax backend at
+        # `import paddle_tpu` time — seconds of TPU-plugin setup (or a
+        # deadlock when another process holds the TPU tunnel) before any
+        # user code runs. The key materializes on first random use.
+        self._key = None
         self._eager_counter = 0
+
+    def _base_key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def next_key(self):
         """Return a fresh PRNG key.
@@ -85,7 +94,7 @@ class _PRNGState:
             entry[1] += 1
             return k
         self._eager_counter += 1
-        return jax.random.fold_in(self._key, self._eager_counter)
+        return jax.random.fold_in(self._base_key(), self._eager_counter)
 
     def next_np_seed(self) -> int:
         """Derive a 32-bit seed for host-side numpy Generators (samplers,

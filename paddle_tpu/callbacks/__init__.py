@@ -197,6 +197,8 @@ class VisualDL(Callback):
     def on_train_end(self, logs=None):
         if self._writer is not None:
             self._writer.close()
+            # a reused callback instance must reopen a fresh event stream
+            self._writer = None
 
 
 class ReduceLROnPlateau(Callback):

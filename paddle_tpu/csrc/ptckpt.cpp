@@ -164,29 +164,48 @@ void* ptckpt_reader_open(const char* path) {
   r->len = size_t(st.st_size);
   r->map = static_cast<uint8_t*>(
       mmap(nullptr, r->len, PROT_READ, MAP_PRIVATE, r->fd, 0));
-  if (r->map == MAP_FAILED || r->len < 24) {
+  if (r->map == MAP_FAILED) {
     close(r->fd); delete r; return nullptr;
+  }
+  if (r->len < 24) {
+    munmap(r->map, r->len); close(r->fd); delete r; return nullptr;
   }
   uint64_t magic_head, magic_tail, index_off;
   memcpy(&magic_head, r->map, 8);
   memcpy(&magic_tail, r->map + r->len - 8, 8);
   memcpy(&index_off, r->map + r->len - 16, 8);
-  if (magic_head != kMagic || magic_tail != kMagic || index_off >= r->len) {
+  // the index must live between the header magic and the footer;
+  // compare without adding to index_off (a crafted value near 2^64
+  // would wrap and defeat the check)
+  if (magic_head != kMagic || magic_tail != kMagic ||
+      index_off < 8 || index_off > r->len - 24) {
     munmap(r->map, r->len); close(r->fd); delete r; return nullptr;
   }
+  // Bounds-check every index entry against the mapped range: a truncated
+  // or corrupt file with intact magics must fail to open, not read OOB.
   const uint8_t* p = r->map + index_off;
+  const uint8_t* end = r->map + r->len - 16;  // index stops at the footer
   uint64_t n;
   memcpy(&n, p, 8); p += 8;
   for (uint64_t i = 0; i < n; ++i) {
     uint32_t nl;
+    if (p + 4 > end) goto corrupt;
     memcpy(&nl, p, 4); p += 4;
-    Entry e;
-    e.name.assign(reinterpret_cast<const char*>(p), nl); p += nl;
-    memcpy(&e.offset, p, 8); p += 8;
-    memcpy(&e.nbytes, p, 8); p += 8;
-    r->index.push_back(std::move(e));
+    if (nl > size_t(end - p) || size_t(end - p) < nl + 16) goto corrupt;
+    {
+      Entry e;
+      e.name.assign(reinterpret_cast<const char*>(p), nl); p += nl;
+      memcpy(&e.offset, p, 8); p += 8;
+      memcpy(&e.nbytes, p, 8); p += 8;
+      // blob must sit entirely in [8, index_off)
+      if (e.offset < 8 || e.offset > index_off ||
+          e.nbytes > index_off - e.offset) goto corrupt;
+      r->index.push_back(std::move(e));
+    }
   }
   return r;
+corrupt:
+  munmap(r->map, r->len); close(r->fd); delete r; return nullptr;
 }
 
 int64_t ptckpt_num_entries(void* h) {

@@ -14,7 +14,7 @@ from .parallel_wrappers import DataParallel  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     ProcessMesh, Placement, Replicate, Shard, Partial, shard_tensor, reshard,
-    shard_layer, dtensor_from_local,
+    shard_layer, dtensor_from_local, to_static, DistModel, shard_dataloader,
 )
 from ..parallel.mesh import create_mesh, get_mesh  # noqa: F401
 from ..parallel.ring import ring_attention  # noqa: F401

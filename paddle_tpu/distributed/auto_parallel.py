@@ -92,5 +92,84 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None, output_fn=Non
     return layer
 
 
+def _mesh_from_layer(layer):
+    """Mesh the layer's parameters were placed on (via shard_tensor), the
+    fleet global mesh, or None (single device)."""
+    for _, p in layer.named_parameters():
+        sh = getattr(unwrap(p), "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return sh.mesh
+    from . import env
+    return env.get_global_mesh()
+
+
+class DistModel:
+    """reference: distributed/auto_parallel/api.py DistModel — the object
+    `to_static` returns. Calling it in train mode runs one compiled
+    hybrid-parallel step (loss returned); in eval mode computes the loss
+    without updating; in predict mode returns outputs."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        from ..parallel.trainer import Trainer
+
+        self._layer = layer
+        self._loss = loss
+        self._mode = "train"
+        mesh = _mesh_from_layer(layer)
+        bspec = None
+        if mesh is not None and "dp" in mesh.shape and mesh.shape["dp"] > 1:
+            bspec = P("dp")  # prefix spec: every batch leaf dp-sharded
+
+        def trainer_loss(model, batch):
+            *inputs, labels = batch
+            out = model(*inputs)
+            return loss(out, labels)
+
+        self._trainer = Trainer(layer, optimizer, trainer_loss, mesh=mesh,
+                                batch_spec=bspec)
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self._layer.eval()
+
+    def dist_main_program(self, mode=None):  # parity introspection hooks
+        return None
+
+    def state_dict(self, mode="all"):
+        self._trainer.sync_model()
+        return self._layer.state_dict()
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            return self._trainer.step(tuple(args))
+        self._trainer.sync_model()
+        if self._mode == "predict":
+            return self._layer(*args)
+        *inputs, labels = args
+        return self._loss(self._layer(*inputs), labels)
+
+
 def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
-    raise NotImplementedError("use paddle_tpu.parallel.Trainer (round 2: facade)")
+    """reference: python/paddle/distributed/auto_parallel/api.py:2988.
+
+    Compiles the (layer, loss, optimizer) triple into a single jitted
+    hybrid-parallel train step over the mesh the layer's parameters were
+    shard_tensor-placed on. Returns a DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     input_keys=None):
+    """reference api.py shard_dataloader: under the single-controller JAX
+    model each host iterates the global batch and `to_static` shards it
+    onto the mesh (dp prefix spec), so the loader passes through."""
+    return dataloader

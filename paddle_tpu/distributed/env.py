@@ -19,12 +19,32 @@ _hybrid_topology = None
 
 def init_parallel_env():
     """reference: paddle.distributed.init_parallel_env. Multi-host init is
-    driven by env vars (COORDINATOR_ADDRESS etc.) via jax.distributed."""
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+    driven by env vars set by paddle_tpu.distributed.launch.
+
+    jax.distributed.initialize() only auto-detects the coordinator on known
+    cluster environments (GKE/Cloud TPU metadata); on a bare launch the
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID vars our launcher exports are NOT
+    read by jax itself, so pass them explicitly. A failed rendezvous must
+    raise: silently continuing would run N independent single-process
+    trainers that all see the same data shard and produce wrong results.
+    """
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coord and jax.process_count() == 1:
+        nproc = os.environ.get("JAX_NUM_PROCESSES")
+        pid = os.environ.get("JAX_PROCESS_ID")
         try:
-            jax.distributed.initialize()
-        except Exception:
-            pass
+            if nproc is not None and pid is not None:
+                jax.distributed.initialize(coordinator_address=coord,
+                                           num_processes=int(nproc),
+                                           process_id=int(pid))
+            else:
+                jax.distributed.initialize(coordinator_address=coord)
+        except Exception as e:
+            raise RuntimeError(
+                f"init_parallel_env: jax.distributed.initialize failed "
+                f"(coordinator={coord}, num_processes={nproc}, "
+                f"process_id={pid}). Refusing to continue as a "
+                f"single-process trainer inside a multi-host launch.") from e
     return get_rank()
 
 

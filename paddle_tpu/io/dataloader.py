@@ -12,6 +12,7 @@ import collections
 import itertools
 import queue
 import threading
+import time
 
 import numpy as np
 import jax
@@ -65,6 +66,124 @@ def default_collate_fn(batch):
         transposed = list(zip(*batch))
         return type(sample)(default_collate_fn(list(col)) for col in transposed)
     return batch
+
+
+class _ProcessPrefetchIterator:
+    """Process-pool prefetch: true parallel Python decode (no GIL).
+
+    Uses the spawn context (fork is unsafe after jax backend init) and the
+    jax-free `pt_ioworker` module as the child target — a worker that
+    imported paddle_tpu would race the parent for TPU-plugin init and
+    deadlock. The dataset/collate_fn must be picklable (and, with a custom
+    collate_fn, must not import jax in the child); workers are per-epoch."""
+
+    def __init__(self, loader, index_iter):
+        import multiprocessing as mp
+
+        import pt_ioworker
+        # forkserver: the server process is a fresh jax-free python (it
+        # only imports __main__'s module file, never the parent's loaded
+        # jax), and each worker is a cheap fork of it — spawn-level safety
+        # at ~ms per-worker startup. Plain fork would clone a live jax/TPU
+        # runtime; plain spawn pays a full interpreter+imports per worker.
+        try:
+            ctx = mp.get_context("forkserver")
+        except ValueError:  # pragma: no cover (non-POSIX)
+            ctx = mp.get_context("spawn")
+        self.loader = loader
+        # None → the worker's numpy-only default collate (NOT ours, which
+        # would drag paddle_tpu/jax into the child)
+        collate = loader.collate_fn
+        self.task_q = ctx.Queue()
+        self.res_q = ctx.Queue(maxsize=max(
+            2, loader.prefetch_factor * loader.num_workers))
+        nw = loader.num_workers
+        # bounded dispatch: only ~window tasks are outstanding at once, so
+        # one slow batch can't make the others pile up in _out_buf (the
+        # res_q maxsize alone doesn't bound memory — the in-order server
+        # drains it while waiting for the straggler)
+        self._tasks = list(index_iter)
+        self.n_batches = len(self._tasks)
+        self._window = max(2, loader.prefetch_factor * nw) + nw
+        self._dispatched = 0
+        self.served = 0
+        self._sentinels_sent = False
+        self._feed_tasks()
+        from .._core.state import prng
+        base_seed = prng.next_np_seed()  # epoch- and pt.seed()-dependent
+        self.procs = []
+        for wid in range(nw):
+            p = ctx.Process(
+                target=pt_ioworker.worker_main,
+                args=(self.task_q, self.res_q, loader.dataset, collate,
+                      wid, nw, loader.worker_init_fn, base_seed),
+                daemon=True)
+            p.start()
+            self.procs.append(p)
+        self._out_buf = {}
+        self._next_serve = 0
+
+    def _feed_tasks(self):
+        while (self._dispatched < self.n_batches and
+               self._dispatched - self.served < self._window):
+            self.task_q.put(self._tasks[self._dispatched])
+            self._dispatched += 1
+        if self._dispatched >= self.n_batches and not self._sentinels_sent:
+            for _ in range(self.loader.num_workers):
+                self.task_q.put(None)  # one sentinel per worker
+            self._sentinels_sent = True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.served >= self.n_batches:
+            self.shutdown()
+            raise StopIteration
+        deadline = (time.monotonic() + self.loader.timeout
+                    if self.loader.timeout else None)
+        while self._next_serve not in self._out_buf:
+            try:
+                seq, batch = self.res_q.get(timeout=2.0)
+            except queue.Empty:
+                # blocked-forever guard: if every worker is gone and no
+                # result is buffered, the epoch can never finish
+                if not any(p.is_alive() for p in self.procs):
+                    self.shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker processes exited before "
+                        "producing all batches. If this happened at "
+                        "startup, the entry script likely lacks the "
+                        "`if __name__ == '__main__':` guard that "
+                        "multiprocessing start methods require.")
+                if deadline is not None and time.monotonic() > deadline:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.loader.timeout}s "
+                        f"waiting for worker batches")
+                continue
+            self._out_buf[seq] = batch
+        batch = self._out_buf.pop(self._next_serve)
+        self._next_serve += 1
+        self.served += 1
+        self._feed_tasks()
+        if isinstance(batch, Exception):
+            self.shutdown()
+            raise batch
+        return _to_tensors(batch, self.loader.return_list)
+
+    def shutdown(self):
+        for p in self.procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self.procs:
+            p.join(timeout=5)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.shutdown()
+        except Exception:
+            pass
 
 
 class _PrefetchIterator:
@@ -153,7 +272,8 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 use_process_workers=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn
@@ -161,6 +281,14 @@ class DataLoader:
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
+        # process workers decode Python datasets in true parallel (paddle's
+        # _DataLoaderIterMultiProcess); threads remain the default because
+        # they need no picklability and libptio covers the byte pipeline
+        if use_process_workers is None:
+            import os
+            use_process_workers = os.environ.get(
+                "PT_DATALOADER_PROCS", "0") == "1"
+        self.use_process_workers = use_process_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -196,6 +324,9 @@ class DataLoader:
                     for i in range(len(self.dataset)))
         if self.num_workers == 0:
             return self._iter_sync()
+        if self.use_process_workers:
+            return _ProcessPrefetchIterator(
+                self, enumerate(iter(self.batch_sampler)))
         it = _PrefetchIterator(self, enumerate(iter(self.batch_sampler)))
         return it
 

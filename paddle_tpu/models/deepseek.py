@@ -15,6 +15,7 @@ TPU-native design notes:
 """
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 
 import jax
@@ -118,9 +119,10 @@ class MLAttention(nn.Layer):
             # pad v head dim to match qk dim for the kernel, slice after
             if dv < dn + dr:
                 vh = jnp.pad(vh, ((0, 0),) * 3 + ((0, dn + dr - dv),))
+            # static python float: sm_scale is a nondiff argnum of the pallas
+            # custom_vjp — a traced array would fail under jit on TPU
             o = flash_attention_bhsd(qh, kh, vh, causal=True,
-                                     sm_scale=1.0 / jnp.sqrt(
-                                         jnp.asarray(dn + dr, jnp.float32)))
+                                     sm_scale=1.0 / math.sqrt(dn + dr))
             o = o[..., :dv].swapaxes(1, 2).reshape(b, s, nh * dv)
             return o @ wo
 

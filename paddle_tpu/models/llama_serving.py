@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
 from ..ops.paged_attention import paged_attention
+from ..ops.varlen_attention import (flash_attention_varlen,
+                                    seg_ids_from_cu_seqlens)
 from .llama import LlamaConfig
 
 
@@ -72,6 +74,55 @@ def prefill(params, input_ids, length, config: LlamaConfig, use_pallas=False):
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
     logits = h[0, length - 1] @ params["lm_head"]
     return logits, kv[0], kv[1]
+
+
+@functools.partial(jax.jit, static_argnames=("config", "use_pallas",
+                                             "interpret"))
+def prefill_varlen(params, input_ids, cu_seqlens, config: LlamaConfig,
+                   use_pallas=False, interpret=False):
+    """Ragged-batch prefill in ONE call (reference parity:
+    flash_attn_unpadded serving prefill).
+
+    input_ids: (T_pad,) all admitted prompts packed back to back;
+    cu_seqlens: (B+1,) prefix sums (fixed length → batch-size changes
+    don't recompile; unused tail entries repeat the last offset).
+    Returns (per-seq next-token logits (B, V),
+             k_all, v_all: (L, KVH, T_pad, D))."""
+    c = config
+    nh, nkv = c.num_attention_heads, c.num_key_value_heads
+    hd = c.hidden_size // nh
+    t = input_ids.shape[0]
+    seg = seg_ids_from_cu_seqlens(cu_seqlens, t)
+    # in-segment position for RoPE (0 for padding; masked away anyway)
+    starts = jnp.concatenate([cu_seqlens[:1] * 0, cu_seqlens])[seg + 1]
+    pos = jnp.maximum(jnp.arange(t, dtype=jnp.int32) - starts, 0)
+    cos, sin = rope_cos_sin(None, hd, base=c.rope_theta,
+                            position_ids=pos)          # (T, hd)
+    h = jnp.take(params["embed"], input_ids, axis=0)   # (T, H)
+
+    def layer(h, lp):
+        x = _rms(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(t, nh, hd)
+        k = (x @ lp["wk"]).reshape(t, nkv, hd)
+        v = (x @ lp["wv"]).reshape(t, nkv, hd)
+        q, k = apply_rotary_emb(q, k, cos[:, None], sin[:, None])
+        o = flash_attention_varlen(q, k, v, seg, seg, causal=True,
+                                   use_pallas=use_pallas,
+                                   interpret=interpret,
+                                   same_offsets=True)   # (T, nh, hd)
+        h = h + o.reshape(t, -1) @ lp["wo"]
+        x = _rms(h, lp["ln2"], c.rms_norm_eps)
+        mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return h + mlp, (k, v)
+
+    h, kv = jax.lax.scan(layer, h, params["layers"])
+    h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    last = jnp.maximum(cu_seqlens[1:] - 1, 0)          # (B,)
+    logits = h[last] @ params["lm_head"]               # (B, V)
+    # (L, T, KVH, D) → (L, KVH, T, D) to match the pool scatter layout
+    k_all = jnp.swapaxes(kv[0], 1, 2)
+    v_all = jnp.swapaxes(kv[1], 1, 2)
+    return logits, k_all, v_all
 
 
 @functools.partial(jax.jit,
@@ -191,11 +242,59 @@ class ServingEngine:
         self._waiting.append(req)
 
     def _admit(self):
-        for slot in range(self.max_seqs):
-            if self._slots[slot] is not None or not self._waiting:
-                continue
-            req = self._waiting.pop(0)
-            self._prefill_into(slot, req)
+        """Admit all waiting requests that fit — ONE varlen prefill call
+        for the whole ragged batch (no per-sequence dense fallback)."""
+        free_slots = [s for s in range(self.max_seqs)
+                      if self._slots[s] is None]
+        take = min(len(free_slots), len(self._waiting))
+        if take == 0:
+            return
+        if take == 1:
+            self._prefill_into(free_slots[0], self._waiting.pop(0))
+            return
+        reqs = [self._waiting.pop(0) for _ in range(take)]
+        slots = free_slots[:take]
+        lens = [len(r.prompt) for r in reqs]
+        total = sum(lens)
+        bucket = max(self.page_size, 1 << math.ceil(math.log2(max(total, 1))))
+        ids = np.zeros((bucket,), np.int64)
+        cu = np.zeros((self.max_seqs + 1,), np.int32)
+        off = 0
+        for i, r in enumerate(reqs):
+            ids[off:off + lens[i]] = r.prompt
+            off += lens[i]
+            cu[i + 1] = off
+        cu[take + 1:] = off  # unused tail: zero-length segments
+        logits, k_all, v_all = prefill_varlen(
+            self.params, jnp.asarray(ids), jnp.asarray(cu), self.config,
+            use_pallas=self._use_pallas, interpret=self._interpret)
+        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        for i, (slot, req) in enumerate(zip(slots, reqs)):
+            a, b = int(cu[i]), int(cu[i + 1])
+            self._scatter_prompt(slot, k_all[:, :, a:b], v_all[:, :, a:b],
+                                 lens[i])
+            req.slot = slot
+            req.next_token = int(nxt[i])
+            req.output.append(int(nxt[i]))
+            self._slots[slot] = req
+            if req.done:
+                self.finished.append(req)
+                self._release(slot)
+
+    def _scatter_prompt(self, slot, kq, vq, S):
+        """Scatter a prompt's per-layer K/V (L, KVH, S, D) into fresh
+        pages for `slot` and set its length."""
+        n_pages = -(-S // self.page_size)
+        self._seq_pages[slot] = []
+        pages = self._alloc_pages(slot, n_pages)
+        pos = np.arange(S)
+        pg = np.asarray(pages)[pos // self.page_size]
+        off = pos % self.page_size
+        self.k_pool = self.k_pool.at[:, :, pg, off].set(
+            kq.astype(self.k_pool.dtype))
+        self.v_pool = self.v_pool.at[:, :, pg, off].set(
+            vq.astype(self.v_pool.dtype))
+        self.lengths = self.lengths.at[slot].set(S)
 
     def _alloc_pages(self, slot, n):
         if len(self._free) < n:
@@ -219,18 +318,7 @@ class ServingEngine:
         logits, k_all, v_all = prefill(self.params, jnp.asarray(ids),
                                        jnp.asarray(S), c,
                                        use_pallas=self._use_pallas)
-        # scatter prompt K/V into freshly-allocated pages
-        n_pages = -(-S // self.page_size)
-        self._seq_pages[slot] = []
-        pages = self._alloc_pages(slot, n_pages)
-        pos = np.arange(S)
-        pg = np.asarray(pages)[pos // self.page_size]
-        off = pos % self.page_size
-        kq = k_all[:, :, :S].astype(self.k_pool.dtype)  # (L, KVH, S, D)
-        vq = v_all[:, :, :S].astype(self.v_pool.dtype)
-        self.k_pool = self.k_pool.at[:, :, pg, off].set(kq)
-        self.v_pool = self.v_pool.at[:, :, pg, off].set(vq)
-        self.lengths = self.lengths.at[slot].set(S)
+        self._scatter_prompt(slot, k_all[:, :, :S], v_all[:, :, :S], S)
         req.slot = slot
         first = int(jnp.argmax(logits))
         req.next_token = first

@@ -134,7 +134,12 @@ def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
     h = jnp.take(params["embed"], input_ids, axis=0)
 
     layer = functools.partial(decoder_layer, config=c, sp_axis=sp_axis)
-    if remat:
+    if remat == "dots":
+        # save matmul outputs, recompute only elementwise — ~MFU win over
+        # full remat when activations still fit in HBM
+        layer = jax.checkpoint(
+            layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif remat:
         layer = jax.checkpoint(layer)
 
     use_pp = mesh is not None and mesh.shape.get("pp", 1) > 1

@@ -8,5 +8,6 @@ from .loss import *  # noqa: F401,F403
 from .vision import *  # noqa: F401,F403
 from .extension import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
-    scaled_dot_product_attention, flash_attention, sdp_kernel,
+    scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
+    sdp_kernel,
 )

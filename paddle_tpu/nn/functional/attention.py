@@ -67,10 +67,19 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
-                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
-                        causal=False, return_softmax=False, training=True,
-                        name=None):
-    """Varlen parity shim: runs dense flash attention per segment boundaries
-    encoded by cu_seqlens (static python ints expected)."""
-    raise NotImplementedError(
-        "flash_attn_unpadded: use paged/ragged attention (round 2)")
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        training=True, name=None):
+    """Varlen flash attention over packed (total, H, D) tensors
+    (reference: python/paddle/nn/functional/flash_attention.py:756).
+    Backed by the segment-id pallas kernel in ops/varlen_attention.py."""
+    from ...ops.varlen_attention import flash_attn_unpadded as _unpadded
+
+    def fn(q, k, v):
+        out, _ = _unpadded(q, k, v, unwrap(cu_seqlens_q),
+                           unwrap(cu_seqlens_k), max_seqlen_q, max_seqlen_k,
+                           scale=scale, dropout=dropout, causal=causal,
+                           training=training)
+        return out
+    out = apply(fn, query, key, value, name="flash_attn_unpadded")
+    return (out, None)

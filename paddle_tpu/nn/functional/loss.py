@@ -345,7 +345,11 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
             logp[:, :, :U], lab_i[:, None, :, None], axis=3)[..., 0]
         blank_p = logp[..., blank]                     # (B, T, U+1)
         if fastemit_lambda:
-            emit = emit + jnp.log1p(jnp.asarray(fastemit_lambda, jnp.float32))
+            # FastEmit (warp-transducer semantics): leave the loss VALUE
+            # unchanged and scale emission-path gradients by (1+λ).
+            # value: e(1+λ) − eλ = e;  grad: (1+λ)·de.
+            lam = jnp.asarray(fastemit_lambda, jnp.float32)
+            emit = emit * (1.0 + lam) - jax.lax.stop_gradient(emit * lam)
 
         u_range = jnp.arange(U1)
         u_valid = u_range[None, :] <= u_len[:, None]   # (B, U+1)
@@ -478,4 +482,62 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
 
 def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
                                    cutoffs, head_bias=None, name=None):
-    raise NotImplementedError("adaptive_log_softmax_with_loss: planned (round 2)")
+    """Adaptive (hierarchical) softmax NLL — reference:
+    python/paddle/nn/functional/loss.py:4458.
+
+    cutoffs: ``[c0, c1, ..., n_classes]``; head covers the ``c0`` shortlist
+    classes plus one logit per tail cluster. TPU redesign: instead of the
+    reference's per-cluster index_select/scatter (dynamic shapes), every
+    cluster's log-prob is computed densely for all rows and the right one
+    selected by mask — static shapes, MXU-friendly, identical math.
+    Returns (per-sample logprob ``output``, scalar ``loss = -mean``).
+    """
+    cuts = [int(c) for c in cutoffs]
+    c0 = cuts[0]
+    n_clusters = len(cuts) - 1
+
+    def fn(x, lab, hw, *rest):
+        bias = rest[-1] if head_bias is not None else None
+        tails = rest[:2 * n_clusters]
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = x[None]
+            lab = lab.reshape(1)
+        lab = lab.astype(jnp.int32)
+        head = x @ hw
+        if bias is not None:
+            head = head + bias
+        head_lp = jax.nn.log_softmax(head, axis=-1)          # (B, c0+K)
+        in_short = lab < c0
+        out = jnp.take_along_axis(
+            head_lp[:, :c0], jnp.clip(lab, 0, c0 - 1)[:, None], axis=1)[:, 0]
+        out = jnp.where(in_short, out, 0.0)
+        for i in range(1, n_clusters + 1):
+            low, high = cuts[i - 1], cuts[i]
+            w1, w2 = tails[2 * (i - 1)], tails[2 * (i - 1) + 1]
+            clp = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)  # (B, high-low)
+            rel = jnp.clip(lab - low, 0, high - low - 1)
+            local = jnp.take_along_axis(clp, rel[:, None], axis=1)[:, 0]
+            in_c = (lab >= low) & (lab < high)
+            out = jnp.where(in_c, head_lp[:, c0 + i - 1] + local, out)
+        loss = -jnp.mean(out)
+        if squeeze:
+            out = out[0]
+        return out, loss
+
+    args = [input, head_weight]
+    for pair in tail_weights:
+        args.extend(pair)
+    if head_bias is not None:
+        args.append(head_bias)
+    lab_raw = unwrap(label)
+    try:
+        lmin, lmax = int(jnp.min(lab_raw)), int(jnp.max(lab_raw))
+        if lmin < 0 or lmax >= cuts[-1]:
+            raise ValueError(
+                f"label values should be in [0, n_classes - 1], but values "
+                f"in range [{lmin}, {lmax}] were found.")
+    except TypeError:
+        pass  # traced labels: bounds unavailable
+    return apply(lambda x, hw, *r: fn(x, unwrap(label), hw, *r), input,
+                 *args[1:], name="adaptive_log_softmax_with_loss", multi=True)

@@ -466,11 +466,26 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         rep = hq // hk
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    o = flash_attention_bhsd(q, k, v, causal=causal, sm_scale=sm_scale,
-                             use_pallas=use_pallas)
     if dropout > 0.0 and training:
+        # reference kernel drops attention *probabilities* (each output is
+        # a partial sum over surviving keys), not whole outputs; no
+        # in-kernel PRNG, so materialize P on the XLA path
         from .._core.state import prng
-        keep = jax.random.bernoulli(prng.next_key(), 1.0 - dropout, o.shape)
-        o = jnp.where(keep, o / (1.0 - dropout), 0.0)
+        *_, sq, d = q.shape
+        sk = k.shape[-2]
+        scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        if causal:
+            cm = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+            s = jnp.where(cm, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        keep = jax.random.bernoulli(prng.next_key(), 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p,
+                       v.astype(jnp.float32)).astype(q.dtype)
+    else:
+        o = flash_attention_bhsd(q, k, v, causal=causal, sm_scale=sm_scale,
+                                 use_pallas=use_pallas)
     out = jnp.swapaxes(o, 1, 2)
     return (out, None) if not return_softmax else (out, None, None)

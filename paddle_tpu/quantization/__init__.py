@@ -68,16 +68,104 @@ class QuantConfig:
         pass
 
 
-class QAT:
-    """Quantization-aware training scaffold (full fake-quant round 2)."""
+def _qdq_ste(x, scale, qmax):
+    """Quantize-dequantize with a straight-through estimator: the value is
+    the rounded/clipped int grid point, the gradient flows as identity."""
+    s = jnp.maximum(scale, 1e-10)
+    qdq = jnp.clip(jnp.round(x / s), -qmax, qmax) * s
+    return x + jax.lax.stop_gradient(qdq - x)
 
-    def __init__(self, config: QuantConfig):
-        self.config = config
+
+class FakeQuanterChannelWiseAbsMax:
+    """Weight fake-quant: per-out-channel absmax scale, recomputed each
+    step from the live weight (reference: quanter ChannelWiseAbsMax)."""
+
+    def __init__(self, bits=8):
+        self.qmax = (1 << (bits - 1)) - 1
+
+    def __call__(self, w):
+        scale = jnp.max(jnp.abs(jax.lax.stop_gradient(w)), axis=0,
+                        keepdims=True) / self.qmax
+        return _qdq_ste(w, scale, self.qmax)
+
+
+class FakeQuanterMovingAverageAbsMax:
+    """Activation fake-quant: EMA of the batch absmax (reference:
+    FakeQuanterWithAbsMaxObserver). State is a python float on the layer —
+    updated eagerly during QAT (which trains eagerly here)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        self.qmax = (1 << (bits - 1)) - 1
+        self.momentum = momentum
+        self.running_absmax = None
+
+    def __call__(self, x, training=True):
+        cur = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+        if training or self.running_absmax is None:
+            try:
+                curf = float(cur)
+                self.running_absmax = (curf if self.running_absmax is None
+                                       else self.momentum * self.running_absmax
+                                       + (1 - self.momentum) * curf)
+            except Exception:
+                pass  # traced: fall back to the current batch stat
+        ref = (jnp.asarray(self.running_absmax, jnp.float32)
+               if self.running_absmax is not None else cur)
+        return _qdq_ste(x, ref / self.qmax, self.qmax)
+
+
+class QAT:
+    """Quantization-aware training (reference: quantization/qat.py:27).
+
+    quantize(): wraps each Linear so its forward computes with fake-
+    quantized weights and activations (STE gradients) — training sees
+    int8 noise while staying fp.
+    convert(): unwraps and swaps each trained Linear for the int8
+    weight-only QuantizedLinear the PTQ path uses at inference.
+    """
+
+    def __init__(self, config: QuantConfig = None):
+        self.config = config or QuantConfig()
 
     def quantize(self, model, inplace=False):
+        from ..nn.layer.common import Linear
+        for _, layer in model.named_sublayers(include_self=True):
+            if isinstance(layer, Linear) and layer.weight is not None \
+                    and not hasattr(layer, "_qat_wq"):
+                layer._qat_wq = FakeQuanterChannelWiseAbsMax()
+                layer._qat_aq = FakeQuanterMovingAverageAbsMax()
+                layer._orig_forward = layer.forward
+
+                def make_fwd(l):
+                    def fwd(inp):
+                        def fn(a, w, *b):
+                            af = l._qat_aq(a, training=l.training)
+                            wf = l._qat_wq(w)
+                            out = af @ wf
+                            if b:
+                                out = out + b[0]
+                            return out
+                        args = [inp, l.weight]
+                        if l.bias is not None:
+                            args.append(l.bias)
+                        return apply(fn, *args, name="qat_linear")
+                    return fwd
+                object.__setattr__(layer, "forward", make_fwd(layer))
         return model
 
     def convert(self, model, inplace=False):
+        """Swap QAT-wrapped Linears for int8 weight-only inference layers
+        (in place within their parents)."""
+        from ..nn.layer.common import Linear
+        for _, parent in model.named_sublayers(include_self=True):
+            for name, child in list(parent.named_children()):
+                if isinstance(child, Linear) and hasattr(child, "_qat_wq"):
+                    object.__setattr__(child, "forward",
+                                       child._orig_forward)
+                    setattr(parent, name, QuantizedLinear.from_linear(child))
+        if isinstance(model, Linear) and hasattr(model, "_qat_wq"):
+            object.__setattr__(model, "forward", model._orig_forward)
+            return QuantizedLinear.from_linear(model)
         return model
 
 

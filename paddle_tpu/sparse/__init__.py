@@ -234,16 +234,27 @@ def leaky_relu(x, negative_slope=0.01, name=None):
 
 
 def softmax(x, axis=-1, name=None):
-    """Row-wise softmax over the sparsity pattern (2D COO, axis=-1)."""
+    """Softmax over the sparsity pattern along the last axis, for COO
+    tensors of any rank (reference sparse softmax supports batched 2D/3D):
+    all leading indices together identify a "row"; nonzeros of a row
+    normalize among themselves via segment reductions."""
     b = x._bcoo
-    if len(b.shape) != 2 or axis not in (-1, 1):
-        raise NotImplementedError("sparse softmax: 2D, last axis only")
-    rows = b.indices[:, 0]
-    v = b.data.astype(jnp.float32)
+    nd = len(b.shape)
+    if axis not in (-1, nd - 1):
+        raise NotImplementedError("sparse softmax: last axis only")
     import jax
-    row_max = jax.ops.segment_max(v, rows, b.shape[0])
+    if nd == 2:
+        rows = b.indices[:, 0]
+        nrows = b.shape[0]
+    else:
+        # linearize all leading dims into a row id per nonzero
+        strides = np.cumprod([1] + list(b.shape[:-1][::-1]))[::-1][1:]
+        rows = sum(b.indices[:, i] * int(strides[i]) for i in range(nd - 1))
+        nrows = int(np.prod(b.shape[:-1]))
+    v = b.data.astype(jnp.float32)
+    row_max = jax.ops.segment_max(v, rows, nrows)
     e = jnp.exp(v - row_max[rows])
-    denom = jax.ops.segment_sum(e, rows, b.shape[0])
+    denom = jax.ops.segment_sum(e, rows, nrows)
     return _rebuild(x, (e / denom[rows]).astype(b.data.dtype))
 
 

@@ -1,23 +1,47 @@
 """paddle.static facade (reference: python/paddle/static).
 
 The reference's static graph (Program/Executor) is subsumed by XLA
-trace-and-compile; this module keeps the legacy API importable, mapping
-Program/Executor onto eager + jit so old scripts run.
+trace-and-compile; this module keeps the legacy API working — not just
+importable — on top of the eager tape:
+
+  * `data()` placeholders register themselves on the default Program.
+  * `Executor.run(feed=...)` honors the feed by replaying the recorded
+    tape forward with the placeholder values substituted (the tape
+    already stores each op's pure fn + inputs for the backward engine;
+    forward replay is the same walk in the opposite direction).
+  * `save`/`load` persist the Program's registered variables (parameters
+    created through the static.nn helpers) — and raise when there is
+    nothing registered rather than silently doing nothing.
+  * `nn.cond` / `nn.while_loop` lower to lax.cond / lax.while_loop when
+    the predicate is traced, so they survive jit; with concrete values
+    they execute eagerly (paddle dygraph behavior).
 """
 from __future__ import annotations
 
 import contextlib
+import pickle
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from .._core.tensor import Tensor
 from .. import nn as _nn
 
 
+def _uw(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_tracer(x):
+    return isinstance(_uw(x), jax.core.Tracer)
+
+
 class Program:
     def __init__(self):
         self._ops = []
+        self._vars = {}      # name -> Tensor (placeholders + parameters)
+        self._params = {}    # name -> Tensor (trainable only)
 
     def global_block(self):
         return self
@@ -25,13 +49,22 @@ class Program:
     def clone(self, for_test=False):
         return self
 
+    def _register(self, name, tensor, trainable=False):
+        self._vars[name] = tensor
+        if trainable:
+            self._params[name] = tensor
+
+    def list_vars(self):
+        return list(self._vars.values())
+
 
 _default_main = Program()
 _default_startup = Program()
+_guard_stack = []
 
 
 def default_main_program():
-    return _default_main
+    return _guard_stack[-1] if _guard_stack else _default_main
 
 
 def default_startup_program():
@@ -40,7 +73,41 @@ def default_startup_program():
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
-    yield
+    _guard_stack.append(main_program)
+    try:
+        yield
+    finally:
+        _guard_stack.pop()
+
+
+def _replay(fetch, feed_values):
+    """Re-execute the tape that produced `fetch` with leaf tensors whose
+    id appears in feed_values replaced. Returns the recomputed array."""
+    from .._core.engine import _topo_order
+
+    if fetch._node is None:
+        return feed_values.get(id(fetch), fetch._value)
+    order = list(reversed(_topo_order([fetch._node])))  # inputs → outputs
+    new_out = {}  # (id(node), out_idx) -> recomputed array
+
+    def value_of(t):
+        if id(t) in feed_values:
+            return feed_values[id(t)]
+        if t._node is not None and (id(t._node), t._out_idx) in new_out:
+            return new_out[(id(t._node), t._out_idx)]
+        return t._value
+
+    for node in order:
+        raw_in = [value_of(t) if t is not None else r
+                  for t, r in zip(node.input_tensors, node.raw_inputs)]
+        outs = node.fn(*raw_in, **node.kwargs) if node.kwargs else \
+            node.fn(*raw_in)
+        if node.multi:
+            for i, o in enumerate(outs):
+                new_out[(id(node), i)] = o
+        else:
+            new_out[(id(node), 0)] = outs
+    return value_of(fetch)
 
 
 class Executor:
@@ -48,24 +115,66 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None):
-        # In eager-first paddle_tpu, graphs execute immediately; fetch_list
-        # entries are already-computed tensors.
+        program = program or default_main_program()
+        feed_values = {}
+        if feed:
+            # map feed names onto registered placeholder tensors
+            unmatched = []
+            for name, val in feed.items():
+                ph = program._vars.get(name)
+                if ph is None:
+                    unmatched.append(name)
+                    continue
+                feed_values[id(ph)] = jnp.asarray(
+                    _uw(val), dtype=ph._value.dtype)
+            if unmatched:
+                raise KeyError(
+                    f"Executor.run: feed names {unmatched} match no "
+                    f"placeholder created by paddle.static.data under this "
+                    f"program")
         out = []
         for f in fetch_list or []:
-            out.append(np.asarray(f._value) if isinstance(f, Tensor) else f)
+            if isinstance(f, Tensor):
+                if feed_values and f._node is None and \
+                        id(f) not in feed_values:
+                    # no recorded graph to replay the feed through —
+                    # returning the stale zero-placeholder result would be
+                    # a silent lie (typical cause: graph built under
+                    # no_grad(), which suppresses tape recording)
+                    raise RuntimeError(
+                        "Executor.run(feed=...): fetched tensor has no "
+                        "recorded compute graph to replay the feed "
+                        "through. Build the static graph with gradients "
+                        "enabled (not under no_grad()) so ops are "
+                        "recorded.")
+                out.append(np.asarray(_replay(f, feed_values)
+                                      if feed_values else f._value))
+            else:
+                out.append(f)
         return out
 
 
 def data(name, shape, dtype="float32", lod_level=0):
     from .._core import dtypes as _dt
     sh = [1 if s in (None, -1) else s for s in shape]
-    return Tensor(jnp.zeros(sh, _dt.convert_dtype(dtype)), name=name)
+    # stop_gradient=False: the tape only records ops whose inputs require
+    # grad, and Executor.run(feed=...) replays that tape — a plain
+    # stop-gradient placeholder would leave `x * 3` unrecorded and feeds
+    # silently ignored
+    t = Tensor(jnp.zeros(sh, _dt.convert_dtype(dtype)), name=name,
+               stop_gradient=False)
+    default_main_program()._register(name, t)
+    return t
 
 
 class nn:
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None):
         layer = _nn.Linear(x.shape[-1], size)
+        prog = default_main_program()
+        base = name or f"fc_{len(prog._params)}"
+        prog._register(f"{base}.w", layer.weight, trainable=True)
+        prog._register(f"{base}.b", layer.bias, trainable=True)
         out = layer(x)
         if activation:
             out = getattr(_nn.functional, activation)(out)
@@ -73,27 +182,74 @@ class nn:
 
     @staticmethod
     def cond(pred, true_fn=None, false_fn=None, name=None):
-        import jax
-        p = pred._value if isinstance(pred, Tensor) else pred
+        if _is_tracer(pred):
+            def wrap(fn):
+                def g(_):
+                    out = fn() if fn else None
+                    return jax.tree_util.tree_map(
+                        _uw, out, is_leaf=lambda x: isinstance(x, Tensor))
+                return g
+            res = jax.lax.cond(_uw(pred), wrap(true_fn), wrap(false_fn), None)
+            return jax.tree_util.tree_map(Tensor, res)
+        p = _uw(pred)
         if bool(p):
             return true_fn() if true_fn else None
         return false_fn() if false_fn else None
 
     @staticmethod
     def while_loop(cond, body, loop_vars, is_test=False, name=None):
+        traced = any(_is_tracer(v) for v in loop_vars) or \
+            _is_tracer(cond(*loop_vars))
+        if traced:
+            def as_tensors(raws):
+                return [Tensor(r) for r in raws]
+
+            def c(raws):
+                return _uw(cond(*as_tensors(raws)))
+
+            def b(raws):
+                out = body(*as_tensors(raws))
+                out = out if isinstance(out, (list, tuple)) else [out]
+                return [_uw(o) for o in out]
+
+            init = [_uw(v) for v in loop_vars]
+            final = jax.lax.while_loop(c, b, init)
+            return [Tensor(f) for f in final]
         vars_ = list(loop_vars)
-        while bool(cond(*vars_)):
+        while bool(_uw(cond(*vars_))):
             out = body(*vars_)
             vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
         return vars_
 
 
 def save(program, model_path, protocol=4):
-    pass
+    """Persist the program's registered variables (parameters first;
+    falls back to all registered vars)."""
+    state = program._params or program._vars
+    if not state:
+        raise RuntimeError(
+            "static.save: this program has no registered variables — "
+            "nothing was created through paddle.static.data / static.nn "
+            "under it. (In paddle_tpu, dynamic-graph models save via "
+            "paddle.save / Layer.state_dict.)")
+    blob = {name: np.asarray(t._value) for name, t in state.items()}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(blob, f, protocol=protocol)
 
 
 def load(program, model_path, executor=None, var_list=None):
-    pass
+    """Restore variables saved by `save` into the program's tensors."""
+    with open(model_path + ".pdparams", "rb") as f:
+        blob = pickle.load(f)
+    state = program._params or program._vars
+    missing = [n for n in blob if n not in state]
+    if missing and not var_list:
+        raise KeyError(f"static.load: saved vars {missing} not registered "
+                       f"in this program")
+    for name, arr in blob.items():
+        t = state.get(name)
+        if t is not None:
+            t._replace(jnp.asarray(arr, dtype=t._value.dtype))
 
 
 class InputSpec:

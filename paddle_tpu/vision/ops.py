@@ -210,11 +210,144 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
     return apply(fn, x, img_size, name="yolo_box", multi=True)
 
 
+def _iou_wh(wh1, wh2):
+    """IoU of boxes at a common origin, by width/height only."""
+    inter = jnp.minimum(wh1[..., 0], wh2[..., 0]) * \
+        jnp.minimum(wh1[..., 1], wh2[..., 1])
+    union = wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _iou_xywh(b1, b2):
+    """IoU of center-format boxes (..., 4) in the same normalized frame."""
+    b1x1, b1x2 = b1[..., 0] - b1[..., 2] / 2, b1[..., 0] + b1[..., 2] / 2
+    b1y1, b1y2 = b1[..., 1] - b1[..., 3] / 2, b1[..., 1] + b1[..., 3] / 2
+    b2x1, b2x2 = b2[..., 0] - b2[..., 2] / 2, b2[..., 0] + b2[..., 2] / 2
+    b2y1, b2y2 = b2[..., 1] - b2[..., 3] / 2, b2[..., 1] + b2[..., 3] / 2
+    iw = jnp.maximum(jnp.minimum(b1x2, b2x2) - jnp.maximum(b1x1, b2x1), 0.0)
+    ih = jnp.maximum(jnp.minimum(b1y2, b2y2) - jnp.maximum(b1y1, b2y1), 0.0)
+    inter = iw * ih
+    a1 = (b1x2 - b1x1) * (b1y2 - b1y1)
+    a2 = (b2x2 - b2x1) * (b2y2 - b2y1)
+    return inter / jnp.maximum(a1 + a2 - inter, 1e-10)
+
+
+def _bce_logits(logit, label):
+    return jnp.maximum(logit, 0) - logit * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
 def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
-              ignore_thresh, downsample_ratio, **kw):
-    raise NotImplementedError(
-        "yolo_loss: use the generic detection losses; the fused CUDA "
-        "yolo_loss has no TPU counterpart yet")
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 loss (reference: python/paddle/vision/ops.py yolo_loss →
+    phi yolov3_loss kernel). x: (N, na*(5+nc), H, W); gt_box: (N, B, 4)
+    normalized center-format (x, y, w, h); gt_label: (N, B). Returns (N,)
+    per-image loss. Target assignment, ignore-threshold objectness, box-
+    size scaling and label smoothing follow the reference kernel
+    (paddle/phi/kernels/cpu/yolo_v3_loss_kernel.cc)."""
+    na = len(anchor_mask)
+    nc = class_num
+    anchors_np = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_np = np.asarray(anchor_mask, np.int64)
+
+    def fn(xr, gbox, glabel, *rest):
+        gscore = rest[0] if rest else None
+        n, _, h, w = xr.shape
+        b = gbox.shape[1]
+        in_w = float(downsample_ratio * w)
+        in_h = float(downsample_ratio * h)
+        p = xr.reshape(n, na, 5 + nc, h, w).astype(jnp.float32)
+        px, py = p[:, :, 0], p[:, :, 1]
+        pw, ph_ = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]                              # (n, na, nc, h, w)
+
+        all_anch = jnp.asarray(anchors_np)              # (A, 2)
+        mask_anch = jnp.asarray(anchors_np[mask_np])    # (na, 2)
+
+        gx, gy = gbox[..., 0], gbox[..., 1]             # (n, b)
+        gw, gh = gbox[..., 2], gbox[..., 3]
+        valid = gw > 1e-8
+        # best anchor per gt: wh IoU against ALL anchors in input pixels
+        gwh = jnp.stack([gw * in_w, gh * in_h], -1)     # (n, b, 2)
+        ious = _iou_wh(gwh[:, :, None], all_anch[None, None])   # (n, b, A)
+        best = jnp.argmax(ious, -1)                     # (n, b)
+        # position of best anchor inside the mask (-1 if not at this scale)
+        k = jnp.argmax(best[..., None] == jnp.asarray(mask_np)[None, None],
+                       -1)
+        in_mask = jnp.any(best[..., None] == jnp.asarray(mask_np)[None,
+                                                                  None], -1)
+        pos = valid & in_mask
+        gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+
+        # scatter targets: (n, na, h, w) maps built per-gt then max-merged
+        bidx = jnp.arange(n)[:, None] * jnp.ones((1, b), jnp.int32)
+        flat = lambda z: z.reshape(-1)
+
+        # out-of-range anchor index for non-positive gts → dropped by the
+        # scatter (negative indices would WRAP, not drop; and writing a
+        # default would clobber real targets landing on the same cell)
+        kk = jnp.where(pos, k, na)
+
+        def scat(vals, init=0.0):
+            t = jnp.full((n, na, h, w), init, jnp.float32)
+            return t.at[flat(bidx), flat(kk), flat(gj), flat(gi)].set(
+                flat(vals), mode="drop")
+
+        obj_mask = scat(jnp.ones_like(gx))              # 1 at positives
+        tx = scat(gx * w - gi.astype(jnp.float32))
+        ty = scat(gy * h - gj.astype(jnp.float32))
+        aw = mask_anch[k][..., 0]
+        ah = mask_anch[k][..., 1]
+        tw = scat(jnp.log(jnp.maximum(gw * in_w, 1e-9) / aw))
+        th = scat(jnp.log(jnp.maximum(gh * in_h, 1e-9) / ah))
+        tscale = scat(2.0 - gw * gh)
+        tobj = scat(gscore if gscore is not None else jnp.ones_like(gx))
+        # class one-hot scattered per gt
+        if use_label_smooth:
+            smooth = 1.0 / max(nc, 40) if nc > 1 else 0.0
+            on, off = 1.0 - smooth, smooth
+        else:
+            on, off = 1.0, 0.0
+        tcls = jnp.full((n, na, nc, h, w), 0.0, jnp.float32)
+        onehot = jax.nn.one_hot(glabel.astype(jnp.int32), nc,
+                                dtype=jnp.float32) * (on - off) \
+            + off                                        # (n, b, nc)
+        tcls = tcls.at[flat(bidx), flat(kk), :, flat(gj), flat(gi)].set(
+            onehot.reshape(-1, nc), mode="drop")
+
+        # ignore mask: decoded pred boxes with IoU > thresh vs any gt
+        gxs = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gys = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        bx = (jax.nn.sigmoid(px) + gxs) / w
+        by = (jax.nn.sigmoid(py) + gys) / h
+        bw = jnp.exp(pw) * mask_anch[None, :, 0, None, None] / in_w
+        bh = jnp.exp(ph_) * mask_anch[None, :, 1, None, None] / in_h
+        pred_boxes = jnp.stack([bx, by, bw, bh], -1)     # (n, na, h, w, 4)
+        gtb = jnp.where(valid[..., None], gbox, 0.0)
+        iou_pg = _iou_xywh(pred_boxes[:, :, :, :, None],
+                           gtb[:, None, None, None])     # (n,na,h,w,b)
+        iou_pg = jnp.where(valid[:, None, None, None], iou_pg, 0.0)
+        best_iou = jnp.max(iou_pg, -1)                   # (n, na, h, w)
+        noobj_mask = (best_iou <= ignore_thresh).astype(jnp.float32) * \
+            (1.0 - obj_mask)
+
+        loss_xy = tscale * obj_mask * (_bce_logits(px, tx) +
+                                       _bce_logits(py, ty))
+        loss_wh = tscale * obj_mask * (jnp.abs(pw - tw) + jnp.abs(ph_ - th))
+        loss_obj = obj_mask * _bce_logits(pobj, tobj) + \
+            noobj_mask * _bce_logits(pobj, 0.0)
+        loss_cls = obj_mask[:, :, None] * _bce_logits(pcls, tcls)
+        total = (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3)) +
+                 loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+        return total
+
+    args = (x, gt_box, gt_label)
+    if gt_score is not None:
+        args = args + (gt_score,)
+    return apply(fn, *args, name="yolo_loss")
 
 
 def _bilinear_sample(img, py, px):
@@ -247,8 +380,6 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     sh, sw = (stride, stride) if isinstance(stride, int) else stride
     ph, pw = (padding, padding) if isinstance(padding, int) else padding
     dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
-    if groups != 1:
-        raise NotImplementedError("deform_conv2d: groups>1 TBD")
 
     def fn(xr, off, wgt, *rest):
         msk = rest[0] if mask is not None else None
@@ -286,9 +417,17 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         sampled = jax.vmap(per_image)(
             xr, py, px, msk_r) if msk_r is not None else jax.vmap(
             lambda im, a, b: per_image(im, a, b, None))(xr, py, px)
-        # (N, C, K, ho, wo) × (Cout, C, K) → (N, Cout, ho, wo)
-        out = jnp.einsum("nckhw,ock->nohw", sampled,
-                         wgt.reshape(cout, cin, kh * kw))
+        if groups == 1:
+            # (N, C, K, ho, wo) × (Cout, C, K) → (N, Cout, ho, wo)
+            out = jnp.einsum("nckhw,ock->nohw", sampled,
+                             wgt.reshape(cout, cin, kh * kw))
+        else:
+            # grouped: each of `groups` output groups contracts only its
+            # c/groups slice of the sampled input channels
+            sg = sampled.reshape(n, groups, c // groups, kh * kw, ho, wo)
+            wg = wgt.reshape(groups, cout // groups, cin, kh * kw)
+            out = jnp.einsum("ngckhw,gock->ngohw", sg, wg).reshape(
+                n, cout, ho, wo)
         if rest and bias is not None:
             out = out + rest[-1].reshape(1, -1, 1, 1)
         return out

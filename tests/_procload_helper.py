@@ -1,0 +1,33 @@
+"""Picklable CPU-bound dataset for the process-worker DataLoader test.
+
+Lives in its own module (not the test file) so spawn workers can import
+it by reference; keep imports numpy-only so workers stay lightweight.
+"""
+import numpy as np
+
+
+class SlowPythonDecodeDataset:
+    """__getitem__ burns pure-Python cycles (GIL-bound in threads)."""
+
+    def __init__(self, n=64, work=120_000):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0
+        for j in range(self.work):  # pure python: holds the GIL
+            acc += j & 7
+        return np.full((8,), i, np.float32), np.int64(acc % 10)
+
+
+class RaisingDataset:
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i >= 4:
+            raise ValueError(f"boom at {i}")
+        return np.zeros(2, np.float32)

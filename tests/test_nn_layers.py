@@ -321,3 +321,56 @@ class TestLosses:
             x, pt.to_tensor(lab), pt.to_tensor(tl), pt.to_tensor(ul))
         loss.backward()
         assert np.isfinite(x.grad.numpy()).all()
+
+        # FastEmit (warp-transducer semantics): λ>0 leaves the loss VALUE
+        # unchanged and only scales emission-path gradients
+        out_fe = F.rnnt_loss(pt.to_tensor(logits), pt.to_tensor(lab),
+                             pt.to_tensor(tl), pt.to_tensor(ul),
+                             fastemit_lambda=0.5, reduction="none")
+        assert np.allclose(out_fe.numpy(), out.numpy(), atol=1e-5)
+        x2 = pt.to_tensor(logits, stop_gradient=False)
+        loss2 = pt.nn.RNNTLoss(fastemit_lambda=0.5)(
+            x2, pt.to_tensor(lab), pt.to_tensor(tl), pt.to_tensor(ul))
+        loss2.backward()
+        g0, g1 = x.grad.numpy(), x2.grad.numpy()
+        assert np.isfinite(g1).all()
+        assert not np.allclose(g0, g1)  # the regularizer acts on gradients
+
+
+class TestAdaptiveLogSoftmax:
+    def test_matches_torch(self):
+        """adaptive_log_softmax_with_loss vs torch.nn.AdaptiveLogSoftmaxWithLoss
+        (reference: python/paddle/nn/functional/loss.py:4458)."""
+        import torch
+        rng = np.random.RandomState(0)
+        B, IN, NC = 16, 12, 20
+        cutoffs_t = [4, 10]
+        x = rng.randn(B, IN).astype(np.float32)
+        y = rng.randint(0, NC, B)
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(IN, NC, cutoffs_t,
+                                                 div_value=2.0)
+        with torch.no_grad():
+            to = tm(torch.tensor(x), torch.tensor(y))
+        hw = tm.head.weight.detach().numpy().T
+        hb = (pt.to_tensor(tm.head.bias.detach().numpy())
+              if tm.head.bias is not None else None)
+        tails = [[pt.to_tensor(t[0].weight.detach().numpy().T),
+                  pt.to_tensor(t[1].weight.detach().numpy().T)]
+                 for t in tm.tail]
+        out, loss = F.adaptive_log_softmax_with_loss(
+            pt.to_tensor(x), pt.to_tensor(y), pt.to_tensor(hw), tails,
+            cutoffs_t + [NC], head_bias=hb)
+        assert np.abs(out.numpy() - to.output.numpy()).max() < 1e-4
+        assert abs(float(loss) - float(to.loss)) < 1e-5
+
+    def test_bad_label_raises(self):
+        import pytest
+        rng = np.random.RandomState(1)
+        hw = rng.randn(4, 3).astype(np.float32)  # c0=2, 1 cluster
+        tails = [[pt.to_tensor(rng.randn(4, 2).astype(np.float32)),
+                  pt.to_tensor(rng.randn(2, 3).astype(np.float32))]]
+        with pytest.raises(ValueError):
+            F.adaptive_log_softmax_with_loss(
+                pt.to_tensor(rng.randn(2, 4).astype(np.float32)),
+                pt.to_tensor(np.array([0, 9])), pt.to_tensor(hw), tails,
+                [2, 5])

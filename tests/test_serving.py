@@ -95,3 +95,34 @@ class TestServing:
         eng.submit(Request("k", prompt, max_new_tokens=4))
         done = eng.run()
         assert done[0].output == ref
+
+    def test_ragged_batch_prefill_one_call(self, params):
+        """All admitted prompts prefill in ONE varlen call (no per-sequence
+        dense loop) and still match the dense reference."""
+        from paddle_tpu.models import llama_serving as S
+        prompts = [[1, 2, 3], [9, 8, 7, 6, 5, 4], [11, 12], [13] * 9]
+        refs = [greedy_reference(params, p, 4) for p in prompts]
+        calls = {"varlen": 0, "single": 0}
+        orig_v, orig_s = S.prefill_varlen, S.prefill
+
+        def spy_v(*a, **k):
+            calls["varlen"] += 1
+            return orig_v(*a, **k)
+
+        def spy_s(*a, **k):
+            calls["single"] += 1
+            return orig_s(*a, **k)
+
+        S.prefill_varlen, S.prefill = spy_v, spy_s
+        try:
+            eng = ServingEngine(params, CFG, max_seqs=4, max_seq_len=64,
+                                page_size=8, use_pallas=False)
+            for i, p in enumerate(prompts):
+                eng.submit(Request(f"r{i}", p, max_new_tokens=4))
+            done = eng.run()
+        finally:
+            S.prefill_varlen, S.prefill = orig_v, orig_s
+        assert calls["varlen"] == 1 and calls["single"] == 0
+        by_id = {r.rid: r.output for r in done}
+        for i, ref in enumerate(refs):
+            assert by_id[f"r{i}"] == ref, f"request {i} diverged"

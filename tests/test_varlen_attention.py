@@ -1,0 +1,221 @@
+"""Varlen (unpadded) flash attention vs per-segment dense reference.
+
+Reference parity target: python/paddle/nn/functional/flash_attention.py:756
+(flash_attn_unpadded with cu_seqlens prefix sums)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.ops.varlen_attention import (flash_attn_unpadded,
+                                             flash_attention_varlen,
+                                             varlen_reference, rev_pos,
+                                             seg_ids_from_cu_seqlens)
+
+H, D = 4, 32
+
+
+def dense_ref(q, k, v, cuq, cuk, causal):
+    """Per-segment dense attention; causal is bottom-right aligned
+    (flash-attention semantics for unequal q/k lengths)."""
+    outs = []
+    for i in range(len(cuq) - 1):
+        a, b = cuq[i], cuq[i + 1]
+        c, d = cuk[i], cuk[i + 1]
+        qi, ki, vi = q[a:b], k[c:d], v[c:d]
+        lq, lk = b - a, d - c
+        s = np.einsum("qhd,khd->hqk", qi, ki) / np.sqrt(D)
+        if causal:
+            m = np.arange(lk)[None, :] <= np.arange(lq)[:, None] + (lk - lq)
+            s = np.where(m[None], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        outs.append(np.einsum("hqk,khd->qhd", p, vi))
+    return np.concatenate(outs, 0)
+
+
+def _cu(lens):
+    return np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+
+
+class TestVarlenForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_per_segment_dense(self, causal):
+        rng = np.random.RandomState(0)
+        cu = _cu([37, 128, 3, 60])
+        t = int(cu[-1])
+        q = rng.randn(t, H, D).astype(np.float32)
+        k = rng.randn(t, H, D).astype(np.float32)
+        v = rng.randn(t, H, D).astype(np.float32)
+        out, _ = flash_attn_unpadded(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), cu, cu, causal=causal,
+                                     use_pallas=True, interpret=True)
+        ref = dense_ref(q, k, v, cu, cu, causal)
+        assert np.max(np.abs(np.asarray(out) - ref)) < 2e-4
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_unequal_qk_lengths(self, causal):
+        """kv-cache/cross-attn case: separate cu_seqlens_q / cu_seqlens_k,
+        causal bottom-right aligned per segment."""
+        rng = np.random.RandomState(1)
+        cuq, cuk = _cu([2, 3, 5]), _cu([4, 3, 9])
+        q = rng.randn(int(cuq[-1]), H, D).astype(np.float32)
+        k = rng.randn(int(cuk[-1]), H, D).astype(np.float32)
+        v = rng.randn(int(cuk[-1]), H, D).astype(np.float32)
+        out, _ = flash_attn_unpadded(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), cuq, cuk, causal=causal,
+                                     use_pallas=True, interpret=True)
+        ref = dense_ref(q, k, v, cuq, cuk, causal)
+        assert np.max(np.abs(np.asarray(out) - ref)) < 2e-4
+
+    def test_gqa_heads(self):
+        rng = np.random.RandomState(2)
+        cu = _cu([10, 22])
+        t = int(cu[-1])
+        q = rng.randn(t, 8, D).astype(np.float32)
+        k = rng.randn(t, 2, D).astype(np.float32)
+        v = rng.randn(t, 2, D).astype(np.float32)
+        out, _ = flash_attn_unpadded(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), cu, cu, causal=True,
+                                     use_pallas=True, interpret=True)
+        kr = np.repeat(k, 4, axis=1)
+        vr = np.repeat(v, 4, axis=1)
+        ref = dense_ref(q, kr, vr, cu, cu, True)
+        assert np.max(np.abs(np.asarray(out) - ref)) < 2e-4
+
+    def test_first_token_attends_only_itself(self):
+        rng = np.random.RandomState(3)
+        cu = _cu([5, 12, 3])
+        t = int(cu[-1])
+        q = jnp.asarray(rng.randn(t, H, D), jnp.float32)
+        out, _ = flash_attn_unpadded(q, q, q, cu, cu, causal=True,
+                                     use_pallas=True, interpret=True)
+        for s in cu[:-1]:
+            assert np.allclose(np.asarray(out[s]), np.asarray(q[s]),
+                               atol=1e-5)
+
+
+class TestVarlenBackward:
+    def test_grads_match_reference(self):
+        rng = np.random.RandomState(4)
+        cu = _cu([37, 100, 19])
+        t = int(cu[-1])
+        q = jnp.asarray(rng.randn(t, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(t, H, D), jnp.float32)
+        v = jnp.asarray(rng.randn(t, H, D), jnp.float32)
+        segs = seg_ids_from_cu_seqlens(jnp.asarray(cu), t)
+
+        def f_pallas(q, k, v):
+            return jnp.sum(flash_attention_varlen(
+                q, k, v, segs, segs, causal=True, use_pallas=True,
+                interpret=True) ** 2)
+
+        def f_ref(q, k, v):
+            o, _ = varlen_reference(jnp.swapaxes(q, 0, 1),
+                                    jnp.swapaxes(k, 0, 1),
+                                    jnp.swapaxes(v, 0, 1), segs, segs, True,
+                                    1.0 / np.sqrt(D))
+            return jnp.sum(jnp.swapaxes(o, 0, 1) ** 2)
+
+        g = jax.grad(f_pallas, (0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            assert float(jnp.max(jnp.abs(a - b))) < 2e-3
+
+    def test_grads_unequal_lengths(self):
+        rng = np.random.RandomState(5)
+        cuq, cuk = _cu([2, 7]), _cu([6, 9])
+        sq = seg_ids_from_cu_seqlens(jnp.asarray(cuq), int(cuq[-1]))
+        sk = seg_ids_from_cu_seqlens(jnp.asarray(cuk), int(cuk[-1]))
+        q = jnp.asarray(rng.randn(int(cuq[-1]), H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(int(cuk[-1]), H, D), jnp.float32)
+        v = jnp.asarray(rng.randn(int(cuk[-1]), H, D), jnp.float32)
+
+        def f_pallas(q, k, v):
+            return jnp.sum(flash_attention_varlen(
+                q, k, v, sq, sk, causal=True, use_pallas=True,
+                interpret=True) ** 2)
+
+        def f_ref(q, k, v):
+            o, _ = varlen_reference(jnp.swapaxes(q, 0, 1),
+                                    jnp.swapaxes(k, 0, 1),
+                                    jnp.swapaxes(v, 0, 1), sq, sk, True,
+                                    1.0 / np.sqrt(D))
+            return jnp.sum(jnp.swapaxes(o, 0, 1) ** 2)
+
+        g = jax.grad(f_pallas, (0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            assert float(jnp.max(jnp.abs(a - b))) < 2e-3
+
+
+class TestVarlenSurface:
+    def test_nn_functional_parity_entry(self):
+        rng = np.random.RandomState(6)
+        cu = _cu([8, 16])
+        t = int(cu[-1])
+        q = pt.to_tensor(rng.randn(t, H, D).astype(np.float32))
+        out, sm = pt.nn.functional.flash_attn_unpadded(q, q, q, cu, cu,
+                                                       causal=True)
+        assert sm is None
+        assert np.isfinite(out.numpy()).all()
+        assert out.shape == [t, H, D]
+
+    def test_dropout_on_probabilities(self):
+        """dropout>0 must change results (applied to P, on the XLA path)
+        and keep rows normalized in expectation — not zero whole outputs."""
+        pt.seed(0)
+        rng = np.random.RandomState(7)
+        cu = _cu([64])
+        t = int(cu[-1])
+        q = pt.to_tensor(rng.randn(t, 2, 16).astype(np.float32))
+        o0, _ = pt.nn.functional.flash_attn_unpadded(q, q, q, cu, cu)
+        o1, _ = pt.nn.functional.flash_attn_unpadded(q, q, q, cu, cu,
+                                                     dropout=0.5)
+        assert not np.allclose(o0.numpy(), o1.numpy())
+        # E[dropped P] = P, so the mean over many keys stays in range
+        assert np.isfinite(o1.numpy()).all()
+
+    def test_rev_pos(self):
+        seg = jnp.asarray([0, 0, 0, 1, 1, 2], jnp.int32)
+        r = np.asarray(rev_pos(seg))
+        assert list(r) == [3, 2, 1, 2, 1, 1]
+
+
+class TestVarlenPadding:
+    def test_pad_rows_produce_zero_not_garbage(self):
+        """Tokens past cu_seqlens[-1] must attend nothing: pad q rows give
+        exactly 0 output (safe-l), and real rows are unaffected by pads."""
+        rng = np.random.RandomState(8)
+        cu = _cu([5, 9])
+        t = int(cu[-1])
+        pad = 6
+        q = rng.randn(t + pad, H, D).astype(np.float32)
+        out, _ = flash_attn_unpadded(jnp.asarray(q), jnp.asarray(q),
+                                     jnp.asarray(q), cu, cu, causal=True,
+                                     use_pallas=True, interpret=True)
+        assert np.allclose(np.asarray(out[t:]), 0.0), "pad rows not zero"
+        out_nopad, _ = flash_attn_unpadded(jnp.asarray(q[:t]),
+                                           jnp.asarray(q[:t]),
+                                           jnp.asarray(q[:t]), cu, cu,
+                                           causal=True, use_pallas=True,
+                                           interpret=True)
+        assert np.abs(np.asarray(out[:t]) - np.asarray(out_nopad)).max() < 1e-5
+
+    def test_padded_one_side_causal_still_correct(self):
+        """k-side padded beyond cu while q exact: rev_pos sanitization must
+        keep real segment ends correct (non-monotone seg would corrupt)."""
+        rng = np.random.RandomState(9)
+        cu = _cu([4, 6])
+        t = int(cu[-1])
+        q = rng.randn(t, H, D).astype(np.float32)
+        k = rng.randn(t + 6, H, D).astype(np.float32)
+        k[:t] = rng.randn(t, H, D)
+        v = rng.randn(t + 6, H, D).astype(np.float32)
+        out, _ = flash_attn_unpadded(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), cu, cu, causal=True,
+                                     use_pallas=True, interpret=True)
+        ref = dense_ref(q, k[:t], v[:t], cu, cu, True)
+        assert np.abs(np.asarray(out) - ref).max() < 2e-4

@@ -1,6 +1,7 @@
 """Vision models / jit / distribution / sparse / incubate tests."""
 import numpy as np
 import pytest
+import jax.numpy as jnp
 
 import paddle_tpu as pt
 
@@ -466,3 +467,256 @@ class TestStaticFacade:
         y = x * 3
         out = exe.run(fetch_list=[y])
         assert np.allclose(out[0], [3.0, 6.0])
+
+    def test_executor_honors_feed(self):
+        """Executor.run(feed=...) replays the recorded graph with the fed
+        placeholder values — not just returns stale fetches."""
+        prog = pt.static.Program()
+        with pt.static.program_guard(prog):
+            x = pt.static.data("x", [None, 4])
+            y = pt.static.nn.fc(x, 8, activation="relu")
+        exe = pt.static.Executor()
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        out1 = exe.run(prog, feed={"x": a}, fetch_list=[y])[0]
+        assert out1.shape == (3, 8)
+        out2 = exe.run(prog, feed={"x": 2 * a}, fetch_list=[y])[0]
+        assert not np.allclose(out1, out2)  # feed actually changes results
+        # pure elementwise graph (no trainables in the path) also replays
+        with pt.static.program_guard(prog):
+            z = pt.static.data("z", [2])
+        w = z * 3
+        outz = exe.run(prog, feed={"z": np.asarray([1.0, 2.0], np.float32)},
+                       fetch_list=[w])[0]
+        assert np.allclose(outz, [3.0, 6.0])
+        # unknown feed name raises instead of being ignored
+        import pytest
+        with pytest.raises(KeyError):
+            exe.run(prog, feed={"nope": a}, fetch_list=[y])
+
+    def test_static_save_load_roundtrip(self, tmp_path):
+        prog = pt.static.Program()
+        with pt.static.program_guard(prog):
+            x = pt.static.data("x", [None, 4])
+            y = pt.static.nn.fc(x, 2)
+        exe = pt.static.Executor()
+        a = np.ones((1, 4), np.float32)
+        before = exe.run(prog, feed={"x": a}, fetch_list=[y])[0]
+        path = str(tmp_path / "model")
+        pt.static.save(prog, path)
+        # clobber the parameters, then restore
+        for t in prog._params.values():
+            t._replace(jnp.zeros_like(t._value))
+        zeroed = exe.run(prog, feed={"x": a}, fetch_list=[y])[0]
+        assert np.allclose(zeroed, 0)
+        pt.static.load(prog, path)
+        after = exe.run(prog, feed={"x": a}, fetch_list=[y])[0]
+        assert np.allclose(before, after)
+        # empty program refuses to "save"
+        import pytest
+        with pytest.raises(RuntimeError):
+            pt.static.save(pt.static.Program(), str(tmp_path / "empty"))
+
+    def test_cond_while_survive_jit(self):
+        """static.nn.cond / while_loop lower to lax under tracing."""
+        import jax
+
+        def f(x):
+            y = pt.static.nn.cond(x.sum() > 0,
+                                  lambda: x * 2,
+                                  lambda: x - 1)
+            return y
+
+        x = jnp.asarray([1.0, 2.0])
+        eager = f(pt.to_tensor(np.asarray(x)))
+        jitted = jax.jit(lambda a: pt.static.nn.cond(
+            a.sum() > 0, lambda: a * 2, lambda: a - 1))(x)
+        assert np.allclose(np.asarray(eager.numpy()), np.asarray(jitted))
+        neg = jax.jit(lambda a: pt.static.nn.cond(
+            a.sum() > 0, lambda: a * 2, lambda: a - 1))(-x)
+        assert np.allclose(np.asarray(neg), np.asarray(-x - 1))
+
+        def wl(n):
+            i, acc = pt.static.nn.while_loop(
+                lambda i, acc: i < n,
+                lambda i, acc: (i + 1, acc + i),
+                (jnp.asarray(0), jnp.asarray(0)))
+            return acc
+
+        out = jax.jit(wl)(jnp.asarray(5))
+        assert int(np.asarray(_as_arr(out))) == 10
+
+    def test_while_loop_eager(self):
+        i, acc = pt.static.nn.while_loop(
+            lambda i, acc: i < 4,
+            lambda i, acc: (i + 1, acc + 2 * i),
+            (pt.to_tensor(0), pt.to_tensor(0)))
+        assert int(acc.numpy()) == 12
+
+
+def _as_arr(x):
+    return x._value if hasattr(x, "_value") else x
+
+
+class TestYoloLossDeformGroups:
+    def test_yolo_loss_matches_numpy_reference(self):
+        """yolo_loss vs an independent numpy YOLOv3 loss (reference
+        semantics: phi yolo_v3_loss kernel — SCE xy, L1 wh with size
+        scale, ignore-thresh objectness, smoothed class BCE)."""
+        from paddle_tpu.vision.ops import yolo_loss
+        rng = np.random.default_rng(0)
+        N, H, W, nc = 2, 4, 4, 3
+        anchors = [10, 13, 16, 30, 33, 23]
+        mask = [0, 1, 2]
+        na = 3
+        down = 8
+        x = rng.standard_normal((N, na * (5 + nc), H, W)).astype(np.float32)
+        gt = np.zeros((N, 3, 4), np.float32)
+        gt[0, 0] = [0.3, 0.4, 0.2, 0.3]
+        gt[0, 1] = [0.7, 0.2, 0.5, 0.5]
+        gt[1, 0] = [0.5, 0.5, 0.1, 0.8]
+        lbl = np.array([[1, 2, 0], [0, 0, 0]], np.int64)
+
+        out = yolo_loss(pt.to_tensor(x), pt.to_tensor(gt), pt.to_tensor(lbl),
+                        anchors, mask, nc, ignore_thresh=0.5,
+                        downsample_ratio=down).numpy()
+
+        # independent numpy implementation
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        def bce(logit, label):
+            return np.maximum(logit, 0) - logit * label + \
+                np.log1p(np.exp(-np.abs(logit)))
+
+        anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+        in_w, in_h = down * W, down * H
+        p = x.reshape(N, na, 5 + nc, H, W)
+        smooth = 1.0 / max(nc, 40)
+        on, off = 1 - smooth, smooth
+        ref = np.zeros(N)
+        for n in range(N):
+            obj_m = np.zeros((na, H, W), bool)
+            tgt = {}
+            for b in range(gt.shape[1]):
+                gx, gy, gw, gh = gt[n, b]
+                if gw <= 1e-8:
+                    continue
+                ious = []
+                for a in range(len(anc)):
+                    iw = min(gw * in_w, anc[a, 0])
+                    ih = min(gh * in_h, anc[a, 1])
+                    inter = iw * ih
+                    union = gw * in_w * gh * in_h + anc[a, 0] * anc[a, 1] - inter
+                    ious.append(inter / union)
+                best = int(np.argmax(ious))
+                if best not in mask:
+                    continue
+                k = mask.index(best)
+                gi, gj = int(gx * W), int(gy * H)
+                obj_m[k, gj, gi] = True
+                tgt[(k, gj, gi)] = (gx * W - gi, gy * H - gj,
+                                    np.log(gw * in_w / anc[best, 0]),
+                                    np.log(gh * in_h / anc[best, 1]),
+                                    2 - gw * gh, lbl[n, b])
+            # ignore mask from decoded preds
+            loss = 0.0
+            for k in range(na):
+                aw, ah = anc[mask[k]]
+                for j in range(H):
+                    for i in range(W):
+                        bx = (sig(p[n, k, 0, j, i]) + i) / W
+                        by = (sig(p[n, k, 1, j, i]) + j) / H
+                        bw = np.exp(p[n, k, 2, j, i]) * aw / in_w
+                        bh = np.exp(p[n, k, 3, j, i]) * ah / in_h
+                        best_iou = 0
+                        for b in range(gt.shape[1]):
+                            if gt[n, b, 2] <= 1e-8:
+                                continue
+                            b1 = [bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2]
+                            g = gt[n, b]
+                            b2 = [g[0] - g[2] / 2, g[1] - g[3] / 2,
+                                  g[0] + g[2] / 2, g[1] + g[3] / 2]
+                            iw = max(min(b1[2], b2[2]) - max(b1[0], b2[0]), 0)
+                            ih = max(min(b1[3], b2[3]) - max(b1[1], b2[1]), 0)
+                            inter = iw * ih
+                            a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+                            a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+                            best_iou = max(best_iou, inter / (a1 + a2 - inter + 1e-10))
+                        if obj_m[k, j, i]:
+                            tx, ty, tw, th, sc, c = tgt[(k, j, i)]
+                            loss += sc * (bce(p[n, k, 0, j, i], tx) +
+                                          bce(p[n, k, 1, j, i], ty))
+                            loss += sc * (abs(p[n, k, 2, j, i] - tw) +
+                                          abs(p[n, k, 3, j, i] - th))
+                            loss += bce(p[n, k, 4, j, i], 1.0)
+                            for cc in range(nc):
+                                t = on if cc == c else off
+                                loss += bce(p[n, k, 5 + cc, j, i], t)
+                        elif best_iou <= 0.5:
+                            loss += bce(p[n, k, 4, j, i], 0.0)
+            ref[n] = loss
+        assert np.allclose(out, ref, rtol=1e-4, atol=1e-3), (out, ref)
+
+    def test_deform_conv_groups(self):
+        """groups>1: matches a plain grouped conv at zero offsets."""
+        import jax
+        import jax.numpy as jnp2
+        from paddle_tpu.vision import ops as V
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 8, 6, 6)).astype(np.float32)
+        w = rng.standard_normal((4, 4, 3, 3)).astype(np.float32)  # groups=2
+        off = np.zeros((2, 18, 6, 6), np.float32)
+        out = V.deform_conv2d(pt.to_tensor(x), pt.to_tensor(off),
+                              pt.to_tensor(w), padding=1, groups=2)
+        ref = jax.lax.conv_general_dilated(
+            jnp2.asarray(x), jnp2.asarray(w), (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=2)
+        assert np.abs(out.numpy() - np.asarray(ref)).max() < 1e-3
+
+
+class TestLKJCholesky:
+    def test_log_prob_matches_torch(self):
+        """reference: python/paddle/distribution/lkj_cholesky.py:128."""
+        import torch
+        from paddle_tpu.distribution import LKJCholesky
+        pt.seed(0)
+        for dim, conc in ((3, 1.0), (4, 2.5), (2, 0.7)):
+            d = LKJCholesky(dim, conc)
+            td = torch.distributions.LKJCholesky(dim, conc)
+            Ls = td.sample((5,))
+            ours = d.log_prob(pt.to_tensor(Ls.numpy())).numpy()
+            theirs = td.log_prob(Ls).numpy()
+            assert np.abs(ours - theirs).max() < 1e-4
+
+    def test_samples_are_valid_cholesky(self):
+        from paddle_tpu.distribution import LKJCholesky
+        pt.seed(1)
+        s = LKJCholesky(4, 1.5).sample((8,)).numpy()
+        assert s.shape == (8, 4, 4)
+        C = s @ np.swapaxes(s, -1, -2)
+        assert np.allclose(np.diagonal(C, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        assert np.allclose(s, np.tril(s))
+        assert (np.linalg.eigvalsh(C) > -1e-6).all()
+
+
+class TestSparseSoftmax3D:
+    def test_batched_3d_matches_masked_dense(self):
+        """sparse softmax beyond 2D (batched): nonzeros of each (i, j, :)
+        row normalize among themselves."""
+        sp = pt.sparse
+        rng = np.random.RandomState(0)
+        dense = rng.randn(2, 4, 5).astype(np.float32)
+        mask = rng.rand(2, 4, 5) < 0.5
+        mask[0, 0] = True  # at least one full row
+        idx = np.stack(np.nonzero(mask))
+        vals = dense[mask]
+        x = sp.sparse_coo_tensor(idx, vals, shape=[2, 4, 5])
+        out = sp.softmax(x).to_dense().numpy()
+        ref = np.zeros_like(dense)
+        for i in range(2):
+            for j in range(4):
+                nz = mask[i, j]
+                if nz.any():
+                    e = np.exp(dense[i, j, nz] - dense[i, j, nz].max())
+                    ref[i, j, nz] = e / e.sum()
+        assert np.abs(out - ref).max() < 1e-5
