@@ -118,7 +118,7 @@ def main():
     flops_per_token = 6 * n_matmul + 12 * L * H * seq  # fwd+bwd incl. attn
     mfu = flops_per_token * tok_per_sec / peak
 
-    print(json.dumps({
+    result = {
         "metric": f"llama-{'2048x8' if on_tpu else 'tiny'} pretrain "
                   f"tokens/sec/chip ({gen}, bf16, flash-attn, remat)",
         "value": round(tok_per_sec, 1),
@@ -126,7 +126,17 @@ def main():
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
                   "loss": float(loss), "backend": backend},
-    }))
+    }
+    print(json.dumps(result))
+    # perf-regression history: tests/test_perf_guard.py compares the last
+    # two same-backend/same-config entries
+    try:
+        hist = dict(result, ts=time.time(), batch=batch, seq=seq)
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_HISTORY.jsonl"), "a") as f:
+            f.write(json.dumps(hist) + "\n")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

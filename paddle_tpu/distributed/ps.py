@@ -1,0 +1,49 @@
+"""Parameter-server mode — explicit out-of-scope facade.
+
+Reference: python/paddle/distributed/ps/the_one_ps.py (TheOnePSRuntime:
+CPU parameter servers + trainer workers exchanging sparse/dense grads
+over DCN/BRPC).
+
+Design decision (documented, not a TODO): the PS architecture exists to
+scale *sparse* embedding tables beyond worker memory on commodity
+ethernet. On a TPU pod the same workloads are served by the SPMD path —
+embedding tables sharded over the mesh with XLA all-to-all on ICI (see
+parallel/tp.py VocabParallelEmbedding and parallel/moe.py), which is
+both faster and simpler than an external server tier; DCN-attached
+python parameter servers would bottleneck a pod. Every entry point here
+raises with that guidance rather than pretending to run.
+"""
+from __future__ import annotations
+
+_MSG = ("parameter-server mode is not part of the TPU execution model: "
+        "sparse/giant embedding tables are sharded over the device mesh "
+        "(VocabParallelEmbedding / fleet sharding) with XLA collectives "
+        "over ICI instead of an external server tier. Use "
+        "fleet.init(is_collective=True) and mesh sharding; see "
+        "docs/distributed.md.")
+
+
+class TheOnePSRuntime:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+class PsProgramBuilder:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(_MSG)
+
+
+def init_server(*a, **k):
+    raise NotImplementedError(_MSG)
+
+
+def init_worker(*a, **k):
+    raise NotImplementedError(_MSG)
+
+
+def run_server(*a, **k):
+    raise NotImplementedError(_MSG)
+
+
+def stop_worker(*a, **k):
+    raise NotImplementedError(_MSG)

@@ -1,5 +1,7 @@
 """paddle.incubate parity (reference: python/paddle/incubate/*)."""
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401
+from . import autograd  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import (  # noqa: F401
     ExponentialMovingAverage, LookAhead, ModelAverage,

@@ -1,0 +1,122 @@
+"""Automatic SParsity — 2:4 semi-structured pruning (reference:
+python/paddle/incubate/asp/asp.py).
+
+The reference maintains per-parameter masks and re-applies them inside a
+decorated optimizer so pruned weights stay zero through training (Ampere
+sparse-tensor-core format). The same n:m scheme is useful on TPU as a
+model-compression path (XLA has no sparse MXU mode, so the win is
+memory/regularization, not FLOPs — documented honestly here).
+
+API parity: set_excluded_layers / reset_excluded_layers / decorate /
+prune_model / calculate_density.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, unwrap
+
+__all__ = ["decorate", "prune_model", "set_excluded_layers",
+           "reset_excluded_layers", "calculate_density",
+           "check_sparsity_2_4", "create_mask_2_4"]
+
+_excluded = set()
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x):
+    v = np.asarray(unwrap(x))
+    return float((v != 0).sum() / max(v.size, 1))
+
+
+def create_mask_2_4(w):
+    """Best 2-of-4 mask along the last axis: keep the two largest |w| in
+    every group of four (the reference's MaskAlgo.MASK_2D_BEST per row)."""
+    v = np.asarray(unwrap(w))
+    flat = v.reshape(-1)
+    pad = (-flat.size) % 4
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(flat.reshape(-1, 4))
+    order = np.argsort(groups, axis=1)
+    mask = np.ones_like(groups, bool)
+    np.put_along_axis(mask, order[:, :2], False, axis=1)  # drop 2 smallest
+    mask = mask.reshape(-1)[:v.size].reshape(v.shape)
+    return mask
+
+
+def check_sparsity_2_4(w):
+    v = np.asarray(unwrap(w)).reshape(-1)
+    pad = (-v.size) % 4
+    if pad:
+        v = np.concatenate([v, np.zeros(pad, v.dtype)])
+    return bool(((v.reshape(-1, 4) != 0).sum(1) <= 2).all())
+
+
+def _prunable(model):
+    from ..nn.layer.common import Linear
+    for name, layer in model.named_sublayers(include_self=True):
+        if isinstance(layer, Linear) and layer.weight is not None:
+            pname = f"{name}.weight" if name else "weight"
+            if pname not in _excluded and layer.weight.shape[-1] % 4 == 0:
+                yield pname, layer
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply 2:4 masks to every prunable Linear weight; masks are stored
+    on the layer for the decorated optimizer to re-apply."""
+    if (n, m) != (2, 4):
+        raise NotImplementedError("only 2:4 sparsity is supported")
+    masks = {}
+    for pname, layer in _prunable(model):
+        mask = create_mask_2_4(layer.weight)
+        layer._asp_mask = jnp.asarray(mask)
+        layer.weight.set_value(Tensor(unwrap(layer.weight) * layer._asp_mask))
+        masks[pname] = mask
+    return masks
+
+
+class ASPOptimizerWrapper:
+    """reference OptimizerWithSparsityGuarantee: after every step, zero
+    the pruned coordinates again so training cannot resurrect them."""
+
+    def __init__(self, optimizer, model=None):
+        self._opt = optimizer
+        self._model = model
+
+    def __getattr__(self, k):
+        return getattr(self._opt, k)
+
+    def _reapply(self):
+        if self._model is None:
+            return
+        for _, layer in self._model.named_sublayers(include_self=True):
+            mask = getattr(layer, "_asp_mask", None)
+            if mask is not None:
+                layer.weight.set_value(
+                    Tensor(unwrap(layer.weight) * mask))
+
+    def step(self):
+        out = self._opt.step()
+        self._reapply()
+        return out
+
+    def clear_grad(self, *a, **k):
+        return self._opt.clear_grad(*a, **k)
+
+    def minimize(self, loss, *a, **k):
+        out = self._opt.minimize(loss, *a, **k)
+        self._reapply()
+        return out
+
+
+def decorate(optimizer, model=None):
+    return ASPOptimizerWrapper(optimizer, model)

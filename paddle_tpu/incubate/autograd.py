@@ -1,0 +1,135 @@
+"""Functional differentiation API (reference:
+python/paddle/incubate/autograd/functional.py — vjp/jvp/Jacobian/Hessian).
+
+On JAX these are native program transforms; the paddle surface maps
+directly onto jax.vjp / jax.jvp / jax.jacobian / jax.hessian — including
+forward-mode, which the reference implements with its own primitive
+rules (incubate/autograd/primx.py) and we get from the tracer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, unwrap
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "forward_grad", "grad"]
+
+
+def _uw_tree(x):
+    return jax.tree_util.tree_map(
+        lambda t: unwrap(t) if isinstance(t, Tensor) else jnp.asarray(t), x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap_tree(x):
+    return jax.tree_util.tree_map(Tensor, x)
+
+
+def _pure(func):
+    def f(*raws):
+        out = func(*[Tensor(r) for r in raws])
+        return jax.tree_util.tree_map(
+            lambda t: unwrap(t) if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return f
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def vjp(func, xs, v=None):
+    """→ (func(xs), vector-Jacobian product). v defaults to ones like the
+    output (reference functional.py:50)."""
+    raws = [_uw_tree(x) for x in _as_list(xs)]
+    out, vjp_fn = jax.vjp(_pure(func), *raws)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = _uw_tree(v if not isinstance(v, (list, tuple)) or
+                       isinstance(out, (list, tuple)) else v)
+        if isinstance(v, (list, tuple)) and not isinstance(out, (list, tuple)):
+            cot = _uw_tree(v[0])
+    grads = vjp_fn(cot)
+    grads = list(grads) if isinstance(xs, (list, tuple)) else grads[0]
+    return _wrap_tree(out), _wrap_tree(grads)
+
+
+def jvp(func, xs, v=None):
+    """→ (func(xs), Jacobian-vector product) via true forward mode."""
+    raws = [_uw_tree(x) for x in _as_list(xs)]
+    if v is None:
+        tans = [jnp.ones_like(r) for r in raws]
+    else:
+        tans = [_uw_tree(t) for t in _as_list(v)]
+    out, tangent = jax.jvp(_pure(func), tuple(raws), tuple(tans))
+    return _wrap_tree(out), _wrap_tree(tangent)
+
+
+def forward_grad(func, xs, v=None):
+    return jvp(func, xs, v)[1]
+
+
+def grad(func, xs, v=None):
+    return vjp(func, xs, v)[1]
+
+
+class Jacobian:
+    """Lazy full Jacobian (reference functional.py Jacobian): J[:] gives
+    the (out_size, in_size)-flattened matrix; rows/cols index into it."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = _uw_tree(xs if not isinstance(xs, (list, tuple)) else
+                            xs[0])
+        self._func = func
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is None:
+            jac = jax.jacobian(_pure(self._func))(self._xs)
+            if self._is_batched:
+                # (B, out..., B, in...) diag over batch → (B, out, in)
+                b = self._xs.shape[0]
+                out_sz = int(jnp.size(jac)) // (b * b * int(
+                    jnp.prod(jnp.asarray(self._xs.shape[1:]))))
+                j = jac.reshape(b, out_sz, b, -1)
+                self._mat = jnp.stack([j[i, :, i] for i in range(b)])
+            else:
+                out_shape = jax.eval_shape(_pure(self._func), self._xs).shape
+                self._mat = jac.reshape(int(jnp.prod(jnp.asarray(
+                    out_shape, jnp.int64))) if out_shape else 1, -1)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._compute()[idx])
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)
+
+
+class Hessian:
+    """Lazy Hessian of a scalar-output function (reference Hessian)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._xs = _uw_tree(xs if not isinstance(xs, (list, tuple)) else
+                            xs[0])
+        self._func = func
+        self._mat = None
+
+    def _compute(self):
+        if self._mat is None:
+            h = jax.hessian(lambda x: jnp.squeeze(_pure(self._func)(x)))(
+                self._xs)
+            n = int(jnp.size(self._xs))
+            self._mat = h.reshape(n, n)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._compute()[idx])
+
+    @property
+    def shape(self):
+        return list(self._compute().shape)

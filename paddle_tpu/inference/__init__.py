@@ -1,0 +1,157 @@
+"""paddle.inference parity: Config + create_predictor
+(reference: python/paddle/inference/wrapper.py).
+
+TPU-native: a Predictor wraps a model saved by paddle_tpu.jit.save —
+the serialized jax.export (StableHLO) program when present (runs with no
+access to the original Python class), else the reconstructed Layer. The
+handle-based copy_from_cpu / run / copy_to_cpu flow matches the
+reference's zero-copy tensor API; device placement is jax's.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, unwrap
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    XPU = "xpu"
+    CUSTOM = "custom"
+
+
+class Config:
+    """reference Config(prog_file, params_file) — here both point at the
+    jit.save prefix: Config("dir/model") reads dir/model.pdmodel /
+    .pdiparams / .pdexport."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_prefix = prog_file
+        self._threads = 1
+        self._memory_optim = True
+
+    def set_prog_file(self, path):
+        self.model_prefix = path[:-len(".pdmodel")] \
+            if path.endswith(".pdmodel") else path
+
+    def prog_file(self):
+        return (self.model_prefix or "") + ".pdmodel"
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._threads = n
+
+    # accelerator knobs: jax/XLA owns placement; these are honest no-ops
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=None):
+        pass
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self, x=True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x=True):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise NotImplementedError(
+            "TensorRT is CUDA-specific; the TPU deployment path is the "
+            "exported StableHLO program (already what this Config loads)")
+
+
+class _IOHandle:
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(np.asarray(arr))
+
+    def reshape(self, shape):
+        pass  # shapes come from the fed array
+
+    def copy_to_cpu(self):
+        if self._value is None:
+            raise RuntimeError(f"output {self.name!r} not computed; "
+                               f"call predictor.run() first")
+        return np.asarray(self._value)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.api import load
+        self._model = load(config.model_prefix)
+        n_in = None
+        exported = getattr(self._model, "_exported", None)
+        if exported is not None:
+            n_state = len(self._model._state)
+            n_in = len(exported.in_avals) - n_state
+        self._n_inputs = n_in if n_in is not None else 1
+        self._inputs = {f"x{i}": _IOHandle(f"x{i}")
+                        for i in range(self._n_inputs)}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """Handle flow (copy_from_cpu beforehand) or direct list-in/
+        list-out when `inputs` (list of numpy arrays) is given."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(a)
+        args = []
+        for name, h in self._inputs.items():
+            if h._value is None:
+                raise RuntimeError(f"input {name!r} was never fed; call "
+                                   f"copy_from_cpu first")
+            args.append(h._value)
+        out = self._model(*args)
+        leaves = jax.tree_util.tree_leaves(
+            out, is_leaf=lambda t: isinstance(t, Tensor))
+        self._outputs = {}
+        res = []
+        for i, leaf in enumerate(leaves):
+            handle = _IOHandle(f"out{i}")
+            handle._value = unwrap(leaf) if isinstance(leaf, Tensor) else leaf
+            self._outputs[f"out{i}"] = handle
+            res.append(np.asarray(handle._value))
+        return res
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -5,4 +5,4 @@ dy2static (SOT/AST → PIR → CINN) collapses to trace+XLA-compile on TPU:
 serialize params + a re-traceable spec.
 """
 from .api import to_static, not_to_static, save, load, ignore_module  # noqa: F401
-from .api import enable_to_static, TranslatedLayer  # noqa: F401
+from .api import enable_to_static, TranslatedLayer, InputSpec  # noqa: F401

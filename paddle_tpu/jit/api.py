@@ -145,20 +145,59 @@ def ignore_module(modules):
 
 
 class TranslatedLayer(Layer):
-    """Loaded inference layer (reference: translated_layer.py)."""
+    """Loaded inference layer (reference: translated_layer.py).
 
-    def __init__(self, state, forward_fn):
+    When the saved model carries a jax.export program (.pdexport), forward
+    executes that serialized StableHLO directly — no access to the
+    original Python class is needed, matching the reference's
+    load-and-run contract."""
+
+    def __init__(self, state, exported=None):
         super().__init__()
         self._state = state
-        self._forward_fn = forward_fn
+        self._exported = exported
 
     def forward(self, *args):
-        return self._forward_fn(*args)
+        if self._exported is None:
+            raise RuntimeError(
+                "TranslatedLayer: this archive has no exported program "
+                "(saved without input_spec); re-save with input_spec or "
+                "reconstruct the original class to run")
+        params = [unwrap(self._state[k]) for k in sorted(self._state)]
+        raws = [unwrap(a) if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._exported.call(*params, *raws)
+        return jax.tree_util.tree_map(Tensor, out)
+
+
+def _spec_to_struct(spec, scope, counter, example=None):
+    """InputSpec → ShapeDtypeStruct; None/-1 dims become jax.export
+    symbolic dimensions (shared scope), so the exported program runs at
+    ANY batch size instead of silently baking in 1."""
+    from jax import export as jexport
+
+    from .._core import dtypes as _dt
+    if example is not None:
+        v = unwrap(example)
+        return jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+    parts = []
+    for s in spec.shape:
+        if s in (None, -1):
+            counter[0] += 1
+            parts.append(f"_d{counter[0]}")
+        else:
+            parts.append(str(int(s)))
+    if any(p.startswith("_d") for p in parts):
+        shape = jexport.symbolic_shape(", ".join(parts), scope=scope)
+    else:
+        shape = tuple(int(p) for p in parts)
+    return jax.ShapeDtypeStruct(shape, _dt.convert_dtype(spec.dtype))
 
 
 def save(layer, path, input_spec=None, **configs):
-    """Serialize params + class info. XLA AOT export is the deployment
-    path on TPU (round 2: jax.export)."""
+    """Serialize params + class info + (with input_spec or example
+    inputs) the traced computation via jax.export — the XLA-AOT
+    deployment path (reference: jit.save → Program + pdiparams)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if isinstance(layer, StaticFunction):
         raise TypeError("save a Layer, not a StaticFunction")
@@ -168,6 +207,41 @@ def save(layer, path, input_spec=None, **configs):
         pickle.dump(state, f)
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f)
+    if input_spec:
+        from jax import export as jexport
+        params, buffers = layer.functional_state()
+        state_keys = sorted(layer.state_dict().keys())
+
+        def pure(*flat):
+            n = len(state_keys)
+            sd = dict(zip(state_keys, flat[:n]))
+            p = {k: sd[k] for k in params if k in sd}
+            bu = {k: sd.get(k, v) for k, v in buffers.items()}
+            inputs = [Tensor(r) for r in flat[n:]]
+            with layer._swapped_state({**params, **p}, bu):
+                out = layer(*inputs)
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        sd_now = layer.state_dict()
+        param_structs = [jax.ShapeDtypeStruct(tuple(sd_now[k].shape),
+                                              unwrap(sd_now[k]).dtype)
+                         for k in state_keys]
+        scope = jexport.SymbolicScope()
+        counter = [0]
+        in_structs = [s if isinstance(s, jax.ShapeDtypeStruct)
+                      else _spec_to_struct(s, scope, counter)
+                      for s in input_spec]
+        was_training = layer.training
+        layer.eval()
+        try:
+            exp = jexport.export(jax.jit(pure))(*param_structs, *in_structs)
+        finally:
+            if was_training:
+                layer.train()
+        with open(path + ".pdexport", "wb") as f:
+            f.write(exp.serialize())
 
 
 def load(path, **configs):
@@ -181,13 +255,21 @@ def load(path, **configs):
         cls = getattr(mod, meta["class"])
         try:
             layer = cls()
-            layer.set_state_dict({k: Tensor(jnp.asarray(v))
-                                  for k, v in state.items()})
-            return layer
+            # only trust the reconstruction when its parameter tree matches
+            # the archive — a default-constructed container (Sequential())
+            # would otherwise pass as an empty identity model
+            if set(layer.state_dict().keys()) == set(state.keys()):
+                layer.set_state_dict({k: Tensor(jnp.asarray(v))
+                                      for k, v in state.items()})
+                return layer
         except TypeError:
             pass
     except Exception:
         pass
     state_t = {k: Tensor(jnp.asarray(v)) for k, v in state.items()}
-    return TranslatedLayer(state_t, lambda *a: (_ for _ in ()).throw(
-        RuntimeError("TranslatedLayer: reconstruct the original class to run")))
+    exported = None
+    if os.path.exists(path + ".pdexport"):
+        from jax import export as jexport
+        with open(path + ".pdexport", "rb") as f:
+            exported = jexport.deserialize(f.read())
+    return TranslatedLayer(state_t, exported)

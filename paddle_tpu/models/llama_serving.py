@@ -246,7 +246,16 @@ class ServingEngine:
         for the whole ragged batch (no per-sequence dense fallback)."""
         free_slots = [s for s in range(self.max_seqs)
                       if self._slots[s] is None]
-        take = min(len(free_slots), len(self._waiting))
+        # admit only what both slots AND kv pages can hold — popping a
+        # request we cannot scatter would silently drop it
+        free_pages = len(self._free)
+        take = 0
+        for req in self._waiting[:len(free_slots)]:
+            need = -(-max(len(req.prompt), 1) // self.page_size)
+            if need > free_pages:
+                break
+            free_pages -= need
+            take += 1
         if take == 0:
             return
         if take == 1:

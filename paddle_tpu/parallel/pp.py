@@ -138,5 +138,8 @@ class PipelineLayer:
 
     def forward(self, x):
         for l in self.built:
-            x = l(x) if not callable(getattr(l, "__call__", None)) or True else l(x)
+            x = l(x)
         return x
+
+    def __call__(self, x):
+        return self.forward(x)

@@ -43,6 +43,11 @@ class Trainer:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or get_mesh()
+        if sharding_stage == 0:
+            # group_sharded_parallel (ZeRO facade) marks the model/opt;
+            # honor it so the paddle API actually shards state
+            sharding_stage = getattr(model, "_sharding_stage", 0) or \
+                getattr(optimizer, "_sharding_stage", 0)
         self.sharding_stage = sharding_stage
         self.grad_clip_norm = grad_clip_norm
         self.base_seed = base_seed

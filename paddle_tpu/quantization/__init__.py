@@ -129,6 +129,9 @@ class QAT:
 
     def quantize(self, model, inplace=False):
         from ..nn.layer.common import Linear
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
         for _, layer in model.named_sublayers(include_self=True):
             if isinstance(layer, Linear) and layer.weight is not None \
                     and not hasattr(layer, "_qat_wq"):
@@ -154,9 +157,12 @@ class QAT:
         return model
 
     def convert(self, model, inplace=False):
-        """Swap QAT-wrapped Linears for int8 weight-only inference layers
-        (in place within their parents)."""
+        """Swap QAT-wrapped Linears for int8 weight-only inference
+        layers."""
         from ..nn.layer.common import Linear
+        if not inplace:
+            import copy
+            model = copy.deepcopy(model)
         for _, parent in model.named_sublayers(include_self=True):
             for name, child in list(parent.named_children()):
                 if isinstance(child, Linear) and hasattr(child, "_qat_wq"):

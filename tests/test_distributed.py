@@ -274,3 +274,38 @@ class TestAutoParallel:
         e1 = float(dist_model(pt.to_tensor(xs), pt.to_tensor(ys)))
         e2 = float(dist_model(pt.to_tensor(xs), pt.to_tensor(ys)))
         assert np.allclose(e1, e2)
+
+
+class TestGroupShardedFacade:
+    def test_sharding_stage_flows_into_trainer(self):
+        """group_sharded_parallel marks the model; Trainer honors it and
+        shards optimizer slots over dp (ZeRO), matching plain DP math."""
+        from paddle_tpu.distributed import group_sharded_parallel
+        from jax.sharding import PartitionSpec as P
+
+        def build():
+            pt.seed(4)
+            return pt.nn.Sequential(pt.nn.Linear(16, 128), pt.nn.Tanh(),
+                                    pt.nn.Linear(128, 4))
+
+        x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        y = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+        loss_fn = lambda m, b: pt.nn.MSELoss()(m(b[0]), b[1])
+        mesh = create_mesh({"dp": 8})
+
+        net1 = build()
+        opt1 = pt.optimizer.Adam(1e-2, parameters=net1.parameters())
+        net1, opt1 = group_sharded_parallel(net1, opt1, "p_g_os")
+        tr1 = Trainer(net1, opt1, loss_fn, mesh=mesh,
+                      batch_spec=(P("dp"), P("dp")))
+        assert tr1.sharding_stage == 3
+        # stage 3 shards at least one large param
+        assert any(s != P() for s in tr1.param_specs.values())
+        l1 = [float(tr1.step((x, y))) for _ in range(3)]
+
+        net2 = build()
+        opt2 = pt.optimizer.Adam(1e-2, parameters=net2.parameters())
+        tr2 = Trainer(net2, opt2, loss_fn, mesh=mesh,
+                      batch_spec=(P("dp"), P("dp")))
+        l2 = [float(tr2.step((x, y))) for _ in range(3)]
+        assert np.allclose(l1, l2, atol=1e-5)

@@ -1,0 +1,86 @@
+"""incubate.autograd (forward/reverse functional diff) and incubate.asp
+(2:4 sparsity) — reference: python/paddle/incubate/autograd/functional.py,
+python/paddle/incubate/asp/asp.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate import asp, autograd as iag
+
+
+class TestFunctionalAutograd:
+    def test_vjp_matches_analytic(self):
+        x = pt.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out, g = iag.vjp(lambda a: (a ** 3).sum(), x)
+        assert np.allclose(float(out), 36.0)
+        assert np.allclose(g.numpy(), 3 * x.numpy() ** 2)
+
+    def test_jvp_forward_mode(self):
+        x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+        v = pt.to_tensor(np.array([1.0, 0.0], np.float32))
+        out, tang = iag.jvp(lambda a: a ** 2, x, v)
+        assert np.allclose(out.numpy(), [1.0, 4.0])
+        assert np.allclose(tang.numpy(), [2.0, 0.0])  # J @ v = 2x * v
+
+    def test_jacobian_full_matrix(self):
+        x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = iag.Jacobian(lambda a: pt.stack([a[0] * a[1], a[0] + a[1],
+                                             a[1] ** 2]), x)
+        ref = np.array([[2.0, 1.0], [1.0, 1.0], [0.0, 4.0]])
+        assert np.allclose(J[:].numpy(), ref)
+        assert J.shape == [3, 2]
+
+    def test_hessian(self):
+        x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = iag.Hessian(lambda a: (a[0] ** 2 * a[1] + a[1] ** 3).reshape([1]),
+                        x)
+        ref = np.array([[2 * 2.0, 2 * 1.0], [2 * 1.0, 6 * 2.0]])
+        assert np.allclose(H[:].numpy(), ref)
+
+
+class TestASP:
+    def test_mask_2_4_keeps_two_largest(self):
+        w = pt.to_tensor(np.array([[1.0, -5.0, 0.1, 3.0],
+                                   [2.0, 2.5, -0.2, 0.3]], np.float32))
+        m = asp.create_mask_2_4(w)
+        assert m.tolist() == [[False, True, False, True],
+                              [True, True, False, False]]
+
+    def test_prune_model_and_density(self):
+        pt.seed(0)
+        net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                               pt.nn.Linear(16, 4))
+        asp.prune_model(net)
+        for lin in (net[0], net[2]):
+            assert asp.check_sparsity_2_4(lin.weight)
+            assert abs(asp.calculate_density(lin.weight) - 0.5) < 0.05
+
+    def test_decorated_optimizer_preserves_sparsity(self):
+        pt.seed(1)
+        net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                               pt.nn.Linear(16, 4))
+        asp.prune_model(net)
+        opt = asp.decorate(
+            pt.optimizer.SGD(0.1, parameters=net.parameters()), net)
+        rng = np.random.RandomState(0)
+        xs = pt.to_tensor(rng.randn(8, 8).astype(np.float32))
+        ys = pt.to_tensor(rng.randn(8, 4).astype(np.float32))
+        for _ in range(3):
+            loss = pt.nn.MSELoss()(net(xs), ys)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # dense training would fill the zeros back in; ASP must not
+        assert asp.check_sparsity_2_4(net[0].weight)
+        assert asp.check_sparsity_2_4(net[2].weight)
+
+    def test_excluded_layers(self):
+        pt.seed(2)
+        net = pt.nn.Sequential(pt.nn.Linear(8, 8))
+        asp.set_excluded_layers(["0.weight"])
+        try:
+            masks = asp.prune_model(net)
+            assert "0.weight" not in masks
+            assert abs(asp.calculate_density(net[0].weight) - 1.0) < 1e-6
+        finally:
+            asp.reset_excluded_layers()

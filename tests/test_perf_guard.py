@@ -1,0 +1,49 @@
+"""Perf-regression guard (VERDICT r1 item 9, SURVEY §4 'perf guard').
+
+bench.py appends every run to BENCH_HISTORY.jsonl; this test compares
+the two most recent entries with the same backend + config and fails on
+a >25% throughput drop. Skips until two comparable datapoints exist
+(e.g. first round on a machine, or CPU-only CI where only smoke entries
+accumulate — CPU smoke numbers on shared machines are too noisy, so
+only TPU entries are guarded).
+"""
+import json
+import os
+
+import pytest
+
+HIST = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_HISTORY.jsonl")
+
+
+def _entries():
+    if not os.path.exists(HIST):
+        return []
+    out = []
+    with open(HIST) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+    return out
+
+
+def test_no_tpu_throughput_regression():
+    tpu = [e for e in _entries()
+           if e.get("extra", {}).get("backend") not in (None, "cpu")]
+    # group by (metric, batch, seq) so config changes don't false-alarm
+    by_cfg = {}
+    for e in tpu:
+        by_cfg.setdefault((e.get("metric"), e.get("batch"),
+                           e.get("seq")), []).append(e)
+    comparable = [v for v in by_cfg.values() if len(v) >= 2]
+    if not comparable:
+        pytest.skip("need two same-config TPU bench entries to compare")
+    for runs in comparable:
+        prev, cur = runs[-2], runs[-1]
+        assert cur["value"] > 0.75 * prev["value"], (
+            f"TPU throughput regressed >25%: {prev['value']} -> "
+            f"{cur['value']} tokens/s for {prev['metric']}")

@@ -262,3 +262,19 @@ class TestSignalGeometric:
         assert pt.geometric.segment_sum(data, ids).numpy().tolist() == [3.0, 7.0]
         assert pt.geometric.segment_mean(data, ids).numpy().tolist() == [1.5, 3.5]
         assert pt.geometric.segment_max(data, ids).numpy().tolist() == [2.0, 4.0]
+
+
+class TestQATInplaceContract:
+    def test_quantize_does_not_mutate_original(self):
+        from paddle_tpu.quantization import QAT, QuantConfig
+        pt.seed(9)
+        net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                               pt.nn.Linear(8, 2))
+        x = pt.randn([3, 4])
+        ref = net(x).numpy()
+        qnet = QAT(QuantConfig()).quantize(net)  # inplace=False default
+        assert qnet is not net
+        # original still computes exact fp32 math
+        assert np.allclose(net(x).numpy(), ref, atol=0)
+        # the copy computes fake-quantized (different) math
+        assert not np.allclose(qnet(x).numpy(), ref, atol=1e-7)

@@ -126,3 +126,22 @@ class TestServing:
         by_id = {r.rid: r.output for r in done}
         for i, ref in enumerate(refs):
             assert by_id[f"r{i}"] == ref, f"request {i} diverged"
+
+    def test_admission_respects_page_capacity(self, params):
+        """Admission must not pop requests it cannot scatter: with pages
+        for only some waiting requests, the rest stay queued and finish
+        later (no dropped/lost requests)."""
+        prompts = [[1, 2, 3, 4, 5, 6]] * 4   # 6+2 tokens fit 1 page (ps=8)
+        eng = ServingEngine(params, CFG, max_seqs=4, max_seq_len=16,
+                            page_size=8, use_pallas=False)
+        # only 2 free pages: capacity admits 2 seqs; the other 2 must stay
+        # queued (NOT be popped and lost) until pages free up
+        eng._free = eng._free[:2]
+        for i, p in enumerate(prompts):
+            eng.submit(Request(f"r{i}", p, max_new_tokens=2))
+        done = eng.run(max_steps=200)
+        assert sorted(r.rid for r in done) == [f"r{i}" for i in range(4)]
+        refs = [greedy_reference(params, p, 2) for p in prompts]
+        by_id = {r.rid: r.output for r in done}
+        for i, ref in enumerate(refs):
+            assert by_id[f"r{i}"] == ref
