@@ -91,3 +91,88 @@ def batch(reader, batch_size, drop_last=False):
         if buf and not drop_last:
             yield buf
     return batched
+
+# ---------------------------------------------------------------------------
+# top-level namespace completion (reference python/paddle/__init__.py __all__):
+# constants, remaining ops, and generated inplace `op_` variants
+# ---------------------------------------------------------------------------
+import math as _math
+
+inf = float("inf")
+nan = float("nan")
+pi = _math.pi
+e = _math.e
+newaxis = None
+
+from .tensor.extras import (  # noqa: F401
+    sinc, baddbmm, cartesian_prod, pdist, histogram_bin_edges, combinations,
+    reduce_as, diagonal_scatter, cast, less, negative,
+    positive, reverse, tolist, is_grad_enabled, set_printoptions,
+    from_dlpack, to_dlpack, check_shape, disable_signal_handler,
+    log_normal_, bernoulli_, where_,
+)
+from .tensor.attribute import shape  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
+from .device import CUDAPinnedPlace  # noqa: F401
+from .framework import set_flags, get_flags  # noqa: F401
+from .distributed import DataParallel  # noqa: F401
+
+
+class LazyGuard:
+    """reference: paddle.LazyGuard — lazy parameter init. Params here are
+    created eagerly but cheaply (jax arrays on first use), so the guard
+    is a transparent context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+# paddle's string dtypes (pstring/raw) exist for Tensor metadata only
+pstring = "pstring"
+raw = "raw"
+
+from .tensor.logic import bitwise_not as bitwise_invert  # noqa: F401
+
+# generated inplace variants: every paddle `op_` whose base op exists
+from .tensor import extras as _extras
+
+
+def _gen_inplace():
+    g = globals()
+    names = [
+        "abs", "acos", "addmm", "asin", "atan", "atanh", "baddbmm",
+        "bernoulli", "bitwise_and", "bitwise_invert", "bitwise_not",
+        "bitwise_or", "bitwise_xor", "bitwise_left_shift",
+        "bitwise_right_shift", "cast", "ceil", "clip", "copysign", "cos",
+        "cosh", "cumprod", "cumsum", "digamma", "divide", "equal", "erf",
+        "erfinv", "exp", "expm1", "fill", "flatten", "floor",
+        "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
+        "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+        "lcm", "ldexp", "lerp", "less", "less_equal", "less_than",
+        "lgamma", "log", "log10", "log1p", "log2", "logical_and",
+        "logical_not", "logical_or", "logical_xor", "logit",
+        "masked_fill", "masked_scatter", "mod", "multigammaln",
+        "multiply", "nan_to_num", "neg", "polygamma", "pow", "reciprocal",
+        "remainder", "renorm", "round", "rsqrt", "scale", "sigmoid",
+        "sign", "sin", "sinc", "sinh", "sqrt", "square", "squeeze",
+        "subtract", "t", "tan", "tanh", "tril", "triu", "trunc",
+        "unsqueeze", "transpose",
+    ]
+    for base in names:
+        fn = g.get(base)
+        iname = base + "_"
+        if callable(fn) and iname not in g:
+            g[iname] = _extras.make_inplace(fn, iname)
+    # add_/sub_ style aliases paddle also exports
+    for base, iname in (("add", "add_"), ("subtract", "sub_"),
+                        ("multiply", "mul_"), ("divide", "div_")):
+        fn = g.get(base)
+        if callable(fn) and iname not in g:
+            g[iname] = _extras.make_inplace(fn, iname)
+
+
+_gen_inplace()
+del _gen_inplace

@@ -116,23 +116,43 @@ for _mod in _METHOD_SOURCES:
         if callable(_f):
             setattr(Tensor, _fname, _f)
 
-# In-place `op_` aliases used widely in paddle code.
+# In-place `op_` aliases used widely in paddle code (node-rewiring +
+# leaf guard live in tensor.extras.inplace_apply).
 def _inplace_from(fname):
     f = getattr(Tensor, fname)
+
     def op(self, *args, **kwargs):
-        out = f(self, *args, **kwargs)
-        self._replace(out._value, out._node, out._out_idx)
-        self.stop_gradient = out.stop_gradient and self.stop_gradient
-        return self
+        from .extras import inplace_apply
+        return inplace_apply(self, f, *args, **kwargs)
     return op
 
 
 for _fname in ["add", "subtract", "multiply", "divide", "clip", "scale", "floor",
                "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round", "abs",
-               "tanh", "squeeze", "unsqueeze", "flatten", "clip"]:
-    if hasattr(Tensor, _fname):
+               "tanh", "squeeze", "unsqueeze", "flatten", "log",
+               "log2", "log10", "log1p", "sin", "cos", "tan", "sinh", "cosh",
+               "asin", "acos", "atan", "erf", "erfinv", "sign", "trunc",
+               "frac", "sigmoid", "neg", "pow", "lerp", "tril", "triu",
+               "digamma", "lgamma", "expm1", "square", "mod",
+               "floor_divide", "logical_and", "logical_or", "logical_not",
+               "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor",
+               "bitwise_not", "masked_fill", "nan_to_num",
+               "cumsum", "cumprod", "transpose", "cast"]:
+    if hasattr(Tensor, _fname) and not hasattr(Tensor, _fname + "_"):
         setattr(Tensor, _fname + "_", _inplace_from(_fname))
 
 Tensor.mean = stat.mean
 Tensor.pow = math.pow
 Tensor.remainder_ = _inplace_from("remainder")
+Tensor.mul_ = _inplace_from("multiply")
+Tensor.sub_ = _inplace_from("subtract")
+Tensor.div_ = _inplace_from("divide")
+
+
+def _cuda(self, device_id=None, blocking=True):
+    raise RuntimeError("Tensor.cuda(): no CUDA device exists on a TPU "
+                       "host; arrays already live on the default jax "
+                       "device (use paddle.device.set_device)")
+
+
+Tensor.cuda = _cuda

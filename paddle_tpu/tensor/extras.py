@@ -1,0 +1,270 @@
+"""Remaining top-level tensor API (reference: python/paddle/__init__.py
+__all__ diff) — small real ops + the machinery that generates paddle's
+inplace `op_` variants.
+
+Inplace semantics on immutable jax arrays: `x.op_()` computes
+functionally and swaps the new array into the SAME Tensor wrapper
+(`_replace`), which is exactly paddle's observable contract (the
+variable's storage is updated; aliases through the same Tensor see it).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.state import prng
+from .._core.tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "sinc", "baddbmm", "cartesian_prod", "pdist", "histogram_bin_edges",
+    "combinations", "reduce_as", "diagonal_scatter",
+    "cast", "less", "negative", "positive", "reverse", "tolist",
+    "is_grad_enabled", "set_printoptions", "from_dlpack", "to_dlpack",
+    "check_shape", "disable_signal_handler", "log_normal_", "bernoulli_",
+    "where_",
+]
+
+
+def sinc(x, name=None):
+    return apply(lambda v: jnp.sinc(v), x, name="sinc")
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) batched (reference paddle.baddbmm)."""
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y, name="baddbmm")
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors → (N, len(x)) like torch/paddle."""
+    xs = [unwrap(t) for t in (x if isinstance(x, (list, tuple)) else [x])]
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    out = jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    if len(xs) == 1:
+        out = out[:, 0]
+    return Tensor(out)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of rows (upper triangle, no diag)."""
+    def fn(v):
+        n = v.shape[0]
+        diff = jnp.abs(v[:, None] - v[None, :])
+        if p == float("inf"):
+            d = jnp.max(diff, -1)
+        else:
+            d = jnp.sum(diff ** p, -1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return d[iu]
+    return apply(fn, x, name="pdist")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    v = unwrap(input)
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        lo, hi = float(jnp.min(v)), float(jnp.max(v))
+        if lo == hi:
+            lo, hi = lo - 0.5, hi + 0.5
+    return Tensor(jnp.linspace(lo, hi, int(bins) + 1))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (host-side index
+    enumeration, device gather)."""
+    import itertools
+    v = unwrap(x)
+    n = v.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), np.int32).reshape(-1, r)
+    return Tensor(v[idx])
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (reference paddle.reduce_as)."""
+    def fn(v, t):
+        extra = v.ndim - t.ndim
+        if extra:
+            v = jnp.sum(v, axis=tuple(range(extra)))
+        axes = tuple(i for i in range(v.ndim)
+                     if t.shape[i] == 1 and v.shape[i] != 1)
+        if axes:
+            v = jnp.sum(v, axis=axes, keepdims=True)
+        return v
+    return apply(fn, x, target, name="reduce_as")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto x's diagonal (reference paddle.diagonal_scatter)."""
+    def fn(v, d):
+        v = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        n, m = v.shape[-2], v.shape[-1]
+        rows = jnp.arange(max(0, -offset), max(0, -offset) + d.shape[-1])
+        cols = rows + offset
+        v = v.at[..., rows, cols].set(d)
+        return jnp.moveaxis(v, (-2, -1), (axis1, axis2))
+    return apply(fn, x, y, name="diagonal_scatter")
+
+
+def cast(x, dtype):
+    from .._core.dtypes import convert_dtype
+    return apply(lambda v: v.astype(convert_dtype(dtype)), x, name="cast")
+
+
+def less(x, y, name=None):
+    return apply(lambda a, b: a < b, x, y, name="less")
+
+
+def negative(x, name=None):
+    return apply(lambda v: -v, x, name="negative")
+
+
+def positive(x, name=None):
+    return apply(lambda v: +v, x, name="positive")
+
+
+def reverse(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply(lambda v: jnp.flip(v, ax), x, name="reverse")
+
+
+def tolist(x):
+    return np.asarray(unwrap(x)).tolist()
+
+
+def is_grad_enabled():
+    from .._core.state import grad_enabled
+    return grad_enabled()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def from_dlpack(dlpack):
+    """Accepts a protocol-implementing array (torch tensor, np array —
+    the modern DLPack path) or a legacy PyCapsule (routed through numpy,
+    since jax dropped raw-capsule ingestion)."""
+    if hasattr(dlpack, "__dlpack__"):
+        return Tensor(jnp.from_dlpack(dlpack))
+
+    class _CapsuleWrapper:
+        def __init__(self, cap):
+            self._cap = cap
+
+        def __dlpack__(self, stream=None):
+            return self._cap
+
+        def __dlpack_device__(self):
+            return (1, 0)  # kDLCPU
+
+    return Tensor(jnp.asarray(np.from_dlpack(_CapsuleWrapper(dlpack))))
+
+
+def to_dlpack(x):
+    """Returns the array itself — it implements __dlpack__/__dlpack_device__,
+    which is what modern consumers (torch.from_dlpack, np.from_dlpack)
+    expect; legacy capsule consumers can call .__dlpack__()."""
+    return unwrap(x)
+
+
+def check_shape(x, shape_list):
+    got = list(unwrap(x).shape)
+    want = list(shape_list)
+    ok = len(got) == len(want) and all(
+        w in (None, -1) or g == w for g, w in zip(got, want))
+    if not ok:
+        raise ValueError(f"check_shape: got {got}, expected {want}")
+    return True
+
+
+def disable_signal_handler():
+    pass  # the reference unhooks its C++ fault handlers; none exist here
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Fill x in place with LogNormal(mean, std) samples."""
+    v = unwrap(x)
+    z = jax.random.normal(prng.next_key(), v.shape) * std + mean
+    x._replace(jnp.exp(z).astype(v.dtype))
+    return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    """Fill x in place with Bernoulli(p) samples."""
+    v = unwrap(x)
+    s = jax.random.bernoulli(prng.next_key(), p, v.shape)
+    x._replace(s.astype(v.dtype))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# inplace `op_` generation
+# ---------------------------------------------------------------------------
+def inplace_apply(x, base_fn, *args, **kwargs):
+    """Shared inplace machinery: run the functional op, swap the result
+    into x's wrapper keeping the tape node, and REWIRE the recorded
+    node's input reference to a snapshot of the pre-mutation tensor —
+    otherwise the node's input would be x itself (now carrying the node),
+    a self-loop that corrupts the backward walk.
+
+    Leaf tensors that require grad refuse inplace (paddle: 'leaf Variable
+    that requires grad is using inplace')."""
+    from .._core.state import grad_enabled
+
+    if isinstance(x, Tensor) and not x.stop_gradient and \
+            x._node is None and grad_enabled():
+        raise RuntimeError(
+            f"a leaf Tensor that requires grad is being used in an "
+            f"inplace operation ({base_fn.__name__}_)")
+    snapshot = None
+    if isinstance(x, Tensor) and x._node is not None:
+        snapshot = Tensor(x._value, stop_gradient=x.stop_gradient)
+        snapshot._node = x._node
+        snapshot._out_idx = x._out_idx
+    out = base_fn(x, *args, **kwargs)
+    if isinstance(out, Tensor):
+        if out._node is not None and snapshot is not None:
+            out._node.input_tensors = [
+                snapshot if t is x else t for t in out._node.input_tensors]
+        x._replace(out._value, out._node, out._out_idx)
+        x.stop_gradient = out.stop_gradient and x.stop_gradient
+        if out._node is None and snapshot is not None and \
+                not x.stop_gradient:
+            # history severed (e.g. mutated under no_grad): x is now a
+            # constant wrt any later backward — mark it so instead of
+            # letting gradients silently vanish upstream
+            x.stop_gradient = True
+    else:
+        x._replace(unwrap(out))
+    return x
+
+
+def make_inplace(base_fn, name):
+    def fn(x, *args, **kwargs):
+        return inplace_apply(x, base_fn, *args, **kwargs)
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = f"Inplace variant of `{base_fn.__name__}` (paddle `{name}`)."
+    return fn
+
+
+def where_(condition, x=None, y=None, name=None):
+    """Inplace where (reference paddle.where_): the RESULT lands in `x`
+    (the second argument), not in the condition mask."""
+    from .manipulation import where as _where
+    return inplace_apply(x, lambda t: _where(condition, t, y))

@@ -1,0 +1,110 @@
+"""Top-level namespace parity vs the reference's paddle.__all__
+(python/paddle/__init__.py), plus inplace-variant semantics."""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+REF_INIT = "/root/reference/python/paddle/__init__.py"
+
+pytestmark_ref = pytest.mark.skipif(not os.path.exists(REF_INIT),
+                                    reason="reference tree not present")
+
+
+def _ref_all():
+    src = open(REF_INIT).read()
+    m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+    return re.findall(r"'([^']+)'", m.group(1))
+
+
+class TestNamespaceParity:
+    @pytestmark_ref
+    def test_every_ref_symbol_exists(self):
+        missing = [n for n in _ref_all() if not hasattr(pt, n)]
+        assert not missing, f"missing top-level symbols: {missing}"
+
+    def test_constants(self):
+        assert pt.inf == float("inf")
+        assert np.isnan(pt.nan)
+        assert abs(pt.pi - np.pi) < 1e-15
+        assert pt.newaxis is None
+
+
+class TestInplaceVariants:
+    def test_functional_inplace_mutates_wrapper(self):
+        x = pt.to_tensor(np.array([1.0, -4.0], np.float32))
+        ret = pt.abs_(x)
+        assert ret is x
+        assert np.allclose(x.numpy(), [1.0, 4.0])
+        pt.sqrt_(x)
+        assert np.allclose(x.numpy(), [1.0, 2.0])
+
+    def test_method_inplace(self):
+        x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+        x.add_(pt.to_tensor(np.array([1.0, 1.0], np.float32)))
+        assert np.allclose(x.numpy(), [2.0, 3.0])
+        x.log_()
+        assert np.allclose(x.numpy(), np.log([2.0, 3.0]), atol=1e-6)
+        x.zero_()
+        assert np.allclose(x.numpy(), 0)
+        x.fill_(7.0)
+        assert np.allclose(x.numpy(), 7.0)
+
+    def test_fill_random_inplace(self):
+        pt.seed(0)
+        y = pt.zeros([200])
+        pt.bernoulli_(y, 0.25)
+        assert 0.1 < float(y.numpy().mean()) < 0.45
+        z = pt.zeros([200])
+        pt.log_normal_(z, mean=0.0, std=0.25)
+        assert (z.numpy() > 0).all()
+
+    def test_cuda_raises(self):
+        with pytest.raises(RuntimeError, match="TPU"):
+            pt.zeros([1]).cuda()
+
+
+class TestNewOps:
+    def test_pdist_baddbmm_cartesian(self):
+        p = pt.pdist(pt.to_tensor(np.array([[0.0, 0], [3, 4], [0, 8]],
+                                           np.float32)))
+        assert np.allclose(np.sort(p.numpy()), [5.0, np.sqrt(25), 8.0])
+        a = pt.to_tensor(np.ones((2, 2, 3), np.float32))
+        b = pt.to_tensor(np.ones((2, 3, 2), np.float32))
+        i = pt.to_tensor(np.ones((2, 2, 2), np.float32))
+        out = pt.baddbmm(i, a, b, beta=2.0, alpha=0.5)
+        assert np.allclose(out.numpy(), 2.0 + 0.5 * 3.0)
+        cp = pt.cartesian_prod([pt.to_tensor([0, 1]), pt.to_tensor([5])])
+        assert cp.numpy().tolist() == [[0, 5], [1, 5]]
+
+    def test_diagonal_scatter_renorm_reduce_as(self):
+        x = pt.zeros([3, 3])
+        out = pt.diagonal_scatter(x, pt.to_tensor(np.array([1.0, 2, 3],
+                                                           np.float32)))
+        assert np.allclose(np.diag(out.numpy()), [1, 2, 3])
+        r = pt.renorm(pt.to_tensor(np.array([[3.0, 4.0], [0.3, 0.4]],
+                                            np.float32)), 2.0, 0, 1.0)
+        assert np.allclose(np.linalg.norm(r.numpy(), axis=1), [1.0, 0.5],
+                           atol=1e-6)
+        s = pt.reduce_as(pt.ones([2, 3, 4]), pt.zeros([3, 1]))
+        assert s.shape == [3, 1]
+        assert np.allclose(s.numpy(), 8.0)
+
+    def test_combinations_histogram_edges(self):
+        c = pt.combinations(pt.to_tensor([1, 2, 3]), 2)
+        assert c.numpy().tolist() == [[1, 2], [1, 3], [2, 3]]
+        e = pt.histogram_bin_edges(pt.to_tensor([0.0, 1.0]), bins=4)
+        assert np.allclose(e.numpy(), [0, 0.25, 0.5, 0.75, 1.0])
+
+
+    def test_where_inplace_mutates_x_not_condition(self):
+        cond = pt.to_tensor(np.array([True, False, True]))
+        x = pt.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        y = pt.to_tensor(np.array([-1.0, -2.0, -3.0], np.float32))
+        ret = pt.where_(cond, x, y)
+        assert ret is x
+        assert np.allclose(x.numpy(), [1.0, -2.0, 3.0])
+        assert cond.numpy().dtype == bool  # condition untouched
