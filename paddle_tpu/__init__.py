@@ -61,6 +61,9 @@ from . import inference  # noqa: F401
 from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import utils  # noqa: F401
+from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
+from .batch import batch  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary  # noqa: F401
 from .hapi.flops import flops  # noqa: F401
@@ -78,19 +81,6 @@ random_key_context = _state.prng.key_ctx
 
 __version__ = "0.1.0"
 
-
-def batch(reader, batch_size, drop_last=False):
-    """paddle.batch parity (python/paddle/batch.py)."""
-    def batched():
-        buf = []
-        for item in reader():
-            buf.append(item)
-            if len(buf) == batch_size:
-                yield buf
-                buf = []
-        if buf and not drop_last:
-            yield buf
-    return batched
 
 # ---------------------------------------------------------------------------
 # top-level namespace completion (reference python/paddle/__init__.py __all__):

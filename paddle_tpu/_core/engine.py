@@ -32,9 +32,9 @@ def _topo_order(root_nodes):
                 continue
             visited.add(id(node))
             stack.append((node, True))
-            for t in node.input_tensors:
-                if t is not None and t._node is not None and id(t._node) not in visited:
-                    stack.append((t._node, False))
+            for pnode, _ in node.input_links:
+                if pnode is not None and id(pnode) not in visited:
+                    stack.append((pnode, False))
     order.reverse()
     return order
 
@@ -90,14 +90,17 @@ def _run_backward(outputs, out_grads, inputs=None, accumulate_into_leaves=True,
         if cts is None or all(c is None for c in cts):
             continue
         in_grads = node.vjp(cts)
-        for t, g in zip(node.input_tensors, in_grads):
+        for t, (pnode, pidx), g in zip(node.input_tensors, node.input_links,
+                                       in_grads):
             if t is None or t.stop_gradient or _float0_like(g):
                 continue
-            if t._node is not None:
-                nkey = id(t._node)
-                nodes[nkey] = t._node
-                lst = cotangents.setdefault(nkey, [None] * len(t._node.raw_outputs))
-                lst[t._out_idx] = g if lst[t._out_idx] is None else lst[t._out_idx] + g
+            # route via the producer link frozen at record time, NOT
+            # t._node (which an in-place op may have redirected since)
+            if pnode is not None:
+                nkey = id(pnode)
+                nodes[nkey] = pnode
+                lst = cotangents.setdefault(nkey, [None] * len(pnode.raw_outputs))
+                lst[pidx] = g if lst[pidx] is None else lst[pidx] + g
                 if t._retain_grads or id(t) in input_ids:
                     _accum_tensor(t, g)
             else:

@@ -55,7 +55,7 @@ class TapeNode:
     """One recorded op. VJP is derived lazily via jax.vjp on the pure fn."""
 
     __slots__ = ("fn", "kwargs", "raw_inputs", "input_tensors", "raw_outputs",
-                 "multi", "name")
+                 "multi", "name", "input_links")
 
     def __init__(self, fn, kwargs, raw_inputs, input_tensors, raw_outputs, multi, name):
         self.fn = fn
@@ -65,6 +65,16 @@ class TapeNode:
         self.raw_outputs = raw_outputs
         self.multi = multi
         self.name = name
+        # Producer links frozen at record time. The tape is snapshot-
+        # consistent: raw_inputs already captures input *values* as of the
+        # record, so routing must capture input *history* then too — if it
+        # resolved t._node at backward time instead, an in-place mutation
+        # of t between record and backward would re-route this node's
+        # cotangent through the mutation op (wrong grads for every earlier
+        # consumer of t).
+        self.input_links = tuple(
+            (t._node, t._out_idx) if isinstance(t, Tensor) else (None, 0)
+            for t in input_tensors)
 
     def vjp(self, cotangents):
         """cotangents: list aligned with raw_outputs (None → zeros)."""

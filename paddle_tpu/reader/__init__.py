@@ -1,0 +1,241 @@
+"""Legacy reader-decorator API (reference: python/paddle/reader/decorator.py).
+
+Paddle 1.x-era composable data readers: a *reader* is a zero-arg callable
+returning a generator of samples. Kept for migration parity; new code
+should use paddle_tpu.io.DataLoader (threaded/process prefetch + libptio).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = [
+    "cache", "map_readers", "shuffle", "chain", "compose", "buffered",
+    "firstn", "xmap_readers", "multiprocess_reader", "ComposeNotAligned",
+]
+
+
+def cache(reader):
+    """Cache all samples in memory on first *complete* epoch; replay
+    thereafter. A partially-consumed first epoch leaves the cache unfilled
+    (next call re-reads the source) rather than accumulating duplicates."""
+    state = {"data": None}
+
+    def rd():
+        if state["data"] is not None:
+            yield from state["data"]
+            return
+        epoch = []
+        for item in reader():
+            epoch.append(item)
+            yield item
+        state["data"] = epoch  # only reached when fully drained
+
+    return rd
+
+
+def map_readers(func, *readers):
+    """Yield func(*one_sample_from_each_reader) lockstep over readers."""
+
+    def rd():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return rd
+
+
+def shuffle(reader, buf_size):
+    """Pool-shuffle with a bounded buffer (reference decorator.py:202)."""
+
+    def rd():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return rd
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def rd():
+        for r in readers:
+            yield from r()
+
+    return rd
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, check_alignment=True):
+    """Zip readers into flattened tuples: (a, (b, c)) → (a, b, c)."""
+
+    def _flatten(item):
+        if isinstance(item, tuple):
+            out = []
+            for x in item:
+                out.extend(_flatten(x))
+            return tuple(out)
+        return (item,)
+
+    def rd():
+        iters = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*iters):
+                if any(i is None for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((_flatten(i) for i in items), ())
+        else:
+            for items in zip(*iters):
+                yield sum((_flatten(i) for i in items), ())
+
+    return rd
+
+
+def buffered(reader, size):
+    """Producer thread fills a bounded queue; consumer drains — overlaps
+    read latency with downstream compute."""
+
+    end = object()
+
+    def rd():
+        q = queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+
+    return rd
+
+
+def firstn(reader, n):
+    def rd():
+        return itertools.islice(reader(), n)
+
+    return rd
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with worker threads (reference
+    decorator.py:476). order=True preserves input order."""
+
+    end = object()
+
+    def rd():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, item in enumerate(reader()):
+                in_q.put((i, item))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        done = [0]
+        lock = threading.Lock()
+
+        def work():
+            while True:
+                got = in_q.get()
+                if got is end:
+                    with lock:
+                        done[0] += 1
+                        if done[0] == process_num:
+                            out_q.put(end)
+                    return
+                i, item = got
+                try:
+                    out = mapper(item)
+                except BaseException as e:  # forward to consumer, don't
+                    out_q.put(("__xmap_error__", e))  # strand the sentinel
+                    return
+                out_q.put((i, out))
+
+        threads = [threading.Thread(target=feed, daemon=True)]
+        threads += [threading.Thread(target=work, daemon=True)
+                    for _ in range(process_num)]
+        for t in threads:
+            t.start()
+
+        def _next():
+            got = out_q.get()
+            if got is not end and got[0] == "__xmap_error__":
+                raise got[1]
+            return got
+
+        if not order:
+            while True:
+                got = _next()
+                if got is end:
+                    break
+                yield got[1]
+        else:
+            pending, want = {}, 0
+            while True:
+                got = _next()
+                if got is end:
+                    break
+                pending[got[0]] = got[1]
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+
+    return rd
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers, each drained on its own thread.
+
+    The reference forks OS processes and shuttles samples over pipes;
+    on TPU hosts the heavy decode belongs in DataLoader's process
+    workers / libptio, so this shim keeps the API and the interleaving
+    semantics with threads (samples arrive in completion order)."""
+    assert len(readers) > 0
+
+    def rd():
+        q = queue.Queue(queue_size)
+        end = object()
+
+        def drain(r):
+            try:
+                for item in r():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=drain, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is end:
+                finished += 1
+            else:
+                yield item
+
+    return rd

@@ -1,0 +1,23 @@
+"""paddle.sysconfig parity (reference: python/paddle/sysconfig.py).
+
+Points at our native runtime artifacts (csrc/ headers + built .so files)
+instead of the reference's bundled fluid libs.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the C sources/headers of the native runtime."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib() -> str:
+    """Directory containing the built native libraries (libptio/libpttext/
+    libptckpt)."""
+    return os.path.join(_ROOT, "csrc")

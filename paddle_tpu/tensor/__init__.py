@@ -116,16 +116,9 @@ for _mod in _METHOD_SOURCES:
         if callable(_f):
             setattr(Tensor, _fname, _f)
 
-# In-place `op_` aliases used widely in paddle code (node-rewiring +
-# leaf guard live in tensor.extras.inplace_apply).
-def _inplace_from(fname):
-    f = getattr(Tensor, fname)
-
-    def op(self, *args, **kwargs):
-        from .extras import inplace_apply
-        return inplace_apply(self, f, *args, **kwargs)
-    return op
-
+# In-place `op_` aliases used widely in paddle code (snapshot tape +
+# leaf guard live in tensor.extras.inplace_apply/make_inplace).
+from .extras import make_inplace as _make_inplace  # noqa: E402
 
 for _fname in ["add", "subtract", "multiply", "divide", "clip", "scale", "floor",
                "ceil", "exp", "sqrt", "rsqrt", "reciprocal", "round", "abs",
@@ -139,14 +132,15 @@ for _fname in ["add", "subtract", "multiply", "divide", "clip", "scale", "floor"
                "bitwise_not", "masked_fill", "nan_to_num",
                "cumsum", "cumprod", "transpose", "cast"]:
     if hasattr(Tensor, _fname) and not hasattr(Tensor, _fname + "_"):
-        setattr(Tensor, _fname + "_", _inplace_from(_fname))
+        setattr(Tensor, _fname + "_",
+                _make_inplace(getattr(Tensor, _fname), _fname + "_"))
 
 Tensor.mean = stat.mean
 Tensor.pow = math.pow
-Tensor.remainder_ = _inplace_from("remainder")
-Tensor.mul_ = _inplace_from("multiply")
-Tensor.sub_ = _inplace_from("subtract")
-Tensor.div_ = _inplace_from("divide")
+Tensor.remainder_ = _make_inplace(Tensor.remainder, "remainder_")
+Tensor.mul_ = _make_inplace(Tensor.multiply, "mul_")
+Tensor.sub_ = _make_inplace(Tensor.subtract, "sub_")
+Tensor.div_ = _make_inplace(Tensor.divide, "div_")
 
 
 def _cuda(self, device_id=None, blocking=True):

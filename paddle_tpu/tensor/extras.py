@@ -216,11 +216,12 @@ def bernoulli_(x, p=0.5, name=None):
 # inplace `op_` generation
 # ---------------------------------------------------------------------------
 def inplace_apply(x, base_fn, *args, **kwargs):
-    """Shared inplace machinery: run the functional op, swap the result
-    into x's wrapper keeping the tape node, and REWIRE the recorded
-    node's input reference to a snapshot of the pre-mutation tensor —
-    otherwise the node's input would be x itself (now carrying the node),
-    a self-loop that corrupts the backward walk.
+    """Shared inplace machinery: run the functional op and swap the result
+    into x's wrapper. Gradient safety comes from the tape being snapshot-
+    consistent: every TapeNode freezes its producer links (and raw input
+    values) at record time, so earlier consumers of x keep their original
+    history and the mutation node itself links to x's pre-mutation
+    producer — no self-loop, no re-routing of other consumers' grads.
 
     Leaf tensors that require grad refuse inplace (paddle: 'leaf Variable
     that requires grad is using inplace')."""
@@ -231,20 +232,12 @@ def inplace_apply(x, base_fn, *args, **kwargs):
         raise RuntimeError(
             f"a leaf Tensor that requires grad is being used in an "
             f"inplace operation ({base_fn.__name__}_)")
-    snapshot = None
-    if isinstance(x, Tensor) and x._node is not None:
-        snapshot = Tensor(x._value, stop_gradient=x.stop_gradient)
-        snapshot._node = x._node
-        snapshot._out_idx = x._out_idx
+    had_history = isinstance(x, Tensor) and x._node is not None
     out = base_fn(x, *args, **kwargs)
     if isinstance(out, Tensor):
-        if out._node is not None and snapshot is not None:
-            out._node.input_tensors = [
-                snapshot if t is x else t for t in out._node.input_tensors]
         x._replace(out._value, out._node, out._out_idx)
         x.stop_gradient = out.stop_gradient and x.stop_gradient
-        if out._node is None and snapshot is not None and \
-                not x.stop_gradient:
+        if out._node is None and had_history and not x.stop_gradient:
             # history severed (e.g. mutated under no_grad): x is now a
             # constant wrt any later backward — mark it so instead of
             # letting gradients silently vanish upstream

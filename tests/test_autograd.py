@@ -159,3 +159,37 @@ class TestTapeUnderJit:
 
         g = jax.grad(pure)(jnp.asarray(np.random.randn(4)))
         assert g.shape == (4,)
+
+
+class TestInplaceTapeSafety:
+    """The tape is snapshot-consistent: TapeNodes freeze producer links
+    (and raw input values) at record time, so in-place mutation between
+    record and backward cannot re-route other consumers' gradients."""
+
+    def test_earlier_consumer_unaffected_by_later_mutation(self):
+        w = pt.to_tensor([2.0], stop_gradient=False)
+        x = w * 1.0
+        y = x.exp()
+        x.multiply_(pt.to_tensor([3.0]))  # mutate AFTER y consumed x
+        y.backward()
+        assert abs(float(w.grad.numpy()[0]) - float(np.exp(2.0))) < 1e-5
+
+    def test_grad_flows_through_mutation_node(self):
+        w = pt.to_tensor([2.0], stop_gradient=False)
+        x = w * 1.0
+        x.multiply_(pt.to_tensor([3.0]))  # x = 3w
+        z = (x * x).sum()                 # z = 9w^2 → dz/dw = 18w = 36
+        z.backward()
+        assert abs(float(w.grad.numpy()[0]) - 36.0) < 1e-4
+
+    def test_setitem_keeps_upstream_history(self):
+        w = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        x = w * 2.0
+        x[0] = 5.0                        # overwritten slot: no grad to w
+        x.sum().backward()
+        assert np.allclose(w.grad.numpy(), [0.0, 2.0])
+
+    def test_leaf_inplace_raises(self):
+        w = pt.to_tensor([1.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            w.exp_()

@@ -34,11 +34,11 @@ def _entries():
 def test_no_tpu_throughput_regression():
     tpu = [e for e in _entries()
            if e.get("extra", {}).get("backend") not in (None, "cpu")]
-    # group by (metric, batch, seq) so config changes don't false-alarm
+    # group by (metric, batch, seq, remat) so config changes don't false-alarm
     by_cfg = {}
     for e in tpu:
         by_cfg.setdefault((e.get("metric"), e.get("batch"),
-                           e.get("seq")), []).append(e)
+                           e.get("seq"), e.get("remat")), []).append(e)
     comparable = [v for v in by_cfg.values() if len(v) >= 2]
     if not comparable:
         pytest.skip("need two same-config TPU bench entries to compare")

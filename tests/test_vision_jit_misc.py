@@ -720,3 +720,65 @@ class TestSparseSoftmax3D:
                     e = np.exp(dense[i, j, nz] - dense[i, j, nz].max())
                     ref[i, j, nz] = e / e.sum()
         assert np.abs(out - ref).max() < 1e-5
+
+
+class TestLegacyReaderAPI:
+    """paddle.batch / paddle.reader decorators (reference python/paddle/
+    batch.py + reader/decorator.py)."""
+
+    def test_batch(self):
+        r = pt.batch(lambda: iter(range(10)), batch_size=3)
+        assert list(r()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        r = pt.batch(lambda: iter(range(10)), batch_size=3, drop_last=True)
+        assert list(r()) == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+
+    def test_decorators(self):
+        rd = pt.reader
+        base = lambda: iter(range(6))
+        assert list(rd.firstn(base, 3)()) == [0, 1, 2]
+        assert list(rd.chain(base, base)()) == list(range(6)) * 2
+        assert list(rd.map_readers(lambda a, b: a + b, base, base)()) == \
+            [0, 2, 4, 6, 8, 10]
+        assert list(rd.compose(base, rd.map_readers(lambda x: (x, -x),
+                                                    base))()) == \
+            [(i, i, -i) for i in range(6)]
+        assert sorted(rd.shuffle(base, 4)()) == list(range(6))
+        assert list(rd.buffered(base, 2)()) == list(range(6))
+        c = rd.cache(base)
+        assert list(c()) == list(range(6)) and list(c()) == list(range(6))
+
+    def test_xmap_and_multiprocess(self):
+        rd = pt.reader
+        base = lambda: iter(range(20))
+        out = list(rd.xmap_readers(lambda x: x * x, base, 4, 8,
+                                   order=True)())
+        assert out == [i * i for i in range(20)]
+        out = sorted(rd.xmap_readers(lambda x: x * x, base, 4, 8)())
+        assert out == sorted(i * i for i in range(20))
+        out = sorted(rd.multiprocess_reader([base, base])())
+        assert out == sorted(list(range(20)) * 2)
+
+    def test_sysconfig(self):
+        import os
+        assert os.path.isdir(pt.sysconfig.get_include())
+        assert os.path.isdir(pt.sysconfig.get_lib())
+
+    def test_cache_partial_epoch_no_dup(self):
+        c = pt.reader.cache(lambda: iter(range(4)))
+        next(c())  # abandon mid-epoch
+        assert list(c()) == [0, 1, 2, 3]
+        assert list(c()) == [0, 1, 2, 3]
+
+    def test_xmap_mapper_error_propagates(self):
+        import pytest as _pytest
+
+        def bad(x):
+            raise ValueError("boom")
+
+        with _pytest.raises(ValueError, match="boom"):
+            list(pt.reader.xmap_readers(bad, lambda: iter(range(4)), 2, 4)())
+
+    def test_batch_size_validation(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            pt.batch(lambda: iter(range(3)), batch_size=0)

@@ -1,0 +1,197 @@
+"""On-chip pallas kernel validation (VERDICT r1 weak #3).
+
+Runs the hand-written pallas kernels on the REAL TPU (no interpret mode)
+and checks them numerically against the XLA reference paths. tests/ pins
+JAX_PLATFORMS=cpu for hermetic CI, so this script is the hardware-truth
+companion: run it whenever the chip tunnel is alive.
+
+    python tools/validate_tpu_kernels.py        # writes TPU_VALIDATION.json
+
+Exit code 0 iff every kernel passes on-chip.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESULTS = []
+
+
+def check(name, fn):
+    t0 = time.perf_counter()
+    try:
+        detail = fn()
+        ok = True
+    except Exception as e:  # noqa: BLE001 — record, keep validating the rest
+        detail = f"{type(e).__name__}: {e}"
+        ok = False
+    dt = time.perf_counter() - t0
+    RESULTS.append({"kernel": name, "ok": ok, "detail": detail,
+                    "seconds": round(dt, 2)})
+    print(f"[{'PASS' if ok else 'FAIL'}] {name} ({dt:.1f}s): {detail}",
+          flush=True)
+    return ok
+
+
+def max_err(a, b):
+    return float(np.max(np.abs(np.asarray(a, np.float32) -
+                               np.asarray(b, np.float32))))
+
+
+def flash_fwd_bwd():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.flash_attention import (flash_attention_bhsd,
+                                                mha_reference)
+    rng = np.random.RandomState(0)
+    errs = {}
+    for (b, h, s, d), causal, dtype in [
+        ((2, 4, 512, 64), True, jnp.float32),
+        ((2, 4, 512, 64), False, jnp.float32),
+        ((1, 8, 1024, 128), True, jnp.bfloat16),
+        ((2, 4, 384, 64), True, jnp.float32),  # ragged tail block
+    ]:
+        q = jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.3
+        k = jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.3
+        v = jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.3
+        scale = 1.0 / math.sqrt(d)
+
+        def loss_pallas(q, k, v):
+            o = flash_attention_bhsd(q, k, v, causal=causal, use_pallas=True,
+                                     interpret=False)
+            return (o * v).sum(), o
+
+        def loss_ref(q, k, v):
+            o, _ = mha_reference(q, k, v, None, causal, scale)
+            return (o * v).sum(), o
+
+        (_, o_p), g_p = jax.value_and_grad(loss_pallas, (0, 1, 2),
+                                           has_aux=True)(q, k, v)
+        (_, o_r), g_r = jax.value_and_grad(loss_ref, (0, 1, 2),
+                                           has_aux=True)(q, k, v)
+        tol = 2e-2 if dtype == jnp.bfloat16 else 2e-3
+        eo = max_err(o_p, o_r)
+        eg = max(max_err(a, b) for a, b in zip(g_p, g_r))
+        # grads scale with S; compare relative to magnitude
+        gmag = max(float(np.abs(np.asarray(g, np.float32)).max())
+                   for g in g_r)
+        key = f"{b}x{h}x{s}x{d}{'c' if causal else ''}-{jnp.dtype(dtype).name}"
+        errs[key] = (round(eo, 5), round(eg / max(gmag, 1.0), 5))
+        assert eo < tol, f"{key}: fwd err {eo}"
+        assert eg / max(gmag, 1.0) < tol, f"{key}: bwd rel err {eg / gmag}"
+    return errs
+
+
+def varlen_fwd_bwd():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.varlen_attention import (flash_attn_unpadded,
+                                                 varlen_reference,
+                                                 seg_ids_from_cu_seqlens)
+    rng = np.random.RandomState(1)
+    h, d = 4, 64
+    lens = [200, 56, 312, 8]
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    total = int(cu[-1])
+    errs = {}
+    for causal in (True, False):
+        q = jnp.asarray(rng.randn(total, h, d), jnp.float32) * 0.3
+        k = jnp.asarray(rng.randn(total, h, d), jnp.float32) * 0.3
+        v = jnp.asarray(rng.randn(total, h, d), jnp.float32) * 0.3
+        seg = seg_ids_from_cu_seqlens(cu, total)
+        scale = 1.0 / math.sqrt(d)
+
+        def loss_pallas(q, k, v):
+            o, _ = flash_attn_unpadded(q, k, v, cu, cu, causal=causal,
+                                       use_pallas=True, interpret=False)
+            return (o * v).sum(), o
+
+        def loss_ref(q, k, v):
+            qh = jnp.swapaxes(q, 0, 1)
+            kh = jnp.swapaxes(k, 0, 1)
+            vh = jnp.swapaxes(v, 0, 1)
+            o, _ = varlen_reference(qh, kh, vh, seg, seg, causal, scale)
+            return (jnp.swapaxes(o, 0, 1) * v).sum(), o
+
+        (_, o_p), g_p = jax.value_and_grad(loss_pallas, (0, 1, 2),
+                                           has_aux=True)(q, k, v)
+        (_, _), g_r = jax.value_and_grad(loss_ref, (0, 1, 2),
+                                         has_aux=True)(q, k, v)
+        eg = max(max_err(a, b) for a, b in zip(g_p, g_r))
+        gmag = max(float(np.abs(np.asarray(g, np.float32)).max())
+                   for g in g_r)
+        errs[f"causal={causal}"] = round(eg / max(gmag, 1.0), 5)
+        assert eg / max(gmag, 1.0) < 2e-3
+    return errs
+
+
+def paged_decode():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.paged_attention import (paged_attention,
+                                                paged_attention_reference)
+    rng = np.random.RandomState(2)
+    b, qh, kvh, d = 4, 8, 4, 64
+    page_size, num_pages, pages_per_seq = 16, 64, 8
+    q = jnp.asarray(rng.randn(b, qh, d), jnp.float32) * 0.3
+    k_pages = jnp.asarray(rng.randn(kvh, num_pages, page_size, d),
+                          jnp.float32) * 0.3
+    v_pages = jnp.asarray(rng.randn(kvh, num_pages, page_size, d),
+                          jnp.float32) * 0.3
+    table = jnp.asarray(rng.permutation(num_pages)[:b * pages_per_seq]
+                        .reshape(b, pages_per_seq), jnp.int32)
+    lengths = jnp.asarray([100, 17, 128, 64], jnp.int32)
+    scale = d ** -0.5
+    o_p = paged_attention(q, k_pages, v_pages, table, lengths,
+                          use_pallas=True)
+    o_r = paged_attention_reference(q, k_pages, v_pages, table, lengths,
+                                    scale)
+    err = max_err(o_p, o_r)
+    assert err < 2e-3, f"paged decode err {err}"
+    return {"max_err": round(err, 6)}
+
+
+def flash_bf16_long():
+    """bf16 @ 4096 ctx — the bench's serving-relevant shape, on-chip."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.flash_attention import (flash_attention_bhsd,
+                                                mha_reference)
+    rng = np.random.RandomState(3)
+    b, h, s, d = 1, 4, 4096, 128
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.3
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.3
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.3
+    o_p = flash_attention_bhsd(q, k, v, causal=True, use_pallas=True,
+                               interpret=False)
+    o_r, _ = mha_reference(q, k, v, None, True, 1.0 / math.sqrt(d))
+    err = max_err(o_p, o_r)
+    assert err < 3e-2, f"bf16 long-ctx err {err}"
+    return {"max_err": round(err, 5)}
+
+
+def main():
+    import jax
+    dev = jax.devices()[0]
+    assert dev.platform != "cpu", f"not on TPU: {dev}"
+    print(f"validating on {dev} (jax {jax.__version__})", flush=True)
+    ok = True
+    ok &= check("flash_attention fwd+bwd", flash_fwd_bwd)
+    ok &= check("varlen flash_attn_unpadded fwd+bwd", varlen_fwd_bwd)
+    ok &= check("paged_attention decode", paged_decode)
+    ok &= check("flash bf16 4k-ctx", flash_bf16_long)
+    out = {"device": str(dev), "ok": bool(ok), "results": RESULTS}
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(here, "TPU_VALIDATION.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"ok": bool(ok)}))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
