@@ -45,6 +45,35 @@ def _tpu_alive():
     return False
 
 
+def _last_tpu_history():
+    """Most recent TPU entry from BENCH_HISTORY.jsonl, or None."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BENCH_HISTORY.jsonl")
+    last = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                # llama-headline entries only (they carry top-level
+                # batch/seq); bench_models.py rows must not masquerade as
+                # the pretrain datapoint
+                if e.get("extra", {}).get("backend") not in (None, "cpu") \
+                        and "batch" in e and "seq" in e:
+                    last = {k: e[k] for k in
+                            ("metric", "value", "unit", "vs_baseline",
+                             "ts", "batch", "seq", "remat") if k in e}
+                    last["mfu"] = e["extra"].get("mfu")
+    except OSError:
+        return None
+    return last
+
+
 def main():
     import jax
     if os.environ.get("PT_BENCH_CPU") == "1" or not _tpu_alive():
@@ -121,7 +150,7 @@ def main():
     mfu = flops_per_token * tok_per_sec / peak
 
     result = {
-        "metric": f"llama-{'2048x8' if on_tpu else 'tiny'} pretrain "
+        "metric": f"llama-{f'{seq}x{batch}' if on_tpu else 'tiny'} pretrain "
                   f"tokens/sec/chip ({gen}, bf16, flash-attn, remat)",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s",
@@ -129,12 +158,24 @@ def main():
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
                   "loss": float(loss), "backend": backend},
     }
+    if not on_tpu:
+        # the chip tunnel comes and goes; if it is down right now, surface
+        # the most recent REAL TPU measurement (clearly labeled with its
+        # timestamp) alongside the smoke number instead of erasing it
+        last = _last_tpu_history()
+        if last is not None:
+            result["extra"]["last_tpu_measured"] = last
     print(json.dumps(result))
     # perf-regression history: tests/test_perf_guard.py compares the last
     # two same-backend/same-config entries
     try:
-        hist = dict(result, ts=time.time(), batch=batch, seq=seq,
-                    remat=str(remat))
+        # history entry: shallow-copy extra WITHOUT the nested
+        # last_tpu_measured report field (it would re-embed the previous
+        # TPU entry into every CPU line)
+        extra = {k: v for k, v in result["extra"].items()
+                 if k != "last_tpu_measured"}
+        hist = dict(result, extra=extra, ts=time.time(), batch=batch,
+                    seq=seq, remat=str(remat))
         here = os.path.dirname(os.path.abspath(__file__))
         with open(os.path.join(here, "BENCH_HISTORY.jsonl"), "a") as f:
             f.write(json.dumps(hist) + "\n")

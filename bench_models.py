@@ -1,0 +1,221 @@
+"""Secondary benchmarks — BASELINE.json's non-Llama headline configs on
+one chip: ResNet-50 (vision conv path), BERT-base (encoder path), MoE
+decoder (expert path). The Llama pretrain headline lives in bench.py.
+
+    python bench_models.py [resnet50] [bert] [moe]   # default: all
+
+Prints one JSON line per model and appends each to BENCH_HISTORY.jsonl
+(tagged with "model") so the perf guard can compare rounds. On CPU (no
+chip / PT_BENCH_CPU=1) runs tiny smoke shapes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from bench import PEAK_FLOPS, _tpu_alive
+
+
+def _mesh1():
+    import jax
+    from jax.sharding import Mesh
+    return Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+
+
+def _time_steps(tr, batch, iters):
+    import jax
+    loss = tr.step(batch)  # compile + warmup
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = tr.step(batch)
+    jax.block_until_ready(loss)
+    return (time.perf_counter() - t0) / iters, float(np.asarray(loss))
+
+
+def bench_resnet50(on_tpu):
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.parallel.trainer import Trainer
+
+    bs, size, iters = (64, 224, 10) if on_tpu else (4, 32, 2)
+    model = pt.vision.models.resnet50(num_classes=1000)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=model.parameters())
+    ce = pt.nn.CrossEntropyLoss()
+
+    def loss_fn(m, b):
+        x, y = b
+        logits = m(x)
+        return ce(logits.astype("float32"), y)
+
+    tr = Trainer(model, opt, loss_fn, mesh=_mesh1())
+    rng = np.random.RandomState(0)
+    x = rng.randn(bs, 3, size, size).astype(
+        np.float32 if not on_tpu else jnp.bfloat16)
+    y = rng.randint(0, 1000, (bs,))
+    dt, loss = _time_steps(tr, (x, y), iters)
+    return {"imgs_per_sec": round(bs / dt, 1), "batch": bs,
+            "step_time_s": round(dt, 4), "loss": loss}
+
+
+def bench_bert(on_tpu):
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.bert import BertConfig, BertForSequenceClassification
+    from paddle_tpu.parallel.trainer import Trainer
+
+    if on_tpu:
+        cfg = BertConfig()  # base: 12L/768H
+        bs, seq, iters = 32, 128, 10
+    else:
+        cfg = BertConfig(vocab_size=512, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=128)
+        bs, seq, iters = 2, 16, 2
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=5e-5,
+                             parameters=model.parameters())
+    ce = pt.nn.CrossEntropyLoss()
+
+    def loss_fn(m, b):
+        ids, y = b
+        logits = m(ids)
+        return ce(logits.astype("float32"), y)
+
+    tr = Trainer(model, opt, loss_fn, mesh=_mesh1())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (bs, seq))
+    y = rng.randint(0, 2, (bs,))
+    dt, loss = _time_steps(tr, (ids, y), iters)
+    return {"seqs_per_sec": round(bs / dt, 1), "batch": bs, "seq": seq,
+            "step_time_s": round(dt, 4), "loss": loss}
+
+
+def bench_moe(on_tpu):
+    """MoE decoder pretrain step (shared+routed experts, top-2 gating) —
+    the DeepSeekMoE/Qwen2-MoE-style config from BASELINE.json."""
+    import jax.numpy as jnp
+    import paddle_tpu as pt
+    from paddle_tpu.models.moe_llm import MoEConfig, MoEForCausalLM
+    from paddle_tpu.parallel.trainer import Trainer
+
+    if on_tpu:
+        cfg = MoEConfig(vocab_size=32000, hidden_size=1024,
+                        intermediate_size=1408, num_hidden_layers=8,
+                        num_attention_heads=16, num_key_value_heads=16,
+                        num_experts=8, num_experts_per_tok=2,
+                        max_position_embeddings=2048)
+        bs, seq, iters = 8, 1024, 10
+    else:
+        cfg = MoEConfig.tiny_moe() if hasattr(MoEConfig, "tiny_moe") else \
+            MoEConfig(vocab_size=256, hidden_size=64, intermediate_size=96,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, num_experts=4,
+                      num_experts_per_tok=2, max_position_embeddings=128)
+        bs, seq, iters = 2, 32, 2
+    model = MoEForCausalLM(cfg)
+    for p in model.parameters():  # single-chip bench: no tp axis in mesh
+        p.dist_spec = None
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=3e-4,
+                             parameters=model.parameters())
+
+    def loss_fn(m, b):
+        ids, labels = b
+        out = m(ids)
+        logits = out[0] if isinstance(out, tuple) else out
+        logp = pt.nn.functional.log_softmax(logits.astype("float32"), axis=-1)
+        import paddle_tpu as _pt
+        picked = _pt.take_along_axis(logp, labels.unsqueeze(-1), axis=-1)
+        return -picked.mean()
+
+    tr = Trainer(model, opt, loss_fn, mesh=_mesh1())
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (bs, seq))
+    dt, loss = _time_steps(tr, (ids, ids), iters)
+    return {"tokens_per_sec": round(bs * seq / dt, 1), "batch": bs,
+            "seq": seq, "step_time_s": round(dt, 4), "loss": loss}
+
+
+def bench_serving(on_tpu):
+    """Continuous-batching decode throughput over the paged KV cache
+    (pallas paged-attention kernel on chip) — the inference-side headline
+    (reference: PaddleNLP predictor block_multihead_attention path)."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models import llama_spmd as M
+    from paddle_tpu.models.llama_serving import Request, ServingEngine
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=8,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048)
+        max_seqs, new_tok, nreq, dtype = 8, 128, 16, jnp.bfloat16
+        max_seq_len, page = 1024, 16
+    else:
+        cfg = LlamaConfig.tiny(vocab=256, hidden=64, layers=2, heads=4,
+                               kv_heads=2, ffn=128)
+        max_seqs, new_tok, nreq, dtype = 2, 8, 3, jnp.float32
+        max_seq_len, page = 64, 8
+    params = M.init_params(cfg, seed=0, dtype=dtype)
+    eng = ServingEngine(params, cfg, max_seqs=max_seqs,
+                        max_seq_len=max_seq_len, page_size=page, dtype=dtype)
+    rng = np.random.RandomState(0)
+    for i in range(nreq):
+        plen = int(rng.randint(8, 64)) if on_tpu else 3
+        eng.submit(Request(f"r{i}", list(rng.randint(1, cfg.vocab_size,
+                                                     plen)),
+                           max_new_tokens=new_tok))
+    t0 = time.perf_counter()
+    done = eng.run() if hasattr(eng, "run") else None
+    dt = time.perf_counter() - t0
+    total_new = sum(len(r.output) for r in done)
+    return {"decode_tokens_per_sec": round(total_new / dt, 1),
+            "requests": nreq, "new_tokens": total_new, "batch": max_seqs,
+            "step_time_s": round(dt / max(total_new, 1), 5),
+            "loss": 0.0}
+
+
+BENCHES = {"resnet50": bench_resnet50, "bert": bench_bert, "moe": bench_moe,
+           "serving": bench_serving}
+
+
+def main():
+    import jax
+    if os.environ.get("PT_BENCH_CPU") == "1" or not _tpu_alive():
+        print("# TPU unreachable; CPU smoke shapes", file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+    on_tpu = backend != "cpu"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e") if on_tpu else "cpu"
+
+    which = sys.argv[1:] or list(BENCHES)
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in which:
+        res = BENCHES[name](on_tpu)
+        kind = "decode" if name == "serving" else "train step"
+        entry = {"metric": f"{name} {kind} ({gen})", "model": name,
+                 "unit": "steps/s",
+                 "value": round(1.0 / res["step_time_s"], 3),
+                 "extra": dict(res, backend=backend)}
+        print(json.dumps(entry))
+        try:
+            with open(os.path.join(here, "BENCH_HISTORY.jsonl"), "a") as f:
+                f.write(json.dumps(dict(entry, ts=time.time())) + "\n")
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    main()

@@ -62,6 +62,7 @@ from . import signal  # noqa: F401
 from . import geometric  # noqa: F401
 from . import utils  # noqa: F401
 from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
 from . import sysconfig  # noqa: F401
 from .batch import batch  # noqa: F401
 from .hapi.model import Model  # noqa: F401

@@ -32,7 +32,7 @@ def _topo_order(root_nodes):
                 continue
             visited.add(id(node))
             stack.append((node, True))
-            for pnode, _ in node.input_links:
+            for pnode, _, _ in node.input_links:
                 if pnode is not None and id(pnode) not in visited:
                     stack.append((pnode, False))
     order.reverse()
@@ -90,12 +90,13 @@ def _run_backward(outputs, out_grads, inputs=None, accumulate_into_leaves=True,
         if cts is None or all(c is None for c in cts):
             continue
         in_grads = node.vjp(cts)
-        for t, (pnode, pidx), g in zip(node.input_tensors, node.input_links,
-                                       in_grads):
-            if t is None or t.stop_gradient or _float0_like(g):
+        for t, (pnode, pidx, sg), g in zip(node.input_tensors,
+                                           node.input_links, in_grads):
+            if t is None or sg or _float0_like(g):
                 continue
-            # route via the producer link frozen at record time, NOT
-            # t._node (which an in-place op may have redirected since)
+            # route via the producer link + stop_gradient frozen at record
+            # time, NOT t._node / t.stop_gradient (an in-place op may have
+            # redirected or severed them since)
             if pnode is not None:
                 nkey = id(pnode)
                 nodes[nkey] = pnode

@@ -68,12 +68,14 @@ class TapeNode:
         # Producer links frozen at record time. The tape is snapshot-
         # consistent: raw_inputs already captures input *values* as of the
         # record, so routing must capture input *history* then too — if it
-        # resolved t._node at backward time instead, an in-place mutation
-        # of t between record and backward would re-route this node's
-        # cotangent through the mutation op (wrong grads for every earlier
-        # consumer of t).
+        # resolved t._node (or t.stop_gradient) at backward time instead,
+        # an in-place mutation of t between record and backward would
+        # re-route or sever this node's cotangent (wrong/missing grads for
+        # every earlier consumer of t). Entries: (producer, out_idx,
+        # stop_gradient) as of the record.
         self.input_links = tuple(
-            (t._node, t._out_idx) if isinstance(t, Tensor) else (None, 0)
+            (t._node, t._out_idx, t.stop_gradient) if isinstance(t, Tensor)
+            else (None, 0, True)
             for t in input_tensors)
 
     def vjp(self, cotangents):

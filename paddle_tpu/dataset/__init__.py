@@ -1,0 +1,84 @@
+"""Legacy paddle.dataset API (reference: python/paddle/dataset/*).
+
+Paddle 1.x exposed datasets as *readers* (zero-arg callables yielding
+samples) — the counterpart of the paddle.reader decorators. This shim
+keeps that surface, backed by the modern dataset classes in
+paddle_tpu.vision.datasets / paddle_tpu.text (synthetic or local-file,
+no downloads in this offline build). New code should use the Dataset /
+DataLoader API directly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "common"]
+
+
+class _ReaderModule:
+    """mnist/cifar-style module face: .train() / .test() return readers."""
+
+    def __init__(self, make_pairs):
+        self._make_pairs = make_pairs
+
+    def train(self, **kwargs):
+        def rd():
+            yield from self._make_pairs("train", **kwargs)
+        return rd
+
+    def test(self, **kwargs):
+        def rd():
+            yield from self._make_pairs("test", **kwargs)
+        return rd
+
+
+def _mnist_pairs(mode, **kwargs):
+    from ..vision.datasets import MNIST
+    ds = MNIST(mode=mode, **kwargs)
+    for i in range(len(ds)):
+        img, label = ds[i]
+        yield np.asarray(img, np.float32).reshape(-1) / 255.0 * 2 - 1, \
+            int(np.asarray(label).reshape(-1)[0])
+
+
+def _cifar_pairs(mode, **kwargs):
+    from ..vision.datasets import Cifar10
+    ds = Cifar10(mode=mode, **kwargs)
+    for i in range(len(ds)):
+        img, label = ds[i]
+        yield np.asarray(img, np.float32).reshape(-1) / 255.0, \
+            int(np.asarray(label).reshape(-1)[0])
+
+
+def _uci_pairs(mode, **kwargs):
+    from ..text import UCIHousing
+    ds = UCIHousing(mode=mode, **kwargs)
+    for i in range(len(ds)):
+        feat, target = ds[i]
+        yield np.asarray(feat, np.float32), np.asarray(target, np.float32)
+
+
+def _imdb_pairs(mode, **kwargs):
+    from ..text import Imdb
+    ds = Imdb(mode=mode, **kwargs)
+    for i in range(len(ds)):
+        doc, label = ds[i]
+        yield doc, int(label)
+
+
+mnist = _ReaderModule(_mnist_pairs)
+cifar = _ReaderModule(_cifar_pairs)
+# reference cifar module names: train10/test10/train100/test100
+cifar.train10, cifar.test10 = cifar.train, cifar.test
+uci_housing = _ReaderModule(_uci_pairs)
+imdb = _ReaderModule(_imdb_pairs)
+
+
+class common:  # reference dataset/common.py surface (md5/convert no-ops)
+    @staticmethod
+    def md5file(fname):
+        import hashlib
+        h = hashlib.md5()
+        with open(fname, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()

@@ -29,7 +29,15 @@ def init_parallel_env():
     trainers that all see the same data shard and produce wrong results.
     """
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coord and jax.process_count() == 1:
+    # NB: must not call jax.process_count() (or anything else that
+    # initializes the XLA backend) before jax.distributed.initialize —
+    # initialize() refuses to run after backend init, which would make
+    # every real rendezvous fail. Probe the distributed client directly.
+    try:
+        already = jax.distributed.is_initialized()
+    except Exception:
+        already = False
+    if coord and not already:
         nproc = os.environ.get("JAX_NUM_PROCESSES")
         pid = os.environ.get("JAX_PROCESS_ID")
         try:

@@ -82,16 +82,21 @@ def _pool(x, kernel, stride, padding, nsp, data_format, kind, ceil_mode=False,
                     rem = (size - k[i]) % s[i]
                     if rem != 0:
                         pad_cfg[ax] = (pad_cfg[ax][0], pad_cfg[ax][1] + s[i] - rem)
+        # init values MUST be python scalars, not arrays: lax.reduce_window
+        # only specializes to the differentiable max/add monoid primitives
+        # when it recognizes the scalar identity; an array init binds the
+        # generic variadic primitive, which fails to linearize under
+        # jit(grad(...)) (broke MaxPool backward inside the Trainer)
         if kind == "max":
             init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
-                jnp.iinfo(a.dtype).min
-            return lax.reduce_window(a, jnp.asarray(init, a.dtype), lax.max,
+                int(jnp.iinfo(a.dtype).min)
+            return lax.reduce_window(a, init, lax.max,
                                      dims, strides, pad_cfg)
-        summed = lax.reduce_window(a, jnp.asarray(0, a.dtype), lax.add, dims,
-                                   strides, pad_cfg)
+        summed = lax.reduce_window(a, 0.0 if jnp.issubdtype(
+            a.dtype, jnp.floating) else 0, lax.add, dims, strides, pad_cfg)
         if exclusive and not isinstance(pad_cfg, str):
             ones = jnp.ones_like(a)
-            counts = lax.reduce_window(ones, jnp.asarray(0, a.dtype), lax.add,
+            counts = lax.reduce_window(ones, 0.0, lax.add,
                                        dims, strides, pad_cfg)
             return summed / counts
         denom = float(np.prod(k))

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -32,8 +33,9 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# (8,128)-aligned tile sizes; overridable for on-chip tuning sweeps
+DEFAULT_BLOCK_Q = int(os.environ.get("PT_FLASH_BLOCK_Q", "128"))
+DEFAULT_BLOCK_K = int(os.environ.get("PT_FLASH_BLOCK_K", "128"))
 # np.float32: a bare Python float lowers as an f64 constant inside Mosaic,
 # and v5e libtpu rejects 'tpu.truncf f64->f32' — keep all kernel consts f32.
 NEG_INF = np.float32(-1e30)
@@ -53,9 +55,6 @@ def _fit_lanes(x128, n):
         return x128[:, :n]
     assert n % LANES == 0, f"block dim {n} must be a multiple of {LANES}"
     return jnp.tile(x128, (1, n // LANES))
-
-
-import os
 
 
 def _on_tpu():

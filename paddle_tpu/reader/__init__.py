@@ -17,6 +17,16 @@ __all__ = [
 ]
 
 
+class _ReaderError:
+    """In-band marker carrying a producer-thread exception to the
+    consumer — a failed reader must raise, not truncate the stream."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
 def cache(reader):
     """Cache all samples in memory on first *complete* epoch; replay
     thereafter. A partially-consumed first epoch leaves the cache unfilled
@@ -117,8 +127,9 @@ def buffered(reader, size):
             try:
                 for item in reader():
                     q.put(item)
-            finally:
                 q.put(end)
+            except BaseException as e:  # forward, never truncate silently
+                q.put(_ReaderError(e))
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
@@ -126,6 +137,8 @@ def buffered(reader, size):
             item = q.get()
             if item is end:
                 break
+            if isinstance(item, _ReaderError):
+                raise item.exc
             yield item
 
     return rd
@@ -149,8 +162,12 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         out_q = queue.Queue(buffer_size)
 
         def feed():
-            for i, item in enumerate(reader()):
-                in_q.put((i, item))
+            try:
+                for i, item in enumerate(reader()):
+                    in_q.put((i, item))
+            except BaseException as e:  # source failed: tell the consumer
+                out_q.put(("__xmap_error__", e))  # (workers stay parked)
+                return
             for _ in range(process_num):
                 in_q.put(end)
 
@@ -202,8 +219,9 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 while want in pending:
                     yield pending.pop(want)
                     want += 1
-            for i in sorted(pending):
-                yield pending[i]
+            # end only arrives after every worker drained (and error paths
+            # raise before it), so the ordered stream must be complete here
+            assert not pending, "xmap_readers: index gap at end of stream"
 
     return rd
 
@@ -225,8 +243,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 for item in r():
                     q.put(item)
-            finally:
                 q.put(end)
+            except BaseException as e:
+                q.put(_ReaderError(e))
 
         for r in readers:
             threading.Thread(target=drain, args=(r,), daemon=True).start()
@@ -235,6 +254,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             item = q.get()
             if item is end:
                 finished += 1
+            elif isinstance(item, _ReaderError):
+                raise item.exc
             else:
                 yield item
 

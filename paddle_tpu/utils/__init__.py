@@ -47,9 +47,29 @@ def map_structure(func, *structures):
     return jax.tree_util.tree_map(func, *structures)
 
 
-class deprecated:
-    def __init__(self, update_to="", since="", reason="", level=0):
-        self.update_to = update_to
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference: python/paddle/
+    utils/deprecated.py). level 0 logs nothing, 1 warns, 2 raises."""
+    import functools
+    import warnings
 
-    def __call__(self, func):
-        return func
+    def wrap(fn):
+        msg = f"API '{getattr(fn, '__name__', fn)}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f"; use {update_to} instead"
+        if reason:
+            msg += f" ({reason})"
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level == 1:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        inner.__deprecated_message__ = msg
+        return inner
+
+    return wrap

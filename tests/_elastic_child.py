@@ -1,0 +1,61 @@
+"""Child trainer for test_elastic: crash once mid-run, resume from the
+checkpoint on relaunch. Exercises the real fault-tolerance loop:
+launch(max_restarts) → crash → relaunch → load_state → continue.
+
+argv: workdir total_steps crash_at
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.utils import checkpoint as ckpt  # noqa: E402
+
+
+def main():
+    workdir, total_steps, crash_at = (sys.argv[1], int(sys.argv[2]),
+                                      int(sys.argv[3]))
+    ck = os.path.join(workdir, "ckpt")
+    marker = os.path.join(workdir, "crashed_once")
+    log = os.path.join(workdir, "steps.log")
+
+    pt.seed(0)
+    model = pt.nn.Linear(4, 1)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+
+    start = 0
+    if os.path.isdir(ck):
+        step, _extra = ckpt.load_state(ck, model=model, optimizer=opt)
+        start = int(step) + 1
+
+    rng = np.random.RandomState(7)
+    x = rng.randn(16, 4).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    y = x @ w_true
+
+    for step in range(start, total_steps):
+        xb = pt.to_tensor(x)
+        yb = pt.to_tensor(y)
+        loss = ((model(xb) - yb) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        with open(log, "a") as f:
+            f.write(f"{step} {float(loss.numpy()):.6f}\n")
+        ckpt.save_state(ck, model=model, optimizer=opt, step=step)
+        if step == crash_at and not os.path.exists(marker):
+            open(marker, "w").close()
+            os._exit(17)  # simulate a hard crash (no cleanup)
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

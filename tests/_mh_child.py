@@ -1,0 +1,46 @@
+"""Child process for test_multihost: one 'host' of a 2-process launch.
+
+Pins a 2-device virtual CPU backend, completes the jax.distributed
+rendezvous via init_parallel_env (driven by the env vars the launcher
+exports), then participates in a cross-process global-array reduction.
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from paddle_tpu.distributed import env as E  # noqa: E402
+
+
+def main():
+    E.init_parallel_env()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert jax.local_device_count() == 2
+    assert E.get_world_size() == 2 and E.get_rank() == jax.process_index()
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4), ("dp",))
+    # each process contributes rows of value (rank+1); the jitted global
+    # sum must see both processes' shards: 2*1*8 + 2*2*8 = 48
+    x = jax.make_array_from_callback(
+        (4, 8), NamedSharding(mesh, P("dp")),
+        lambda idx: np.full((1, 8), jax.process_index() + 1.0, np.float32))
+    s = jax.jit(lambda a: jnp.sum(a),
+                out_shardings=NamedSharding(mesh, P()))(x)
+    val = float(np.asarray(jax.device_get(s)))
+    assert val == 48.0, val
+    print(f"RENDEZVOUS_OK rank={jax.process_index()} sum={val}")
+
+
+if __name__ == "__main__":
+    main()

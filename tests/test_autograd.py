@@ -193,3 +193,19 @@ class TestInplaceTapeSafety:
         w = pt.to_tensor([1.0], stop_gradient=False)
         with pytest.raises(RuntimeError):
             w.exp_()
+
+    def test_no_grad_mutation_keeps_earlier_consumer_grads(self):
+        """stop_gradient is frozen into the tape at record time: a later
+        no_grad in-place mutation (which severs x's history and marks it
+        constant) must not drop gradients of consumers recorded before."""
+        w = pt.to_tensor([2.0], stop_gradient=False)
+        x = w * 1.0
+        y = x.exp()
+        with pt.no_grad():
+            x.add_(pt.to_tensor([1.0]))
+        y.backward()
+        assert w.grad is not None
+        assert abs(float(w.grad.numpy()[0]) - float(np.exp(2.0))) < 1e-5
+        # and post-mutation consumers see x as a constant
+        z = (x * x).sum()
+        assert z.stop_gradient

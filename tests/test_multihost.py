@@ -1,0 +1,56 @@
+"""Multi-host launch: REAL 2-process jax.distributed rendezvous on CPU.
+
+ADVICE r1 (high): the launcher env-var contract was only unit-tested on
+dict construction; a broken rendezvous silently ran N independent
+trainers. This test spawns two actual processes through the launcher's
+build_env and requires: coordinator handshake, global device visibility
+(2 procs x 2 local devices = 4), and a cross-process global-array
+reduction producing the mathematically-correct value in both processes.
+
+Reference parity: python/paddle/distributed/launch (multi-node spawn) +
+collective init over NCCL; ours rides jax.distributed + XLA collectives.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CHILD = os.path.join(HERE, "_mh_child.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_rendezvous_and_global_reduction():
+    from paddle_tpu.distributed.launch import build_env
+
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = build_env(2, rank, f"127.0.0.1:{port}", base_env=os.environ)
+        env.pop("JAX_PLATFORMS", None)  # child pins its own platform
+        procs.append(subprocess.Popen(
+            [sys.executable, CHILD], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
+        outs.append(out)
+    for rank, out in enumerate(outs):
+        assert f"RENDEZVOUS_OK rank={rank} sum=48.0" in out, out
+
+
+def test_single_process_launch_unchanged():
+    """nnodes=1 must not export rendezvous vars (plain local run)."""
+    from paddle_tpu.distributed.launch import build_env
+
+    env = build_env(1, 0, "127.0.0.1:9999", base_env={})
+    assert "JAX_COORDINATOR_ADDRESS" not in env
+    assert "JAX_NUM_PROCESSES" not in env

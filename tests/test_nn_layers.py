@@ -374,3 +374,51 @@ class TestAdaptiveLogSoftmax:
                 pt.to_tensor(rng.randn(2, 4).astype(np.float32)),
                 pt.to_tensor(np.array([0, 9])), pt.to_tensor(hw), tails,
                 [2, 5])
+
+
+class TestPoolGradUnderJit:
+    """MaxPool/AvgPool backward must survive jit(grad(...)): lax.reduce_window
+    only specializes to differentiable monoid primitives for scalar inits
+    (array inits bind the generic primitive, which cannot linearize)."""
+
+    def test_maxpool_avgpool_jit_grad(self):
+        import jax
+        import numpy as np
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu._core.tensor import Tensor
+
+        x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+
+        for fn in (lambda t: F.max_pool2d(t, 3, stride=2, padding=1),
+                   lambda t: F.avg_pool2d(t, 3, stride=2, padding=1),
+                   lambda t: F.max_pool2d(t, 2, stride=2, ceil_mode=True)):
+            def scalar(raw):
+                return fn(Tensor(raw))._value.sum()
+            g_jit = jax.jit(jax.grad(scalar))(x)
+            g_eager = jax.grad(scalar)(x)
+            assert np.allclose(np.asarray(g_jit), np.asarray(g_eager))
+
+    def test_trainer_conv_maxpool_step(self):
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+        import paddle_tpu as pt
+        from paddle_tpu.parallel.trainer import Trainer
+
+        model = pt.nn.Sequential(
+            pt.nn.Conv2D(3, 4, 3, padding=1),
+            pt.nn.MaxPool2D(3, stride=2, padding=1),
+            pt.nn.Flatten(),
+            pt.nn.Linear(4 * 16 * 16, 5),
+        )
+        opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+        ce = pt.nn.CrossEntropyLoss()
+        tr = Trainer(model, opt, lambda m, b: ce(m(b[0]), b[1]),
+                     mesh=Mesh(np.asarray(jax.devices()[:1]), ("dp",)))
+        x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+        y = np.random.randint(0, 5, (2,))
+        l0 = float(np.asarray(tr.step((x, y))))
+        for _ in range(3):
+            loss = tr.step((x, y))
+        assert float(np.asarray(loss)) < l0

@@ -34,11 +34,17 @@ def _entries():
 def test_no_tpu_throughput_regression():
     tpu = [e for e in _entries()
            if e.get("extra", {}).get("backend") not in (None, "cpu")]
-    # group by (metric, batch, seq, remat) so config changes don't false-alarm
+    # group by (model, batch, seq, remat) so config changes don't
+    # false-alarm and bench_models.py entries (keyed by "model") never
+    # cross-compare with each other or the llama headline. Pre-format
+    # entries lacking the remat key ran the default remat=True, and the
+    # metric string is a label (it once hard-coded the config), so
+    # neither joins the grouping key in a way that would orphan history.
     by_cfg = {}
     for e in tpu:
-        by_cfg.setdefault((e.get("metric"), e.get("batch"),
-                           e.get("seq"), e.get("remat")), []).append(e)
+        by_cfg.setdefault((e.get("model", "llama"), e.get("batch"),
+                           e.get("seq"), e.get("remat", "True")),
+                          []).append(e)
     comparable = [v for v in by_cfg.values() if len(v) >= 2]
     if not comparable:
         pytest.skip("need two same-config TPU bench entries to compare")

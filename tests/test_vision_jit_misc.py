@@ -782,3 +782,44 @@ class TestLegacyReaderAPI:
         import pytest as _pytest
         with _pytest.raises(ValueError):
             pt.batch(lambda: iter(range(3)), batch_size=0)
+
+    def test_buffered_and_multiprocess_reader_errors_propagate(self):
+        import pytest as _pytest
+
+        def bad():
+            yield 1
+            raise IOError("disk gone")
+
+        with _pytest.raises(IOError):
+            list(pt.reader.buffered(bad, 2)())
+        with _pytest.raises(IOError):
+            list(pt.reader.multiprocess_reader([bad, lambda: iter(range(3))])())
+        with _pytest.raises(IOError):
+            list(pt.reader.xmap_readers(lambda x: x, bad, 2, 4)())
+
+    def test_legacy_dataset_readers(self):
+        """paddle.dataset parity (reference python/paddle/dataset/*):
+        reader-style .train()/.test() backed by the modern datasets."""
+        import itertools
+        r = pt.dataset.mnist.train()
+        x, y = next(iter(r()))
+        assert x.shape == (784,) and 0 <= y < 10
+        assert -1.0 <= x.min() and x.max() <= 1.0
+        b = next(pt.batch(pt.dataset.mnist.test(), 16)())
+        assert len(b) == 16
+        feats, target = next(iter(pt.dataset.uci_housing.train()()))
+        assert feats.shape[-1] == 13
+        assert len(list(itertools.islice(pt.dataset.cifar.train10()(), 2))) == 2
+
+    def test_utils_deprecated(self):
+        import warnings
+        from paddle_tpu.utils import deprecated
+
+        @deprecated(update_to="pt.new_api", since="2.0", level=1)
+        def old(x):
+            return x + 1
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old(1) == 2
+            assert any(issubclass(i.category, DeprecationWarning) for i in w)
