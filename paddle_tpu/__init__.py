@@ -43,7 +43,13 @@ from . import distributed  # noqa: F401
 from . import metric  # noqa: F401
 from . import distribution  # noqa: F401
 from . import device  # noqa: F401
-from . import linalg  # noqa: F401
+# NB: `from . import linalg` would NOT import our linalg.py here — the
+# `from .tensor import *` above already bound the name to the
+# tensor.linalg submodule, and _handle_fromlist skips importing when the
+# attribute exists. Import the real module explicitly and rebind.
+import paddle_tpu.linalg as _linalg_full  # noqa: E402
+
+linalg = _linalg_full
 from . import fft  # noqa: F401
 from . import sparse  # noqa: F401
 from . import incubate  # noqa: F401

@@ -51,16 +51,33 @@ class Place:
         return False
 
 
+# active (pack, unpack) hook pairs — see autograd.saved_tensors_hooks
+_saved_tensor_hooks: list = []
+
+
 class TapeNode:
     """One recorded op. VJP is derived lazily via jax.vjp on the pure fn."""
 
     __slots__ = ("fn", "kwargs", "raw_inputs", "input_tensors", "raw_outputs",
-                 "multi", "name", "input_links")
+                 "multi", "name", "input_links", "_unpack")
 
     def __init__(self, fn, kwargs, raw_inputs, input_tensors, raw_outputs, multi, name):
         self.fn = fn
         self.kwargs = kwargs
-        self.raw_inputs = raw_inputs
+        if _saved_tensor_hooks:
+            # pack only the slots that are saved TENSORS (reference
+            # semantics) — axis ints, shapes, and raw index arrays pass
+            # through untouched so replay/vjp see them as recorded
+            pack, unpack = _saved_tensor_hooks[-1]
+            packed_slots = tuple(isinstance(t, Tensor)
+                                 for t in input_tensors)
+            self.raw_inputs = tuple(
+                pack(r) if is_t else r
+                for r, is_t in zip(raw_inputs, packed_slots))
+            self._unpack = (unpack, packed_slots)
+        else:
+            self.raw_inputs = raw_inputs
+            self._unpack = None
         self.input_tensors = input_tensors
         self.raw_outputs = raw_outputs
         self.multi = multi
@@ -82,7 +99,13 @@ class TapeNode:
         """cotangents: list aligned with raw_outputs (None → zeros)."""
         fn, kw = self.fn, self.kwargs
         closed = (lambda *a: fn(*a, **kw)) if kw else fn
-        _, vjp_fn = jax.vjp(closed, *self.raw_inputs)
+        if self._unpack is None:
+            raw = self.raw_inputs
+        else:
+            unpack, packed_slots = self._unpack
+            raw = tuple(unpack(r) if is_t else r
+                        for r, is_t in zip(self.raw_inputs, packed_slots))
+        _, vjp_fn = jax.vjp(closed, *raw)
         if self.multi:
             ct = tuple(
                 jnp.zeros_like(o) if c is None else c

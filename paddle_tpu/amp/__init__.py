@@ -123,3 +123,16 @@ class debugging:
     @staticmethod
     def disable_operator_stats_collection():
         pass
+
+
+def is_float16_supported(device=None):
+    """reference: amp/__init__.py — device fp16 capability. XLA:TPU
+    computes fp16 (though bf16 is the native fast path); CPU reports
+    False like the reference."""
+    import jax
+    return jax.default_backend() != "cpu"
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is TPU-native (MXU accumulates bf16 inputs in fp32)."""
+    return True

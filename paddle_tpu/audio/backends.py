@@ -1,0 +1,90 @@
+"""Audio file IO (reference: python/paddle/audio/backends — wave_backend).
+
+The reference's default backend is a pure-python WAV reader/writer; same
+here via the stdlib `wave` module (16-bit PCM), no external deps.
+"""
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+
+import numpy as np
+
+from .._core.tensor import Tensor, unwrap
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=8 * f.getsampwidth())
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    if channels_first:
+        data = data.T
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(np.ascontiguousarray(data))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_S", bits_per_sample=16):
+    data = np.asarray(unwrap(src))
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    if bits_per_sample != 16:
+        raise ValueError("wave backend writes 16-bit PCM only "
+                         "(reference wave_backend parity)")
+    if np.issubdtype(data.dtype, np.floating):
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(data).tobytes())
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name not in ("wave_backend",):
+        raise NotImplementedError(
+            f"only the stdlib wave backend exists offline; got "
+            f"{backend_name}")

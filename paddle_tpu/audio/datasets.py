@@ -1,0 +1,98 @@
+"""Audio datasets (reference: python/paddle/audio/datasets — TESS/ESC50).
+
+Offline build: local-file mode reads WAVs from a directory laid out like
+the reference datasets; without files, a seeded synthetic waveform set
+keeps pipelines runnable (mirrors the vision datasets' offline policy).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from .backends import load as _load
+
+__all__ = ["AudioClassificationDataset", "TESS", "ESC50"]
+
+
+class AudioClassificationDataset(Dataset):
+    def __init__(self, files=None, labels=None, feat_type="raw",
+                 sample_rate=16000, duration=1.0, n_classes=8, n_items=64,
+                 archive=None, **kwargs):
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        if files:
+            self.files = list(files)
+            self.labels = list(labels)
+            self._synthetic = None
+        else:
+            rng = np.random.RandomState(0)
+            n = int(sample_rate * duration)
+            t = np.arange(n) / sample_rate
+            waves, labs = [], []
+            for i in range(n_items):
+                lab = i % n_classes
+                f0 = 120.0 * (lab + 1)
+                w = np.sin(2 * np.pi * f0 * t) + \
+                    0.1 * rng.randn(n)
+                waves.append(w.astype(np.float32))
+                labs.append(lab)
+            self._synthetic = waves
+            self.labels = labs
+            self.files = [None] * n_items
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        if self._synthetic is not None:
+            wave = self._synthetic[idx]
+        else:
+            t, _sr = _load(self.files[idx], channels_first=False)
+            wave = np.asarray(t.numpy())[:, 0]
+        return wave, self.labels[idx]
+
+
+class TESS(AudioClassificationDataset):
+    """Toronto emotional speech set layout (reference audio/datasets/
+    tess.py): <root>/<speaker>_<word>_<emotion>.wav."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, mode="train", feat_type="raw", data_dir=None, **kw):
+        if data_dir and os.path.isdir(data_dir):
+            files, labels = [], []
+            for fn in sorted(os.listdir(data_dir)):
+                if fn.lower().endswith(".wav"):
+                    emo = fn.rsplit("_", 1)[-1][:-4].lower()
+                    if emo in self.EMOTIONS:
+                        files.append(os.path.join(data_dir, fn))
+                        labels.append(self.EMOTIONS.index(emo))
+            super().__init__(files=files, labels=labels,
+                             feat_type=feat_type, **kw)
+        else:
+            super().__init__(feat_type=feat_type,
+                             n_classes=len(self.EMOTIONS), **kw)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds layout (reference audio/datasets/
+    esc50.py): <root>/<fold>-<id>-<take>-<target>.wav."""
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, **kw):
+        if data_dir and os.path.isdir(data_dir):
+            files, labels = [], []
+            for fn in sorted(os.listdir(data_dir)):
+                if fn.endswith(".wav") and fn.count("-") >= 3:
+                    fold = int(fn.split("-")[0])
+                    target = int(fn[:-4].split("-")[-1])
+                    train = fold != split
+                    if (mode == "train") == train:
+                        files.append(os.path.join(data_dir, fn))
+                        labels.append(target)
+            super().__init__(files=files, labels=labels,
+                             feat_type=feat_type, **kw)
+        else:
+            super().__init__(feat_type=feat_type, n_classes=50, **kw)

@@ -176,4 +176,28 @@ def hessian(func_out, xs, batch_axis=None):
 
 
 __all__ = ["no_grad", "enable_grad", "backward", "grad", "PyLayer",
-           "PyLayerContext", "jacobian", "set_grad_enabled"]
+           "PyLayerContext", "jacobian", "set_grad_enabled",
+           "saved_tensors_hooks"]
+
+
+class saved_tensors_hooks:
+    """reference: paddle.autograd.saved_tensors_hooks — pack/unpack hooks
+    over tensors the tape saves for backward. Tape integration: while the
+    context is active, every recorded TapeNode stores pack_hook(raw) in
+    place of each raw input and calls unpack_hook when its VJP runs
+    (e.g. offload activations to host numpy, reload on backward).
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        from .._core import tensor as _t
+        _t._saved_tensor_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        from .._core import tensor as _t
+        _t._saved_tensor_hooks.pop()
+        return False

@@ -35,3 +35,16 @@ def spawn(func, args=(), nprocs=-1, **options):
 
 def get_device_count():
     return env.device_count()
+from . import io  # noqa: E402,F401
+from .extras import (  # noqa: E402,F401
+    ParallelEnv, ParallelMode, ReduceType, DistAttr, gather,
+    scatter_object_list, isend, irecv, gloo_init_parallel_env, gloo_barrier,
+    gloo_release, split, dtensor_from_fn, unshard_dtensor, set_mesh,
+    save_state_dict, load_state_dict, ShardingStage1, ShardingStage2,
+    ShardingStage3, shard_optimizer, shard_scaler, Strategy, LocalLayer,
+    parallelize, ColWiseParallel, RowWiseParallel, SequenceParallelBegin,
+    SequenceParallelEnd, SequenceParallelEnable, SequenceParallelDisable,
+    PrepareLayerInput, PrepareLayerOutput, SplitPoint, QueueDataset,
+    InMemoryDataset, CountFilterEntry, ShowClickEntry, ProbabilityEntry,
+    to_distributed,
+)

@@ -1,0 +1,386 @@
+"""Remaining paddle.distributed surface (reference: python/paddle/
+distributed/__init__.py __all__): legacy env objects, dtensor auxiliary
+APIs, the `parallelize` plan classes, and PS-era dataset/entry configs.
+
+Single-controller SPMD translation: "process group" notions map onto mesh
+axes; anything that only exists to coordinate multi-process CPU servers
+(gloo, parameter-server datasets) is a documented shim pointing at the
+mesh-native path (see distributed/ps.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, unwrap
+from . import env
+from .collective import all_gather, barrier
+
+
+# ---------------------------------------------------------------- legacy env
+class ParallelEnv:
+    """reference: parallel.ParallelEnv (legacy env object)."""
+
+    @property
+    def rank(self):
+        return env.get_rank()
+
+    @property
+    def world_size(self):
+        return env.get_world_size()
+
+    @property
+    def device_id(self):
+        try:
+            return jax.devices()[0].id
+        except Exception:
+            return 0
+
+    @property
+    def current_endpoint(self):
+        import os
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        import os
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+class ParallelMode:
+    """reference: fleet.base.topology.ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """reference: auto_parallel ReduceType (partial placements)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """reference: legacy static DistAttr — carries (mesh, sharding_specs)
+    for a tensor; superseded by NamedSharding placements here."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+
+# ------------------------------------------------------------- collectives+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference: communication/gather.py — like all_gather but only dst
+    keeps the result. Single-controller SPMD sees every shard, so this is
+    all_gather with the destination-rank convention kept for parity."""
+    out = []
+    all_gather(out, tensor, group=group)
+    if gather_list is not None and env.get_rank() == dst:
+        gather_list.clear()
+        gather_list.extend(out)
+    return out if env.get_rank() == dst else None
+
+
+def scatter_object_list(out_object_list, in_object_list, src=0, group=None):
+    """reference: communication/scatter.py — rank r receives
+    in_object_list[r]."""
+    r = env.get_rank()
+    if in_object_list is None or not len(in_object_list):
+        raise ValueError("scatter_object_list: empty in_object_list")
+    out_object_list.clear()
+    out_object_list.append(in_object_list[min(r, len(in_object_list) - 1)])
+
+
+def isend(tensor, dst=0, group=None):
+    raise RuntimeError(
+        "point-to-point isend/irecv is not a TPU primitive; use "
+        "lax.ppermute inside shard_map (distributed.p2p_ppermute) — the "
+        "pipeline schedule in parallel/pp.py shows the pattern")
+
+
+def irecv(tensor, src=0, group=None):
+    raise RuntimeError(
+        "use lax.ppermute inside shard_map (distributed.p2p_ppermute)")
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference: parallel gloo bootstrap (CPU rendezvous for PS mode).
+    jax.distributed handles host rendezvous here — nothing to start."""
+    return None
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    return None
+
+
+# ------------------------------------------------------- megatron split op
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """reference: fleet/layers/mpu/mp_layers via paddle.distributed.split
+    — build a row/column-partitioned linear or embedding over the model-
+    parallel axis. Returns the layer's output for input x (paddle's
+    functional form constructs the layer internally)."""
+    from ..parallel.tp import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:  # split columns of the weight
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(in_f, out_f,
+                                      input_is_parallel=False)
+        return layer(x)
+    if operation == "embedding":
+        vocab, hidden = size
+        layer = VocabParallelEmbedding(vocab, hidden)
+        return layer(x)
+    raise ValueError(f"split: unknown operation {operation!r}")
+
+
+# --------------------------------------------------------- dtensor helpers
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """reference: auto_parallel api.dtensor_from_fn."""
+    from .auto_parallel import shard_tensor
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(dist_tensor):
+    """reference: auto_parallel api.unshard_dtensor — gather to a dense
+    replicated tensor."""
+    v = unwrap(dist_tensor)
+    return Tensor(jnp.asarray(jax.device_get(v)))
+
+
+def set_mesh(mesh):
+    env.set_global_mesh(mesh)
+
+
+def get_mesh():
+    return env.get_global_mesh()
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_name=True):
+    """reference: auto_parallel checkpoint save — each host writes its
+    shards; single-controller writes one file."""
+    from ..framework.io import save
+    save({k: (v if isinstance(v, Tensor) else Tensor(jnp.asarray(v)))
+          for k, v in state_dict.items()}, path)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_name=True):
+    from ..framework.io import load
+    loaded = load(path)
+    for k in list(state_dict):
+        if k in loaded:
+            v = loaded[k]
+            state_dict[k] = v if isinstance(v, Tensor) else \
+                Tensor(jnp.asarray(v))
+    return state_dict
+
+
+# ------------------------------------------------- sharding (ZeRO) markers
+class ShardingStage1:
+    """Marker/shard_fn for shard_optimizer (reference sharding api)."""
+    stage = 1
+
+    def __init__(self, axis=None, mesh=None):
+        self.axis, self.mesh = axis, mesh
+
+
+class ShardingStage2(ShardingStage1):
+    stage = 2
+
+
+class ShardingStage3(ShardingStage1):
+    stage = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """reference: auto_parallel api.shard_optimizer — mark the optimizer
+    so the Trainer shards its slots (ZeRO); the actual sharding specs are
+    derived from the stage at Trainer build time."""
+    stage = getattr(shard_fn, "stage", 1) if shard_fn is not None else 1
+    optimizer._sharding_stage = stage
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """reference: api.shard_scaler — GradScaler state is replicated (the
+    found-inf reduction rides the grad psum), nothing extra to shard."""
+    return scaler
+
+
+# ----------------------------------------------------- parallelize planner
+class _Plan:
+    def __init__(self, gather_output=False):
+        self.gather_output = gather_output
+
+
+class ColWiseParallel(_Plan):
+    """Shard Linear weight columns over 'tp' (reference mp plan)."""
+    spec = ("cols",)
+
+
+class RowWiseParallel(_Plan):
+    spec = ("rows",)
+
+
+class SequenceParallelBegin(_Plan):
+    spec = ("sp_begin",)
+
+
+class SequenceParallelEnd(_Plan):
+    spec = ("sp_end",)
+
+
+class SequenceParallelEnable(_Plan):
+    spec = ("sp",)
+
+
+class SequenceParallelDisable(_Plan):
+    spec = ("sp_off",)
+
+
+class PrepareLayerInput(_Plan):
+    def __init__(self, fn=None):
+        super().__init__()
+        self.fn = fn
+
+
+class PrepareLayerOutput(_Plan):
+    def __init__(self, fn=None):
+        super().__init__()
+        self.fn = fn
+
+
+class SplitPoint:
+    """Pipeline split markers (reference pp plan)."""
+    BEGINNING = "beginning"
+    END = "end"
+
+
+class Strategy:
+    """reference: auto_parallel Strategy — config bag; consumed by
+    to_static/parallelize."""
+
+    def __init__(self, config=None):
+        self.sharding = type("C", (), {"enable": False, "stage": 1,
+                                       "degree": -1})()
+        self.fused_passes = type("C", (), {"enable": False})()
+        self.pipeline = type("C", (), {"enable": False, "schedule_mode":
+                                       "1F1B", "micro_batch_size": 1})()
+        self.gradient_merge = type("C", (), {"enable": False, "k_steps": 1})()
+        if config:
+            for k, v in config.items():
+                setattr(self, k, v)
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """reference: auto_parallel api.parallelize — apply a plan dict
+    {sublayer-name-pattern: plan} (mp_config/pp_config/dp_config) by
+    setting dist_spec placements on matching parameters; the jitted
+    Trainer/GSPMD does the rest."""
+    from jax.sharding import PartitionSpec as P
+    config = config or {}
+    mp = (config.get("mp_config") or {}).get("parallelize_plan", {})
+    for pattern, plan in mp.items():
+        for name, sub in model.named_sublayers():
+            if not _name_match(name, pattern):
+                continue
+            w = getattr(sub, "weight", None)
+            if w is None:
+                continue
+            if isinstance(plan, ColWiseParallel):
+                w.dist_spec = P(None, "tp")
+                b = getattr(sub, "bias", None)
+                if b is not None:
+                    b.dist_spec = P("tp")
+            elif isinstance(plan, RowWiseParallel):
+                w.dist_spec = P("tp", None)
+    if optimizer is not None and (config.get("dp_config") or {}):
+        optimizer._sharding_stage = 2
+    return (model, optimizer) if optimizer is not None else model
+
+
+def _name_match(name, pattern):
+    import re
+    rx = re.escape(pattern).replace(r"\*", ".*")
+    return re.fullmatch(rx, name) is not None or name.endswith(pattern)
+
+
+class LocalLayer:
+    """reference: auto_parallel LocalLayer — a layer whose forward runs
+    per-device inside shard_map with declared out placements. Here plain
+    composition: subclass nn.Layer and annotate outputs yourself; kept as
+    an alias base for API parity."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+
+    def __new__(cls, *a, **kw):
+        from ..nn.layer.layers import Layer
+        if cls is LocalLayer:
+            raise TypeError("subclass LocalLayer together with nn.Layer")
+        return super().__new__(cls)
+
+
+def to_distributed(model, optimizer, dataloader, device_num=None,
+                   node_num=1):
+    """reference: auto_parallel high-level to_distributed — single-
+    controller SPMD needs no wrapping: ensure a mesh exists and return
+    the triple; the Trainer reads placements from the model."""
+    from ..parallel.mesh import get_mesh as _gm, create_mesh
+    if _gm() is None:
+        n = device_num or jax.device_count()
+        env.set_global_mesh(create_mesh({"dp": n}))
+    return model, optimizer, dataloader
+
+
+# ------------------------------------------------------ PS-era data configs
+_PS_MSG = ("parameter-server data pipelines are out of TPU scope (see "
+           "distributed/ps.py and README): shard embedding tables over the "
+           "mesh (VocabParallelEmbedding / MoE all_to_all) and feed with "
+           "paddle_tpu.io.DataLoader instead")
+
+
+class QueueDataset:
+    """reference: distributed/ps QueueDataset (streaming PS reader)."""
+
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_PS_MSG)
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_PS_MSG)
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_PS_MSG)
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_PS_MSG)
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_PS_MSG)

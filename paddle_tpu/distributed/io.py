@@ -1,0 +1,49 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+persistables save/load for trainer checkpoints)."""
+from __future__ import annotations
+
+import os
+
+from ..framework.io import save as _save, load as _load
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "save_inference_model", "load_inference_model_distributed"]
+
+
+def is_persistable(var):
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor=None, dirname=".", main_program=None,
+                      filename=None):
+    """Persist every registered persistable var of the program (or the
+    layer passed as main_program)."""
+    state = {}
+    if main_program is not None and hasattr(main_program, "state_dict"):
+        state = main_program.state_dict()
+    elif main_program is not None and hasattr(main_program, "_vars"):
+        state = {k: v for k, v in main_program._vars.items()
+                 if is_persistable(v)}
+    os.makedirs(dirname, exist_ok=True)
+    _save(state, os.path.join(dirname, filename or "persistables.pdparams"))
+
+
+def load_persistables(executor=None, dirname=".", main_program=None,
+                      filename=None):
+    path = os.path.join(dirname, filename or "persistables.pdparams")
+    state = _load(path)
+    if main_program is not None and hasattr(main_program, "set_state_dict"):
+        main_program.set_state_dict(state)
+    return state
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, **kw):
+    from ..static import save_inference_model as _sim
+    return _sim(os.path.join(dirname, "model"), feeded_var_names,
+                target_vars, executor, program=main_program)
+
+
+def load_inference_model_distributed(dirname, executor, **kw):
+    from ..static import load_inference_model as _lim
+    return _lim(os.path.join(dirname, "model"), executor)

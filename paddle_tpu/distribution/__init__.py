@@ -690,7 +690,34 @@ class ChainTransform:
         return Tensor(ld)
 
 
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """reference: distribution/kl.py register_kl — decorator registering a
+    custom KL(p||q) implementation, dispatched by exact-or-subclass match
+    (most-derived pair wins)."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def _lookup_kl(p, q):
+    best, best_score = None, None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            score = (len(type(p).__mro__) - len(cp.__mro__),
+                     len(type(q).__mro__) - len(cq.__mro__))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    return best
+
+
 def kl_divergence(p, q):
+    fn = _lookup_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = (p.scale / q.scale) ** 2
         t1 = ((p.loc - q.loc) / q.scale) ** 2
@@ -778,3 +805,36 @@ class LKJCholesky(Distribution):
                      jax.scipy.special.gammaln(conc[..., None] +
                                                0.5 * (d - 1)), -1)
         return Tensor(unnorm - lz)
+
+
+class ExponentialFamily(Distribution):
+    """reference: distribution/exponential_family.py — base class for
+    exponential-family distributions; entropy via the Bregman identity
+    H = -<natural_params, E[T(x)]> + log_normalizer + E[log h(x)],
+    computed here with jax.grad of the log normalizer (the reference
+    differentiates its static graph the same way)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        import builtins
+        import jax
+        nat = [jnp.asarray(_t(p), jnp.float32)
+               for p in self._natural_parameters]
+        lognorm = self._log_normalizer(*nat)       # batch-shaped
+        # grad of the summed normalizer is per-element for an
+        # elementwise-batched log normalizer, so batch shape survives
+        grads = jax.grad(lambda *np_: jnp.sum(self._log_normalizer(*np_)),
+                         argnums=tuple(range(len(nat))))(*nat)
+        ent = -jnp.asarray(self._mean_carrier_measure) + lognorm \
+            - builtins.sum(n * g for n, g in zip(nat, grads))
+        return Tensor(ent)

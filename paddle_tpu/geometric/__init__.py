@@ -13,7 +13,7 @@ from .._core.tensor import Tensor, apply, unwrap
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min", "sample_neighbors",
-           "reindex_graph"]
+           "reindex_graph", "weighted_sample_neighbors", "reindex_heter_graph"]
 
 
 def _num_segments(dst, out_size):
@@ -151,3 +151,58 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     return (Tensor(jnp.asarray(reindexed.astype(np.int64))),
             Tensor(jnp.asarray(out_nodes)),
             Tensor(jnp.asarray(np.asarray(unwrap(count)))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling (reference geometric/sampling/
+    neighbors.py:218): draw up to sample_size neighbors per node without
+    replacement, probability ∝ edge_weight."""
+    r = np.asarray(unwrap(row))
+    cp = np.asarray(unwrap(colptr))
+    w = np.asarray(unwrap(edge_weight), np.float64)
+    nodes = np.asarray(unwrap(input_nodes))
+    e = np.asarray(unwrap(eids)) if eids is not None else None
+    if return_eids and e is None:
+        raise ValueError("weighted_sample_neighbors: return_eids=True "
+                         "requires eids")
+    rng = np.random.default_rng(_state.prng.next_np_seed())
+    out_i, out_count = [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        pick = np.arange(lo, hi)
+        if 0 < sample_size < len(pick):
+            p = w[lo:hi]
+            p = p / p.sum() if p.sum() > 0 else None
+            pick = rng.choice(pick, sample_size, replace=False, p=p)
+        out_i.append(pick)
+        out_count.append(len(pick))
+    idx = np.concatenate(out_i) if out_i else np.zeros(0, np.int64)
+    res = (Tensor(jnp.asarray(r[idx])),
+           Tensor(jnp.asarray(np.asarray(out_count, np.int64))))
+    if return_eids:
+        res = res + (Tensor(jnp.asarray(e[idx])),)
+    return res
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference geometric/reindex.py:153):
+    same renumbering as reindex_graph, with per-edge-type neighbor/count
+    lists sharing ONE id space (first-appearance order across the
+    concatenation)."""
+    xs = np.asarray(unwrap(x))
+    nbs = [np.asarray(unwrap(n)) for n in neighbors]
+    cts = [np.asarray(unwrap(c)) for c in count]
+    allnb = np.concatenate(nbs) if nbs else np.zeros(0, np.int64)
+    fresh = allnb[~np.isin(allnb, xs)]
+    uniq, first = np.unique(fresh, return_index=True)
+    new_in_order = uniq[np.argsort(first)]
+    out_nodes = np.concatenate([xs, new_in_order]).astype(np.int64)
+    sort_idx = np.argsort(out_nodes, kind="stable")
+    reindexed = sort_idx[np.searchsorted(out_nodes[sort_idx], allnb)]
+    return (Tensor(jnp.asarray(reindexed.astype(np.int64))),
+            Tensor(jnp.asarray(out_nodes)),
+            Tensor(jnp.asarray(np.concatenate(cts) if cts else
+                               np.zeros(0, np.int64))))

@@ -203,3 +203,10 @@ class FusedTransformerEncoderLayer(Layer):
 from ...parallel.moe import MoELayer as FusedMoE  # noqa: E402
 
 flash_attention = _flash
+
+# rebind `functional` from the legacy class to the real submodule (same
+# surface + the full fused-op set); plain `from . import functional`
+# would NOT import it here — the class already occupies the attribute
+import paddle_tpu.incubate.nn.functional as _functional_mod  # noqa: E402
+
+functional = _functional_mod

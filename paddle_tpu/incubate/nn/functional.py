@@ -1,0 +1,378 @@
+"""paddle.incubate.nn.functional as a real module (reference:
+python/paddle/incubate/nn/functional/__init__.py — ~20 fused CUDA ops).
+
+TPU mapping: the "fused" ops are either XLA-fused elementwise chains (XLA
+does the fusion the CUDA kernels hand-code) or route to the pallas
+kernels in ops/ (flash, paged, varlen attention). The class-style
+``incubate.nn.functional`` accessor from earlier rounds keeps working;
+this module is the importable form (``import
+paddle_tpu.incubate.nn.functional as F``).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, apply, unwrap
+
+# The earlier rounds shipped these as staticmethods on a `functional`
+# class inside the package __init__ (attribute-access style). The parent
+# package is fully executed before this submodule, so lift them off the
+# class here; the parent then rebinds `functional` to this module, which
+# exposes the same names — both access styles keep working.
+import sys as _sys
+
+_cls = getattr(_sys.modules[__package__], "functional")
+fused_multi_head_attention = _cls.fused_multi_head_attention
+fused_feedforward = _cls.fused_feedforward
+fused_rms_norm = _cls.fused_rms_norm
+fused_layer_norm = _cls.fused_layer_norm
+fused_rotary_position_embedding = _cls.fused_rotary_position_embedding
+fused_linear = _cls.fused_linear
+fused_linear_cross_entropy = _cls.fused_linear_cross_entropy
+
+__all__ = [
+    "fused_multi_head_attention", "fused_feedforward", "fused_rms_norm",
+    "fused_layer_norm", "fused_rotary_position_embedding", "fused_linear",
+    "fused_linear_cross_entropy", "swiglu", "fused_dropout_add",
+    "fused_bias_act", "fused_matmul_bias", "fused_bias_dropout_residual_layer_norm",
+    "masked_multihead_attention", "block_multihead_attention",
+    "variable_length_memory_efficient_attention",
+    "fused_dot_product_attention", "moe_dispatch", "moe_ffn", "moe_reduce",
+    "fused_moe", "blha_get_max_len", "fused_linear_activation",
+    "fused_multi_transformer",
+]
+
+
+def swiglu(x, y=None, name=None):
+    """reference: incubate/nn/functional/swiglu.py — silu(x) * y (y
+    defaults to the second half of x's last axis)."""
+    def fn(a, *rest):
+        if rest:
+            b = rest[0]
+        else:
+            a, b = jnp.split(a, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    args = [x] + ([y] if y is not None else [])
+    return apply(fn, *args, name="swiglu")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """reference: fused_dropout_add — dropout(x) + y in one pass."""
+    from ..._core.state import prng
+    if not training or p == 0.0:
+        return apply(lambda a, b: a + b, x, y, name="fused_dropout_add")
+    key = prng.next_key()
+
+    def fn(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0) + b
+        return jnp.where(keep, a, 0.0) + b
+    return apply(fn, x, y, name="fused_dropout_add")
+
+
+def fused_bias_act(x, bias=None, dequant_scales=None, shift=None, smooth=None,
+                   act_method="gelu", compute_dtype="default",
+                   quant_scale=-1, quant_round_type=0, quant_max_bound=0,
+                   quant_min_bound=0, name=None):
+    """reference: fused_bias_act — (x + bias) then activation."""
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "swiglu": lambda v: jax.nn.silu(*jnp.split(v, 2, -1)[:1]) *
+           jnp.split(v, 2, -1)[1],
+           "geglu": lambda v: jax.nn.gelu(jnp.split(v, 2, -1)[0]) *
+           jnp.split(v, 2, -1)[1]}[act_method]
+
+    def fn(a, *rest):
+        if rest:
+            a = a + rest[0]
+        return act(a)
+    args = [x] + ([bias] if bias is not None else [])
+    return apply(fn, *args, name="fused_bias_act")
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    def fn(a, b, *rest):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply(fn, *args, name="fused_matmul_bias")
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train",
+                                           name=None):
+    """reference: fused_bias_dropout_residual_layer_norm."""
+    h = fused_dropout_add(x if bias is None else
+                          apply(lambda a, b: a + b, x, bias), residual,
+                          p=dropout_rate, training=training, mode=mode)
+
+    def fn(a, *rest):
+        mu = jnp.mean(a, -1, keepdims=True)
+        var = jnp.var(a, -1, keepdims=True)
+        out = (a - mu) * jax.lax.rsqrt(var + ln_epsilon)
+        i = 0
+        if ln_scale is not None:
+            out = out * rest[i]
+            i += 1
+        if ln_bias is not None:
+            out = out + rest[i]
+        return out
+    args = [h] + ([ln_scale] if ln_scale is not None else []) + \
+        ([ln_bias] if ln_bias is not None else [])
+    return apply(fn, *args, name="fused_bias_dropout_residual_ln")
+
+
+def fused_dot_product_attention(q, k, v, attn_mask=None, scaling_factor=None,
+                                dropout_probability=0.0, is_training=True,
+                                is_causal_masking=False, name=None):
+    """reference: fused_dot_product_attention (cuDNN) → flash kernel.
+    q/k/v: (B, S, H, D)."""
+    from ...ops.flash_attention import flash_attention as _flash
+
+    def fn(qq, kk, vv):
+        out, _ = _flash(qq, kk, vv, dropout=dropout_probability,
+                        causal=is_causal_masking, training=is_training,
+                        sm_scale=scaling_factor)
+        return out
+    return apply(fn, q, k, v, name="fused_dot_product_attention")
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens,
+                                               kv_seq_lens, mask=None,
+                                               scale=None, causal=False,
+                                               pre_cache_length=0):
+    """reference: variable_length_memory_efficient_attention — ragged
+    batch attention; maps to the varlen pallas kernel via cu_seqlens.
+    query: (B, H, S, D) with per-batch valid lengths seq_lens."""
+    from ...ops.varlen_attention import flash_attn_unpadded as _varlen
+    qv, kv_, vv = unwrap(query), unwrap(key), unwrap(value)
+    lens_q = np.asarray(unwrap(seq_lens)).reshape(-1)
+    lens_k = np.asarray(unwrap(kv_seq_lens)).reshape(-1)
+    b, h, s, d = qv.shape
+    sk = kv_.shape[2]
+    # pack valid tokens
+    packs_q = [np.asarray(qv[i, :, :lens_q[i]]).transpose(1, 0, 2)
+               for i in range(b)]
+    packs_k = [np.asarray(kv_[i, :, :lens_k[i]]).transpose(1, 0, 2)
+               for i in range(b)]
+    packs_v = [np.asarray(vv[i, :, :lens_k[i]]).transpose(1, 0, 2)
+               for i in range(b)]
+    cu_q = np.concatenate([[0], np.cumsum(lens_q)]).astype(np.int32)
+    cu_k = np.concatenate([[0], np.cumsum(lens_k)]).astype(np.int32)
+    out, _ = _varlen(jnp.asarray(np.concatenate(packs_q)),
+                     jnp.asarray(np.concatenate(packs_k)),
+                     jnp.asarray(np.concatenate(packs_v)),
+                     jnp.asarray(cu_q), jnp.asarray(cu_k),
+                     scale=scale, causal=causal)
+    out = np.asarray(out)
+    res = np.zeros((b, h, s, d), out.dtype)
+    for i in range(b):
+        res[i, :, :lens_q[i]] = out[cu_q[i]:cu_q[i + 1]].transpose(1, 0, 2)
+    return Tensor(jnp.asarray(res))
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", **kwargs):
+    """reference: masked_multihead_attention — single-token decode over a
+    dense (2, B, H, S, D) cache (the paged path is ops/paged_attention)."""
+    xv = unwrap(x)
+    cache = unwrap(cache_kv)
+    b = xv.shape[0]
+    _, _, h, s_max, d = cache.shape
+    q, k, v = jnp.split(xv.reshape(b, 3, h, d), 3, axis=1)
+    q, k, v = q[:, 0], k[:, 0], v[:, 0]
+    lens = unwrap(sequence_lengths) if sequence_lengths is not None else \
+        jnp.zeros((b,), jnp.int32)
+    pos = lens.reshape(b)
+    ck, cv = cache[0], cache[1]
+    ck = ck.at[jnp.arange(b), :, pos].set(k)
+    cv = cv.at[jnp.arange(b), :, pos].set(v)
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                        ck.astype(jnp.float32)) * scale
+    mask = jnp.arange(s_max)[None, None, :] <= pos[:, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhs,bhsd->bhd", p, cv.astype(jnp.float32))
+    new_cache = jnp.stack([ck, cv])
+    return (Tensor(out.reshape(b, h * d).astype(xv.dtype)),
+            Tensor(new_cache))
+
+
+def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
+                              seq_lens_decoder, seq_lens_this_time,
+                              padding_offsets=None, cum_offsets=None,
+                              cu_seqlens_q=None, cu_seqlens_k=None,
+                              block_tables=None, **kwargs):
+    """reference: block_multihead_attention (PaddleNLP serving core) —
+    the paged-KV path; see models/llama_serving.py for the full engine.
+    This functional form handles the decode step over the paged pools."""
+    from ...ops.paged_attention import paged_attention
+    q = unwrap(qkv)
+    b = q.shape[0]
+    kvh, num_pages, page_size, d = unwrap(key_cache).shape
+    h = q.shape[-2] if q.ndim > 2 else kvh
+    lens = unwrap(seq_lens_decoder).reshape(-1).astype(jnp.int32)
+    out = paged_attention(q.reshape(b, -1, d), unwrap(key_cache),
+                          unwrap(value_cache),
+                          unwrap(block_tables).astype(jnp.int32), lens)
+    return Tensor(out), key_cache, value_cache
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None):
+    """reference: blha_get_max_len — max enc/dec lengths for kernel
+    dispatch."""
+    e = unwrap(seq_lens_encoder)
+    d = unwrap(seq_lens_decoder)
+    return Tensor(jnp.max(e)), Tensor(jnp.max(d))
+
+
+# ------------------------------------------------------------------- MoE
+def moe_dispatch(x, gating_logits, moe_topk, group_moe=False,
+                 topk_only_mode=False):
+    """reference: fused_moe moe_dispatch — top-k routing tables."""
+    xv = unwrap(x)
+    logits = unwrap(gating_logits)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    topv, topi = jax.lax.top_k(probs, moe_topk)
+    n_exp = logits.shape[-1]
+    # rows sorted by expert id → permuted input table
+    flat_exp = topi.reshape(-1)
+    order = jnp.argsort(flat_exp, stable=True)
+    token_ids = jnp.repeat(jnp.arange(xv.shape[0]), moe_topk)[order]
+    permuted = xv[token_ids]
+    rows_per_exp = jnp.sum(jax.nn.one_hot(flat_exp, n_exp, dtype=jnp.int32),
+                           axis=0)
+    return (Tensor(permuted), Tensor(token_ids.astype(jnp.int32)),
+            Tensor(order.astype(jnp.int32)), Tensor(rows_per_exp),
+            Tensor(topv))
+
+
+def moe_ffn(permuted_x, rows_per_expert, up_gate_weight, down_weight,
+            up_gate_bias=None, down_bias=None, quant_method="None"):
+    """Apply each expert's FFN to its contiguous row block."""
+    xv = unwrap(permuted_x)
+    counts = np.asarray(unwrap(rows_per_expert))
+    ug = unwrap(up_gate_weight)
+    dw = unwrap(down_weight)
+    outs = []
+    start = 0
+    for e, n in enumerate(counts):
+        blk = xv[start:start + int(n)]
+        hgate = blk @ ug[e]
+        a, b = jnp.split(hgate, 2, -1)
+        h = jax.nn.silu(a) * b
+        outs.append(h @ dw[e])
+        start += int(n)
+    return Tensor(jnp.concatenate(outs, 0) if outs else xv[:0])
+
+
+def moe_reduce(ffn_out, topk_weights, permute_indices_per_token,
+               token_ids, norm_topk_prob=True, routed_scaling_factor=1.0):
+    """Scatter expert outputs back to token order and combine by gate."""
+    y = unwrap(ffn_out)
+    order = unwrap(permute_indices_per_token).astype(jnp.int32)
+    tok = unwrap(token_ids).astype(jnp.int32)
+    w = unwrap(topk_weights)
+    n_tok, k = w.shape
+    # invert the dispatch permutation: row r came from (token tok[r],
+    # slot order[r] % k)
+    unperm = jnp.zeros((n_tok * k, y.shape[-1]), y.dtype)
+    unperm = unperm.at[order].set(y)
+    unperm = unperm.reshape(n_tok, k, -1)
+    ww = w / jnp.sum(w, -1, keepdims=True) if norm_topk_prob else w
+    out = jnp.einsum("tkd,tk->td", unperm.astype(jnp.float32),
+                     ww.astype(jnp.float32)) * routed_scaling_factor
+    return Tensor(out.astype(y.dtype))
+
+
+def fused_moe(x, gate_weight, up_gate_weight, down_weight, moe_topk=2,
+              norm_topk_prob=True, **kwargs):
+    """One-call MoE layer (dispatch → expert FFN → reduce)."""
+    logits = unwrap(x) @ unwrap(gate_weight)
+    permuted, token_ids, order, rows, topv = moe_dispatch(
+        x, Tensor(logits), moe_topk)
+    y = moe_ffn(permuted, rows, up_gate_weight, down_weight)
+    return moe_reduce(y, topv, order, token_ids,
+                      norm_topk_prob=norm_topk_prob)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    """reference: fused_linear_activation — matmul + bias + activation in
+    one XLA fusion."""
+    out = fused_matmul_bias(x, y, bias, transpose_x=trans_x,
+                            transpose_y=trans_y)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "none": lambda v: v}[activation or "none"]
+    return apply(act, out, name="fused_linear_activation")
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, attn_mask=None,
+                            dropout_rate=0.0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None):
+    """reference: fused_multi_transformer — a whole pre-LN decoder stack
+    in one call (the CUDA mega-kernel). XLA expresses it as the same
+    fused graph; each layer: LN → MHA → residual → LN → FFN → residual."""
+    from ...ops.flash_attention import flash_attention as _flash2
+
+    h = x
+    L = len(qkv_weights)
+    for i in range(L):
+        def ln(t, scale, bias_):
+            def fn(a, *rest):
+                mu = jnp.mean(a, -1, keepdims=True)
+                var = jnp.var(a, -1, keepdims=True)
+                o = (a - mu) * jax.lax.rsqrt(var + epsilon)
+                j = 0
+                if scale is not None:
+                    o = o * rest[j]; j += 1
+                if bias_ is not None:
+                    o = o + rest[j]
+                return o
+            args = [t] + [s for s in (scale, bias_) if s is not None]
+            return apply(fn, *args, name="fmt_ln")
+
+        residual = h
+        a_in = ln(h, ln_scales[i], ln_biases[i]) if pre_layer_norm else h
+        out = fused_multi_head_attention(
+            a_in, qkv_weights[i], linear_weights[i],
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_dropout_rate=dropout_rate if training else 0.0,
+            training=training)
+        h = apply(lambda a, b: a + b, out, residual, name="fmt_res1")
+        residual = h
+        f_in = ln(h, ffn_ln_scales[i], ffn_ln_biases[i]) \
+            if pre_layer_norm else h
+        f = fused_matmul_bias(f_in, ffn1_weights[i],
+                              ffn1_biases[i] if ffn1_biases else None)
+        f = apply({"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation], f,
+                  name="fmt_act")
+        f = fused_matmul_bias(f, ffn2_weights[i],
+                              ffn2_biases[i] if ffn2_biases else None)
+        h = apply(lambda a, b: a + b, f, residual, name="fmt_res2")
+    return (h, cache_kvs) if cache_kvs is not None else h

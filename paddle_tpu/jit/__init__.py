@@ -6,3 +6,4 @@ serialize params + a re-traceable spec.
 """
 from .api import to_static, not_to_static, save, load, ignore_module  # noqa: F401
 from .api import enable_to_static, TranslatedLayer, InputSpec  # noqa: F401
+from .api import set_code_level, set_verbosity  # noqa: F401

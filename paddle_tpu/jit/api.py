@@ -273,3 +273,23 @@ def load(path, **configs):
         with open(path + ".pdexport", "rb") as f:
             exported = jexport.deserialize(f.read())
     return TranslatedLayer(state_t, exported)
+
+
+_verbosity = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: jit/dy2static logging verbosity. Trace-compile on TPU
+    has no transpiler stages; this toggles jax compilation logging."""
+    global _verbosity
+    _verbosity = int(level)
+    import logging
+    logging.getLogger("jax").setLevel(
+        logging.DEBUG if level >= 3 else
+        logging.INFO if level >= 1 else logging.WARNING)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: prints transformed code at each dy2static stage. There
+    is no AST transpiler here (trace-once jit); kept as a logging shim."""
+    set_verbosity(1 if level else 0, also_to_stdout)

@@ -8,3 +8,37 @@ from .tensor.linalg import (  # noqa: F401
     cholesky_inverse, svd_lowrank, pca_lowrank, histogram_bin_edges,
 )
 from .tensor.math import vander  # noqa: F401
+from .tensor.creation import diagonal  # noqa: F401
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="bfloat16", activation_type=None):
+    """reference: linalg fp8 GEMM (CUDA cutlass kernel). TPU path: cast
+    fp8 operands up, run the MXU matmul with fp32 accumulation, apply
+    scale/bias/activation, emit bf16/fp16. On fp8-capable TPU gens XLA
+    keeps the low-precision layout."""
+    import jax
+    import jax.numpy as jnp
+    from ._core.tensor import apply
+
+    def fn(a, b, *rest):
+        bb = rest[0] if bias is not None else None
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
+        out = out * scale
+        if bb is not None:
+            out = out + bb.astype(jnp.float32)
+        if activation_type in ("gelu",):
+            out = jax.nn.gelu(out)
+        elif activation_type in ("relu",):
+            out = jax.nn.relu(out)
+        return out.astype(jnp.bfloat16 if output_dtype == "bfloat16"
+                          else jnp.float16)
+
+    args = [x, y] + ([bias] if bias is not None else [])
+    return apply(fn, *args, name="fp8_fp8_half_gemm_fused")

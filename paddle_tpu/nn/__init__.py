@@ -50,3 +50,9 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
 from .layer import layers  # noqa: F401
+from .layer.extra_layers import (  # noqa: F401
+    ParameterDict, BiRNN, HSigmoidLoss, AdaptiveLogSoftmaxWithLoss,
+    FractionalMaxPool2D, FractionalMaxPool3D,
+)
+from .layer.activation import SiLU as Silu  # noqa: F401  (paddle alias)
+from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401

@@ -1,0 +1,149 @@
+"""Seq2seq decoding (reference: python/paddle/nn/decode.py —
+BeamSearchDecoder + dynamic_decode).
+
+TPU-shaped design: the beam state is a fixed-size (batch*beam) pytree the
+whole way through — candidates are scored with one dense top-k over
+beam*vocab per step, so every step is the same static-shape program. The
+step loop itself is host-driven (dynamic_decode is an eager API in the
+reference too); compiled KV-cache generation lives in models/*_decode.py.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .._core.tensor import Tensor, unwrap
+
+__all__ = ["BeamSearchDecoder", "dynamic_decode", "Decoder"]
+
+
+class Decoder:
+    """Abstract decoder interface (reference decode.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """reference: decode.py:163. Wraps a cell; scores live in log space;
+    finished beams are locked to end_token with a one-hot -inf/0 score row
+    so they never spawn new candidates."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers (reference tile_beam_merge_with_batch) -------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        import jax.numpy as jnp
+        v = unwrap(x)
+        v = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(v.reshape((-1,) + v.shape[2:]))
+
+    def _merge(self, v):
+        import jax.numpy as jnp
+        return jnp.repeat(jnp.asarray(v)[:, None], self.beam_size,
+                          axis=1).reshape((-1,) + tuple(v.shape[1:]))
+
+    def initialize(self, inits):
+        import jax.numpy as jnp
+        states = jax.tree_util.tree_map(
+            lambda t: Tensor(self._merge(unwrap(t))), inits,
+            is_leaf=lambda t: isinstance(t, Tensor)) if inits is not None \
+            else None
+        # infer batch from the first state leaf
+        leaves = jax.tree_util.tree_leaves(
+            inits, is_leaf=lambda t: isinstance(t, Tensor))
+        batch = unwrap(leaves[0]).shape[0] if leaves else 1
+        bk = batch * self.beam_size
+        tokens = jnp.full((bk,), self.start_token, jnp.int64)
+        # only beam 0 is live initially (all beams identical otherwise)
+        lp = jnp.where(jnp.arange(bk) % self.beam_size == 0, 0.0, -1e9)
+        finished = jnp.zeros((bk,), bool)
+        return tokens, (states, lp, finished, batch)
+
+    def step(self, time, inputs, states, **kwargs):
+        import jax
+        import jax.numpy as jnp
+        cell_states, log_probs, finished, batch = states
+        emb = self.embedding_fn(Tensor(inputs)) if self.embedding_fn \
+            else Tensor(inputs)
+        out, new_cell_states = self.cell(emb, cell_states, **kwargs)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        logits = unwrap(out).astype(jnp.float32)
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, axis=-1)
+        # finished beams only extend with end_token at zero cost
+        fin_row = jnp.full((vocab,), -jnp.inf).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, None], fin_row[None, :], step_lp)
+        total = log_probs[:, None] + step_lp              # (B*K, V)
+        k = self.beam_size
+        flat = total.reshape(batch, k * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, k)          # (B, K)
+        beam_src = top_idx // vocab                       # which parent beam
+        tokens = (top_idx % vocab).astype(jnp.int64)
+        # gather parent state rows: global row = b*k + beam_src
+        gidx = (jnp.arange(batch)[:, None] * k + beam_src).reshape(-1)
+
+        def pick(t):
+            return Tensor(jnp.take(unwrap(t), gidx, axis=0))
+
+        new_cell_states = jax.tree_util.tree_map(
+            pick, new_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        new_finished = jnp.take(finished, gidx) | \
+            (tokens.reshape(-1) == self.end_token)
+        next_states = (new_cell_states, top_lp.reshape(-1), new_finished,
+                       batch)
+        return tokens.reshape(-1), next_states, new_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference: decode.py:1238 — run decoder.initialize then step until
+    every beam is finished or max_step_num. Returns (outputs, final
+    states[, sequence_lengths])."""
+    import jax.numpy as jnp
+    inputs, states = decoder.initialize(inits)
+    step_outputs = []
+    lengths = None
+    limit = max_step_num if max_step_num is not None else 256
+    finished = None
+    for t in range(limit):
+        out, states, finished = decoder.step(t, inputs, states, **kwargs)
+        step_outputs.append(np.asarray(out))
+        fin_np = np.asarray(finished)
+        if lengths is None:
+            lengths = np.full(fin_np.shape, limit, np.int64)
+        newly = (fin_np) & (lengths == limit)
+        lengths[newly] = t + 1
+        inputs = out
+        if fin_np.all():
+            break
+    seq = np.stack(step_outputs, axis=0 if output_time_major else 1)
+    outputs = Tensor(jnp.asarray(seq))
+    outputs, states = decoder.finalize(outputs, states, lengths)
+    if return_length:
+        return outputs, states, Tensor(jnp.asarray(lengths))
+    return outputs, states

@@ -9,5 +9,6 @@ from .vision import *  # noqa: F401,F403
 from .extension import *  # noqa: F401,F403
 from .attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention, flash_attn_unpadded,
-    sdp_kernel,
+    sdp_kernel, flash_attn_qkvpacked, flash_attn_varlen_qkvpacked,
+    sparse_attention, flashmask_attention,
 )

@@ -13,6 +13,7 @@ from ..._core.tensor import apply
 
 __all__ = [
     "relu", "relu_", "relu6", "elu", "elu_", "celu", "selu", "gelu", "silu",
+    "hardtanh_", "leaky_relu_", "thresholded_relu_",
     "swish", "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "hardshrink",
     "softshrink", "tanhshrink", "thresholded_relu", "leaky_relu", "prelu",
     "rrelu", "log_sigmoid", "maxout", "softmax", "softmax_", "log_softmax",
@@ -223,3 +224,19 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def sigmoid_focal_loss_act(x):
     return sigmoid(x)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    """Inplace hardtanh (reference nn/functional/activation.py)."""
+    from ...tensor.extras import inplace_apply
+    return inplace_apply(x, lambda t: hardtanh(t, min, max))
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from ...tensor.extras import inplace_apply
+    return inplace_apply(x, lambda t: leaky_relu(t, negative_slope))
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    from ...tensor.extras import inplace_apply
+    return inplace_apply(x, lambda t: thresholded_relu(t, threshold, value))

@@ -5,6 +5,7 @@ from __future__ import annotations
 import contextlib
 import math
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -83,3 +84,176 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         return out
     out = apply(fn, query, key, value, name="flash_attn_unpadded")
     return (out, None)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         fixed_seed_offset=None, rng_name="", training=True,
+                         name=None):
+    """Packed-QKV flash attention (reference flash_attention.py:562).
+    qkv: (B, S, H/Hk + 2, Hk, D) — leading groups are query heads, the
+    last two are K and V."""
+    def fn(p):
+        b, s, gp2, hk, d = p.shape
+        q = p[:, :, :-2].reshape(b, s, (gp2 - 2) * hk, d)
+        k = p[:, :, -2]
+        v = p[:, :, -1]
+        from ...ops.flash_attention import flash_attention as _flash
+        out, _ = _flash(q, k, v, dropout=dropout, causal=causal,
+                        training=training)
+        return out
+    out = apply(fn, qkv, name="flash_attn_qkvpacked")
+    return (out, None)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", varlen_padded=True, training=True,
+                                name=None):
+    """Varlen packed-QKV flash attention (reference flash_attention.py:
+    flash_attn_varlen_qkvpacked). qkv: (total, H/Hk + 2, Hk, D)."""
+    from ...ops.varlen_attention import flash_attn_unpadded as _unpadded
+
+    def fn(p):
+        t, gp2, hk, d = p.shape
+        q = p[:, :-2].reshape(t, (gp2 - 2) * hk, d)
+        k = p[:, -2]
+        v = p[:, -1]
+        out, _ = _unpadded(q, k, v, unwrap(cu_seqlens_q),
+                           unwrap(cu_seqlens_k), max_seqlen_q, max_seqlen_k,
+                           scale=scale, dropout=dropout, causal=causal,
+                           training=training)
+        return out
+    out = apply(fn, qkv, name="flash_attn_varlen_qkvpacked")
+    return (out, None)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention with a per-row CSR layout (reference
+    nn/functional/sparse_attention.py:22 — CUDA-only there; here an XLA
+    gather formulation: each query row attends only to its CSR columns).
+
+    query/key/value: (B, H, S, D); sparse_csr_offset: (B, H, S+1) int32;
+    sparse_csr_columns: (B, H, nnz) int32.
+    """
+    off_np = np.asarray(unwrap(sparse_csr_offset))
+    row_nnz = np.diff(off_np, axis=-1)             # (B, H, S)
+    max_nnz = int(row_nnz.max()) if row_nnz.size else 0
+    b_, h_, s_ = row_nnz.shape
+    # (B, H, S, max_nnz) gather index into the flat columns array + mask
+    base = off_np[..., :-1][..., None] + np.arange(max_nnz)
+    valid_np = np.arange(max_nnz) < row_nnz[..., None]
+    base = np.where(valid_np, base, 0)
+
+    def fn(q, k, v, cols, *rest):
+        rest = list(rest)
+        kpm = rest.pop(0) if key_padding_mask is not None else None
+        am = rest.pop(0) if attn_mask is not None else None
+        d = q.shape[-1]
+        gi = jnp.take_along_axis(
+            jnp.broadcast_to(cols[..., None, :], cols.shape[:2] + (s_, cols.shape[-1])),
+            jnp.asarray(base), axis=-1)            # (B,H,S,max_nnz) col ids
+        kg = jnp.take_along_axis(k[:, :, None], gi[..., None], axis=3)
+        vg = jnp.take_along_axis(v[:, :, None], gi[..., None], axis=3)
+        scores = jnp.einsum("bhsd,bhsnd->bhsn", q.astype(jnp.float32),
+                            kg.astype(jnp.float32)) / math.sqrt(d)
+        mask = jnp.asarray(valid_np)
+        if kpm is not None:  # (B, S_k): 0/-inf style or bool keep-mask
+            keep = jnp.take_along_axis(
+                jnp.broadcast_to(kpm[:, None, None, :],
+                                 (b_, h_, s_, kpm.shape[-1])), gi, axis=-1)
+            mask = mask & (keep > -1.0) if keep.dtype != jnp.bool_ else \
+                mask & keep
+        scores = jnp.where(mask, scores, -jnp.inf)
+        if am is not None:   # dense (B, H, S, S_k) additive mask
+            scores = scores + jnp.take_along_axis(am.astype(jnp.float32),
+                                                  gi, axis=-1)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(mask, p, 0.0)
+        out = jnp.einsum("bhsn,bhsnd->bhsd", p, vg.astype(jnp.float32))
+        return out.astype(q.dtype)
+
+    args = [query, key, value, sparse_csr_columns]
+    if key_padding_mask is not None:
+        args.append(key_padding_mask)
+    if attn_mask is not None:
+        args.append(attn_mask)
+    return apply(fn, *args, name="sparse_attention")
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask attention (reference flash_attention.py:1299): sparse
+    causal masks expressed as per-column start/end row indices instead of
+    a dense (S, S) mask. With no indices and no window this is plain
+    flash attention (pallas path on TPU); with indices, the dense mask is
+    materialized and applied in the fused XLA reference path.
+
+    startend_row_indices: (B, Hk, S_k, {1, 2, 4}) int32 — see the
+    reference docstring for the per-shape semantics (LT start / LT
+    start+end / LT start + UT end / LT+UT start+end).
+    """
+    if startend_row_indices is None and window_size is None:
+        return flash_attention(query, key, value, dropout=dropout,
+                               causal=causal, training=training)
+
+    def fn(q, k, v, *rest):
+        b, s_q, h, d = q.shape
+        s_k = k.shape[1]
+        rows = jnp.arange(s_q)[:, None]            # query row index
+        cols = jnp.arange(s_k)[None, :]
+        # base mask: causal / sliding window
+        keep = jnp.ones((s_q, s_k), bool)
+        if causal:
+            keep = keep & (cols <= rows)
+        if window_size is not None:
+            w = (window_size, window_size) if isinstance(window_size, int) \
+                else tuple(window_size)
+            keep = keep & (cols >= rows - w[0])
+            if not causal:
+                keep = keep & (cols <= rows + w[1])
+        keep = jnp.broadcast_to(keep[None, None], (b, h, s_q, s_k))
+        if rest:
+            sri = rest[0].astype(jnp.int32)        # (B, Hk, S_k, n)
+            hk = sri.shape[1]
+            n = sri.shape[-1]
+            sri = jnp.repeat(sri, h // hk, axis=1)  # broadcast to q heads
+            r = rows[None, None]                    # (1,1,S_q,1)
+            def col(i):
+                return jnp.swapaxes(sri[..., i][:, :, None, :], 2, 2)
+            if causal and n == 1:
+                masked = r >= col(0)                # LT start downwards
+            elif causal and n == 2:
+                masked = (r >= col(0)) & (r < col(1))
+            elif not causal and n == 2:
+                masked = (r >= col(0)) | (r < col(1))
+            elif not causal and n == 4:
+                masked = ((r >= col(0)) & (r < col(1))) | \
+                         ((r >= col(2)) & (r < col(3)))
+            else:
+                raise ValueError(
+                    f"startend_row_indices last dim {n} invalid for "
+                    f"causal={causal}")
+            keep = keep & ~masked
+        qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+        kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+        vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+        if kh.shape[1] != h:
+            kh = jnp.repeat(kh, h // kh.shape[1], axis=1)
+            vh = jnp.repeat(vh, h // vh.shape[1], axis=1)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(d)
+        scores = jnp.where(keep, scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(keep, p, 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+    args = [query, key, value]
+    if startend_row_indices is not None:
+        args.append(startend_row_indices)
+    return apply(fn, *args, name="flashmask_attention")

@@ -20,7 +20,7 @@ __all__ = [
     "triplet_margin_with_distance_loss", "ctc_loss", "huber_loss",
     "poisson_nll_loss", "gaussian_nll_loss", "sigmoid_focal_loss", "dice_loss",
     "log_loss", "npair_loss", "multi_label_soft_margin_loss", "soft_margin_loss",
-    "multi_margin_loss", "margin_cross_entropy", "rnnt_loss", "adaptive_log_softmax_with_loss",
+    "multi_margin_loss", "margin_cross_entropy", "rnnt_loss", "adaptive_log_softmax_with_loss", "hsigmoid_loss",
 ]
 
 
@@ -541,3 +541,58 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
         pass  # traced labels: bounds unavailable
     return apply(lambda x, hw, *r: fn(x, unwrap(label), hw, *r), input,
                  *args[1:], name="adaptive_log_softmax_with_loss", multi=True)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py:926; phi
+    hsigmoid_loss_kernel + matrix_bit_code SimpleCode/CustomCode).
+
+    Default tree: class c encodes as ``c + num_classes`` in a complete
+    binary tree with root id 1; weight row for bit j is the encoding
+    prefix ``(c >> (j+1)) - 1``, the binary target is suffix bit
+    ``(c >> j) & 1``. Matches the reference numerics exactly, including
+    its out-of-path log(2) padding terms (same constant appears in its
+    forward; gradients are unaffected). is_sparse is accepted for API
+    parity — on TPU dense gather/scatter IS the fast path.
+    """
+    nm1 = num_classes - 1
+
+    def fn(x, lab, w, *rest):
+        rest = list(rest)
+        b = rest.pop(0) if bias is not None else None
+        ptab = rest.pop(0) if path_table is not None else None
+        pcode = rest.pop(0) if path_code is not None else None
+        lab = lab.reshape(-1).astype(jnp.int64)
+        if ptab is None:
+            code_length = int(num_classes - 1).bit_length()
+            c = lab + num_classes
+            js = jnp.arange(code_length, dtype=jnp.int64)
+            valid = (c[:, None] >> (js[None, :] + 1)) > 0
+            idx = jnp.clip((c[:, None] >> (js[None, :] + 1)) - 1, 0, nm1 - 1)
+            bit = ((c[:, None] >> js[None, :]) & 1).astype(x.dtype)
+        else:
+            ptab = ptab.astype(jnp.int64)
+            valid = ptab >= 0
+            idx = jnp.clip(ptab, 0, nm1 - 1)
+            bit = pcode.astype(x.dtype) * valid
+        pre = jnp.einsum("nd,nld->nl", x.astype(jnp.float32),
+                         w[idx].astype(jnp.float32))
+        if b is not None:
+            pre = pre + b.reshape(-1)[idx]
+        pre = jnp.clip(pre, -40.0, 40.0)
+        pre = jnp.where(valid, pre, 0.0)
+        bit = jnp.where(valid, bit.astype(jnp.float32), 0.0)
+        loss = jnp.sum(jnp.log1p(jnp.exp(pre)) - bit * pre, axis=1,
+                       keepdims=True)
+        return loss.astype(x.dtype)
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if path_table is not None:
+        args.append(path_table)
+    if path_code is not None:
+        args.append(path_code)
+    return apply(fn, *args, name="hsigmoid_loss")

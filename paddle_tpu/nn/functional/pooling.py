@@ -17,7 +17,8 @@ __all__ = [
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
     "adaptive_max_pool3d", "lp_pool1d", "lp_pool2d", "max_unpool1d",
-    "max_unpool2d", "max_unpool3d",
+    "max_unpool2d", "max_unpool3d", "fractional_max_pool2d",
+    "fractional_max_pool3d",
 ]
 
 
@@ -95,10 +96,13 @@ def _pool(x, kernel, stride, padding, nsp, data_format, kind, ceil_mode=False,
         summed = lax.reduce_window(a, 0.0 if jnp.issubdtype(
             a.dtype, jnp.floating) else 0, lax.add, dims, strides, pad_cfg)
         if exclusive and not isinstance(pad_cfg, str):
-            ones = jnp.ones_like(a)
+            # count in f32 regardless of input dtype (scalar init must
+            # match the operand dtype for the monoid specialization)
+            ones = jnp.ones(a.shape, jnp.float32)
             counts = lax.reduce_window(ones, 0.0, lax.add,
                                        dims, strides, pad_cfg)
-            return summed / counts
+            return (summed / counts).astype(a.dtype) if not jnp.issubdtype(
+                a.dtype, jnp.floating) else summed / counts
         denom = float(np.prod(k))
         return summed / denom
     return apply(fn, x, name=name)
@@ -354,3 +358,129 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0, data_format="N
                  output_size=None, name=None):
     return _max_unpool(x, indices, kernel_size, stride, padding, output_size, 3,
                        data_format, "max_unpool3d")
+
+
+# ---------------------------------------------------------------------------
+# fractional max pooling (Graham 2015; reference nn/functional/pooling.py
+# fractional_max_pool2d/3d + phi FractionalStartIndex/EndIndex math)
+# ---------------------------------------------------------------------------
+def _fractional_bounds(in_size, out_size, u0, pool_size=0):
+    """Per-output-index [start, end) windows — exact phi kernel math
+    (paddle/phi/kernels/funcs/pooling.h FractionalRationalU/Start/End)."""
+    alpha = in_size / out_size
+    if pool_size > 0:
+        u = u0
+    else:
+        base = in_size // out_size
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (in_size + 1 - base) / alpha - (out_size - 1)
+        u = u0 * min(u_max1, u_max2)
+    off = int(u * alpha)
+    starts, ends = [], []
+    for i in range(out_size):
+        s = int((i + u) * alpha) - off
+        e = s + pool_size if pool_size > 0 else \
+            int((i + 1 + u) * alpha) - off
+        starts.append(max(0, min(s, in_size - 1)))
+        ends.append(max(1, min(e, in_size)))
+    return starts, ends
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, return_mask,
+                     nsp, name):
+    from ..._core.state import prng
+
+    xv = unwrap(x)
+    spatial = xv.shape[-nsp:]
+    outs = _tuple(output_size, nsp)
+    ks = _tuple(kernel_size, nsp) if kernel_size is not None else (0,) * nsp
+    if random_u is None:
+        u0 = float(jax.random.uniform(prng.next_key(), ()))
+    else:
+        u0 = float(random_u)
+        if not 0 < u0 < 1:
+            raise ValueError(f"random_u must be in (0, 1), got {u0}")
+
+    dim_idx = []   # per spatial dim: gather index (out, maxk) + valid mask
+    for d in range(nsp):
+        starts, ends = _fractional_bounds(spatial[d], outs[d], u0, ks[d])
+        maxk = max(e - s for s, e in zip(starts, ends))
+        gi = np.zeros((outs[d], maxk), np.int32)
+        gm = np.zeros((outs[d], maxk), bool)
+        for i, (s, e) in enumerate(zip(starts, ends)):
+            w = e - s
+            gi[i, :w] = np.arange(s, e)
+            gi[i, w:] = s
+            gm[i, :w] = True
+        dim_idx.append((gi, gm))
+
+    # host-side table: flat input spatial index for every (output cell,
+    # window slot); the argmax over flattened window slots maps through it
+    kshape = tuple(g.shape[1] for g, _ in dim_idx)
+    grids = np.meshgrid(*[np.arange(o) for o in outs], indexing="ij")
+    tbl = np.zeros(tuple(outs) + (int(np.prod(kshape)),), np.int64)
+    for slot in range(int(np.prod(kshape))):
+        rem, offs = slot, []
+        for d in range(nsp):
+            stride = int(np.prod(kshape[d + 1:]))
+            offs.append(rem // stride)
+            rem %= stride
+        flat = np.zeros(tuple(outs), np.int64)
+        for d in range(nsp):
+            flat = flat * spatial[d] + dim_idx[d][0][grids[d], offs[d]]
+        tbl[..., slot] = flat
+    valid = np.ones(tuple(outs) + (int(np.prod(kshape)),), bool)
+    for slot in range(int(np.prod(kshape))):
+        rem = slot
+        for d in range(nsp):
+            stride = int(np.prod(kshape[d + 1:]))
+            o = rem // stride
+            rem %= stride
+            valid[..., slot] &= dim_idx[d][1][grids[d], o]
+
+    def fn(a):
+        lead = a.shape[:-nsp]
+        nl = len(lead)
+        neg = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else \
+            jnp.iinfo(a.dtype).min
+        out = a
+        for d in range(nsp):
+            gi, _ = dim_idx[d]
+            axis = nl + 2 * d  # earlier dims already expanded to (out, k)
+            out = jnp.take(out, jnp.asarray(gi.reshape(-1)), axis=axis)
+            out = out.reshape(out.shape[:axis] + gi.shape +
+                              out.shape[axis + 1:])
+        # windows → lead + outs + (K,) with invalid slots masked
+        perm = (tuple(range(nl)) +
+                tuple(nl + 2 * d for d in range(nsp)) +
+                tuple(nl + 2 * d + 1 for d in range(nsp)))
+        wins = out.transpose(perm).reshape(
+            lead + tuple(outs) + (int(np.prod(kshape)),))
+        wins = jnp.where(jnp.asarray(valid), wins, neg)
+        pooled = jnp.max(wins, axis=-1)
+        if not return_mask:
+            return pooled
+        am = jnp.argmax(wins, axis=-1)
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(jnp.asarray(tbl), wins.shape), am[..., None],
+            axis=-1)[..., 0]
+        return pooled, mask
+
+    if return_mask:
+        out, mask = apply(fn, x, name=name, multi=True)
+        return out, mask
+    return apply(fn, x, name=name)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: python/paddle/nn/functional/pooling.py:2087 (phi
+    FractionalRationalU/StartIndex/EndIndex window math)."""
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, output_size, kernel_size, random_u,
+                            return_mask, 3, "fractional_max_pool3d")

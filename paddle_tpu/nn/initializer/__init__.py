@@ -18,7 +18,7 @@ from ..._core.state import prng
 __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-    "Assign", "Dirac", "Orthogonal", "calculate_gain",
+    "Assign", "Dirac", "Orthogonal", "Bilinear", "calculate_gain",
     "set_global_initializer",
 ]
 
@@ -209,3 +209,28 @@ class Orthogonal(Initializer):
 constant = Constant
 normal = Normal
 uniform = Uniform
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernel initializer for transposed-conv
+    upsampling (reference: python/paddle/nn/initializer/Bilinear.py).
+    Kernel layout here is (spatial..., in, out) — see nn/layer/conv.py."""
+
+    def _generate(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer requires a 4-D weight")
+        kh, kw, c_in, c_out = shape
+        if kh != kw:
+            raise ValueError("Bilinear initializer requires square kernels")
+        f = int(np.ceil(kw / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f - c)) *
+                (1 - abs(og[1] / f - c))).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for i in range(min(c_in, c_out)):
+            w[:, :, i, i] = filt
+        if c_in != c_out:  # broadcast pattern for channel-changing upsample
+            for o in range(c_out):
+                w[:, :, o % c_in, o] = filt
+        return jnp.asarray(w).astype(_dt.convert_dtype(dtype))

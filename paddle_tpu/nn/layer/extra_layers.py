@@ -1,0 +1,215 @@
+"""Layers completing nn.__all__ parity (reference: python/paddle/nn/layer/
+loss.py HSigmoidLoss/AdaptiveLogSoftmaxWithLoss, layer/rnn.py BiRNN,
+layer/container.py ParameterDict, layer/pooling.py FractionalMaxPool2D/3D).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .layers import Layer
+from .. import functional as F
+from ..initializer import Uniform
+from ..._core.tensor import Parameter
+
+
+class ParameterDict(Layer):
+    """reference: nn.ParameterDict — dict-style parameter container."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            self.update(parameters)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, param):
+        self.add_parameter(key, param)
+
+    def __delitem__(self, key):
+        del self._parameters[key]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def __contains__(self, key):
+        return key in self._parameters
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        items = parameters.items() if hasattr(parameters, "items") \
+            else parameters
+        for k, v in items:
+            self.add_parameter(k, v)
+        return self
+
+
+class BiRNN(Layer):
+    """reference: nn.BiRNN (layer/rnn.py:1426) — runs a forward and a
+    backward cell and concatenates outputs along the last axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        from .rnn import RNN
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        st_fw = st_bw = None
+        if initial_states is not None:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length, **kwargs)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length, **kwargs)
+        from ...tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (fin_fw, fin_bw)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn.HSigmoidLoss (layer/loss.py:477)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if (num_classes < 2) and (not is_custom):
+            raise ValueError("num_classes must not be less than 2 "
+                             "with default tree")
+        self._feature_size = feature_size
+        self._num_classes = num_classes
+        self._is_custom = is_custom
+        self._is_sparse = is_sparse
+        rows = num_classes if is_custom else num_classes - 1
+        bound = float(np.sqrt(1.0 / feature_size))
+        self.weight = self.create_parameter(
+            [rows, feature_size], attr=weight_attr,
+            default_initializer=Uniform(-bound, bound))
+        self.bias = self.create_parameter(
+            [rows, 1], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code,
+                               is_sparse=self._is_sparse)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: nn.AdaptiveLogSoftmaxWithLoss (layer/loss.py:2393) —
+    head [in, c0 + n_clusters] plus per-cluster low-rank tail projections
+    with dims divided by div_value**(i+1)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if any(cutoffs[i] >= cutoffs[i + 1] for i in range(len(cutoffs) - 1)) \
+                or any(c <= 0 for c in cutoffs) or cutoffs[-1] > n_classes:
+            raise ValueError("cutoffs should be a sequence of unique, "
+                             "positive, increasing integers < n_classes")
+        if cutoffs[-1] != n_classes:
+            cutoffs = cutoffs + [n_classes]
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs
+        self.div_value = div_value
+        n_clusters = len(cutoffs) - 1
+        head_size = cutoffs[0] + n_clusters
+        self.head_weight = self.create_parameter(
+            [in_features, head_size], attr=weight_attr)
+        self.head_bias = self.create_parameter(
+            [head_size], attr=bias_attr, is_bias=True) if head_bias else None
+        self.tail_weights = []
+        for i in range(n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (i + 1))))
+            osz = cutoffs[i + 1] - cutoffs[i]
+            proj = self.create_parameter([in_features, hsz],
+                                         attr=weight_attr)
+            out = self.create_parameter([hsz, osz], attr=weight_attr)
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_out_{i}", out)
+            self.tail_weights.append([proj, out])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:], head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full (N, n_classes) log-probabilities."""
+        import jax
+        import jax.numpy as jnp
+        from ..._core.tensor import apply
+
+        cutoffs = self.cutoffs
+        n_clusters = len(cutoffs) - 1
+        c0 = cutoffs[0]
+
+        def fn(x, hw, *rest):
+            bias = rest[-1] if self.head_bias is not None else None
+            tails = rest[:2 * n_clusters]
+            head = x @ hw
+            if bias is not None:
+                head = head + bias
+            head_lp = jax.nn.log_softmax(head, axis=-1)
+            outs = [head_lp[:, :c0]]
+            for i in range(n_clusters):
+                proj, w = tails[2 * i], tails[2 * i + 1]
+                t_lp = jax.nn.log_softmax((x @ proj) @ w, axis=-1)
+                outs.append(t_lp + head_lp[:, c0 + i][:, None])
+            return jnp.concatenate(outs, axis=-1)
+
+        args = [input, self.head_weight]
+        args += [w for pair in self.tail_weights for w in pair]
+        if self.head_bias is not None:
+            args.append(self.head_bias)
+        return apply(fn, *args, name="adaptive_log_prob")
+
+    def predict(self, input):
+        from ...tensor.search import argmax
+        return argmax(self.log_prob(input), axis=-1)
+
+
+class FractionalMaxPool2D(Layer):
+    """reference: nn.FractionalMaxPool2D (layer/pooling.py)."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.kernel_size = kernel_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size, self.kernel_size,
+                                       self.random_u, self.return_mask)

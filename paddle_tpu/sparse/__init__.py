@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import sparse as jsparse
 
 from .._core.tensor import Tensor, unwrap
+from .._core import dtypes as _dt
 
 __all__ = [
     "SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor", "matmul",
@@ -24,12 +25,24 @@ __all__ = [
     "sin", "sinh", "asin", "asinh", "tan", "tanh", "atan", "atanh", "sqrt",
     "square", "abs", "pow", "neg", "expm1", "log1p", "cast", "rad2deg",
     "deg2rad", "relu", "relu6", "leaky_relu", "softmax", "nn",
+    "sum", "isnan", "mv", "mask_as", "slice", "pca_lowrank",
 ]
+
+
+def _todense(bcoo):
+    """BCOO.todense sums duplicates, which rejects bool data — route bool
+    through int8 (valid: a coalesced bool pattern is 0/1)."""
+    if bcoo.data.dtype == jnp.bool_:
+        import jax.experimental.sparse as _js
+        as_int = _js.BCOO((bcoo.data.astype(jnp.int8), bcoo.indices),
+                          shape=bcoo.shape)
+        return as_int.todense().astype(jnp.bool_)
+    return bcoo.todense()
 
 
 class SparseCooTensor(Tensor):
     def __init__(self, bcoo, stop_gradient=True):
-        super().__init__(bcoo.todense(), stop_gradient=stop_gradient)
+        super().__init__(_todense(bcoo), stop_gradient=stop_gradient)
         self._bcoo = bcoo
 
     def indices(self):
@@ -39,7 +52,7 @@ class SparseCooTensor(Tensor):
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
-        return Tensor(self._bcoo.todense())
+        return Tensor(_todense(self._bcoo))
 
     def is_sparse(self):
         return True
@@ -249,7 +262,9 @@ def softmax(x, axis=-1, name=None):
     else:
         # linearize all leading dims into a row id per nonzero
         strides = np.cumprod([1] + list(b.shape[:-1][::-1]))[::-1][1:]
-        rows = sum(b.indices[:, i] * int(strides[i]) for i in range(nd - 1))
+        import builtins
+        rows = builtins.sum(b.indices[:, i] * int(strides[i])
+                            for i in range(nd - 1))
         nrows = int(np.prod(b.shape[:-1]))
     v = b.data.astype(jnp.float32)
     row_max = jax.ops.segment_max(v, rows, nrows)
@@ -289,3 +304,93 @@ nn = _SparseNN()
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """reference: paddle.sparse.sum — reduce over the sparsity pattern
+    (returns a sparse 0-d-equivalent dense Tensor when axis is None,
+    sparse over remaining dims otherwise)."""
+    b = x._bcoo
+    v = b.data.astype(_dt.convert_dtype(dtype)) if dtype else b.data
+    if axis is None:
+        return Tensor(jnp.sum(v))
+    import jax
+    nd = len(b.shape)
+    ax = axis + nd if axis < 0 else axis
+    keep_dims = [d for d in range(nd) if d != ax]
+    if not keep_dims:  # 1-D: reducing the only axis → scalar
+        out = jnp.sum(v)
+        return Tensor(jnp.expand_dims(out, 0) if keepdim else out)
+    # linearize remaining dims → segment-sum nonzeros
+    strides = {}
+    mult = 1
+    for d in reversed(keep_dims):
+        strides[d] = mult
+        mult *= b.shape[d]
+    seg = None
+    for d in keep_dims:
+        t = b.indices[:, d].astype(jnp.int64) * strides[d]
+        seg = t if seg is None else seg + t
+    dense = jax.ops.segment_sum(v, seg, mult).reshape(
+        [b.shape[d] for d in keep_dims])
+    if keepdim:
+        dense = jnp.expand_dims(dense, ax)
+    return Tensor(dense)
+
+
+def isnan(x, name=None):
+    """Elementwise isnan over the sparsity pattern."""
+    return _rebuild(x, jnp.isnan(x._bcoo.data))
+
+
+def mv(x, vec, name=None):
+    """Sparse (M, N) @ dense (N,) → dense (M,) (reference sparse.mv)."""
+    from .. import sparse as _sp
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    out = matmul(x, Tensor(v[:, None]))
+    return Tensor(out._value[:, 0])
+
+
+def mask_as(x, mask, name=None):
+    """Select entries of dense `x` at `mask`'s sparsity pattern
+    (reference sparse.mask_as)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    b = mask._bcoo
+    vals = xv[tuple(b.indices[:, d] for d in range(b.indices.shape[1]))]
+    return _rebuild(mask, vals.astype(b.data.dtype))
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse COO tensor (reference sparse.slice): filter the
+    nonzeros inside the window and shift their indices."""
+    from jax.experimental import sparse as jsparse
+    b = x._bcoo
+    nd = len(b.shape)
+    lo = [0] * nd
+    hi = list(b.shape)
+    for ax, s, e in zip(axes, starts, ends):
+        ax = ax + nd if ax < 0 else ax
+        size = b.shape[ax]
+        s = s + size if s < 0 else s
+        e = e + size if e < 0 else e
+        lo[ax] = max(0, min(int(s), size))
+        hi[ax] = max(0, min(int(e), size))
+    keepm = None
+    for d in range(nd):
+        m = (b.indices[:, d] >= lo[d]) & (b.indices[:, d] < hi[d])
+        keepm = m if keepm is None else (keepm & m)
+    idx = np.asarray(b.indices)[np.asarray(keepm)]
+    vals = np.asarray(b.data)[np.asarray(keepm)]
+    idx = idx - np.asarray(lo)[None, :]
+    new_shape = tuple(h - l for l, h in zip(lo, hi))
+    nb = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                      shape=new_shape)
+    return SparseCooTensor(nb, stop_gradient=x.stop_gradient)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """reference: paddle.sparse.pca_lowrank — densify (rank-q PCA output
+    is dense anyway) and reuse the dense implementation."""
+    from ..linalg import pca_lowrank as _dense_pca
+    return _dense_pca(Tensor(x._bcoo.todense()), q=q, center=center,
+                      niter=niter)

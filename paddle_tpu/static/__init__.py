@@ -262,3 +262,349 @@ class InputSpec:
     def from_tensor(cls, tensor, name=None):
         from .._core import dtypes as _dt
         return cls(tensor.shape, _dt.dtype_name(tensor.dtype), name)
+
+
+# ---------------------------------------------------------------------------
+# remaining paddle.static __all__ surface (reference: python/paddle/static)
+# ---------------------------------------------------------------------------
+class Variable(Tensor):
+    """reference: static Variable — here the Tensor IS the variable."""
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(shape, value, dtype=np.dtype(dtype)))
+    t.persistable = persistable
+    name = name or f"global_var_{len(default_main_program()._vars)}"
+    t.name = name
+    default_main_program()._register(name, t)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .. import create_parameter as _cp
+    p = _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+            default_initializer=default_initializer)
+    name = name or f"param_{len(default_main_program()._params)}"
+    default_main_program()._register(name, p, trainable=True)
+    return p
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference: static append_backward — builds grad ops. Tape world:
+    run backward and return [(param, grad)] pairs."""
+    loss.backward(retain_graph=True)
+    params = parameter_list
+    if params is None:
+        params = list(default_main_program()._params.values())
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: static gradients → autograd.grad."""
+    from ..autograd import grad as _grad
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 retain_graph=True, allow_unused=True)
+
+
+class Scope:
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, Tensor(jnp.zeros(())))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+class BuildStrategy:
+    """reference: compiled program build options — XLA decides fusion/
+    memory here; the knobs are accepted and recorded for parity."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.build_cuda_graph = False
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, item):
+        return getattr(self._program, item)
+
+
+class ExponentialMovingAverage:
+    """reference: static ExponentialMovingAverage — shadow params with
+    bias-corrected EMA and apply/restore guards."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._step = 0
+        self._shadow = {}
+        self._backup = {}
+
+    def update(self, parameters=None):
+        params = parameters or default_main_program()._params.values()
+        self._step += 1
+        for p in params:
+            k = id(p)
+            v = np.asarray(p._value, np.float32)
+            if k not in self._shadow:
+                self._shadow[k] = (p, np.zeros_like(v))
+            _, s = self._shadow[k]
+            s *= self._decay
+            s += (1 - self._decay) * v
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for k, (p, s) in self._shadow.items():
+            self._backup[k] = p._value
+            corr = s / (1 - self._decay ** max(self._step, 1))
+            p._replace(jnp.asarray(corr, p._value.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor=None):
+        for k, (p, _s) in self._shadow.items():
+            if k in self._backup:
+                p._replace(self._backup.pop(k))
+
+
+class WeightNormParamAttr(_nn.layer.layers.ParamAttr):
+    """reference: static WeightNormParamAttr — param attr requesting
+    weight normalization (dim recorded; applied via nn.utils.weight_norm)."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+def name_scope(prefix=None):
+    """reference: static name_scope — graph-visualization grouping; the
+    tape has no protobuf names, so this is a transparent context."""
+    return contextlib.nullcontext(prefix)
+
+
+def device_guard(device=None):
+    """reference: pin ops to a device inside a program. XLA owns placement
+    on TPU; accepted and ignored (single logical device per host)."""
+    return contextlib.nullcontext(device)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """reference: static Print op → jax.debug.print-compatible eager echo."""
+    v = input._value if isinstance(input, Tensor) else input
+    msg = message or ""
+    print(f"{msg} {'var' if not getattr(input, 'name', None) else input.name}"
+          f" shape={tuple(np.asarray(v).shape)} "
+          f"values={np.asarray(v).reshape(-1)[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: static py_func op — call a python function on tensors.
+    Eager tape: just call it (jax.pure_callback covers the jit case)."""
+    ins = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*ins)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    ress = res if isinstance(res, (list, tuple)) else [res]
+    for o, r in zip(outs, ress):
+        if isinstance(o, Tensor):
+            o._replace(jnp.asarray(_uw(r), o._value.dtype))
+    return out
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA in this framework (TPU build)
+
+
+def xpu_places(device_ids=None):
+    from ..device import TPUPlace
+    try:
+        return [TPUPlace(d.id) for d in jax.devices()]
+    except Exception:
+        return []
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..metric import Auc
+    m = Auc(curve=curve, num_thresholds=min(num_thresholds, 4095))
+    m.update(preds=np.stack([1 - np.asarray(_uw(input))[:, -1],
+                             np.asarray(_uw(input))[:, -1]], axis=1)
+             if np.asarray(_uw(input)).ndim > 1 else _uw(input),
+             labels=_uw(label))
+    val = m.accumulate()
+    return Tensor(jnp.asarray(val)), None, None
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference: CTR eval bundle (AUC + MAE + RMSE over predictions)."""
+    p = np.asarray(_uw(input), np.float64).reshape(-1)
+    y = np.asarray(_uw(label), np.float64).reshape(-1)
+    mae = np.abs(p - y).mean()
+    rmse = np.sqrt(((p - y) ** 2).mean())
+    return (auc(Tensor(jnp.asarray(np.stack([1 - p, p], 1))),
+                Tensor(jnp.asarray(y.astype(np.int64))))[0],
+            Tensor(jnp.asarray(mae)), Tensor(jnp.asarray(rmse)))
+
+
+# ------------------------------------------------ program (de)serialization
+def serialize_program(feed_vars, fetch_vars, program=None):
+    program = program or default_main_program()
+    blob = {"vars": {n: np.asarray(t._value)
+                     for n, t in program._vars.items()},
+            "feeds": [getattr(v, "name", None) for v in
+                      (feed_vars if isinstance(feed_vars, (list, tuple))
+                       else [feed_vars])],
+            "fetches": len(fetch_vars if isinstance(fetch_vars, (list, tuple))
+                           else [fetch_vars])}
+    return pickle.dumps(blob)
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None):
+    program = program or default_main_program()
+    return pickle.dumps({n: np.asarray(t._value)
+                         for n, t in program._params.items()})
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data):
+    blob = pickle.loads(data)
+    prog = Program()
+    for n, arr in blob["vars"].items():
+        prog._register(n, Tensor(jnp.asarray(arr)))
+    return prog
+
+
+def deserialize_persistables(program, data, executor=None):
+    blob = pickle.loads(data)
+    for n, arr in blob.items():
+        t = program._vars.get(n)
+        if t is not None:
+            t._replace(jnp.asarray(arr, t._value.dtype))
+        else:
+            program._register(n, Tensor(jnp.asarray(arr)), trainable=True)
+    return program
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference: static save_inference_model — program + persistables in
+    two files (<prefix>.pdmodel / <prefix>.pdiparams)."""
+    import os
+    program = program or default_main_program()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars, program))
+    save_to_file(path_prefix + ".pdiparams",
+                 serialize_persistables(feed_vars, fetch_vars, program))
+    return None
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    prog = deserialize_program(load_from_file(path_prefix + ".pdmodel"))
+    deserialize_persistables(prog,
+                             load_from_file(path_prefix + ".pdiparams"))
+    blob = pickle.loads(load_from_file(path_prefix + ".pdmodel"))
+    feeds = blob.get("feeds", [])
+    fetches = list(prog._vars.values())[-blob.get("fetches", 1):] \
+        if blob.get("fetches") else []
+    return prog, feeds, fetches
+
+
+def load_program_state(model_path, var_list=None):
+    import os
+    for suffix in (".pdiparams", ".pdparams", ""):
+        p = model_path + suffix
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                return pickle.loads(f.read())
+    raise FileNotFoundError(model_path)
+
+
+def set_program_state(program, state):
+    for n, arr in state.items():
+        t = program._vars.get(n)
+        if t is not None:
+            t._replace(jnp.asarray(arr, t._value.dtype))
+
+
+# --------------------------------------------------------------- IPU shims
+_IPU_MSG = ("IPU is another vendor's accelerator — out of scope for the "
+            "TPU build (deployment path: StableHLO/XLA AOT; see onnx.py)")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError(_IPU_MSG)
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError(_IPU_MSG)
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise NotImplementedError(_IPU_MSG)
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_IPU_MSG)

@@ -148,3 +148,49 @@ class WMT14(Dataset):
 class WMT16(Dataset):
     def __init__(self, **kw):
         raise NotImplementedError("WMT16 requires local data files")
+
+
+from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: E402,F401
+
+
+class Imikolov(Dataset):
+    """reference: python/paddle/dataset/imikolov.py + text Imikolov —
+    n-gram / seq LM samples over a word corpus. Offline build: reads a
+    local token file if given, else a small synthetic corpus (seeded)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=1, **kw):
+        if data_type.upper() not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        if data_file:
+            with open(data_file) as f:
+                tokens = f.read().split()
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            vocab = [f"w{i}" for i in range(50)]
+            tokens = [vocab[i] for i in rng.zipf(1.5, 2000) % 50]
+        from collections import Counter
+        freq = Counter(tokens)
+        words = sorted(w for w, c in freq.items() if c >= min_word_freq)
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        ids = [self.word_idx.get(t, self.word_idx["<unk>"]) for t in tokens]
+        self.samples = []
+        if self.data_type == "NGRAM":
+            for i in range(len(ids) - window_size + 1):
+                self.samples.append(np.asarray(ids[i:i + window_size],
+                                               np.int64))
+        else:
+            step = window_size
+            for i in range(0, len(ids) - step, step):
+                self.samples.append((np.asarray(ids[i:i + step], np.int64),
+                                     np.asarray(ids[i + 1:i + step + 1],
+                                                np.int64)))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]

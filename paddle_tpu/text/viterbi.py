@@ -1,0 +1,73 @@
+"""Viterbi decode (reference: python/paddle/text/viterbi_decode.py + phi
+viterbi_decode kernel).
+
+CRF-style decode: DP over (B, L, N) unary potentials with an (N, N)
+transition matrix. include_bos_eos_tag follows the reference: the LAST
+row/column of `transition_params` is the start tag, the second-to-last
+the stop tag (start transitions added at t=0, stop transitions at each
+sequence's final step).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .._core.tensor import Tensor, unwrap
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    pot = np.asarray(unwrap(potentials), np.float32)
+    trans = np.asarray(unwrap(transition_params), np.float32)
+    lens = np.asarray(unwrap(lengths)).astype(np.int64)
+    b, seq_len, n = pot.shape
+    max_len = int(min(seq_len, lens.max()))
+    start_trans = trans[-1] if include_bos_eos_tag else None
+    stop_trans = trans[-2] if include_bos_eos_tag else None
+
+    alpha = pot[:, 0].copy()
+    if include_bos_eos_tag:
+        alpha += start_trans[None, :]
+        alpha += np.where((lens == 1)[:, None], stop_trans[None, :], 0.0)
+    history = []
+    left = lens - 1
+    for t in range(1, max_len):
+        scores = alpha[:, :, None] + trans[None, :, :]   # prev → cur
+        best_prev = scores.argmax(axis=1)                # (B, N)
+        alpha_nxt = scores.max(axis=1) + pot[:, t]
+        if include_bos_eos_tag:
+            alpha_nxt += np.where((left == 1)[:, None],
+                                  stop_trans[None, :], 0.0)
+        active = (left > 0)[:, None]
+        alpha = np.where(active, alpha_nxt, alpha)
+        history.append(best_prev)
+        left = left - 1
+
+    scores = alpha.max(axis=1)
+    last_ids = alpha.argmax(axis=1).astype(np.int64)
+    paths = np.zeros((b, max_len), np.int64)
+    for bi in range(b):
+        L = int(min(lens[bi], max_len))
+        if L <= 0:
+            continue
+        paths[bi, L - 1] = last_ids[bi]
+        for t in range(L - 1, 0, -1):
+            paths[bi, t - 1] = history[t - 1][bi, paths[bi, t]]
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(scores)), Tensor(jnp.asarray(paths))
+
+
+class ViterbiDecoder:
+    """reference: paddle.text.ViterbiDecoder — layer-style wrapper
+    holding the transition matrix."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+    forward = __call__

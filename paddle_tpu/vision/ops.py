@@ -1,6 +1,8 @@
 """Vision ops (reference: python/paddle/vision/ops.py)."""
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -9,7 +11,9 @@ from .._core.tensor import Tensor, apply, unwrap
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "yolo_box", "yolo_loss",
            "deform_conv2d", "DeformConv2D", "distribute_fpn_proposals",
-           "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool"]
+           "generate_proposals", "PSRoIPool", "RoIAlign", "RoIPool",
+           "psroi_pool", "prior_box", "matrix_nms", "read_file",
+           "decode_jpeg"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
@@ -583,3 +587,179 @@ class PSRoIPool:
                 ys.append(jnp.stack(rows, -2))
             return jnp.stack(ys)
         return apply(fn, x, boxes, name="psroi_pool")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Functional PSRoIPool (reference vision/ops.py psroi_pool)."""
+    return PSRoIPool(output_size, spatial_scale)(x, boxes, boxes_num)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes (reference vision/ops.py:438; exact phi
+    prior_box_kernel math incl. ExpandAspectRatios + the min/max ordering
+    switch). Returns (boxes (H, W, P, 4), variances (H, W, P, 4))."""
+    feat = unwrap(input)
+    img = unwrap(image)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    min_sizes = [float(m) for m in np.atleast_1d(min_sizes)]
+    max_sizes = [float(m) for m in np.atleast_1d(max_sizes)] \
+        if max_sizes is not None else []
+    variance = [float(v) for v in np.atleast_1d(variance)]
+    # ExpandAspectRatios: 1.0 first, then each new ar (+ 1/ar if flip)
+    ars = [1.0]
+    for ar in np.atleast_1d(aspect_ratios):
+        ar = float(ar)
+        if all(abs(ar - e) > 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    sw = float(steps[0]) or iw / fw
+    sh = float(steps[1]) or ih / fh
+
+    boxes = []
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * sw
+            cy = (h + offset) * sh
+
+            def emit(bw, bh):
+                boxes.append([(cx - bw) / iw, (cy - bh) / ih,
+                              (cx + bw) / iw, (cy + bh) / ih])
+
+            for s, mn in enumerate(min_sizes):
+                if min_max_aspect_ratios_order:
+                    emit(mn / 2.0, mn / 2.0)
+                    if max_sizes:
+                        sq = math.sqrt(mn * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        emit(mn * math.sqrt(ar) / 2.0,
+                             mn / math.sqrt(ar) / 2.0)
+                else:
+                    for ar in ars:
+                        emit(mn * math.sqrt(ar) / 2.0,
+                             mn / math.sqrt(ar) / 2.0)
+                    if max_sizes:
+                        sq = math.sqrt(mn * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+    num_priors = len(ars) * len(min_sizes) + len(max_sizes)
+    b = np.asarray(boxes, np.float32).reshape(fh, fw, num_priors, 4)
+    if clip:
+        b = np.clip(b, 0.0, 1.0)
+    v = np.broadcast_to(np.asarray(variance, np.float32),
+                        (fh, fw, num_priors, 4)).copy()
+    return Tensor(jnp.asarray(b)), Tensor(jnp.asarray(v))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference vision/ops.py:2358 / phi
+    matrix_nms kernel): parallel soft suppression — each candidate's
+    score decays by min_i f(iou_ij)/f(max_iou_i) over higher-scored
+    same-class boxes instead of hard removal."""
+    bb = np.asarray(unwrap(bboxes), np.float32)    # (N, M, 4)
+    sc = np.asarray(unwrap(scores), np.float32)    # (N, C, M)
+    n, c, m = sc.shape
+    norm = 0.0 if normalized else 1.0
+
+    def iou_mat(b):
+        x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+        area = (x2 - x1 + norm) * (y2 - y1 + norm)
+        ix1 = np.maximum(x1[:, None], x1[None, :])
+        iy1 = np.maximum(y1[:, None], y1[None, :])
+        ix2 = np.minimum(x2[:, None], x2[None, :])
+        iy2 = np.minimum(y2[:, None], y2[None, :])
+        iw = np.clip(ix2 - ix1 + norm, 0, None)
+        ih = np.clip(iy2 - iy1 + norm, 0, None)
+        inter = iw * ih
+        return inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+    all_out, all_idx, rois_num = [], [], []
+    for b in range(n):
+        dets = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = sc[b, cls]
+            keep = np.nonzero(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bb[b, order]
+            scores_c = s[order]
+            iou = iou_mat(boxes_c)
+            iou = np.triu(iou, k=1)                 # i < j pairs
+            # comp[i]: suppressor i's own max IoU with anything scored
+            # above IT — the matrix-NMS compensation term divides by
+            # f(comp_i) so already-suppressed boxes suppress less
+            comp = iou.max(axis=0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - comp[:, None], 1e-10)
+            # min over higher-scored i for each j (row 0..j-1)
+            mask = np.triu(np.ones_like(iou, dtype=bool), k=1)
+            decay = np.where(mask, decay, np.inf).min(axis=0)
+            decay = np.where(np.isinf(decay), 1.0, decay)
+            new_s = scores_c * decay
+            ok = new_s >= post_threshold
+            for j in np.nonzero(ok)[0]:
+                dets.append((cls, new_s[j], *boxes_c[j], order[j]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        rois_num.append(len(dets))
+        for d in dets:
+            all_out.append(d[:6])
+            all_idx.append(b * m + d[6])
+    out = Tensor(jnp.asarray(np.asarray(all_out, np.float32).reshape(-1, 6)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(all_idx, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(rois_num, np.int64))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def read_file(filename, name=None):
+    """Read a file's bytes into a uint8 tensor (reference vision read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to (C, H, W) uint8 (reference: nvjpeg
+    GPU op). Host path: PIL when available (it is not baked into this
+    offline image), else a clear error — TPU inference pipelines decode
+    on host CPU either way."""
+    try:
+        from PIL import Image
+        import io as _io
+    except ImportError as e:
+        raise NotImplementedError(
+            "decode_jpeg needs a host JPEG decoder; PIL is not available "
+            "in this build. Pre-decode images (vision.image backend) or "
+            "pack raw tensors with io/native.py record files.") from e
+    raw = bytes(np.asarray(unwrap(x), np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode.lower() == "gray":
+        img = img.convert("L")
+    elif mode.lower() == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

@@ -5,6 +5,7 @@ runs in the libptio C++ loader or numpy, keeping TPU host CPUs free.
 """
 from __future__ import annotations
 
+import math
 import numbers
 import random
 
@@ -230,7 +231,8 @@ class HueTransform(BaseTransform):
         self.value = value
 
     def _apply_image(self, img):
-        return _hwc(img)  # hue rotation: identity fallback (round 2: HSV path)
+        return adjust_hue(_hwc(img),
+                          random.uniform(-self.value, self.value))
 
 
 class ColorJitter(BaseTransform):
@@ -415,3 +417,240 @@ def adjust_contrast(img, contrast_factor):
 def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
     t = RandomRotation((angle, angle))
     return t(img)
+
+
+def _sample_at(arr, xi, yi, fill, interpolation):
+    """Sample an HWC array at float input coords (xi, yi) per output
+    pixel — nearest or bilinear, out-of-bounds → fill."""
+    h, w = arr.shape[:2]
+    if interpolation == "bilinear":
+        x0 = np.floor(xi).astype(np.int64)
+        y0 = np.floor(yi).astype(np.int64)
+        wx = xi - x0
+        wy = yi - y0
+        out = np.zeros(arr.shape, np.float32)
+        valid_any = np.zeros((h, w), bool)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xx = x0 + dx
+                yy = y0 + dy
+                wgt = (wx if dx else 1 - wx) * (wy if dy else 1 - wy)
+                v = (xx >= 0) & (xx < w) & (yy >= 0) & (yy < h)
+                valid_any |= v & (wgt > 0)
+                samp = arr[np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)]
+                out += np.where(v[..., None] if arr.ndim == 3 else v,
+                                samp * (wgt[..., None] if arr.ndim == 3
+                                        else wgt), 0.0)
+        out = np.where(valid_any[..., None] if arr.ndim == 3 else valid_any,
+                       out, fill)
+        return out.astype(arr.dtype)
+    xi = np.round(xi).astype(np.int64)
+    yi = np.round(yi).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    samp = arr[np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)]
+    mask = valid[..., None] if arr.ndim == 3 else valid
+    return np.where(mask, samp, fill).astype(arr.dtype)
+
+
+def _affine_grid_sample(arr, matrix, fill=0, interpolation="nearest",
+                        center=None):
+    """Apply an inverse 2x3 affine matrix (output→input coords, pixel
+    units, origin at `center`, default image center) to an HWC array —
+    the torchvision/paddle affine convention."""
+    h, w = arr.shape[:2]
+    if center is None:
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    else:
+        cx, cy = float(center[0]), float(center[1])
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    xo = xs - cx
+    yo = ys - cy
+    a, b, c, d, e, f = [float(m) for m in np.asarray(matrix).reshape(6)]
+    xi = a * xo + b * yo + c + cx
+    yi = d * xo + e * yo + f + cy
+    return _sample_at(arr, xi, yi, fill, interpolation)
+
+
+def _affine_inverse(angle, translate, scale, shear, center):
+    """Build the inverse (output→input) matrix for the paddle/torchvision
+    affine parameterization: M = T(translate) C R(angle) Sh(shear) S C^-1."""
+    rot = math.radians(angle)
+    sx, sy = [math.radians(s) for s in shear]
+    # forward 2x2: R @ Shear, scaled
+    a = math.cos(rot - sy) / math.cos(sy)
+    b = -math.cos(rot - sy) * math.tan(sx) / math.cos(sy) - math.sin(rot)
+    c = math.sin(rot - sy) / math.cos(sy)
+    d = -math.sin(rot - sy) * math.tan(sx) / math.cos(sy) + math.cos(rot)
+    fwd = np.array([[scale * a, scale * b, translate[0]],
+                    [scale * c, scale * d, translate[1]],
+                    [0, 0, 1]], np.float64)
+    inv = np.linalg.inv(fwd)
+    return inv[:2].reshape(-1)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """reference: transforms.functional.affine."""
+    if isinstance(shear, numbers.Number):
+        shear = [shear, 0.0]
+    arr = _hwc(img)
+    m = _affine_inverse(angle, translate, scale, list(shear), center)
+    return _affine_grid_sample(arr, m, fill=fill,
+                               interpolation=interpolation, center=center)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """Solve the 8-dof homography mapping endpoints → startpoints
+    (output→input, torchvision convention)."""
+    A = []
+    B = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        B.extend([sx, sy])
+    coeffs = np.linalg.solve(np.asarray(A, np.float64),
+                             np.asarray(B, np.float64))
+    return coeffs
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference: transforms.functional.perspective — map the quad
+    `startpoints` to `endpoints` (corner lists [[x, y] x4])."""
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    co = _perspective_coeffs(startpoints, endpoints)
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float64)
+    den = co[6] * xs + co[7] * ys + 1.0
+    xi = ((co[0] * xs + co[1] * ys + co[2]) / den).astype(np.float32)
+    yi = ((co[3] * xs + co[4] * ys + co[5]) / den).astype(np.float32)
+    return _sample_at(arr, xi, yi, fill, interpolation)
+
+
+def adjust_hue(img, hue_factor):
+    """reference: transforms.functional.adjust_hue — shift hue by
+    hue_factor (in [-0.5, 0.5]) in HSV space."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _hwc(img).astype(np.float32) / 255.0
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return np.asarray(img)  # grayscale: hue is undefined
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr.max(-1)
+    minc = arr.min(-1)
+    v = maxc
+    deltac = maxc - minc
+    s = np.where(maxc > 0, deltac / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(deltac, 1e-12)
+    rc = (maxc - r) / dz
+    gc = (maxc - g) / dz
+    bc = (maxc - b) / dz
+    hh = np.where(maxc == r, bc - gc,
+                  np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    hh = (hh / 6.0) % 1.0
+    hh = np.where(deltac == 0, 0.0, hh)
+    hh = (hh + hue_factor) % 1.0
+    i = np.floor(hh * 6.0)
+    f = hh * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return np.clip(out * 255.0, 0, 255).astype(np.asarray(_hwc(img)).dtype)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference: transforms.functional.erase — overwrite the [i:i+h,
+    j:j+w] window with value(s) v. Handles CHW tensors and HWC arrays."""
+    from ..._core.tensor import Tensor as _T
+    if isinstance(img, _T):
+        arr = np.asarray(img.numpy())
+        chw = arr.ndim == 3
+        out = arr.copy()
+        if chw:
+            out[:, i:i + h, j:j + w] = v
+        else:
+            out[i:i + h, j:j + w] = v
+        import jax.numpy as _jnp
+        return _T(_jnp.asarray(out))
+    arr = np.asarray(img)
+    out = arr if inplace else arr.copy()
+    if arr.ndim == 3 and arr.shape[0] in (1, 3):
+        out[:, i:i + h, j:j + w] = v
+    else:
+        out[i:i + h, j:j + w] = v
+    return out
+
+
+class RandomAffine(BaseTransform):
+    """reference: transforms.RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) \
+            if isinstance(degrees, numbers.Number) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = random.uniform(*self.scale) if self.scale else 1.0
+        sh = [0.0, 0.0]
+        if self.shear is not None:
+            shear = self.shear
+            if isinstance(shear, numbers.Number):
+                sh = [random.uniform(-shear, shear), 0.0]
+            elif len(shear) == 2:
+                sh = [random.uniform(shear[0], shear[1]), 0.0]
+            else:
+                sh = [random.uniform(shear[0], shear[1]),
+                      random.uniform(shear[2], shear[3])]
+        return affine(arr, angle, (tx, ty), sc, sh,
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """reference: transforms.RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if random.random() > self.prob:
+            return arr
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        hw, hh = int(w * d / 2), int(h * d / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [[random.randint(0, max(hw, 1)), random.randint(0, max(hh, 1))],
+               [w - 1 - random.randint(0, max(hw, 1)),
+                random.randint(0, max(hh, 1))],
+               [w - 1 - random.randint(0, max(hw, 1)),
+                h - 1 - random.randint(0, max(hh, 1))],
+               [random.randint(0, max(hw, 1)),
+                h - 1 - random.randint(0, max(hh, 1))]]
+        return perspective(arr, start, end,
+                           interpolation=self.interpolation, fill=self.fill)

