@@ -182,10 +182,18 @@ __all__ = ["no_grad", "enable_grad", "backward", "grad", "PyLayer",
 
 class saved_tensors_hooks:
     """reference: paddle.autograd.saved_tensors_hooks — pack/unpack hooks
-    over tensors the tape saves for backward. Tape integration: while the
-    context is active, every recorded TapeNode stores pack_hook(raw) in
-    place of each raw input and calls unpack_hook when its VJP runs
-    (e.g. offload activations to host numpy, reload on backward).
+    over tensors the tape saves for backward. While the context is
+    active, every recorded TapeNode stores pack_hook(raw) in place of
+    each tensor-valued raw input and calls unpack_hook when its VJP runs
+    — use it to compress, quantize, or checksum saved activations.
+
+    NOTE on device-memory offload: packing transforms the tape's saved
+    copy, but the live `Tensor` objects flowing through your model still
+    hold their device arrays (they ARE the forward values), so a
+    to-host pack hook alone does not shrink HBM. For memory-bound
+    training use the compiled path with `jax.checkpoint` (llama_spmd
+    remat / Trainer), which is the TPU-native answer to activation
+    memory.
     """
 
     def __init__(self, pack_hook, unpack_hook):

@@ -223,18 +223,34 @@ def block_multihead_attention(qkv, key_cache, value_cache, seq_lens_encoder,
                               cu_seqlens_q=None, cu_seqlens_k=None,
                               block_tables=None, **kwargs):
     """reference: block_multihead_attention (PaddleNLP serving core) —
-    the paged-KV path; see models/llama_serving.py for the full engine.
-    This functional form handles the decode step over the paged pools."""
+    the paged-KV decode step. qkv packs (num_head + 2*kv_heads) heads per
+    token: the query heads first, then this token's K and V heads, which
+    are scattered into the paged pools at each sequence's current length
+    before attending. See models/llama_serving.py for the full engine
+    (continuous batching, varlen prefill)."""
     from ...ops.paged_attention import paged_attention
-    q = unwrap(qkv)
-    b = q.shape[0]
-    kvh, num_pages, page_size, d = unwrap(key_cache).shape
-    h = q.shape[-2] if q.ndim > 2 else kvh
+    kc = unwrap(key_cache)
+    vc = unwrap(value_cache)
+    kvh, num_pages, page_size, d = kc.shape
+    q3 = unwrap(qkv)
+    b = q3.shape[0]
+    q3 = q3.reshape(b, -1, d)
+    h = q3.shape[1] - 2 * kvh
+    if h <= 0:
+        raise ValueError(
+            f"qkv packs {q3.shape[1]} heads but caches have {kvh} kv heads "
+            f"— expected num_head + 2*{kvh}")
+    q, k_new, v_new = q3[:, :h], q3[:, h:h + kvh], q3[:, h + kvh:]
     lens = unwrap(seq_lens_decoder).reshape(-1).astype(jnp.int32)
-    out = paged_attention(q.reshape(b, -1, d), unwrap(key_cache),
-                          unwrap(value_cache),
-                          unwrap(block_tables).astype(jnp.int32), lens)
-    return Tensor(out), key_cache, value_cache
+    tables = unwrap(block_tables).astype(jnp.int32)
+    # scatter this token's K/V: page = table[b, len//page], slot = len%page
+    bidx = jnp.arange(b)
+    pages = tables[bidx, lens // page_size]
+    slots = lens % page_size
+    kc = kc.at[:, pages, slots].set(jnp.swapaxes(k_new, 0, 1))
+    vc = vc.at[:, pages, slots].set(jnp.swapaxes(v_new, 0, 1))
+    out = paged_attention(q, kc, vc, tables, lens + 1)
+    return Tensor(out), Tensor(kc), Tensor(vc)
 
 
 def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size=None):
@@ -273,14 +289,21 @@ def moe_ffn(permuted_x, rows_per_expert, up_gate_weight, down_weight,
     counts = np.asarray(unwrap(rows_per_expert))
     ug = unwrap(up_gate_weight)
     dw = unwrap(down_weight)
+    ugb = unwrap(up_gate_bias) if up_gate_bias is not None else None
+    dwb = unwrap(down_bias) if down_bias is not None else None
     outs = []
     start = 0
     for e, n in enumerate(counts):
         blk = xv[start:start + int(n)]
         hgate = blk @ ug[e]
+        if ugb is not None:
+            hgate = hgate + ugb[e]
         a, b = jnp.split(hgate, 2, -1)
         h = jax.nn.silu(a) * b
-        outs.append(h @ dw[e])
+        y = h @ dw[e]
+        if dwb is not None:
+            y = y + dwb[e]
+        outs.append(y)
         start += int(n)
     return Tensor(jnp.concatenate(outs, 0) if outs else xv[:0])
 

@@ -7,5 +7,9 @@ from .bert import (  # noqa: F401
 from .gpt2 import GPT2Config, GPT2Model, GPT2LMHeadModel  # noqa: F401
 from .moe_llm import MoEConfig, MoEForCausalLM  # noqa: F401
 from .qwen2 import Qwen2Config, Qwen2Model, Qwen2ForCausalLM  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+    ErnieForTokenClassification, ErnieForPretraining,
+)
 from .deepseek import DeepSeekConfig, DeepSeekForCausalLM  # noqa: F401
 from . import generation  # noqa: F401

@@ -1,0 +1,195 @@
+"""ERNIE family — Paddle's flagship NLP pretrained models.
+
+Reference workload: PaddleNLP ernie (ERNIE 1.0/3.0-style encoder:
+BERT-architecture transformer whose pretraining uses knowledge/entity
+masking; the network differs from BERT in config defaults, the
+`task_type_embeddings` used by ERNIE 3.0, and relu feed-forward in
+ERNIE 1.0). Built on the same paddle_tpu.nn encoder stack as models/
+bert.py — TPU-first: one jittable pure function per head via
+Layer.functional_state().
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from .. import nn
+from ..nn import functional as F
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 18000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "relu"          # ERNIE 1.0 uses relu FFN
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 513
+    type_vocab_size: int = 2
+    task_type_vocab_size: int = 3     # ERNIE 3.0 task-type embedding
+    use_task_id: bool = True
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+    @classmethod
+    def base(cls):
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=128,
+                   max_position_embeddings=128)
+
+
+class ErnieEmbeddings(nn.Layer):
+    """word + position + token-type (+ task-type) embeddings + LN."""
+
+    def __init__(self, c: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(c.vocab_size, c.hidden_size)
+        self.position_embeddings = nn.Embedding(c.max_position_embeddings,
+                                                c.hidden_size)
+        self.token_type_embeddings = nn.Embedding(c.type_vocab_size,
+                                                  c.hidden_size)
+        self.use_task_id = c.use_task_id
+        if c.use_task_id:
+            self.task_type_embeddings = nn.Embedding(c.task_type_vocab_size,
+                                                     c.hidden_size)
+        self.layer_norm = nn.LayerNorm(c.hidden_size,
+                                       epsilon=c.layer_norm_eps)
+        self.dropout = nn.Dropout(c.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = Tensor(jnp.arange(s)[None, :])
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(
+                input_ids._value if isinstance(input_ids, Tensor)
+                else jnp.asarray(input_ids)))
+        h = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids) \
+            + self.token_type_embeddings(token_type_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = Tensor(jnp.zeros(
+                    (input_ids.shape[0], s), jnp.int32))
+            h = h + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(h))
+
+
+class ErnieModel(nn.Layer):
+    """reference: PaddleNLP ErnieModel — encoder + pooled [CLS] output."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        c = config
+        self.config = c
+        self.embeddings = ErnieEmbeddings(c)
+        layer = nn.TransformerEncoderLayer(
+            c.hidden_size, c.num_attention_heads, c.intermediate_size,
+            dropout=c.hidden_dropout_prob, activation=c.hidden_act,
+            attn_dropout=c.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = nn.TransformerEncoder(layer, c.num_hidden_layers)
+        self.pooler = nn.Linear(c.hidden_size, c.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        h = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # (B, S) 1/0 keep-mask → additive (B, 1, 1, S); higher-rank
+            # masks are assumed already additive (bert.py convention)
+            def fn(m):
+                return (1.0 - m.astype(jnp.float32))[:, None, None, :] \
+                    * -1e4
+            from .._core.tensor import apply
+            attention_mask = apply(fn, attention_mask, name="ernie_mask")
+        seq = self.encoder(h, attention_mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask, task_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+
+class ErnieForTokenClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, **kw):
+        seq, _ = self.ernie(input_ids, **kw)
+        return self.classifier(self.dropout(seq))
+
+
+# MLM head with tied input embeddings: identical machinery to BERT's
+# (transform → act → LN → tied decode + bias); reuse it outright.
+from .bert import BertLMHead as ErnieLMHead  # noqa: E402
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM (knowledge masking) + NSP, mirroring BertForPretraining."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.lm_head = ErnieLMHead(
+            config, self.ernie.embeddings.word_embeddings.weight)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None, **kw):
+        seq, pooled = self.ernie(input_ids, token_type_ids,
+                                 attention_mask=attention_mask, **kw)
+        lm_logits = self.lm_head(seq)
+        nsp_logits = self.nsp_head(pooled)
+        if masked_lm_labels is None:
+            return lm_logits, nsp_logits
+        # -100-style ignore: positions with label < 0 excluded; NSP term
+        # only when next_sentence_labels given (MLM-only pretrain is valid)
+        from .._core.tensor import apply
+        with_nsp = next_sentence_labels is not None
+
+        def loss_fn(lm, lab, nsp, *rest):
+            import jax
+            lab = lab.astype(jnp.int32)
+            logp = jnp.take_along_axis(
+                jax.nn.log_softmax(lm.astype(jnp.float32), -1),
+                jnp.clip(lab, 0)[..., None], -1)[..., 0]
+            m = (lab >= 0).astype(jnp.float32)
+            mlm = -jnp.sum(logp * m) / jnp.maximum(jnp.sum(m), 1.0)
+            if not rest:
+                return mlm
+            nlogp = jax.nn.log_softmax(nsp.astype(jnp.float32), -1)
+            nsp_l = -jnp.mean(jnp.take_along_axis(
+                nlogp, rest[0].astype(jnp.int32)[:, None], -1))
+            return mlm + nsp_l
+
+        args = [lm_logits, masked_lm_labels, nsp_logits]
+        if with_nsp:
+            args.append(next_sentence_labels)
+        return apply(loss_fn, *args, name="ernie_pretrain_loss")

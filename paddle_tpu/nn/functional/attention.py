@@ -225,7 +225,7 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
             sri = jnp.repeat(sri, h // hk, axis=1)  # broadcast to q heads
             r = rows[None, None]                    # (1,1,S_q,1)
             def col(i):
-                return jnp.swapaxes(sri[..., i][:, :, None, :], 2, 2)
+                return sri[..., i][:, :, None, :]   # (B, H, 1, S_k)
             if causal and n == 1:
                 masked = r >= col(0)                # LT start downwards
             elif causal and n == 2:

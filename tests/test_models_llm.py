@@ -203,3 +203,67 @@ class TestMoE:
         gate = model.layers[0].mlp.gate_weight
         assert gate.grad is not None
         assert np.isfinite(float(loss))
+
+
+class TestErnie:
+    """ERNIE family (reference: PaddleNLP ernie — paddle's flagship NLP
+    pretrained model): BERT-architecture encoder + task-type embeddings
+    (3.0) + knowledge-masking MLM/NSP pretrain heads."""
+
+    def test_forward_and_finetune_step(self):
+        import paddle_tpu as pt
+        from paddle_tpu.models.ernie import (ErnieConfig,
+                                             ErnieForSequenceClassification)
+        pt.seed(0)
+        cfg = ErnieConfig.tiny()
+        model = ErnieForSequenceClassification(cfg, num_classes=3)
+        rng = np.random.RandomState(0)
+        ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)))
+        task = pt.to_tensor(np.ones((2, 16), np.int32))
+        logits = model(ids, task_type_ids=task)
+        assert logits.shape == [2, 3]
+        ce = pt.nn.CrossEntropyLoss()
+        y = pt.to_tensor(np.array([0, 2]))
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        l0 = None
+        for i in range(5):
+            loss = ce(model(ids), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if l0 is None:
+                l0 = float(loss.numpy())
+        assert float(loss.numpy()) < l0
+
+    def test_pretrain_loss_and_mask(self):
+        import paddle_tpu as pt
+        from paddle_tpu.models.ernie import ErnieConfig, ErnieForPretraining
+        pt.seed(1)
+        cfg = ErnieConfig.tiny()
+        model = ErnieForPretraining(cfg)
+        model.eval()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, cfg.vocab_size, (2, 12))
+        labels = np.full((2, 12), -100)
+        labels[:, 3:6] = ids[:, 3:6]  # knowledge-masked span
+        nsl = np.array([0, 1])
+        loss = model(pt.to_tensor(ids),
+                     masked_lm_labels=pt.to_tensor(labels),
+                     next_sentence_labels=pt.to_tensor(nsl))
+        v = float(loss.numpy())
+        assert np.isfinite(v) and v > 0
+        # logits shape without labels
+        lm, nsp = model(pt.to_tensor(ids))
+        assert lm.shape == [2, 12, cfg.vocab_size] and nsp.shape == [2, 2]
+
+    def test_token_classification(self):
+        import paddle_tpu as pt
+        from paddle_tpu.models.ernie import (ErnieConfig,
+                                             ErnieForTokenClassification)
+        cfg = ErnieConfig.tiny()
+        m = ErnieForTokenClassification(cfg, num_classes=7)
+        ids = pt.to_tensor(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (2, 10)))
+        out = m(ids)
+        assert out.shape == [2, 10, 7]

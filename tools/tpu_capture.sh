@@ -1,0 +1,21 @@
+#!/bin/bash
+# One-shot on-chip capture: run whenever the v5e tunnel is alive.
+# Order: kernel validation (cheap, highest evidence value) → model
+# benches → remat/batch sweep refinements. Everything appends to
+# BENCH_HISTORY.jsonl / TPU_VALIDATION.json which are committed.
+cd "$(dirname "$0")/.."
+set -x
+
+timeout 900 python tools/validate_tpu_kernels.py 2>&1 | tail -12
+
+for m in resnet50 bert moe serving; do
+  timeout 900 python bench_models.py "$m" 2>&1 | tail -2
+done
+
+# headline refinements: dots remat and batch 24 at the winning seq
+for cfg in "16 2048 dots" "24 2048 true"; do
+  set -- $cfg
+  PT_BENCH_BATCH=$1 PT_BENCH_SEQ=$2 PT_BENCH_REMAT=$3 \
+    timeout 900 python bench.py 2>&1 | tail -1
+done
+echo "CAPTURE_DONE"
