@@ -2,13 +2,14 @@
 //
 // Replaces the reference's C++ DataLoader machinery
 // (paddle/fluid/operators/reader/blocking_queue.h + buffered_reader.cc):
-// an mmap'd fixed-record reader, epoch shuffling (xoshiro PRNG), a
-// multi-threaded batch-assembly pool, and a bounded prefetch queue the
-// Python DataLoader drains via ctypes. Keeps TPU host CPUs feeding HBM
-// without the GIL in the hot path.
+// mmap'd record readers (fixed-size and varlen), epoch shuffling
+// (xoshiro PRNG), a multi-threaded batch-assembly pool, and a bounded
+// prefetch queue the Python DataLoader drains via ctypes. Keeps TPU host
+// CPUs feeding HBM without the GIL in the hot path.
 //
 // Build: make -C paddle_tpu/csrc  → libptio.so (ctypes, no pybind11).
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -62,37 +63,64 @@ struct RecordFile {
   size_t n_records = 0;
 };
 
-// ----------------------------------------------------------- pipeline
-struct Batch {
-  std::vector<uint8_t> buf;
-  int64_t n = 0;      // samples in batch
-  int64_t seq = 0;    // ordering key
+// .ptvr layout: "PTVR" u32 version, u64 n, u64 offsets[n+1] (relative to
+// the data region start), data blob. Offsets are validated against the
+// mapped length on open — a truncated/corrupt file fails cleanly.
+struct VarRecordFile {
+  int fd = -1;
+  const uint8_t* map = nullptr;
+  size_t bytes = 0;
+  const uint64_t* offsets = nullptr;  // n+1 entries
+  const uint8_t* data = nullptr;
+  size_t n_records = 0;
+  size_t max_record = 0;
 };
 
-struct Pipeline {
-  RecordFile* rf = nullptr;
+// ----------------------------------------------------------- pipeline core
+struct Batch {
+  std::vector<uint8_t> buf;
+  std::vector<int64_t> sizes;  // per-record byte counts (varlen only)
+  int64_t n = 0;               // samples in batch
+  int64_t seq = 0;             // ordering key
+};
+
+// Shared threaded prefetch machinery: epoch shuffle, worker pool, bounded
+// ordered-emit queue. Subclasses provide the record count and the
+// per-batch copy. Concurrency invariants:
+//   * stop.store happens under mu before notifying — a worker that has
+//     evaluated its wait predicate but not yet slept would otherwise
+//     miss the wakeup and the join would hang;
+//   * a producer holding the NEXT in-order batch may exceed `capacity`,
+//     otherwise out-of-order completions can fill the queue while the
+//     consumer waits for exactly that batch — mutual deadlock.
+struct PipelineCore {
   int64_t batch_size = 0;
   bool shuffle = false;
   bool drop_last = true;
   uint64_t seed = 0;
   int64_t capacity = 4;
 
-  std::vector<uint64_t> order;       // shuffled indices for the epoch
+  std::vector<uint64_t> order;  // shuffled indices for the epoch
   std::atomic<int64_t> next_batch{0};
   int64_t n_batches = 0;
 
-  std::deque<Batch> queue;           // completed batches (ordered pop)
-  int64_t next_emit = 0;             // next seq to hand to python
+  std::deque<Batch> queue;  // completed batches (ordered pop)
+  int64_t next_emit = 0;    // next seq to hand to python (guarded by mu)
   std::mutex mu;
-  std::condition_variable cv_room;   // producers wait for queue room
-  std::condition_variable cv_data;   // consumer waits for next_emit batch
+  std::condition_variable cv_room;  // producers wait for queue room
+  std::condition_variable cv_data;  // consumer waits for next_emit batch
   std::atomic<bool> stop{false};
   std::vector<std::thread> workers;
 
-  ~Pipeline() { shutdown(); }
+  virtual ~PipelineCore() { shutdown(); }
+  virtual size_t n_records() const = 0;
+  virtual void assemble(int64_t lo, int64_t hi, Batch* out) = 0;
 
   void shutdown() {
-    stop.store(true);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop.store(true);
+    }
     cv_room.notify_all();
     cv_data.notify_all();
     for (auto& t : workers)
@@ -100,21 +128,26 @@ struct Pipeline {
     workers.clear();
   }
 
+  int64_t batches_for(size_t n) const {
+    if (batch_size <= 0) return 0;
+    return drop_last ? (int64_t)(n / batch_size)
+                     : (int64_t)((n + batch_size - 1) / batch_size);
+  }
+
   void start_epoch(uint64_t epoch, int n_threads) {
     shutdown();
     stop.store(false);
-    size_t n = rf->n_records;
+    size_t n = n_records();
     order.resize(n);
     for (size_t i = 0; i < n; i++) order[i] = i;
-    if (shuffle) {
+    if (shuffle && n > 1) {
       Xoshiro256 rng(seed * 2654435761ull + epoch + 1);
       for (size_t i = n - 1; i > 0; i--) {
         size_t j = rng.next() % (i + 1);
         std::swap(order[i], order[j]);
       }
     }
-    n_batches = drop_last ? (int64_t)(n / batch_size)
-                          : (int64_t)((n + batch_size - 1) / batch_size);
+    n_batches = batches_for(n);
     next_batch.store(0);
     next_emit = 0;
     queue.clear();
@@ -123,7 +156,6 @@ struct Pipeline {
   }
 
   void work() {
-    const size_t rb = rf->record_bytes;
     while (!stop.load()) {
       int64_t b = next_batch.fetch_add(1);
       if (b >= n_batches) return;
@@ -132,13 +164,11 @@ struct Pipeline {
       Batch out;
       out.n = hi - lo;
       out.seq = b;
-      out.buf.resize((size_t)(hi - lo) * rb);
-      for (int64_t i = lo; i < hi; i++)
-        std::memcpy(out.buf.data() + (size_t)(i - lo) * rb,
-                    rf->data + order[(size_t)i] * rb, rb);
+      assemble(lo, hi, &out);
       std::unique_lock<std::mutex> lk(mu);
-      cv_room.wait(lk, [this] {
-        return stop.load() || (int64_t)queue.size() < capacity;
+      cv_room.wait(lk, [this, &out] {
+        return stop.load() || (int64_t)queue.size() < capacity ||
+               out.seq == next_emit;  // in-order batch never blocks
       });
       if (stop.load()) return;
       queue.push_back(std::move(out));
@@ -146,15 +176,19 @@ struct Pipeline {
     }
   }
 
-  // Returns samples copied (0 → epoch done), -1 on shutdown.
-  int64_t next(uint8_t* dst) {
+  // dst: batch bytes; sizes: per-record byte counts (null for the
+  // fixed-record path). Returns samples copied (0 → epoch done), -1 on
+  // shutdown.
+  int64_t next(uint8_t* dst, int64_t* sizes) {
     std::unique_lock<std::mutex> lk(mu);
     for (;;) {
       if (next_emit >= n_batches) return 0;
-      // find batch with seq == next_emit
       for (auto it = queue.begin(); it != queue.end(); ++it) {
         if (it->seq == next_emit) {
           std::memcpy(dst, it->buf.data(), it->buf.size());
+          if (sizes)
+            for (size_t i = 0; i < it->sizes.size(); i++)
+              sizes[i] = it->sizes[i];
           int64_t n = it->n;
           queue.erase(it);
           next_emit++;
@@ -164,6 +198,43 @@ struct Pipeline {
       }
       if (stop.load()) return -1;
       cv_data.wait(lk);
+    }
+  }
+};
+
+struct FixedPipeline : PipelineCore {
+  RecordFile* rf = nullptr;
+  ~FixedPipeline() override { shutdown(); }
+  size_t n_records() const override { return rf->n_records; }
+  void assemble(int64_t lo, int64_t hi, Batch* out) override {
+    const size_t rb = rf->record_bytes;
+    out->buf.resize((size_t)(hi - lo) * rb);
+    for (int64_t i = lo; i < hi; i++)
+      std::memcpy(out->buf.data() + (size_t)(i - lo) * rb,
+                  rf->data + order[(size_t)i] * rb, rb);
+  }
+};
+
+struct VarPipeline : PipelineCore {
+  VarRecordFile* rf = nullptr;
+  ~VarPipeline() override { shutdown(); }
+  size_t n_records() const override { return rf->n_records; }
+  void assemble(int64_t lo, int64_t hi, Batch* out) override {
+    out->sizes.reserve((size_t)(hi - lo));
+    size_t total = 0;
+    for (int64_t i = lo; i < hi; i++) {
+      uint64_t r = order[(size_t)i];
+      size_t sz = (size_t)(rf->offsets[r + 1] - rf->offsets[r]);
+      out->sizes.push_back((int64_t)sz);
+      total += sz;
+    }
+    out->buf.resize(total);
+    size_t w = 0;
+    for (int64_t i = lo; i < hi; i++) {
+      uint64_t r = order[(size_t)i];
+      size_t sz = (size_t)(rf->offsets[r + 1] - rf->offsets[r]);
+      std::memcpy(out->buf.data() + w, rf->data + rf->offsets[r], sz);
+      w += sz;
     }
   }
 };
@@ -210,7 +281,7 @@ void ptio_close_records(void* handle) {
 void* ptio_pipeline_create(void* records, int64_t batch_size, int shuffle,
                            int drop_last, uint64_t seed, int64_t capacity) {
   if (!records) return nullptr;
-  auto* p = new Pipeline();
+  auto* p = new FixedPipeline();
   p->rf = static_cast<RecordFile*>(records);
   p->batch_size = batch_size;
   p->shuffle = shuffle != 0;
@@ -222,20 +293,123 @@ void* ptio_pipeline_create(void* records, int64_t batch_size, int shuffle,
 
 void ptio_pipeline_start_epoch(void* pipeline, uint64_t epoch, int n_threads) {
   if (!pipeline) return;
-  static_cast<Pipeline*>(pipeline)->start_epoch(
+  static_cast<FixedPipeline*>(pipeline)->start_epoch(
       epoch, n_threads > 0 ? n_threads : 2);
 }
 
 int64_t ptio_pipeline_num_batches(void* pipeline) {
-  return pipeline ? static_cast<Pipeline*>(pipeline)->n_batches : -1;
+  if (!pipeline) return -1;
+  auto* p = static_cast<FixedPipeline*>(pipeline);
+  return p->batches_for(p->n_records());
 }
 
 int64_t ptio_pipeline_next(void* pipeline, uint8_t* dst) {
-  return pipeline ? static_cast<Pipeline*>(pipeline)->next(dst) : -1;
+  return pipeline ? static_cast<FixedPipeline*>(pipeline)->next(dst, nullptr)
+                  : -1;
 }
 
 void ptio_pipeline_destroy(void* pipeline) {
-  delete static_cast<Pipeline*>(pipeline);
+  delete static_cast<FixedPipeline*>(pipeline);
+}
+
+// ----------------------------------------------------------- varlen API
+void* ptio_open_varlen(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < 16) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* p = mmap(nullptr, (size_t)st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (p == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  const uint8_t* m = static_cast<const uint8_t*>(p);
+  size_t len = (size_t)st.st_size;
+  auto fail = [&]() -> void* {
+    munmap(p, len);
+    ::close(fd);
+    return nullptr;
+  };
+  if (std::memcmp(m, "PTVR", 4) != 0) return fail();
+  uint64_t n;
+  std::memcpy(&n, m + 8, 8);
+  // overflow-safe: the index alone needs (n+1)*8 bytes inside the file
+  if (n >= (len - 16) / 8) return fail();
+  size_t header = 16 + ((size_t)n + 1) * 8;
+  if (len < header) return fail();
+  const uint64_t* offs = reinterpret_cast<const uint64_t*>(m + 16);
+  size_t data_len = len - header;
+  // validate: monotone offsets ending inside the data region
+  if (offs[0] != 0) return fail();
+  for (uint64_t i = 0; i < n; i++)
+    if (offs[i + 1] < offs[i] || offs[i + 1] > data_len) return fail();
+  auto* rf = new VarRecordFile();
+  rf->fd = fd;
+  rf->map = m;
+  rf->bytes = len;
+  rf->offsets = offs;
+  rf->data = m + header;
+  rf->n_records = (size_t)n;
+  size_t mx = 0;
+  for (uint64_t i = 0; i < n; i++)
+    mx = std::max(mx, (size_t)(offs[i + 1] - offs[i]));
+  rf->max_record = mx;
+  madvise(p, len, MADV_WILLNEED);
+  return rf;
+}
+
+int64_t ptio_varlen_num_records(void* h) {
+  return h ? (int64_t)static_cast<VarRecordFile*>(h)->n_records : -1;
+}
+
+int64_t ptio_varlen_max_record(void* h) {
+  return h ? (int64_t)static_cast<VarRecordFile*>(h)->max_record : -1;
+}
+
+void ptio_close_varlen(void* h) {
+  if (!h) return;
+  auto* rf = static_cast<VarRecordFile*>(h);
+  munmap(const_cast<uint8_t*>(rf->map), rf->bytes);
+  ::close(rf->fd);
+  delete rf;
+}
+
+void* ptio_varlen_pipeline_create(void* records, int64_t batch_size,
+                                  int shuffle, int drop_last, uint64_t seed,
+                                  int64_t capacity) {
+  if (!records) return nullptr;
+  auto* p = new VarPipeline();
+  p->rf = static_cast<VarRecordFile*>(records);
+  p->batch_size = batch_size;
+  p->shuffle = shuffle != 0;
+  p->drop_last = drop_last != 0;
+  p->seed = seed;
+  p->capacity = capacity > 0 ? capacity : 4;
+  return p;
+}
+
+void ptio_varlen_pipeline_start_epoch(void* pl, uint64_t epoch,
+                                      int n_threads) {
+  if (!pl) return;
+  static_cast<VarPipeline*>(pl)->start_epoch(epoch,
+                                             n_threads > 0 ? n_threads : 2);
+}
+
+int64_t ptio_varlen_pipeline_num_batches(void* pl) {
+  if (!pl) return -1;
+  auto* p = static_cast<VarPipeline*>(pl);
+  return p->batches_for(p->n_records());
+}
+
+int64_t ptio_varlen_pipeline_next(void* pl, uint8_t* dst, int64_t* sizes) {
+  return pl ? static_cast<VarPipeline*>(pl)->next(dst, sizes) : -1;
+}
+
+void ptio_varlen_pipeline_destroy(void* pl) {
+  delete static_cast<VarPipeline*>(pl);
 }
 
 // ----------------------------------------------------------- staging pool

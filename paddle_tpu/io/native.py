@@ -112,9 +112,9 @@ class NativeDataLoader:
                              dtype=dataset.dtype)
 
     def __len__(self):
-        lib = _load()
-        lib.ptio_pipeline_start_epoch(self._p, self._epoch, 0)
-        return lib.ptio_pipeline_num_batches(self._p)
+        # pure count (never touches epoch state — calling len() mid-
+        # iteration must not restart the pipeline)
+        return _load().ptio_pipeline_num_batches(self._p)
 
     def __iter__(self):
         lib = _load()
@@ -131,6 +131,139 @@ class NativeDataLoader:
         try:
             if self._p:
                 _load().ptio_pipeline_destroy(self._p)
+                self._p = None
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------- varlen
+def _load_varlen():
+    lib = _load()
+    if getattr(lib, "_varlen_bound", False):
+        return lib
+    lib.ptio_open_varlen.restype = ctypes.c_void_p
+    lib.ptio_open_varlen.argtypes = [ctypes.c_char_p]
+    lib.ptio_varlen_num_records.restype = ctypes.c_int64
+    lib.ptio_varlen_num_records.argtypes = [ctypes.c_void_p]
+    lib.ptio_varlen_max_record.restype = ctypes.c_int64
+    lib.ptio_varlen_max_record.argtypes = [ctypes.c_void_p]
+    lib.ptio_close_varlen.argtypes = [ctypes.c_void_p]
+    lib.ptio_varlen_pipeline_create.restype = ctypes.c_void_p
+    lib.ptio_varlen_pipeline_create.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int64]
+    lib.ptio_varlen_pipeline_start_epoch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.ptio_varlen_pipeline_num_batches.restype = ctypes.c_int64
+    lib.ptio_varlen_pipeline_num_batches.argtypes = [ctypes.c_void_p]
+    lib.ptio_varlen_pipeline_next.restype = ctypes.c_int64
+    lib.ptio_varlen_pipeline_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.ptio_varlen_pipeline_destroy.argtypes = [ctypes.c_void_p]
+    lib._varlen_bound = True
+    return lib
+
+
+def write_varlen_records(path, records):
+    """Pack an iterable of bytes-like records into a .ptvr file
+    ("PTVR" + u32 version + u64 n + u64 offsets[n+1] + blob)."""
+    import struct
+    blobs = [bytes(memoryview(np.ascontiguousarray(r)).cast("B"))
+             if isinstance(r, np.ndarray) else bytes(r) for r in records]
+    offs = [0]
+    for b in blobs:
+        offs.append(offs[-1] + len(b))
+    with open(path, "wb") as f:
+        f.write(b"PTVR")
+        f.write(struct.pack("<I", 1))
+        f.write(struct.pack("<Q", len(blobs)))
+        f.write(np.asarray(offs, np.uint64).tobytes())
+        for b in blobs:
+            f.write(b)
+    return len(blobs)
+
+
+class VarlenRecordDataset:
+    """Variable-length binary record dataset (native mmap; validated
+    index — the serving/LLM token-sequence layout the fixed-record path
+    can't express)."""
+
+    def __init__(self, path):
+        lib = _load_varlen()
+        self._h = lib.ptio_open_varlen(str(path).encode())
+        if not self._h:
+            raise IOError(f"cannot open varlen record file {path} "
+                          f"(missing, truncated, or corrupt index)")
+        self._n = lib.ptio_varlen_num_records(self._h)
+        self.max_record = lib.ptio_varlen_max_record(self._h)
+
+    def __len__(self):
+        return self._n
+
+    def close(self):
+        if self._h:
+            _load_varlen().ptio_close_varlen(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeVarlenLoader:
+    """Prefetching loader over variable-length records.
+
+    Yields lists of uint8 arrays (one per record, exact sizes); pass
+    `decode` (e.g. lambda b: np.frombuffer(b, np.int32)) to map bytes
+    to samples in the worker-free consumer loop.
+    """
+
+    def __init__(self, dataset: VarlenRecordDataset, batch_size=1,
+                 shuffle=False, drop_last=True, seed=0, num_threads=2,
+                 capacity=8, decode=None):
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.num_threads = num_threads
+        self.decode = decode
+        lib = _load_varlen()
+        self._p = lib.ptio_varlen_pipeline_create(
+            dataset._h, batch_size, 1 if shuffle else 0,
+            1 if drop_last else 0, seed, capacity)
+        self._epoch = 0
+        self._buf = np.empty(batch_size * max(int(dataset.max_record), 1),
+                             np.uint8)
+        self._sizes = np.empty(batch_size, np.int64)
+
+    def __len__(self):
+        # pure count (never touches epoch state)
+        return _load_varlen().ptio_varlen_pipeline_num_batches(self._p)
+
+    def __iter__(self):
+        lib = _load_varlen()
+        lib.ptio_varlen_pipeline_start_epoch(self._p, self._epoch,
+                                             self.num_threads)
+        self._epoch += 1
+        bptr = self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        sptr = self._sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+        while True:
+            n = lib.ptio_varlen_pipeline_next(self._p, bptr, sptr)
+            if n <= 0:
+                break
+            out, off = [], 0
+            for i in range(n):
+                sz = int(self._sizes[i])
+                rec = np.array(self._buf[off:off + sz], copy=True)
+                off += sz
+                out.append(self.decode(rec) if self.decode else rec)
+            yield out
+
+    def __del__(self):
+        try:
+            if self._p:
+                _load_varlen().ptio_varlen_pipeline_destroy(self._p)
                 self._p = None
         except Exception:
             pass
