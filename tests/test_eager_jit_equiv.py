@@ -117,3 +117,166 @@ def test_eager_equals_jit(name, fn, args):
     assert e.shape == j.shape, name
     assert e.dtype == j.dtype, name
     assert np.allclose(e, j, atol=1e-6, rtol=1e-6), name
+
+
+# -- round-2 breadth: manipulation / search / stat / logic families ------
+SWEEP2 = [
+    ("reshape", lambda a: a.reshape([4, 3]), [_r((3, 4))]),
+    ("flatten", lambda a: pt.flatten(a), [_r((3, 4))]),
+    ("squeeze", lambda a: pt.squeeze(a, [0]), [_r((1, 3, 4))]),
+    ("unsqueeze", lambda a: pt.unsqueeze(a, [1]), [_r((3, 4))]),
+    ("transpose", lambda a: pt.transpose(a, [1, 0]), [_r((3, 4))]),
+    ("concat", lambda a, b: pt.concat([a, b], 0), [_r((2, 4)), _r((3, 4), 1)]),
+    ("stack", lambda a, b: pt.stack([a, b], 0), [_r((3, 4)), _r((3, 4), 1)]),
+    ("split0", lambda a: pt.split(a, 2, 0)[0], [_r((4, 4))]),
+    ("chunk1", lambda a: pt.chunk(a, 2, 1)[1], [_r((4, 4))]),
+    ("tile", lambda a: pt.tile(a, [2, 1]), [_r((3, 4))]),
+    ("expand", lambda a: pt.expand(a, [3, 4]), [_r((1, 4))]),
+    ("broadcast_to", lambda a: pt.broadcast_to(a, [3, 4]), [_r((1, 4))]),
+    ("gather", lambda a: pt.gather(a, pt.to_tensor(np.array([0, 2]))),
+     [_r((3, 4))]),
+    ("index_select", lambda a: pt.index_select(
+        a, pt.to_tensor(np.array([1, 0])), axis=1), [_r((3, 4))]),
+    # masked_select / nonzero are host-side ops (data-dependent output
+    # shape — not jittable by design, like the reference's dynamic ops)
+    ("diff", lambda a: pt.diff(a, axis=1), [_r((3, 4))]),
+    ("roll", lambda a: pt.roll(a, 1, 0), [_r((3, 4))]),
+    ("flip", lambda a: pt.flip(a, [1]), [_r((3, 4))]),
+    ("rot90", lambda a: pt.rot90(a), [_r((3, 4))]),
+    ("take_along_axis", lambda a: pt.take_along_axis(
+        a, pt.to_tensor(np.zeros((3, 1), np.int64)), 1), [_r((3, 4))]),
+    ("repeat_interleave", lambda a: pt.repeat_interleave(a, 2, 0),
+     [_r((3, 4))]),
+    ("unbind0", lambda a: pt.unbind(a, 0)[0], [_r((3, 4))]),
+    ("pad", lambda a: pt.nn.functional.pad(a, [1, 1, 1, 1]),
+     [_r((1, 1, 3, 4))]),
+    ("moveaxis", lambda a: pt.moveaxis(a, 0, 1), [_r((3, 4))]),
+    ("tensordot", lambda a, b: pt.tensordot(a, b, 1),
+     [_r((3, 4)), _r((4, 5), 1)]),
+    ("searchsorted", lambda a: pt.searchsorted(
+        pt.to_tensor(np.array([0.0, 1.0, 2.0], np.float32)), a).astype("float32"),
+     [np.abs(_r((3, 4)))]),
+    ("argmax", lambda a: pt.argmax(a, 1).astype("float32"), [_r((3, 4))]),
+    ("argmin", lambda a: pt.argmin(a, 1).astype("float32"), [_r((3, 4))]),
+    ("argsort", lambda a: pt.argsort(a, 1).astype("float32"), [_r((3, 4))]),
+    ("sort", lambda a: pt.sort(a, 1), [_r((3, 4))]),
+    ("topk", lambda a: pt.topk(a, 2, 1)[0], [_r((3, 4))]),
+    ("kthvalue", lambda a: pt.kthvalue(a, 2, 1)[0], [_r((3, 4))]),
+    ("median", lambda a: pt.median(a, 1), [_r((3, 4))]),
+    ("quantile", lambda a: pt.quantile(a, 0.5, 1), [_r((3, 4))]),
+    ("mode", lambda a: pt.mode(a, 1)[0], [_r((3, 4))]),
+    ("count_nonzero", lambda a: pt.count_nonzero(a, 1).astype("float32"),
+     [_r((3, 4))]),
+    ("cumsum", lambda a: pt.cumsum(a, 1), [_r((3, 4))]),
+    ("cumprod", lambda a: pt.cumprod(a, 1), [_r((3, 4))]),
+    ("logcumsumexp", lambda a: pt.logcumsumexp(a, 1), [_r((3, 4))]),
+    ("logsumexp", lambda a: pt.logsumexp(a, 1), [_r((3, 4))]),
+    ("std", lambda a: pt.std(a, 1), [_r((3, 4))]),
+    ("var", lambda a: pt.var(a, 1), [_r((3, 4))]),
+    ("nanmean", lambda a: pt.nanmean(a, 1), [_r((3, 4))]),
+    ("nansum", lambda a: pt.nansum(a, 1), [_r((3, 4))]),
+    ("prod", lambda a: pt.prod(a, 1), [_r((3, 4))]),
+    ("amax", lambda a: pt.amax(a, 1), [_r((3, 4))]),
+    ("amin", lambda a: pt.amin(a, 1), [_r((3, 4))]),
+    ("where", lambda a, b: pt.where(a > 0, a, b),
+     [_r((3, 4)), _r((3, 4), 1)]),
+    ("equal", lambda a, b: pt.equal(a, b).astype("float32"),
+     [_r((3, 4)), _r((3, 4))]),
+    ("greater_than", lambda a, b: pt.greater_than(a, b).astype("float32"),
+     [_r((3, 4)), _r((3, 4), 1)]),
+    ("logical_and", lambda a, b: pt.logical_and(a > 0, b > 0)
+     .astype("float32"), [_r((3, 4)), _r((3, 4), 1)]),
+    ("isclose", lambda a, b: pt.isclose(a, b).astype("float32"),
+     [_r((3, 4)), _r((3, 4), 1)]),
+    ("isfinite", lambda a: pt.isfinite(a).astype("float32"), [_r((3, 4))]),
+    ("bucketize", lambda a: pt.bucketize(
+        a, pt.to_tensor(np.array([-1.0, 0.0, 1.0], np.float32)))
+     .astype("float32"), [_r((3, 4))]),
+    ("expm1", pt.expm1, [_r((3, 4))]),
+    ("log1p", lambda a: pt.log1p(a), [np.abs(_r((3, 4)))]),
+    ("atan2", pt.atan2, [_r((3, 4)), _r((3, 4), 1)]),
+    ("hypot", pt.hypot, [_r((3, 4)), _r((3, 4), 1)]),
+    ("fmax", pt.fmax, [_r((3, 4)), _r((3, 4), 1)]),
+    ("fmod", lambda a, b: pt.mod(a, b), [_r((3, 4)), _r((3, 4), 1, True)]),
+    ("reciprocal", pt.reciprocal, [_r((3, 4), 0, True)]),
+    ("square", pt.square, [_r((3, 4))]),
+    ("stanh", lambda a: pt.stanh(a), [_r((3, 4))]),
+    ("logit", lambda a: pt.logit(a * 0.4 + 0.5, eps=1e-6), [_r((3, 4))]),
+    ("nan_to_num", lambda a: pt.nan_to_num(a / a.abs().clip(0.2, None)),
+     [_r((3, 4))]),
+    ("outer", lambda a, b: pt.outer(a.flatten(), b.flatten()),
+     [_r((3,)), _r((4,), 1)]),
+    ("softmax_f", lambda a: pt.nn.functional.softmax(a, 1), [_r((3, 4))]),
+    ("log_softmax_f", lambda a: pt.nn.functional.log_softmax(a, 1),
+     [_r((3, 4))]),
+    ("layer_norm_f", lambda a: pt.nn.functional.layer_norm(
+        a, [4], weight=None, bias=None), [_r((3, 4))]),
+    ("one_hot", lambda a: pt.nn.functional.one_hot(
+        pt.to_tensor(np.array([0, 2, 1])), 3), [_r((1,))]),
+]
+
+
+@pytest.mark.parametrize("name,fn,args", SWEEP2, ids=[s[0] for s in SWEEP2])
+def test_eager_equals_jit_round2(name, fn, args):
+    tensors = [pt.to_tensor(a) for a in args]
+    eager = fn(*tensors)
+    jitted = pt.jit.to_static(fn)(*tensors)
+    e = eager.numpy() if hasattr(eager, "numpy") else np.asarray(eager)
+    j = jitted.numpy() if hasattr(jitted, "numpy") else np.asarray(jitted)
+    assert e.shape == j.shape and e.dtype == j.dtype, name
+    assert np.allclose(e, j, atol=1e-6, rtol=1e-6, equal_nan=True), name
+
+
+# -- tape backward vs jax.grad of the pure composition -------------------
+GRAD_SWEEP = [
+    ("mul_sum", lambda a, b: (a * b).sum(), 2),
+    ("matmul_mean", lambda a, b: (a @ b.t()).mean(), 2),
+    ("exp_tanh", lambda a: pt.tanh(pt.exp(a * 0.3)).sum(), 1),
+    ("softmax_pick", lambda a: pt.nn.functional.softmax(a, 1)[:, 0].sum(), 1),
+    ("norm_chain", lambda a: pt.linalg.norm(a + 1.0).sum(), 1),
+    ("logsumexp_g", lambda a: pt.logsumexp(a, 1).sum(), 1),
+    ("cumsum_g", lambda a: pt.cumsum(a, 1).sum(), 1),
+    ("where_g", lambda a: pt.where(a > 0, a * 2.0, a * 0.5).sum(), 1),
+    ("gather_g", lambda a: pt.index_select(
+        a, pt.to_tensor(np.array([0, 2])), axis=0).sum(), 1),
+    ("pad_g", lambda a: pt.nn.functional.pad(
+        a[None, None], [1, 1, 1, 1]).sum(), 1),
+    ("maxpool_g", lambda a: pt.nn.functional.max_pool2d(
+        a[None, None], 2).sum(), 1),
+    ("mean_std", lambda a: (pt.std(a, 1) + pt.mean(a, 1)).sum(), 1),
+    ("lerp_g", lambda a, b: pt.lerp(a, b, 0.7).sum(), 2),
+    ("silu_g", lambda a: pt.nn.functional.silu(a).sum(), 1),
+    ("gelu_g", lambda a: pt.nn.functional.gelu(a).sum(), 1),
+    ("division", lambda a, b: (a / (b.abs() + 1.0)).sum(), 2),
+    ("slice_g", lambda a: a[1:, :2].sum(), 1),
+    ("concat_g", lambda a, b: pt.concat([a, b], 0).sum(), 2),
+    ("transpose_g", lambda a: pt.transpose(a, [1, 0]).prod(), 1),
+    ("clip_g", lambda a: pt.clip(a, -0.5, 0.5).sum(), 1),
+]
+
+
+@pytest.mark.parametrize("name,fn,nargs", GRAD_SWEEP,
+                         ids=[s[0] for s in GRAD_SWEEP])
+def test_tape_grad_equals_jax_grad(name, fn, nargs):
+    """The eager tape's backward must agree with jax.grad of the same
+    composition (the compiled-path gradient) — the framework's two
+    gradient engines computing one derivative."""
+    import jax
+    from paddle_tpu._core.tensor import Tensor
+
+    arrs = [_r((3, 4), seed=i) for i in range(nargs)]
+    tensors = [pt.to_tensor(a, stop_gradient=False) for a in arrs]
+    out = fn(*tensors)
+    out.backward()
+    tape_grads = [t.grad.numpy() for t in tensors]
+
+    def pure(*raw):
+        ts = [Tensor(r) for r in raw]
+        o = fn(*ts)
+        return o._value.astype(np.float32).sum()
+
+    jax_grads = jax.grad(pure, argnums=tuple(range(nargs)))(*arrs)
+    for name_i, (tg, jg) in enumerate(zip(tape_grads, jax_grads)):
+        assert np.allclose(tg, np.asarray(jg), atol=1e-5, rtol=1e-5), \
+            f"{name} arg{name_i}: tape {tg.ravel()[:4]} vs " \
+            f"jax {np.asarray(jg).ravel()[:4]}"
