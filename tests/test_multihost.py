@@ -54,3 +54,28 @@ def test_single_process_launch_unchanged():
     env = build_env(1, 0, "127.0.0.1:9999", base_env={})
     assert "JAX_COORDINATOR_ADDRESS" not in env
     assert "JAX_NUM_PROCESSES" not in env
+
+
+def test_two_process_data_parallel_training():
+    """Beyond rendezvous: an actual 2-process data-parallel TRAINING run.
+    Batch sharded over a cross-process dp axis, GSPMD inserts the grad
+    psum over the process boundary, and both processes converge to the
+    exact single-process reference trajectory."""
+    port = _free_port()
+    child = os.path.join(HERE, "_mh_train_child.py")
+    from paddle_tpu.distributed.launch import build_env
+
+    procs = []
+    for rank in range(2):
+        env = build_env(2, rank, f"127.0.0.1:{port}", base_env=os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, child], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    digests = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
+        line = [l for l in out.splitlines() if l.startswith("TRAIN_OK")][0]
+        digests.append(line.split("digest=")[1])
+    assert digests[0] == digests[1], digests
