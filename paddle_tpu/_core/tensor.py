@@ -54,6 +54,10 @@ class Place:
 # active (pack, unpack) hook pairs — see autograd.saved_tensors_hooks
 _saved_tensor_hooks: list = []
 
+# optional per-op observer (amp.debugging stats/tensor-checker); None in
+# the hot path so the common case costs one None check per eager op
+_op_observer = None
+
 
 class TapeNode:
     """One recorded op. VJP is derived lazily via jax.vjp on the pure fn."""
@@ -390,6 +394,10 @@ def _apply(fn, kwargs, *args, name=None, multi=False, nondiff=()):
     out = fn(*raw, **kwargs) if kwargs else fn(*raw)
     is_multi = multi or isinstance(out, (tuple, list))
     outs = tuple(out) if is_multi else (out,)
+
+    if _op_observer is not None and not any(
+            _is_tracer(o) for o in outs if o is not None):
+        _op_observer(name or fn.__name__, outs)
 
     requires = grad_enabled() and any(
         isinstance(a, Tensor) and not a.stop_gradient for a in args

@@ -106,25 +106,6 @@ def decorate(models, optimizers=None, level="O1", dtype="float16",
     return models, optimizers
 
 
-class debugging:
-    @staticmethod
-    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
-        v = tensor._value if isinstance(tensor, Tensor) else tensor
-        finite = bool(jnp.all(jnp.isfinite(v.astype(jnp.float32))))
-        if not finite:
-            raise FloatingPointError(
-                f"check_numerics failed: non-finite values in {op_type}:{var_name}")
-        return tensor
-
-    @staticmethod
-    def enable_operator_stats_collection():
-        pass
-
-    @staticmethod
-    def disable_operator_stats_collection():
-        pass
-
-
 def is_float16_supported(device=None):
     """reference: amp/__init__.py — device fp16 capability. XLA:TPU
     computes fp16 (though bf16 is the native fast path); CPU reports
@@ -136,3 +117,9 @@ def is_float16_supported(device=None):
 def is_bfloat16_supported(device=None):
     """bf16 is TPU-native (MXU accumulates bf16 inputs in fp32)."""
     return True
+# full debugging module (DebugMode / TensorCheckerConfig / op stats);
+# import explicitly — a plain `from . import` would be skipped if any
+# attribute named `debugging` already existed
+import paddle_tpu.amp.debugging as _debugging_mod  # noqa: E402
+
+debugging = _debugging_mod

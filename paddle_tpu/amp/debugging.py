@@ -1,0 +1,171 @@
+"""paddle.amp.debugging (reference: python/paddle/amp/debugging.py):
+numeric-health tooling for mixed-precision runs — nan/inf checks,
+per-op stats collection, accuracy comparison between runs.
+
+Tape-native: op stats come from counting recorded TapeNodes; the tensor
+checker validates op outputs as they are recorded (eager only — inside
+jit, XLA arrays are traced; use utils.watchdog NaN monitors there).
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor, unwrap
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "check_numerics",
+    "enable_operator_stats_collection", "disable_operator_stats_collection",
+    "collect_operator_stats", "enable_tensor_checker",
+    "disable_tensor_checker", "compare_accuracy", "check_layer_numerics",
+]
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL_FOR_OVERFLOW = 2
+    CHECK_ALL = 3
+    CHECK_ALL_ABORT = 4
+    CHECK_ALL_ABORT_STOP = 5
+    DUMP_ALL = 6
+
+
+@dataclass
+class TensorCheckerConfig:
+    enable: bool = False
+    debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT
+    output_dir: str | None = None
+    checked_op_list: list = field(default_factory=list)
+    skipped_op_list: list = field(default_factory=list)
+    debug_step: tuple | None = None
+    stack_height_limit: int = 1
+
+
+_checker: TensorCheckerConfig | None = None
+_op_stats: dict | None = None
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None,
+                   stack_height_limit=1):
+    """Raise if the tensor contains nan/inf (reference check_numerics)."""
+    v = np.asarray(unwrap(tensor))
+    bad = ~np.isfinite(v)
+    if bad.any():
+        raise FloatingPointError(
+            f"check_numerics: {int(bad.sum())}/{v.size} non-finite values "
+            f"in {var_name or 'tensor'}"
+            f"{f' (op {op_type})' if op_type else ''}: "
+            f"nan={int(np.isnan(v).sum())} inf={int(np.isinf(v).sum())}")
+    return tensor
+
+
+def _record_op(name, outputs):
+    """Called by the tape on every recorded op (see _core/tensor._apply)."""
+    if _op_stats is not None:
+        _op_stats[name] = _op_stats.get(name, 0) + 1
+    if _checker is not None and _checker.enable:
+        if _checker.checked_op_list and name not in _checker.checked_op_list:
+            return
+        if name in (_checker.skipped_op_list or ()):
+            return
+        for o in outputs:
+            arr = np.asarray(o)
+            if not np.issubdtype(arr.dtype, np.floating):
+                continue
+            if not np.isfinite(arr).all():
+                msg = (f"tensor checker: op '{name}' produced non-finite "
+                       f"values")
+                if _checker.debug_mode in (
+                        DebugMode.CHECK_NAN_INF_AND_ABORT,
+                        DebugMode.CHECK_ALL_ABORT,
+                        DebugMode.CHECK_ALL_ABORT_STOP):
+                    raise FloatingPointError(msg)
+                print(f"[amp.debugging] {msg}")
+
+
+def _sync_observer():
+    from .._core import tensor as _t
+    _t._op_observer = _record_op if (_op_stats is not None or
+                                     _checker is not None) else None
+
+
+def enable_operator_stats_collection():
+    global _op_stats
+    _op_stats = {}
+    _sync_observer()
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    stats = _op_stats or {}
+    _op_stats = None
+    _sync_observer()
+    if stats:
+        print("op".ljust(28), "calls")
+        for k in sorted(stats, key=stats.get, reverse=True):
+            print(k.ljust(28), stats[k])
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    global _checker
+    config.enable = True
+    _checker = config
+    _sync_observer()
+
+
+def disable_tensor_checker():
+    global _checker
+    _checker = None
+    _sync_observer()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1.0, dump_all_tensors=False):
+    """Compare two runs' saved tensor dumps (npz dirs) and write a report
+    (reference compares fp16 vs fp32 run dumps)."""
+    import os
+    a = np.load(dump_path) if dump_path.endswith(".npz") else None
+    b = np.load(another_dump_path) if another_dump_path.endswith(".npz") \
+        else None
+    lines = []
+    if a is not None and b is not None:
+        for k in sorted(set(a.files) & set(b.files)):
+            diff = float(np.max(np.abs(a[k].astype(np.float64) -
+                                       b[k].astype(np.float64))))
+            lines.append(f"{k}\tmax_abs_diff={diff:.3e}")
+    with open(output_filename, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return lines
+
+
+def check_layer_numerics(func):
+    """Decorator: validate a Layer forward's inputs/outputs are finite."""
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for i, a in enumerate(args):
+            if isinstance(a, Tensor):
+                check_numerics(a, var_name=f"input{i}")
+        out = func(self, *args, **kwargs)
+        for i, o in enumerate(out if isinstance(out, (tuple, list))
+                              else [out]):
+            if isinstance(o, Tensor):
+                check_numerics(o, var_name=f"output{i}")
+        return out
+    return wrapper

@@ -91,3 +91,8 @@ def load(path, **kw):
     if str(path).endswith(".npy"):
         return Tensor(jnp.asarray(np.load(path))), 16000
     return backends.load(path, **kw)
+
+# rebind `features` from the legacy namespace class to the real module
+import paddle_tpu.audio.features as _features_mod  # noqa: E402
+
+features = _features_mod

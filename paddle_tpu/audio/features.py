@@ -1,0 +1,15 @@
+"""paddle.audio.features as a real module (reference: python/paddle/
+audio/features/layers.py). The Layer classes were defined on a nested
+namespace class in earlier rounds; lift them here and keep both access
+styles working (the parent rebinds `features` to this module)."""
+from __future__ import annotations
+
+import sys as _sys
+
+_cls = getattr(_sys.modules[__package__], "features")
+Spectrogram = _cls.Spectrogram
+MelSpectrogram = _cls.MelSpectrogram
+LogMelSpectrogram = _cls.LogMelSpectrogram
+MFCC = _cls.MFCC
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -112,3 +112,35 @@ def create_dct(n_mfcc, n_mels, norm="ortho"):
     if norm == "ortho":
         basis[0] /= np.sqrt(2.0)
     return Tensor(jnp.asarray(basis.T.astype(np.float32)))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    """reference: audio/functional/functional.py fft_frequencies."""
+    import jax.numpy as jnp
+    return Tensor(jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2)
+                  .astype(dtype))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    """reference: mel_frequencies — n_mels points evenly spaced on the
+    mel scale between f_min and f_max, back in Hz."""
+    import jax.numpy as jnp
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    return Tensor(jnp.asarray(mel_to_hz(mels, htk)).astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    """reference: power_to_db — 10*log10(S/ref) clipped to top_db."""
+    import jax.numpy as jnp
+    from .._core.tensor import apply as _apply
+
+    def fn(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+    return _apply(fn, spect, name="power_to_db")

@@ -262,3 +262,43 @@ class CallbackList:
                     getattr(c, name)(*args, **kwargs)
             return dispatch
         raise AttributeError(name)
+
+
+class WandbCallback(Callback):
+    """reference: callbacks/callbacks.py WandbCallback — logs metrics to
+    Weights & Biases. wandb is not in this offline image; the callback
+    degrades to a no-op with a one-time notice (same metrics flow through
+    VisualDL / history)."""
+
+    def __init__(self, project=None, entity=None, name=None, dir=None,
+                 mode=None, job_type=None, **kwargs):
+        self._cfg = dict(project=project, entity=entity, name=name,
+                         dir=dir, mode=mode, job_type=job_type, **kwargs)
+        self._run = None
+        self._warned = False
+
+    def _wandb(self):
+        try:
+            import wandb
+            return wandb
+        except ImportError:
+            if not self._warned:
+                print("[WandbCallback] wandb not installed; metrics are "
+                      "not forwarded (offline build)")
+                self._warned = True
+            return None
+
+    def on_train_begin(self, logs=None):
+        w = self._wandb()
+        if w is not None and self._run is None:
+            self._run = w.init(**{k: v for k, v in self._cfg.items()
+                                  if v is not None})
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._run is not None:
+            self._run.log(dict(logs or {}, epoch=epoch))
+
+    def on_train_end(self, logs=None):
+        if self._run is not None:
+            self._run.finish()
+            self._run = None

@@ -161,3 +161,26 @@ def stream_guard(stream):
 
 
 from . import cuda  # noqa: E402
+
+
+def get_cudnn_version():
+    """reference: device.get_cudnn_version — None when no cuDNN (always,
+    on a TPU build)."""
+    return None
+
+
+class IPUPlace:
+    """Another vendor's accelerator: importable for API parity, unusable
+    by design (see static.ipu_shard_guard)."""
+
+    def __init__(self, *a):
+        pass
+
+    def __repr__(self):
+        return "IPUPlace() [unsupported on the TPU build]"
+
+
+def set_stream(stream=None):
+    """reference: device.set_stream — XLA owns stream scheduling on TPU;
+    accepted and ignored (returns the previous 'stream', i.e. None)."""
+    return None

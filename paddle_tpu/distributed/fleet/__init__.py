@@ -246,3 +246,103 @@ def recompute(function, *args, **kwargs):
 
     ck = _jax.checkpoint(pure)
     return apply(ck, *(tensor_args + ptensors), name="recompute")
+
+
+# ---- remaining reference __all__ surface --------------------------------
+Fleet = _Fleet  # class name export (reference: base/fleet_base.Fleet)
+
+
+class Role:
+    """reference: fleet Role enum (PS-era: WORKER/SERVER)."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class CommunicateTopology:
+    """reference: fleet.base.topology.CommunicateTopology — named-axis
+    rank bookkeeping; here a thin view over the hybrid mesh axes."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    def world_size(self):
+        n = 1
+        for d in self._dims:
+            n *= d
+        return n
+
+    def get_rank(self, **axis_ranks):
+        rank, stride = 0, 1
+        for name, dim in zip(reversed(self._names), reversed(self._dims)):
+            rank += axis_ranks.get(name, 0) * stride
+            stride *= dim
+        return rank
+
+    def get_coord(self, rank):
+        coord = []
+        for name, dim in zip(reversed(self._names), reversed(self._dims)):
+            coord.append(rank % dim)
+            rank //= dim
+        return list(reversed(coord))
+
+
+class UtilBase:
+    """reference: fleet.UtilBase — rank-0 barrier/all-gather utilities."""
+
+    def barrier(self, comm_world="worker"):
+        from ..collective import barrier as _barrier
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..collective import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from ..collective import all_reduce, ReduceOp
+        from ..._core.tensor import Tensor
+        import numpy as _np
+        import jax.numpy as _jnp
+        op = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX,
+              "min": ReduceOp.MIN}.get(mode, ReduceOp.SUM)
+        t = input if isinstance(input, Tensor) else \
+            Tensor(_jnp.asarray(_np.asarray(input)))
+        out = all_reduce(t, op=op)
+        return out if isinstance(input, Tensor) else \
+            _np.asarray(out.numpy()).tolist()
+
+    def get_file_shard(self, files):
+        r, w = _env.get_rank(), max(_env.get_world_size(), 1)
+        return files[r::w]
+
+    def print_on_rank(self, message, rank_id=0):
+        if _env.get_rank() == rank_id:
+            print(message)
+
+
+_PS_DATAGEN_MSG = ("MultiSlot*DataGenerator feeds the parameter-server "
+                   "dataset pipeline — out of TPU scope (see "
+                   "distributed/ps.py); pack samples with io.DataLoader / "
+                   "io/native.py instead")
+
+
+class MultiSlotDataGenerator:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_PS_DATAGEN_MSG)
+
+
+class MultiSlotStringDataGenerator:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(_PS_DATAGEN_MSG)

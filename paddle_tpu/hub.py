@@ -31,3 +31,24 @@ def load(repo_dir, model, *args, source="local", **kwargs):
         raise RuntimeError("paddle_tpu.hub supports source='local' only (no network)")
     mod = _load_hubconf(repo_dir)
     return getattr(mod, model)(*args, **kwargs)
+
+
+def load_state_dict_from_url(url, model_dir=None, check_hash=False,
+                             file_name=None, map_location=None):
+    """reference: hub.load_state_dict_from_url. Zero-egress build: serve
+    from a local cache only — if the file named by the url basename is
+    already in model_dir (or PADDLE_HUB_DIR), load it; never download."""
+    import os
+    from .framework.io import load
+    base = file_name or os.path.basename(url.split("?")[0])
+    cand_dirs = [d for d in (model_dir, os.environ.get("PADDLE_HUB_DIR"),
+                             os.path.expanduser("~/.cache/paddle/hub"))
+                 if d]
+    for d in cand_dirs:
+        p = os.path.join(d, base)
+        if os.path.exists(p):
+            return load(p)
+    raise RuntimeError(
+        f"load_state_dict_from_url: no network egress in this build and "
+        f"'{base}' was not found in {cand_dirs}; place the file locally "
+        f"and retry")

@@ -20,3 +20,74 @@ def softmax_mask_fuse_upper_triangle(x):
         mask = jnp.tril(jnp.ones((s, k), bool))
         return jax.nn.softmax(jnp.where(mask, a, -1e30), axis=-1)
     return apply(fn, x, name="softmax_mask_fuse_upper_triangle")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate softmax_mask_fuse — softmax(x + mask) in one
+    fused XLA graph."""
+    import jax
+    from .._core.tensor import apply
+    return apply(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask,
+                 name="softmax_mask_fuse")
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate identity_loss (IPU-era loss marker)."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    return x
+
+
+# graph ops: the geometric module IS the implementation (reference moved
+# these from incubate to paddle.geometric; both names stay valid)
+from ..geometric import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_max, segment_min,
+    sample_neighbors as graph_sample_neighbors,
+    reindex_graph as graph_reindex,
+    send_u_recv as graph_send_recv,
+)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference: incubate.graph_khop_sampler — multi-hop neighbor
+    sampling; composed from per-hop sample_neighbors + reindex_graph.
+    Returns (edge_src, edge_dst, sample_index, reindex_x): edges in the
+    RENUMBERED id space, the subgraph's original node ids, and the
+    renumbered seed nodes — the reference's 4-tuple contract."""
+    import numpy as np
+    from ..geometric import sample_neighbors
+    from .._core.tensor import Tensor
+    import jax.numpy as jnp
+    seeds = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
+                       else input_nodes).astype(np.int64)
+    cur = seeds
+    edge_src_all, edge_dst_all = [], []
+    for size in sample_sizes:
+        nbr, cnt = sample_neighbors(row, colptr, Tensor(jnp.asarray(cur)),
+                                    sample_size=size)[:2]
+        dst = np.repeat(cur, np.asarray(cnt._value))
+        edge_src_all.append(np.asarray(nbr._value).astype(np.int64))
+        edge_dst_all.append(dst)
+        cur = np.unique(np.asarray(nbr._value).astype(np.int64))
+    src = np.concatenate(edge_src_all) if edge_src_all else \
+        np.zeros(0, np.int64)
+    dst = np.concatenate(edge_dst_all) if edge_dst_all else \
+        np.zeros(0, np.int64)
+    # renumber: seeds keep ids 0..len-1, new nodes by first appearance
+    fresh = np.concatenate([src, dst])
+    fresh = fresh[~np.isin(fresh, seeds)]
+    uniq, first = np.unique(fresh, return_index=True)
+    sample_index = np.concatenate([seeds, uniq[np.argsort(first)]])
+    sort_idx = np.argsort(sample_index, kind="stable")
+    lut_sorted = sample_index[sort_idx]
+    remap = lambda a: sort_idx[np.searchsorted(lut_sorted, a)]  # noqa: E731
+    return (Tensor(jnp.asarray(remap(src))),
+            Tensor(jnp.asarray(remap(dst))),
+            Tensor(jnp.asarray(sample_index)),
+            Tensor(jnp.asarray(remap(seeds))))
+
+
+from .. import inference  # noqa: E402,F401  (reference re-exports it)

@@ -210,3 +210,133 @@ flash_attention = _flash
 import paddle_tpu.incubate.nn.functional as _functional_mod  # noqa: E402
 
 functional = _functional_mod
+
+
+class FusedLinear(Layer):
+    """reference: incubate.nn.FusedLinear — matmul+bias in one kernel
+    (XLA fuses it; kept for API parity). transpose_weight stores the
+    weight as [out, in] (reference checkpoint layout) and transposes in
+    the fused matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from ...nn.layer.common import Linear
+        self.transpose_weight = transpose_weight
+        if transpose_weight:
+            from ...nn.initializer import XavierUniform, Constant
+            self.weight = self.create_parameter(
+                [out_features, in_features], attr=weight_attr,
+                default_initializer=XavierUniform())
+            self.bias = self.create_parameter(
+                [out_features], attr=bias_attr, is_bias=True,
+                default_initializer=Constant(0.0))
+            self._linear = None
+        else:
+            self._linear = Linear(in_features, out_features,
+                                  weight_attr=weight_attr,
+                                  bias_attr=bias_attr)
+            self.weight = self._linear.weight
+            self.bias = self._linear.bias
+
+    def forward(self, x):
+        if self._linear is not None:
+            return self._linear(x)
+        return _functional_mod.fused_linear(x, self.weight, self.bias,
+                                            transpose_weight=True)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: incubate.nn.FusedDropoutAdd — dropout(x) + y."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return _functional_mod.fused_dropout_add(
+            x, y, p=self.p, training=self.training, mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: incubate.nn.FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.ln_scale = self.create_parameter(
+            [embed_dim], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        return _functional_mod.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """reference: incubate.nn.FusedMultiTransformer — the whole pre-LN
+    decoder stack as one fused call (see functional.fused_multi_transformer)."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, epsilon=1e-5, **kw):
+        super().__init__()
+        from ...nn.initializer import Constant, XavierUniform
+        self.num_layers = num_layers
+        self.activation = activation
+        self.epsilon = epsilon
+        self.dropout_rate = dropout_rate
+        head_dim = embed_dim // num_heads
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        for i in range(num_layers):
+            def mk(shape, init=None, bias=False):
+                return self.create_parameter(
+                    shape, is_bias=bias,
+                    default_initializer=init or XavierUniform())
+            one, zero = Constant(1.0), Constant(0.0)
+            self.ln_scales.append(mk([embed_dim], one))
+            self.ln_biases.append(mk([embed_dim], zero, True))
+            self.qkv_weights.append(mk([3, num_heads, head_dim, embed_dim]))
+            self.qkv_biases.append(mk([3, num_heads, head_dim], zero, True))
+            self.linear_weights.append(mk([embed_dim, embed_dim]))
+            self.linear_biases.append(mk([embed_dim], zero, True))
+            self.ffn_ln_scales.append(mk([embed_dim], one))
+            self.ffn_ln_biases.append(mk([embed_dim], zero, True))
+            self.ffn1_weights.append(mk([embed_dim, dim_feedforward]))
+            self.ffn1_biases.append(mk([dim_feedforward], zero, True))
+            self.ffn2_weights.append(mk([dim_feedforward, embed_dim]))
+            self.ffn2_biases.append(mk([embed_dim], zero, True))
+            for nm, lst in [("ln_s", self.ln_scales), ("ln_b", self.ln_biases),
+                            ("qkv_w", self.qkv_weights), ("qkv_b", self.qkv_biases),
+                            ("lin_w", self.linear_weights), ("lin_b", self.linear_biases),
+                            ("fln_s", self.ffn_ln_scales), ("fln_b", self.ffn_ln_biases),
+                            ("f1_w", self.ffn1_weights), ("f1_b", self.ffn1_biases),
+                            ("f2_w", self.ffn2_weights), ("f2_b", self.ffn2_biases)]:
+                self.add_parameter(f"{nm}_{i}", lst[-1])
+
+    def forward(self, x, attn_mask=None, caches=None, **kw):
+        return _functional_mod.fused_multi_transformer(
+            x, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            epsilon=self.epsilon, dropout_rate=self.dropout_rate,
+            activation=self.activation,
+            training=self.training, cache_kvs=caches, attn_mask=attn_mask)

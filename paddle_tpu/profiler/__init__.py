@@ -151,3 +151,16 @@ class RecordEvent:
 
 def load_profiler_result(filename):
     raise NotImplementedError("load XPlane dumps with TensorBoard")
+
+
+class SummaryView:
+    """reference: profiler.SummaryView enum (table selection)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

@@ -224,3 +224,60 @@ class QuantizedLinear(Layer):
     def forward(self, x):
         return weight_only_linear(x, self.quant_weight, self.bias,
                                   self.quant_scale)
+
+
+class BaseObserver:
+    """reference: quantization/base_observer.py — collects activation
+    statistics during calibration; subclasses implement cal_thresholds."""
+
+    def __init__(self, quant_bits=8):
+        self.quant_bits = quant_bits
+        self._min = None
+        self._max = None
+
+    def observe(self, tensor):
+        import numpy as np
+        from .._core.tensor import unwrap as _uw
+        v = np.asarray(_uw(tensor))
+        lo, hi = float(v.min()), float(v.max())
+        self._min = lo if self._min is None else min(self._min, lo)
+        self._max = hi if self._max is None else max(self._max, hi)
+        return tensor
+
+    __call__ = observe
+
+    def cal_thresholds(self):
+        return self._min, self._max
+
+    def scales(self):
+        m = max(abs(self._min or 0.0), abs(self._max or 0.0))
+        return m / (2 ** (self.quant_bits - 1) - 1)
+
+
+class BaseQuanter(BaseObserver):
+    """reference: quantization/base_quanter.py — a fake-quant module the
+    QAT pass inserts; quantize-dequantize with the observed scale."""
+
+    def __call__(self, tensor):
+        import jax.numpy as jnp
+        from .._core.tensor import apply as _apply
+        self.observe(tensor)
+        s = self.scales() or 1e-8
+        qmax = 2 ** (self.quant_bits - 1) - 1
+
+        def fn(v):
+            q = jnp.clip(jnp.round(v / s), -qmax - 1, qmax)
+            return (q * s).astype(v.dtype)
+        return _apply(fn, tensor, name="fake_quant")
+
+
+def quanter(name):
+    """reference: quantization/factory.py quanter decorator — register a
+    quanter class under a config name."""
+    def decorator(cls):
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+    return decorator
+
+
+_QUANTER_REGISTRY = {}

@@ -41,17 +41,40 @@ def _todense(bcoo):
 
 
 class SparseCooTensor(Tensor):
-    def __init__(self, bcoo, stop_gradient=True):
+    def __init__(self, bcoo, stop_gradient=True, values_tensor=None):
+        """values_tensor: optional TAPE-CONNECTED Tensor holding the nnz
+        values (set by sparse.nn layers so gradients flow from sparse
+        outputs back to layer parameters); the BCOO always stores the
+        concrete snapshot."""
         super().__init__(_todense(bcoo), stop_gradient=stop_gradient)
         self._bcoo = bcoo
+        self._values_t = values_tensor
+        if values_tensor is not None and values_tensor._node is not None:
+            # dense view shares the producing op, so using the sparse
+            # output directly in a loss backprops too
+            from .._core.tensor import apply as _apply
+            idx = np.asarray(bcoo.indices)
+            shape = bcoo.shape
+            dense_t = _apply(
+                lambda v: jnp.zeros(shape, v.dtype).at[
+                    tuple(jnp.asarray(idx[:, d]) for d in range(idx.shape[1]))
+                ].set(v), values_tensor, name="sparse_to_dense")
+            self._replace(dense_t._value, dense_t._node, dense_t._out_idx)
+            self.stop_gradient = values_tensor.stop_gradient
 
     def indices(self):
         return Tensor(jnp.asarray(self._bcoo.indices.T))
 
     def values(self):
-        return Tensor(self._bcoo.data)
+        return self._values_t if self._values_t is not None \
+            else Tensor(self._bcoo.data)
 
     def to_dense(self):
+        if self._values_t is not None:
+            t = Tensor(self._value, stop_gradient=self.stop_gradient)
+            t._node = self._node
+            t._out_idx = self._out_idx
+            return t
         return Tensor(_todense(self._bcoo))
 
     def is_sparse(self):
@@ -394,3 +417,8 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     from ..linalg import pca_lowrank as _dense_pca
     return _dense_pca(Tensor(x._bcoo.todense()), q=q, center=center,
                       niter=niter)
+
+# rebind `nn` from the legacy namespace object to the real submodule
+import paddle_tpu.sparse.nn as _nn_mod  # noqa: E402
+
+nn = _nn_mod

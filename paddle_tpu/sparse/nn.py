@@ -1,0 +1,221 @@
+"""paddle.sparse.nn as a real module (reference: python/paddle/sparse/nn).
+
+TPU-first design note: XLA has no sparse conv kernels — and on the MXU
+dense convolution IS the fast path at the densities these layers see in
+practice. The layers therefore compute through the dense kernels and
+re-sparsify: regular conv/pool emit the nonzero pattern of the dense
+result; submanifold conv (SubmConv*) keeps the INPUT's active sites
+(the defining property of submanifold convolution). Batch norms
+normalize the nonzero values per channel, matching the reference's
+values-only semantics. Layouts are channels-last (NHWC / NDHWC), like
+the reference sparse ops.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .._core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..nn import functional as F
+from ..nn.initializer import KaimingUniform, Uniform
+
+_parent = _sys.modules[__package__]
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+           "MaxPool3D"]
+
+# activations from the parent's namespace object (same objects, both
+# access styles keep working)
+_legacy = getattr(_parent, "nn", None)
+ReLU = _legacy.ReLU if _legacy is not None else None
+ReLU6 = _legacy.ReLU6 if _legacy is not None else None
+LeakyReLU = _legacy.LeakyReLU if _legacy is not None else None
+Softmax = _legacy.Softmax if _legacy is not None else None
+
+
+def _to_sparse(dense_t, mask=None):
+    """Tape-connected dense Tensor → SparseCooTensor. The sparsity
+    pattern comes from the CONCRETE snapshot (sparse layers are eager —
+    data-dependent patterns cannot trace under jit, as in the reference);
+    the VALUES are gathered through the tape so layer parameters train."""
+    from jax.experimental import sparse as jsparse
+    from .._core.tensor import apply as _apply
+    arr = np.asarray(dense_t._value)
+    if mask is None:
+        site = (arr != 0).any(-1, keepdims=True)
+        mask = np.broadcast_to(site, arr.shape)
+    idx = np.stack(np.nonzero(mask))
+    gather = tuple(jnp.asarray(idx[d]) for d in range(idx.shape[0]))
+    values_t = _apply(lambda d: d[gather], dense_t, name="sparse_gather")
+    b = jsparse.BCOO((values_t._value, jnp.asarray(idx.T)),
+                     shape=arr.shape)
+    return _parent.SparseCooTensor(b, stop_gradient=dense_t.stop_gradient,
+                                   values_tensor=values_t)
+
+
+class _SparseConvBase(Layer):
+    NSP = 2  # spatial dims
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        n = self.NSP
+        ks = (kernel_size,) * n if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.kernel_size = ks
+        self.stride = (stride,) * n if isinstance(stride, int) \
+            else tuple(stride)
+        self.padding = padding
+        self.dilation = (dilation,) * n if isinstance(dilation, int) \
+            else tuple(dilation)
+        self.groups = groups
+        self.subm = subm
+        # kernel layout (spatial..., in/groups, out) — matches nn conv
+        self.weight = self.create_parameter(
+            list(ks) + [in_channels // groups, out_channels],
+            attr=weight_attr, default_initializer=KaimingUniform())
+        if bias_attr is not False:
+            bound = 1.0 / float(np.sqrt(in_channels * int(np.prod(ks))))
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        dense = x.to_dense() if hasattr(x, "to_dense") else x
+        fmt = "NHWC" if self.NSP == 2 else "NDHWC"
+        conv = F.conv2d if self.NSP == 2 else F.conv3d
+        out = conv(dense, self.weight, bias=self.bias, stride=self.stride,
+                   padding=self.padding, dilation=self.dilation,
+                   groups=self.groups, data_format=fmt)
+        if self.subm:
+            # submanifold: output active sites == input active sites
+            xin = np.asarray(dense._value if isinstance(dense, Tensor)
+                             else dense)
+            site = (xin != 0).any(-1, keepdims=True)
+            mask = np.broadcast_to(site, tuple(out.shape))
+            masked = out * Tensor(jnp.asarray(mask.astype(np.float32)))
+            return _to_sparse(masked, mask=mask)
+        return _to_sparse(out)
+
+
+class Conv2D(_SparseConvBase):
+    NSP = 2
+
+
+class Conv3D(_SparseConvBase):
+    NSP = 3
+
+
+class SubmConv2D(_SparseConvBase):
+    NSP = 2
+
+    def __init__(self, *a, **kw):
+        kw["subm"] = True
+        super().__init__(*a, **kw)
+
+
+class SubmConv3D(_SparseConvBase):
+    NSP = 3
+
+    def __init__(self, *a, **kw):
+        kw["subm"] = True
+        super().__init__(*a, **kw)
+
+
+class BatchNorm(Layer):
+    """Channel-wise batch norm over the NONZERO values only (reference
+    sparse BatchNorm semantics: stats from the active sites)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        from ..nn.initializer import Constant
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        from .._core.tensor import apply as _apply
+        b = x._bcoo
+        ch = jnp.asarray(np.asarray(b.indices)[:, -1])
+        C = self.weight._value.shape[0]
+        vals_in = x.values()                    # tape-connected if avail
+        training = self.training
+        eps = self.epsilon
+
+        def fn(v, w, beta, run_mu, run_var):
+            vf = v.astype(jnp.float32)
+            if training:
+                cnt = jnp.maximum(
+                    jax.ops.segment_sum(jnp.ones_like(vf), ch, C), 1.0)
+                mu = jax.ops.segment_sum(vf, ch, C) / cnt
+                var = jax.ops.segment_sum((vf - mu[ch]) ** 2, ch, C) / cnt
+            else:
+                mu, var = run_mu, run_var
+            out = (vf - mu[ch]) * jax.lax.rsqrt(var[ch] + eps)
+            return (out * w[ch] + beta[ch]).astype(v.dtype)
+
+        out_t = _apply(fn, vals_in, self.weight, self.bias,
+                       self._mean, self._variance, name="sparse_batch_norm")
+        if training:  # running stats from the concrete snapshot
+            vf = np.asarray(b.data, np.float32)
+            chn = np.asarray(b.indices)[:, -1]
+            mu = np.zeros(C, np.float32)
+            var = np.ones(C, np.float32)
+            for c in range(C):
+                vc = vf[chn == c]
+                if vc.size:
+                    mu[c] = vc.mean()
+                    var[c] = vc.var()
+            m = self.momentum
+            self._mean._replace(m * self._mean._value +
+                                (1 - m) * jnp.asarray(mu))
+            self._variance._replace(m * self._variance._value +
+                                    (1 - m) * jnp.asarray(var))
+        from jax.experimental import sparse as jsparse
+        nb = jsparse.BCOO((out_t._value, b.indices), shape=b.shape)
+        return _parent.SparseCooTensor(nb, stop_gradient=x.stop_gradient,
+                                       values_tensor=out_t)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica stats ride the GSPMD psum under pjit (same design as
+    dense SyncBatchNorm); single-process semantics equal BatchNorm."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        dense = x.to_dense() if hasattr(x, "to_dense") else x
+        out = F.max_pool3d(dense, self.kernel_size, self.stride,
+                           self.padding, data_format="NDHWC")
+        return _to_sparse(out)

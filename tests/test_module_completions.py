@@ -1,0 +1,171 @@
+"""Behavior tests for the final submodule completions (sparse.nn convs,
+amp.debugging, incubate.nn fused layers, quantization observers,
+audio features/functional, fleet topology)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+class TestSparseNN:
+    def _make_input(self):
+        rng = np.random.RandomState(0)
+        dense = np.zeros((1, 8, 8, 3), np.float32)
+        self.sites = [(0, 1, 2), (0, 4, 4), (0, 6, 1)]
+        for s in self.sites:
+            dense[s] = rng.randn(3)
+        self.dense = dense
+        return pt.sparse.sparse_coo_tensor(
+            np.stack(np.nonzero(dense)), dense[dense != 0],
+            shape=list(dense.shape))
+
+    def test_subm_conv_preserves_sites_and_matches_dense(self):
+        import importlib
+        snn = importlib.import_module("paddle_tpu.sparse.nn")
+        x = self._make_input()
+        conv = snn.SubmConv2D(3, 5, 3, padding=1)
+        yd = conv(x).to_dense().numpy()
+        out_sites = set(map(tuple, np.stack(
+            np.nonzero((yd != 0).any(-1))).T))
+        assert out_sites == set(self.sites)
+        ref = pt.nn.functional.conv2d(
+            pt.to_tensor(self.dense), conv.weight, bias=conv.bias,
+            padding=1, data_format="NHWC").numpy()
+        mask = (self.dense != 0).any(-1, keepdims=True)
+        assert np.allclose(yd, ref * mask, atol=1e-5)
+
+    def test_batchnorm_per_channel_stats(self):
+        import importlib
+        snn = importlib.import_module("paddle_tpu.sparse.nn")
+        x = self._make_input()
+        v = snn.BatchNorm(3)(x).values().numpy()
+        idx = np.stack(np.nonzero(self.dense)).T
+        for c in range(3):
+            vc = v[idx[:, -1] == c]
+            assert abs(vc.mean()) < 1e-4 and abs(vc.std() - 1) < 0.05
+
+    def test_conv3d_pool3d_shapes(self):
+        import importlib
+        snn = importlib.import_module("paddle_tpu.sparse.nn")
+        x3 = np.zeros((1, 4, 4, 4, 2), np.float32)
+        x3[0, 1, 2, 3] = [1.0, -1.0]
+        xs = pt.sparse.sparse_coo_tensor(
+            np.stack(np.nonzero(x3)), x3[x3 != 0], shape=list(x3.shape))
+        assert snn.Conv3D(2, 4, 3, padding=1)(xs).to_dense().numpy().shape \
+            == (1, 4, 4, 4, 4)
+        assert snn.MaxPool3D(2)(xs).to_dense().numpy().shape \
+            == (1, 2, 2, 2, 2)
+
+
+class TestAmpDebugging:
+    def test_op_stats_and_checker(self):
+        from paddle_tpu.amp import debugging as D
+        with D.collect_operator_stats():
+            x = pt.to_tensor(np.ones((2, 2)))
+            (x * 2 + 1).sum()
+        D.enable_tensor_checker(D.TensorCheckerConfig(enable=True))
+        try:
+            with pytest.raises(FloatingPointError):
+                pt.to_tensor(np.array([1.0, np.inf])) * 2
+        finally:
+            D.disable_tensor_checker()
+        # checker off: no raise
+        (pt.to_tensor(np.array([1.0, np.inf])) * 2)
+
+    def test_check_numerics(self):
+        from paddle_tpu.amp import debugging as D
+        with pytest.raises(FloatingPointError):
+            D.check_numerics(pt.to_tensor(np.array([1.0, np.nan])))
+        D.check_numerics(pt.to_tensor(np.array([1.0, 2.0])))
+
+
+class TestIncubateNNLayers:
+    def test_fused_layers(self):
+        from paddle_tpu.incubate import nn as inn
+        x = pt.to_tensor(np.random.RandomState(0).randn(2, 6, 16)
+                         .astype(np.float32))
+        assert inn.FusedLinear(16, 8)(x).shape == [2, 6, 8]
+        fda = inn.FusedDropoutAdd(0.3)
+        fda.eval()
+        assert np.allclose(fda(x, x).numpy(), 2 * x.numpy())
+        fb = inn.FusedBiasDropoutResidualLayerNorm(16, dropout_rate=0.0)
+        assert fb(x, x).shape == [2, 6, 16]
+        fmt = inn.FusedMultiTransformer(16, 4, 32, num_layers=2)
+        fmt.eval()
+        out = fmt(x)
+        assert out.shape == [2, 6, 16] and np.isfinite(out.numpy()).all()
+
+
+class TestQuantObservers:
+    def test_fake_quant_roundtrip(self):
+        from paddle_tpu.quantization import BaseQuanter, BaseObserver
+        x = pt.to_tensor(np.random.RandomState(0).randn(16)
+                         .astype(np.float32))
+        obs = BaseObserver(8)
+        obs(x)
+        lo, hi = obs.cal_thresholds()
+        assert lo <= hi
+        q = BaseQuanter(8)
+        out = q(x)
+        assert np.abs(out.numpy() - x.numpy()).max() < 0.05
+
+    def test_nn_quant_weight_only(self):
+        from paddle_tpu.nn.quant import weight_only_linear, llm_int8_linear
+        from paddle_tpu.quantization import weight_quantize
+        rng = np.random.RandomState(1)
+        x = pt.to_tensor(rng.randn(4, 16).astype(np.float32))
+        w = rng.randn(16, 8).astype(np.float32)
+        qw, sc = weight_quantize(pt.to_tensor(w))
+        for fn in (weight_only_linear, llm_int8_linear):
+            out = fn(x, qw, weight_scale=sc)
+            assert np.abs(out.numpy() - x.numpy() @ w).max() < 0.1
+
+
+class TestAudioFeaturesModule:
+    def test_layers_and_functional(self):
+        import importlib
+        feats = importlib.import_module("paddle_tpu.audio.features")
+        x = pt.to_tensor(np.random.RandomState(0).randn(1, 4000)
+                         .astype(np.float32))
+        mel = feats.MelSpectrogram(sr=8000, n_fft=256)(x)
+        assert mel.shape[1] == 64
+        db = pt.audio.functional.power_to_db(mel)
+        assert float(db.numpy().max()) <= float(db.numpy().min()) + 80.0 + 1
+        fr = pt.audio.functional.mel_frequencies(8, 0.0, 4000.0).numpy()
+        assert fr.shape == (8,) and (np.diff(fr) > 0).all()
+        ff = pt.audio.functional.fft_frequencies(8000, 256).numpy()
+        assert ff[0] == 0 and abs(ff[-1] - 4000) < 1e-3
+
+
+class TestFleetTopology:
+    def test_communicate_topology_roundtrip(self):
+        from paddle_tpu.distributed.fleet import CommunicateTopology
+        topo = CommunicateTopology(("data", "pipe", "model"), (2, 3, 4))
+        assert topo.world_size() == 24
+        for r in range(24):
+            coord = topo.get_coord(r)
+            back = topo.get_rank(data=coord[0], pipe=coord[1],
+                                 model=coord[2])
+            assert back == r
+
+
+class TestSparseNNGradients:
+    """Review regression: sparse conv/BN parameters must receive
+    gradients (values are gathered through the tape, not numpy)."""
+
+    def test_conv_bn_params_train(self):
+        import importlib
+        snn = importlib.import_module("paddle_tpu.sparse.nn")
+        rng = np.random.RandomState(0)
+        dense = np.zeros((1, 8, 8, 3), np.float32)
+        for s in [(0, 1, 2), (0, 4, 4), (0, 6, 1)]:
+            dense[s] = rng.randn(3)
+        x = pt.sparse.sparse_coo_tensor(
+            np.stack(np.nonzero(dense)), dense[dense != 0],
+            shape=list(dense.shape))
+        conv = snn.SubmConv2D(3, 5, 3, padding=1)
+        bn = snn.BatchNorm(5)
+        (bn(conv(x)).values() ** 2).sum().backward()
+        for p in (conv.weight, conv.bias, bn.weight, bn.bias):
+            assert p.grad is not None
+        assert np.abs(conv.weight.grad.numpy()).sum() > 0

@@ -42,6 +42,19 @@ MODULES = [
      "paddle_tpu.incubate.nn.functional"),
     ("text/__init__.py", "paddle_tpu.text"),
     ("audio/__init__.py", "paddle_tpu.audio"),
+    ("audio/functional/__init__.py", "paddle_tpu.audio.functional"),
+    ("audio/features/__init__.py", "paddle_tpu.audio.features"),
+    ("amp/debugging.py", "paddle_tpu.amp.debugging"),
+    ("nn/quant/__init__.py", "paddle_tpu.nn.quant"),
+    ("sparse/nn/__init__.py", "paddle_tpu.sparse.nn"),
+    ("callbacks.py", "paddle_tpu.callbacks"),
+    ("incubate/__init__.py", "paddle_tpu.incubate"),
+    ("incubate/nn/__init__.py", "paddle_tpu.incubate.nn"),
+    ("hub.py", "paddle_tpu.hub"),
+    ("device/__init__.py", "paddle_tpu.device"),
+    ("profiler/__init__.py", "paddle_tpu.profiler"),
+    ("quantization/__init__.py", "paddle_tpu.quantization"),
+    ("distributed/fleet/__init__.py", "paddle_tpu.distributed.fleet"),
 ]
 
 
