@@ -137,11 +137,15 @@ def disable_tensor_checker():
 def compare_accuracy(dump_path, another_dump_path, output_filename,
                      loss_scale=1.0, dump_all_tensors=False):
     """Compare two runs' saved tensor dumps (npz dirs) and write a report
-    (reference compares fp16 vs fp32 run dumps)."""
-    import os
-    a = np.load(dump_path) if dump_path.endswith(".npz") else None
-    b = np.load(another_dump_path) if another_dump_path.endswith(".npz") \
-        else None
+    (reference compares fp16 vs fp32 run dumps). Inputs: two .npz
+    archives of named tensors."""
+    if not (dump_path.endswith(".npz") and
+            another_dump_path.endswith(".npz")):
+        raise ValueError(
+            "compare_accuracy: pass two .npz tensor dumps (save runs with "
+            "np.savez); directory dumps are not supported in this build")
+    a = np.load(dump_path)
+    b = np.load(another_dump_path)
     lines = []
     if a is not None and b is not None:
         for k in sorted(set(a.files) & set(b.files)):

@@ -61,6 +61,10 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     from ..geometric import sample_neighbors
     from .._core.tensor import Tensor
     import jax.numpy as jnp
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler: return_eids=True is not implemented "
+            "(pass eids through per-hop sample_neighbors if needed)")
     seeds = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
                        else input_nodes).astype(np.int64)
     cur = seeds

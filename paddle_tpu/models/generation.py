@@ -104,3 +104,31 @@ def make_decode_step(forward_fn, max_len):
         nxt = _sample_logits(logits, temperature, top_k, top_p, key)
         return nxt, cache
     return jax.jit(step, static_argnums=(6, 7))
+
+
+def sample_logits_np(logits_row, temperature, top_k, top_p, rng=None):
+    """Host-side (numpy) twin of _sample_logits above — used by the
+    serving engine's per-request sampling (each request carries its own
+    seeded RNG, which the jit'd jax path cannot). Keep the two in sync:
+    temperature=0 → greedy; top_k then top_p filtering; same
+    include-crossing-token top_p convention."""
+    if temperature <= 0.0:
+        return int(np.argmax(logits_row))
+    logits = np.asarray(logits_row, np.float64) / temperature
+    k = int(top_k)
+    if k > 0:
+        k = min(k, logits.shape[-1])
+        kth = np.partition(logits, -k)[-k]
+        logits = np.where(logits < kth, -np.inf, logits)
+    probs = np.exp(logits - logits.max())
+    probs /= probs.sum()
+    if top_p < 1.0:
+        order = np.argsort(-probs)
+        csum = np.cumsum(probs[order])
+        cutoff = np.searchsorted(csum, top_p) + 1
+        keep = order[:cutoff]
+        mask = np.zeros_like(probs)
+        mask[keep] = probs[keep]
+        probs = mask / mask.sum()
+    rng = rng or np.random
+    return int(rng.choice(len(probs), p=probs))

@@ -189,14 +189,30 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
 # engine (host-side orchestration)
 # ---------------------------------------------------------------------------
 class Request:
-    def __init__(self, rid, prompt_ids, max_new_tokens=64, eos_id=None):
+    """One generation request. Per-request sampling params (reference:
+    PaddleNLP predictor SamplingParams): temperature=0 → greedy;
+    top_k/top_p restrict the candidate set before sampling."""
+
+    def __init__(self, rid, prompt_ids, max_new_tokens=64, eos_id=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None):
         self.rid = rid
         self.prompt = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
         self.eos_id = eos_id
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.rng = np.random.RandomState(seed) if seed is not None or \
+            temperature > 0 else None
         self.output = []
         self.slot = None
         self.next_token = None
+
+    def pick(self, logits_row):
+        """Select the next token from this request's logits row."""
+        from .generation import sample_logits_np
+        return sample_logits_np(logits_row, self.temperature, self.top_k,
+                                self.top_p, self.rng)
 
     @property
     def done(self):
@@ -362,11 +378,17 @@ class ServingEngine:
             self.lengths, jnp.asarray(tokens), jnp.asarray(active),
             self.config, self.page_size, use_pallas=self._use_pallas,
             interpret=self._interpret)
-        nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        # all-greedy fast path: argmax on device, transfer max_seqs ints;
+        # only sampling requests pull their [vocab] logits row to host
+        sampled = [s for s in active_slots
+                   if self._slots[s].temperature > 0.0]
+        greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))
+        rows = {s: np.asarray(logits[s]) for s in sampled}
         for s in active_slots:
             req = self._slots[s]
-            req.output.append(int(nxt[s]))
-            req.next_token = int(nxt[s])
+            tok = req.pick(rows[s]) if s in rows else int(greedy_nxt[s])
+            req.output.append(tok)
+            req.next_token = tok
             if req.done:
                 self.finished.append(req)
                 self._release(s)

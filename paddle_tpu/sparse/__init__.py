@@ -46,12 +46,13 @@ class SparseCooTensor(Tensor):
         values (set by sparse.nn layers so gradients flow from sparse
         outputs back to layer parameters); the BCOO always stores the
         concrete snapshot."""
-        super().__init__(_todense(bcoo), stop_gradient=stop_gradient)
-        self._bcoo = bcoo
-        self._values_t = values_tensor
-        if values_tensor is not None and values_tensor._node is not None:
-            # dense view shares the producing op, so using the sparse
-            # output directly in a loss backprops too
+        tape_connected = values_tensor is not None and \
+            values_tensor._node is not None
+        if tape_connected:
+            # build the dense snapshot THROUGH the tape (one scatter; the
+            # plain _todense would materialize the same array a second
+            # time), so using the sparse output directly in a loss
+            # backprops too
             from .._core.tensor import apply as _apply
             idx = np.asarray(bcoo.indices)
             shape = bcoo.shape
@@ -59,8 +60,14 @@ class SparseCooTensor(Tensor):
                 lambda v: jnp.zeros(shape, v.dtype).at[
                     tuple(jnp.asarray(idx[:, d]) for d in range(idx.shape[1]))
                 ].set(v), values_tensor, name="sparse_to_dense")
-            self._replace(dense_t._value, dense_t._node, dense_t._out_idx)
-            self.stop_gradient = values_tensor.stop_gradient
+            super().__init__(dense_t._value,
+                             stop_gradient=values_tensor.stop_gradient)
+            self._node = dense_t._node
+            self._out_idx = dense_t._out_idx
+        else:
+            super().__init__(_todense(bcoo), stop_gradient=stop_gradient)
+        self._bcoo = bcoo
+        self._values_t = values_tensor
 
     def indices(self):
         return Tensor(jnp.asarray(self._bcoo.indices.T))

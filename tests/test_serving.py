@@ -145,3 +145,45 @@ class TestServing:
         by_id = {r.rid: r.output for r in done}
         for i, ref in enumerate(refs):
             assert by_id[f"r{i}"] == ref
+
+
+class TestServingSampling:
+    def test_temperature_zero_equals_greedy(self, params):
+        prompt = [1, 5, 9, 3, 7]
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False)
+        eng.submit(Request("g", prompt, max_new_tokens=6, temperature=0.0))
+        ref = greedy_reference(params, prompt, 6)
+        assert eng.run()[0].output == ref
+
+    def test_sampled_decode_seeded_and_valid(self, params):
+        prompt = [2, 4, 6]
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False)
+        eng.submit(Request("s1", prompt, max_new_tokens=8, temperature=0.8,
+                           top_k=8, top_p=0.9, seed=123))
+        eng.submit(Request("s2", prompt, max_new_tokens=8, temperature=0.8,
+                           top_k=8, top_p=0.9, seed=123))
+        done = {r.rid: r for r in eng.run()}
+        # same seed + same prompt → identical stochastic decode
+        assert done["s1"].output == done["s2"].output
+        assert all(0 <= t < CFG.vocab_size for t in done["s1"].output)
+
+    def test_mixed_greedy_and_sampled_batch(self, params):
+        prompt = [1, 2, 3]
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False)
+        eng.submit(Request("g", prompt, max_new_tokens=5, temperature=0.0))
+        eng.submit(Request("s", prompt, max_new_tokens=5, temperature=1.0,
+                           seed=7))
+        done = {r.rid: r for r in eng.run()}
+        assert done["g"].output == greedy_reference(params, prompt, 5)
+        assert len(done["s"].output) == 5
+
+    def test_huge_top_k_clamped(self, params):
+        eng = ServingEngine(params, CFG, max_seqs=1, max_seq_len=64,
+                            page_size=8, use_pallas=False)
+        eng.submit(Request("k", [1, 2], max_new_tokens=4, temperature=0.9,
+                           top_k=10 ** 6, seed=0))
+        done = eng.run()
+        assert len(done[0].output) == 4
