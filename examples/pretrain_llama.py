@@ -9,6 +9,16 @@ failure detection, and the libptio-style packed-token data path.
 """
 from __future__ import annotations
 
+import os as _os
+
+_os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# default to CPU unless explicitly aimed at the chip: the axon TPU tunnel
+# comes and goes, and a wedged plugin otherwise kills backend auto-select
+if _os.environ.get("PT_EXAMPLE_TPU") != "1":
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 import argparse
 import time
 
