@@ -76,10 +76,31 @@ def _last_tpu_history():
 
 def main():
     import jax
-    if os.environ.get("PT_BENCH_CPU") == "1" or not _tpu_alive():
+    guarded_child = os.environ.get("_PT_BENCH_GUARDED") == "1"
+    if os.environ.get("PT_BENCH_CPU") == "1" or \
+            (not guarded_child and not _tpu_alive()):
         print("# TPU unreachable; benching CPU smoke fallback",
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
+    elif os.environ.get("_PT_BENCH_GUARDED") != "1":
+        # the probe passing does not guarantee compile/execute will —
+        # a half-wedged tunnel can hang AFTER device init, which would
+        # leave the driver with no output line at all. Run the real
+        # bench in a guarded child; on timeout fall back to CPU smoke.
+        import subprocess
+        env = dict(os.environ, _PT_BENCH_GUARDED="1")
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, timeout=int(os.environ.get(
+                                   "PT_BENCH_TIMEOUT", "1500")))
+            sys.exit(r.returncode)
+        except subprocess.TimeoutExpired:
+            print("# TPU bench hung past the watchdog; CPU smoke fallback",
+                  file=sys.stderr)
+            env = dict(os.environ, PT_BENCH_CPU="1")
+            sys.exit(subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env).returncode)
     import jax.numpy as jnp
     backend = jax.default_backend()
     on_tpu = backend not in ("cpu",)
