@@ -82,25 +82,29 @@ def main():
         print("# TPU unreachable; benching CPU smoke fallback",
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
-    elif os.environ.get("_PT_BENCH_GUARDED") != "1":
+    elif not guarded_child:
         # the probe passing does not guarantee compile/execute will —
-        # a half-wedged tunnel can hang AFTER device init, which would
-        # leave the driver with no output line at all. Run the real
-        # bench in a guarded child; on timeout fall back to CPU smoke.
+        # a half-wedged tunnel can hang (or die) AFTER device init, which
+        # would leave the driver with no output line at all. Run the real
+        # bench in a guarded child; on timeout OR crash fall back to the
+        # CPU smoke (which still surfaces last_tpu_measured).
         import subprocess
         env = dict(os.environ, _PT_BENCH_GUARDED="1")
         try:
             r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                                env=env, timeout=int(os.environ.get(
                                    "PT_BENCH_TIMEOUT", "1500")))
-            sys.exit(r.returncode)
+            if r.returncode == 0:
+                sys.exit(0)
+            print(f"# TPU bench child died rc={r.returncode}; "
+                  "CPU smoke fallback", file=sys.stderr)
         except subprocess.TimeoutExpired:
             print("# TPU bench hung past the watchdog; CPU smoke fallback",
                   file=sys.stderr)
-            env = dict(os.environ, PT_BENCH_CPU="1")
-            sys.exit(subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env).returncode)
+        env = dict(os.environ, PT_BENCH_CPU="1")
+        sys.exit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env).returncode)
     import jax.numpy as jnp
     backend = jax.default_backend()
     on_tpu = backend not in ("cpu",)
