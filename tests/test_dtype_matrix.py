@@ -166,3 +166,44 @@ class TestDefaultDtype:
     def test_explicit_float64_preserved(self):
         t = pt.to_tensor(np.zeros(3, np.float64))
         assert _name(t) == "float64"
+
+
+class TestLowPrecisionLayerForward:
+    """bf16/fp16 forward sweep over the core layers (TPU's native dtypes
+    must flow through without silent upcasts to fp32 outputs)."""
+
+    @pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+    def test_linear_norm_act_chain(self, dtype):
+        pt.seed(0)
+        net = pt.nn.Sequential(
+            pt.nn.Linear(16, 32), pt.nn.GELU(), pt.nn.LayerNorm(32),
+            pt.nn.Linear(32, 8))
+        net.to(dtype=dtype)
+        x = pt.to_tensor(np.random.RandomState(0).randn(4, 16)
+                         .astype(np.float32)).astype(dtype)
+        y = net(x)
+        assert str(y.dtype) == dtype, y.dtype
+        assert np.isfinite(np.asarray(y._value, np.float32)).all()
+
+    @pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+    def test_attention_block(self, dtype):
+        pt.seed(0)
+        mha = pt.nn.MultiHeadAttention(32, 4)
+        mha.to(dtype=dtype)
+        x = pt.to_tensor(np.random.RandomState(0).randn(2, 6, 32)
+                         .astype(np.float32)).astype(dtype)
+        y = mha(x, x, x)
+        assert str(y.dtype) == dtype
+        assert np.isfinite(np.asarray(y._value, np.float32)).all()
+
+    def test_bf16_matmul_accumulates_sanely(self):
+        """bf16 matmul on long contractions should stay close to fp32
+        (MXU-style fp32 accumulation, not bf16 accumulation)."""
+        rng = np.random.RandomState(0)
+        a = rng.randn(8, 2048).astype(np.float32)
+        b = rng.randn(2048, 8).astype(np.float32)
+        ref = a @ b
+        out = (pt.to_tensor(a).astype("bfloat16") @
+               pt.to_tensor(b).astype("bfloat16"))
+        err = np.abs(np.asarray(out._value, np.float32) - ref).max()
+        assert err < np.abs(ref).max() * 0.05, err
