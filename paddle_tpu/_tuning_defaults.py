@@ -1,0 +1,44 @@
+"""Single source of truth for tunable flash-kernel defaults and the
+effective-config normalizer.
+
+Used by three consumers that must agree byte-for-byte:
+  * paddle_tpu/ops/flash_attention.py — actual kernel block defaults
+  * tools/autotune.py                 — trial dedup key
+  * tests/test_perf_guard.py          — history grouping key
+
+Deliberately a leaf module with no jax imports; tools/ and tests/ load
+it by file path (importlib) to avoid paying for paddle_tpu/__init__.
+"""
+import os
+
+DEFAULT_FLASH_BLOCK_Q = 128
+DEFAULT_FLASH_BLOCK_K = 128
+
+
+def flash_block_q():
+    return int(os.environ.get("PT_FLASH_BLOCK_Q", DEFAULT_FLASH_BLOCK_Q))
+
+
+def flash_block_k():
+    return int(os.environ.get("PT_FLASH_BLOCK_K", DEFAULT_FLASH_BLOCK_K))
+
+
+def effective_knobs(entry):
+    """Normalize a history row / trial cfg dict to its EFFECTIVE tuning
+    knobs: absent/None block sizes mean the kernel defaults, and
+    absent/0/None n_micro all mean no gradient accumulation."""
+    return (int(entry.get("block_q") or DEFAULT_FLASH_BLOCK_Q),
+            int(entry.get("block_k") or DEFAULT_FLASH_BLOCK_K),
+            int(entry.get("n_micro") or 0))
+
+
+def load_by_path(repo_root):
+    """Helper-for-helpers: how tools/tests import this file without
+    triggering the package __init__ (documented here so the pattern
+    stays greppable)."""
+    import importlib.util
+    p = os.path.join(repo_root, "paddle_tpu", "_tuning_defaults.py")
+    spec = importlib.util.spec_from_file_location("_tuning_defaults", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

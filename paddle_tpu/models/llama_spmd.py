@@ -219,9 +219,38 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
                                     is_leaf=lambda x: isinstance(x, P))
 
     def step_fn(params, opt_state, step, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            params, batch, config, mesh if use_pp else None, n_micro, remat,
-            sp_axis)
+        if n_micro and n_micro > 1 and not use_pp:
+            # true gradient accumulation: scan over n_micro microbatches,
+            # summing fp32 grads. Peak activation memory drops ~n_micro×
+            # (one microbatch's activations live at a time) at the cost
+            # of a serial loop — can unlock a bigger global batch or a
+            # lighter remat policy. With pp, n_micro instead feeds the
+            # pipeline schedule (forward() above).
+            x, y = batch
+            assert x.shape[0] % n_micro == 0, (
+                f"batch {x.shape[0]} not divisible by n_micro={n_micro}")
+            mb = x.shape[0] // n_micro
+            xs = x.reshape(n_micro, mb, *x.shape[1:])
+            ys = y.reshape(n_micro, mb, *y.shape[1:])
+
+            def micro(acc, mb_batch):
+                acc_l, acc_g = acc
+                l, g = jax.value_and_grad(loss_fn)(
+                    params, mb_batch, config, None, None, remat, sp_axis)
+                acc_g = jax.tree_util.tree_map(
+                    lambda a, b: a + b.astype(jnp.float32), acc_g, g)
+                return (acc_l + l, acc_g), None
+
+            zero_g = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            (loss, grads), _ = lax.scan(micro, (jnp.float32(0.0), zero_g),
+                                        (xs, ys))
+            loss = loss / n_micro
+            grads = jax.tree_util.tree_map(lambda g: g / n_micro, grads)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, config, mesh if use_pp else None, n_micro,
+                remat, sp_axis)
         if clip_norm is not None:
             leaves = jax.tree_util.tree_leaves(grads)
             gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))

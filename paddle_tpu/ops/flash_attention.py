@@ -33,9 +33,12 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-# (8,128)-aligned tile sizes; overridable for on-chip tuning sweeps
-DEFAULT_BLOCK_Q = int(os.environ.get("PT_FLASH_BLOCK_Q", "128"))
-DEFAULT_BLOCK_K = int(os.environ.get("PT_FLASH_BLOCK_K", "128"))
+# (8,128)-aligned tile sizes; overridable for on-chip tuning sweeps.
+# Canonical defaults live in _tuning_defaults (shared with autotune +
+# perf guard so dedup/grouping stay in sync with the kernel).
+from paddle_tpu._tuning_defaults import flash_block_q, flash_block_k
+DEFAULT_BLOCK_Q = flash_block_q()
+DEFAULT_BLOCK_K = flash_block_k()
 # np.float32: a bare Python float lowers as an f64 constant inside Mosaic,
 # and v5e libtpu rejects 'tpu.truncf f64->f32' — keep all kernel consts f32.
 NEG_INF = np.float32(-1e30)

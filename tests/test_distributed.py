@@ -192,6 +192,51 @@ class TestPipeline:
                            np.asarray(g_pp["embed"]), atol=1e-4)
 
 
+class TestGradAccum:
+    def test_n_micro_matches_full_batch_step(self):
+        """make_train_step(n_micro=k) without pp == true grad
+        accumulation: same params/loss as the one-shot step."""
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models import llama_spmd as M
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               kv_heads=4, ffn=64)
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+        x = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 16)))
+        y = jnp.asarray(np.random.RandomState(1).randint(0, 64, (4, 16)))
+
+        outs = {}
+        for nm in (None, 2, 4):
+            params = M.init_params(cfg, seed=3)
+            opt = M.init_opt_state(params)
+            step = M.make_train_step(cfg, mesh, n_micro=nm, remat=False,
+                                     donate=False)
+            for i in range(2):
+                params, opt, loss = step(params, opt, jnp.asarray(i), (x, y))
+            outs[nm] = (params, float(loss))
+
+        for nm in (2, 4):
+            assert abs(outs[nm][1] - outs[None][1]) < 1e-5
+            a = np.asarray(outs[None][0]["layers"]["wq"], np.float32)
+            b = np.asarray(outs[nm][0]["layers"]["wq"], np.float32)
+            assert np.allclose(a, b, atol=1e-5), f"n_micro={nm}"
+
+    def test_n_micro_indivisible_raises(self):
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models import llama_spmd as M
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               kv_heads=4, ffn=64)
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+        params = M.init_params(cfg, seed=0)
+        opt = M.init_opt_state(params)
+        step = M.make_train_step(cfg, mesh, n_micro=3, remat=False,
+                                 donate=False)
+        x = jnp.zeros((4, 16), jnp.int32)
+        with pytest.raises(Exception):
+            step(params, opt, jnp.asarray(0), (x, x))
+
+
 class TestFleetAPI:
     def test_fleet_init_topology(self):
         from paddle_tpu.distributed import fleet

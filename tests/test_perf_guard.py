@@ -7,10 +7,18 @@ a >25% throughput drop. Skips until two comparable datapoints exist
 accumulate — CPU smoke numbers on shared machines are too noisy, so
 only TPU entries are guarded).
 """
+import importlib.util
 import json
 import os
 
 import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "_tuning_defaults",
+    os.path.join(_ROOT, "paddle_tpu", "_tuning_defaults.py"))
+_TD = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_TD)
 
 HIST = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "BENCH_HISTORY.jsonl")
@@ -40,10 +48,18 @@ def test_no_tpu_throughput_regression():
     # entries lacking the remat key ran the default remat=True, and the
     # metric string is a label (it once hard-coded the config), so
     # neither joins the grouping key in a way that would orphan history.
+    # block_q/block_k/n_micro joined the key in r3 (autotune sweeps
+    # write same-batch entries differing only in those knobs).
+    # effective_knobs (shared with autotune + the kernel defaults)
+    # normalizes absent/None to the kernel defaults so pre-r3 entries
+    # still compare against new same-config runs. A pallas_fallback run
+    # executed a different program — keep it out of normal groups.
     by_cfg = {}
     for e in tpu:
         by_cfg.setdefault((e.get("model", "llama"), e.get("batch"),
-                           e.get("seq"), e.get("remat", "True")),
+                           e.get("seq"), e.get("remat", "True"))
+                          + _TD.effective_knobs(e)
+                          + (bool(e.get("extra", {}).get("pallas_fallback")),),
                           []).append(e)
     comparable = [v for v in by_cfg.values() if len(v) >= 2]
     if not comparable:

@@ -1,0 +1,204 @@
+"""TPU-native auto-tuner (parity: reference auto_tuner subsystem,
+/root/reference/python/paddle/distributed/auto_tuner/tuner.py — a
+parallel-config/batch search harness; ours searches the knobs that
+matter on one TPU chip and persists the winner).
+
+Staged search over (batch, remat policy, flash block_q/block_k,
+n_micro) for the headline Llama pretrain step:
+
+  stage A: batch x remat coarse grid
+  stage B: flash block sizes at the stage-A winner
+  stage C: grad-accum microbatching at the stage-B winner
+
+Every trial is a guarded `bench.py` child (so a Mosaic rejection or OOM
+kills the trial, not the tuner) and appends to BENCH_HISTORY.jsonl via
+bench.py's own history hook.  The winner is written to TUNED.json after
+every stage (partial progress survives a mid-search tunnel death), and
+bench.py reads TUNED.json as its defaults.
+
+Run on a live chip:  python tools/autotune.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+TUNED = os.path.join(ROOT, "TUNED.json")
+
+TRIAL_TIMEOUT = int(os.environ.get("PT_TUNE_TRIAL_TIMEOUT", "600"))
+
+
+def _load_defaults():
+    import importlib.util
+    p = os.path.join(ROOT, "paddle_tpu", "_tuning_defaults.py")
+    spec = importlib.util.spec_from_file_location("_tuning_defaults", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_TD = _load_defaults()
+
+
+def _resolved(cfg):
+    """Dedup key over EFFECTIVE knobs: {batch,seq,remat} and the same
+    cfg with explicit default block/n_micro values build identical
+    child environments and must not be measured twice."""
+    return (cfg["batch"], cfg["seq"], str(cfg["remat"]).lower()) + \
+        _TD.effective_knobs(cfg)
+
+
+def run_trial(cfg, trials):
+    """One bench.py child at `cfg`; returns the parsed JSON line or None."""
+    for t in trials:
+        if _resolved(t["cfg"]) == _resolved(cfg):
+            return t["result"]  # already measured this round
+    # pin EVERY knob explicitly: an unset env var would fall back to a
+    # stale TUNED.json inside the bench child, mislabeling the trial
+    env = dict(os.environ,
+               _PT_BENCH_GUARDED="1",  # we are the watchdog
+               PT_BENCH_SKIP_VALIDATE="1",
+               PT_BENCH_BATCH=str(cfg["batch"]),
+               PT_BENCH_SEQ=str(cfg["seq"]),
+               PT_BENCH_REMAT=str(cfg["remat"]).lower(),
+               PT_FLASH_BLOCK_Q=str(cfg.get("block_q")
+                                    or _TD.DEFAULT_FLASH_BLOCK_Q),
+               PT_FLASH_BLOCK_K=str(cfg.get("block_k")
+                                    or _TD.DEFAULT_FLASH_BLOCK_K),
+               PT_BENCH_NMICRO=str(cfg.get("n_micro", 0)))
+    t0 = time.perf_counter()
+    try:
+        r = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                           env=env, capture_output=True, text=True,
+                           timeout=TRIAL_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        print(f"  trial {cfg} TIMED OUT after {TRIAL_TIMEOUT}s", flush=True)
+        trials.append({"cfg": cfg, "result": None, "error": "timeout"})
+        return None
+    out = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if r.returncode != 0 or out is None:
+        tail = "\n".join(r.stderr.strip().splitlines()[-4:])
+        print(f"  trial {cfg} FAILED rc={r.returncode}: {tail}", flush=True)
+        trials.append({"cfg": cfg, "result": None,
+                       "error": f"rc={r.returncode}"})
+        return None
+    if out.get("extra", {}).get("backend") == "cpu":
+        # tunnel died mid-search and the bench child fell back to the
+        # CPU smoke — a number that must never reach TUNED.json
+        print(f"  trial {cfg} INVALID: child fell back to CPU", flush=True)
+        trials.append({"cfg": cfg, "result": None, "error": "cpu_fallback"})
+        return None
+    if out.get("extra", {}).get("pallas_fallback"):
+        # Mosaic rejected this block config and bench.py silently
+        # re-ran on the XLA attention path — scoring that number as
+        # this pallas config would poison TUNED.json
+        print(f"  trial {cfg} INVALID: pallas rejected, XLA fallback ran",
+              flush=True)
+        trials.append({"cfg": cfg, "result": None,
+                       "error": "pallas_fallback"})
+        return None
+    dt = time.perf_counter() - t0
+    print(f"  trial {cfg}: {out['value']} tok/s "
+          f"(mfu={out['extra']['mfu']}, {dt:.0f}s wall)", flush=True)
+    trials.append({"cfg": cfg, "result": out})
+    return out
+
+
+def score(res):
+    return res["value"] if res else -1.0
+
+
+def persist(best_cfg, best_res, trials, done):
+    data = {"best": dict(best_cfg, tok_s=best_res["value"],
+                         mfu=best_res["extra"]["mfu"],
+                         mfu_legacy=best_res["extra"].get("mfu_legacy")),
+            "stages_done": done, "n_trials": len(trials),
+            "trials": [{"cfg": t["cfg"],
+                        "tok_s": t["result"]["value"] if t["result"] else None,
+                        "error": t.get("error")} for t in trials],
+            "ts": time.time()}
+    tmp = TUNED + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=1)
+    os.replace(tmp, TUNED)
+    print(f"TUNED.json <- {data['best']}", flush=True)
+
+
+def main():
+    # refuse to tune on CPU — numbers would be meaningless as defaults
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=180)
+        alive = probe.returncode == 0 and probe.stdout.strip() == "tpu"
+    except subprocess.TimeoutExpired:
+        alive = False  # half-wedged tunnel: device init hung
+    if not alive:
+        print("autotune: TPU unreachable; not tuning", file=sys.stderr)
+        sys.exit(1)
+
+    seq = int(os.environ.get("PT_TUNE_SEQ", "2048"))
+    trials = []
+    best_cfg, best_res = None, None
+    done = []
+
+    def consider(cfg):
+        nonlocal best_cfg, best_res
+        res = run_trial(cfg, trials)
+        if score(res) > score(best_res):
+            best_cfg, best_res = cfg, res
+            # persist on every improvement, not just stage boundaries —
+            # a mid-stage tunnel death must not lose the search
+            persist(best_cfg, best_res, trials, list(done))
+
+    # stage A: batch x remat (remat=False OOM'd at batch 16 in r2 —
+    # only try it at the smallest batch)
+    print("stage A: batch x remat", flush=True)
+    for batch in (16, 24, 32):
+        for remat in ("true", "dots"):
+            consider({"batch": batch, "seq": seq, "remat": remat})
+    consider({"batch": 8, "seq": seq, "remat": "false"})
+    if best_res is None:
+        print("autotune: every stage-A trial failed; aborting",
+              file=sys.stderr)
+        sys.exit(1)
+    done.append("A")
+    persist(best_cfg, best_res, trials, done)
+
+    # stage B: flash block sizes at the winner (must divide seq)
+    print("stage B: flash block_q/block_k", flush=True)
+    a_win = dict(best_cfg)
+    for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 256),
+                   (512, 512)):
+        consider(dict(a_win, block_q=bq, block_k=bk))
+    done.append("B")
+    persist(best_cfg, best_res, trials, done)
+
+    # stage C: gradient accumulation (true grad-accum scan in
+    # make_train_step — trades peak activation memory for a serial loop;
+    # can unlock bigger batch or lighter remat)
+    print("stage C: n_micro grad accumulation", flush=True)
+    b_win = dict(best_cfg)
+    for nm in (2, 4):
+        if b_win["batch"] % nm == 0:
+            consider(dict(b_win, n_micro=nm))
+    done.append("C")
+    persist(best_cfg, best_res, trials, done)
+    print(json.dumps({"best": best_cfg, "tok_s": best_res["value"],
+                      "mfu": best_res["extra"]["mfu"]}))
+
+
+if __name__ == "__main__":
+    main()

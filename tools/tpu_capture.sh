@@ -12,10 +12,14 @@ for m in resnet50 bert moe serving; do
   timeout 900 python bench_models.py "$m" 2>&1 | tail -2
 done
 
-# headline refinements: dots remat and batch 24 at the winning seq
-for cfg in "16 2048 dots" "24 2048 true"; do
-  set -- $cfg
-  PT_BENCH_BATCH=$1 PT_BENCH_SEQ=$2 PT_BENCH_REMAT=$3 \
-    timeout 900 python bench.py 2>&1 | tail -1
-done
+# autotune: search batch/remat/flash-block space, persist winner to
+# TUNED.json (bench.py picks it up as its defaults)
+timeout 7200 python tools/autotune.py 2>&1 | tail -8
+
+# final driver-comparable headline at the tuned defaults (validation
+# already ran above — skip the redundant pre-step)
+PT_BENCH_SKIP_VALIDATE=1 timeout 1800 python bench.py 2>&1 | tail -1
+
+# serving throughput on-chip (VERDICT r2 item 8)
+timeout 900 python bench_models.py serving 2>&1 | tail -2
 echo "CAPTURE_DONE"

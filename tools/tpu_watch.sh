@@ -1,0 +1,18 @@
+#!/bin/bash
+# Poll the TPU tunnel; on first successful device init, run the full
+# on-chip capture suite (tools/tpu_capture.sh). Designed to run in the
+# background for the whole round — exits after capture or ~10.5h.
+cd "$(dirname "$0")/.."
+LOG=tpu_watch.log
+for i in $(seq 1 100); do
+  if timeout 150 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>>"$LOG"; then
+    echo "TPU alive at probe $i ($(date -u +%FT%TZ))" | tee -a "$LOG"
+    bash tools/tpu_capture.sh 2>&1 | tee -a tpu_capture.log
+    echo "CAPTURE_EXIT=$?" | tee -a "$LOG"
+    exit 0
+  fi
+  echo "probe $i: tunnel down ($(date -u +%FT%TZ))" >>"$LOG"
+  sleep 230
+done
+echo "TPU never came up this round ($(date -u +%FT%TZ))" | tee -a "$LOG"
+exit 1
