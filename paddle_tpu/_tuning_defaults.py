@@ -30,15 +30,3 @@ def effective_knobs(entry):
     return (int(entry.get("block_q") or DEFAULT_FLASH_BLOCK_Q),
             int(entry.get("block_k") or DEFAULT_FLASH_BLOCK_K),
             int(entry.get("n_micro") or 0))
-
-
-def load_by_path(repo_root):
-    """Helper-for-helpers: how tools/tests import this file without
-    triggering the package __init__ (documented here so the pattern
-    stays greppable)."""
-    import importlib.util
-    p = os.path.join(repo_root, "paddle_tpu", "_tuning_defaults.py")
-    spec = importlib.util.spec_from_file_location("_tuning_defaults", p)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod

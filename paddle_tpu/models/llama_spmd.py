@@ -26,7 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
-from ..parallel.pp import pipeline_apply, group_stages
+from ..parallel.pp import (pipeline_apply, pipeline_train_1f1b,
+                           group_stages)
 from ..parallel.ring import ring_attention_local
 from .llama import LlamaConfig
 
@@ -202,10 +203,16 @@ def adamw_update(params, grads, state, lr, step, b1=0.9, b2=0.95, eps=1e-8,
 
 
 def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
-                    clip_norm=1.0, lr=3e-4, sp_axis=None, donate=True):
+                    clip_norm=1.0, lr=3e-4, sp_axis=None, donate=True,
+                    schedule="gpipe"):
     """Build the jitted 4D-parallel train step.
 
     (params, opt_state, step, batch) → (params, opt_state, loss)
+
+    schedule: with pp>1, "gpipe" runs the differentiable scan pipeline
+    (AD backward, O(n_micro) stashed activations) and "1f1b" runs the
+    hand-seeded one-forward-one-backward schedule (O(pp) stashed stage
+    inputs — reference pipeline_parallel.py:958 parity).
     """
     use_pp = mesh.shape.get("pp", 1) > 1
     specs = param_specs(config, mesh, pp=use_pp)
@@ -218,8 +225,55 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
     bshard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), batch_spec,
                                     is_leaf=lambda x: isinstance(x, P))
 
+    def grads_1f1b(params, batch):
+        """Loss + grads via the 1F1B pipeline: embed lookup and its
+        scatter-grad run replicated outside the pipeline; final-norm +
+        lm_head + loss fold into head_fn on the last stage."""
+        c = config
+        input_ids, labels = batch
+        s = input_ids.shape[1]
+        cos, sin = rope_cos_sin(s, c.hidden_size // c.num_attention_heads,
+                                c.rope_theta, jnp.float32)
+        layer = functools.partial(decoder_layer, config=c, sp_axis=sp_axis)
+        if remat == "dots":
+            layer = jax.checkpoint(
+                layer,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif remat:
+            layer = jax.checkpoint(layer)
+
+        h0, pull_embed = jax.vjp(
+            lambda e: jnp.take(e, input_ids, axis=0), params["embed"])
+
+        def head_fn(hp, h, tgt):
+            hh = _rms(h, hp["final_norm"], c.rms_norm_eps)
+            logits = hh @ hp["lm_head"]
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            picked = jnp.take_along_axis(
+                logp, tgt[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            return -jnp.mean(picked)
+
+        n_stages = mesh.shape["pp"]
+        staged = group_stages(params["layers"], n_stages)
+        head_p = {"final_norm": params["final_norm"],
+                  "lm_head": params["lm_head"]}
+        loss, gstage, ghead, dh0 = pipeline_train_1f1b(
+            staged, h0, labels,
+            lambda lp, hh, extra: layer(lp, hh, extra),
+            head_fn, head_p, mesh, pp_axis="pp", n_micro=n_micro,
+            extra=(cos, sin))
+        (g_embed,) = pull_embed(dh0.astype(h0.dtype))
+        L = c.num_hidden_layers
+        g_layers = jax.tree_util.tree_map(
+            lambda a: a.reshape(L, *a.shape[2:]), gstage)
+        grads = {"embed": g_embed, "final_norm": ghead["final_norm"],
+                 "lm_head": ghead["lm_head"], "layers": g_layers}
+        return loss, grads
+
     def step_fn(params, opt_state, step, batch):
-        if n_micro and n_micro > 1 and not use_pp:
+        if use_pp and schedule == "1f1b":
+            loss, grads = grads_1f1b(params, batch)
+        elif n_micro and n_micro > 1 and not use_pp:
             # true gradient accumulation: scan over n_micro microbatches,
             # summing fp32 grads. Peak activation memory drops ~n_micro×
             # (one microbatch's activations live at a time) at the cost

@@ -1,0 +1,52 @@
+"""Child for test_pp2_faster_than_sequential_compute_bound: times the
+GPipe pipeline at pp=1 vs pp=2 with one XLA intra-op thread per virtual
+device (otherwise the 1-device baseline silently uses every core and no
+stage-parallel speedup is observable). Prints one JSON line."""
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 "
+                           "--xla_cpu_multi_thread_eigen=false "
+                           "intra_op_parallelism_threads=1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.parallel.pp import pipeline_apply, group_stages  # noqa: E402
+
+
+def main():
+    D, L, B, M = 1024, 8, 16, 8
+    rng = np.random.RandomState(0)
+    Ws = jnp.asarray(rng.randn(L, D, D) * 0.02, jnp.float32)
+    x = jnp.asarray(rng.randn(B, D), jnp.float32)
+
+    def layer_fn(lp, h, e):
+        return jnp.tanh(h @ lp["w"])
+
+    def timed(mesh, n):
+        staged = group_stages({"w": Ws}, n)
+        f = jax.jit(lambda s, xx: pipeline_apply(s, xx, layer_fn, mesh,
+                                                 n_micro=M))
+        out = f(staged, x)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = f(staged, x)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0, np.asarray(out)
+
+    t1, o1 = timed(Mesh(np.asarray(jax.devices()[:1]), ("pp",)), 1)
+    t2, o2 = timed(Mesh(np.asarray(jax.devices()[:2]), ("pp",)), 2)
+    print(json.dumps({"t_seq": t1, "t_pp2": t2,
+                      "equal": bool(np.allclose(o1, o2, atol=1e-5))}))
+
+
+if __name__ == "__main__":
+    main()

@@ -17,6 +17,11 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: wall-clock-sensitive tests (timing assertions)")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import numpy as np

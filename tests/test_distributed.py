@@ -1,6 +1,10 @@
 """Distributed tests on the 8-device virtual CPU mesh (SURVEY §4):
 TP == single-device math, ZeRO == DP, pipeline == sequential,
 ring == full attention, MoE EP == dense."""
+import json
+import os
+import sys
+
 import numpy as np
 import pytest
 
@@ -190,6 +194,167 @@ class TestPipeline:
             assert np.allclose(a, b, atol=1e-4), key
         assert np.allclose(np.asarray(g_scan["embed"]),
                            np.asarray(g_pp["embed"]), atol=1e-4)
+
+
+class Test1F1B:
+    def _cfg_mesh(self):
+        from paddle_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4,
+                               kv_heads=4, ffn=64)
+        return cfg, create_mesh({"pp": 4, "dp": 2})
+
+    def test_1f1b_step_matches_sequential(self):
+        """make_train_step(schedule='1f1b') == the no-pp step: same loss
+        trajectory and updated params over 2 steps."""
+        from paddle_tpu.models import llama_spmd as M
+        from jax.sharding import Mesh
+        cfg, mesh = self._cfg_mesh()
+        mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+        x = jnp.asarray(np.random.RandomState(0).randint(0, 64, (4, 16)))
+        y = jnp.asarray(np.random.RandomState(1).randint(0, 64, (4, 16)))
+
+        outs = {}
+        for name, m, kw in (("seq", mesh1, {}),
+                            ("1f1b", mesh, {"schedule": "1f1b",
+                                            "n_micro": 2})):
+            params = M.init_params(cfg, seed=3)
+            if name == "1f1b":
+                params = M.place_params(params, cfg, m)
+            opt = M.init_opt_state(params)
+            step = M.make_train_step(cfg, m, n_micro=kw.get("n_micro"),
+                                     remat=False, donate=False,
+                                     schedule=kw.get("schedule", "gpipe"))
+            losses = []
+            for i in range(2):
+                params, opt, loss = step(params, opt, jnp.asarray(i), (x, y))
+                losses.append(float(loss))
+            outs[name] = (losses, params)
+
+        assert np.allclose(outs["seq"][0], outs["1f1b"][0], atol=1e-4), \
+            (outs["seq"][0], outs["1f1b"][0])
+        for key in ("wq", "w_down", "ln1"):
+            a = np.asarray(outs["seq"][1]["layers"][key], np.float32)
+            b = np.asarray(outs["1f1b"][1]["layers"][key], np.float32)
+            assert np.allclose(a, b, atol=2e-4), key
+        a = np.asarray(outs["seq"][1]["embed"], np.float32)
+        b = np.asarray(outs["1f1b"][1]["embed"], np.float32)
+        assert np.allclose(a, b, atol=2e-4)
+
+    def test_1f1b_grads_match_autodiff(self, ):
+        """pipeline_train_1f1b's hand-seeded backward == jax.grad of the
+        equivalent dense program, including head and dx grads."""
+        from paddle_tpu.parallel.pp import (pipeline_train_1f1b,
+                                            group_stages)
+        mesh = create_mesh({"pp": 4, "dp": 2})
+        rng = np.random.RandomState(0)
+        Lp, H = 8, 16
+        W = jnp.asarray(rng.randn(Lp, H, H) * 0.1, jnp.float32)
+        head_w = jnp.asarray(rng.randn(H, 7) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.randn(6, 5, H), jnp.float32)
+        tgt = jnp.asarray(rng.randint(0, 7, (6, 5)))
+
+        def layer_fn(lw, h, extra):
+            return jnp.tanh(h @ lw)
+
+        def head_fn(hp, h, t):
+            logp = jax.nn.log_softmax(h @ hp["w"], axis=-1)
+            return -jnp.mean(jnp.take_along_axis(
+                logp, t[..., None], axis=-1))
+
+        def dense_loss(W_, hw, x_):
+            h = x_
+            for i in range(Lp):
+                h = layer_fn(W_[i], h, None)
+            # per-microbatch mean-of-means == global mean (equal sizes)
+            return head_fn({"w": hw}, h, tgt)
+
+        loss_ref, g_ref = jax.value_and_grad(dense_loss, (0, 1, 2))(
+            W, head_w, x)
+
+        staged = group_stages({"w": W}, 4)
+        loss, gstage, ghead, dx = jax.jit(
+            lambda s, xx, tt, hp: pipeline_train_1f1b(
+                s, xx, tt, lambda lp, h, e: layer_fn(lp["w"], h, e),
+                head_fn, hp, mesh, n_micro=3))(
+            staged, x, tgt, {"w": head_w})
+
+        assert abs(float(loss) - float(loss_ref)) < 1e-5
+        gW = np.asarray(gstage["w"]).reshape(Lp, H, H)
+        assert np.allclose(gW, np.asarray(g_ref[0]), atol=1e-4)
+        assert np.allclose(np.asarray(ghead["w"]), np.asarray(g_ref[1]),
+                           atol=1e-4)
+        assert np.allclose(np.asarray(dx), np.asarray(g_ref[2]), atol=1e-4)
+
+    def test_bubble_fraction(self):
+        from paddle_tpu.parallel.pp import pipeline_bubble_fraction
+        assert pipeline_bubble_fraction(4, 1) == 0.0
+        assert pipeline_bubble_fraction(4, 2) == pytest.approx(2 / 6)
+        assert pipeline_bubble_fraction(4, 2, "gpipe") == pytest.approx(1 / 5)
+
+
+class TestPipelineLayer:
+    def test_staged_forward_matches_sequential(self):
+        """PipelineLayer with a pp mesh runs the homogeneous block
+        through pipeline_apply and matches the sequential result."""
+        from paddle_tpu.parallel.pp import PipelineLayer, LayerDesc
+        import paddle_tpu.nn as nn
+        pt.seed(0)
+        mesh = create_mesh({"pp": 4, "dp": 2})
+        descs = [LayerDesc(nn.Linear, 16, 16) for _ in range(8)]
+        seq = PipelineLayer(descs, num_stages=4)
+        # same built layers, staged execution
+        staged = PipelineLayer(seq.built, num_stages=4, mesh=mesh)
+        assert staged._block == (0, 8)
+        x = jnp.asarray(np.random.RandomState(0).randn(4, 16),
+                        jnp.float32)
+        a = seq(x)
+        b = staged(x)
+        a = a._value if hasattr(a, "_value") else a
+        b = b._value if hasattr(b, "_value") else b
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_heterogeneous_tail_runs_outside(self):
+        from paddle_tpu.parallel.pp import PipelineLayer, LayerDesc
+        import paddle_tpu.nn as nn
+        pt.seed(1)
+        mesh = create_mesh({"pp": 2, "dp": 4})
+        layers = [nn.Linear(8, 16)] + [nn.Linear(16, 16) for _ in range(4)] \
+            + [nn.Linear(16, 3)]
+        plain = PipelineLayer(layers, num_stages=2)
+        staged = PipelineLayer(layers, num_stages=2, mesh=mesh)
+        assert staged._block == (1, 5)
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 8), jnp.float32)
+        a, b = plain(x), staged(x)
+        a = a._value if hasattr(a, "_value") else a
+        b = b._value if hasattr(b, "_value") else b
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    @pytest.mark.slow
+    def test_pp2_faster_than_sequential_compute_bound(self):
+        """VERDICT r3 item 3 'Done' bar: pp=2 wall-clock beats the
+        1-device sequential run for a compute-bound toy. Runs in a
+        subprocess with ONE XLA intra-op thread per virtual device —
+        in-process, the 1-device baseline silently uses every core and
+        no stage-parallel win is physically observable. Skips on hosts
+        without enough cores to run two stages concurrently."""
+        import subprocess
+        cores = os.cpu_count() or 1
+        if cores < 3:
+            pytest.skip(f"host has {cores} core(s); pp=2 + scheduler "
+                        "cannot run concurrently — no wall-clock win "
+                        "is physically possible")
+        child = os.path.join(os.path.dirname(__file__),
+                             "_pp_speed_child.py")
+        r = subprocess.run([sys.executable, child], capture_output=True,
+                           text=True, timeout=600,
+                           env={k: v for k, v in os.environ.items()
+                                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")})
+        assert r.returncode == 0, r.stderr[-2000:]
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["equal"], "pp=2 result differs from sequential"
+        assert out["t_pp2"] < 0.85 * out["t_seq"], (
+            f"pp=2 {out['t_pp2']:.3f}s not faster than "
+            f"seq {out['t_seq']:.3f}s")
 
 
 class TestGradAccum:
