@@ -190,67 +190,51 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
                         name=None):
     """FlashMask attention (reference flash_attention.py:1299): sparse
     causal masks expressed as per-column start/end row indices instead of
-    a dense (S, S) mask. With no indices and no window this is plain
-    flash attention (pallas path on TPU); with indices, the dense mask is
-    materialized and applied in the fused XLA reference path.
+    a dense (S, S) mask.
+
+    Routing: no indices/window → plain flash attention (pallas on TPU).
+    With indices/window and no training-time dropout → the FlashMask
+    pallas kernel (ops/flashmask_attention.py): start/end columns
+    streamed block-by-block, fully-masked blocks skipped, O(S·block)
+    memory — never a dense (S, S) materialization. Training-time
+    dropout needs materialized probabilities, so it runs the dense
+    flashmask_reference path WITH dropout applied (reference kernel
+    drops attention probabilities).
 
     startend_row_indices: (B, Hk, S_k, {1, 2, 4}) int32 — see the
     reference docstring for the per-shape semantics (LT start / LT
-    start+end / LT start + UT end / LT+UT start+end).
+    start+end / LT start + UT end / LT+UT start+end). Invalid
+    (causal, n) combinations raise ValueError on both paths.
     """
     if startend_row_indices is None and window_size is None:
         return flash_attention(query, key, value, dropout=dropout,
                                causal=causal, training=training)
 
+    from ...ops.flashmask_attention import (flashmask_attention_bhsd,
+                                            flashmask_reference)
+    use_dropout = dropout > 0.0 and training
+
     def fn(q, k, v, *rest):
-        b, s_q, h, d = q.shape
-        s_k = k.shape[1]
-        rows = jnp.arange(s_q)[:, None]            # query row index
-        cols = jnp.arange(s_k)[None, :]
-        # base mask: causal / sliding window
-        keep = jnp.ones((s_q, s_k), bool)
-        if causal:
-            keep = keep & (cols <= rows)
-        if window_size is not None:
-            w = (window_size, window_size) if isinstance(window_size, int) \
-                else tuple(window_size)
-            keep = keep & (cols >= rows - w[0])
-            if not causal:
-                keep = keep & (cols <= rows + w[1])
-        keep = jnp.broadcast_to(keep[None, None], (b, h, s_q, s_k))
-        if rest:
-            sri = rest[0].astype(jnp.int32)        # (B, Hk, S_k, n)
-            hk = sri.shape[1]
-            n = sri.shape[-1]
-            sri = jnp.repeat(sri, h // hk, axis=1)  # broadcast to q heads
-            r = rows[None, None]                    # (1,1,S_q,1)
-            def col(i):
-                return sri[..., i][:, :, None, :]   # (B, H, 1, S_k)
-            if causal and n == 1:
-                masked = r >= col(0)                # LT start downwards
-            elif causal and n == 2:
-                masked = (r >= col(0)) & (r < col(1))
-            elif not causal and n == 2:
-                masked = (r >= col(0)) | (r < col(1))
-            elif not causal and n == 4:
-                masked = ((r >= col(0)) & (r < col(1))) | \
-                         ((r >= col(2)) & (r < col(3)))
-            else:
-                raise ValueError(
-                    f"startend_row_indices last dim {n} invalid for "
-                    f"causal={causal}")
-            keep = keep & ~masked
-        qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
-        kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-        vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        h = qh.shape[1]
         if kh.shape[1] != h:
             kh = jnp.repeat(kh, h // kh.shape[1], axis=1)
             vh = jnp.repeat(vh, h // vh.shape[1], axis=1)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / math.sqrt(d)
-        scores = jnp.where(keep, scores, -jnp.inf)
-        p = jax.nn.softmax(scores, axis=-1)
-        p = jnp.where(keep, p, 0.0)
-        out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+        sri = None
+        if rest:
+            sri = rest[0].astype(jnp.int32)
+            if sri.shape[1] != h:
+                sri = jnp.repeat(sri, h // sri.shape[1], axis=1)
+        if use_dropout:
+            from ..._core.state import prng
+            out, _ = flashmask_reference(qh, kh, vh, sri, causal,
+                                         window_size, dropout=dropout,
+                                         dropout_key=prng.next_key())
+        else:
+            out = flashmask_attention_bhsd(qh, kh, vh, sri, causal=causal,
+                                           window=window_size)
         return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
     args = [query, key, value]

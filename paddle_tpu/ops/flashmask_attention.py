@@ -1,0 +1,550 @@
+"""FlashMask attention for TPU — pallas kernels (fwd + bwd).
+
+Reference: python/paddle/nn/functional/flash_attention.py:1299
+(flashmask_attention) and its CUDA kernel
+paddle/phi/kernels/gpu/flash_attn_kernel.cu — sparse causal masks
+expressed as per-key-column start/end row indices, applied WITHOUT ever
+materializing the dense (S, S) mask.
+
+TPU-native design (VERDICT r2 item 4): the dense flash kernel's
+blockwise online-softmax structure, plus
+
+  * the column index vector `startend_row_indices` (B, Hk, S_k, n) is
+    transposed to (n, S_k) per head and streamed block-by-block next to
+    K/V — O(S) memory, never (S, S);
+  * per (q-block, k-block), block-level aggregates (max of starts, min
+    of ends over the k-block's columns) decide SKIP: a block whose every
+    (row, col) pair is masked is skipped via @pl.when before any MXU
+    work, mirroring the reference kernel's block-skip. Aggregates over
+    the ragged tail's padding lanes only weaken the skip predicate
+    (max grows / min shrinks), never falsify it;
+  * surviving blocks apply the exact per-pair mask built from row iota
+    vs the streamed start/end columns.
+
+Mask semantics (n = trailing dim of startend_row_indices), matching the
+reference docstring:
+  causal,  n=1: masked  <=>  r >= start_j
+  causal,  n=2: masked  <=>  start_j <= r < end_j
+  ~causal, n=2: masked  <=>  (r >= start_j) | (r < end_j)
+  ~causal, n=4: masked  <=>  (s0_j <= r < e0_j) | (s1_j <= r < e1_j)
+plus the base causal triangle / sliding window when requested.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .flash_attention import (_HAS_PLTPU, pltpu, NEG_INF, Z, LANES,
+                              _col_mask, _fit_lanes, _on_tpu,
+                              pallas_disabled, DEFAULT_BLOCK_Q,
+                              DEFAULT_BLOCK_K)
+
+
+def _zero_oob(qi, ki, q, k, v, do=None, *, block_q, block_k, sq, sk):
+    """Zero out ragged-tail garbage: OOB lanes of a padded block read
+    undefined values, and 0 * NaN would poison the accumulators even
+    where the keep-mask already zeroes p/ds."""
+    d = q.shape[-1]
+    if sk % block_k != 0:
+        km = _col_mask(ki * block_k, block_k, sk, d)
+        k = jnp.where(km, k, 0.0)
+        v = jnp.where(km, v, 0.0)
+    if sq % block_q != 0:
+        qm = _col_mask(qi * block_q, block_q, sq, d)
+        q = jnp.where(qm, q, 0.0)
+        if do is not None:
+            do = jnp.where(qm, do, 0.0)
+    return (q, k, v) if do is None else (q, k, v, do)
+
+
+def _sri_masked(rows, srib, causal, n):
+    """(block_q, block_k) bool: pairs masked by the start/end indices.
+    rows: (block_q, block_k) absolute row ids; srib: (n, block_k)."""
+    def col(i):
+        return srib[i:i + 1, :]  # (1, block_k) broadcasts over rows
+    if causal and n == 1:
+        return rows >= col(0)
+    if causal and n == 2:
+        return (rows >= col(0)) & (rows < col(1))
+    if not causal and n == 2:
+        return (rows >= col(0)) | (rows < col(1))
+    if not causal and n == 4:
+        return ((rows >= col(0)) & (rows < col(1))) | \
+               ((rows >= col(2)) & (rows < col(3)))
+    raise ValueError(f"startend_row_indices last dim {n} invalid for "
+                     f"causal={causal}")
+
+
+def _sri_all_masked(r_first, r_last, srib, causal, n):
+    """Scalar bool: every (row, col) pair of this block is masked —
+    safe to skip. Conservative under ragged-tail padding garbage in
+    srib (max only grows, min only shrinks)."""
+    def mx(i):
+        return jnp.max(srib[i:i + 1, :])
+    def mn(i):
+        return jnp.min(srib[i:i + 1, :])
+    if causal and n == 1:
+        return r_first >= mx(0)
+    if causal and n == 2:
+        return (r_first >= mx(0)) & (r_last < mn(1))
+    if not causal and n == 2:
+        return (r_first >= mx(0)) | (r_last < mn(1))
+    if not causal and n == 4:
+        return ((r_first >= mx(0)) & (r_last < mn(1))) | \
+               ((r_first >= mx(2)) & (r_last < mn(3)))
+    raise ValueError(f"n={n} invalid for causal={causal}")
+
+
+def _block_keep(qi, ki, block_q, block_k, sq, sk, causal, window, srib, n):
+    """(compute_predicate, per-pair keep mask builder) for one block."""
+    r_first = qi * block_q
+    r_last = qi * block_q + block_q - 1
+    c_first = ki * block_k
+    c_last = ki * block_k + block_k - 1
+    compute = jnp.bool_(True)
+    if causal:
+        compute = compute & (r_last >= c_first)
+    if window is not None:
+        compute = compute & (c_last >= r_first - window[0])
+        if not causal:
+            compute = compute & (c_first <= r_last + window[1])
+    if srib is not None:
+        compute = compute & ~_sri_all_masked(r_first, r_last, srib,
+                                             causal, n)
+
+    def keep_mask():
+        rows = r_first + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = c_first + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        keep = (cols < sk) & (rows < sq)
+        if causal:
+            keep = keep & (rows >= cols)
+        if window is not None:
+            keep = keep & (cols >= rows - window[0])
+            if not causal:
+                keep = keep & (cols <= rows + window[1])
+        if srib is not None:
+            keep = keep & ~_sri_masked(rows, srib, causal, n)
+        return keep
+    return compute, keep_mask
+
+
+# ---------------------------------------------------------------------------
+# Reference (dense XLA) — correctness baseline + off-TPU fallback.
+# ---------------------------------------------------------------------------
+def flashmask_reference(q, k, v, sri=None, causal=True, window=None,
+                        sm_scale=None, dropout=0.0, dropout_key=None):
+    """q,k,v (B,H,S,D); sri (B,H,S_k,n) already at q heads. Returns
+    (out, lse). Materializes the dense mask — baseline only. window may
+    be an int (symmetric) or (left, right). dropout drops attention
+    probabilities (reference kernel semantics) using dropout_key."""
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if window is not None and np.isscalar(window):
+        window = (int(window), int(window))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    rows = jnp.arange(sq)[:, None]
+    cols = jnp.arange(sk)[None, :]
+    keep = jnp.ones((sq, sk), bool)
+    if causal:
+        keep = keep & (cols <= rows)
+    if window is not None:
+        keep = keep & (cols >= rows - window[0])
+        if not causal:
+            keep = keep & (cols <= rows + window[1])
+    keep = jnp.broadcast_to(keep[None, None], s.shape)
+    if sri is not None:
+        n = sri.shape[-1]
+        r = rows[None, None]
+        sc = jnp.swapaxes(sri, -1, -2)[:, :, :, None, :]  # (B,H,n,1,S_k)
+
+        def col(i):
+            return sc[:, :, i]
+        if causal and n == 1:
+            masked = r >= col(0)
+        elif causal and n == 2:
+            masked = (r >= col(0)) & (r < col(1))
+        elif not causal and n == 2:
+            masked = (r >= col(0)) | (r < col(1))
+        elif not causal and n == 4:
+            masked = ((r >= col(0)) & (r < col(1))) | \
+                     ((r >= col(2)) & (r < col(3)))
+        else:
+            raise ValueError(f"n={n} invalid for causal={causal}")
+        keep = keep & ~masked
+    s = jnp.where(keep, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    p = jnp.where(keep, p, 0.0)
+    if dropout > 0.0:
+        assert dropout_key is not None, "dropout requires dropout_key"
+        keep_p = jax.random.bernoulli(dropout_key, 1.0 - dropout, p.shape)
+        p = jnp.where(keep_p, p / (1.0 - dropout), 0.0)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, sri_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, window, n_sri,
+                block_q, block_k, n_k, sq, sk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    srib = sri_ref[0] if sri_ref is not None else None
+    compute, keep_mask = _block_keep(qi, ki, block_q, block_k, sq, sk,
+                                     causal, window, srib, n_sri)
+
+    @pl.when(compute)
+    def body():
+        q, k, v = _zero_oob(qi, ki, q_ref[0], k_ref[0], v_ref[0],
+                            block_q=block_q, block_k=block_k, sq=sq, sk=sk)
+        d = q.shape[-1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        keep = keep_mask()
+        s = jnp.where(keep, s, NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - _fit_lanes(m_new, s.shape[-1]))
+        p = jnp.where(keep, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * _fit_lanes(alpha, d) + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        d = o_ref.shape[-1]
+        o_ref[0] = (acc_ref[:] / _fit_lanes(l_safe, d)).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, sri_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, window, n_sri,
+                   block_q, block_k, n_k, sq, sk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    srib = sri_ref[0] if sri_ref is not None else None
+    compute, keep_mask = _block_keep(qi, ki, block_q, block_k, sq, sk,
+                                     causal, window, srib, n_sri)
+
+    @pl.when(compute)
+    def body():
+        q, k, v, do = _zero_oob(qi, ki, q_ref[0], k_ref[0], v_ref[0],
+                                do_ref[0], block_q=block_q,
+                                block_k=block_k, sq=sq, sk=sk)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        keep = keep_mask()
+        s = jnp.where(keep, s, NEG_INF)
+        p = jnp.exp(s - _fit_lanes(lse_ref[0], s.shape[-1]))
+        p = jnp.where(keep, p, 0.0)
+        do = do.astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = jnp.where(keep,
+                       p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1]))
+                       * scale, 0.0)
+        dq_acc[:] += jax.lax.dot_general(ds, k.astype(jnp.float32),
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _fin():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, sri_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, window,
+                    n_sri, block_q, block_k, n_q, sq, sk):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    srib = sri_ref[0] if sri_ref is not None else None
+    compute, keep_mask = _block_keep(qi, ki, block_q, block_k, sq, sk,
+                                     causal, window, srib, n_sri)
+
+    @pl.when(compute)
+    def body():
+        q, k, v, do = _zero_oob(qi, ki, q_ref[0], k_ref[0], v_ref[0],
+                                do_ref[0], block_q=block_q,
+                                block_k=block_k, sq=sq, sk=sk)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        keep = keep_mask()
+        s = jnp.where(keep, s, NEG_INF)
+        p = jnp.exp(s - _fit_lanes(lse_ref[0], s.shape[-1]))
+        p = jnp.where(keep, p, 0.0)
+        do = do.astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v.astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = jnp.where(keep,
+                       p * (dp - _fit_lanes(delta_ref[0], dp.shape[-1]))
+                       * scale, 0.0)
+        dk_acc[:] += jax.lax.dot_general(ds, q.astype(jnp.float32),
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _fin():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+def _prep(q, k, v, sri):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qr = q.reshape(bh, sq, d)
+    kr = k.reshape(bh, sk, d)
+    vr = v.reshape(bh, sk, d)
+    if sri is not None:
+        # (B,H,S_k,n) -> (bh, n, S_k): the kernel reads (n, block_k)
+        # tiles whose LANE dim is the 128-aligned key axis
+        n = sri.shape[-1]
+        srir = jnp.swapaxes(sri, -1, -2).reshape(bh, n, sk).astype(jnp.int32)
+    else:
+        srir = None
+    return qr, kr, vr, srir, b, h, sq, sk, d, bh
+
+
+def _mem_spec():
+    mem = pltpu.VMEM if _HAS_PLTPU else None
+    return (lambda bs, im: pl.BlockSpec(bs, im, memory_space=mem)
+            if mem else pl.BlockSpec(bs, im))
+
+
+def _fwd_pallas(q, k, v, sri, causal, window, scale, block_q, block_k,
+                interpret):
+    scale = np.float32(scale)
+    qr, kr, vr, srir, b, h, sq, sk, d, bh = _prep(q, k, v, sri)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = pl.cdiv(sq, block_q)
+    n_k = pl.cdiv(sk, block_k)
+    n_sri = srir.shape[1] if srir is not None else 0
+    spec = _mem_spec()
+
+    in_specs = [
+        spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, Z)),
+        spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, Z)),
+        spec((1, block_k, d), lambda bh_, qi, ki: (bh_, ki, Z)),
+    ]
+    args = [qr, kr, vr]
+    if srir is not None:
+        in_specs.append(spec((1, n_sri, block_k),
+                             lambda bh_, qi, ki: (bh_, Z, ki)))
+        args.append(srir)
+        kernel = functools.partial(
+            _fwd_kernel, scale=scale, causal=causal, window=window,
+            n_sri=n_sri, block_q=block_q, block_k=block_k, n_k=n_k,
+            sq=sq, sk=sk)
+    else:
+        kernel = functools.partial(
+            lambda q_, k_, v_, *rest, **kw: _fwd_kernel(
+                q_, k_, v_, None, *rest, **kw),
+            scale=scale, causal=causal, window=window, n_sri=0,
+            block_q=block_q, block_k=block_k, n_k=n_k, sq=sq, sk=sk)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=in_specs,
+        out_specs=[
+            spec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, Z)),
+            spec((1, block_q, LANES), lambda bh_, qi, ki: (bh_, qi, Z)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    return o.reshape(b, h, sq, d), lse.reshape(b, h, sq, LANES)
+
+
+def _bwd_pallas(q, k, v, sri, o, lse, do, causal, window, scale,
+                block_q, block_k, interpret):
+    scale = np.float32(scale)
+    qr, kr, vr, srir, b, h, sq, sk, d, bh = _prep(q, k, v, sri)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    n_q = pl.cdiv(sq, block_q)
+    n_k = pl.cdiv(sk, block_k)
+    n_sri = srir.shape[1] if srir is not None else 0
+    spec = _mem_spec()
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dor = do.reshape(bh, sq, d)
+    lser = lse.reshape(bh, sq, LANES)
+    deltar = jnp.broadcast_to(delta.reshape(bh, sq)[..., None],
+                              (bh, sq, LANES))
+
+    def specs(order):
+        # order: index-map arg order differs between the two kernels
+        qspec = spec((1, block_q, d), order("q"))
+        return ([qspec,
+                 spec((1, block_k, d), order("k")),
+                 spec((1, block_k, d), order("k")),
+                 ] + ([spec((1, n_sri, block_k), order("sri"))]
+                      if srir is not None else []) +
+                [spec((1, block_q, d), order("q")),
+                 spec((1, block_q, LANES), order("q")),
+                 spec((1, block_q, LANES), order("q"))])
+
+    def dq_order(which):
+        return {"q": lambda b_, qi, ki: (b_, qi, Z),
+                "k": lambda b_, qi, ki: (b_, ki, Z),
+                "sri": lambda b_, qi, ki: (b_, Z, ki)}[which]
+
+    def dkv_order(which):
+        return {"q": lambda b_, ki, qi: (b_, qi, Z),
+                "k": lambda b_, ki, qi: (b_, ki, Z),
+                "sri": lambda b_, ki, qi: (b_, Z, ki)}[which]
+
+    base_args = [qr, kr, vr] + ([srir] if srir is not None else [])
+
+    def mk_kernel(fn, **kw):
+        if srir is not None:
+            return functools.partial(fn, **kw)
+        return functools.partial(
+            lambda q_, k_, v_, *rest, **kw2: fn(q_, k_, v_, None, *rest,
+                                                **kw2), **kw)
+
+    dq = pl.pallas_call(
+        mk_kernel(_bwd_dq_kernel, scale=scale, causal=causal, window=window,
+                  n_sri=n_sri, block_q=block_q, block_k=block_k, n_k=n_k,
+                  sq=sq, sk=sk),
+        grid=(bh, n_q, n_k),
+        in_specs=specs(dq_order),
+        out_specs=[spec((1, block_q, d), dq_order("q"))],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)]
+        if _HAS_PLTPU else [],
+        interpret=interpret,
+    )(*base_args, dor, lser, deltar)[0]
+
+    dk, dv = pl.pallas_call(
+        mk_kernel(_bwd_dkv_kernel, scale=scale, causal=causal, window=window,
+                  n_sri=n_sri, block_q=block_q, block_k=block_k, n_q=n_q,
+                  sq=sq, sk=sk),
+        grid=(bh, n_k, n_q),
+        in_specs=specs(dkv_order),
+        out_specs=[
+            spec((1, block_k, d), dkv_order("k")),
+            spec((1, block_k, d), dkv_order("k")),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ] if _HAS_PLTPU else [],
+        interpret=interpret,
+    )(*base_args, dor, lser, deltar)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# Public op with custom VJP
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flashmask(q, k, v, sri, causal, window, scale, block_q, block_k,
+               interpret):
+    o, _ = _fwd_pallas(q, k, v, sri, causal, window, scale, block_q,
+                       block_k, interpret)
+    return o
+
+
+def _flashmask_fwd(q, k, v, sri, causal, window, scale, block_q, block_k,
+                   interpret):
+    o, lse = _fwd_pallas(q, k, v, sri, causal, window, scale, block_q,
+                         block_k, interpret)
+    return o, (q, k, v, sri, o, lse)
+
+
+def _flashmask_bwd(causal, window, scale, block_q, block_k, interpret,
+                   res, do):
+    q, k, v, sri, o, lse = res
+    dq, dk, dv = _bwd_pallas(q, k, v, sri, o, lse, do, causal, window,
+                             scale, block_q, block_k, interpret)
+    dsri = (None if sri is None
+            else np.zeros(sri.shape, jax.dtypes.float0))
+    return dq, dk, dv, dsri
+
+
+_flashmask.defvjp(_flashmask_fwd, _flashmask_bwd)
+
+
+def flashmask_attention_bhsd(q, k, v, startend_row_indices=None, causal=True,
+                             window=None, sm_scale=None,
+                             block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K,
+                             use_pallas=None, interpret=None):
+    """Core entry: q,k,v (B,H,S,D), startend_row_indices (B,H,S_k,n)
+    already broadcast to the q heads. O(S·block) memory on the kernel
+    path; dense reference off-TPU unless interpret is forced."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    if window is not None:
+        window = (int(window), int(window)) if np.isscalar(window) \
+            else (int(window[0]), int(window[1]))
+    if use_pallas is None:
+        use_pallas = _on_tpu() and not pallas_disabled()
+    if interpret is None:
+        interpret = not _on_tpu()
+    if not use_pallas:
+        o, _ = flashmask_reference(q, k, v, startend_row_indices, causal,
+                                   window, scale)
+        return o
+    return _flashmask(q, k, v, startend_row_indices, causal, window,
+                      scale, block_q, block_k, interpret)

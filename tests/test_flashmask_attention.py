@@ -1,0 +1,203 @@
+"""FlashMask pallas kernel vs dense reference (VERDICT r2 item 4).
+
+The kernel path never materializes the (S, S) mask; these tests pin it
+against the dense flashmask_reference in interpret mode, fwd + bwd,
+across every supported (causal, n) mask flavor, ragged shapes, and the
+block-skip edge cases (fully-masked rows/blocks)."""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.flashmask_attention import (flashmask_attention_bhsd,
+                                                flashmask_reference)
+
+
+def _qkv(b, h, s, d, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    return (jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.3,
+            jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.3,
+            jnp.asarray(rng.randn(b, h, s, d), dtype) * 0.3)
+
+
+def _close(a, b, tol=2e-3):
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    assert a.shape == b.shape
+    assert np.max(np.abs(a - b)) < tol, np.max(np.abs(a - b))
+
+
+def _grads(fn, *args):
+    loss = lambda *a: (fn(*a) * a[2]).sum()
+    return jax.value_and_grad(loss, (0, 1, 2))(*args)
+
+
+class TestFlashMaskKernel:
+    def _check(self, sri, causal, s=256, window=None, seed=0, b=2, h=2,
+               d=64, block=128):
+        q, k, v = _qkv(b, h, s, d, seed)
+        o_ref, _ = flashmask_reference(q, k, v, sri, causal, window)
+        o_ker = flashmask_attention_bhsd(
+            q, k, v, sri, causal=causal, window=window, use_pallas=True,
+            interpret=True, block_q=block, block_k=block)
+        _close(o_ker, o_ref)
+        # backward
+        ref_fn = lambda q_, k_, v_: flashmask_reference(
+            q_, k_, v_, sri, causal, window)[0]
+        ker_fn = lambda q_, k_, v_: flashmask_attention_bhsd(
+            q_, k_, v_, sri, causal=causal, window=window, use_pallas=True,
+            interpret=True, block_q=block, block_k=block)
+        _, g_ref = _grads(ref_fn, q, k, v)
+        _, g_ker = _grads(ker_fn, q, k, v)
+        for a, b_ in zip(g_ker, g_ref):
+            _close(a, b_, tol=5e-3)
+
+    def test_causal_n1_lt_start(self):
+        """n=1: rows >= start_j masked (e.g. document-causal cutoff)."""
+        s = 256
+        rng = np.random.RandomState(1)
+        sri = jnp.asarray(rng.randint(1, s + 1, (2, 2, s, 1)), jnp.int32)
+        self._check(sri, causal=True, s=s)
+
+    def test_causal_n2_band(self):
+        s = 256
+        rng = np.random.RandomState(2)
+        start = rng.randint(0, s, (2, 2, s, 1))
+        end = start + rng.randint(0, s // 2, (2, 2, s, 1))
+        sri = jnp.asarray(np.concatenate([start, np.minimum(end, s)], -1),
+                          jnp.int32)
+        self._check(sri, causal=True, s=s)
+
+    def test_noncausal_n2(self):
+        s = 256
+        rng = np.random.RandomState(3)
+        start = rng.randint(s // 2, s + 1, (2, 2, s, 1))
+        end = rng.randint(0, s // 2, (2, 2, s, 1))
+        sri = jnp.asarray(np.concatenate([start, end], -1), jnp.int32)
+        self._check(sri, causal=False, s=s)
+
+    def test_noncausal_n4_two_bands(self):
+        s = 256
+        rng = np.random.RandomState(4)
+        s0 = rng.randint(0, s // 4, (2, 2, s, 1))
+        e0 = s0 + rng.randint(0, s // 4, (2, 2, s, 1))
+        s1 = rng.randint(s // 2, s, (2, 2, s, 1))
+        e1 = s1 + rng.randint(0, s // 4, (2, 2, s, 1))
+        sri = jnp.asarray(np.concatenate(
+            [s0, e0, s1, np.minimum(e1, s)], -1), jnp.int32)
+        self._check(sri, causal=False, s=s)
+
+    def test_sliding_window_no_sri(self):
+        self._check(None, causal=True, s=256, window=(64, 0))
+
+    def test_window_plus_sri(self):
+        s = 256
+        rng = np.random.RandomState(5)
+        sri = jnp.asarray(rng.randint(1, s + 1, (2, 2, s, 1)), jnp.int32)
+        self._check(sri, causal=True, s=s, window=(96, 0))
+
+    def test_ragged_tail_blocks(self):
+        """S not a multiple of the block: padding lanes must weaken, not
+        falsify, the skip predicate."""
+        s = 192  # 1.5 blocks of 128
+        rng = np.random.RandomState(6)
+        sri = jnp.asarray(rng.randint(1, s + 1, (1, 2, s, 1)), jnp.int32)
+        self._check(sri, causal=True, s=s, b=1)
+
+    def test_fully_masked_rows_zero(self):
+        """Rows masked for every key must produce zeros (both paths)."""
+        s = 128
+        sri = jnp.full((1, 1, s, 1), 1, jnp.int32)  # mask all rows >= 1
+        q, k, v = _qkv(1, 1, s, 64, seed=7)
+        o_ker = flashmask_attention_bhsd(q, k, v, sri, causal=True,
+                                         use_pallas=True, interpret=True)
+        # row 0 attends to col 0 only; every other row fully masked -> 0
+        assert np.allclose(np.asarray(o_ker)[0, 0, 1:], 0.0, atol=1e-6)
+        o_ref, _ = flashmask_reference(q, k, v, sri, True, None)
+        _close(o_ker, o_ref)
+
+    def test_block_skip_equals_no_skip(self):
+        """A mask that kills entire blocks (shared document boundary at
+        a block edge) — the skip fast-path must not change results."""
+        s = 512
+        # every column masks rows >= 256: the bottom half of the matrix
+        # is entirely masked -> whole k-blocks skipped for q-blocks >= 2
+        sri = jnp.full((1, 2, s, 1), 256, jnp.int32)
+        self._check(sri, causal=True, s=s, b=1)
+
+    def test_bf16(self):
+        s = 256
+        rng = np.random.RandomState(8)
+        sri = jnp.asarray(rng.randint(1, s + 1, (2, 2, s, 1)), jnp.int32)
+        q, k, v = _qkv(2, 2, s, 64, seed=8, dtype=jnp.bfloat16)
+        o_ref, _ = flashmask_reference(q, k, v, sri, True, None)
+        o_ker = flashmask_attention_bhsd(q, k, v, sri, causal=True,
+                                         use_pallas=True, interpret=True)
+        _close(o_ker, o_ref, tol=2e-2)
+
+    def test_causal_scalar_window_off_tpu(self):
+        """Regression: causal + int window_size through the public
+        wrapper must not crash on the off-TPU reference path."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(10)
+        q = pt.to_tensor(rng.randn(1, 128, 2, 64).astype(np.float32))
+        out = F.flashmask_attention(q, q, q, causal=True, window_size=32)
+        o = np.asarray(out.numpy())
+        assert o.shape == (1, 128, 2, 64) and np.isfinite(o).all()
+
+    def test_training_dropout_actually_drops(self):
+        """dropout>0 + training must change the result (reference
+        semantics: probabilities dropped), not silently no-op."""
+        import paddle_tpu as pt
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(11)
+        s = 128
+        q = pt.to_tensor(rng.randn(1, s, 2, 64).astype(np.float32) * 0.3)
+        sri = pt.to_tensor(rng.randint(1, s + 1, (1, 2, s, 1))
+                           .astype(np.int32))
+        pt.seed(7)
+        o_drop = np.asarray(F.flashmask_attention(
+            q, q, q, startend_row_indices=sri, causal=True, dropout=0.5,
+            training=True).numpy())
+        o_plain = np.asarray(F.flashmask_attention(
+            q, q, q, startend_row_indices=sri, causal=True).numpy())
+        assert np.isfinite(o_drop).all()
+        assert np.max(np.abs(o_drop - o_plain)) > 1e-3
+        # eval mode ignores dropout
+        o_eval = np.asarray(F.flashmask_attention(
+            q, q, q, startend_row_indices=sri, causal=True, dropout=0.5,
+            training=False).numpy())
+        assert np.allclose(o_eval, o_plain, atol=2e-3)
+
+    @pytest.mark.slow
+    def test_long_context_8k_no_dense_mask(self):
+        """VERDICT 'Done' bar: S=8k through the kernel path (O(S·block)
+        memory — a dense f32 mask would be 256 MB/head). Spot-checks a
+        handful of rows against an O(S)-per-row reference."""
+        s, d = 8192, 64
+        rng = np.random.RandomState(9)
+        q, k, v = _qkv(1, 1, s, d, seed=9)
+        # document-mask: tokens attend only within their 1k-doc —
+        # each key column masks every row >= its doc's end boundary
+        doc = np.arange(s) // 1024
+        sri = jnp.asarray(((doc + 1) * 1024)[None, None, :, None],
+                          jnp.int32)
+        o = flashmask_attention_bhsd(q, k, v, sri, causal=True,
+                                     use_pallas=True, interpret=True,
+                                     block_q=512, block_k=512)
+        o = np.asarray(o)
+        assert np.isfinite(o).all()
+        qn = np.asarray(q, np.float32)
+        kn = np.asarray(k, np.float32)
+        vn = np.asarray(v, np.float32)
+        for r in (0, 700, 1024, 5000, 8191):
+            lo = (r // 1024) * 1024
+            cols = np.arange(lo, r + 1)  # in-doc causal window
+            sc = qn[0, 0, r] @ kn[0, 0, cols].T / math.sqrt(d)
+            p = np.exp(sc - sc.max())
+            p /= p.sum()
+            exp = p @ vn[0, 0, cols]
+            assert np.allclose(o[0, 0, r], exp, atol=2e-3), r

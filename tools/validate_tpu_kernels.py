@@ -157,6 +157,58 @@ def paged_decode():
     return {"max_err": round(err, 6)}
 
 
+def flashmask_fwd_bwd():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.flashmask_attention import (flashmask_attention_bhsd,
+                                                    flashmask_reference)
+    rng = np.random.RandomState(5)
+    errs = {}
+    for (b, h, s, d), causal, n in [
+        ((2, 2, 512, 64), True, 1),    # document-causal cutoff
+        ((2, 2, 512, 64), True, 2),    # causal band
+        ((1, 2, 512, 128), False, 2),  # bidirectional start/end
+        ((1, 2, 384, 64), True, 1),    # ragged tail block
+    ]:
+        q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32) * 0.3
+        k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32) * 0.3
+        v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32) * 0.3
+        if causal and n == 1:
+            sri = rng.randint(1, s + 1, (b, h, s, 1))
+        elif causal and n == 2:
+            st = rng.randint(0, s, (b, h, s, 1))
+            sri = np.concatenate(
+                [st, np.minimum(st + rng.randint(0, s // 2, st.shape), s)],
+                -1)
+        else:
+            sri = np.concatenate([rng.randint(s // 2, s + 1, (b, h, s, 1)),
+                                  rng.randint(0, s // 2, (b, h, s, 1))], -1)
+        sri = jnp.asarray(sri, jnp.int32)
+
+        def loss_k(q_, k_, v_):
+            o = flashmask_attention_bhsd(q_, k_, v_, sri, causal=causal,
+                                         use_pallas=True, interpret=False)
+            return (o * v_).sum(), o
+
+        def loss_r(q_, k_, v_):
+            o, _ = flashmask_reference(q_, k_, v_, sri, causal, None)
+            return (o * v_).sum(), o
+
+        (_, o_k), g_k = jax.value_and_grad(loss_k, (0, 1, 2),
+                                           has_aux=True)(q, k, v)
+        (_, o_r), g_r = jax.value_and_grad(loss_r, (0, 1, 2),
+                                           has_aux=True)(q, k, v)
+        eo = max_err(o_k, o_r)
+        eg = max(max_err(a, b2) for a, b2 in zip(g_k, g_r))
+        gmag = max(float(np.abs(np.asarray(g, np.float32)).max())
+                   for g in g_r)
+        key = f"{b}x{h}x{s}x{d}{'c' if causal else ''}n{n}"
+        errs[key] = (round(eo, 5), round(eg / max(gmag, 1.0), 5))
+        assert eo < 2e-3, f"{key}: fwd err {eo}"
+        assert eg / max(gmag, 1.0) < 2e-3, f"{key}: bwd rel err"
+    return errs
+
+
 def flash_bf16_long():
     """bf16 @ 4096 ctx — the bench's serving-relevant shape, on-chip."""
     import jax.numpy as jnp
@@ -184,6 +236,7 @@ def main():
     ok &= check("flash_attention fwd+bwd", flash_fwd_bwd)
     ok &= check("varlen flash_attn_unpadded fwd+bwd", varlen_fwd_bwd)
     ok &= check("paged_attention decode", paged_decode)
+    ok &= check("flashmask fwd+bwd", flashmask_fwd_bwd)
     ok &= check("flash bf16 4k-ctx", flash_bf16_long)
     out = {"device": str(dev), "ok": bool(ok), "results": RESULTS}
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
