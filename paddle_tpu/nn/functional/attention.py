@@ -213,6 +213,12 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     from ...ops.flashmask_attention import (flashmask_attention_bhsd,
                                             flashmask_reference)
     use_dropout = dropout > 0.0 and training
+    # key drawn OUTSIDE fn: tape backward re-executes fn via jax.vjp, and
+    # an in-fn next_key() would give the backward a different dropout
+    # mask than the forward (see _dropout_impl in common.py)
+    if use_dropout:
+        from ..._core.state import prng
+        dropout_key = prng.next_key()
 
     def fn(q, k, v, *rest):
         qh = jnp.swapaxes(q, 1, 2)
@@ -228,10 +234,9 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
             if sri.shape[1] != h:
                 sri = jnp.repeat(sri, h // sri.shape[1], axis=1)
         if use_dropout:
-            from ..._core.state import prng
             out, _ = flashmask_reference(qh, kh, vh, sri, causal,
                                          window_size, dropout=dropout,
-                                         dropout_key=prng.next_key())
+                                         dropout_key=dropout_key)
         else:
             out = flashmask_attention_bhsd(qh, kh, vh, sri, causal=causal,
                                            window=window_size)

@@ -355,6 +355,16 @@ def _mem_spec():
             if mem else pl.BlockSpec(bs, im))
 
 
+def _mk_kernel(fn, have_sri, **kw):
+    """Bind statics; when sri is absent, shim a None into the kernel's
+    sri_ref slot so one kernel body serves both signatures."""
+    if have_sri:
+        return functools.partial(fn, **kw)
+    return functools.partial(
+        lambda q_, k_, v_, *rest, **kw2: fn(q_, k_, v_, None, *rest, **kw2),
+        **kw)
+
+
 def _fwd_pallas(q, k, v, sri, causal, window, scale, block_q, block_k,
                 interpret):
     scale = np.float32(scale)
@@ -376,16 +386,10 @@ def _fwd_pallas(q, k, v, sri, causal, window, scale, block_q, block_k,
         in_specs.append(spec((1, n_sri, block_k),
                              lambda bh_, qi, ki: (bh_, Z, ki)))
         args.append(srir)
-        kernel = functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, window=window,
-            n_sri=n_sri, block_q=block_q, block_k=block_k, n_k=n_k,
-            sq=sq, sk=sk)
-    else:
-        kernel = functools.partial(
-            lambda q_, k_, v_, *rest, **kw: _fwd_kernel(
-                q_, k_, v_, None, *rest, **kw),
-            scale=scale, causal=causal, window=window, n_sri=0,
-            block_q=block_q, block_k=block_k, n_k=n_k, sq=sq, sk=sk)
+    kernel = _mk_kernel(_fwd_kernel, srir is not None, scale=scale,
+                        causal=causal, window=window, n_sri=n_sri,
+                        block_q=block_q, block_k=block_k, n_k=n_k,
+                        sq=sq, sk=sk)
 
     o, lse = pl.pallas_call(
         kernel,
@@ -450,17 +454,10 @@ def _bwd_pallas(q, k, v, sri, o, lse, do, causal, window, scale,
 
     base_args = [qr, kr, vr] + ([srir] if srir is not None else [])
 
-    def mk_kernel(fn, **kw):
-        if srir is not None:
-            return functools.partial(fn, **kw)
-        return functools.partial(
-            lambda q_, k_, v_, *rest, **kw2: fn(q_, k_, v_, None, *rest,
-                                                **kw2), **kw)
-
     dq = pl.pallas_call(
-        mk_kernel(_bwd_dq_kernel, scale=scale, causal=causal, window=window,
-                  n_sri=n_sri, block_q=block_q, block_k=block_k, n_k=n_k,
-                  sq=sq, sk=sk),
+        _mk_kernel(_bwd_dq_kernel, srir is not None, scale=scale,
+                   causal=causal, window=window, n_sri=n_sri,
+                   block_q=block_q, block_k=block_k, n_k=n_k, sq=sq, sk=sk),
         grid=(bh, n_q, n_k),
         in_specs=specs(dq_order),
         out_specs=[spec((1, block_q, d), dq_order("q"))],
@@ -471,9 +468,9 @@ def _bwd_pallas(q, k, v, sri, o, lse, do, causal, window, scale,
     )(*base_args, dor, lser, deltar)[0]
 
     dk, dv = pl.pallas_call(
-        mk_kernel(_bwd_dkv_kernel, scale=scale, causal=causal, window=window,
-                  n_sri=n_sri, block_q=block_q, block_k=block_k, n_q=n_q,
-                  sq=sq, sk=sk),
+        _mk_kernel(_bwd_dkv_kernel, srir is not None, scale=scale,
+                   causal=causal, window=window, n_sri=n_sri,
+                   block_q=block_q, block_k=block_k, n_q=n_q, sq=sq, sk=sk),
         grid=(bh, n_k, n_q),
         in_specs=specs(dkv_order),
         out_specs=[

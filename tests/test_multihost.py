@@ -56,6 +56,46 @@ def test_single_process_launch_unchanged():
     assert "JAX_NUM_PROCESSES" not in env
 
 
+def _run_4d(mode):
+    port = _free_port()
+    child = os.path.join(HERE, "_mh_4d_child.py")
+    from paddle_tpu.distributed.launch import build_env
+
+    procs = []
+    for rank in range(2):
+        env = build_env(2, rank, f"127.0.0.1:{port}", base_env=os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, child, mode], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    lines = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"{mode} child failed:\n{err[-2500:]}"
+        lines.append([l for l in out.splitlines()
+                      if l.startswith("4D_OK")][0])
+    # both ranks observed the identical (replicated) loss trajectory
+    assert lines[0].split("losses=")[1] == lines[1].split("losses=")[1]
+
+
+def test_two_process_tensor_parallel_spanning():
+    """tp=2 spans the process boundary: every megatron collective of the
+    llama step crosses processes; loss == single-device reference."""
+    _run_4d("tp")
+
+
+def test_two_process_pipeline_spanning():
+    """pp=2 spans the process boundary: every ppermute activation hop
+    crosses processes (GPipe scan)."""
+    _run_4d("pp")
+
+
+def test_two_process_pipeline_1f1b_spanning():
+    """1F1B across the process boundary: forward activations and
+    backward gradients ride cross-process ppermutes in the same tick."""
+    _run_4d("pp1f1b")
+
+
 def test_two_process_data_parallel_training():
     """Beyond rendezvous: an actual 2-process data-parallel TRAINING run.
     Batch sharded over a cross-process dp axis, GSPMD inserts the grad
