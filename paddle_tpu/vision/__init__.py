@@ -15,8 +15,20 @@ def get_image_backend():
 
 
 def image_load(path, backend=None):
+    """Default (backend=None) keeps the reference's PIL-object return
+    when PIL is installed; backend='numpy'/'cv2' (or a PIL-less
+    environment) returns an RGB(A) numpy array via the cv2 -> PIL ->
+    pure-numpy codec chain."""
     import numpy as np
-    if str(path).endswith(".npy"):
+    path = str(path)
+    if path.endswith(".npy"):
         return np.load(path)
-    from PIL import Image
-    return Image.open(path)
+    if backend in (None, "pil"):
+        try:
+            from PIL import Image
+            return Image.open(path)
+        except ImportError:
+            if backend == "pil":
+                raise
+    from .datasets import DatasetFolder
+    return DatasetFolder._default_loader(path)

@@ -1,0 +1,573 @@
+"""Pure-numpy image codecs — no PIL/cv2 required (VERDICT r3 item 7).
+
+The reference decodes JPEG on GPU via nvjpeg
+(paddle/phi/kernels/gpu/decode_jpeg_kernel.cu); on TPU the decode is a
+host-CPU concern, so this module provides a dependency-free baseline:
+
+  * JPEG: baseline sequential DCT (SOF0), 8-bit, grayscale/4:4:4/4:2:0,
+    restart markers, both decode and encode (encode exists so tests and
+    offline dataset tooling can produce real bitstreams hermetically).
+  * PNG: 8-bit gray/RGB/RGBA via stdlib zlib, all five filters, decode
+    and encode.
+
+vision/ops.decode_jpeg prefers cv2/PIL when installed (C-speed) and
+falls back here; correctness of this module is pinned against the
+faster decoders in tests when those are available.
+"""
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# shared JPEG tables
+# ---------------------------------------------------------------------------
+ZIGZAG = np.array([
+    0, 1, 8, 16, 9, 2, 3, 10, 17, 24, 32, 25, 18, 11, 4, 5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6, 7, 14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63],
+    np.int32)
+
+# ITU-T T.81 Annex K quantization tables (luma, chroma), quality 50 base
+QTAB_LUMA = np.array([
+    16, 11, 10, 16, 24, 40, 51, 61, 12, 12, 14, 19, 26, 58, 60, 55,
+    14, 13, 16, 24, 40, 57, 69, 56, 14, 17, 22, 29, 51, 87, 80, 62,
+    18, 22, 37, 56, 68, 109, 103, 77, 24, 35, 55, 64, 81, 104, 113, 92,
+    49, 64, 78, 87, 103, 121, 120, 101, 72, 92, 95, 98, 112, 100, 103,
+    99], np.int32)
+QTAB_CHROMA = np.array([
+    17, 18, 24, 47, 99, 99, 99, 99, 18, 21, 26, 66, 99, 99, 99, 99,
+    24, 26, 56, 99, 99, 99, 99, 99, 47, 66, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99],
+    np.int32)
+
+# Annex K typical Huffman tables: (bits[1..16], values)
+DC_LUMA = ([0, 1, 5, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0, 0],
+           list(range(12)))
+DC_CHROMA = ([0, 3, 1, 1, 1, 1, 1, 1, 1, 1, 1, 0, 0, 0, 0, 0],
+             list(range(12)))
+AC_LUMA = ([0, 2, 1, 3, 3, 2, 4, 3, 5, 5, 4, 4, 0, 0, 1, 0x7D], [
+    0x01, 0x02, 0x03, 0x00, 0x04, 0x11, 0x05, 0x12, 0x21, 0x31, 0x41,
+    0x06, 0x13, 0x51, 0x61, 0x07, 0x22, 0x71, 0x14, 0x32, 0x81, 0x91,
+    0xA1, 0x08, 0x23, 0x42, 0xB1, 0xC1, 0x15, 0x52, 0xD1, 0xF0, 0x24,
+    0x33, 0x62, 0x72, 0x82, 0x09, 0x0A, 0x16, 0x17, 0x18, 0x19, 0x1A,
+    0x25, 0x26, 0x27, 0x28, 0x29, 0x2A, 0x34, 0x35, 0x36, 0x37, 0x38,
+    0x39, 0x3A, 0x43, 0x44, 0x45, 0x46, 0x47, 0x48, 0x49, 0x4A, 0x53,
+    0x54, 0x55, 0x56, 0x57, 0x58, 0x59, 0x5A, 0x63, 0x64, 0x65, 0x66,
+    0x67, 0x68, 0x69, 0x6A, 0x73, 0x74, 0x75, 0x76, 0x77, 0x78, 0x79,
+    0x7A, 0x83, 0x84, 0x85, 0x86, 0x87, 0x88, 0x89, 0x8A, 0x92, 0x93,
+    0x94, 0x95, 0x96, 0x97, 0x98, 0x99, 0x9A, 0xA2, 0xA3, 0xA4, 0xA5,
+    0xA6, 0xA7, 0xA8, 0xA9, 0xAA, 0xB2, 0xB3, 0xB4, 0xB5, 0xB6, 0xB7,
+    0xB8, 0xB9, 0xBA, 0xC2, 0xC3, 0xC4, 0xC5, 0xC6, 0xC7, 0xC8, 0xC9,
+    0xCA, 0xD2, 0xD3, 0xD4, 0xD5, 0xD6, 0xD7, 0xD8, 0xD9, 0xDA, 0xE1,
+    0xE2, 0xE3, 0xE4, 0xE5, 0xE6, 0xE7, 0xE8, 0xE9, 0xEA, 0xF1, 0xF2,
+    0xF3, 0xF4, 0xF5, 0xF6, 0xF7, 0xF8, 0xF9, 0xFA])
+AC_CHROMA = ([0, 2, 1, 2, 4, 4, 3, 4, 7, 5, 4, 4, 0, 1, 2, 0x77], [
+    0x00, 0x01, 0x02, 0x03, 0x11, 0x04, 0x05, 0x21, 0x31, 0x06, 0x12,
+    0x41, 0x51, 0x07, 0x61, 0x71, 0x13, 0x22, 0x32, 0x81, 0x08, 0x14,
+    0x42, 0x91, 0xA1, 0xB1, 0xC1, 0x09, 0x23, 0x33, 0x52, 0xF0, 0x15,
+    0x62, 0x72, 0xD1, 0x0A, 0x16, 0x24, 0x34, 0xE1, 0x25, 0xF1, 0x17,
+    0x18, 0x19, 0x1A, 0x26, 0x27, 0x28, 0x29, 0x2A, 0x35, 0x36, 0x37,
+    0x38, 0x39, 0x3A, 0x43, 0x44, 0x45, 0x46, 0x47, 0x48, 0x49, 0x4A,
+    0x53, 0x54, 0x55, 0x56, 0x57, 0x58, 0x59, 0x5A, 0x63, 0x64, 0x65,
+    0x66, 0x67, 0x68, 0x69, 0x6A, 0x73, 0x74, 0x75, 0x76, 0x77, 0x78,
+    0x79, 0x7A, 0x82, 0x83, 0x84, 0x85, 0x86, 0x87, 0x88, 0x89, 0x8A,
+    0x92, 0x93, 0x94, 0x95, 0x96, 0x97, 0x98, 0x99, 0x9A, 0xA2, 0xA3,
+    0xA4, 0xA5, 0xA6, 0xA7, 0xA8, 0xA9, 0xAA, 0xB2, 0xB3, 0xB4, 0xB5,
+    0xB6, 0xB7, 0xB8, 0xB9, 0xBA, 0xC2, 0xC3, 0xC4, 0xC5, 0xC6, 0xC7,
+    0xC8, 0xC9, 0xCA, 0xD2, 0xD3, 0xD4, 0xD5, 0xD6, 0xD7, 0xD8, 0xD9,
+    0xDA, 0xE2, 0xE3, 0xE4, 0xE5, 0xE6, 0xE7, 0xE8, 0xE9, 0xEA, 0xF2,
+    0xF3, 0xF4, 0xF5, 0xF6, 0xF7, 0xF8, 0xF9, 0xFA])
+
+_C = np.array([1.0 / np.sqrt(2)] + [1.0] * 7)
+_DCT = np.array([[np.cos((2 * x + 1) * u * np.pi / 16) for x in range(8)]
+                 for u in range(8)]) * _C[:, None] / 2.0  # orthonormal-ish
+
+
+def _idct2(block):
+    return _DCT.T @ block @ _DCT
+
+
+def _dct2(block):
+    return _DCT @ block @ _DCT.T
+
+
+# ---------------------------------------------------------------------------
+# Huffman
+# ---------------------------------------------------------------------------
+def _build_decode_table(bits, values):
+    """(length, code) -> value map plus min/max code per length."""
+    table = {}
+    code = 0
+    k = 0
+    for length in range(1, 17):
+        for _ in range(bits[length - 1]):
+            table[(length, code)] = values[k]
+            code += 1
+            k += 1
+        code <<= 1
+    return table
+
+
+def _build_encode_table(bits, values):
+    table = {}
+    code = 0
+    k = 0
+    for length in range(1, 17):
+        for _ in range(bits[length - 1]):
+            table[values[k]] = (code, length)
+            code += 1
+            k += 1
+        code <<= 1
+    return table
+
+
+class _BitReader:
+    """MSB-first bit reader over entropy-coded data with 0xFF00
+    unstuffing and restart-marker awareness."""
+
+    def __init__(self, data):
+        self.data = data
+        self.pos = 0
+        self.buf = 0
+        self.nbits = 0
+
+    def _fill(self):
+        while self.nbits <= 24:
+            if self.pos >= len(self.data):
+                self.buf = (self.buf << 8) | 0  # pad: spec allows 1s/0s
+                self.nbits += 8
+                continue
+            b = self.data[self.pos]
+            if b == 0xFF:
+                nxt = self.data[self.pos + 1] if self.pos + 1 < \
+                    len(self.data) else 0
+                if nxt == 0x00:
+                    self.pos += 2
+                elif 0xD0 <= nxt <= 0xD7:  # restart marker: stop fill
+                    self.buf = (self.buf << 8) | 0
+                    self.nbits += 8
+                    continue
+                else:  # EOI or other marker
+                    self.buf = (self.buf << 8) | 0
+                    self.nbits += 8
+                    continue
+            else:
+                self.pos += 1
+            self.buf = (self.buf << 8) | b
+            self.nbits += 8
+
+    def read_bit(self):
+        self._fill()
+        self.nbits -= 1
+        return (self.buf >> self.nbits) & 1
+
+    def read_bits(self, n):
+        v = 0
+        for _ in range(n):
+            v = (v << 1) | self.read_bit()
+        return v
+
+    def align_restart(self):
+        """Skip to just past the next restart marker."""
+        self.buf = 0
+        self.nbits = 0
+        while self.pos + 1 < len(self.data):
+            if self.data[self.pos] == 0xFF and \
+                    0xD0 <= self.data[self.pos + 1] <= 0xD7:
+                self.pos += 2
+                return
+            self.pos += 1
+        self.pos = len(self.data)
+
+
+def _decode_huff(reader, table):
+    code = 0
+    for length in range(1, 17):
+        code = (code << 1) | reader.read_bit()
+        if (length, code) in table:
+            return table[(length, code)]
+    raise ValueError("bad huffman code")
+
+
+def _extend(v, t):
+    """JPEG EXTEND: t-bit raw value -> signed coefficient."""
+    return v if v >= (1 << (t - 1)) else v - (1 << t) + 1
+
+
+# ---------------------------------------------------------------------------
+# JPEG decode
+# ---------------------------------------------------------------------------
+def decode_jpeg_np(data):
+    """Baseline JPEG bytes -> (H, W) uint8 gray or (H, W, 3) uint8 RGB."""
+    data = bytes(data)
+    if data[:2] != b"\xff\xd8":
+        raise ValueError("not a JPEG (missing SOI)")
+    pos = 2
+    qtabs = {}
+    huff_dc, huff_ac = {}, {}
+    frame = None
+    restart = 0
+    while pos < len(data):
+        assert data[pos] == 0xFF, f"marker expected at {pos}"
+        marker = data[pos + 1]
+        pos += 2
+        if marker == 0xD9:  # EOI
+            break
+        if marker in (0x01,) or 0xD0 <= marker <= 0xD7:
+            continue
+        seglen = struct.unpack(">H", data[pos:pos + 2])[0]
+        seg = data[pos + 2:pos + seglen]
+        if marker == 0xDB:  # DQT
+            p = 0
+            while p < len(seg):
+                pq, tq = seg[p] >> 4, seg[p] & 15
+                p += 1
+                if pq:
+                    tab = np.frombuffer(seg[p:p + 128], ">u2").astype(
+                        np.int32)
+                    p += 128
+                else:
+                    tab = np.frombuffer(seg[p:p + 64], np.uint8).astype(
+                        np.int32)
+                    p += 64
+                qtabs[tq] = tab
+        elif marker == 0xC4:  # DHT
+            p = 0
+            while p < len(seg):
+                tc, th = seg[p] >> 4, seg[p] & 15
+                bits = list(seg[p + 1:p + 17])
+                n = sum(bits)
+                values = list(seg[p + 17:p + 17 + n])
+                tab = _build_decode_table(bits, values)
+                (huff_ac if tc else huff_dc)[th] = tab
+                p += 17 + n
+        elif marker in (0xC0, 0xC1):  # SOF0/1 baseline
+            prec, h, w, nc = seg[0], \
+                struct.unpack(">H", seg[1:3])[0], \
+                struct.unpack(">H", seg[3:5])[0], seg[5]
+            assert prec == 8, "only 8-bit JPEG supported"
+            comps = []
+            for i in range(nc):
+                cid, hv, tq = seg[6 + 3 * i], seg[7 + 3 * i], seg[8 + 3 * i]
+                comps.append({"id": cid, "h": hv >> 4, "v": hv & 15,
+                              "tq": tq})
+            frame = {"h": h, "w": w, "comps": comps}
+        elif marker in (0xC2, 0xC3, 0xC5, 0xC6, 0xC7, 0xC9, 0xCA, 0xCB,
+                        0xCD, 0xCE, 0xCF):
+            raise ValueError(f"unsupported JPEG type (SOF{marker - 0xC0}); "
+                             "only baseline sequential is implemented")
+        elif marker == 0xDD:  # DRI
+            restart = struct.unpack(">H", seg[:2])[0]
+        elif marker == 0xDA:  # SOS
+            ns = seg[0]
+            sel = {}
+            for i in range(ns):
+                cs, tt = seg[1 + 2 * i], seg[2 + 2 * i]
+                sel[cs] = (tt >> 4, tt & 15)
+            scan = data[pos + seglen:]
+            return _decode_scan(scan, frame, sel, qtabs, huff_dc, huff_ac,
+                                restart)
+        pos += seglen
+    raise ValueError("no SOS segment found")
+
+
+def _decode_scan(scan, frame, sel, qtabs, huff_dc, huff_ac, restart):
+    h, w, comps = frame["h"], frame["w"], frame["comps"]
+    hmax = max(c["h"] for c in comps)
+    vmax = max(c["v"] for c in comps)
+    mcux = -(-w // (8 * hmax))
+    mcuy = -(-h // (8 * vmax))
+    planes = []
+    for c in comps:
+        planes.append(np.zeros((mcuy * c["v"] * 8, mcux * c["h"] * 8),
+                               np.float64))
+    reader = _BitReader(scan)
+    pred = [0] * len(comps)
+    mcu_count = 0
+    for my in range(mcuy):
+        for mx in range(mcux):
+            if restart and mcu_count and mcu_count % restart == 0:
+                reader.align_restart()
+                pred = [0] * len(comps)
+            for ci, c in enumerate(comps):
+                dct, act = sel[c["id"]]
+                for by in range(c["v"]):
+                    for bx in range(c["h"]):
+                        block = np.zeros(64, np.float64)
+                        t = _decode_huff(reader, huff_dc[dct])
+                        diff = _extend(reader.read_bits(t), t) if t else 0
+                        pred[ci] += diff
+                        block[0] = pred[ci]
+                        kk = 1
+                        while kk < 64:
+                            rs = _decode_huff(reader, huff_ac[act])
+                            r, s = rs >> 4, rs & 15
+                            if s == 0:
+                                if r == 15:
+                                    kk += 16
+                                    continue
+                                break  # EOB
+                            kk += r
+                            block[kk] = _extend(reader.read_bits(s), s)
+                            kk += 1
+                        block = block * qtabs[c["tq"]]
+                        deq = np.zeros(64, np.float64)
+                        deq[ZIGZAG] = block
+                        pix = _idct2(deq.reshape(8, 8)) + 128.0
+                        y0 = (my * c["v"] + by) * 8
+                        x0 = (mx * c["h"] + bx) * 8
+                        planes[ci][y0:y0 + 8, x0:x0 + 8] = pix
+            mcu_count += 1
+    # upsample to full res and crop
+    full = []
+    for c, p in zip(comps, planes):
+        ry, rx = vmax // c["v"], hmax // c["h"]
+        if ry > 1 or rx > 1:
+            p = np.repeat(np.repeat(p, ry, axis=0), rx, axis=1)
+        full.append(p[:h, :w])
+    if len(full) == 1:
+        return np.clip(full[0] + 0.5, 0, 255).astype(np.uint8)
+    y, cb, cr = full[0], full[1] - 128.0, full[2] - 128.0
+    r = y + 1.402 * cr
+    g = y - 0.344136 * cb - 0.714136 * cr
+    b = y + 1.772 * cb
+    return np.clip(np.stack([r, g, b], -1) + 0.5, 0, 255).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# JPEG encode (baseline, 4:4:4 / grayscale)
+# ---------------------------------------------------------------------------
+class _BitWriter:
+    def __init__(self):
+        self.out = bytearray()
+        self.acc = 0
+        self.n = 0
+
+    def write(self, code, length):
+        self.acc = (self.acc << length) | (code & ((1 << length) - 1))
+        self.n += length
+        while self.n >= 8:
+            self.n -= 8
+            b = (self.acc >> self.n) & 0xFF
+            self.out.append(b)
+            if b == 0xFF:
+                self.out.append(0x00)
+
+    def flush(self):
+        if self.n:
+            self.write((1 << (8 - self.n)) - 1, 8 - self.n)
+
+
+def _quality_scale(q, tab):
+    q = max(1, min(100, int(q)))
+    s = 5000 // q if q < 50 else 200 - 2 * q
+    t = np.clip((tab * s + 50) // 100, 1, 255)
+    return t.astype(np.int32)
+
+
+def encode_jpeg_np(img, quality=90):
+    """(H, W) or (H, W, 3) uint8 -> baseline JPEG bytes (4:4:4)."""
+    img = np.asarray(img, np.uint8)
+    gray = img.ndim == 2
+    h, w = img.shape[:2]
+    if gray:
+        planes = [img.astype(np.float64)]
+    else:
+        rgb = img.astype(np.float64)
+        y = 0.299 * rgb[..., 0] + 0.587 * rgb[..., 1] + 0.114 * rgb[..., 2]
+        cb = -0.168736 * rgb[..., 0] - 0.331264 * rgb[..., 1] \
+            + 0.5 * rgb[..., 2] + 128.0
+        cr = 0.5 * rgb[..., 0] - 0.418688 * rgb[..., 1] \
+            - 0.081312 * rgb[..., 2] + 128.0
+        planes = [y, cb, cr]
+    qs = [_quality_scale(quality, QTAB_LUMA)]
+    if not gray:
+        qs.append(_quality_scale(quality, QTAB_CHROMA))
+
+    out = bytearray(b"\xff\xd8")  # SOI
+
+    def seg(marker, payload):
+        out.extend(marker)
+        out.extend(struct.pack(">H", len(payload) + 2))
+        out.extend(payload)
+
+    # DQT payload and in-loop division both use ZIGZAG order (the qs
+    # tables are in natural order): zz_tab[i] = natural_tab[ZIGZAG[i]]
+    for i, qt in enumerate(qs):
+        seg(b"\xff\xdb", bytes([i]) + bytes(qt[ZIGZAG].astype(np.uint8)))
+    nc = 1 if gray else 3
+    sof = bytes([8]) + struct.pack(">HH", h, w) + bytes([nc])
+    for i in range(nc):
+        sof += bytes([i + 1, 0x11, 0 if i == 0 else 1])
+    seg(b"\xff\xc0", sof)
+    tabs = [(0x00, DC_LUMA), (0x10, AC_LUMA)]
+    if not gray:
+        tabs += [(0x01, DC_CHROMA), (0x11, AC_CHROMA)]
+    for tclass, (bits, values) in tabs:
+        seg(b"\xff\xc4", bytes([tclass]) + bytes(bits) + bytes(values))
+    sos = bytes([nc])
+    for i in range(nc):
+        sos += bytes([i + 1, 0x00 if i == 0 else 0x11])
+    sos += bytes([0, 63, 0])
+    seg(b"\xff\xda", sos)
+
+    enc_dc = [_build_encode_table(*DC_LUMA)]
+    enc_ac = [_build_encode_table(*AC_LUMA)]
+    if not gray:
+        enc_dc.append(_build_encode_table(*DC_CHROMA))
+        enc_ac.append(_build_encode_table(*AC_CHROMA))
+
+    bw = _BitWriter()
+    ph = -(-h // 8) * 8
+    pw = -(-w // 8) * 8
+    padded = []
+    for p in planes:
+        pp = np.empty((ph, pw), np.float64)
+        pp[:h, :w] = p
+        pp[h:, :w] = p[h - 1:h, :]
+        pp[:, w:] = pp[:, w - 1:w]
+        padded.append(pp)
+    pred = [0] * len(planes)
+    for by in range(ph // 8):
+        for bx in range(pw // 8):
+            for ci, p in enumerate(padded):
+                ti = 0 if ci == 0 else 1
+                qt = qs[ti][ZIGZAG].astype(np.float64)  # zigzag order
+                block = p[by * 8:by * 8 + 8, bx * 8:bx * 8 + 8]
+                coef = _dct2(block - 128.0)
+                zz = coef.reshape(64)[ZIGZAG]
+                zz = np.round(zz / qt).astype(np.int64)
+                diff = int(zz[0]) - pred[ci]
+                pred[ci] = int(zz[0])
+                # DC
+                mag = int(diff)
+                t = 0 if mag == 0 else int(np.floor(np.log2(abs(mag)))) + 1
+                code, ln = enc_dc[ti][t]
+                bw.write(code, ln)
+                if t:
+                    raw = mag if mag >= 0 else mag + (1 << t) - 1
+                    bw.write(raw, t)
+                # AC with run-lengths
+                run = 0
+                for kk in range(1, 64):
+                    v = int(zz[kk])
+                    if v == 0:
+                        run += 1
+                        continue
+                    while run > 15:
+                        code, ln = enc_ac[ti][0xF0]
+                        bw.write(code, ln)
+                        run -= 16
+                    t = int(np.floor(np.log2(abs(v)))) + 1
+                    code, ln = enc_ac[ti][(run << 4) | t]
+                    bw.write(code, ln)
+                    raw = v if v >= 0 else v + (1 << t) - 1
+                    bw.write(raw, t)
+                    run = 0
+                if run:
+                    code, ln = enc_ac[ti][0x00]
+                    bw.write(code, ln)
+    bw.flush()
+    out.extend(bw.out)
+    out.extend(b"\xff\xd9")
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# PNG
+# ---------------------------------------------------------------------------
+_PNG_SIG = b"\x89PNG\r\n\x1a\n"
+
+
+def decode_png_np(data):
+    """PNG bytes -> (H, W[, C]) uint8. 8-bit gray/RGB/RGBA/gray+alpha."""
+    data = bytes(data)
+    assert data[:8] == _PNG_SIG, "not a PNG"
+    pos = 8
+    idat = bytearray()
+    meta = None
+    while pos < len(data):
+        ln = struct.unpack(">I", data[pos:pos + 4])[0]
+        typ = data[pos + 4:pos + 8]
+        body = data[pos + 8:pos + 8 + ln]
+        pos += 12 + ln
+        if typ == b"IHDR":
+            w, h, depth, ctype, comp, filt, inter = struct.unpack(
+                ">IIBBBBB", body)
+            assert depth == 8, "only 8-bit PNG supported"
+            assert inter == 0, "interlaced PNG unsupported"
+            nch = {0: 1, 2: 3, 4: 2, 6: 4}[ctype]
+            meta = (w, h, nch)
+        elif typ == b"IDAT":
+            idat.extend(body)
+        elif typ == b"IEND":
+            break
+    w, h, nch = meta
+    raw = zlib.decompress(bytes(idat))
+    stride = w * nch
+    img = np.zeros((h, stride), np.uint8)
+    prev = np.zeros(stride, np.int32)
+    p = 0
+    for row in range(h):
+        ftype = raw[p]
+        line = np.frombuffer(raw[p + 1:p + 1 + stride],
+                             np.uint8).astype(np.int32)
+        p += 1 + stride
+        if ftype == 0:
+            cur = line
+        elif ftype == 1:  # Sub
+            cur = line.copy()
+            for i in range(nch, stride):
+                cur[i] = (cur[i] + cur[i - nch]) & 0xFF
+        elif ftype == 2:  # Up
+            cur = (line + prev) & 0xFF
+        elif ftype == 3:  # Average
+            cur = line.copy()
+            for i in range(stride):
+                left = cur[i - nch] if i >= nch else 0
+                cur[i] = (cur[i] + ((left + prev[i]) >> 1)) & 0xFF
+        elif ftype == 4:  # Paeth
+            cur = line.copy()
+            for i in range(stride):
+                a = cur[i - nch] if i >= nch else 0
+                b = prev[i]
+                c = prev[i - nch] if i >= nch else 0
+                pa, pb, pc = abs(b - c), abs(a - c), abs(a + b - 2 * c)
+                pr = a if pa <= pb and pa <= pc else (b if pb <= pc else c)
+                cur[i] = (cur[i] + pr) & 0xFF
+        else:
+            raise ValueError(f"bad PNG filter {ftype}")
+        img[row] = cur.astype(np.uint8)
+        prev = cur
+    img = img.reshape(h, w, nch)
+    return img[..., 0] if nch == 1 else img
+
+
+def encode_png_np(img):
+    """(H, W[, C]) uint8 -> PNG bytes (filter 0, zlib default)."""
+    img = np.asarray(img, np.uint8)
+    if img.ndim == 2:
+        img = img[..., None]
+    h, w, nch = img.shape
+    ctype = {1: 0, 2: 4, 3: 2, 4: 6}[nch]
+    raw = bytearray()
+    for row in range(h):
+        raw.append(0)
+        raw.extend(img[row].tobytes())
+    out = bytearray(_PNG_SIG)
+
+    def chunk(typ, body):
+        out.extend(struct.pack(">I", len(body)))
+        out.extend(typ)
+        out.extend(body)
+        out.extend(struct.pack(">I", zlib.crc32(typ + body) & 0xFFFFFFFF))
+
+    chunk(b"IHDR", struct.pack(">IIBBBBB", w, h, 8, ctype, 0, 0, 0))
+    chunk(b"IDAT", zlib.compress(bytes(raw), 6))
+    chunk(b"IEND", b"")
+    return bytes(out)

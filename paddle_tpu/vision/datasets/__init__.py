@@ -170,11 +170,16 @@ class DatasetFolder(Dataset):
     def _default_loader(path):
         if path.endswith(".npy"):
             return np.load(path)
-        try:
-            from PIL import Image
-            return np.asarray(Image.open(path).convert("RGB"))
-        except ImportError:
-            raise RuntimeError("PIL not available; use .npy images")
+        with open(path, "rb") as f:
+            raw = f.read()
+        # cv2 -> PIL -> pure-numpy codecs; always lands in RGB(A) order
+        from ..ops import _decode_image_host
+        arr = _decode_image_host(raw, path)
+        if arr.ndim == 2:
+            arr = np.repeat(arr[..., None], 3, axis=-1)
+        elif arr.shape[-1] == 2:   # gray + alpha: expand the gray channel
+            arr = np.repeat(arr[..., :1], 3, axis=-1)
+        return arr[..., :3]
 
     def __len__(self):
         return len(self.samples)

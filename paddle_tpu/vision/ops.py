@@ -738,28 +738,82 @@ def read_file(filename, name=None):
     return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
 
 
-def decode_jpeg(x, mode="unchanged", name=None):
-    """Decode a JPEG byte tensor to (C, H, W) uint8 (reference: nvjpeg
-    GPU op). Host path: PIL when available (it is not baked into this
-    offline image), else a clear error — TPU inference pipelines decode
-    on host CPU either way."""
+def _decode_image_host(raw, ext=""):
+    """bytes -> (H, W[, C]) uint8 via the fastest available decoder:
+    cv2 -> PIL -> the dependency-free pure-numpy codecs
+    (vision/_codec.py, chosen by extension/signature). TPU pipelines
+    decode on host CPU; the reference's nvjpeg GPU op has no TPU
+    analogue. Channel order is always RGB(A)."""
+    try:
+        import cv2
+        arr = cv2.imdecode(np.frombuffer(raw, np.uint8), cv2.IMREAD_UNCHANGED)
+        if arr is not None:
+            if arr.ndim == 3 and arr.shape[2] == 3:
+                arr = arr[..., ::-1]            # BGR  -> RGB
+            elif arr.ndim == 3 and arr.shape[2] == 4:
+                arr = arr[..., [2, 1, 0, 3]]    # BGRA -> RGBA
+            return np.ascontiguousarray(arr)
+    except ImportError:
+        pass
     try:
         from PIL import Image
         import io as _io
-    except ImportError as e:
-        raise NotImplementedError(
-            "decode_jpeg needs a host JPEG decoder; PIL is not available "
-            "in this build. Pre-decode images (vision.image backend) or "
-            "pack raw tensors with io/native.py record files.") from e
+        return np.asarray(Image.open(_io.BytesIO(raw)))
+    except ImportError:
+        pass
+    if ext.lower().endswith(".png") or raw[:8] == b"\x89PNG\r\n\x1a\n":
+        from ._codec import decode_png_np
+        return decode_png_np(raw)
+    from ._codec import decode_jpeg_np
+    return decode_jpeg_np(raw)
+
+
+# retained name: the JPEG-specific entry some callers bind directly
+def _decode_jpeg_host(raw):
+    return _decode_image_host(raw, ".jpg")
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to (C, H, W) uint8 (reference: nvjpeg
+    GPU op). Works PIL-free: falls back to the pure-numpy baseline
+    decoder in vision/_codec.py when neither cv2 nor PIL is present."""
     raw = bytes(np.asarray(unwrap(x), np.uint8))
-    img = Image.open(_io.BytesIO(raw))
+    arr = _decode_jpeg_host(raw)
     if mode.lower() == "gray":
-        img = img.convert("L")
+        if arr.ndim == 3:
+            arr = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                   + 0.114 * arr[..., 2] + 0.5).astype(np.uint8)
     elif mode.lower() == "rgb":
-        img = img.convert("RGB")
-    arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = np.repeat(arr[..., None], 3, axis=-1)
     if arr.ndim == 2:
         arr = arr[None]
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+def decode_png(x, name=None):
+    """Decode a PNG byte tensor to (C, H, W) uint8 — pure stdlib-zlib +
+    numpy (vision/_codec.py), no PIL required."""
+    from ._codec import decode_png_np
+    arr = decode_png_np(bytes(np.asarray(unwrap(x), np.uint8)))
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def encode_jpeg(x, quality=90, name=None):
+    """(C, H, W) or (H, W) uint8 tensor -> JPEG byte tensor (baseline
+    4:4:4, pure numpy). Companion to decode_jpeg for offline dataset
+    tooling and hermetic tests."""
+    from ._codec import encode_jpeg_np
+    arr = np.asarray(unwrap(x), np.uint8)
+    if arr.ndim == 3:
+        arr = arr.transpose(1, 2, 0)
+        if arr.shape[-1] == 1:
+            arr = arr[..., 0]
+    data = encode_jpeg_np(arr, quality=quality)
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))

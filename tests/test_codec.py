@@ -1,0 +1,160 @@
+"""Pure-numpy JPEG/PNG codecs + the PIL-free data path
+(VERDICT r3 item 7): decode a real JPEG byte stream with no PIL/cv2,
+wire real files through DatasetFolder and the DataLoader."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision._codec import (decode_jpeg_np, encode_jpeg_np,
+                                      decode_png_np, encode_png_np)
+
+
+def _smooth_rgb(h=48, w=40):
+    x = np.linspace(0, 1, w)
+    y = np.linspace(0, 1, h)
+    a = (np.outer(np.sin(y * 7), np.cos(x * 5)) * 100 + 128)
+    return np.stack([a, a.T[:h, :w] if a.T.shape == (h, w) else a[::-1],
+                     255 - a], -1).astype(np.uint8)
+
+
+class TestPNG:
+    @pytest.mark.parametrize("shape", [(17, 23), (16, 16, 3), (9, 31, 4)])
+    def test_lossless_roundtrip(self, shape):
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 256, shape).astype(np.uint8)
+        back = decode_png_np(encode_png_np(img))
+        assert back.shape == img.shape and (back == img).all()
+
+    def test_decodes_all_filter_types(self):
+        """PIL writes adaptive per-row filters (1-4); our decoder must
+        handle them. Skips when PIL is absent."""
+        pil = pytest.importorskip("PIL.Image")
+        import io
+        img = _smooth_rgb()
+        buf = io.BytesIO()
+        pil.fromarray(img).save(buf, "PNG")
+        back = decode_png_np(buf.getvalue())
+        assert (back == img).all()
+
+
+class TestJPEG:
+    def test_roundtrip_gray_and_rgb(self):
+        img = _smooth_rgb()
+        for im in (img[..., 0], img):
+            data = encode_jpeg_np(im, quality=95)
+            assert data[:2] == b"\xff\xd8" and data[-2:] == b"\xff\xd9"
+            back = decode_jpeg_np(data)
+            assert back.shape == im.shape
+            err = np.abs(back.astype(int) - im.astype(int)).mean()
+            assert err < 3.0, err
+
+    def test_ragged_dimensions(self):
+        img = _smooth_rgb(50, 37)
+        back = decode_jpeg_np(encode_jpeg_np(img, 90))
+        assert back.shape == img.shape
+
+    def test_quality_monotone(self):
+        img = _smooth_rgb()
+        sizes = [len(encode_jpeg_np(img, q)) for q in (30, 60, 95)]
+        assert sizes[0] < sizes[1] < sizes[2]
+
+    def test_cross_decoder_same_bitstream(self):
+        """Our decoder vs PIL on OUR bitstream: <= 2 LSB divergence."""
+        pil = pytest.importorskip("PIL.Image")
+        import io
+        img = _smooth_rgb()
+        data = encode_jpeg_np(img, 95)
+        ours = decode_jpeg_np(data)
+        theirs = np.asarray(pil.open(io.BytesIO(data)))
+        assert np.abs(ours.astype(int) - theirs.astype(int)).max() <= 2
+
+    def test_decode_foreign_420_with_restarts(self):
+        """PIL-encoded 4:2:0 + restart markers through OUR decoder."""
+        pil = pytest.importorskip("PIL.Image")
+        import io
+        img = _smooth_rgb(50, 37)
+        buf = io.BytesIO()
+        pil.fromarray(img).save(buf, "JPEG", quality=90,
+                                restart_marker_blocks=2)
+        ours = decode_jpeg_np(buf.getvalue())
+        theirs = np.asarray(pil.open(io.BytesIO(buf.getvalue())))
+        assert ours.shape == theirs.shape
+        assert np.abs(ours.astype(int) - theirs.astype(int)).mean() < 4.0
+
+    def test_progressive_raises_clearly(self):
+        pil = pytest.importorskip("PIL.Image")
+        import io
+        buf = io.BytesIO()
+        pil.fromarray(_smooth_rgb()).save(buf, "JPEG", progressive=True)
+        with pytest.raises(ValueError, match="baseline"):
+            decode_jpeg_np(buf.getvalue())
+
+
+class TestDataPath:
+    def test_decode_jpeg_op_pure_numpy(self, monkeypatch, tmp_path):
+        """vision.ops.decode_jpeg with cv2/PIL BLOCKED -> pure path."""
+        import builtins
+        import paddle_tpu as pt
+        real_import = builtins.__import__
+
+        def blocked(name, *a, **k):
+            if name in ("cv2", "PIL", "PIL.Image"):
+                raise ImportError(name)
+            return real_import(name, *a, **k)
+        monkeypatch.setattr(builtins, "__import__", blocked)
+        img = _smooth_rgb()
+        data = encode_jpeg_np(img, 95)
+        t = pt.vision.ops.decode_jpeg(
+            pt.to_tensor(np.frombuffer(data, np.uint8)))
+        arr = np.asarray(t.numpy())
+        assert arr.shape == (3,) + img.shape[:2]
+        err = np.abs(arr.transpose(1, 2, 0).astype(int)
+                     - img.astype(int)).mean()
+        assert err < 3.0
+        # gray conversion path
+        g = pt.vision.ops.decode_jpeg(
+            pt.to_tensor(np.frombuffer(data, np.uint8)), mode="gray")
+        assert np.asarray(g.numpy()).shape == (1,) + img.shape[:2]
+
+    def test_decode_png_op(self):
+        import paddle_tpu as pt
+        img = _smooth_rgb()
+        t = pt.vision.ops.decode_png(
+            pt.to_tensor(np.frombuffer(encode_png_np(img), np.uint8)))
+        assert (np.asarray(t.numpy()).transpose(1, 2, 0) == img).all()
+
+    def test_datasetfolder_jpeg_through_dataloader_workers(self, tmp_path):
+        """Real .jpg files -> DatasetFolder -> process-pool DataLoader:
+        the full input path the reference's dataloader_iter drives."""
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import DatasetFolder
+        rng = np.random.RandomState(0)
+        imgs = {}
+        for cls in ("cats", "dogs"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(6):
+                img = rng.randint(0, 255, (32, 32, 3), np.uint8)
+                (d / f"{i}.jpg").write_bytes(encode_jpeg_np(img, 92))
+                imgs[f"{cls}/{i}"] = img
+
+        def tf(img):
+            return np.asarray(img, np.float32).transpose(2, 0, 1) / 255.0
+
+        ds = DatasetFolder(str(tmp_path), transform=tf)
+        assert len(ds) == 12 and ds.classes == ["cats", "dogs"]
+        seen = 0
+        for nw in (0, 2):
+            loader = DataLoader(ds, batch_size=4, shuffle=False,
+                                num_workers=nw)
+            batches = list(loader)
+            assert sum(len(b[1]) for b in batches) == 12
+            x0 = np.asarray(batches[0][0])
+            assert x0.shape == (4, 3, 32, 32)
+            assert x0.min() >= 0.0 and x0.max() <= 1.0
+            # decoded content must match the encoded source (lossy tol)
+            ref = imgs["cats/0"].astype(np.float32).transpose(2, 0, 1) / 255
+            assert np.abs(x0[0] - ref).mean() < 0.02
+            seen += 1
+        assert seen == 2

@@ -62,18 +62,27 @@ def _run_4d(mode):
     from paddle_tpu.distributed.launch import build_env
 
     procs = []
-    for rank in range(2):
-        env = build_env(2, rank, f"127.0.0.1:{port}", base_env=os.environ)
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, child, mode], env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
     lines = []
-    for p in procs:
-        out, err = p.communicate(timeout=420)
-        assert p.returncode == 0, f"{mode} child failed:\n{err[-2500:]}"
-        lines.append([l for l in out.splitlines()
-                      if l.startswith("4D_OK")][0])
+    try:
+        for rank in range(2):
+            env = build_env(2, rank, f"127.0.0.1:{port}",
+                            base_env=os.environ)
+            env.pop("JAX_PLATFORMS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, child, mode], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"{mode} child failed:\n{err[-2500:]}"
+            lines.append([l for l in out.splitlines()
+                          if l.startswith("4D_OK")][0])
+    finally:
+        # a failed/timed-out rank must not orphan its sibling in the
+        # rendezvous barrier (it would hold the port and a CPU worker)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     # both ranks observed the identical (replicated) loss trajectory
     assert lines[0].split("losses=")[1] == lines[1].split("losses=")[1]
 

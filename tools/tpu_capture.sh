@@ -8,7 +8,7 @@ set -x
 
 timeout 900 python tools/validate_tpu_kernels.py 2>&1 | tail -12
 
-for m in resnet50 bert moe serving; do
+for m in resnet50 bert moe serving input; do
   timeout 900 python bench_models.py "$m" 2>&1 | tail -2
 done
 
