@@ -222,19 +222,41 @@ class Request:
 
 
 class ServingEngine:
-    """Continuous-batching decode loop over the paged cache."""
+    """Continuous-batching decode loop over the paged cache.
+
+    Admission control (reference: PaddleNLP predictor scheduling +
+    vLLM-style paged serving): submit() rejects requests that can never
+    fit max_seq_len with a clear error; requests that fit but exceed
+    CURRENT capacity queue until slots/pages free up. `num_pages`
+    (default: worst-case max_seqs*pages_per_seq) may oversubscribe the
+    pool; if decode then runs out of pages, the most-recently admitted
+    request is preempted — its pages return to the pool and it re-enters
+    the head of the queue, resuming later by re-prefilling its prompt +
+    already-generated tokens (no re-sampling of tokens it already
+    emitted)."""
 
     def __init__(self, params, config: LlamaConfig, max_seqs=4,
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
-                 use_pallas=None, interpret=False):
+                 use_pallas=None, interpret=False, num_pages=None):
         c = config
         self.params = params
         self.config = c
         self.page_size = page_size
         self.max_seqs = max_seqs
+        self.max_seq_len = max_seq_len
         self.pages_per_seq = -(-max_seq_len // page_size)
         # +1 trash page for masked writes of inactive slots
-        num_pages = max_seqs * self.pages_per_seq + 1
+        if num_pages is None:
+            num_pages = max_seqs * self.pages_per_seq + 1
+        else:
+            num_pages = int(num_pages)
+            if num_pages < self.pages_per_seq + 1:
+                raise ValueError(
+                    f"num_pages={num_pages} cannot hold even one "
+                    f"max_seq_len sequence ({self.pages_per_seq} pages) "
+                    "+ the trash page")
+        self.preemptions = 0
+        self._order = 0
         kvh = c.num_key_value_heads
         hd = c.hidden_size // c.num_attention_heads
         L = c.num_hidden_layers
@@ -255,7 +277,29 @@ class ServingEngine:
 
     # -- request admission ------------------------------------------------
     def submit(self, req: Request):
+        """Validate-or-reject now; queue what fits. Raises ValueError
+        for requests that could NEVER run (clear engine-level error
+        instead of a deep PagedKVCache failure mid-decode)."""
+        S = len(req.prompt)
+        if S == 0:
+            raise ValueError("serving: empty prompt")
+        if S + req.max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"serving: prompt ({S} tokens) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds max_seq_len="
+                f"{self.max_seq_len}; truncate the prompt, lower "
+                "max_new_tokens, or build the engine with a larger "
+                "max_seq_len")
         self._waiting.append(req)
+
+    @staticmethod
+    def _feed_ids(req):
+        """Tokens to prefill: the original prompt, plus — after a
+        preemption — everything already generated except the pending
+        next_token (which was sampled but not yet fed to the cache)."""
+        if getattr(req, "_resume", False):
+            return list(req.prompt) + [int(t) for t in req.output[:-1]]
+        return list(req.prompt)
 
     def _admit(self):
         """Admit all waiting requests that fit — ONE varlen prefill call
@@ -264,10 +308,23 @@ class ServingEngine:
                       if self._slots[s] is None]
         # admit only what both slots AND kv pages can hold — popping a
         # request we cannot scatter would silently drop it
-        free_pages = len(self._free)
+        # reserve pages that active slots will need at this step's page
+        # boundary — otherwise an admission can fill the pool and become
+        # the immediate preemption victim (full prefill wasted)
+        growth_need = sum(
+            1 for s in range(self.max_seqs)
+            if self._slots[s] is not None
+            and int(self.lengths[s]) > 0
+            and int(self.lengths[s]) % self.page_size == 0
+            and len(self._seq_pages[s]) * self.page_size
+            <= int(self.lengths[s]))
+        free_pages = len(self._free) - growth_need
         take = 0
         for req in self._waiting[:len(free_slots)]:
-            need = -(-max(len(req.prompt), 1) // self.page_size)
+            feed_len = max(len(self._feed_ids(req)), 1)
+            need = -(-feed_len // self.page_size)
+            if feed_len % self.page_size == 0:
+                need += 1  # its own first decode boundary, same step
             if need > free_pages:
                 break
             free_pages -= need
@@ -279,14 +336,15 @@ class ServingEngine:
             return
         reqs = [self._waiting.pop(0) for _ in range(take)]
         slots = free_slots[:take]
-        lens = [len(r.prompt) for r in reqs]
+        feeds = [self._feed_ids(r) for r in reqs]
+        lens = [len(f) for f in feeds]
         total = sum(lens)
         bucket = max(self.page_size, 1 << math.ceil(math.log2(max(total, 1))))
         ids = np.zeros((bucket,), np.int64)
         cu = np.zeros((self.max_seqs + 1,), np.int32)
         off = 0
-        for i, r in enumerate(reqs):
-            ids[off:off + lens[i]] = r.prompt
+        for i, f in enumerate(feeds):
+            ids[off:off + lens[i]] = f
             off += lens[i]
             cu[i + 1] = off
         cu[take + 1:] = off  # unused tail: zero-length segments
@@ -299,8 +357,18 @@ class ServingEngine:
             self._scatter_prompt(slot, k_all[:, :, a:b], v_all[:, :, a:b],
                                  lens[i])
             req.slot = slot
-            req.next_token = int(nxt[i])
-            req.output.append(int(nxt[i]))
+            req._admit_order = self._order
+            self._order += 1
+            if getattr(req, "_resume", False):
+                # resuming after preemption: next_token was already
+                # sampled before eviction — do NOT re-sample it
+                req._resume = False
+            else:
+                # first token honors the request's sampling params too
+                tok = req.pick(np.asarray(logits[i])) \
+                    if req.temperature > 0.0 else int(nxt[i])
+                req.next_token = tok
+                req.output.append(tok)
             self._slots[slot] = req
             if req.done:
                 self.finished.append(req)
@@ -335,39 +403,78 @@ class ServingEngine:
 
     def _prefill_into(self, slot, req: Request):
         c = self.config
-        S = len(req.prompt)
+        feed = self._feed_ids(req)
+        S = len(feed)
         bucket = max(self.page_size,
                      1 << math.ceil(math.log2(max(S, 1))))
         ids = np.zeros((1, bucket), np.int64)
-        ids[0, :S] = req.prompt
+        ids[0, :S] = feed
         logits, k_all, v_all = prefill(self.params, jnp.asarray(ids),
                                        jnp.asarray(S), c,
                                        use_pallas=self._use_pallas)
         self._scatter_prompt(slot, k_all[:, :, :S], v_all[:, :, :S], S)
         req.slot = slot
-        first = int(jnp.argmax(logits))
-        req.next_token = first
-        req.output.append(first)
+        req._admit_order = self._order
+        self._order += 1
+        if getattr(req, "_resume", False):
+            req._resume = False  # next_token survives from before eviction
+        else:
+            row = np.asarray(logits).reshape(-1)
+            first = req.pick(row) if req.temperature > 0.0 \
+                else int(np.argmax(row))
+            req.next_token = first
+            req.output.append(first)
         self._slots[slot] = req
         if req.done:  # e.g. max_new_tokens == 1
             self.finished.append(req)
             self._release(slot)
 
+    def _preempt_one(self, exclude):
+        """Evict the most-recently admitted active request (never
+        `exclude`): pages return to the pool, the request re-enters the
+        HEAD of the waiting queue and resumes by re-prefilling
+        prompt + generated-so-far. Returns False when nothing can be
+        evicted."""
+        victims = [s for s, r in enumerate(self._slots)
+                   if r is not None and s != exclude]
+        if not victims:
+            return False
+        s = max(victims, key=lambda v: self._slots[v]._admit_order)
+        req = self._slots[s]
+        req._resume = True
+        req.slot = None
+        self._waiting.insert(0, req)
+        self._release(s)
+        self.preemptions += 1
+        return True
+
     # -- decode loop ------------------------------------------------------
     def step(self):
         """One decode step for all active slots; returns #active."""
         self._admit()
+        # page-growth pass with preemption: a slot about to cross a page
+        # boundary must get a page; when the (oversubscribed) pool is
+        # dry, evict the most recent admission rather than dying deep in
+        # the allocator
+        for s in range(self.max_seqs):
+            if self._slots[s] is None:
+                continue
+            cur = int(self.lengths[s])
+            if cur % self.page_size == 0 and cur > 0 and \
+                    len(self._seq_pages[s]) * self.page_size <= cur:
+                while not self._free:
+                    if not self._preempt_one(exclude=s):
+                        raise RuntimeError(
+                            "serving: KV page pool exhausted with a "
+                            "single active sequence — num_pages is too "
+                            "small for max_seq_len")
+                self._alloc_pages(s, 1)
         active_slots = [s for s, r in enumerate(self._slots) if r is not None]
         if not active_slots:
             return 0
         tokens = np.zeros((self.max_seqs,), np.int64)
         for s in active_slots:
             req = self._slots[s]
-            # the token being fed needs a cache position: extend first
-            cur = int(self.lengths[s])
-            if cur % self.page_size == 0 and cur > 0 and \
-                    len(self._seq_pages[s]) * self.page_size <= cur:
-                self._alloc_pages(s, 1)
             tokens[s] = req.next_token
         active = np.zeros((self.max_seqs,), bool)
         active[active_slots] = True

@@ -30,5 +30,8 @@ def image_load(path, backend=None):
         except ImportError:
             if backend == "pil":
                 raise
-    from .datasets import DatasetFolder
-    return DatasetFolder._default_loader(path)
+    # array path: preserves alpha (unlike DatasetFolder's RGB-only
+    # training loader) — decode chain cv2 -> PIL -> pure numpy
+    from .ops import _decode_image_host
+    with open(path, "rb") as f:
+        return _decode_image_host(f.read(), path)

@@ -501,6 +501,10 @@ def decode_png_np(data):
                 ">IIBBBBB", body)
             assert depth == 8, "only 8-bit PNG supported"
             assert inter == 0, "interlaced PNG unsupported"
+            if ctype not in (0, 2, 4, 6):
+                raise ValueError(
+                    f"PNG color type {ctype} unsupported by the pure-"
+                    "numpy decoder (palette PNGs need cv2 or PIL)")
             nch = {0: 1, 2: 3, 4: 2, 6: 4}[ctype]
             meta = (w, h, nch)
         elif typ == b"IDAT":

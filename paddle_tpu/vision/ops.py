@@ -758,7 +758,13 @@ def _decode_image_host(raw, ext=""):
     try:
         from PIL import Image
         import io as _io
-        return np.asarray(Image.open(_io.BytesIO(raw)))
+        img = Image.open(_io.BytesIO(raw))
+        if img.mode == "P":       # palette -> real colors
+            img = img.convert(
+                "RGBA" if "transparency" in img.info else "RGB")
+        elif img.mode not in ("RGB", "RGBA", "L", "LA"):
+            img = img.convert("RGB")  # CMYK/YCbCr/16-bit etc.
+        return np.asarray(img)
     except ImportError:
         pass
     if ext.lower().endswith(".png") or raw[:8] == b"\x89PNG\r\n\x1a\n":
