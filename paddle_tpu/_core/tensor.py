@@ -391,9 +391,20 @@ def _apply(fn, kwargs, *args, name=None, multi=False, nondiff=()):
     args via float0, so this is only needed for non-array statics).
     """
     raw = tuple(unwrap(a) for a in args)
-    out = fn(*raw, **kwargs) if kwargs else fn(*raw)
-    is_multi = multi or isinstance(out, (tuple, list))
-    outs = tuple(out) if is_multi else (out,)
+    tr = _get_trace()
+    if tr is not None and tr.enabled():
+        t0 = _perf_counter()
+        out = fn(*raw, **kwargs) if kwargs else fn(*raw)
+        is_multi = multi or isinstance(out, (tuple, list))
+        outs = tuple(out) if is_multi else (out,)
+        if not any(_is_tracer(o) for o in outs if o is not None):
+            # host dispatch-level span (async device work not awaited)
+            tr.record(name or fn.__name__, _perf_counter() - t0,
+                      getattr(outs[0], "shape", None))
+    else:
+        out = fn(*raw, **kwargs) if kwargs else fn(*raw)
+        is_multi = multi or isinstance(out, (tuple, list))
+        outs = tuple(out) if is_multi else (out,)
 
     if _op_observer is not None and not any(
             _is_tracer(o) for o in outs if o is not None):
@@ -418,6 +429,23 @@ def _apply(fn, kwargs, *args, name=None, multi=False, nondiff=()):
 def apply(fn, *args, name=None, multi=False, **kwargs):
     """Public op-dispatch entry: paddle_tpu ops call this."""
     return _apply(fn, kwargs, *args, name=name, multi=multi)
+
+
+_TRACE_MOD = None
+from time import perf_counter as _perf_counter  # noqa: E402
+
+
+def _get_trace():
+    """Lazy utils.trace import: avoids a package-init cycle and costs
+    one None-check per dispatch once resolved."""
+    global _TRACE_MOD
+    if _TRACE_MOD is None:
+        try:
+            from ..utils import trace as _t
+            _TRACE_MOD = _t
+        except ImportError:  # pragma: no cover - partial interpreter teardown
+            return None
+    return _TRACE_MOD
 
 
 # Register Tensor as a pytree so it can cross jit/pjit boundaries directly.

@@ -78,6 +78,14 @@ class Profiler:
         self._last = None
 
     def start(self):
+        # host event ring: sessions enable tracing for their duration
+        # (restoring the prior state on stop) and export only events
+        # recorded after this timestamp — earlier sessions' spans must
+        # not leak into this session's trace
+        from ..utils import trace as _trace
+        self._prev_trace_enabled = _trace.enabled()
+        _trace.enable()
+        self._t_session = time.time()
         if not self._timer_only:
             try:
                 jax.profiler.start_trace(self._dir)
@@ -93,6 +101,9 @@ class Profiler:
             except Exception:
                 pass
             self._active = False
+        if not getattr(self, "_prev_trace_enabled", True):
+            from ..utils import trace as _trace
+            _trace.disable()
         if self._on_trace_ready:
             self._on_trace_ready(self)
 
@@ -117,7 +128,30 @@ class Profiler:
         print(trace_summary())
 
     def export(self, path, format="json"):
-        pass
+        """Write THIS session's host-side events (RecordEvent spans +
+        dispatch-layer op spans fed by _core.apply when tracing is on)
+        as chrome://tracing JSON. On-chip XLA traces captured by
+        start_trace live under self._dir for TensorBoard/XProf."""
+        if format not in ("json", "chrome"):
+            raise ValueError(
+                f"unsupported export format {format!r}: only chrome-"
+                "tracing 'json' is implemented (XLA device traces are "
+                "XPlane dumps under the profiler dir)")
+        import json as _json
+        from ..utils import trace as _trace
+        t0 = getattr(self, "_t_session", 0.0)
+        evts = []
+        for name, dur, shape, ts_end in _trace.events():
+            if ts_end < t0:
+                continue  # a previous session's span
+            e = {"name": name, "ph": "X", "pid": 0, "tid": 0,
+                 "ts": (ts_end - dur) * 1e6, "dur": dur * 1e6}
+            if shape is not None:
+                e["args"] = {"shape": str(shape)}
+            evts.append(e)
+        with open(path, "w") as f:
+            _json.dump({"traceEvents": evts,
+                        "displayTimeUnit": "ms"}, f)
 
     def __enter__(self):
         self.start()
@@ -131,15 +165,27 @@ class RecordEvent:
     def __init__(self, name, event_type=None):
         self.name = name
         self._ctx = None
+        self._t0 = None
 
     def begin(self):
-        self._ctx = jax.profiler.TraceAnnotation(self.name)
-        self._ctx.__enter__()
+        self._t0 = time.perf_counter()
+        try:
+            self._ctx = jax.profiler.TraceAnnotation(self.name)
+            self._ctx.__enter__()
+        except Exception:
+            self._ctx = None
 
     def end(self):
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        if self._t0 is not None:
+            # feed the host ring (gated: Profiler.start enables tracing
+            # for its session; PADDLE_TPU_TRACE=1 enables it globally)
+            from ..utils import trace as _trace
+            if _trace.enabled():
+                _trace.record(self.name, time.perf_counter() - self._t0)
+            self._t0 = None
 
     def __enter__(self):
         self.begin()
