@@ -392,19 +392,15 @@ def _apply(fn, kwargs, *args, name=None, multi=False, nondiff=()):
     """
     raw = tuple(unwrap(a) for a in args)
     tr = _get_trace()
-    if tr is not None and tr.enabled():
-        t0 = _perf_counter()
-        out = fn(*raw, **kwargs) if kwargs else fn(*raw)
-        is_multi = multi or isinstance(out, (tuple, list))
-        outs = tuple(out) if is_multi else (out,)
-        if not any(_is_tracer(o) for o in outs if o is not None):
-            # host dispatch-level span (async device work not awaited)
-            tr.record(name or fn.__name__, _perf_counter() - t0,
-                      getattr(outs[0], "shape", None))
-    else:
-        out = fn(*raw, **kwargs) if kwargs else fn(*raw)
-        is_multi = multi or isinstance(out, (tuple, list))
-        outs = tuple(out) if is_multi else (out,)
+    tracing = tr is not None and tr.enabled()
+    t0 = _perf_counter() if tracing else None
+    out = fn(*raw, **kwargs) if kwargs else fn(*raw)
+    is_multi = multi or isinstance(out, (tuple, list))
+    outs = tuple(out) if is_multi else (out,)
+    if tracing and not any(_is_tracer(o) for o in outs if o is not None):
+        # host dispatch-level span (async device work not awaited)
+        tr.record(name or fn.__name__, _perf_counter() - t0,
+                  getattr(outs[0], "shape", None))
 
     if _op_observer is not None and not any(
             _is_tracer(o) for o in outs if o is not None):

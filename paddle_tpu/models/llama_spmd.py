@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
+from ..ops.flashmask_attention import flashmask_attention_bhsd
 from ..parallel.pp import (pipeline_apply, pipeline_train_1f1b,
                            group_stages)
 from ..parallel.ring import ring_attention_local
@@ -95,10 +96,26 @@ def _rms(x, g, eps):
     return (out * g.astype(jnp.float32)).astype(x.dtype)
 
 
+def doc_end_indices(doc_ids):
+    """(B, S) contiguous per-token document ids → (B, 1, S, 1) FlashMask
+    startend_row_indices: for key column j, the first row that must NOT
+    attend to it (= its document's end boundary). jit-safe."""
+    B, S = doc_ids.shape
+    idx = jnp.arange(S)
+    is_last = jnp.concatenate(
+        [doc_ids[:, 1:] != doc_ids[:, :-1], jnp.ones((B, 1), bool)], axis=1)
+    cand = jnp.where(is_last, idx + 1, S + 1)
+    end = lax.cummin(cand[:, ::-1], axis=1)[:, ::-1]
+    return end.astype(jnp.int32)[:, None, :, None]
+
+
 def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
-    """One decoder layer, pure. h: (B, S, H). rope: (cos, sin)."""
+    """One decoder layer, pure. h: (B, S, H). rope: (cos, sin) or
+    (cos, sin, sri) where sri is a FlashMask startend_row_indices
+    tensor (B, 1, S_k, n) for packed-document attention."""
     c = config
-    cos, sin = rope
+    cos, sin = rope[0], rope[1]
+    sri = rope[2] if len(rope) > 2 else None
     nh = c.num_attention_heads
     nkv = c.num_key_value_heads
     hd = c.hidden_size // nh
@@ -115,6 +132,11 @@ def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
         v = jnp.repeat(v, rep, axis=1)
     if sp_axis is not None:
         o = ring_attention_local(q, k, v, axis_name=sp_axis, causal=True)
+    elif sri is not None:
+        # packed-document pretraining: causal within each document,
+        # blocked across documents — flashmask kernel, no dense mask
+        sri_h = jnp.broadcast_to(sri, (b, nh, s, sri.shape[-1]))
+        o = flashmask_attention_bhsd(q, k, v, sri_h, causal=True)
     else:
         o = flash_attention_bhsd(q, k, v, causal=True)
     attn_out = o.swapaxes(1, 2).reshape(b, s, H) @ lp["wo"]
@@ -126,12 +148,30 @@ def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
 
 
 def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
-            remat=True, sp_axis=None):
-    """→ logits (B, S, V). Uses pipeline when mesh has pp>1, else scan."""
+            remat=True, sp_axis=None, doc_ids=None):
+    """→ logits (B, S, V). Uses pipeline when mesh has pp>1, else scan.
+
+    doc_ids: optional (B, S) contiguous document ids for packed-sequence
+    pretraining — attention stays causal within a document and is
+    blocked across documents via the FlashMask kernel (no dense mask).
+    """
     c = config
     s = input_ids.shape[1]
     cos, sin = rope_cos_sin(s, c.hidden_size // c.num_attention_heads,
                             c.rope_theta, jnp.float32)
+    extra = (cos, sin)
+    if doc_ids is not None:
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            raise NotImplementedError(
+                "packed-document flashmask + pipeline parallelism: the "
+                "per-row mask cannot ride the replicated pipeline extra "
+                "yet — use doc_ids without pp, or pp without doc_ids")
+        if sp_axis is not None:
+            raise NotImplementedError(
+                "packed-document flashmask + ring sequence parallelism "
+                "is not supported: ring_attention_local has no document "
+                "mask — drop sp_axis or doc_ids")
+        extra = (cos, sin, doc_end_indices(doc_ids))
     h = jnp.take(params["embed"], input_ids, axis=0)
 
     layer = functools.partial(decoder_layer, config=c, sp_axis=sp_axis)
@@ -148,12 +188,12 @@ def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
         n_stages = mesh.shape["pp"]
         staged = group_stages(params["layers"], n_stages)
         h = pipeline_apply(staged, h,
-                           lambda lp, hh, extra: layer(lp, hh, extra),
+                           lambda lp, hh, extra_: layer(lp, hh, extra_),
                            mesh, pp_axis="pp", n_micro=n_micro,
-                           extra=(cos, sin))
+                           extra=extra)
     else:
         def body(hh, lp):
-            return layer(lp, hh, (cos, sin)), None
+            return layer(lp, hh, extra), None
         h, _ = lax.scan(body, h, params["layers"])
 
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
@@ -162,8 +202,12 @@ def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
 
 def loss_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
             sp_axis=None):
-    input_ids, labels = batch
-    logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis)
+    """batch: (input_ids, labels) or (input_ids, labels, doc_ids) for
+    packed-document pretraining."""
+    input_ids, labels = batch[0], batch[1]
+    doc_ids = batch[2] if len(batch) > 2 else None
+    logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
+                     doc_ids=doc_ids)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
                                  axis=-1)[..., 0]
@@ -230,7 +274,11 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
         scatter-grad run replicated outside the pipeline; final-norm +
         lm_head + loss fold into head_fn on the last stage."""
         c = config
-        input_ids, labels = batch
+        if len(batch) > 2:
+            raise NotImplementedError(
+                "packed-document flashmask + 1F1B pipeline is not "
+                "supported yet (see forward()'s doc_ids + pp note)")
+        input_ids, labels = batch[0], batch[1]
         s = input_ids.shape[1]
         cos, sin = rope_cos_sin(s, c.hidden_size // c.num_attention_heads,
                                 c.rope_theta, jnp.float32)
@@ -280,12 +328,12 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             # of a serial loop — can unlock a bigger global batch or a
             # lighter remat policy. With pp, n_micro instead feeds the
             # pipeline schedule (forward() above).
-            x, y = batch
-            assert x.shape[0] % n_micro == 0, (
-                f"batch {x.shape[0]} not divisible by n_micro={n_micro}")
-            mb = x.shape[0] // n_micro
-            xs = x.reshape(n_micro, mb, *x.shape[1:])
-            ys = y.reshape(n_micro, mb, *y.shape[1:])
+            B = batch[0].shape[0]
+            assert B % n_micro == 0, (
+                f"batch {B} not divisible by n_micro={n_micro}")
+            mb = B // n_micro
+            parts = tuple(p.reshape(n_micro, mb, *p.shape[1:])
+                          for p in batch)
 
             def micro(acc, mb_batch):
                 acc_l, acc_g = acc
@@ -298,7 +346,7 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             zero_g = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
             (loss, grads), _ = lax.scan(micro, (jnp.float32(0.0), zero_g),
-                                        (xs, ys))
+                                        parts)
             loss = loss / n_micro
             grads = jax.tree_util.tree_map(lambda g: g / n_micro, grads)
         else:
@@ -316,7 +364,9 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
 
     return jax.jit(
         step_fn,
-        in_shardings=(pshard, sshard, None, (bshard, bshard)),
+        # batch may be (ids, labels) or (ids, labels, doc_ids): shard
+        # every element the same way without pinning the arity
+        in_shardings=(pshard, sshard, None, bshard),
         out_shardings=(pshard, sshard, repl),
         donate_argnums=(0, 1) if donate else ())
 

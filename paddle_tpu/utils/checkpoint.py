@@ -93,6 +93,51 @@ def load_state(path, model=None, optimizer=None, lr_scheduler=None):
     return payload.get("step", 0), payload.get("extra")
 
 
+def save_orbax(path, tree):
+    """Orbax interop (SURVEY §1 checkpoint row): write a pytree of
+    arrays/Tensors as a standard orbax checkpoint readable by ANY
+    orbax-based JAX stack (maxtext, flax examples, t5x). Own-format
+    save_state remains the default (it also captures RNG/step/extra,
+    which orbax's StandardCheckpointHandler does not)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # atomic like save_state: write beside, swap, then drop the old —
+    # a crash mid-save must never leave zero valid checkpoints
+    tmp = path + ".tmp-orbax"
+    old = path + ".old-orbax"
+    for p in (tmp, old):
+        if os.path.exists(p):
+            shutil.rmtree(p)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        ckptr.save(tmp, _pack_tree(tree))
+        ckptr.wait_until_finished()
+    finally:
+        ckptr.close()
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    shutil.rmtree(old, ignore_errors=True)
+
+
+def load_orbax(path, like=None):
+    """Restore an orbax checkpoint → pytree of numpy arrays (or shaped
+    like `like` when given — required for sharded restore)."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        if like is not None:
+            import jax
+            tmpl = jax.tree_util.tree_map(
+                ocp.utils.to_shape_dtype_struct, _pack_tree(like))
+            return ckptr.restore(path, tmpl)
+        return ckptr.restore(path)
+    finally:
+        ckptr.close()
+
+
 def latest_checkpoint(root):
     if not os.path.isdir(root):
         return None

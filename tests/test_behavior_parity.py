@@ -145,6 +145,30 @@ class TestProfilerTrace:
         json.loads(raw)  # must be valid JSON, not just a text dump
 
 
+class TestOrbaxInterop:
+    def test_roundtrip_and_cross_compat(self, tmp_path):
+        """save_orbax/load_orbax speak real orbax: raw orbax reads our
+        checkpoints and we read raw-orbax checkpoints."""
+        from paddle_tpu.utils.checkpoint import save_orbax, load_orbax
+        net = pt.nn.Linear(4, 3)
+        sd = dict(net.state_dict())
+        p = str(tmp_path / "ckpt")
+        save_orbax(p, sd)
+        back = load_orbax(p, like=sd)
+        for k in sd:
+            assert np.allclose(np.asarray(back[k]), sd[k].numpy()), k
+
+        ocp = pytest.importorskip("orbax.checkpoint")
+        with ocp.StandardCheckpointer() as c:
+            raw = c.restore(os.path.abspath(p))
+        assert np.allclose(np.asarray(raw["weight"]), sd["weight"].numpy())
+        with ocp.StandardCheckpointer() as c:
+            c.save(os.path.abspath(str(tmp_path / "foreign")),
+                   {"a": np.arange(6.0).reshape(2, 3)})
+        ours = load_orbax(str(tmp_path / "foreign"))
+        assert np.allclose(ours["a"], np.arange(6.0).reshape(2, 3))
+
+
 class TestQuantValues:
     def test_weight_quantize_dequantize_roundtrip(self):
         """int8 weight-only quantization: per-out-channel absmax scale,

@@ -57,7 +57,8 @@ def test_no_tpu_throughput_regression():
     by_cfg = {}
     for e in tpu:
         by_cfg.setdefault((e.get("model", "llama"), e.get("batch"),
-                           e.get("seq"), e.get("remat", "True"))
+                           e.get("seq"), e.get("remat", "True"),
+                           e.get("docs"))
                           + _TD.effective_knobs(e)
                           + (bool(e.get("extra", {}).get("pallas_fallback")),),
                           []).append(e)

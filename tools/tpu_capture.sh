@@ -20,6 +20,10 @@ timeout 7200 python tools/autotune.py 2>&1 | tail -8
 # already ran above — skip the redundant pre-step)
 PT_BENCH_SKIP_VALIDATE=1 timeout 1800 python bench.py 2>&1 | tail -1
 
+# packed-document flashmask: 4 docs per 2048-ctx row — block-skip
+# should convert the blocked cross-doc attention into real tok/s
+PT_BENCH_SKIP_VALIDATE=1 PT_BENCH_DOCS=4 timeout 1200 python bench.py 2>&1 | tail -1
+
 # serving throughput on-chip (VERDICT r2 item 8)
 timeout 900 python bench_models.py serving 2>&1 | tail -2
 echo "CAPTURE_DONE"
