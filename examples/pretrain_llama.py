@@ -50,6 +50,9 @@ def main():
     ap.add_argument("--pp", type=int, default=1)
     ap.add_argument("--n-micro", type=int, default=None)
     ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--packed-docs", type=int, default=0,
+                    help="N>0: pack N documents per row; cross-doc "
+                         "attention blocked via the flashmask kernel")
     ap.add_argument("--lr", type=float, default=3e-4)
     args = ap.parse_args()
 
@@ -84,8 +87,19 @@ def main():
         for step in range(args.steps):
             x = rng.randint(0, cfg.vocab_size, (args.batch, args.seq))
             y = np.roll(x, -1, axis=1)
+            if args.packed_docs > 0:
+                assert args.seq % args.packed_docs == 0
+                dlen = args.seq // args.packed_docs
+                doc = np.repeat(np.arange(args.packed_docs), dlen)
+                # each document's last token must not be trained to
+                # predict the NEXT document's first token: ignore-label
+                # (-1) there, mirroring what the attention mask blocks
+                y[:, dlen - 1::dlen] = -1
+                batch = (x, y, doc[None].repeat(args.batch, 0))
+            else:
+                batch = (x, y)
             params, opt_state, loss = step_fn(params, opt_state,
-                                              jnp.asarray(step), (x, y))
+                                              jnp.asarray(step), batch)
             wd.beat()
             sched.step()
             if step % 5 == 0 or step == args.steps - 1:

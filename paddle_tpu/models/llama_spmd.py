@@ -203,15 +203,19 @@ def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
 def loss_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
             sp_axis=None):
     """batch: (input_ids, labels) or (input_ids, labels, doc_ids) for
-    packed-document pretraining."""
+    packed-document pretraining. Labels < 0 are ignored (masked mean) —
+    used at document boundaries where the next token belongs to another
+    document."""
     input_ids, labels = batch[0], batch[1]
     doc_ids = batch[2] if len(batch) > 2 else None
     logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
                      doc_ids=doc_ids)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
-                                 axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    picked = jnp.take_along_axis(
+        logp, jnp.maximum(labels, 0)[..., None].astype(jnp.int32),
+        axis=-1)[..., 0]
+    valid = (labels >= 0).astype(jnp.float32)
+    return -jnp.sum(picked * valid) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
 # ---------------------------------------------------------------- training
@@ -298,8 +302,11 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             logits = hh @ hp["lm_head"]
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             picked = jnp.take_along_axis(
-                logp, tgt[..., None].astype(jnp.int32), axis=-1)[..., 0]
-            return -jnp.mean(picked)
+                logp, jnp.maximum(tgt, 0)[..., None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            valid = (tgt >= 0).astype(jnp.float32)
+            return -jnp.sum(picked * valid) / jnp.maximum(
+                jnp.sum(valid), 1.0)
 
         n_stages = mesh.shape["pp"]
         staged = group_stages(params["layers"], n_stages)

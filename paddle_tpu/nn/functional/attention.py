@@ -130,36 +130,57 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
 
 
 def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
-                     key_padding_mask=None, attn_mask=None, name=None):
-    """Block-sparse attention with a per-row CSR layout (reference
+                     key_padding_mask=None, attn_mask=None, name=None,
+                     max_nnz=None):
+    """Sparse attention with a per-row CSR layout (reference
     nn/functional/sparse_attention.py:22 — CUDA-only there; here an XLA
     gather formulation: each query row attends only to its CSR columns).
 
     query/key/value: (B, H, S, D); sparse_csr_offset: (B, H, S+1) int32;
     sparse_csr_columns: (B, H, nnz) int32.
-    """
-    off_np = np.asarray(unwrap(sparse_csr_offset))
-    row_nnz = np.diff(off_np, axis=-1)             # (B, H, S)
-    max_nnz = int(row_nnz.max()) if row_nnz.size else 0
-    b_, h_, s_ = row_nnz.shape
-    # (B, H, S, max_nnz) gather index into the flat columns array + mask
-    base = off_np[..., :-1][..., None] + np.arange(max_nnz)
-    valid_np = np.arange(max_nnz) < row_nnz[..., None]
-    base = np.where(valid_np, base, 0)
 
-    def fn(q, k, v, cols, *rest):
+    jit-compatible: only the per-row gather WIDTH must be static. With
+    concrete offsets it is derived (max row nnz); under tracing pass
+    `max_nnz` explicitly (an upper bound is fine — padding lanes are
+    masked).
+    """
+    off_c = unwrap(sparse_csr_offset)
+    if not isinstance(off_c, jax.core.Tracer):
+        row_nnz_np = np.diff(np.asarray(off_c), axis=-1)
+        derived = int(row_nnz_np.max()) if row_nnz_np.size else 0
+        if max_nnz is None:
+            max_nnz = derived
+        elif max_nnz < derived:
+            # a too-small width would silently drop keys from the
+            # softmax — validation is free while offsets are concrete
+            raise ValueError(
+                f"max_nnz={max_nnz} is smaller than the widest CSR "
+                f"row ({derived} columns): attention would be "
+                "silently truncated")
+    elif max_nnz is None:
+        raise ValueError(
+            "sparse_attention under jit needs a static max_nnz= "
+            "(the widest row's nonzero count, or any upper bound)")
+
+    def fn(q, k, v, off, cols, *rest):
         rest = list(rest)
         kpm = rest.pop(0) if key_padding_mask is not None else None
         am = rest.pop(0) if attn_mask is not None else None
         d = q.shape[-1]
+        b_, h_, s_ = off.shape[0], off.shape[1], off.shape[2] - 1
+        row_nnz = jnp.diff(off, axis=-1)                   # (B, H, S)
+        lane = jnp.arange(max_nnz)
+        base = off[..., :-1, None] + lane                  # (B, H, S, n)
+        mask = lane < row_nnz[..., None]
+        base = jnp.where(mask, base, 0)
         gi = jnp.take_along_axis(
-            jnp.broadcast_to(cols[..., None, :], cols.shape[:2] + (s_, cols.shape[-1])),
-            jnp.asarray(base), axis=-1)            # (B,H,S,max_nnz) col ids
+            jnp.broadcast_to(cols[..., None, :],
+                             cols.shape[:2] + (s_, cols.shape[-1])),
+            base, axis=-1)                                 # col ids
         kg = jnp.take_along_axis(k[:, :, None], gi[..., None], axis=3)
         vg = jnp.take_along_axis(v[:, :, None], gi[..., None], axis=3)
         scores = jnp.einsum("bhsd,bhsnd->bhsn", q.astype(jnp.float32),
                             kg.astype(jnp.float32)) / math.sqrt(d)
-        mask = jnp.asarray(valid_np)
         if kpm is not None:  # (B, S_k): 0/-inf style or bool keep-mask
             keep = jnp.take_along_axis(
                 jnp.broadcast_to(kpm[:, None, None, :],
@@ -175,7 +196,7 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
         out = jnp.einsum("bhsn,bhsnd->bhsd", p, vg.astype(jnp.float32))
         return out.astype(q.dtype)
 
-    args = [query, key, value, sparse_csr_columns]
+    args = [query, key, value, sparse_csr_offset, sparse_csr_columns]
     if key_padding_mask is not None:
         args.append(key_padding_mask)
     if attn_mask is not None:

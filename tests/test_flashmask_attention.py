@@ -137,6 +137,34 @@ class TestFlashMaskKernel:
                                          use_pallas=True, interpret=True)
         _close(o_ker, o_ref, tol=2e-2)
 
+    def test_sparse_attention_under_jit(self):
+        """CSR sparse_attention must trace under jit with a static
+        max_nnz and match eager + dense-causal (regression: it used to
+        host-compute gather indices from concrete offsets)."""
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.ops.flash_attention import mha_reference
+        rng = np.random.RandomState(0)
+        B, H, S, D = 1, 2, 16, 8
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        off = np.zeros((B, H, S + 1), np.int32)
+        cols = []
+        for i in range(S):
+            cols += list(range(i + 1))
+            off[..., i + 1] = len(cols)
+        col = np.tile(np.asarray(cols, np.int32), (B, H, 1))
+        eager = np.asarray(F.sparse_attention(q, q, q, off, col).numpy())
+        jitted = np.asarray(jax.jit(
+            lambda a, o, c: F.sparse_attention(a, a, a, o, c,
+                                               max_nnz=S))(q, off, col))
+        assert np.allclose(eager, jitted, atol=1e-5)
+        ref, _ = mha_reference(jnp.asarray(q), jnp.asarray(q),
+                               jnp.asarray(q), None, True,
+                               1.0 / math.sqrt(D))
+        assert np.allclose(eager, np.asarray(ref), atol=1e-4)
+        with pytest.raises(ValueError, match="max_nnz"):
+            jax.jit(lambda a, o, c: F.sparse_attention(a, a, a, o, c))(
+                q, off, col)
+
     def test_causal_scalar_window_off_tpu(self):
         """Regression: causal + int window_size through the public
         wrapper must not crash on the off-TPU reference path."""
