@@ -149,6 +149,24 @@ class _Fleet:
     def get_mesh(self):
         return self._mesh
 
+    def pipeline_schedule(self):
+        """Normalized pipeline schedule from
+        strategy.pipeline_configs['schedule_mode'] (reference:
+        fleet/meta_optimizers/pipeline_optimizer.py:55 — 'F-then-B' is
+        GPipe, '1F1B' is one-forward-one-backward). Consumed by
+        models.llama_spmd.make_train_step(schedule=None)."""
+        cfgs = getattr(self._strategy, "pipeline_configs", None) or {}
+        mode = str(cfgs.get("schedule_mode", "F-then-B"))
+        table = {"1f1b": "1f1b", "f-then-b": "gpipe"}
+        if mode.lower() not in table:
+            # never silently downgrade: a user who asked for a schedule
+            # we don't implement (e.g. interleaved virtual stages) must
+            # not discover it via an OOM from the wrong memory profile
+            raise ValueError(
+                f"pipeline_configs schedule_mode={mode!r} is not "
+                "supported: use '1F1B' or 'F-then-B' (GPipe)")
+        return table[mode.lower()]
+
     def distributed_model(self, model):
         from ..parallel_wrappers import DataParallel
         return DataParallel(model)

@@ -200,22 +200,37 @@ def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
     return h @ params["lm_head"]
 
 
-def loss_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
-            sp_axis=None):
-    """batch: (input_ids, labels) or (input_ids, labels, doc_ids) for
-    packed-document pretraining. Labels < 0 are ignored (masked mean) —
-    used at document boundaries where the next token belongs to another
-    document."""
-    input_ids, labels = batch[0], batch[1]
-    doc_ids = batch[2] if len(batch) > 2 else None
-    logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
-                     doc_ids=doc_ids)
+def _masked_nll(logits, labels):
+    """→ (nll_sum, valid_count): summed next-token NLL over labels >= 0
+    (labels < 0 are the ignore sentinel, e.g. document boundaries).
+    Single source for every loss path so semantics cannot drift."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(
         logp, jnp.maximum(labels, 0)[..., None].astype(jnp.int32),
         axis=-1)[..., 0]
     valid = (labels >= 0).astype(jnp.float32)
-    return -jnp.sum(picked * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+    return -jnp.sum(picked * valid), jnp.sum(valid)
+
+
+def loss_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
+            sp_axis=None):
+    """batch: (input_ids, labels) or (input_ids, labels, doc_ids) for
+    packed-document pretraining. Labels < 0 are ignored (masked mean)."""
+    s, n = loss_sum_fn(params, batch, config, mesh, n_micro, remat, sp_axis)
+    return s / jnp.maximum(n, 1.0)
+
+
+def loss_sum_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
+                sp_axis=None):
+    """(nll_sum, valid_count) variant — the grad-accumulation path
+    accumulates these so microbatches are weighted by their VALID token
+    counts, keeping n_micro=k exactly equal to the one-shot step even
+    with unevenly distributed ignore-labels."""
+    input_ids, labels = batch[0], batch[1]
+    doc_ids = batch[2] if len(batch) > 2 else None
+    logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
+                     doc_ids=doc_ids)
+    return _masked_nll(logits, labels)
 
 
 # ---------------------------------------------------------------- training
@@ -252,7 +267,7 @@ def adamw_update(params, grads, state, lr, step, b1=0.9, b2=0.95, eps=1e-8,
 
 def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
                     clip_norm=1.0, lr=3e-4, sp_axis=None, donate=True,
-                    schedule="gpipe"):
+                    schedule=None):
     """Build the jitted 4D-parallel train step.
 
     (params, opt_state, step, batch) → (params, opt_state, loss)
@@ -260,8 +275,18 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
     schedule: with pp>1, "gpipe" runs the differentiable scan pipeline
     (AD backward, O(n_micro) stashed activations) and "1f1b" runs the
     hand-seeded one-forward-one-backward schedule (O(pp) stashed stage
-    inputs — reference pipeline_parallel.py:958 parity).
+    inputs — reference pipeline_parallel.py:958 parity). None (default)
+    consults fleet's strategy.pipeline_configs['schedule_mode'] when
+    fleet.init ran, else "gpipe".
     """
+    if schedule is None:
+        schedule = "gpipe"
+        try:
+            from ..distributed.fleet import fleet as _fleet
+            if getattr(_fleet, "_is_initialized", False):
+                schedule = _fleet.pipeline_schedule()
+        except ImportError:  # pragma: no cover
+            pass
     use_pp = mesh.shape.get("pp", 1) > 1
     specs = param_specs(config, mesh, pp=use_pp)
     pshard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), specs,
@@ -298,15 +323,15 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             lambda e: jnp.take(e, input_ids, axis=0), params["embed"])
 
         def head_fn(hp, h, tgt):
+            # NB: the pipeline averages per-microbatch losses uniformly
+            # (reference pipeline_parallel semantics); with ignore-
+            # labels this weights microbatches equally regardless of
+            # their valid-token counts — exact count-weighting lives in
+            # the non-pp grad-accum path.
             hh = _rms(h, hp["final_norm"], c.rms_norm_eps)
             logits = hh @ hp["lm_head"]
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            picked = jnp.take_along_axis(
-                logp, jnp.maximum(tgt, 0)[..., None].astype(jnp.int32),
-                axis=-1)[..., 0]
-            valid = (tgt >= 0).astype(jnp.float32)
-            return -jnp.sum(picked * valid) / jnp.maximum(
-                jnp.sum(valid), 1.0)
+            s, n = _masked_nll(logits, tgt)
+            return s / jnp.maximum(n, 1.0)
 
         n_stages = mesh.shape["pp"]
         staged = group_stages(params["layers"], n_stages)
@@ -342,20 +367,28 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             parts = tuple(p.reshape(n_micro, mb, *p.shape[1:])
                           for p in batch)
 
+            # accumulate SUMMED NLL + valid counts so microbatches are
+            # weighted by their valid-token counts — exactly equal to
+            # the one-shot step even with uneven ignore-labels
             def micro(acc, mb_batch):
-                acc_l, acc_g = acc
-                l, g = jax.value_and_grad(loss_fn)(
-                    params, mb_batch, config, None, None, remat, sp_axis)
+                acc_s, acc_n, acc_g = acc
+
+                def sum_only(p):
+                    s, n = loss_sum_fn(p, mb_batch, config, None, None,
+                                       remat, sp_axis)
+                    return s, n
+                (s, n), g = jax.value_and_grad(sum_only, has_aux=True)(params)
                 acc_g = jax.tree_util.tree_map(
                     lambda a, b: a + b.astype(jnp.float32), acc_g, g)
-                return (acc_l + l, acc_g), None
+                return (acc_s + s, acc_n + n, acc_g), None
 
             zero_g = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            (loss, grads), _ = lax.scan(micro, (jnp.float32(0.0), zero_g),
-                                        parts)
-            loss = loss / n_micro
-            grads = jax.tree_util.tree_map(lambda g: g / n_micro, grads)
+            (loss_s, loss_n, grads), _ = lax.scan(
+                micro, (jnp.float32(0.0), jnp.float32(0.0), zero_g), parts)
+            denom = jnp.maximum(loss_n, 1.0)
+            loss = loss_s / denom
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, batch, config, mesh if use_pp else None, n_micro,

@@ -386,6 +386,33 @@ class TestGradAccum:
             b = np.asarray(outs[nm][0]["layers"]["wq"], np.float32)
             assert np.allclose(a, b, atol=1e-5), f"n_micro={nm}"
 
+    def test_n_micro_matches_with_uneven_ignore_labels(self):
+        """Grad accumulation must weight microbatches by VALID token
+        counts: with ignore-labels piled into one microbatch, n_micro=2
+        still equals the one-shot step exactly."""
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models import llama_spmd as M
+        from jax.sharding import Mesh
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               kv_heads=4, ffn=64)
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+        x = np.random.RandomState(0).randint(0, 64, (4, 16))
+        y = np.random.RandomState(1).randint(0, 64, (4, 16))
+        y[:2, 4:] = -1  # first microbatch mostly ignored: 2x24 vs 2x64
+
+        outs = {}
+        for nm in (None, 2):
+            params = M.init_params(cfg, seed=3)
+            opt = M.init_opt_state(params)
+            step = M.make_train_step(cfg, mesh, n_micro=nm, remat=False,
+                                     donate=False)
+            params, opt, loss = step(params, opt, jnp.asarray(0), (x, y))
+            outs[nm] = (float(loss), np.asarray(params["layers"]["wq"],
+                                                np.float32))
+        assert abs(outs[None][0] - outs[2][0]) < 1e-5, \
+            (outs[None][0], outs[2][0])
+        assert np.allclose(outs[None][1], outs[2][1], atol=1e-5)
+
     def test_n_micro_indivisible_raises(self):
         from paddle_tpu.models.llama import LlamaConfig
         from paddle_tpu.models import llama_spmd as M
@@ -403,6 +430,34 @@ class TestGradAccum:
 
 
 class TestFleetAPI:
+    def test_pipeline_schedule_mode_flows_to_train_step(self):
+        """strategy.pipeline_configs['schedule_mode'] (reference
+        pipeline_optimizer) selects the SPMD pipeline schedule."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models import llama_spmd as M
+        strategy = fleet.DistributedStrategy()
+        strategy.pipeline = True
+        strategy.hybrid_configs = {"dp_degree": 4, "pp_degree": 2}
+        strategy.pipeline_configs = {"schedule_mode": "1F1B",
+                                     "micro_batch_size": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        assert fleet.fleet.pipeline_schedule() == "1f1b"
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4,
+                               kv_heads=4, ffn=64)
+        mesh = fleet.fleet.get_mesh()
+        params = M.place_params(M.init_params(cfg, seed=0), cfg, mesh)
+        opt = M.init_opt_state(params)
+        # schedule=None -> consult fleet -> 1f1b
+        step = M.make_train_step(cfg, mesh, n_micro=2, remat=False,
+                                 donate=False)
+        x = np.random.RandomState(0).randint(0, 64, (4, 16))
+        params, opt, loss = step(params, opt, jnp.asarray(0), (x, x))
+        assert np.isfinite(float(loss))
+        strategy.pipeline_configs = {"schedule_mode": "F-then-B"}
+        fleet.init(is_collective=True, strategy=strategy)
+        assert fleet.fleet.pipeline_schedule() == "gpipe"
+
     def test_fleet_init_topology(self):
         from paddle_tpu.distributed import fleet
         strategy = fleet.DistributedStrategy()
