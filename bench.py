@@ -90,9 +90,15 @@ def _tuned_defaults():
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         with open(os.path.join(here, "TUNED.json")) as f:
-            return json.load(f).get("best", {})
+            data = json.load(f)
     except (OSError, json.JSONDecodeError):
         return {}
+    if data.get("smoke"):
+        # a smoke-mode search wrote here (PT_TUNE_OUT override or a
+        # copied TUNED.smoke.json) — fake numbers must not become the
+        # on-chip defaults
+        return {}
+    return data.get("best", {})
 
 
 def _last_tpu_history():

@@ -17,6 +17,20 @@ every stage (partial progress survives a mid-search tunnel death), and
 bench.py reads TUNED.json as its defaults.
 
 Run on a live chip:  python tools/autotune.py
+
+Smoke mode (no hardware): PT_TUNE_SMOKE=1 skips the TPU-alive probe and
+runs the full stage-A/B/C search against a stub child
+(tools/_tune_smoke_child.py by default) that answers with deterministic
+fake numbers — so the tuner's parsing, guards, dedup, and persistence
+are all proven BEFORE its first unattended run on a real tunnel window.
+Smoke results are written to TUNED.smoke.json (or $PT_TUNE_OUT), never
+to the TUNED.json that bench.py reads as defaults.
+
+Env knobs:
+  PT_TUNE_SMOKE=1   — smoke mode (see above)
+  PT_TUNE_CHILD     — path to the per-trial child script
+  PT_TUNE_OUT       — output path override for the winner JSON
+  PT_TUNE_TRIAL_TIMEOUT — per-trial wall clock (seconds)
 """
 from __future__ import annotations
 
@@ -28,7 +42,15 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
-TUNED = os.path.join(ROOT, "TUNED.json")
+SMOKE = os.environ.get("PT_TUNE_SMOKE") == "1"
+# Smoke output must NEVER land on the TUNED.json bench.py reads as
+# defaults — fake numbers as real defaults would poison the next
+# on-chip bench.
+TUNED = os.environ.get("PT_TUNE_OUT") or os.path.join(
+    ROOT, "TUNED.smoke.json" if SMOKE else "TUNED.json")
+_DEFAULT_CHILD = os.path.join(HERE, "_tune_smoke_child.py") if SMOKE \
+    else os.path.join(ROOT, "bench.py")
+CHILD = os.environ.get("PT_TUNE_CHILD") or _DEFAULT_CHILD
 
 TRIAL_TIMEOUT = int(os.environ.get("PT_TUNE_TRIAL_TIMEOUT", "600"))
 
@@ -73,7 +95,7 @@ def run_trial(cfg, trials):
                PT_BENCH_NMICRO=str(cfg.get("n_micro", 0)))
     t0 = time.perf_counter()
     try:
-        r = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+        r = subprocess.run([sys.executable, CHILD],
                            env=env, capture_output=True, text=True,
                            timeout=TRIAL_TIMEOUT)
     except subprocess.TimeoutExpired:
@@ -83,10 +105,12 @@ def run_trial(cfg, trials):
     out = None
     for line in reversed(r.stdout.strip().splitlines()):
         try:
-            out = json.loads(line)
-            break
+            parsed = json.loads(line)
         except json.JSONDecodeError:
             continue
+        if isinstance(parsed, dict):  # bare numbers/strings are valid JSON
+            out = parsed
+            break
     if r.returncode != 0 or out is None:
         tail = "\n".join(r.stderr.strip().splitlines()[-4:])
         print(f"  trial {cfg} FAILED rc={r.returncode}: {tail}", flush=True)
@@ -123,7 +147,7 @@ def persist(best_cfg, best_res, trials, done):
     data = {"best": dict(best_cfg, tok_s=best_res["value"],
                          mfu=best_res["extra"]["mfu"],
                          mfu_legacy=best_res["extra"].get("mfu_legacy")),
-            "stages_done": done, "n_trials": len(trials),
+            "stages_done": done, "n_trials": len(trials), "smoke": SMOKE,
             "trials": [{"cfg": t["cfg"],
                         "tok_s": t["result"]["value"] if t["result"] else None,
                         "error": t.get("error")} for t in trials],
@@ -132,22 +156,26 @@ def persist(best_cfg, best_res, trials, done):
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1)
     os.replace(tmp, TUNED)
-    print(f"TUNED.json <- {data['best']}", flush=True)
+    print(f"{os.path.basename(TUNED)} <- {data['best']}", flush=True)
 
 
 def main():
-    # refuse to tune on CPU — numbers would be meaningless as defaults
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, text=True, timeout=180)
-        alive = probe.returncode == 0 and probe.stdout.strip() == "tpu"
-    except subprocess.TimeoutExpired:
-        alive = False  # half-wedged tunnel: device init hung
-    if not alive:
-        print("autotune: TPU unreachable; not tuning", file=sys.stderr)
-        sys.exit(1)
+    if SMOKE:
+        print(f"autotune: SMOKE mode (child={os.path.basename(CHILD)}, "
+              f"out={os.path.basename(TUNED)})", flush=True)
+    else:
+        # refuse to tune on CPU — numbers would be meaningless as defaults
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=180)
+            alive = probe.returncode == 0 and probe.stdout.strip() == "tpu"
+        except subprocess.TimeoutExpired:
+            alive = False  # half-wedged tunnel: device init hung
+        if not alive:
+            print("autotune: TPU unreachable; not tuning", file=sys.stderr)
+            sys.exit(1)
 
     seq = int(os.environ.get("PT_TUNE_SEQ", "2048"))
     trials = []
