@@ -201,6 +201,10 @@ def main():
 
     n_micro = int(os.environ.get("PT_BENCH_NMICRO",
                                  str(tuned.get("n_micro", 0)))) or None
+    # fused linear+CE head (no (B,S,V) logits materialization) — the
+    # biggest single-chip MFU lever at vocab 32000; swept by autotune
+    fused_ce = os.environ.get(
+        "PT_FUSED_CE", "1" if tuned.get("fused_ce") else "0") == "1"
     if n_micro and batch % n_micro:
         # an indivisible n_micro would trip the grad-accum assert during
         # trace and get swallowed by the pallas-fallback except below,
@@ -212,7 +216,8 @@ def main():
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
     params = M.init_params(cfg, seed=0, dtype=dtype)
     opt = M.init_opt_state(params)
-    step = M.make_train_step(cfg, mesh, n_micro=n_micro, remat=remat, lr=3e-4)
+    step = M.make_train_step(cfg, mesh, n_micro=n_micro, remat=remat, lr=3e-4,
+                             fused_ce=fused_ce)
 
     rng = np.random.RandomState(0)
     x = rng.randint(0, cfg.vocab_size, (batch, seq))
@@ -245,7 +250,7 @@ def main():
         params = M.init_params(cfg, seed=0, dtype=dtype)
         opt = M.init_opt_state(params)
         step = M.make_train_step(cfg, mesh, n_micro=n_micro, remat=remat,
-                                 lr=3e-4)
+                                 lr=3e-4, fused_ce=fused_ce)
         params, opt, loss = step(params, opt, jnp.asarray(0), data)
         jax.block_until_ready(loss)
 
@@ -290,6 +295,7 @@ def main():
                                       "kept); attention full 12LHS on a "
                                       "causal kernel",
                   "loss": float(loss), "backend": backend,
+                  "fused_ce": fused_ce,
                   "pallas_fallback": pallas_fallback},
     }
     if not on_tpu:
@@ -310,7 +316,7 @@ def main():
                  if k != "last_tpu_measured"}
         hist = dict(result, extra=extra, ts=time.time(), batch=batch,
                     seq=seq, remat=str(remat), n_micro=n_micro,
-                    docs=docs or None,
+                    docs=docs or None, fused_ce=fused_ce,
                     block_q=os.environ.get("PT_FLASH_BLOCK_Q"),
                     block_k=os.environ.get("PT_FLASH_BLOCK_K"))
         here = os.path.dirname(os.path.abspath(__file__))

@@ -148,12 +148,17 @@ def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
 
 
 def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
-            remat=True, sp_axis=None, doc_ids=None):
+            remat=True, sp_axis=None, doc_ids=None, return_hidden=False):
     """→ logits (B, S, V). Uses pipeline when mesh has pp>1, else scan.
 
     doc_ids: optional (B, S) contiguous document ids for packed-sequence
     pretraining — attention stays causal within a document and is
     blocked across documents via the FlashMask kernel (no dense mask).
+
+    return_hidden: return the final-norm'd hidden states (B, S, H)
+    WITHOUT the lm_head projection — the fused linear+cross-entropy
+    loss path consumes these directly so the (B, S, V) logits are never
+    materialized.
     """
     c = config
     s = input_ids.shape[1]
@@ -197,6 +202,8 @@ def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
         h, _ = lax.scan(body, h, params["layers"])
 
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    if return_hidden:
+        return h
     return h @ params["lm_head"]
 
 
@@ -212,22 +219,63 @@ def _masked_nll(logits, labels):
     return -jnp.sum(picked * valid), jnp.sum(valid)
 
 
+# default vocab-chunk for the fused linear+CE path; 8192 keeps the live
+# (N, chunk) logits slab ~64 MB at N=32k tokens vs 4 GB for full fp32
+# (B, S, V) logits at V=32000
+FUSED_CE_CHUNK = 8192
+
+
+def _fused_masked_nll(h, lm_head, labels, chunk=FUSED_CE_CHUNK):
+    """(nll_sum, valid_count) via ops.fused.fused_linear_cross_entropy:
+    the (B, S, V) logits are never materialized — vocab is streamed in
+    chunks with an online logsumexp (reference parity:
+    paddle/phi/kernels/gpu/cross_entropy_kernel.cu softmax+CE fusion).
+    Same semantics as _masked_nll(h @ lm_head, labels)."""
+    from ..ops.fused import fused_linear_cross_entropy
+    B, S, H = h.shape
+    x = h.reshape(B * S, H)
+    lab = labels.reshape(B * S).astype(jnp.int32)
+    valid = lab >= 0
+    per_tok = fused_linear_cross_entropy(
+        x, lm_head, jnp.where(valid, lab, 0), chunk_size=chunk,
+        reduction="none")
+    per_tok = jnp.where(valid, per_tok, 0.0)
+    return jnp.sum(per_tok), jnp.sum(valid.astype(jnp.float32))
+
+
+def _resolve_fused_ce(fused_ce):
+    """None → the PT_FUSED_CE env knob (bench/autotune sweep surface)."""
+    if fused_ce is None:
+        import os
+        return os.environ.get("PT_FUSED_CE", "0") == "1"
+    return bool(fused_ce)
+
+
 def loss_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
-            sp_axis=None):
+            sp_axis=None, fused_ce=False):
     """batch: (input_ids, labels) or (input_ids, labels, doc_ids) for
     packed-document pretraining. Labels < 0 are ignored (masked mean)."""
-    s, n = loss_sum_fn(params, batch, config, mesh, n_micro, remat, sp_axis)
+    s, n = loss_sum_fn(params, batch, config, mesh, n_micro, remat, sp_axis,
+                       fused_ce=fused_ce)
     return s / jnp.maximum(n, 1.0)
 
 
 def loss_sum_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
-                sp_axis=None):
+                sp_axis=None, fused_ce=False):
     """(nll_sum, valid_count) variant — the grad-accumulation path
     accumulates these so microbatches are weighted by their VALID token
     counts, keeping n_micro=k exactly equal to the one-shot step even
-    with unevenly distributed ignore-labels."""
+    with unevenly distributed ignore-labels.
+
+    fused_ce=True routes the head through the fused linear+CE op (no
+    logits materialization) — numerically equivalent, big activation-
+    memory/HBM win at large vocab."""
     input_ids, labels = batch[0], batch[1]
     doc_ids = batch[2] if len(batch) > 2 else None
+    if fused_ce:
+        h = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
+                    doc_ids=doc_ids, return_hidden=True)
+        return _fused_masked_nll(h, params["lm_head"], labels)
     logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
                      doc_ids=doc_ids)
     return _masked_nll(logits, labels)
@@ -267,7 +315,7 @@ def adamw_update(params, grads, state, lr, step, b1=0.9, b2=0.95, eps=1e-8,
 
 def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
                     clip_norm=1.0, lr=3e-4, sp_axis=None, donate=True,
-                    schedule=None):
+                    schedule=None, fused_ce=None):
     """Build the jitted 4D-parallel train step.
 
     (params, opt_state, step, batch) → (params, opt_state, loss)
@@ -278,7 +326,13 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
     inputs — reference pipeline_parallel.py:958 parity). None (default)
     consults fleet's strategy.pipeline_configs['schedule_mode'] when
     fleet.init ran, else "gpipe".
+
+    fused_ce: route every loss path through the fused linear+CE op so
+    the (B, S, V) logits never materialize (reference:
+    phi/kernels/gpu/cross_entropy_kernel.cu fusion). None consults the
+    PT_FUSED_CE env knob so bench.py/autotune can sweep it.
     """
+    fused_ce = _resolve_fused_ce(fused_ce)
     if schedule is None:
         schedule = "gpipe"
         try:
@@ -323,15 +377,16 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             lambda e: jnp.take(e, input_ids, axis=0), params["embed"])
 
         def head_fn(hp, h, tgt):
-            # NB: the pipeline averages per-microbatch losses uniformly
-            # (reference pipeline_parallel semantics); with ignore-
-            # labels this weights microbatches equally regardless of
-            # their valid-token counts — exact count-weighting lives in
-            # the non-pp grad-accum path.
+            # returns (nll_sum, valid_count): pipeline_train_1f1b
+            # normalizes by the GLOBAL valid count, so microbatches are
+            # weighted by their valid tokens — identical loss/grad
+            # semantics to the no-pp and grad-accum paths even with
+            # uneven ignore-label masking.
             hh = _rms(h, hp["final_norm"], c.rms_norm_eps)
+            if fused_ce:
+                return _fused_masked_nll(hh, hp["lm_head"], tgt)
             logits = hh @ hp["lm_head"]
-            s, n = _masked_nll(logits, tgt)
-            return s / jnp.maximum(n, 1.0)
+            return _masked_nll(logits, tgt)
 
         n_stages = mesh.shape["pp"]
         staged = group_stages(params["layers"], n_stages)
@@ -375,7 +430,7 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
 
                 def sum_only(p):
                     s, n = loss_sum_fn(p, mb_batch, config, None, None,
-                                       remat, sp_axis)
+                                       remat, sp_axis, fused_ce=fused_ce)
                     return s, n
                 (s, n), g = jax.value_and_grad(sum_only, has_aux=True)(params)
                 acc_g = jax.tree_util.tree_map(
@@ -392,7 +447,7 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
         else:
             loss, grads = jax.value_and_grad(loss_fn)(
                 params, batch, config, mesh if use_pp else None, n_micro,
-                remat, sp_axis)
+                remat, sp_axis, fused_ce)
         if clip_norm is not None:
             leaves = jax.tree_util.tree_leaves(grads)
             gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))

@@ -139,13 +139,19 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
       x: (B, ...) activations entering stage 0 (replicated over pp).
       targets: (B, ...) labels, consumed by head_fn on the last stage.
       layer_fn(layer_params, h, extra) -> h: one transformer layer.
-      head_fn(head_params, h, targets_mb) -> scalar mean loss for one
-        microbatch (fold final-norm + lm_head + loss here).
+      head_fn(head_params, h, targets_mb) -> (loss_sum, weight) for one
+        microbatch (fold final-norm + lm_head + loss here). The
+        pipeline's loss is sum(loss_sum) / sum(weight) over all
+        microbatches, so with ignore-labels every microbatch is
+        weighted by its VALID token count — exactly matching the no-pp
+        and grad-accum paths even with unevenly distributed masking.
+        For plain mean-loss semantics return (mean_loss, 1.0).
       head_params: pytree, replicated.
     Returns:
-      (mean_loss, stage_grads, head_grads, dx) — stage_grads matches
-      stage_params' structure/sharding (fp32), head_grads matches
-      head_params (fp32, replicated), dx is dLoss/dx (B, ...).
+      (loss, stage_grads, head_grads, dx) — loss = Σ loss_sum / Σ
+      weight; stage_grads matches stage_params' structure/sharding
+      (fp32), head_grads matches head_params (fp32, replicated), dx is
+      dLoss/dx (B, ...).
     """
     n_stages = mesh.shape[pp_axis]
     B = x.shape[0]
@@ -175,10 +181,11 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
         stash0 = jnp.zeros((cap,) + xm.shape[1:], xm.dtype)
         act0 = jnp.zeros_like(xm[0])
         carry0 = (stash0, act0, act0, f32z(params_local), f32z(head_p),
-                  jnp.zeros_like(xm), jnp.zeros((M,), jnp.float32))
+                  jnp.zeros_like(xm), jnp.zeros((M,), jnp.float32),
+                  jnp.zeros((M,), jnp.float32))
 
         def tick(carry, t):
-            stash, fwd_buf, bwd_buf, gparams, ghead, dx, losses = carry
+            stash, fwd_buf, bwd_buf, gparams, ghead, dx, losses, wts = carry
 
             # ---- forward sub-tick: microbatch mf = t - s
             mf = t - s
@@ -195,26 +202,33 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
                 lambda st: st, stash)
 
             # last stage: head vjp NOW — its gy seeds this tick's
-            # backward sub-tick (bwd microbatch == mf on the last stage)
+            # backward sub-tick (bwd microbatch == mf on the last stage).
+            # The backward is seeded with d/d(loss_sum) = 1; the global
+            # 1/Σweight normalization is applied once after the scan.
             def head_grad(args):
                 y_, tgt = args
-                loss_m, pull = jax.vjp(
-                    lambda hp, yy: head_fn(hp, yy, tgt), head_p, y_)
+                loss_m, pull, w_m = jax.vjp(
+                    lambda hp, yy: head_fn(hp, yy, tgt), head_p, y_,
+                    has_aux=True)
                 ghp, gy = pull(jnp.float32(1.0))
-                return (loss_m,
+                return (loss_m, jnp.float32(w_m),
                         jax.tree_util.tree_map(
                             lambda a: a.astype(jnp.float32), ghp),
                         gy.astype(y_.dtype))
-            loss_m, ghp, gy = lax.cond(
+            loss_m, w_m, ghp, gy = lax.cond(
                 f_active & is_last, head_grad,
-                lambda args: (jnp.float32(0.0), f32z(head_p),
-                              jnp.zeros_like(args[0])),
+                lambda args: (jnp.float32(0.0), jnp.float32(0.0),
+                              f32z(head_p), jnp.zeros_like(args[0])),
                 (y, tm[mf_c]))
             ghead = jax.tree_util.tree_map(lambda a, b: a + b, ghead, ghp)
             losses = lax.cond(
                 f_active & is_last,
                 lambda ls: ls.at[mf_c].set(loss_m),
                 lambda ls: ls, losses)
+            wts = lax.cond(
+                f_active & is_last,
+                lambda ws: ws.at[mf_c].set(w_m),
+                lambda ws: ws, wts)
 
             # ---- backward sub-tick: microbatch mb_ = t - (2S - 2 - s)
             mb_ = t - (2 * S - 2 - s)
@@ -250,39 +264,50 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
             bwd_buf = lax.ppermute(
                 gh, pp_axis, [(i, (i - 1) % S) for i in range(S)])
             return (stash, fwd_buf, bwd_buf, gparams, ghead, dx,
-                    losses), None
+                    losses, wts), None
 
-        (_, _, _, gparams, ghead, dx, losses), _ = lax.scan(
+        (_, _, _, gparams, ghead, dx, losses, wts), _ = lax.scan(
             tick, carry0, jnp.arange(total))
 
-        inv_m = jnp.float32(1.0 / M)
-        gparams = jax.tree_util.tree_map(
-            lambda a: (a * inv_m)[None], gparams)  # re-add stage axis
-        # ghead/losses live on the last rank, dx on rank 0 — replicate
-        ghead = jax.tree_util.tree_map(
-            lambda a: lax.psum(a * inv_m, pp_axis), ghead)
-        dx = lax.psum(jnp.where(s == 0, dx * inv_m, jnp.zeros_like(dx)),
-                      pp_axis)
+        # losses/wts live on the last rank, dx on rank 0 — replicate,
+        # then normalize everything by the GLOBAL weight sum (valid
+        # token count for NLL heads), so uneven ignore-label masking
+        # across microbatches matches the no-pp step exactly
         losses = lax.psum(jnp.where(is_last, losses,
                                     jnp.zeros_like(losses)), pp_axis)
-        return gparams, ghead, dx, losses
+        wts = lax.psum(jnp.where(is_last, wts, jnp.zeros_like(wts)),
+                       pp_axis)
+        inv_w = 1.0 / jnp.maximum(jnp.sum(wts), 1e-9)
+        gparams = jax.tree_util.tree_map(
+            lambda a: (a * inv_w)[None], gparams)  # re-add stage axis
+        ghead = jax.tree_util.tree_map(
+            lambda a: lax.psum(a, pp_axis) * inv_w, ghead)
+        dx = lax.psum(jnp.where(s == 0, dx, jnp.zeros_like(dx)),
+                      pp_axis) * inv_w
+        return gparams, ghead, dx, losses, wts
 
     mapped = jax.shard_map(
         per_rank, mesh=mesh,
         in_specs=(P(pp_axis), P(), P(), P(), P()),
-        out_specs=(P(pp_axis), P(), P(), P()),
+        out_specs=(P(pp_axis), P(), P(), P(), P()),
         axis_names=frozenset({pp_axis}),
         check_vma=False)
-    gstage, ghead, dx, losses = mapped(
+    gstage, ghead, dx, losses, wts = mapped(
         stage_params, x_micro, t_micro, head_params,
         extra if extra is not None else jnp.zeros(()))
-    return (jnp.mean(losses), gstage, ghead,
-            dx.reshape(B, *dx.shape[2:]))
+    loss = jnp.sum(losses) / jnp.maximum(jnp.sum(wts), 1e-9)
+    return loss, gstage, ghead, dx.reshape(B, *dx.shape[2:])
 
 
 def pipeline_bubble_fraction(n_micro, n_stages, schedule="1f1b"):
-    """Idle fraction of the tick grid. Both schedules share the same
-    bubble; 1F1B's win is O(stages) activation memory, not wall-clock."""
+    """Idle fraction of the tick grid.
+
+    Our lockstep 1F1B burns M + 2S - 2 full fwd+bwd ticks — (S-1) extra
+    tick-pairs versus the GPipe-AD path's M + S - 1 (canonical
+    asynchronous 1F1B also needs M + S - 1) — in exchange for O(stages)
+    stashed stage inputs instead of GPipe's O(n_micro) activations.
+    Efficiency numbers printed from this function reflect that larger
+    bubble; pick 1F1B for memory, GPipe for the smaller tick grid."""
     if schedule == "1f1b":
         return (2 * n_stages - 2) / (n_micro + 2 * n_stages - 2)
     return (n_stages - 1) / (n_micro + n_stages - 1)

@@ -49,17 +49,19 @@ def test_full_search_finds_planted_peak(tmp_path):
     best = data["best"]
     # the smoke child's landscape peaks exactly here
     assert (best["batch"], best["remat"]) == (24, "dots")
+    assert best["fused_ce"] is True
     assert (best["block_q"], best["block_k"]) == (256, 512)
     assert best["n_micro"] == 2
-    assert best["tok_s"] == 14650.0
+    assert best["tok_s"] == 15850.0
 
 
 def test_dedup_skips_equivalent_configs(tmp_path):
     r, data = run_tuner(tmp_path)
     assert r.returncode == 0
-    # stage A: 7 trials; stage B: 5 configs but (128,128) == the
-    # stage-A winner's effective knobs -> 4 measured; stage C: 2.
-    assert data["n_trials"] == 13
+    # stage A: 14 trials (3 batches x 2 remat x 2 fused_ce + 2 probes);
+    # stage B: 5 configs but (128,128) == the stage-A winner's
+    # effective knobs -> 4 measured; stage C: 2.
+    assert data["n_trials"] == 20
     cfgs = [json.dumps(t["cfg"], sort_keys=True) for t in data["trials"]]
     assert len(set(cfgs)) == len(cfgs), "a config was measured twice"
 

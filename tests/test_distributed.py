@@ -257,16 +257,18 @@ class Test1F1B:
             return jnp.tanh(h @ lw)
 
         def head_fn(hp, h, t):
+            # 1F1B head contract: (loss_sum, weight) — the pipeline
+            # normalizes by the global weight sum
             logp = jax.nn.log_softmax(h @ hp["w"], axis=-1)
-            return -jnp.mean(jnp.take_along_axis(
-                logp, t[..., None], axis=-1))
+            picked = jnp.take_along_axis(logp, t[..., None], axis=-1)
+            return -jnp.sum(picked), jnp.float32(picked.size)
 
         def dense_loss(W_, hw, x_):
             h = x_
             for i in range(Lp):
                 h = layer_fn(W_[i], h, None)
-            # per-microbatch mean-of-means == global mean (equal sizes)
-            return head_fn({"w": hw}, h, tgt)
+            s, n = head_fn({"w": hw}, h, tgt)
+            return s / n
 
         loss_ref, g_ref = jax.value_and_grad(dense_loss, (0, 1, 2))(
             W, head_w, x)

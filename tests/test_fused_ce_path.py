@@ -1,0 +1,175 @@
+"""Fused linear+cross-entropy in the flagship Llama loss paths
+(VERDICT r4 item 2) and count-weighted 1F1B loss (ADVICE r3 item 2).
+
+The fused path must be a pure drop-in: identical loss and gradients to
+the materialized-logits path on every route a train step can take —
+one-shot, grad-accum, and the 1F1B pipeline — including batches with
+unevenly distributed ignore-labels.
+
+Reference parity: the softmax+CE fusion in
+/root/reference/paddle/phi/kernels/gpu/cross_entropy_kernel.cu and the
+fused kernels in /root/reference/paddle/phi/kernels/fusion/.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models import llama_spmd as M
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.parallel import create_mesh
+
+
+def _cfg(vocab=96):
+    # vocab divisible by tp=4 for the sharded-step tests; the ragged-
+    # chunk test overrides with a prime vocab
+    return LlamaConfig.tiny(vocab=vocab, hidden=32, layers=4, heads=4,
+                            kv_heads=4, ffn=64)
+
+
+def _batch(cfg, B=4, S=16, uneven_mask=False, seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)))
+    y = rng.randint(0, cfg.vocab_size, (B, S))
+    if uneven_mask:
+        # row 0 nearly all ignored, row B-1 fully valid — uniform
+        # microbatch weighting would visibly diverge from count
+        # weighting on this batch
+        y[0, : S - 2] = -1
+        y[1, : S // 2] = -1
+    return x, jnp.asarray(y)
+
+
+class TestFusedLossEquivalence:
+    def test_loss_value_matches(self):
+        cfg = _cfg()
+        params = M.init_params(cfg, seed=1)
+        batch = _batch(cfg, uneven_mask=True)
+        base = M.loss_fn(params, batch, cfg, remat=False)
+        fused = M.loss_fn(params, batch, cfg, remat=False, fused_ce=True)
+        assert np.isclose(float(base), float(fused), rtol=1e-5), \
+            (float(base), float(fused))
+
+    def test_grads_match(self):
+        cfg = _cfg()
+        params = M.init_params(cfg, seed=1)
+        batch = _batch(cfg, uneven_mask=True)
+        g0 = jax.grad(M.loss_fn)(params, batch, cfg, remat=False)
+        g1 = jax.grad(lambda p: M.loss_fn(p, batch, cfg, remat=False,
+                                          fused_ce=True))(params)
+        flat0 = jax.tree_util.tree_leaves_with_path(g0)
+        flat1 = jax.tree_util.tree_leaves(g1)
+        for (path, a), b in zip(flat0, flat1):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=2e-5, rtol=1e-4, err_msg=str(path))
+
+    def test_chunking_crosses_vocab_boundaries(self):
+        """vocab 97 with chunk 32: labels land in every chunk including
+        the ragged last one — the online logsumexp must agree."""
+        cfg = _cfg(vocab=97)
+        params = M.init_params(cfg, seed=2)
+        x, y = _batch(cfg, seed=3)
+        h = M.forward(params, x, cfg, remat=False, return_hidden=True)
+        s0, n0 = M._masked_nll(h @ params["lm_head"], y)
+        s1, n1 = M._fused_masked_nll(h, params["lm_head"], y, chunk=32)
+        assert np.isclose(float(s0), float(s1), rtol=1e-5)
+        assert float(n0) == float(n1)
+
+
+class TestFusedTrainStepRoutes:
+    def _run(self, mesh_axes, step_kw, B=4, uneven=True, steps=2):
+        cfg = _cfg()
+        mesh = create_mesh(mesh_axes)
+        params = M.init_params(cfg, seed=5)
+        if mesh.shape.get("pp", 1) > 1:
+            params = M.place_params(params, cfg, mesh)
+        opt = M.init_opt_state(params)
+        step = M.make_train_step(cfg, mesh, remat=False, donate=False,
+                                 **step_kw)
+        batch = _batch(cfg, B=B, uneven_mask=uneven)
+        losses = []
+        for i in range(steps):
+            params, opt, loss = step(params, opt, jnp.asarray(i), batch)
+            losses.append(float(loss))
+        return losses, jax.device_get(params)
+
+    def _assert_same(self, a, b):
+        la, pa = a
+        lb, pb = b
+        assert np.allclose(la, lb, atol=1e-4), (la, lb)
+        fa = jax.tree_util.tree_leaves_with_path(pa)
+        fb = jax.tree_util.tree_leaves(pb)
+        for (path, x), y in zip(fa, fb):
+            np.testing.assert_allclose(np.asarray(x, np.float32),
+                                       np.asarray(y, np.float32),
+                                       atol=3e-4, err_msg=str(path))
+
+    def test_one_shot(self):
+        base = self._run({"dp": 2, "tp": 4}, {"fused_ce": False})
+        fused = self._run({"dp": 2, "tp": 4}, {"fused_ce": True})
+        self._assert_same(base, fused)
+
+    def test_grad_accum(self):
+        base = self._run({"dp": 2, "tp": 4}, {"fused_ce": False, "n_micro": 2})
+        fused = self._run({"dp": 2, "tp": 4}, {"fused_ce": True, "n_micro": 2})
+        self._assert_same(base, fused)
+
+    def test_1f1b(self):
+        base = self._run({"pp": 4, "dp": 2},
+                         {"fused_ce": False, "schedule": "1f1b",
+                          "n_micro": 2})
+        fused = self._run({"pp": 4, "dp": 2},
+                          {"fused_ce": True, "schedule": "1f1b",
+                           "n_micro": 2})
+        self._assert_same(base, fused)
+
+    def test_env_knob(self, monkeypatch):
+        """fused_ce=None consults PT_FUSED_CE — the bench/autotune
+        sweep surface."""
+        monkeypatch.setenv("PT_FUSED_CE", "1")
+        fused = self._run({"dp": 2, "tp": 4}, {"fused_ce": None})
+        monkeypatch.setenv("PT_FUSED_CE", "0")
+        base = self._run({"dp": 2, "tp": 4}, {"fused_ce": None})
+        self._assert_same(base, fused)
+
+
+class Test1F1BCountWeighting:
+    """ADVICE r3 item 2: with uneven ignore-labels, schedule='1f1b'
+    previously weighted microbatches uniformly while every other path
+    weighted by valid-token counts. All paths must now agree."""
+
+    def _losses(self, schedule_kw, mesh_axes):
+        cfg = _cfg()
+        mesh = create_mesh(mesh_axes)
+        params = M.init_params(cfg, seed=7)
+        if mesh.shape.get("pp", 1) > 1:
+            params = M.place_params(params, cfg, mesh)
+        opt = M.init_opt_state(params)
+        step = M.make_train_step(cfg, mesh, remat=False, donate=False,
+                                 **schedule_kw)
+        batch = _batch(cfg, uneven_mask=True)
+        losses = []
+        for i in range(2):
+            params, opt, loss = step(params, opt, jnp.asarray(i), batch)
+            losses.append(float(loss))
+        return losses, jax.device_get(params)
+
+    def test_1f1b_matches_no_pp_with_uneven_masking(self):
+        seq_l, seq_p = self._losses({}, {"dp": 2, "tp": 4})
+        pp_l, pp_p = self._losses({"schedule": "1f1b", "n_micro": 2},
+                                  {"pp": 4, "dp": 2})
+        assert np.allclose(seq_l, pp_l, atol=1e-4), (seq_l, pp_l)
+        for key in ("wq", "w_down", "ln1"):
+            np.testing.assert_allclose(
+                np.asarray(seq_p["layers"][key], np.float32),
+                np.asarray(pp_p["layers"][key], np.float32),
+                atol=3e-4, err_msg=key)
+
+    def test_all_labels_ignored_is_finite(self):
+        cfg = _cfg()
+        params = M.init_params(cfg, seed=9)
+        x, _ = _batch(cfg)
+        y = jnp.full(x.shape, -1)
+        loss = M.loss_fn(params, (x, y), cfg, remat=False, fused_ce=True)
+        assert np.isfinite(float(loss))

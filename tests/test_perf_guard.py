@@ -48,8 +48,9 @@ def test_no_tpu_throughput_regression():
     # entries lacking the remat key ran the default remat=True, and the
     # metric string is a label (it once hard-coded the config), so
     # neither joins the grouping key in a way that would orphan history.
-    # block_q/block_k/n_micro joined the key in r3 (autotune sweeps
-    # write same-batch entries differing only in those knobs).
+    # block_q/block_k/n_micro joined the key in r3, fused_ce in r4
+    # (autotune sweeps write same-batch entries differing only in
+    # those knobs).
     # effective_knobs (shared with autotune + the kernel defaults)
     # normalizes absent/None to the kernel defaults so pre-r3 entries
     # still compare against new same-config runs. A pallas_fallback run
@@ -58,7 +59,7 @@ def test_no_tpu_throughput_regression():
     for e in tpu:
         by_cfg.setdefault((e.get("model", "llama"), e.get("batch"),
                            e.get("seq"), e.get("remat", "True"),
-                           e.get("docs"))
+                           e.get("docs"), bool(e.get("fused_ce")))
                           + _TD.effective_knobs(e)
                           + (bool(e.get("extra", {}).get("pallas_fallback")),),
                           []).append(e)

@@ -29,6 +29,7 @@ def main():
     bq = int(os.environ.get("PT_FLASH_BLOCK_Q", "128"))
     bk = int(os.environ.get("PT_FLASH_BLOCK_K", "128"))
     nm = int(os.environ.get("PT_BENCH_NMICRO", "0"))
+    fce = os.environ.get("PT_FUSED_CE", "0") == "1"
 
     fault = os.environ.get("PT_SMOKE_FAULT", "")
     only_bq = os.environ.get("PT_SMOKE_FAULT_BLOCK_Q")
@@ -49,11 +50,12 @@ def main():
         return
 
     # Deterministic landscape, peaked at batch=24, remat=dots,
-    # (block_q, block_k)=(256, 512), n_micro=2.  Tests assert the
-    # staged search lands exactly there.
+    # fused_ce=True, (block_q, block_k)=(256, 512), n_micro=2.  Tests
+    # assert the staged search lands exactly there.
     v = 10_000.0
     v += {16: 500, 24: 2000, 32: 1200, 8: 100}.get(batch, 0)
     v += {"dots": 1500, "true": 800, "false": 400}.get(remat, 0)
+    v += 1200 if fce else 0
     v += {(128, 128): 0, (256, 256): 600, (256, 512): 900,
           (512, 256): 300, (512, 512): 500}.get((bq, bk), 0)
     v += {0: 0, 2: 250, 4: -400}.get(nm, 0)

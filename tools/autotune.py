@@ -3,10 +3,10 @@
 parallel-config/batch search harness; ours searches the knobs that
 matter on one TPU chip and persists the winner).
 
-Staged search over (batch, remat policy, flash block_q/block_k,
-n_micro) for the headline Llama pretrain step:
+Staged search over (batch, remat policy, fused linear+CE head, flash
+block_q/block_k, n_micro) for the headline Llama pretrain step:
 
-  stage A: batch x remat coarse grid
+  stage A: batch x remat x fused_ce coarse grid
   stage B: flash block sizes at the stage-A winner
   stage C: grad-accum microbatching at the stage-B winner
 
@@ -71,8 +71,8 @@ def _resolved(cfg):
     """Dedup key over EFFECTIVE knobs: {batch,seq,remat} and the same
     cfg with explicit default block/n_micro values build identical
     child environments and must not be measured twice."""
-    return (cfg["batch"], cfg["seq"], str(cfg["remat"]).lower()) + \
-        _TD.effective_knobs(cfg)
+    return (cfg["batch"], cfg["seq"], str(cfg["remat"]).lower(),
+            bool(cfg.get("fused_ce"))) + _TD.effective_knobs(cfg)
 
 
 def run_trial(cfg, trials):
@@ -92,7 +92,8 @@ def run_trial(cfg, trials):
                                     or _TD.DEFAULT_FLASH_BLOCK_Q),
                PT_FLASH_BLOCK_K=str(cfg.get("block_k")
                                     or _TD.DEFAULT_FLASH_BLOCK_K),
-               PT_BENCH_NMICRO=str(cfg.get("n_micro", 0)))
+               PT_BENCH_NMICRO=str(cfg.get("n_micro", 0)),
+               PT_FUSED_CE="1" if cfg.get("fused_ce") else "0")
     t0 = time.perf_counter()
     try:
         r = subprocess.run([sys.executable, CHILD],
@@ -191,13 +192,19 @@ def main():
             # a mid-stage tunnel death must not lose the search
             persist(best_cfg, best_res, trials, list(done))
 
-    # stage A: batch x remat (remat=False OOM'd at batch 16 in r2 —
-    # only try it at the smallest batch)
-    print("stage A: batch x remat", flush=True)
+    # stage A: batch x remat x fused_ce (remat=False OOM'd at batch 16
+    # in r2 — only try it at the smallest batch). fused_ce avoids the
+    # (B,S,V) logits materialization, so it both speeds the head and
+    # frees HBM that may admit configs the plain head OOMs on.
+    print("stage A: batch x remat x fused_ce", flush=True)
     for batch in (16, 24, 32):
         for remat in ("true", "dots"):
-            consider({"batch": batch, "seq": seq, "remat": remat})
-    consider({"batch": 8, "seq": seq, "remat": "false"})
+            for fce in (False, True):
+                consider({"batch": batch, "seq": seq, "remat": remat,
+                          "fused_ce": fce})
+    for fce in (False, True):
+        consider({"batch": 8, "seq": seq, "remat": "false",
+                  "fused_ce": fce})
     if best_res is None:
         print("autotune: every stage-A trial failed; aborting",
               file=sys.stderr)
