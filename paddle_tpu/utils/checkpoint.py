@@ -102,10 +102,22 @@ def save_orbax(path, tree):
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    # atomic like save_state: write beside, swap, then drop the old —
-    # a crash mid-save must never leave zero valid checkpoints
+    # near-atomic like save_state: write beside, swap, then drop the
+    # old. The two-rename swap has a crash window (between moving the
+    # live dir to .old-orbax and moving .tmp-orbax into place nothing
+    # exists at `path`) — load_orbax covers it by falling back to
+    # .old-orbax / .tmp-orbax, so a crash at ANY point still leaves a
+    # loadable checkpoint
     tmp = path + ".tmp-orbax"
     old = path + ".old-orbax"
+    if not os.path.exists(path):
+        # a previous save crashed inside its swap window: promote the
+        # best survivor to `path` BEFORE clearing the scratch names, so
+        # a crash during THIS save still leaves a loadable checkpoint
+        for survivor in (tmp, old):  # tmp = fully-written newer save
+            if os.path.exists(survivor):
+                os.rename(survivor, path)
+                break
     for p in (tmp, old):
         if os.path.exists(p):
             shutil.rmtree(p)
@@ -123,9 +135,20 @@ def save_orbax(path, tree):
 
 def load_orbax(path, like=None):
     """Restore an orbax checkpoint → pytree of numpy arrays (or shaped
-    like `like` when given — required for sharded restore)."""
+    like `like` when given — required for sharded restore).
+
+    Recovery: if `path` is missing but a save_orbax swap was
+    interrupted, restore from `path + '.old-orbax'` (the previous live
+    checkpoint) or `path + '.tmp-orbax'` (the fully-written new one)."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
+    if not os.path.exists(path):
+        for fallback in (path + ".tmp-orbax", path + ".old-orbax"):
+            # .tmp-orbax preferred: it only survives a crash AFTER the
+            # new checkpoint was fully written (save renames it last)
+            if os.path.exists(fallback):
+                path = fallback
+                break
     ckptr = ocp.StandardCheckpointer()
     try:
         if like is not None:

@@ -251,6 +251,12 @@ def decode_jpeg_np(data):
                 struct.unpack(">H", seg[1:3])[0], \
                 struct.unpack(">H", seg[3:5])[0], seg[5]
             assert prec == 8, "only 8-bit JPEG supported"
+            if nc not in (1, 3):
+                # e.g. Adobe CMYK/YCCK 4-component baseline: silently
+                # dropping the 4th plane would yield wrong colors
+                raise ValueError(
+                    f"unsupported JPEG component count {nc}; only "
+                    "grayscale (1) and YCbCr (3) are implemented")
             comps = []
             for i in range(nc):
                 cid, hv, tq = seg[6 + 3 * i], seg[7 + 3 * i], seg[8 + 3 * i]

@@ -168,6 +168,47 @@ class TestOrbaxInterop:
         ours = load_orbax(str(tmp_path / "foreign"))
         assert np.allclose(ours["a"], np.arange(6.0).reshape(2, 3))
 
+    def test_crash_window_recovery(self, tmp_path):
+        """save_orbax's two-rename swap has a window where nothing
+        exists at `path`; load_orbax must recover from the .old-orbax /
+        .tmp-orbax survivors (ADVICE r3)."""
+        import shutil
+        from paddle_tpu.utils.checkpoint import save_orbax, load_orbax
+        old_v, new_v = np.arange(3.0), np.arange(3.0) + 1
+        save_orbax(str(tmp_path / "prev"), {"v": old_v})
+        save_orbax(str(tmp_path / "next"), {"v": new_v})
+        # simulate the crash window: nothing at `path`, both survivors
+        p = str(tmp_path / "ckpt")
+        shutil.copytree(str(tmp_path / "prev"), p + ".old-orbax")
+        shutil.copytree(str(tmp_path / "next"), p + ".tmp-orbax")
+        # .tmp-orbax is the fully-written NEW checkpoint — preferred
+        assert np.allclose(load_orbax(p)["v"], new_v)
+        shutil.rmtree(p + ".tmp-orbax")
+        # only the previous live checkpoint survived
+        assert np.allclose(load_orbax(p)["v"], old_v)
+
+    def test_save_after_crash_window_keeps_a_loadable_ckpt(self,
+                                                          tmp_path,
+                                                          monkeypatch):
+        """A save issued right after a crash-window crash must promote
+        the survivor to `path` before clearing scratch names — even if
+        that save dies too, a loadable checkpoint remains."""
+        import shutil
+        import orbax.checkpoint as ocp
+        from paddle_tpu.utils.checkpoint import save_orbax, load_orbax
+        v = np.arange(4.0)
+        save_orbax(str(tmp_path / "prev"), {"v": v})
+        p = str(tmp_path / "ckpt")
+        shutil.copytree(str(tmp_path / "prev"), p + ".old-orbax")
+        # the retry save itself dies before writing anything
+        monkeypatch.setattr(
+            ocp.StandardCheckpointer, "save",
+            lambda self, *a, **k: (_ for _ in ()).throw(
+                RuntimeError("tunnel died")))
+        with pytest.raises(RuntimeError):
+            save_orbax(p, {"v": v + 1})
+        assert np.allclose(load_orbax(p)["v"], v)
+
 
 class TestQuantValues:
     def test_weight_quantize_dequantize_roundtrip(self):

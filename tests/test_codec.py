@@ -90,6 +90,25 @@ class TestJPEG:
         with pytest.raises(ValueError, match="baseline"):
             decode_jpeg_np(buf.getvalue())
 
+    def test_four_component_cmyk_raises_clearly(self):
+        """Adobe CMYK/YCCK baseline has 4 components — decoding only
+        the first three through YCbCr would yield wrong colors, so it
+        must be rejected, not silently mangled (ADVICE r3)."""
+        from paddle_tpu.vision._codec import encode_jpeg_np
+        # take a valid 3-component stream and patch the SOF0 component
+        # count to 4 (with a bogus 4th component entry)
+        data = bytearray(encode_jpeg_np(_smooth_rgb()))
+        i = data.find(b"\xff\xc0")
+        assert i >= 0
+        seg_len = int.from_bytes(data[i + 2:i + 4], "big")
+        assert data[i + 9] == 3
+        data[i + 9] = 4
+        data[i + 2:i + 4] = (seg_len + 3).to_bytes(2, "big")
+        patched = (bytes(data[:i + 4 + 6 + 9]) + b"\x04\x11\x00"
+                   + bytes(data[i + 4 + 6 + 9:]))
+        with pytest.raises(ValueError, match="component count 4"):
+            decode_jpeg_np(patched)
+
 
 class TestDataPath:
     def test_decode_jpeg_op_pure_numpy(self, monkeypatch, tmp_path):
