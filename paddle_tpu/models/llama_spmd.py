@@ -28,7 +28,8 @@ from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
 from ..ops.flashmask_attention import flashmask_attention_bhsd
 from ..parallel.pp import (pipeline_apply, pipeline_train_1f1b,
-                           group_stages)
+                           pipeline_train_interleaved, group_stages,
+                           group_virtual_stages, ungroup_virtual_stages)
 from ..parallel.ring import ring_attention_local
 from .llama import LlamaConfig
 
@@ -315,17 +316,23 @@ def adamw_update(params, grads, state, lr, step, b1=0.9, b2=0.95, eps=1e-8,
 
 def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
                     clip_norm=1.0, lr=3e-4, sp_axis=None, donate=True,
-                    schedule=None, fused_ce=None):
+                    schedule=None, fused_ce=None, vpp=2):
     """Build the jitted 4D-parallel train step.
 
     (params, opt_state, step, batch) → (params, opt_state, loss)
 
     schedule: with pp>1, "gpipe" runs the differentiable scan pipeline
-    (AD backward, O(n_micro) stashed activations) and "1f1b" runs the
+    (AD backward, O(n_micro) stashed activations), "1f1b" runs the
     hand-seeded one-forward-one-backward schedule (O(pp) stashed stage
-    inputs — reference pipeline_parallel.py:958 parity). None (default)
-    consults fleet's strategy.pipeline_configs['schedule_mode'] when
-    fleet.init ran, else "gpipe".
+    inputs — reference pipeline_parallel.py:958 parity), and
+    "interleave" runs interleaved virtual-stage 1F1B with `vpp` layer
+    chunks per stage — fill/drain bubble divided by vpp (reference
+    pipeline_parallel.py:1309). None (default) consults fleet's
+    strategy.pipeline_configs['schedule_mode'] when fleet.init ran,
+    else "gpipe". NB interleave keeps the contiguous (L, ...) param
+    layout at rest; the step regroups to the chunked layout under jit,
+    so GSPMD inserts a per-step layer-param reshuffle over the pp axis
+    — store-interleaved layouts are a future optimization.
 
     fused_ce: route every loss path through the fused linear+CE op so
     the (B, S, V) logits never materialize (reference:
@@ -352,10 +359,11 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
     bshard = jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), batch_spec,
                                     is_leaf=lambda x: isinstance(x, P))
 
-    def grads_1f1b(params, batch):
-        """Loss + grads via the 1F1B pipeline: embed lookup and its
-        scatter-grad run replicated outside the pipeline; final-norm +
-        lm_head + loss fold into head_fn on the last stage."""
+    def grads_pipelined(params, batch):
+        """Loss + grads via the hand-seeded pipeline (1F1B or
+        interleaved vpp): embed lookup and its scatter-grad run
+        replicated outside the pipeline; final-norm + lm_head + loss
+        fold into head_fn on the last stage."""
         c = config
         if len(batch) > 2:
             raise NotImplementedError(
@@ -389,25 +397,31 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             return _masked_nll(logits, tgt)
 
         n_stages = mesh.shape["pp"]
-        staged = group_stages(params["layers"], n_stages)
         head_p = {"final_norm": params["final_norm"],
                   "lm_head": params["lm_head"]}
-        loss, gstage, ghead, dh0 = pipeline_train_1f1b(
-            staged, h0, labels,
-            lambda lp, hh, extra: layer(lp, hh, extra),
-            head_fn, head_p, mesh, pp_axis="pp", n_micro=n_micro,
-            extra=(cos, sin))
+        layer_fn = lambda lp, hh, extra: layer(lp, hh, extra)
+        if schedule == "interleave":
+            staged = group_virtual_stages(params["layers"], n_stages, vpp)
+            loss, gstage, ghead, dh0 = pipeline_train_interleaved(
+                staged, h0, labels, layer_fn, head_fn, head_p, mesh,
+                pp_axis="pp", n_micro=n_micro, vpp=vpp, extra=(cos, sin))
+            g_layers = ungroup_virtual_stages(gstage, n_stages, vpp)
+        else:
+            staged = group_stages(params["layers"], n_stages)
+            loss, gstage, ghead, dh0 = pipeline_train_1f1b(
+                staged, h0, labels, layer_fn, head_fn, head_p, mesh,
+                pp_axis="pp", n_micro=n_micro, extra=(cos, sin))
+            L = c.num_hidden_layers
+            g_layers = jax.tree_util.tree_map(
+                lambda a: a.reshape(L, *a.shape[2:]), gstage)
         (g_embed,) = pull_embed(dh0.astype(h0.dtype))
-        L = c.num_hidden_layers
-        g_layers = jax.tree_util.tree_map(
-            lambda a: a.reshape(L, *a.shape[2:]), gstage)
         grads = {"embed": g_embed, "final_norm": ghead["final_norm"],
                  "lm_head": ghead["lm_head"], "layers": g_layers}
         return loss, grads
 
     def step_fn(params, opt_state, step, batch):
-        if use_pp and schedule == "1f1b":
-            loss, grads = grads_1f1b(params, batch)
+        if use_pp and schedule in ("1f1b", "interleave"):
+            loss, grads = grads_pipelined(params, batch)
         elif n_micro and n_micro > 1 and not use_pp:
             # true gradient accumulation: scan over n_micro microbatches,
             # summing fp32 grads. Peak activation memory drops ~n_micro×

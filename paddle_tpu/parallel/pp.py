@@ -37,6 +37,51 @@ def group_stages(stacked, n_stages):
     return jax.tree_util.tree_map(regroup, stacked)
 
 
+def _f32z(tree):
+    return jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+
+def _head_vjp(head_fn, head_p, y, tgt):
+    """Head vjp for the hand-seeded schedules: head_fn returns
+    (loss_sum, weight); backward is seeded with d/d(loss_sum)=1 and the
+    global 1/Σweight normalization is applied once in _epilogue."""
+    loss_m, pull, w_m = jax.vjp(
+        lambda hp, yy: head_fn(hp, yy, tgt), head_p, y, has_aux=True)
+    ghp, gy = pull(jnp.float32(1.0))
+    return (loss_m, jnp.float32(w_m),
+            jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), ghp),
+            gy.astype(y.dtype))
+
+
+def _stage_vjp(fn, params, inp, gin):
+    """Backward of one stage/chunk forward, recomputing the forward
+    from the stashed input (remat); grads cast to fp32 for
+    accumulation, activation grad kept in the ring dtype."""
+    _, pull = jax.vjp(fn, params, inp)
+    gp, gh = pull(gin)
+    return (jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), gp),
+            gh.astype(gin.dtype))
+
+
+def _epilogue(r, S, pp_axis, gparams, ghead, dx, losses, wts):
+    """Shared normalization: replicate losses/weights from the last
+    rank and dx from rank 0, then scale every gradient by the GLOBAL
+    1/Σweight (valid-token count for NLL heads)."""
+    is_last = r == S - 1
+    losses = lax.psum(jnp.where(is_last, losses, jnp.zeros_like(losses)),
+                      pp_axis)
+    wts = lax.psum(jnp.where(is_last, wts, jnp.zeros_like(wts)), pp_axis)
+    inv_w = 1.0 / jnp.maximum(jnp.sum(wts), 1e-9)
+    gparams = jax.tree_util.tree_map(
+        lambda a: (a * inv_w)[None], gparams)  # re-add the stage axis
+    ghead = jax.tree_util.tree_map(
+        lambda a: lax.psum(a, pp_axis) * inv_w, ghead)
+    dx = lax.psum(jnp.where(r == 0, dx, jnp.zeros_like(dx)),
+                  pp_axis) * inv_w
+    return gparams, ghead, dx, losses, wts
+
+
 def pipeline_apply(stage_params, x, layer_fn, mesh, pp_axis="pp", n_micro=None,
                    extra=None):
     """Differentiable GPipe forward.
@@ -176,11 +221,9 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
         s = lax.axis_index(pp_axis)
         is_last = s == S - 1
 
-        f32z = functools.partial(jax.tree_util.tree_map,
-                                 lambda a: jnp.zeros(a.shape, jnp.float32))
         stash0 = jnp.zeros((cap,) + xm.shape[1:], xm.dtype)
         act0 = jnp.zeros_like(xm[0])
-        carry0 = (stash0, act0, act0, f32z(params_local), f32z(head_p),
+        carry0 = (stash0, act0, act0, _f32z(params_local), _f32z(head_p),
                   jnp.zeros_like(xm), jnp.zeros((M,), jnp.float32),
                   jnp.zeros((M,), jnp.float32))
 
@@ -202,23 +245,12 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
                 lambda st: st, stash)
 
             # last stage: head vjp NOW — its gy seeds this tick's
-            # backward sub-tick (bwd microbatch == mf on the last stage).
-            # The backward is seeded with d/d(loss_sum) = 1; the global
-            # 1/Σweight normalization is applied once after the scan.
-            def head_grad(args):
-                y_, tgt = args
-                loss_m, pull, w_m = jax.vjp(
-                    lambda hp, yy: head_fn(hp, yy, tgt), head_p, y_,
-                    has_aux=True)
-                ghp, gy = pull(jnp.float32(1.0))
-                return (loss_m, jnp.float32(w_m),
-                        jax.tree_util.tree_map(
-                            lambda a: a.astype(jnp.float32), ghp),
-                        gy.astype(y_.dtype))
+            # backward sub-tick (bwd microbatch == mf on the last stage)
             loss_m, w_m, ghp, gy = lax.cond(
-                f_active & is_last, head_grad,
+                f_active & is_last,
+                lambda args: _head_vjp(head_fn, head_p, *args),
                 lambda args: (jnp.float32(0.0), jnp.float32(0.0),
-                              f32z(head_p), jnp.zeros_like(args[0])),
+                              _f32z(head_p), jnp.zeros_like(args[0])),
                 (y, tm[mf_c]))
             ghead = jax.tree_util.tree_map(lambda a, b: a + b, ghead, ghp)
             losses = lax.cond(
@@ -238,18 +270,13 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
                                              keepdims=False)
             gin = jnp.where(is_last, gy, bwd_buf)
 
-            def bwd(args):
-                inp_b_, gin_ = args
-                _, pull = jax.vjp(
-                    lambda p, h: stage_fn(p, h, extra_),
-                    params_local, inp_b_)
-                gp, gh = pull(gin_)
-                return (jax.tree_util.tree_map(
-                    lambda a: a.astype(jnp.float32), gp),
-                    gh.astype(gin_.dtype))
             gp, gh = lax.cond(
-                b_active, bwd,
-                lambda args: (f32z(params_local), jnp.zeros_like(args[1])),
+                b_active,
+                lambda args: _stage_vjp(
+                    lambda p, h: stage_fn(p, h, extra_), params_local,
+                    *args),
+                lambda args: (_f32z(params_local),
+                              jnp.zeros_like(args[1])),
                 (inp_b, gin))
             gparams = jax.tree_util.tree_map(lambda a, b: a + b, gparams, gp)
             dx = lax.cond(
@@ -268,23 +295,7 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
 
         (_, _, _, gparams, ghead, dx, losses, wts), _ = lax.scan(
             tick, carry0, jnp.arange(total))
-
-        # losses/wts live on the last rank, dx on rank 0 — replicate,
-        # then normalize everything by the GLOBAL weight sum (valid
-        # token count for NLL heads), so uneven ignore-label masking
-        # across microbatches matches the no-pp step exactly
-        losses = lax.psum(jnp.where(is_last, losses,
-                                    jnp.zeros_like(losses)), pp_axis)
-        wts = lax.psum(jnp.where(is_last, wts, jnp.zeros_like(wts)),
-                       pp_axis)
-        inv_w = 1.0 / jnp.maximum(jnp.sum(wts), 1e-9)
-        gparams = jax.tree_util.tree_map(
-            lambda a: (a * inv_w)[None], gparams)  # re-add stage axis
-        ghead = jax.tree_util.tree_map(
-            lambda a: lax.psum(a, pp_axis) * inv_w, ghead)
-        dx = lax.psum(jnp.where(s == 0, dx, jnp.zeros_like(dx)),
-                      pp_axis) * inv_w
-        return gparams, ghead, dx, losses, wts
+        return _epilogue(s, S, pp_axis, gparams, ghead, dx, losses, wts)
 
     mapped = jax.shard_map(
         per_rank, mesh=mesh,
@@ -299,18 +310,369 @@ def pipeline_train_1f1b(stage_params, x, targets, layer_fn, head_fn,
     return loss, gstage, ghead, dx.reshape(B, *dx.shape[2:])
 
 
-def pipeline_bubble_fraction(n_micro, n_stages, schedule="1f1b"):
-    """Idle fraction of the tick grid.
+def group_virtual_stages(stacked, n_stages, vpp):
+    """{name: (L, ...)} → {name: (n_stages, vpp, L/(S*v), ...)} laid out
+    for the interleaved schedule: virtual stage j = c*S + r (chunk c of
+    rank r) owns the j-th contiguous run of layers — rank r holds
+    chunks r, r+S, ..., r+(v-1)S of the model (Megatron vpp layout)."""
+    Sv = n_stages * vpp
+    perm = np.arange(vpp)[None, :] * n_stages + np.arange(n_stages)[:, None]
 
-    Our lockstep 1F1B burns M + 2S - 2 full fwd+bwd ticks — (S-1) extra
-    tick-pairs versus the GPipe-AD path's M + S - 1 (canonical
-    asynchronous 1F1B also needs M + S - 1) — in exchange for O(stages)
-    stashed stage inputs instead of GPipe's O(n_micro) activations.
-    Efficiency numbers printed from this function reflect that larger
-    bubble; pick 1F1B for memory, GPipe for the smaller tick grid."""
-    if schedule == "1f1b":
-        return (2 * n_stages - 2) / (n_micro + 2 * n_stages - 2)
-    return (n_stages - 1) / (n_micro + n_stages - 1)
+    def regroup(a):
+        L = a.shape[0]
+        assert L % Sv == 0, \
+            f"layers {L} not divisible by pp*vpp={n_stages}*{vpp}"
+        chunks = a.reshape(Sv, L // Sv, *a.shape[1:])
+        return chunks[perm]  # (S, v, Lc, ...)
+
+    return jax.tree_util.tree_map(regroup, stacked)
+
+
+def ungroup_virtual_stages(grouped, n_stages, vpp):
+    """Inverse of group_virtual_stages: (S, v, Lc, ...) → (L, ...)."""
+    inv = np.argsort(
+        (np.arange(vpp)[None, :] * n_stages
+         + np.arange(n_stages)[:, None]).reshape(-1))
+
+    def flatten(a):
+        Sv = n_stages * vpp
+        flat = a.reshape(Sv, *a.shape[2:])
+        return flat[inv].reshape(Sv * a.shape[2], *a.shape[3:])
+
+    return jax.tree_util.tree_map(flatten, grouped)
+
+
+def build_interleaved_schedule(n_micro, n_stages, vpp):
+    """Static lockstep slot tables for interleaved (virtual-stage) 1F1B.
+
+    Greedy list scheduling under the lockstep constraints — per tick
+    each rank runs at most one chunk-forward and one chunk-backward,
+    and activations/gradients hop exactly one rank per tick (ppermute)
+    with arrival the next tick. Forward priority is deepest-virtual-
+    stage-first (drives the first microbatches to the head ASAP);
+    backward is FIFO by microbatch. The resulting wall-clock matches
+    Megatron's interleaved 1F1B: fill/drain cost (S-1)/v stage-units
+    (reference pipeline_parallel.py:1309, :1359-1367).
+
+    Returns a dict of int32 numpy tables, each (T, S):
+      f_c/f_m:   chunk/microbatch of the forward slot (-1 = idle)
+      b_c/b_m:   same for the backward slot
+      rf_c/rf_m: chunk/mb of the activation arriving at tick start
+                 (produced by rank r-1 last tick) to stash (-1 = none)
+      rb_c/rb_m: same for the arriving gradient (from rank r+1)
+    plus scalars T, in_cap, g_cap (stash depths, collision-free mod-cap
+    indexing proven against the schedule itself).
+    """
+    M, S, v = n_micro, n_stages, vpp
+    Sv = S * v
+    INF = 1 << 30
+    avail_f = {(j, m): (0 if j == 0 else INF)
+               for j in range(Sv) for m in range(M)}
+    avail_b = {(j, m): INF for j in range(Sv) for m in range(M)}
+    done_f, done_b = set(), set()
+    slots = {r: [] for r in range(S)}
+    arrive_f = {}   # (j, m) -> tick its input lands in the stash
+    arrive_g = {}   # (j, m) -> tick its upstream grad lands
+    bwd_at = {}
+    t = 0
+    while len(done_b) < Sv * M:
+        assert t < 4 * (M + 2 * Sv), "interleave scheduler wedged"
+        produced = []
+        for r in range(S):
+            js = [c * S + r for c in range(v)]
+            cand_f = [(j, m) for j in js for m in range(M)
+                      if (j, m) not in done_f and avail_f[(j, m)] <= t]
+            f_op = min(cand_f, key=lambda jm: (-jm[0], jm[1])) \
+                if cand_f else None
+            cand_b = [(j, m) for j in js for m in range(M)
+                      if (j, m) not in done_b and avail_b[(j, m)] <= t]
+            if f_op and f_op[0] == Sv - 1:
+                cand_b.append(f_op)  # head seeds its own bwd this tick
+            b_op = min(cand_b, key=lambda jm: (jm[1], -jm[0])) \
+                if cand_b else None
+            slots[r].append((f_op, b_op))
+            produced.append((r, f_op, b_op))
+        for r, f_op, b_op in produced:
+            if f_op:
+                done_f.add(f_op)
+                j, m = f_op
+                if j + 1 < Sv:
+                    avail_f[(j + 1, m)] = t + 1
+                    arrive_f[(j + 1, m)] = t + 1
+                else:
+                    avail_b[(j, m)] = min(avail_b[(j, m)], t)
+                    arrive_g[(j, m)] = t  # head gy written same tick
+            if b_op:
+                done_b.add(b_op)
+                bwd_at[b_op] = t
+                j, m = b_op
+                if j - 1 >= 0:
+                    avail_b[(j - 1, m)] = t + 1
+                    arrive_g[(j - 1, m)] = t + 1
+        t += 1
+    T = t
+
+    tabs = {k: np.full((T, S), -1, np.int32)
+            for k in ("f_c", "f_m", "b_c", "b_m",
+                      "rf_c", "rf_m", "rb_c", "rb_m")}
+    for r in range(S):
+        for t_, (f_op, b_op) in enumerate(slots[r]):
+            if f_op:
+                tabs["f_c"][t_, r] = f_op[0] // S
+                tabs["f_m"][t_, r] = f_op[1]
+            if b_op:
+                tabs["b_c"][t_, r] = b_op[0] // S
+                tabs["b_m"][t_, r] = b_op[1]
+    # receive tables: what rank r must stash at the START of tick t is
+    # whatever its ring neighbour produced at t-1
+    for r in range(S):
+        p = (r - 1) % S
+        for t_ in range(1, T):
+            fp, _ = slots[p][t_ - 1]
+            if fp and fp[0] + 1 < Sv and (fp[0] + 1) % S == r:
+                tabs["rf_c"][t_, r] = (fp[0] + 1) // S
+                tabs["rf_m"][t_, r] = fp[1]
+        p = (r + 1) % S
+        for t_ in range(1, T):
+            _, bp = slots[p][t_ - 1]
+            if bp and bp[0] - 1 >= 0 and (bp[0] - 1) % S == r:
+                tabs["rb_c"][t_, r] = (bp[0] - 1) // S
+                tabs["rb_m"][t_, r] = bp[1]
+
+    def min_cap(arrive, release):
+        """Smallest cap with no mod-cap collision: for every pair of
+        same-chunk ops m < m', m' must not land on m's slot while m is
+        live (live = [arrive, release])."""
+        for cap in range(1, M + 1):
+            ok = True
+            for (j, m), a in arrive.items():
+                rel = release.get((j, m), a)
+                m2 = m + cap
+                while ok and (j, m2) in arrive:
+                    if arrive[(j, m2)] <= rel:
+                        ok = False
+                    m2 += cap
+                if not ok:
+                    break
+            if ok:
+                return cap
+        return M
+
+    # forward-input stash entries live from arrival until the chunk's
+    # backward consumes them for recompute; grad entries from arrival
+    # until the backward runs
+    in_cap = min_cap(arrive_f, bwd_at)
+    g_cap = min_cap(arrive_g, bwd_at)
+    return dict(tabs, T=T, in_cap=max(in_cap, 1), g_cap=max(g_cap, 1))
+
+
+def pipeline_train_interleaved(stage_params, x, targets, layer_fn, head_fn,
+                               head_params, mesh, pp_axis="pp", n_micro=None,
+                               vpp=2, extra=None):
+    """Interleaved virtual-stage 1F1B TRAIN pass (Megatron vpp parity;
+    reference python/paddle/distributed/fleet/meta_parallel/
+    pipeline_parallel.py:1309 — ours is a single lockstep lax.scan
+    driven by the static slot tables from build_interleaved_schedule).
+
+    Each physical stage owns vpp non-adjacent layer chunks (stage r
+    holds chunks r, r+S, ..., virtual stage j = c*S + r), so the
+    pipeline fill/drain costs (S-1)/vpp stage-units instead of (S-1) —
+    the standard bubble lever once 1F1B works. Backward recomputes each
+    chunk forward from its stashed input (same remat policy as
+    pipeline_train_1f1b).
+
+    Args as pipeline_train_1f1b, except stage_params leaves are
+    (n_stages, vpp, layers_per_chunk, ...) — see group_virtual_stages —
+    and head_fn keeps the (loss_sum, weight) contract.
+    Returns (loss, stage_grads, head_grads, dx) with stage_grads
+    matching stage_params' layout.
+    """
+    n_stages = mesh.shape[pp_axis]
+    B = x.shape[0]
+    if n_micro is None:
+        n_micro = n_stages * vpp
+    assert B % n_micro == 0, f"batch {B} not divisible by n_micro {n_micro}"
+    mb = B // n_micro
+    M, S, v = n_micro, n_stages, vpp
+    sched = build_interleaved_schedule(M, S, v)
+    T, in_cap, g_cap = sched["T"], sched["in_cap"], sched["g_cap"]
+    tables = jnp.stack([jnp.asarray(sched[k]) for k in
+                        ("f_c", "f_m", "b_c", "b_m",
+                         "rf_c", "rf_m", "rb_c", "rb_m")], axis=1)  # (T,8,S)
+    x_micro = x.reshape(M, mb, *x.shape[1:])
+    t_micro = targets.reshape(M, mb, *targets.shape[1:])
+
+    def chunk_fn(params_chunk, h, extra_):
+        def body(carry, layer_params):
+            return layer_fn(layer_params, carry, extra_), None
+        out, _ = lax.scan(body, h, params_chunk)
+        return out
+
+    def per_rank(params_shard, xm, tm, head_p, extra_, tabs):
+        params_local = jax.tree_util.tree_map(lambda a: a[0], params_shard)
+        r = lax.axis_index(pp_axis)
+
+        mb_shape = xm.shape[1:]
+        in_stash0 = jnp.zeros((v, in_cap) + mb_shape, xm.dtype)
+        g_stash0 = jnp.zeros((v, g_cap) + mb_shape, xm.dtype)
+        act0 = jnp.zeros_like(xm[0])
+        carry0 = (in_stash0, g_stash0, act0, act0, _f32z(params_local),
+                  _f32z(head_p), jnp.zeros_like(xm),
+                  jnp.zeros((M,), jnp.float32), jnp.zeros((M,), jnp.float32))
+
+        def pick(params, c):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+                params)
+
+        def tick(carry, row):
+            (in_stash, g_stash, fwd_in, bwd_in, gparams, ghead, dx,
+             losses, wts) = carry
+            f_c, f_m, b_c, b_m, rf_c, rf_m, rb_c, rb_m = [
+                jnp.take(row[i], r) for i in range(8)]
+
+            # ---- 0. stash what the ring delivered at end of last tick
+            in_stash = lax.cond(
+                rf_c >= 0,
+                lambda st: lax.dynamic_update_index_in_dim(
+                    st, lax.dynamic_update_index_in_dim(
+                        lax.dynamic_index_in_dim(
+                            st, jnp.clip(rf_c, 0, v - 1), 0, keepdims=False),
+                        fwd_in, jnp.clip(rf_m, 0, M - 1) % in_cap, 0),
+                    jnp.clip(rf_c, 0, v - 1), 0),
+                lambda st: st, in_stash)
+            g_stash = lax.cond(
+                rb_c >= 0,
+                lambda st: lax.dynamic_update_index_in_dim(
+                    st, lax.dynamic_update_index_in_dim(
+                        lax.dynamic_index_in_dim(
+                            st, jnp.clip(rb_c, 0, v - 1), 0, keepdims=False),
+                        bwd_in, jnp.clip(rb_m, 0, M - 1) % g_cap, 0),
+                    jnp.clip(rb_c, 0, v - 1), 0),
+                lambda st: st, g_stash)
+
+            # ---- 1. forward sub-tick
+            f_active = f_c >= 0
+            fc = jnp.clip(f_c, 0, v - 1)
+            fm = jnp.clip(f_m, 0, M - 1)
+            from_input = (r == 0) & (fc == 0)
+            stashed = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(in_stash, fc, 0, keepdims=False),
+                fm % in_cap, 0, keepdims=False)
+            inp = jnp.where(from_input, xm[fm], stashed)
+            y = lax.cond(f_active,
+                         lambda h: chunk_fn(pick(params_local, fc), h,
+                                            extra_),
+                         lambda h: h, inp)
+
+            # head: last virtual stage (chunk v-1 on rank S-1)
+            is_head = f_active & (r == S - 1) & (fc == v - 1)
+            loss_m, w_m, ghp, gy = lax.cond(
+                is_head,
+                lambda args: _head_vjp(head_fn, head_p, *args),
+                lambda args: (jnp.float32(0.0), jnp.float32(0.0),
+                              _f32z(head_p), jnp.zeros_like(args[0])),
+                (y, tm[fm]))
+            ghead = jax.tree_util.tree_map(lambda a, b: a + b, ghead, ghp)
+            losses = lax.cond(is_head, lambda ls: ls.at[fm].set(loss_m),
+                              lambda ls: ls, losses)
+            wts = lax.cond(is_head, lambda ws: ws.at[fm].set(w_m),
+                           lambda ws: ws, wts)
+            # the head's gy enters the grad stash like any arrival
+            g_stash = lax.cond(
+                is_head,
+                lambda st: lax.dynamic_update_index_in_dim(
+                    st, lax.dynamic_update_index_in_dim(
+                        lax.dynamic_index_in_dim(st, v - 1, 0,
+                                                 keepdims=False),
+                        gy, fm % g_cap, 0),
+                    v - 1, 0),
+                lambda st: st, g_stash)
+
+            # ---- 2. backward sub-tick (recomputes the chunk forward)
+            b_active = b_c >= 0
+            bc = jnp.clip(b_c, 0, v - 1)
+            bm = jnp.clip(b_m, 0, M - 1)
+            b_from_input = (r == 0) & (bc == 0)
+            inp_b = jnp.where(
+                b_from_input, xm[bm],
+                lax.dynamic_index_in_dim(
+                    lax.dynamic_index_in_dim(in_stash, bc, 0,
+                                             keepdims=False),
+                    bm % in_cap, 0, keepdims=False))
+            gin = lax.dynamic_index_in_dim(
+                lax.dynamic_index_in_dim(g_stash, bc, 0, keepdims=False),
+                bm % g_cap, 0, keepdims=False)
+
+            gp, gh = lax.cond(
+                b_active,
+                lambda args: _stage_vjp(
+                    lambda p, h: chunk_fn(p, h, extra_),
+                    pick(params_local, bc), *args),
+                lambda args: (_f32z(pick(params_local, 0)),
+                              jnp.zeros_like(args[1])),
+                (inp_b, gin))
+            # scatter-add this chunk's grads into the (v, ...) slab;
+            # inactive ticks add zeros to chunk 0 (harmless)
+            gparams = jax.tree_util.tree_map(
+                lambda G, g: G.at[bc].add(g), gparams, gp)
+            dx = lax.cond(
+                b_active & b_from_input,
+                lambda d: lax.dynamic_update_index_in_dim(
+                    d, gh.astype(d.dtype), bm, 0),
+                lambda d: d, dx)
+
+            # ---- 3. ring hops (uniform across ranks)
+            fwd_in = lax.ppermute(
+                y, pp_axis, [(i, (i + 1) % S) for i in range(S)])
+            bwd_in = lax.ppermute(
+                gh, pp_axis, [(i, (i - 1) % S) for i in range(S)])
+            return (in_stash, g_stash, fwd_in, bwd_in, gparams, ghead,
+                    dx, losses, wts), None
+
+        (_, _, _, _, gparams, ghead, dx, losses, wts), _ = lax.scan(
+            tick, carry0, tabs)
+        return _epilogue(r, S, pp_axis, gparams, ghead, dx, losses, wts)
+
+    mapped = jax.shard_map(
+        per_rank, mesh=mesh,
+        in_specs=(P(pp_axis), P(), P(), P(), P(), P()),
+        out_specs=(P(pp_axis), P(), P(), P(), P()),
+        axis_names=frozenset({pp_axis}),
+        check_vma=False)
+    gstage, ghead, dx, losses, wts = mapped(
+        stage_params, x_micro, t_micro, head_params,
+        extra if extra is not None else jnp.zeros(()), tables)
+    loss = jnp.sum(losses) / jnp.maximum(jnp.sum(wts), 1e-9)
+    return loss, gstage, ghead, dx.reshape(B, *dx.shape[2:])
+
+
+def pipeline_bubble_fraction(n_micro, n_stages, schedule="1f1b", vpp=1):
+    """Wall-clock idle fraction of the pipeline.
+
+    All our schedules run on a lockstep tick grid (longer than the
+    canonical asynchronous schedules' slot count), but inactive
+    sub-ticks are lax.cond passthroughs costing ~nothing, so the
+    wall-clock bubble matches the canonical formulas (verified by
+    per-tick cost simulation, tests/test_interleave_pp.py):
+
+      gpipe / 1f1b:  (S-1) / (M + S-1)       — same wall clock; 1F1B's
+                     win is O(stages) stashed inputs vs O(n_micro)
+                     activations, paid for with fwd recompute in bwd.
+      interleave:    ((S-1)/v) / (M + (S-1)/v) — v virtual chunks per
+                     stage divide the fill/drain cost by v (Megatron
+                     interleaved 1F1B parity, reference
+                     pipeline_parallel.py:1309).
+    """
+    M, S = n_micro, n_stages
+    if schedule == "interleave":
+        assert vpp > 1, ("interleave bubble needs the vpp actually used "
+                         "(vpp=1 would silently report the plain 1F1B "
+                         "bubble)")
+        fill = (S - 1) / vpp
+    else:
+        fill = S - 1
+    return fill / (M + fill)
 
 
 class LayerDesc:

@@ -288,10 +288,14 @@ class Test1F1B:
         assert np.allclose(np.asarray(dx), np.asarray(g_ref[2]), atol=1e-4)
 
     def test_bubble_fraction(self):
+        # wall-clock model with cond-skipped idle sub-ticks: gpipe and
+        # 1f1b share (S-1)/(M+S-1); interleave divides the fill by vpp
         from paddle_tpu.parallel.pp import pipeline_bubble_fraction
         assert pipeline_bubble_fraction(4, 1) == 0.0
-        assert pipeline_bubble_fraction(4, 2) == pytest.approx(2 / 6)
+        assert pipeline_bubble_fraction(4, 2) == pytest.approx(1 / 5)
         assert pipeline_bubble_fraction(4, 2, "gpipe") == pytest.approx(1 / 5)
+        assert pipeline_bubble_fraction(4, 2, "interleave", vpp=2) == \
+            pytest.approx(0.5 / 4.5)
 
 
 class TestPipelineLayer:

@@ -1,0 +1,207 @@
+"""Interleaved virtual-stage 1F1B (VERDICT r4 item 3).
+
+Parity: Megatron-style vpp in the reference
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:1309, :1359-1367). Ours is a lockstep lax.scan
+driven by static slot tables (build_interleaved_schedule); these tests
+pin down (a) schedule validity — every chunk op exactly once, data
+deps respected with the one-hop-per-tick ring, (b) the Megatron bubble
+formula on the tick-cost model, (c) gradient equivalence vs plain
+autodiff, and (d) train-step equivalence vs the sequential model.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.parallel import create_mesh
+from paddle_tpu.parallel.pp import (build_interleaved_schedule,
+                                    group_virtual_stages,
+                                    ungroup_virtual_stages,
+                                    pipeline_train_interleaved,
+                                    pipeline_bubble_fraction)
+
+
+class TestScheduleBuilder:
+    @pytest.mark.parametrize("M,S,v", [(4, 2, 2), (8, 4, 2), (6, 4, 2),
+                                       (8, 4, 4), (3, 4, 2)])
+    def test_schedule_is_valid(self, M, S, v):
+        s = build_interleaved_schedule(M, S, v)
+        Sv = S * v
+        fwd_t, bwd_t = {}, {}
+        for t in range(s["T"]):
+            for r in range(S):
+                if s["f_c"][t, r] >= 0:
+                    j = s["f_c"][t, r] * S + r
+                    key = (j, s["f_m"][t, r])
+                    assert key not in fwd_t, f"fwd {key} scheduled twice"
+                    fwd_t[key] = t
+                if s["b_c"][t, r] >= 0:
+                    j = s["b_c"][t, r] * S + r
+                    key = (j, s["b_m"][t, r])
+                    assert key not in bwd_t, f"bwd {key} scheduled twice"
+                    bwd_t[key] = t
+        assert len(fwd_t) == Sv * M and len(bwd_t) == Sv * M
+        for (j, m), t in fwd_t.items():
+            if j > 0:  # producer ran >= 2 ticks earlier? No: 1-hop ring
+                assert fwd_t[(j - 1, m)] < t, (j, m)
+            # backward needs the fwd done and (for j < Sv-1) the
+            # downstream grad produced strictly earlier
+            assert bwd_t[(j, m)] >= t
+            if j < Sv - 1:
+                assert bwd_t[(j + 1, m)] < bwd_t[(j, m)], (j, m)
+
+    @pytest.mark.parametrize("M,S,v", [(8, 4, 2), (8, 4, 4), (4, 2, 2),
+                                       (16, 8, 2)])
+    def test_wall_cost_matches_megatron_formula(self, M, S, v):
+        """Tick-cost model: each tick costs the busiest rank's active
+        chunk ops (lax.cond skips inactive sub-ticks). The interleaved
+        schedule must hit Megatron's 2*(M + (S-1)/v) stage-units."""
+        s = build_interleaved_schedule(M, S, v)
+        cost = 0.0
+        for t in range(s["T"]):
+            mx = 0
+            for r in range(S):
+                mx = max(mx, int(s["f_c"][t, r] >= 0)
+                         + int(s["b_c"][t, r] >= 0))
+            cost += mx / v
+        expect = 2 * (M + (S - 1) / v)
+        assert abs(cost - expect) < 1e-9, (cost, expect)
+        # and the public bubble formula agrees
+        bub = pipeline_bubble_fraction(M, S, "interleave", vpp=v)
+        assert abs((1 - bub) - 2 * M / cost) < 1e-9
+
+    def test_interleave_beats_1f1b_bubble(self):
+        for M, S in [(4, 2), (8, 4), (16, 8)]:
+            b1 = pipeline_bubble_fraction(M, S, "1f1b")
+            bi = pipeline_bubble_fraction(M, S, "interleave", vpp=2)
+            assert bi < b1, (M, S, b1, bi)
+
+    def test_receive_tables_consistent(self):
+        """What rank r stashes at tick t must be exactly what its ring
+        neighbour produced at t-1, mapped to the next virtual stage."""
+        M, S, v = 6, 4, 2
+        s = build_interleaved_schedule(M, S, v)
+        for t in range(1, s["T"]):
+            for r in range(S):
+                p = (r - 1) % S
+                fc, fm = s["f_c"][t - 1, p], s["f_m"][t - 1, p]
+                j = fc * S + p if fc >= 0 else -1
+                if j >= 0 and j + 1 < S * v and (j + 1) % S == r:
+                    assert s["rf_c"][t, r] == (j + 1) // S
+                    assert s["rf_m"][t, r] == fm
+                else:
+                    assert s["rf_c"][t, r] == -1
+
+
+class TestInterleavedGrads:
+    def test_grads_match_autodiff(self):
+        """pipeline_train_interleaved == jax.grad of the dense program,
+        including head grads and dx, at pp=4 vpp=2."""
+        mesh = create_mesh({"pp": 4, "dp": 2})
+        rng = np.random.RandomState(0)
+        Lp, H, v = 8, 16, 2
+        W = jnp.asarray(rng.randn(Lp, H, H) * 0.1, jnp.float32)
+        head_w = jnp.asarray(rng.randn(H, 7) * 0.1, jnp.float32)
+        x = jnp.asarray(rng.randn(6, 5, H), jnp.float32)
+        tgt = jnp.asarray(rng.randint(0, 7, (6, 5)))
+
+        def layer_fn(lw, h, extra):
+            return jnp.tanh(h @ lw["w"])
+
+        def head_fn(hp, h, t):
+            logp = jax.nn.log_softmax(h @ hp["w"], axis=-1)
+            picked = jnp.take_along_axis(logp, t[..., None], axis=-1)
+            return -jnp.sum(picked), jnp.float32(picked.size)
+
+        def dense_loss(W_, hw, x_):
+            h = x_
+            for i in range(Lp):
+                h = jnp.tanh(h @ W_[i])
+            s, n = head_fn({"w": hw}, h, tgt)
+            return s / n
+
+        loss_ref, g_ref = jax.value_and_grad(dense_loss, (0, 1, 2))(
+            W, head_w, x)
+        staged = group_virtual_stages({"w": W}, 4, v)
+        loss, gstage, ghead, dx = jax.jit(
+            lambda st, xx, tt, hp: pipeline_train_interleaved(
+                st, xx, tt, layer_fn, head_fn, hp, mesh,
+                n_micro=3, vpp=v))(staged, x, tgt, {"w": head_w})
+        assert abs(float(loss) - float(loss_ref)) < 1e-5
+        gW = np.asarray(ungroup_virtual_stages(gstage, 4, v)["w"])
+        assert np.allclose(gW, np.asarray(g_ref[0]), atol=1e-4)
+        assert np.allclose(np.asarray(ghead["w"]), np.asarray(g_ref[1]),
+                           atol=1e-4)
+        assert np.allclose(np.asarray(dx), np.asarray(g_ref[2]), atol=1e-4)
+
+    def test_group_ungroup_roundtrip(self):
+        W = jnp.arange(8 * 3 * 2, dtype=jnp.float32).reshape(8, 3, 2)
+        g = group_virtual_stages({"w": W}, 2, 2)
+        assert g["w"].shape == (2, 2, 2, 3, 2)
+        # rank 0 chunk 1 = virtual stage 2 = layers 4,5
+        assert np.allclose(np.asarray(g["w"][0, 1]), np.asarray(W[4:6]))
+        back = ungroup_virtual_stages(g, 2, 2)
+        assert np.allclose(np.asarray(back["w"]), np.asarray(W))
+
+
+class TestInterleavedTrainStep:
+    def test_matches_sequential_with_uneven_masking(self):
+        from paddle_tpu.models import llama_spmd as M
+        from paddle_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=8, heads=4,
+                               kv_heads=4, ffn=64)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randint(0, 64, (4, 16)))
+        y = rng.randint(0, 64, (4, 16))
+        y[0, :12] = -1  # uneven ignore-labels across microbatches
+        y = jnp.asarray(y)
+
+        outs = {}
+        for name, axes, kw in (
+                ("seq", {"dp": 2, "tp": 4}, {}),
+                ("vpp", {"pp": 4, "dp": 2},
+                 {"schedule": "interleave", "n_micro": 2, "vpp": 2})):
+            mesh = create_mesh(axes)
+            params = M.init_params(cfg, seed=3)
+            if "pp" in axes:
+                params = M.place_params(params, cfg, mesh)
+            opt = M.init_opt_state(params)
+            step = M.make_train_step(cfg, mesh, remat=False, donate=False,
+                                     **kw)
+            losses = []
+            for i in range(2):
+                params, opt, loss = step(params, opt, jnp.asarray(i),
+                                         (x, y))
+                losses.append(float(loss))
+            outs[name] = (losses, jax.device_get(params))
+
+        assert np.allclose(outs["seq"][0], outs["vpp"][0], atol=1e-4), \
+            (outs["seq"][0], outs["vpp"][0])
+        for key in ("wq", "w_down", "ln1"):
+            a = np.asarray(outs["seq"][1]["layers"][key], np.float32)
+            b = np.asarray(outs["vpp"][1]["layers"][key], np.float32)
+            assert np.allclose(a, b, atol=3e-4), key
+        a = np.asarray(outs["seq"][1]["embed"], np.float32)
+        b = np.asarray(outs["vpp"][1]["embed"], np.float32)
+        assert np.allclose(a, b, atol=3e-4)
+
+    def test_fused_ce_under_interleave(self):
+        from paddle_tpu.models import llama_spmd as M
+        from paddle_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=8, heads=4,
+                               kv_heads=4, ffn=64)
+        mesh = create_mesh({"pp": 4, "dp": 2})
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randint(0, 64, (4, 16)))
+        y = jnp.asarray(rng.randint(0, 64, (4, 16)))
+        losses = {}
+        for fce in (False, True):
+            params = M.place_params(M.init_params(cfg, seed=4), cfg, mesh)
+            opt = M.init_opt_state(params)
+            step = M.make_train_step(cfg, mesh, remat=False, donate=False,
+                                     schedule="interleave", n_micro=2,
+                                     vpp=2, fused_ce=fce)
+            _, _, loss = step(params, opt, jnp.asarray(0), (x, y))
+            losses[fce] = float(loss)
+        assert np.isclose(losses[False], losses[True], rtol=1e-5), losses
