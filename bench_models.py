@@ -169,8 +169,17 @@ def bench_serving(on_tpu):
         max_seqs, new_tok, nreq, dtype = 2, 8, 3, jnp.float32
         max_seq_len, page = 64, 8
     params = M.init_params(cfg, seed=0, dtype=dtype)
+    # PT_SERVE_CACHE=int8: quantized KV pool (halves HBM per token;
+    # autotune/capture sweep both on chip). Fail fast on anything else
+    # — a typo must not burn a capture window deep in engine init.
+    cache_dtype = os.environ.get("PT_SERVE_CACHE") or None
+    if cache_dtype not in (None, "int8"):
+        raise SystemExit(
+            f"PT_SERVE_CACHE={cache_dtype!r} unsupported; use 'int8' or "
+            "unset (pool stores the model dtype)")
     eng = ServingEngine(params, cfg, max_seqs=max_seqs,
-                        max_seq_len=max_seq_len, page_size=page, dtype=dtype)
+                        max_seq_len=max_seq_len, page_size=page, dtype=dtype,
+                        cache_dtype=cache_dtype)
     rng = np.random.RandomState(0)
     for i in range(nreq):
         plen = int(rng.randint(8, 64)) if on_tpu else 3
@@ -183,6 +192,7 @@ def bench_serving(on_tpu):
     total_new = sum(len(r.output) for r in done)
     return {"decode_tokens_per_sec": round(total_new / dt, 1),
             "requests": nreq, "new_tokens": total_new, "batch": max_seqs,
+            "cache_dtype": cache_dtype or str(jnp.dtype(dtype).name),
             "step_time_s": round(dt / max(total_new, 1), 5),
             "loss": 0.0}
 

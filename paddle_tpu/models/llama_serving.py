@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
-from ..ops.paged_attention import paged_attention
+from ..ops.paged_attention import paged_attention, quantize_kv
 from ..ops.varlen_attention import (flash_attention_varlen,
                                     seg_ids_from_cu_seqlens)
 from .llama import LlamaConfig
@@ -130,18 +130,22 @@ def prefill_varlen(params, input_ids, cu_seqlens, config: LlamaConfig,
                                     "interpret"))
 def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
                 active, config: LlamaConfig, page_size, use_pallas=False,
-                interpret=False):
+                interpret=False, k_scale=None, v_scale=None):
     """One token for every slot.
 
     k_pool/v_pool: (L, KVH, P, page, D); tokens: (B,) current input token;
     lengths: (B,) length INCLUDING the current token; active: (B,) bool.
-    Returns (k_pool, v_pool, logits (B, V)).
+    With an int8 cache, k_scale/v_scale (L, KVH, P, page, 1) fp32 ride
+    along: the new token's K/V is quantized in-graph and the attention
+    kernel dequantizes on read.
+    Returns (k_pool, v_pool, k_scale, v_scale, logits (B, V)).
     """
     c = config
     nh, nkv = c.num_attention_heads, c.num_key_value_heads
     hd = c.hidden_size // nh
     B = tokens.shape[0]
     P = k_pool.shape[2]
+    quant = k_scale is not None
 
     pos = jnp.maximum(lengths - 1, 0)                       # (B,)
     cos, sin = rope_cos_sin(None, hd, base=c.rope_theta,
@@ -153,7 +157,7 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
     off = pos % page_size
 
     def layer(carry, xs):
-        h, kp, vp = carry
+        h, kp, vp, ksp, vsp = carry
         lp, li = xs
         x = _rms(h, lp["ln1"], c.rms_norm_eps)
         q = (x @ lp["wq"]).reshape(B, 1, nh, hd).swapaxes(1, 2)
@@ -163,26 +167,37 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
         # write this token's K/V: (B, KVH, D) → pool[li][:, page_ids, off]
         kl = jax.lax.dynamic_index_in_dim(kp, li, 0, keepdims=False)
         vl = jax.lax.dynamic_index_in_dim(vp, li, 0, keepdims=False)
-        kt = k[:, :, 0].swapaxes(0, 1).astype(kp.dtype)     # (KVH, B, D)
-        vt = v[:, :, 0].swapaxes(0, 1).astype(vp.dtype)
-        kl = kl.at[:, page_ids, off].set(kt)
-        vl = vl.at[:, page_ids, off].set(vt)
+        kt = k[:, :, 0].swapaxes(0, 1)                      # (KVH, B, D)
+        vt = v[:, :, 0].swapaxes(0, 1)
+        ksl = vsl = None
+        if quant:
+            kt, kts = quantize_kv(kt)
+            vt, vts = quantize_kv(vt)
+            ksl = jax.lax.dynamic_index_in_dim(ksp, li, 0, keepdims=False)
+            vsl = jax.lax.dynamic_index_in_dim(vsp, li, 0, keepdims=False)
+            ksl = ksl.at[:, page_ids, off].set(kts)
+            vsl = vsl.at[:, page_ids, off].set(vts)
+            ksp = jax.lax.dynamic_update_index_in_dim(ksp, ksl, li, 0)
+            vsp = jax.lax.dynamic_update_index_in_dim(vsp, vsl, li, 0)
+        kl = kl.at[:, page_ids, off].set(kt.astype(kl.dtype))
+        vl = vl.at[:, page_ids, off].set(vt.astype(vl.dtype))
         kp = jax.lax.dynamic_update_index_in_dim(kp, kl, li, 0)
         vp = jax.lax.dynamic_update_index_in_dim(vp, vl, li, 0)
         o = paged_attention(q[:, :, 0], kl, vl, page_table, lengths,
-                            use_pallas=use_pallas,
-                            interpret=interpret)            # (B, QH, D)
-        h = h + o.reshape(B, 1, -1) @ lp["wo"]
+                            use_pallas=use_pallas, interpret=interpret,
+                            k_scale=ksl, v_scale=vsl)       # (B, QH, D)
+        h = h + o.reshape(B, 1, -1).astype(h.dtype) @ lp["wo"]
         x = _rms(h, lp["ln2"], c.rms_norm_eps)
         mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-        return (h + mlp, kp, vp), None
+        return (h + mlp, kp, vp, ksp, vsp), None
 
     L = k_pool.shape[0]
-    (h, k_pool, v_pool), _ = jax.lax.scan(
-        layer, (h, k_pool, v_pool), (params["layers"], jnp.arange(L)))
+    (h, k_pool, v_pool, k_scale, v_scale), _ = jax.lax.scan(
+        layer, (h, k_pool, v_pool, k_scale, v_scale),
+        (params["layers"], jnp.arange(L)))
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
     logits = h[:, 0] @ params["lm_head"]
-    return k_pool, v_pool, logits
+    return k_pool, v_pool, k_scale, v_scale, logits
 
 
 # ---------------------------------------------------------------------------
@@ -237,7 +252,8 @@ class ServingEngine:
 
     def __init__(self, params, config: LlamaConfig, max_seqs=4,
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
-                 use_pallas=None, interpret=False, num_pages=None):
+                 use_pallas=None, interpret=False, num_pages=None,
+                 cache_dtype=None):
         c = config
         self.params = params
         self.config = c
@@ -260,8 +276,21 @@ class ServingEngine:
         kvh = c.num_key_value_heads
         hd = c.hidden_size // c.num_attention_heads
         L = c.num_hidden_layers
-        self.k_pool = jnp.zeros((L, kvh, num_pages, page_size, hd), dtype)
-        self.v_pool = jnp.zeros((L, kvh, num_pages, page_size, hd), dtype)
+        # cache_dtype="int8": quantized KV pool with per-token fp32
+        # scales (reference parity: cachekv-quant decode in
+        # phi/kernels/fusion/gpu/block_attn.h) — 2x (bf16) / ~3.5x
+        # (fp32, net of scales) the servable tokens per pool byte
+        self.cache_quant = cache_dtype in ("int8", jnp.int8)
+        pool_dtype = jnp.int8 if self.cache_quant else \
+            (cache_dtype or dtype)
+        pshape = (L, kvh, num_pages, page_size, hd)
+        self.k_pool = jnp.zeros(pshape, pool_dtype)
+        self.v_pool = jnp.zeros(pshape, pool_dtype)
+        if self.cache_quant:
+            self.k_scale = jnp.zeros(pshape[:-1] + (1,), jnp.float32)
+            self.v_scale = jnp.zeros(pshape[:-1] + (1,), jnp.float32)
+        else:
+            self.k_scale = self.v_scale = None
         self.page_table = jnp.zeros((max_seqs, self.pages_per_seq), jnp.int32)
         self.lengths = jnp.zeros((max_seqs,), jnp.int32)
         # trash page (last) never enters the free list
@@ -383,6 +412,11 @@ class ServingEngine:
         pos = np.arange(S)
         pg = np.asarray(pages)[pos // self.page_size]
         off = pos % self.page_size
+        if self.cache_quant:
+            kq, ks = quantize_kv(kq)
+            vq, vs = quantize_kv(vq)
+            self.k_scale = self.k_scale.at[:, :, pg, off].set(ks)
+            self.v_scale = self.v_scale.at[:, :, pg, off].set(vs)
         self.k_pool = self.k_pool.at[:, :, pg, off].set(
             kq.astype(self.k_pool.dtype))
         self.v_pool = self.v_pool.at[:, :, pg, off].set(
@@ -480,11 +514,13 @@ class ServingEngine:
         active[active_slots] = True
         self.lengths = jnp.where(jnp.asarray(active), self.lengths + 1,
                                  self.lengths)
-        self.k_pool, self.v_pool, logits = decode_step(
+        (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+         logits) = decode_step(
             self.params, self.k_pool, self.v_pool, self.page_table,
             self.lengths, jnp.asarray(tokens), jnp.asarray(active),
             self.config, self.page_size, use_pallas=self._use_pallas,
-            interpret=self._interpret)
+            interpret=self._interpret, k_scale=self.k_scale,
+            v_scale=self.v_scale)
         # all-greedy fast path: argmax on device, transfer max_seqs ints;
         # only sampling requests pull their [vocab] logits row to host
         sampled = [s for s in active_slots

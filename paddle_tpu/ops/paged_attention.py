@@ -44,12 +44,35 @@ def _on_tpu():
 
 
 # ---------------------------------------------------------------------------
+# int8 cache quantization (reference parity: the cachekv-quant decode in
+# paddle/phi/kernels/fusion/gpu/block_attn.h — int8 KV pages with scales,
+# dequantized inside the attention kernel). Per-token-per-head absmax:
+# one fp32 scale per stored (head, token) vector.
+# ---------------------------------------------------------------------------
+def quantize_kv(x, axis=-1):
+    """x: (..., D) → (int8 values, fp32 scale with D→1 kept).
+
+    scale = absmax/127 (floored to avoid div-by-zero on all-zero
+    vectors, e.g. untouched pool pages)."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=axis, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+# ---------------------------------------------------------------------------
 # Reference (XLA) implementation
 # ---------------------------------------------------------------------------
 def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
-                              sm_scale=None):
+                              sm_scale=None, k_scale=None, v_scale=None):
     """q: (B, QH, D); pages: (KVH, P, page, D); page_table: (B, pages_per_seq);
-    lengths: (B,). Returns (B, QH, D)."""
+    lengths: (B,). k_scale/v_scale: (KVH, P, page, 1) fp32 when the
+    pages are int8-quantized. Returns (B, QH, D)."""
     b, qh, d = q.shape
     kvh, _, page, _ = k_pages.shape
     group = qh // kvh
@@ -57,6 +80,11 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
     # gather this batch's pages: (B, KVH, pages_per_seq*page, D)
     k = jnp.swapaxes(k_pages[:, page_table], 0, 1).reshape(b, kvh, -1, d)
     v = jnp.swapaxes(v_pages[:, page_table], 0, 1).reshape(b, kvh, -1, d)
+    if k_scale is not None:  # dequantize the gathered slices only
+        ks = jnp.swapaxes(k_scale[:, page_table], 0, 1).reshape(b, kvh, -1, 1)
+        vs = jnp.swapaxes(v_scale[:, page_table], 0, 1).reshape(b, kvh, -1, 1)
+        k = dequantize_kv(k, ks)
+        v = dequantize_kv(v, vs)
     qg = q.reshape(b, kvh, group, d).astype(jnp.float32)
     s = jnp.einsum("bhgd,bhkd->bhgk", qg, k.astype(jnp.float32)) * scale
     mask = jnp.arange(s.shape[-1])[None, None, None] < lengths[:, None, None,
@@ -71,7 +99,11 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
 # Pallas kernel
 # ---------------------------------------------------------------------------
 def _decode_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, scale, page_size, n_pages):
+                   acc_ref, m_ref, l_ref, *, scale, page_size, n_pages,
+                   ks_ref=None, vs_ref=None):
+    """ks_ref/vs_ref: per-token fp32 scale blocks (1, 1, page, 1) when
+    the K/V pages are int8 — dequantized HERE, so the int8 pool is what
+    rides HBM→VMEM (the whole point of cache quantization)."""
     del ptab_ref  # consumed by the index maps
     bi = pl.program_id(0)
     pi = pl.program_id(2)
@@ -89,6 +121,9 @@ def _decode_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0, 0].astype(jnp.float32)   # (group, d)
         k = k_ref[0, 0].astype(jnp.float32)   # (page, d)
         v = v_ref[0, 0].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0, 0]              # (page, 1) broadcast over d
+            v = v * vs_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         cols = pi * page_size + jax.lax.broadcasted_iota(
@@ -113,26 +148,43 @@ def _decode_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                        _fit_lanes(l_safe, o_ref.shape[-1])).astype(o_ref.dtype)
 
 
+def _quant_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                  o_ref, acc_ref, m_ref, l_ref, **kw):
+    """Positional adapter: pallas passes the two scale inputs between
+    v and the output ref."""
+    _decode_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, ks_ref=ks_ref, vs_ref=vs_ref,
+                   **kw)
+
+
 def _decode_pallas(q4, k_pages, v_pages, page_table, lengths, scale,
-                   interpret):
+                   interpret, k_scale=None, v_scale=None):
     b, kvh, group, d = q4.shape
     _, _, page_size, _ = k_pages.shape
     n_pages = page_table.shape[1]
+    quant = k_scale is not None
 
+    # index maps receive grid indices first, then scalar-prefetch refs
+    page_spec = pl.BlockSpec((1, 1, page_size, d),
+                             lambda bi, hi, pi, ptab, lens:
+                             (hi, ptab[bi, pi], Z, Z))
+    in_specs = [
+        pl.BlockSpec((1, 1, group, d),
+                     lambda bi, hi, pi, ptab, lens: (bi, hi, Z, Z)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [page_table, lengths, q4, k_pages, v_pages]
+    if quant:
+        scale_spec = pl.BlockSpec((1, 1, page_size, 1),
+                                  lambda bi, hi, pi, ptab, lens:
+                                  (hi, ptab[bi, pi], Z, Z))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, kvh, n_pages),
-        in_specs=[
-            # index maps receive grid indices first, then scalar-prefetch refs
-            pl.BlockSpec((1, 1, group, d),
-                         lambda bi, hi, pi, ptab, lens: (bi, hi, Z, Z)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda bi, hi, pi, ptab, lens:
-                         (hi, ptab[bi, pi], Z, Z)),
-            pl.BlockSpec((1, 1, page_size, d),
-                         lambda bi, hi, pi, ptab, lens:
-                         (hi, ptab[bi, pi], Z, Z)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group, d),
                                lambda bi, hi, pi, ptab, lens: (bi, hi, Z, Z)),
         scratch_shapes=[
@@ -141,34 +193,44 @@ def _decode_pallas(q4, k_pages, v_pages, page_table, lengths, scale,
             pltpu.VMEM((group, LANES), jnp.float32),
         ],
     )
-    kernel = functools.partial(_decode_kernel, scale=np.float32(scale),
+    kernel = functools.partial(_quant_kernel if quant else _decode_kernel,
+                               scale=np.float32(scale),
                                page_size=page_size, n_pages=n_pages)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kvh, group, d), q4.dtype),
         interpret=interpret,
-    )(page_table, lengths, q4, k_pages, v_pages)
+    )(*operands)
 
 
 def paged_attention(q, k_pages, v_pages, page_table, lengths, sm_scale=None,
-                    use_pallas=None, interpret=None):
+                    use_pallas=None, interpret=None, k_scale=None,
+                    v_scale=None):
     """Single-token decode attention over a paged KV cache.
 
     q: (B, QH, D); k_pages/v_pages: (KVH, num_pages, page_size, D);
     page_table: (B, pages_per_seq) int32; lengths: (B,) int32.
+
+    int8 cache: pass int8 pages plus k_scale/v_scale fp32 per-token
+    scales (KVH, num_pages, page_size, 1) — see quantize_kv. The pages
+    are dequantized inside the kernel (reference parity: cachekv-quant
+    in phi/kernels/fusion/gpu/block_attn.h), halving/quartering the
+    HBM traffic and pool footprint vs bf16/fp32.
     """
     b, qh, d = q.shape
     kvh = k_pages.shape[0]
     group = qh // kvh
     scale = sm_scale if sm_scale is not None else d ** -0.5
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     if use_pallas is None:
         use_pallas = _on_tpu()
     if interpret is None:
         interpret = False
     if not use_pallas and not interpret:
         return paged_attention_reference(q, k_pages, v_pages, page_table,
-                                         lengths, scale)
+                                         lengths, scale, k_scale, v_scale)
     q4 = q.reshape(b, kvh, group, d)
     # q-rows block dim must be a multiple of the sublane tile (8)
     pad = (-group) % MIN_GROUP
@@ -176,7 +238,8 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, sm_scale=None,
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, pad), (0, 0)))
     o = _decode_pallas(q4, k_pages, v_pages,
                        page_table.astype(jnp.int32),
-                       lengths.astype(jnp.int32), scale, interpret)
+                       lengths.astype(jnp.int32), scale, interpret,
+                       k_scale=k_scale, v_scale=v_scale)
     if pad:
         o = o[:, :, :group]
     return o.reshape(b, qh, d)
@@ -197,8 +260,19 @@ class PagedKVCache:
     def __init__(self, num_layers, kv_heads, head_dim, num_pages, page_size,
                  max_seqs, pages_per_seq, dtype=jnp.bfloat16):
         shape = (num_layers, kv_heads, num_pages, page_size, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        # dtype "int8": quantized pool + per-token fp32 scales — 2x
+        # (vs bf16) / 4x (vs fp32) the servable tokens per pool byte
+        self.quantized = dtype in ("int8", jnp.int8)
+        if self.quantized:
+            self.k = jnp.zeros(shape, jnp.int8)
+            self.v = jnp.zeros(shape, jnp.int8)
+            sshape = shape[:-1] + (1,)
+            self.k_scale = jnp.zeros(sshape, jnp.float32)
+            self.v_scale = jnp.zeros(sshape, jnp.float32)
+        else:
+            self.k = jnp.zeros(shape, dtype)
+            self.v = jnp.zeros(shape, dtype)
+            self.k_scale = self.v_scale = None
         self.page_size = page_size
         self.page_table = jnp.zeros((max_seqs, pages_per_seq), jnp.int32)
         self.lengths = jnp.zeros((max_seqs,), jnp.int32)
@@ -237,5 +311,13 @@ class PagedKVCache:
         pos = int(self.lengths[slot]) - 1
         pg = self._seq_pages[slot][pos // self.page_size]
         off = pos % self.page_size
+        if self.quantized:
+            kq, ks = quantize_kv(k_tok)
+            vq, vs = quantize_kv(v_tok)
+            self.k = self.k.at[layer, :, pg, off].set(kq)
+            self.v = self.v.at[layer, :, pg, off].set(vq)
+            self.k_scale = self.k_scale.at[layer, :, pg, off].set(ks)
+            self.v_scale = self.v_scale.at[layer, :, pg, off].set(vs)
+            return
         self.k = self.k.at[layer, :, pg, off].set(k_tok.astype(self.k.dtype))
         self.v = self.v.at[layer, :, pg, off].set(v_tok.astype(self.v.dtype))

@@ -264,3 +264,64 @@ class TestServingSampling:
                            top_k=10 ** 6, seed=0))
         done = eng.run()
         assert len(done[0].output) == 4
+
+
+class TestInt8CacheServing:
+    """cache_dtype='int8' (VERDICT r4 item 4): quantized KV pool with
+    per-token scales, dequant-in-kernel on read. Reference parity:
+    cachekv-quant in phi/kernels/fusion/gpu/block_attn.h."""
+
+    def test_int8_engine_matches_fp_engine_greedy(self, params):
+        prompts = [[1, 5, 9, 3, 7], [9, 8, 7, 6, 5, 4]]
+        outs = {}
+        for tag, kw in (("fp", {}), ("int8", {"cache_dtype": "int8"})):
+            eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                                page_size=8, use_pallas=False, **kw)
+            for i, p in enumerate(prompts):
+                eng.submit(Request(f"r{i}", p, max_new_tokens=8))
+            done = eng.run()
+            outs[tag] = {r.rid: r.output for r in done}
+        # absmax-per-token int8 KV keeps greedy decode on-trajectory
+        # at this scale — token-exact against the fp cache engine
+        assert outs["int8"] == outs["fp"]
+
+    def test_int8_pool_bytes_halved(self, params):
+        fp = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                           page_size=8, dtype=jnp.bfloat16)
+        q = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                          page_size=8, cache_dtype="int8")
+        fp_bytes = fp.k_pool.nbytes + fp.v_pool.nbytes
+        q_bytes = (q.k_pool.nbytes + q.v_pool.nbytes
+                   + q.k_scale.nbytes + q.v_scale.nbytes)
+        # head_dim 8 at this tiny config → scales cost 4/8 of the pool;
+        # real head dims (64-128) approach 2x. Check the dtype plumbing
+        # and that we beat bf16 even in the worst tiny case.
+        assert q.k_pool.dtype == jnp.int8
+        assert q_bytes < fp_bytes, (q_bytes, fp_bytes)
+
+    def test_int8_with_interpret_kernel(self, params):
+        """int8 decode through the pallas kernel (interpret) — the
+        in-kernel dequant path an on-chip run would take."""
+        prompt = [1, 5, 9, 3, 7]
+        ref = greedy_reference(params, prompt, 6)
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=True, interpret=True,
+                            cache_dtype="int8")
+        eng.submit(Request("a", prompt, max_new_tokens=6))
+        done = eng.run()
+        assert done[0].output == ref
+
+    def test_int8_survives_preemption(self, params):
+        """Oversubscribed pool + int8 cache: eviction and re-prefill
+        must re-quantize cleanly."""
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                            page_size=8, use_pallas=False,
+                            num_pages=6, cache_dtype="int8")
+        refs = {}
+        for i, p in enumerate([[1, 2, 3], [7, 6, 5]]):
+            refs[f"r{i}"] = greedy_reference(params, p, 10)
+            eng.submit(Request(f"r{i}", p, max_new_tokens=10))
+        done = eng.run()
+        assert len(done) == 2
+        for r in done:
+            assert r.output == refs[r.rid]

@@ -24,6 +24,7 @@ PT_BENCH_SKIP_VALIDATE=1 timeout 1800 python bench.py 2>&1 | tail -1
 # should convert the blocked cross-doc attention into real tok/s
 PT_BENCH_SKIP_VALIDATE=1 PT_BENCH_DOCS=4 timeout 1200 python bench.py 2>&1 | tail -1
 
-# serving throughput on-chip (VERDICT r2 item 8)
+# serving throughput on-chip (VERDICT r2 item 8), fp and int8 KV cache
 timeout 900 python bench_models.py serving 2>&1 | tail -2
+PT_SERVE_CACHE=int8 timeout 900 python bench_models.py serving 2>&1 | tail -2
 echo "CAPTURE_DONE"

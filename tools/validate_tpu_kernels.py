@@ -154,7 +154,24 @@ def paged_decode():
                                     scale)
     err = max_err(o_p, o_r)
     assert err < 2e-3, f"paged decode err {err}"
-    return {"max_err": round(err, 6)}
+    # int8 cache variant: the quant kernel (scale blocks, reordered
+    # operands) must be chip-proven against the XLA dequant path before
+    # tpu_capture.sh benches PT_SERVE_CACHE=int8 (docs/tuning.md rule:
+    # validate before benchmarking)
+    from paddle_tpu.ops.paged_attention import quantize_kv
+    kq, ks = quantize_kv(k_pages)
+    vq, vs = quantize_kv(v_pages)
+    oq_p = paged_attention(q, kq, vq, table, lengths, use_pallas=True,
+                           k_scale=ks, v_scale=vs)
+    oq_r = paged_attention_reference(q, kq, vq, table, lengths, scale,
+                                     k_scale=ks, v_scale=vs)
+    err_q = max_err(oq_p, oq_r)
+    assert err_q < 2e-3, f"int8 paged decode err {err_q}"
+    # and the quantized result tracks the fp result within quant noise
+    err_qfp = max_err(oq_r, o_r)
+    assert err_qfp < 0.05, f"int8-vs-fp decode err {err_qfp}"
+    return {"max_err": round(err, 6), "max_err_int8": round(err_q, 6),
+            "int8_vs_fp": round(err_qfp, 6)}
 
 
 def flashmask_fwd_bwd():
