@@ -271,6 +271,14 @@ class ServingEngine:
                     f"num_pages={num_pages} cannot hold even one "
                     f"max_seq_len sequence ({self.pages_per_seq} pages) "
                     "+ the trash page")
+        if cache_dtype not in (None, "int8", jnp.int8):
+            # a silently-wrong pool dtype (e.g. 'int4', or a typo)
+            # would truncate K/V writes with no scales and decode
+            # garbage — fail at construction, not mid-decode
+            raise ValueError(
+                f"cache_dtype={cache_dtype!r} unsupported: use 'int8' "
+                "(quantized pool + per-token scales) or None (pool "
+                "stores `dtype`)")
         self.preemptions = 0
         self._order = 0
         kvh = c.num_key_value_heads

@@ -214,13 +214,13 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     a dense (S, S) mask.
 
     Routing: no indices/window → plain flash attention (pallas on TPU).
-    With indices/window and no training-time dropout → the FlashMask
-    pallas kernel (ops/flashmask_attention.py): start/end columns
-    streamed block-by-block, fully-masked blocks skipped, O(S·block)
-    memory — never a dense (S, S) materialization. Training-time
-    dropout needs materialized probabilities, so it runs the dense
-    flashmask_reference path WITH dropout applied (reference kernel
-    drops attention probabilities).
+    With indices/window → the FlashMask pallas kernel
+    (ops/flashmask_attention.py): start/end columns streamed
+    block-by-block, fully-masked blocks skipped, O(S·block) memory —
+    never a dense (S, S) materialization on ANY config. Training-time
+    dropout is applied IN-KERNEL from a deterministic counter-based
+    mask (dropout_keep_mask), matching the reference CUDA kernel's
+    philox attention-probability dropout.
 
     startend_row_indices: (B, Hk, S_k, {1, 2, 4}) int32 — see the
     reference docstring for the per-shape semantics (LT start / LT
@@ -231,15 +231,19 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
         return flash_attention(query, key, value, dropout=dropout,
                                causal=causal, training=training)
 
-    from ...ops.flashmask_attention import (flashmask_attention_bhsd,
-                                            flashmask_reference)
+    from ...ops.flashmask_attention import flashmask_attention_bhsd
     use_dropout = dropout > 0.0 and training
-    # key drawn OUTSIDE fn: tape backward re-executes fn via jax.vjp, and
-    # an in-fn next_key() would give the backward a different dropout
-    # mask than the forward (see _dropout_impl in common.py)
+    # seed drawn OUTSIDE fn: tape backward re-executes fn via jax.vjp,
+    # and an in-fn next_key() would give the backward a different
+    # dropout mask than the forward (see _dropout_impl in common.py).
+    # The kernel regenerates its mask from (seed, coords), so the seed
+    # is the only state to thread.
+    dropout_seed = None
     if use_dropout:
         from ..._core.state import prng
-        dropout_key = prng.next_key()
+        dropout_seed = jax.random.randint(prng.next_key(), (), 0,
+                                          jnp.iinfo(jnp.int32).max,
+                                          jnp.int32)
 
     def fn(q, k, v, *rest):
         qh = jnp.swapaxes(q, 1, 2)
@@ -254,13 +258,10 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
             sri = rest[0].astype(jnp.int32)
             if sri.shape[1] != h:
                 sri = jnp.repeat(sri, h // sri.shape[1], axis=1)
-        if use_dropout:
-            out, _ = flashmask_reference(qh, kh, vh, sri, causal,
-                                         window_size, dropout=dropout,
-                                         dropout_key=dropout_key)
-        else:
-            out = flashmask_attention_bhsd(qh, kh, vh, sri, causal=causal,
-                                           window=window_size)
+        out = flashmask_attention_bhsd(
+            qh, kh, vh, sri, causal=causal, window=window_size,
+            dropout=dropout if use_dropout else 0.0,
+            dropout_seed=dropout_seed)
         return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
     args = [query, key, value]

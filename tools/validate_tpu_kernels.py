@@ -223,6 +223,38 @@ def flashmask_fwd_bwd():
         errs[key] = (round(eo, 5), round(eg / max(gmag, 1.0), 5))
         assert eo < 2e-3, f"{key}: fwd err {eo}"
         assert eg / max(gmag, 1.0) < 2e-3, f"{key}: bwd rel err"
+
+    # in-kernel dropout (r4): fwd+bwd vs the dense reference applying
+    # the SAME counter-based mask — must be bit-tight, and must run on
+    # the real chip (uint32 hash ops in Mosaic) before any training
+    # config relies on it
+    b, h, s, d, rate, seed = 2, 2, 512, 64, 0.3, 123
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32) * 0.3
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32) * 0.3
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32) * 0.3
+    sri = jnp.asarray(rng.randint(1, s + 1, (b, h, s, 1)), jnp.int32)
+
+    def loss_kd(q_, k_, v_):
+        o = flashmask_attention_bhsd(q_, k_, v_, sri, causal=True,
+                                     use_pallas=True, interpret=False,
+                                     dropout=rate, dropout_seed=seed)
+        return (o * v_).sum(), o
+
+    def loss_rd(q_, k_, v_):
+        o, _ = flashmask_reference(q_, k_, v_, sri, True, None,
+                                   dropout=rate, dropout_seed=seed)
+        return (o * v_).sum(), o
+
+    (_, o_k), g_k = jax.value_and_grad(loss_kd, (0, 1, 2),
+                                       has_aux=True)(q, k, v)
+    (_, o_r), g_r = jax.value_and_grad(loss_rd, (0, 1, 2),
+                                       has_aux=True)(q, k, v)
+    eo = max_err(o_k, o_r)
+    eg = max(max_err(a, b2) for a, b2 in zip(g_k, g_r))
+    gmag = max(float(np.abs(np.asarray(g, np.float32)).max()) for g in g_r)
+    errs["dropout0.3"] = (round(eo, 5), round(eg / max(gmag, 1.0), 5))
+    assert eo < 2e-3, f"dropout fwd err {eo}"
+    assert eg / max(gmag, 1.0) < 2e-3, "dropout bwd rel err"
     return errs
 
 
