@@ -217,10 +217,12 @@ def flashmask_attention(query, key, value, startend_row_indices=None,
     With indices/window → the FlashMask pallas kernel
     (ops/flashmask_attention.py): start/end columns streamed
     block-by-block, fully-masked blocks skipped, O(S·block) memory —
-    never a dense (S, S) materialization on ANY config. Training-time
-    dropout is applied IN-KERNEL from a deterministic counter-based
-    mask (dropout_keep_mask), matching the reference CUDA kernel's
-    philox attention-probability dropout.
+    the kernel path never materializes a dense (S, S) mask for any
+    config, dropout included (training-time dropout is applied
+    IN-KERNEL from a deterministic counter-based mask, matching the
+    reference CUDA kernel's philox attention-probability dropout).
+    Off-TPU the dense flashmask_reference still runs — correctness
+    baseline, not the memory-scaling path.
 
     startend_row_indices: (B, Hk, S_k, {1, 2, 4}) int32 — see the
     reference docstring for the per-shape semantics (LT start / LT

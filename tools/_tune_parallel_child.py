@@ -1,0 +1,108 @@
+"""Stage-D trial child: time ONE parallel placement on the virtual
+CPU mesh (no hardware needed — parity with the reference auto_tuner's
+searched-configs runs, /root/reference/python/paddle/distributed/
+auto_tuner/search.py, which launches real trial jobs).
+
+Env:
+  PT_TUNE_PAR_CFG   json {dp, tp, pp, n_micro, schedule, vpp, zero,
+                          fused_ce}
+  PT_TUNE_PAR_NDEV  virtual device count (default 8)
+  PT_TUNE_PAR_SIZE  "tiny" (tests) | "small" (default search size)
+
+Prints one JSON line {"step_time_s": float, "cfg": {...}}.
+Exit non-zero on any failure (OOM-equivalent, bad mesh, compile error)
+— the parent scores only clean trials.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    cfg = json.loads(os.environ["PT_TUNE_PAR_CFG"])
+    ndev = int(os.environ.get("PT_TUNE_PAR_NDEV", "8"))
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               f" --xla_force_host_platform_device_count="
+                               f"{ndev}").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models import llama_spmd as M
+    from paddle_tpu.parallel.mesh import create_mesh, fsdp_spec
+
+    size = os.environ.get("PT_TUNE_PAR_SIZE", "small")
+    if size == "tiny":
+        mcfg = LlamaConfig.tiny(vocab=128, hidden=64, layers=8, heads=4,
+                                kv_heads=4, ffn=128)
+        batch, seq, iters = 8, 32, 2
+    else:
+        mcfg = LlamaConfig.tiny(vocab=1024, hidden=256, layers=8, heads=8,
+                                kv_heads=8, ffn=704)
+        batch, seq, iters = 8, 128, 3
+
+    dp, tp, pp = cfg.get("dp", 1), cfg.get("tp", 1), cfg.get("pp", 1)
+    axes = {}
+    if pp > 1:
+        axes["pp"] = pp
+    axes["dp"] = dp
+    if tp > 1:
+        axes["tp"] = tp
+    mesh = create_mesh(axes, devices=jax.devices()[:dp * tp * pp])
+
+    params = M.init_params(mcfg, seed=0)
+    if cfg.get("zero") and pp == 1 and tp == 1:
+        # ZeRO-3 placement: every param fsdp-sharded over dp; GSPMD
+        # inserts the all-gathers/reduce-scatters. make_train_step pins
+        # its own (megatron) in_shardings, so build the step directly
+        # (mirrors __graft_entry__'s ZeRO dryrun step).
+        params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, NamedSharding(
+                mesh, fsdp_spec(a.shape, mesh, "dp"))), params)
+        opt = M.init_opt_state(params)
+        fused = bool(cfg.get("fused_ce"))
+
+        def z_loss(p, batch):
+            return M.loss_fn(p, batch, mcfg, mesh=None, remat=False,
+                             fused_ce=fused)
+
+        @jax.jit
+        def step(p, o, i, batch):
+            loss, g = jax.value_and_grad(z_loss)(p, batch)
+            p2, o2 = M.adamw_update(p, g, o, 1e-3, i.astype(jnp.float32))
+            return p2, o2, loss
+    else:
+        if pp > 1:
+            params = M.place_params(params, mcfg, mesh)
+        opt = M.init_opt_state(params)
+        kw = {}
+        if pp > 1:
+            kw["schedule"] = cfg.get("schedule", "1f1b")
+            if kw["schedule"] == "interleave":
+                kw["vpp"] = cfg.get("vpp", 2)
+        step = M.make_train_step(mcfg, mesh,
+                                 n_micro=cfg.get("n_micro") or None,
+                                 remat=False, donate=False,
+                                 fused_ce=bool(cfg.get("fused_ce")),
+                                 lr=1e-3, **kw)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, mcfg.vocab_size, (batch, seq)))
+    y = jnp.asarray(rng.randint(0, mcfg.vocab_size, (batch, seq)))
+    params, opt, loss = step(params, opt, jnp.asarray(0), (x, y))  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, opt, loss = step(params, opt, jnp.asarray(i + 1), (x, y))
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+    assert np.isfinite(float(loss)), f"loss diverged: {loss}"
+    print(json.dumps({"step_time_s": round(dt, 5), "cfg": cfg}))
+
+
+if __name__ == "__main__":
+    main()

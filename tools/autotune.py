@@ -144,23 +144,217 @@ def score(res):
     return res["value"] if res else -1.0
 
 
-def persist(best_cfg, best_res, trials, done):
-    data = {"best": dict(best_cfg, tok_s=best_res["value"],
-                         mfu=best_res["extra"]["mfu"],
-                         mfu_legacy=best_res["extra"].get("mfu_legacy")),
-            "stages_done": done, "n_trials": len(trials), "smoke": SMOKE,
-            "trials": [{"cfg": t["cfg"],
-                        "tok_s": t["result"]["value"] if t["result"] else None,
-                        "error": t.get("error")} for t in trials],
-            "ts": time.time()}
+def _merge_tuned(updates):
+    """Atomically merge top-level keys into TUNED.json, preserving
+    whatever other stages wrote there."""
+    data = {}
+    try:
+        with open(TUNED) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    data.update(updates)
     tmp = TUNED + ".tmp"
     with open(tmp, "w") as f:
         json.dump(data, f, indent=1)
     os.replace(tmp, TUNED)
+    return data
+
+
+def persist(best_cfg, best_res, trials, done):
+    data = _merge_tuned(dict(
+        best=dict(best_cfg, tok_s=best_res["value"],
+                  mfu=best_res["extra"]["mfu"],
+                  mfu_legacy=best_res["extra"].get("mfu_legacy")),
+        stages_done=done, n_trials=len(trials), smoke=SMOKE,
+        trials=[{"cfg": t["cfg"],
+                 "tok_s": t["result"]["value"] if t["result"] else None,
+                 "error": t.get("error")} for t in trials],
+        ts=time.time()))
     print(f"{os.path.basename(TUNED)} <- {data['best']}", flush=True)
 
 
+# ---------------------------------------------------------------------------
+# stage D: parallel-config search on the virtual CPU mesh (reference
+# parity: the auto_tuner's dp/tp/pp/sharding search with cost-model
+# pruning, /root/reference/python/paddle/distributed/auto_tuner/
+# {search,prune,cost_model}.py). Needs NO hardware: each candidate is
+# timed on the 8-device CPU mesh (captures partition imbalance and
+# schedule bubbles) and scored with an analytic ICI comm model
+# (captures what CPU timing cannot — the collectives' on-chip cost).
+# ---------------------------------------------------------------------------
+# stage-D child model dims per PT_TUNE_PAR_SIZE — enumeration, the comm
+# cost model, and the compute estimate must all use the dims the child
+# actually runs, or the ranking scores a model that was never measured
+PAR_MODELS = {
+    "small": {"hidden": 256, "layers": 8, "ffn": 704, "vocab": 1024,
+              "batch": 8, "seq": 128, "heads": 8},
+    "tiny": {"hidden": 64, "layers": 8, "ffn": 128, "vocab": 128,
+             "batch": 8, "seq": 32, "heads": 4},
+}
+PAR_MODEL = PAR_MODELS["small"]
+V5E_ICI_BPS = 1.6e11   # ~per-chip ICI bandwidth, bytes/s (order-of-mag)
+V5E_FLOPS = 197e12 * 0.4  # assume 40% MFU for the compute-time estimate
+
+
+def enumerate_parallel_configs(n_devices, n_layers, batch, n_heads):
+    """Candidate placements with reference-style pruning
+    (auto_tuner/prune.py parity): device/layer/batch/head divisibility,
+    tp capped at head count; pp adds n_micro x {1f1b, interleave}
+    (interleave only when layers admit 2 chunks per stage); ZeRO-3 only
+    for the pure-dp placement."""
+    out = []
+    for pp in (1, 2, 4, 8):
+        for tp in (1, 2, 4, 8):
+            if pp * tp > n_devices or n_devices % (pp * tp):
+                continue
+            dp = n_devices // (pp * tp)
+            if n_layers % pp or batch % dp or n_heads % tp:
+                continue
+            base = {"dp": dp, "tp": tp, "pp": pp, "fused_ce": True}
+            if pp == 1:
+                out.append(dict(base))
+                if tp == 1 and dp > 1:
+                    out.append(dict(base, zero=True))
+                continue
+            for nm in (2, 4):
+                if batch % nm:
+                    continue
+                out.append(dict(base, n_micro=nm, schedule="1f1b"))
+                if n_layers % (pp * 2) == 0:
+                    out.append(dict(base, n_micro=nm,
+                                    schedule="interleave", vpp=2))
+    return out
+
+
+def parallel_comm_cost(cfg, model=PAR_MODEL):
+    """Analytic per-step ICI seconds for a placement (bf16 wire bytes).
+
+    tp: 4 activation all-reduces per layer (2 fwd + 2 bwd, megatron);
+    dp: one grad all-reduce (2x param bytes ring cost);
+    zero: + param all-gather fwd+bwd and reduce-scatter grads;
+    pp: p2p activations per microbatch boundary, plus the schedule
+    bubble inflating COMPUTE time (modeled on the compute estimate).
+    A ranking heuristic to combine with measured CPU step time — not a
+    simulator; calibrate against the chip when the tunnel returns.
+    """
+    H, L, F_, V = (model["hidden"], model["layers"], model["ffn"],
+                   model["vocab"])
+    B, S = model["batch"], model["seq"]
+    dp, tp, pp = cfg.get("dp", 1), cfg.get("tp", 1), cfg.get("pp", 1)
+    act = B * S * H * 2 / dp          # bf16 activation bytes per shard
+    params = (L * (4 * H * H + 3 * H * F_) + 2 * V * H) * 2
+    comm = 0.0
+    if tp > 1:
+        comm += 4 * L * act * (tp - 1) / tp / V5E_ICI_BPS
+    if dp > 1:
+        comm += 2 * (params / (tp * pp)) * (dp - 1) / dp / V5E_ICI_BPS
+    if cfg.get("zero"):
+        comm += 3 * params * (dp - 1) / dp / V5E_ICI_BPS
+    if pp > 1:
+        nm = cfg.get("n_micro", pp)
+        comm += 2 * act * (pp - 1) / V5E_ICI_BPS  # p2p fwd+bwd
+        flops = 6 * (L * (4 * H * H + 3 * H * F_) + V * H) * B * S
+        compute = flops / V5E_FLOPS
+        fill = (pp - 1) / cfg.get("vpp", 1) if \
+            cfg.get("schedule") == "interleave" else (pp - 1)
+        comm += compute * fill / (nm + fill)      # bubble as lost time
+    return comm
+
+
+def run_parallel_trial(cfg, ndev=8, size="small", timeout=None):
+    """One _tune_parallel_child.py run; returns step_time_s or None."""
+    env = dict(os.environ, PT_TUNE_PAR_CFG=json.dumps(cfg),
+               PT_TUNE_PAR_NDEV=str(ndev), PT_TUNE_PAR_SIZE=size)
+    env.pop("JAX_PLATFORMS", None)  # child pins cpu via jax.config
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(HERE, "_tune_parallel_child.py")],
+            env=env, capture_output=True, text=True,
+            timeout=timeout or TRIAL_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        print(f"  parallel trial {cfg} TIMED OUT", flush=True)
+        return None
+    out = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(parsed, dict):
+            out = parsed
+            break
+    if r.returncode != 0 or out is None:
+        tail = "\n".join(r.stderr.strip().splitlines()[-3:])
+        print(f"  parallel trial {cfg} FAILED rc={r.returncode}: {tail}",
+              flush=True)
+        return None
+    return float(out["step_time_s"])
+
+
+def run_parallel_search(ndev=8, size="small", runner=None, max_trials=None):
+    """Measure every candidate, score = cpu_step_time x (1 + modeled
+    ICI comm / modeled compute), prune dominated configs, and merge the
+    ranking into TUNED.json under "parallel"."""
+    model = PAR_MODELS[size]
+    cands = enumerate_parallel_configs(ndev, model["layers"],
+                                       model["batch"], model["heads"])
+    if max_trials:
+        cands = cands[:max_trials]
+    runner = runner or (lambda cfg: run_parallel_trial(cfg, ndev, size))
+    flops = 6 * (model["layers"] * (4 * model["hidden"] ** 2
+                                    + 3 * model["hidden"] * model["ffn"])
+                 + model["vocab"] * model["hidden"]) \
+        * model["batch"] * model["seq"]
+    compute_s = flops / V5E_FLOPS
+    rows = []
+    print(f"stage D: parallel placement search ({len(cands)} candidates, "
+          f"{ndev} virtual devices)", flush=True)
+    for cfg in cands:
+        t = runner(cfg)
+        if t is None:
+            rows.append({"cfg": cfg, "step_time_s": None, "score": None})
+            continue
+        comm = parallel_comm_cost(cfg, model)
+        score = t * (1.0 + comm / compute_s)
+        rows.append({"cfg": cfg, "step_time_s": t,
+                     "comm_model_s": round(comm, 6),
+                     "score": round(score, 5)})
+        print(f"  {cfg}: cpu {t:.3f}s, comm-model {comm * 1e3:.2f}ms, "
+              f"score {score:.4f}", flush=True)
+    ok = [r_ for r_ in rows if r_["score"] is not None]
+    if not ok:
+        print("stage D: every parallel trial failed", file=sys.stderr)
+        return None
+    ok.sort(key=lambda r_: r_["score"])
+    # dominated = strictly worse on BOTH measured time and modeled comm
+    for r_ in ok:
+        r_["dominated"] = any(
+            o is not r_ and o["step_time_s"] <= r_["step_time_s"]
+            and o["comm_model_s"] <= r_["comm_model_s"]
+            and (o["step_time_s"] < r_["step_time_s"]
+                 or o["comm_model_s"] < r_["comm_model_s"])
+            for o in ok)
+    block = {"best": ok[0]["cfg"], "n_devices": ndev, "size": size,
+             "model": model, "ranking": ok,
+             "failed": [r_["cfg"] for r_ in rows if r_["score"] is None],
+             "note": "cpu-mesh measured step time x analytic ICI comm "
+                     "model; calibrate on chip", "ts": time.time()}
+    _merge_tuned({"parallel": block})
+    print(f"{os.path.basename(TUNED)} parallel <- {block['best']}",
+          flush=True)
+    return block
+
+
 def main():
+    if "--parallel" in sys.argv:
+        # stage D runs WITHOUT hardware (virtual CPU mesh) — never
+        # burn a tunnel window on it
+        ok = run_parallel_search(
+            ndev=int(os.environ.get("PT_TUNE_PAR_NDEV", "8")),
+            size=os.environ.get("PT_TUNE_PAR_SIZE", "small"),
+            max_trials=int(os.environ.get("PT_TUNE_PAR_MAX", "0")) or None)
+        sys.exit(0 if ok else 1)
     if SMOKE:
         print(f"autotune: SMOKE mode (child={os.path.basename(CHILD)}, "
               f"out={os.path.basename(TUNED)})", flush=True)
