@@ -699,48 +699,90 @@ class PipelineLayer:
     splits a LayerDesc list into pp stages.
 
     When constructed with a mesh whose pp axis == num_stages, forward()
-    actually executes stage-parallel: the longest homogeneous run of
-    layers (same class, same param shapes) is stacked and run through
-    pipeline_apply over the mesh, with any heterogeneous head/tail
-    layers running replicated outside the pp loop. This is the
-    compiled-functional path (params are read out of the layers as raw
-    arrays), matching how the reference's PP engine drives the layer —
-    not the eager-tape path. Without a mesh, forward is sequential.
+    actually executes stage-parallel: EVERY maximal homogeneous run of
+    layers (same class, same param shapes) long enough to fill the
+    stages is stacked and run through pipeline_apply over the mesh —
+    arbitrary LayerDesc lists (embed → blocksA → blocksB → head) stage
+    each run, with the heterogeneous layers between runs executing
+    replicated (reference seg-method parity: the reference segments any
+    LayerDesc list; ours stages the stackable runs and warns when
+    nothing is stackable). This is the compiled-functional path (params
+    are read out of the layers as raw arrays), matching how the
+    reference's PP engine drives the layer — not the eager-tape path.
+    Without a mesh, forward is sequential.
+
+    seg_method: "uniform" (default) stages every eligible run;
+    "layer:ClassName" stages only runs of that class (reference
+    seg_method="layer:..." cut-point parity). recompute_interval > 0
+    wraps each staged layer in jax.checkpoint (activation remat inside
+    the pipeline, reference recompute_interval semantics at
+    granularity 1).
     """
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
                  seg_method="uniform", recompute_interval=0, mesh=None,
                  pp_axis="pp", n_micro=None, **kwargs):
+        import warnings
         self.descs = layers
         self.num_stages = num_stages or 1
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.pp_axis = pp_axis
         self.n_micro = n_micro
+        self.seg_method = seg_method
+        self.recompute_interval = int(recompute_interval)
+        if not (seg_method == "uniform"
+                or str(seg_method).startswith("layer:")):
+            raise ValueError(
+                f"seg_method={seg_method!r} unsupported: use 'uniform' "
+                "or 'layer:ClassName'")
         self.built = [d.build() if isinstance(d, LayerDesc) else d
                       for d in layers]
-        self._block = (self._find_homogeneous_block()
-                       if self.num_stages > 1 else None)
-        self._pipeline_fn = None
+        self._segments = (self._find_stageable_segments()
+                          if self.num_stages > 1 else [])
+        self._pipeline_fns = {}
+        if self.num_stages > 1 and self.mesh is not None:
+            mesh_pp = self.mesh.shape.get(self.pp_axis, 1)
+            if not self._segments:
+                warnings.warn(
+                    f"PipelineLayer(num_stages={self.num_stages}): no "
+                    f"homogeneous run of >= {self.num_stages} stackable "
+                    "layers found — forward() will run SEQUENTIALLY "
+                    "(replicated), not pipelined. Stage-parallel "
+                    "execution needs same-class layers with identical "
+                    f"param shapes (seg_method={seg_method!r}).",
+                    stacklevel=2)
+            elif mesh_pp != self.num_stages:
+                warnings.warn(
+                    f"PipelineLayer(num_stages={self.num_stages}): mesh "
+                    f"'{self.pp_axis}' axis has {mesh_pp} devices — "
+                    "forward() will run SEQUENTIALLY (replicated), not "
+                    "pipelined. Make num_stages match the mesh's pp "
+                    "axis.", stacklevel=2)
 
-    def _find_homogeneous_block(self):
-        """[start, end) of the longest run of same-class layers with
-        identical param signatures, trimmed to a multiple of num_stages;
-        None when no run can fill every stage."""
-        sigs = []
-        for l in self.built:
-            if hasattr(l, "functional_state"):
-                p, b = l.functional_state()
-                # buffered layers (e.g. BatchNorm) are NOT stackable:
-                # functional_call would run every stacked layer with the
-                # template's buffer values and silently diverge
-                sigs.append(None if b else
-                            (type(l),
-                             tuple(sorted((n, tuple(a.shape), str(a.dtype))
-                                          for n, a in p.items()))))
-            else:
-                sigs.append(None)
-        best = (0, 0)
+    def _layer_sig(self, l):
+        if not hasattr(l, "functional_state"):
+            return None
+        p, b = l.functional_state()
+        # buffered layers (e.g. BatchNorm) are NOT stackable:
+        # functional_call would run every stacked layer with the
+        # template's buffer values and silently diverge
+        if b:
+            return None
+        sig = (type(l), tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                                     for n, a in p.items())))
+        if str(self.seg_method).startswith("layer:"):
+            want = str(self.seg_method)[len("layer:"):]
+            if type(l).__name__ != want:
+                return None
+        return sig
+
+    def _find_stageable_segments(self):
+        """All maximal runs of same-signature layers, each trimmed to
+        the largest multiple of num_stages (leftover tail layers run
+        sequentially); empty when nothing can fill every stage."""
+        sigs = [self._layer_sig(l) for l in self.built]
+        segments = []
         i, n = 0, len(sigs)
         while i < n:
             if sigs[i] is None:
@@ -749,47 +791,50 @@ class PipelineLayer:
             j = i
             while j < n and sigs[j] == sigs[i]:
                 j += 1
-            if j - i > best[1] - best[0]:
-                best = (i, j)
+            count = (j - i) // self.num_stages * self.num_stages
+            if count >= self.num_stages and count >= 2:
+                segments.append((i, i + count))
             i = j
-        start, end = best
-        count = (end - start) // self.num_stages * self.num_stages
-        if count < self.num_stages or count < 2:
-            return None
-        return (start, start + count)
+        return segments
 
-    def _staged_pipeline(self):
-        """Jitted pipeline over the homogeneous block, built once —
-        rebuilding per forward would retrace/recompile every step."""
-        if self._pipeline_fn is None:
-            template = self.built[self._block[0]]
+    def _staged_pipeline(self, seg):
+        """Jitted pipeline per staged segment, built once — rebuilding
+        per forward would retrace/recompile every step."""
+        if seg not in self._pipeline_fns:
+            template = self.built[seg[0]]
 
             def layer_fn(lp, h, extra):
                 return template.functional_call(lp, {}, h)
+            if self.recompute_interval > 0:
+                layer_fn = jax.checkpoint(layer_fn)
 
             # under jit: shard_map with partial-manual axes (pp manual,
             # the mesh's other axes auto) only composes with GSPMD
             # inside a traced computation; eager would reject them
-            self._pipeline_fn = jax.jit(functools.partial(
+            self._pipeline_fns[seg] = jax.jit(functools.partial(
                 pipeline_apply, layer_fn=layer_fn, mesh=self.mesh,
                 pp_axis=self.pp_axis, n_micro=self.n_micro))
-        return self._pipeline_fn
+        return self._pipeline_fns[seg]
 
     def _staged_forward(self, x):
-        start, end = self._block
-        for l in self.built[:start]:
+        pos = 0
+        for start, end in self._segments:
+            for l in self.built[pos:start]:
+                x = l(x)
+            plist = [l.functional_state()[0]
+                     for l in self.built[start:end]]
+            stacked = {k: jnp.stack([p[k] for p in plist])
+                       for k in plist[0]}
+            raw = x._value if hasattr(x, "_value") else jnp.asarray(x)
+            x = self._staged_pipeline((start, end))(
+                group_stages(stacked, self.num_stages), raw)
+            pos = end
+        for l in self.built[pos:]:
             x = l(x)
-        plist = [l.functional_state()[0] for l in self.built[start:end]]
-        stacked = {k: jnp.stack([p[k] for p in plist]) for k in plist[0]}
-        raw = x._value if hasattr(x, "_value") else jnp.asarray(x)
-        out = self._staged_pipeline()(group_stages(stacked, self.num_stages),
-                                      raw)
-        for l in self.built[end:]:
-            out = l(out)
-        return out
+        return x
 
     def forward(self, x):
-        if (self._block is not None and self.mesh is not None
+        if (self._segments and self.mesh is not None
                 and self.mesh.shape.get(self.pp_axis, 1) == self.num_stages):
             return self._staged_forward(x)
         for l in self.built:

@@ -197,6 +197,16 @@ V5E_ICI_BPS = 1.6e11   # ~per-chip ICI bandwidth, bytes/s (order-of-mag)
 V5E_FLOPS = 197e12 * 0.4  # assume 40% MFU for the compute-time estimate
 
 
+def model_flops(model):
+    """fwd+bwd matmul FLOPs per step of the stage-D child model (6N
+    convention, lm_head kept) — single source for the bubble term and
+    the score's compute estimate."""
+    H, L, F_, V = (model["hidden"], model["layers"], model["ffn"],
+                   model["vocab"])
+    return 6 * (L * (4 * H * H + 3 * H * F_) + V * H) \
+        * model["batch"] * model["seq"]
+
+
 def enumerate_parallel_configs(n_devices, n_layers, batch, n_heads):
     """Candidate placements with reference-style pruning
     (auto_tuner/prune.py parity): device/layer/batch/head divisibility,
@@ -247,15 +257,16 @@ def parallel_comm_cost(cfg, model=PAR_MODEL):
     comm = 0.0
     if tp > 1:
         comm += 4 * L * act * (tp - 1) / tp / V5E_ICI_BPS
-    if dp > 1:
-        comm += 2 * (params / (tp * pp)) * (dp - 1) / dp / V5E_ICI_BPS
     if cfg.get("zero"):
+        # ZeRO-3 REPLACES the grad all-reduce: param all-gather fwd +
+        # bwd and grad reduce-scatter, ~3x param wire bytes total
         comm += 3 * params * (dp - 1) / dp / V5E_ICI_BPS
+    elif dp > 1:
+        comm += 2 * (params / (tp * pp)) * (dp - 1) / dp / V5E_ICI_BPS
     if pp > 1:
         nm = cfg.get("n_micro", pp)
         comm += 2 * act * (pp - 1) / V5E_ICI_BPS  # p2p fwd+bwd
-        flops = 6 * (L * (4 * H * H + 3 * H * F_) + V * H) * B * S
-        compute = flops / V5E_FLOPS
+        compute = model_flops(model) / V5E_FLOPS
         fill = (pp - 1) / cfg.get("vpp", 1) if \
             cfg.get("schedule") == "interleave" else (pp - 1)
         comm += compute * fill / (nm + fill)      # bubble as lost time
@@ -302,11 +313,7 @@ def run_parallel_search(ndev=8, size="small", runner=None, max_trials=None):
     if max_trials:
         cands = cands[:max_trials]
     runner = runner or (lambda cfg: run_parallel_trial(cfg, ndev, size))
-    flops = 6 * (model["layers"] * (4 * model["hidden"] ** 2
-                                    + 3 * model["hidden"] * model["ffn"])
-                 + model["vocab"] * model["hidden"]) \
-        * model["batch"] * model["seq"]
-    compute_s = flops / V5E_FLOPS
+    compute_s = model_flops(model) / V5E_FLOPS
     rows = []
     print(f"stage D: parallel placement search ({len(cands)} candidates, "
           f"{ndev} virtual devices)", flush=True)
