@@ -246,14 +246,24 @@ class ServingEngine:
     (default: worst-case max_seqs*pages_per_seq) may oversubscribe the
     pool; if decode then runs out of pages, the most-recently admitted
     request is preempted — its pages return to the pool and it re-enters
-    the head of the queue, resuming later by re-prefilling its prompt +
-    already-generated tokens (no re-sampling of tokens it already
-    emitted)."""
+    the head of the queue (no re-sampling of tokens it already emitted).
+
+    `preempt_policy` selects how an evicted request resumes (reference
+    parity: fleet BlockManager swap-out/swap-in in
+    paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu's
+    serving stack):
+      * "offload" (default): the victim's KV pages are copied to HOST
+        memory on eviction and scattered back into fresh device pages on
+        resume — zero recompute, one device<->host round trip of
+        n_pages*page_size tokens of KV.
+      * "recompute": pages are dropped; resume re-prefills
+        prompt + generated-so-far (cheaper on host RAM, ~1 extra prefill
+        of compute per eviction)."""
 
     def __init__(self, params, config: LlamaConfig, max_seqs=4,
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
                  use_pallas=None, interpret=False, num_pages=None,
-                 cache_dtype=None):
+                 cache_dtype=None, preempt_policy="offload"):
         c = config
         self.params = params
         self.config = c
@@ -279,7 +289,13 @@ class ServingEngine:
                 f"cache_dtype={cache_dtype!r} unsupported: use 'int8' "
                 "(quantized pool + per-token scales) or None (pool "
                 "stores `dtype`)")
+        if preempt_policy not in ("offload", "recompute"):
+            raise ValueError(
+                f"preempt_policy={preempt_policy!r}: use 'offload' "
+                "(host-swap KV pages) or 'recompute' (re-prefill)")
+        self.preempt_policy = preempt_policy
         self.preemptions = 0
+        self.prefill_tokens = 0  # total tokens ever run through prefill
         self._order = 0
         kvh = c.num_key_value_heads
         hd = c.hidden_size // c.num_attention_heads
@@ -358,24 +374,45 @@ class ServingEngine:
         free_pages = len(self._free) - growth_need
         take = 0
         for req in self._waiting[:len(free_slots)]:
-            feed_len = max(len(self._feed_ids(req)), 1)
-            need = -(-feed_len // self.page_size)
-            if feed_len % self.page_size == 0:
-                need += 1  # its own first decode boundary, same step
+            ofl = getattr(req, "_offload", None)
+            if ofl is not None:
+                need = ofl["pages"]
+                if ofl["len"] % self.page_size == 0 and \
+                        need * self.page_size <= ofl["len"]:
+                    need += 1  # boundary growth this same step
+            else:
+                feed_len = max(len(self._feed_ids(req)), 1)
+                need = -(-feed_len // self.page_size)
+                if feed_len % self.page_size == 0:
+                    need += 1  # its own first decode boundary, same step
             if need > free_pages:
                 break
             free_pages -= need
             take += 1
         if take == 0:
             return
-        if take == 1:
-            self._prefill_into(free_slots[0], self._waiting.pop(0))
+        all_reqs = [self._waiting.pop(0) for _ in range(take)]
+        all_slots = free_slots[:take]
+        # host-offloaded victims resume by scattering their saved pages
+        # back — no prefill compute; everything else joins one varlen
+        # prefill batch
+        reqs, slots = [], []
+        for slot, req in zip(all_slots, all_reqs):
+            if getattr(req, "_offload", None) is not None:
+                self._restore_into(slot, req)
+            else:
+                reqs.append(req)
+                slots.append(slot)
+        take = len(reqs)
+        if take == 0:
             return
-        reqs = [self._waiting.pop(0) for _ in range(take)]
-        slots = free_slots[:take]
+        if take == 1:
+            self._prefill_into(slots[0], reqs[0])
+            return
         feeds = [self._feed_ids(r) for r in reqs]
         lens = [len(f) for f in feeds]
         total = sum(lens)
+        self.prefill_tokens += total
         bucket = max(self.page_size, 1 << math.ceil(math.log2(max(total, 1))))
         ids = np.zeros((bucket,), np.int64)
         cu = np.zeros((self.max_seqs + 1,), np.int32)
@@ -447,6 +484,7 @@ class ServingEngine:
         c = self.config
         feed = self._feed_ids(req)
         S = len(feed)
+        self.prefill_tokens += S
         bucket = max(self.page_size,
                      1 << math.ceil(math.log2(max(S, 1))))
         ids = np.zeros((1, bucket), np.int64)
@@ -473,8 +511,10 @@ class ServingEngine:
 
     def _preempt_one(self, exclude):
         """Evict the most-recently admitted active request (never
-        `exclude`): pages return to the pool, the request re-enters the
-        HEAD of the waiting queue and resumes by re-prefilling
+        `exclude`): pages return to the pool and the request re-enters
+        the HEAD of the waiting queue. Under preempt_policy="offload"
+        its KV pages are first copied to host memory (resume = scatter
+        back, no recompute); under "recompute" resume re-prefills
         prompt + generated-so-far. Returns False when nothing can be
         evicted."""
         victims = [s for s, r in enumerate(self._slots)
@@ -483,12 +523,54 @@ class ServingEngine:
             return False
         s = max(victims, key=lambda v: self._slots[v]._admit_order)
         req = self._slots[s]
+        if self.preempt_policy == "offload":
+            pg = np.asarray(self._seq_pages[s])
+            req._offload = {
+                "len": int(self.lengths[s]),
+                # actual page count, NOT ceil(len/page_size): a victim
+                # evicted right after its boundary growth already holds
+                # the next (still-empty) page
+                "pages": len(pg),
+                "k": np.asarray(self.k_pool[:, :, pg]),
+                "v": np.asarray(self.v_pool[:, :, pg]),
+                "ks": None if self.k_scale is None else
+                      np.asarray(self.k_scale[:, :, pg]),
+                "vs": None if self.v_scale is None else
+                      np.asarray(self.v_scale[:, :, pg]),
+            }
         req._resume = True
         req.slot = None
         self._waiting.insert(0, req)
         self._release(s)
         self.preemptions += 1
         return True
+
+    def _restore_into(self, slot, req: Request):
+        """Swap-in: scatter a host-offloaded request's KV pages into
+        fresh device pages. No prefill compute; the pending next_token
+        survived eviction on the Request itself."""
+        o = req._offload
+        S = o["len"]
+        n_pages = o["pages"]
+        self._seq_pages[slot] = []
+        pages = self._alloc_pages(slot, n_pages)
+        pg = np.asarray(pages)
+        self.k_pool = self.k_pool.at[:, :, pg].set(
+            jnp.asarray(o["k"], self.k_pool.dtype))
+        self.v_pool = self.v_pool.at[:, :, pg].set(
+            jnp.asarray(o["v"], self.v_pool.dtype))
+        if self.cache_quant:
+            self.k_scale = self.k_scale.at[:, :, pg].set(
+                jnp.asarray(o["ks"], jnp.float32))
+            self.v_scale = self.v_scale.at[:, :, pg].set(
+                jnp.asarray(o["vs"], jnp.float32))
+        self.lengths = self.lengths.at[slot].set(S)
+        req._offload = None
+        req._resume = False
+        req.slot = slot
+        req._admit_order = self._order
+        self._order += 1
+        self._slots[slot] = req
 
     # -- decode loop ------------------------------------------------------
     def step(self):

@@ -759,6 +759,19 @@ class PipelineLayer:
                     "forward() will run SEQUENTIALLY (replicated), not "
                     "pipelined. Make num_stages match the mesh's pp "
                     "axis.", stacklevel=2)
+        if self.recompute_interval > 0 and not self._will_stage():
+            warnings.warn(
+                f"PipelineLayer: recompute_interval="
+                f"{self.recompute_interval} only applies on the staged "
+                "pipeline path; this construction runs sequentially "
+                "(no mesh / mesh-axis mismatch / nothing stackable), so "
+                "NO activation recompute will happen.", stacklevel=2)
+
+    def _will_stage(self):
+        """True iff forward() will take the stage-parallel path."""
+        return bool(
+            self._segments and self.mesh is not None
+            and self.mesh.shape.get(self.pp_axis, 1) == self.num_stages)
 
     def _layer_sig(self, l):
         if not hasattr(l, "functional_state"):
@@ -823,8 +836,7 @@ class PipelineLayer:
                 x = l(x)
             plist = [l.functional_state()[0]
                      for l in self.built[start:end]]
-            stacked = {k: jnp.stack([p[k] for p in plist])
-                       for k in plist[0]}
+            stacked = stack_layer_params(plist)
             raw = x._value if hasattr(x, "_value") else jnp.asarray(x)
             x = self._staged_pipeline((start, end))(
                 group_stages(stacked, self.num_stages), raw)
@@ -834,8 +846,7 @@ class PipelineLayer:
         return x
 
     def forward(self, x):
-        if (self._segments and self.mesh is not None
-                and self.mesh.shape.get(self.pp_axis, 1) == self.num_stages):
+        if self._will_stage():
             return self._staged_forward(x)
         for l in self.built:
             x = l(x)

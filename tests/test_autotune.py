@@ -21,10 +21,14 @@ TUNER = os.path.join(ROOT, "tools", "autotune.py")
 SMOKE_CHILD = os.path.join(ROOT, "tools", "_tune_smoke_child.py")
 
 
-def run_tuner(tmp_path, fault=None, fault_block_q=None, timeout_s="30"):
+def run_tuner(tmp_path, fault=None, fault_block_q=None, timeout_s="30",
+              dead_trip=None):
     out = str(tmp_path / "TUNED.json")
     env = dict(os.environ, PT_TUNE_SMOKE="1", PT_TUNE_OUT=out,
                PT_TUNE_TRIAL_TIMEOUT=timeout_s)
+    env.pop("PT_TUNE_DEAD_TRIP", None)
+    if dead_trip is not None:
+        env["PT_TUNE_DEAD_TRIP"] = str(dead_trip)
     env.pop("PT_SMOKE_FAULT", None)
     env.pop("PT_SMOKE_FAULT_BLOCK_Q", None)
     env.pop("PT_TUNE_CHILD", None)
@@ -66,14 +70,16 @@ def test_dedup_skips_equivalent_configs(tmp_path):
     assert len(set(cfgs)) == len(cfgs), "a config was measured twice"
 
 
-def test_cpu_fallback_rejected_everywhere(tmp_path):
-    # every child answers backend:"cpu" -> all stage-A trials invalid
-    # -> the tuner must abort with a non-zero exit and write no winner
+def test_cpu_fallback_trips_dead_tunnel_breaker(tmp_path):
+    # every child answers backend:"cpu" -> tunnel-death-shaped failures
+    # -> the circuit breaker must abort the search after DEAD_TRIP (3)
+    # consecutive trials instead of burning TRIAL_TIMEOUT on all 14,
+    # with a non-zero exit and no winner written
     r, data = run_tuner(tmp_path, fault="cpu")
     assert r.returncode != 0
-    assert "every stage-A trial failed" in r.stderr
+    assert "aborting search" in r.stderr and "consecutive" in r.stderr
     assert data is None
-    assert "INVALID: child fell back to CPU" in r.stdout
+    assert r.stdout.count("INVALID: child fell back to CPU") == 3
 
 
 def test_pallas_rejection_guard(tmp_path):
@@ -85,6 +91,20 @@ def test_pallas_rejection_guard(tmp_path):
     assert (data["best"]["block_q"], data["best"]["block_k"]) == (256, 512)
     errors = {e["error"] for e in data["trials"] if e.get("error")}
     assert errors == {"pallas_fallback"}
+
+
+def test_breaker_mid_search_keeps_best_so_far(tmp_path):
+    # cpu-fault only block_q=512 trials with DEAD_TRIP=2: stage B's two
+    # consecutive 512 trials trip the breaker AFTER stage A found a
+    # winner — the tuner must exit 0 with the best-so-far persisted,
+    # not lose the search
+    r, data = run_tuner(tmp_path, fault="cpu", fault_block_q=512,
+                        dead_trip=2)
+    assert r.returncode == 0, r.stderr
+    assert "aborting search" in r.stderr
+    assert data is not None and "best" in data
+    assert data["best"]["batch"] == 24  # stage-A peak survived
+    assert "C" not in data["stages_done"]
 
 
 def test_crashing_child_is_survived(tmp_path):

@@ -169,9 +169,9 @@ class TestServingRobustness:
 
     def test_oversubscribed_pool_preempts_and_completes(self, params):
         """Pool holds ~1.5 sequences' worst case; two long generations
-        must BOTH finish via preemption-by-recompute, with outputs
-        identical to the fully-provisioned run (greedy determinism
-        across eviction/resume)."""
+        must BOTH finish via preemption (default offload policy), with
+        outputs identical to the fully-provisioned run (greedy
+        determinism across eviction/resume)."""
         prompts = [[1, 5, 9, 3], [2, 6, 4, 8]]
         n_new = 24  # crosses several 8-token page boundaries
         refs = [greedy_reference(params, p, n_new) for p in prompts]
@@ -316,7 +316,8 @@ class TestInt8CacheServing:
         must re-quantize cleanly."""
         eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
                             page_size=8, use_pallas=False,
-                            num_pages=6, cache_dtype="int8")
+                            num_pages=6, cache_dtype="int8",
+                            preempt_policy="recompute")
         refs = {}
         for i, p in enumerate([[1, 2, 3], [7, 6, 5]]):
             refs[f"r{i}"] = greedy_reference(params, p, 10)
@@ -325,3 +326,72 @@ class TestInt8CacheServing:
         assert len(done) == 2
         for r in done:
             assert r.output == refs[r.rid]
+
+
+class TestPreemptOffload:
+    """preempt_policy="offload": evicted KV pages swap to host and back
+    (reference BlockManager swap-out/swap-in) — zero recompute."""
+
+    def test_bad_policy_rejected(self, params):
+        with pytest.raises(ValueError, match="preempt_policy"):
+            ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                          page_size=8, preempt_policy="swap")
+
+    def test_offload_matches_and_skips_recompute(self, params):
+        """Both policies produce greedy-identical outputs under pool
+        pressure, but offload's prefill compute is exactly the original
+        prompts — eviction costs no re-prefill."""
+        prompts = [[1, 5, 9, 3], [2, 6, 4, 8]]
+        n_new = 24
+        refs = [greedy_reference(params, p, n_new) for p in prompts]
+        outs, prefills = {}, {}
+        for pol in ("offload", "recompute"):
+            eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                                page_size=8, num_pages=7, use_pallas=False,
+                                preempt_policy=pol)
+            for i, p in enumerate(prompts):
+                eng.submit(Request(f"r{i}", p, max_new_tokens=n_new))
+            done = eng.run(max_steps=500)
+            assert eng.preemptions > 0, f"{pol}: no preemption exercised"
+            assert len(eng._free) == 6, f"{pol}: pool not fully recycled"
+            outs[pol] = {r.rid: r.output for r in done}
+            prefills[pol] = eng.prefill_tokens
+        for i, ref in enumerate(refs):
+            assert outs["offload"][f"r{i}"] == ref
+            assert outs["recompute"][f"r{i}"] == ref
+        assert prefills["offload"] == sum(len(p) for p in prompts), \
+            "offload resume must not re-prefill"
+        assert prefills["recompute"] > prefills["offload"]
+
+    def test_offload_int8_restores_scales(self, params):
+        """Quantized pool offload must round-trip pages AND per-token
+        scales; greedy outputs stay identical to the reference."""
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                            page_size=8, use_pallas=False, num_pages=7,
+                            cache_dtype="int8", preempt_policy="offload")
+        refs = {}
+        for i, p in enumerate([[1, 2, 3, 4], [7, 6, 5, 2]]):
+            refs[f"r{i}"] = greedy_reference(params, p, 24)
+            eng.submit(Request(f"r{i}", p, max_new_tokens=24))
+        done = eng.run(max_steps=500)
+        assert eng.preemptions > 0
+        assert len(done) == 2
+        for r in done:
+            assert r.output == refs[r.rid]
+
+    def test_offload_sampled_request_keeps_tokens(self, params):
+        """temperature>0 + offload: resume re-samples nothing; output
+        matches the unpressured engine with the same seed."""
+        prompt = [3, 7, 2, 9]
+        outs = []
+        for num_pages in (None, 7):
+            eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                                page_size=8, num_pages=num_pages,
+                                use_pallas=False, preempt_policy="offload")
+            eng.submit(Request("s", prompt, max_new_tokens=20,
+                               temperature=0.8, top_k=8, seed=123))
+            eng.submit(Request("g", [1, 4, 6, 2], max_new_tokens=20))
+            done = eng.run(max_steps=500)
+            outs.append({r.rid: r.output for r in done})
+        assert outs[0]["g"] == outs[1]["g"]
+        assert outs[0]["s"] == outs[1]["s"]

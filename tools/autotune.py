@@ -54,6 +54,26 @@ CHILD = os.environ.get("PT_TUNE_CHILD") or _DEFAULT_CHILD
 
 TRIAL_TIMEOUT = int(os.environ.get("PT_TUNE_TRIAL_TIMEOUT", "600"))
 
+# circuit breaker: N consecutive tunnel-death-shaped trial failures
+# (timeout or cpu_fallback) abort the search instead of burning
+# TRIAL_TIMEOUT per remaining trial on a dead tunnel. Best-so-far is
+# already persisted on every improvement.
+DEAD_TRIP = int(os.environ.get("PT_TUNE_DEAD_TRIP", "3"))
+_consec_dead = 0
+
+
+class TunnelDead(RuntimeError):
+    pass
+
+
+def _mark_trial(kind):
+    """kind: 'ok' | 'dead' (timeout/cpu_fallback) | 'bad' (config)."""
+    global _consec_dead
+    _consec_dead = _consec_dead + 1 if kind == "dead" else 0
+    if _consec_dead >= DEAD_TRIP:
+        raise TunnelDead(
+            f"{_consec_dead} consecutive timeout/cpu-fallback trials")
+
 
 def _load_defaults():
     import importlib.util
@@ -102,6 +122,7 @@ def run_trial(cfg, trials):
     except subprocess.TimeoutExpired:
         print(f"  trial {cfg} TIMED OUT after {TRIAL_TIMEOUT}s", flush=True)
         trials.append({"cfg": cfg, "result": None, "error": "timeout"})
+        _mark_trial("dead")
         return None
     out = None
     for line in reversed(r.stdout.strip().splitlines()):
@@ -117,12 +138,14 @@ def run_trial(cfg, trials):
         print(f"  trial {cfg} FAILED rc={r.returncode}: {tail}", flush=True)
         trials.append({"cfg": cfg, "result": None,
                        "error": f"rc={r.returncode}"})
+        _mark_trial("bad")
         return None
     if out.get("extra", {}).get("backend") == "cpu":
         # tunnel died mid-search and the bench child fell back to the
         # CPU smoke — a number that must never reach TUNED.json
         print(f"  trial {cfg} INVALID: child fell back to CPU", flush=True)
         trials.append({"cfg": cfg, "result": None, "error": "cpu_fallback"})
+        _mark_trial("dead")
         return None
     if out.get("extra", {}).get("pallas_fallback"):
         # Mosaic rejected this block config and bench.py silently
@@ -132,11 +155,13 @@ def run_trial(cfg, trials):
               flush=True)
         trials.append({"cfg": cfg, "result": None,
                        "error": "pallas_fallback"})
+        _mark_trial("bad")
         return None
     dt = time.perf_counter() - t0
     print(f"  trial {cfg}: {out['value']} tok/s "
           f"(mfu={out['extra']['mfu']}, {dt:.0f}s wall)", flush=True)
     trials.append({"cfg": cfg, "result": out})
+    _mark_trial("ok")
     return out
 
 
@@ -259,8 +284,10 @@ def parallel_comm_cost(cfg, model=PAR_MODEL):
         comm += 4 * L * act * (tp - 1) / tp / V5E_ICI_BPS
     if cfg.get("zero"):
         # ZeRO-3 REPLACES the grad all-reduce: param all-gather fwd +
-        # bwd and grad reduce-scatter, ~3x param wire bytes total
-        comm += 3 * params * (dp - 1) / dp / V5E_ICI_BPS
+        # bwd and grad reduce-scatter, ~3x param wire bytes total —
+        # over the dp shard of THIS rank's tp/pp param slice, same
+        # sharding the dp branch below charges
+        comm += 3 * (params / (tp * pp)) * (dp - 1) / dp / V5E_ICI_BPS
     elif dp > 1:
         comm += 2 * (params / (tp * pp)) * (dp - 1) / dp / V5E_ICI_BPS
     if pp > 1:
@@ -397,41 +424,51 @@ def main():
     # in r2 — only try it at the smallest batch). fused_ce avoids the
     # (B,S,V) logits materialization, so it both speeds the head and
     # frees HBM that may admit configs the plain head OOMs on.
-    print("stage A: batch x remat x fused_ce", flush=True)
-    for batch in (16, 24, 32):
-        for remat in ("true", "dots"):
-            for fce in (False, True):
-                consider({"batch": batch, "seq": seq, "remat": remat,
-                          "fused_ce": fce})
-    for fce in (False, True):
-        consider({"batch": 8, "seq": seq, "remat": "false",
-                  "fused_ce": fce})
-    if best_res is None:
-        print("autotune: every stage-A trial failed; aborting",
-              file=sys.stderr)
-        sys.exit(1)
-    done.append("A")
-    persist(best_cfg, best_res, trials, done)
+    try:
+        print("stage A: batch x remat x fused_ce", flush=True)
+        for batch in (16, 24, 32):
+            for remat in ("true", "dots"):
+                for fce in (False, True):
+                    consider({"batch": batch, "seq": seq, "remat": remat,
+                              "fused_ce": fce})
+        for fce in (False, True):
+            consider({"batch": 8, "seq": seq, "remat": "false",
+                      "fused_ce": fce})
+        if best_res is None:
+            print("autotune: every stage-A trial failed; aborting",
+                  file=sys.stderr)
+            sys.exit(1)
+        done.append("A")
+        persist(best_cfg, best_res, trials, done)
 
-    # stage B: flash block sizes at the winner (must divide seq)
-    print("stage B: flash block_q/block_k", flush=True)
-    a_win = dict(best_cfg)
-    for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 256),
-                   (512, 512)):
-        consider(dict(a_win, block_q=bq, block_k=bk))
-    done.append("B")
-    persist(best_cfg, best_res, trials, done)
+        # stage B: flash block sizes at the winner (must divide seq)
+        print("stage B: flash block_q/block_k", flush=True)
+        a_win = dict(best_cfg)
+        for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 256),
+                       (512, 512)):
+            consider(dict(a_win, block_q=bq, block_k=bk))
+        done.append("B")
+        persist(best_cfg, best_res, trials, done)
 
-    # stage C: gradient accumulation (true grad-accum scan in
-    # make_train_step — trades peak activation memory for a serial loop;
-    # can unlock bigger batch or lighter remat)
-    print("stage C: n_micro grad accumulation", flush=True)
-    b_win = dict(best_cfg)
-    for nm in (2, 4):
-        if b_win["batch"] % nm == 0:
-            consider(dict(b_win, n_micro=nm))
-    done.append("C")
-    persist(best_cfg, best_res, trials, done)
+        # stage C: gradient accumulation (true grad-accum scan in
+        # make_train_step — trades peak activation memory for a serial
+        # loop; can unlock bigger batch or lighter remat)
+        print("stage C: n_micro grad accumulation", flush=True)
+        b_win = dict(best_cfg)
+        for nm in (2, 4):
+            if b_win["batch"] % nm == 0:
+                consider(dict(b_win, n_micro=nm))
+        done.append("C")
+        persist(best_cfg, best_res, trials, done)
+    except TunnelDead as e:
+        print(f"autotune: aborting search — {e}; "
+              f"stages completed: {done or 'none'}", file=sys.stderr)
+        if best_res is None:
+            sys.exit(3)
+        # re-persist so the trials record includes the dead trials that
+        # tripped the breaker — TUNED.json must explain why the search
+        # stopped, not just stderr
+        persist(best_cfg, best_res, trials, list(done))
     print(json.dumps({"best": best_cfg, "tok_s": best_res["value"],
                       "mfu": best_res["extra"]["mfu"]}))
 

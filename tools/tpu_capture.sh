@@ -1,30 +1,78 @@
 #!/bin/bash
 # One-shot on-chip capture: run whenever the v5e tunnel is alive.
-# Order: kernel validation (cheap, highest evidence value) → model
-# benches → remat/batch sweep refinements. Everything appends to
-# BENCH_HISTORY.jsonl / TPU_VALIDATION.json which are committed.
+#
+# r4 reordering: the 2026-07-31 tunnel window lasted ~18 minutes and
+# compiles through this tunnel are MUCH slower than local (kernel
+# validation did not finish one family in 900s). So: bank the headline
+# bench FIRST, then validation, then the ablation, then the long-tail
+# (per-model benches, autotune). Between steps a cheap probe checks the
+# tunnel is still alive and EXITS EARLY otherwise — a dead tunnel must
+# not pin the caller for the summed step timeouts (the watch loop
+# re-fires us on the next window; the persistent compilation cache
+# makes the re-fire skip straight to execution for anything already
+# compiled). Every step appends to BENCH_HISTORY.jsonl /
+# TPU_VALIDATION.json which are committed.
 cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+alive() {
+  timeout 150 python -c \
+    "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null
+}
+
+# skip re-validation when a fresh passing result exists (a re-fired
+# capture after a tunnel drop must spend its window on what's missing)
+SKIP_VALIDATE=0
+python - <<'EOF' && SKIP_VALIDATE=1
+import json, os, sys, time
+st = os.stat("TPU_VALIDATION.json")
+ok = json.load(open("TPU_VALIDATION.json")).get("ok") is True
+sys.exit(0 if (ok and time.time() - st.st_mtime < 6 * 3600) else 1)
+EOF
 set -x
 
-timeout 900 python tools/validate_tpu_kernels.py 2>&1 | tail -12
+# 1. headline: fused linear+CE on, best hand-known knobs (TUNED.json
+#    "best" block is honored automatically when a real search wrote it)
+PT_BENCH_SKIP_VALIDATE=1 PT_FUSED_CE=1 PT_BENCH_TIMEOUT=3300 \
+  timeout 3600 python bench.py 2>&1 | tail -3
+alive || { echo "CAPTURE_ABORT tunnel dead after step 1"; exit 2; }
 
-for m in resnet50 bert moe serving input; do
-  timeout 900 python bench_models.py "$m" 2>&1 | tail -2
+# 2. kernel validation -> TPU_VALIDATION.json (five pallas families)
+if [ "$SKIP_VALIDATE" != 1 ]; then
+  timeout 5400 python tools/validate_tpu_kernels.py 2>&1 | tail -14
+  alive || { echo "CAPTURE_ABORT tunnel dead after step 2"; exit 2; }
+fi
+
+# 3. fused-CE ablation at the same knobs (quantifies the lever)
+PT_BENCH_SKIP_VALIDATE=1 PT_FUSED_CE=0 PT_BENCH_TIMEOUT=3300 \
+  timeout 3600 python bench.py 2>&1 | tail -2
+alive || { echo "CAPTURE_ABORT tunnel dead after step 3"; exit 2; }
+
+# 4. packed-document flashmask: 4 docs per 2048-ctx row — block-skip
+#    converts the blocked cross-doc attention into real tok/s
+PT_BENCH_SKIP_VALIDATE=1 PT_FUSED_CE=1 PT_BENCH_DOCS=4 \
+  PT_BENCH_TIMEOUT=3300 timeout 3600 python bench.py 2>&1 | tail -2
+alive || { echo "CAPTURE_ABORT tunnel dead after step 4"; exit 2; }
+
+# 5. serving throughput on-chip, fp then int8 KV cache
+timeout 1800 python bench_models.py serving 2>&1 | tail -2
+PT_SERVE_CACHE=int8 timeout 1800 python bench_models.py serving 2>&1 | tail -2
+alive || { echo "CAPTURE_ABORT tunnel dead after step 5"; exit 2; }
+
+# 6. remaining per-model benches
+for m in resnet50 bert moe input; do
+  timeout 1800 python bench_models.py "$m" 2>&1 | tail -2
+  alive || { echo "CAPTURE_ABORT tunnel dead during step 6 ($m)"; exit 2; }
 done
 
-# autotune: search batch/remat/flash-block space, persist winner to
-# TUNED.json (bench.py picks it up as its defaults)
-timeout 7200 python tools/autotune.py 2>&1 | tail -8
+# 7. autotune: batch/remat/fused-CE/block/n_micro search, persists the
+#    winner to TUNED.json (bench.py picks it up as its defaults).
+#    Trial timeout sized for slow tunnel compiles; the search
+#    checkpoints every improvement, so a mid-search death keeps the
+#    best-so-far.
+PT_TUNE_TRIAL_TIMEOUT=2700 timeout 14400 python tools/autotune.py 2>&1 | tail -8
 
-# final driver-comparable headline at the tuned defaults (validation
-# already ran above — skip the redundant pre-step)
-PT_BENCH_SKIP_VALIDATE=1 timeout 1800 python bench.py 2>&1 | tail -1
-
-# packed-document flashmask: 4 docs per 2048-ctx row — block-skip
-# should convert the blocked cross-doc attention into real tok/s
-PT_BENCH_SKIP_VALIDATE=1 PT_BENCH_DOCS=4 timeout 1200 python bench.py 2>&1 | tail -1
-
-# serving throughput on-chip (VERDICT r2 item 8), fp and int8 KV cache
-timeout 900 python bench_models.py serving 2>&1 | tail -2
-PT_SERVE_CACHE=int8 timeout 900 python bench_models.py serving 2>&1 | tail -2
+# 8. final headline at the tuned defaults
+alive && PT_BENCH_SKIP_VALIDATE=1 timeout 3600 python bench.py 2>&1 | tail -1
 echo "CAPTURE_DONE"
