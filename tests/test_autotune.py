@@ -62,10 +62,10 @@ def test_full_search_finds_planted_peak(tmp_path):
 def test_dedup_skips_equivalent_configs(tmp_path):
     r, data = run_tuner(tmp_path)
     assert r.returncode == 0
-    # stage A: 14 trials (3 batches x 2 remat x 2 fused_ce + 2 probes);
+    # stage A: 12 trials (promise-ordered batch x remat x fused_ce list);
     # stage B: 5 configs but (128,128) == the stage-A winner's
     # effective knobs -> 4 measured; stage C: 2.
-    assert data["n_trials"] == 20
+    assert data["n_trials"] == 18
     cfgs = [json.dumps(t["cfg"], sort_keys=True) for t in data["trials"]]
     assert len(set(cfgs)) == len(cfgs), "a config was measured twice"
 
@@ -73,7 +73,7 @@ def test_dedup_skips_equivalent_configs(tmp_path):
 def test_cpu_fallback_trips_dead_tunnel_breaker(tmp_path):
     # every child answers backend:"cpu" -> tunnel-death-shaped failures
     # -> the circuit breaker must abort the search after DEAD_TRIP (3)
-    # consecutive trials instead of burning TRIAL_TIMEOUT on all 14,
+    # consecutive trials instead of burning TRIAL_TIMEOUT on all 12,
     # with a non-zero exit and no winner written
     r, data = run_tuner(tmp_path, fault="cpu")
     assert r.returncode != 0

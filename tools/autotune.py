@@ -420,20 +420,36 @@ def main():
             # a mid-stage tunnel death must not lose the search
             persist(best_cfg, best_res, trials, list(done))
 
-    # stage A: batch x remat x fused_ce (remat=False OOM'd at batch 16
-    # in r2 — only try it at the smallest batch). fused_ce avoids the
+    # stage A: batch x remat x fused_ce, ordered by expected win so a
+    # short tunnel window still measures the promising region first.
+    # Full remat charges ~33% extra matmul FLOPs; "dots" (save matmul
+    # outputs, recompute elementwise only) erases most of that but its
+    # saved activations (~0.7 GB per batch row at seq 2048 on the
+    # headline model) only fit HBM at small batch next to ~7 GB of
+    # params+opt — so the likely-to-fit dots candidates (batch 8-16) go
+    # first, the long-shot ones (24/32, expected OOM but cheap to let
+    # the guarded child prove it) go last, and remat=false runs only at
+    # 8 (16 OOM'd in r2). fused_ce avoids the
     # (B,S,V) logits materialization, so it both speeds the head and
     # frees HBM that may admit configs the plain head OOMs on.
     try:
         print("stage A: batch x remat x fused_ce", flush=True)
-        for batch in (16, 24, 32):
-            for remat in ("true", "dots"):
-                for fce in (False, True):
-                    consider({"batch": batch, "seq": seq, "remat": remat,
-                              "fused_ce": fce})
-        for fce in (False, True):
-            consider({"batch": 8, "seq": seq, "remat": "false",
-                      "fused_ce": fce})
+        stage_a = [
+            {"batch": 16, "remat": "true", "fused_ce": True},   # warm anchor
+            {"batch": 8, "remat": "dots", "fused_ce": True},    # predicted win
+            {"batch": 16, "remat": "dots", "fused_ce": True},
+            {"batch": 12, "remat": "dots", "fused_ce": True},
+            {"batch": 8, "remat": "false", "fused_ce": True},
+            {"batch": 24, "remat": "true", "fused_ce": True},
+            {"batch": 32, "remat": "true", "fused_ce": True},
+            {"batch": 16, "remat": "true", "fused_ce": False},
+            {"batch": 8, "remat": "dots", "fused_ce": False},
+            {"batch": 24, "remat": "dots", "fused_ce": True},
+            {"batch": 32, "remat": "dots", "fused_ce": True},
+            {"batch": 8, "remat": "false", "fused_ce": False},
+        ]
+        for cfg in stage_a:
+            consider(dict(cfg, seq=seq))
         if best_res is None:
             print("autotune: every stage-A trial failed; aborting",
                   file=sys.stderr)

@@ -22,6 +22,24 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 RESULTS = []
+OUT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "TPU_VALIDATION.json")
+
+
+def _write(final_ok=None):
+    """Progressive banking: a tunnel death mid-suite must still leave the
+    families already proven on disk. ok stays false until the full suite
+    passes (the watch loop / bench skip-logic key on ok:true)."""
+    out = {"device": DEVICE[0], "ok": bool(final_ok),
+           "complete": final_ok is not None, "results": RESULTS}
+    tmp = OUT_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, OUT_PATH)
+
+
+DEVICE = ["unknown"]
 
 
 def check(name, fn):
@@ -35,6 +53,7 @@ def check(name, fn):
     dt = time.perf_counter() - t0
     RESULTS.append({"kernel": name, "ok": ok, "detail": detail,
                     "seconds": round(dt, 2)})
+    _write()
     print(f"[{'PASS' if ok else 'FAIL'}] {name} ({dt:.1f}s): {detail}",
           flush=True)
     return ok
@@ -280,6 +299,7 @@ def main():
     import jax
     dev = jax.devices()[0]
     assert dev.platform != "cpu", f"not on TPU: {dev}"
+    DEVICE[0] = str(dev)
     print(f"validating on {dev} (jax {jax.__version__})", flush=True)
     ok = True
     ok &= check("flash_attention fwd+bwd", flash_fwd_bwd)
@@ -287,10 +307,7 @@ def main():
     ok &= check("paged_attention decode", paged_decode)
     ok &= check("flashmask fwd+bwd", flashmask_fwd_bwd)
     ok &= check("flash bf16 4k-ctx", flash_bf16_long)
-    out = {"device": str(dev), "ok": bool(ok), "results": RESULTS}
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    with open(os.path.join(here, "TPU_VALIDATION.json"), "w") as f:
-        json.dump(out, f, indent=1)
+    _write(final_ok=ok)
     print(json.dumps({"ok": bool(ok)}))
     sys.exit(0 if ok else 1)
 
