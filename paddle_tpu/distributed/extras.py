@@ -353,10 +353,11 @@ def to_distributed(model, optimizer, dataloader, device_num=None,
 
 
 # ------------------------------------------------------ PS-era data configs
-_PS_MSG = ("parameter-server data pipelines are out of TPU scope (see "
-           "distributed/ps.py and README): shard embedding tables over the "
-           "mesh (VocabParallelEmbedding / MoE all_to_all) and feed with "
-           "paddle_tpu.io.DataLoader instead")
+_PS_MSG = ("the PS streaming dataset pipeline is out of TPU scope: feed "
+           "with paddle_tpu.io.DataLoader instead. (PS *tables* are "
+           "supported — host-RAM sparse embeddings via distributed/ps "
+           "SparseTable/DistributedEmbedding; dense params train on the "
+           "mesh: VocabParallelEmbedding / MoE all_to_all)")
 
 
 class QueueDataset:

@@ -350,10 +350,11 @@ class UtilBase:
             print(message)
 
 
-_PS_DATAGEN_MSG = ("MultiSlot*DataGenerator feeds the parameter-server "
-                   "dataset pipeline — out of TPU scope (see "
-                   "distributed/ps.py); pack samples with io.DataLoader / "
-                   "io/native.py instead")
+_PS_DATAGEN_MSG = ("MultiSlot*DataGenerator feeds the PS streaming dataset "
+                   "pipeline — out of TPU scope; pack samples with "
+                   "io.DataLoader / io/native.py instead (PS sparse tables "
+                   "themselves ARE supported: distributed/ps "
+                   "SparseTable/DistributedEmbedding)")
 
 
 class MultiSlotDataGenerator:

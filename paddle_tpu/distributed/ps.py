@@ -1,49 +1,36 @@
-"""Parameter-server mode — explicit out-of-scope facade.
+"""Parameter-server mode (reference: python/paddle/distributed/ps/
+the_one_ps.py TheOnePSRuntime — CPU parameter servers + trainer workers
+exchanging sparse/dense grads over BRPC).
 
-Reference: python/paddle/distributed/ps/the_one_ps.py (TheOnePSRuntime:
-CPU parameter servers + trainer workers exchanging sparse/dense grads
-over DCN/BRPC).
+TPU-native split of that architecture (see ps_impl.py for the full
+design notes):
 
-Design decision (documented, not a TODO): the PS architecture exists to
-scale *sparse* embedding tables beyond worker memory on commodity
-ethernet. On a TPU pod the same workloads are served by the SPMD path —
-embedding tables sharded over the mesh with XLA all-to-all on ICI (see
-parallel/tp.py VocabParallelEmbedding and parallel/moe.py), which is
-both faster and simpler than an external server tier; DCN-attached
-python parameter servers would bottleneck a pod. Every entry point here
-raises with that guidance rather than pretending to run.
+* DENSE parameters never use a server tier — they train on the SPMD
+  path (mesh-sharded, XLA collectives over ICI), which is faster and
+  simpler than external servers on a pod. fleet.init(is_collective=True)
+  + mesh sharding is the recommended path for everything that fits HBM.
+* SPARSE host-RAM tables (rec-sys embeddings beyond collective HBM) are
+  the one PS job the mesh cannot do, and that part is implemented:
+  sharded SparseTable servers with per-row sgd/adagrad/adam, TCP
+  pull/push, and a DistributedEmbedding worker layer that feeds pulled
+  rows through a jitted step and pushes the row-gradient back.
 """
-from __future__ import annotations
+from paddle_tpu.distributed.ps_impl import (  # noqa: F401
+    DistributedEmbedding,
+    EmbeddingPSServer,
+    PSClient,
+    SparseTable,
+    TheOnePSRuntime,
+    init_server,
+    init_worker,
+    run_server,
+    shard_of,
+    sparse_embedding_step,
+    stop_worker,
+)
 
-_MSG = ("parameter-server mode is not part of the TPU execution model: "
-        "sparse/giant embedding tables are sharded over the device mesh "
-        "(VocabParallelEmbedding / fleet sharding) with XLA collectives "
-        "over ICI instead of an external server tier. Use "
-        "fleet.init(is_collective=True) and mesh sharding; see "
-        "docs/distributed.md.")
-
-
-class TheOnePSRuntime:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(_MSG)
-
-
-class PsProgramBuilder:
-    def __init__(self, *a, **k):
-        raise NotImplementedError(_MSG)
-
-
-def init_server(*a, **k):
-    raise NotImplementedError(_MSG)
-
-
-def init_worker(*a, **k):
-    raise NotImplementedError(_MSG)
-
-
-def run_server(*a, **k):
-    raise NotImplementedError(_MSG)
-
-
-def stop_worker(*a, **k):
-    raise NotImplementedError(_MSG)
+__all__ = [
+    "DistributedEmbedding", "EmbeddingPSServer", "PSClient", "SparseTable",
+    "TheOnePSRuntime", "init_server", "init_worker", "run_server",
+    "shard_of", "sparse_embedding_step", "stop_worker",
+]

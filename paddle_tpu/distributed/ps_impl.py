@@ -1,0 +1,493 @@
+"""Parameter-server runtime, TPU-native re-design.
+
+Reference: python/paddle/distributed/ps/the_one_ps.py (TheOnePSRuntime)
++ paddle/fluid/distributed/ps/service/brpc_ps_server.cc — CPU parameter
+servers holding sparse embedding tables, trainer workers pulling rows
+and pushing per-row gradients over RPC, servers applying per-row
+optimizer rules (async SGD family).
+
+TPU-native re-design (NOT a port of the BRPC stack):
+
+* Dense parameters never leave the device mesh — they train on the SPMD
+  path (VocabParallelEmbedding / fleet sharding over ICI). The PS tier
+  exists for ONE job the mesh cannot do: sparse tables larger than
+  collective HBM (rec-sys embeddings, 100 GB+). Those rows live in host
+  RAM, sharded by id across server processes.
+* The worker step is the host/device split jax makes natural: unique
+  the batch ids on host, PULL rows, feed them to the jitted step as a
+  plain input, take the row-gradient OUT of the step as a plain output,
+  PUSH it back. No side effects inside jit, no custom_vjp tricks — the
+  pulled rows are just another (trainable) input, so the same step
+  compiles once and reruns for any id set of the same unique-count.
+* Transport: length-prefixed binary over TCP sockets (threaded server,
+  one shard lock per table — concurrent workers give the reference's
+  async-SGD semantics). In-process shards (no sockets) are the default
+  when no endpoints are configured: single-host training and tests run
+  the identical table/optimizer code without the network.
+
+Per-row optimizer rules: sgd, adagrad, adam (per-row state, lazily
+materialized rows with deterministic seeded init so any server
+restart / re-shard reproduces untouched rows).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import struct
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+__all__ = [
+    "SparseTable", "PSClient", "EmbeddingPSServer", "DistributedEmbedding",
+    "sparse_embedding_step", "init_server", "run_server", "init_worker",
+    "stop_worker", "TheOnePSRuntime", "shard_of",
+]
+
+
+def shard_of(ids, n_shards):
+    """Server shard owning each id (stable modulo placement)."""
+    return np.asarray(ids) % n_shards
+
+
+# ---------------------------------------------------------------------------
+# server-side sparse table (one shard)
+# ---------------------------------------------------------------------------
+
+
+class SparseTable:
+    """One shard of a host-RAM embedding table with per-row optimizer.
+
+    Rows materialize on first pull (reference sparse tables are keyed
+    hash tables, not dense arrays): id -> slot index into growing numpy
+    arrays. Unseen rows are initialized deterministically from
+    (seed, id) so restarts and re-shards reproduce them exactly.
+    """
+
+    GROW = 1024
+
+    def __init__(self, dim, optimizer="adagrad", lr=0.01, seed=0,
+                 init_scale=0.01, beta1=0.9, beta2=0.999, eps=1e-8):
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unknown sparse optimizer: {optimizer!r}")
+        self.lr, self.seed, self.init_scale = float(lr), int(seed), init_scale
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self._slot = {}                       # id -> row index
+        self._rows = np.empty((0, dim), np.float32)
+        self._state = {}                      # name -> per-row state array
+        self._steps = np.empty((0,), np.int64)  # adam bias-correction t
+        self._lock = threading.Lock()
+        if optimizer == "adagrad":
+            self._state["g2"] = np.empty((0, dim), np.float32)
+        elif optimizer == "adam":
+            self._state["m"] = np.empty((0, dim), np.float32)
+            self._state["v"] = np.empty((0, dim), np.float32)
+
+    def __len__(self):
+        return len(self._slot)
+
+    def _init_row(self, id_):
+        rng = np.random.RandomState((self.seed * 0x9E3779B1 + id_)
+                                    & 0x7FFFFFFF)
+        return (rng.randn(self.dim) * self.init_scale).astype(np.float32)
+
+    def _ensure(self, ids):
+        """Slot indices for ids, materializing unseen rows. Lock held."""
+        new = [i for i in ids if i not in self._slot]
+        if new:
+            n0, n1 = len(self._slot), len(self._slot) + len(new)
+            if n1 > len(self._rows):
+                cap = max(n1, len(self._rows) + self.GROW)
+                self._rows = np.resize(self._rows, (cap, self.dim))
+                for k in self._state:
+                    st = np.resize(self._state[k], (cap, self.dim))
+                    st[n0:] = 0.0
+                    self._state[k] = st
+                self._steps = np.resize(self._steps, (cap,))
+                self._steps[n0:] = 0
+            for j, id_ in enumerate(new):
+                self._slot[id_] = n0 + j
+                self._rows[n0 + j] = self._init_row(id_)
+                for k in self._state:
+                    self._state[k][n0 + j] = 0.0
+                self._steps[n0 + j] = 0
+        return np.fromiter((self._slot[i] for i in ids), np.int64,
+                           count=len(ids))
+
+    def pull(self, ids):
+        """rows (n, dim) for int64 ids (duplicates allowed)."""
+        ids = np.asarray(ids, np.int64)
+        with self._lock:
+            idx = self._ensure(ids.tolist())
+            return self._rows[idx].copy()
+
+    def push(self, ids, grads):
+        """Apply per-row rule to summed-by-id gradients (scatter-add:
+        duplicate ids in one push contribute once at their summed
+        gradient, matching dense embedding backward semantics)."""
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        if grads.shape != (len(ids), self.dim):
+            raise ValueError(f"push shape {grads.shape} != "
+                             f"({len(ids)}, {self.dim})")
+        uniq, inv = np.unique(ids, return_inverse=True)
+        g = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(g, inv, grads)
+        with self._lock:
+            idx = self._ensure(uniq.tolist())
+            if self.optimizer == "sgd":
+                self._rows[idx] -= self.lr * g
+            elif self.optimizer == "adagrad":
+                g2 = self._state["g2"]
+                g2[idx] += g * g
+                self._rows[idx] -= self.lr * g / (np.sqrt(g2[idx]) + self.eps)
+            else:  # adam
+                self._steps[idx] += 1
+                t = self._steps[idx][:, None].astype(np.float32)
+                m, v = self._state["m"], self._state["v"]
+                m[idx] = self.beta1 * m[idx] + (1 - self.beta1) * g
+                v[idx] = self.beta2 * v[idx] + (1 - self.beta2) * g * g
+                mhat = m[idx] / (1 - self.beta1 ** t)
+                vhat = v[idx] / (1 - self.beta2 ** t)
+                self._rows[idx] -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+    def state_dict(self):
+        with self._lock:
+            ids = np.fromiter(self._slot.keys(), np.int64, len(self._slot))
+            idx = np.fromiter(self._slot.values(), np.int64, len(self._slot))
+            out = {"ids": ids, "rows": self._rows[idx].copy(),
+                   "steps": self._steps[idx].copy()}
+            for k, st in self._state.items():
+                out[k] = st[idx].copy()
+            return out
+
+    def load_state_dict(self, d):
+        with self._lock:
+            idx = self._ensure([int(i) for i in d["ids"]])
+            self._rows[idx] = d["rows"]
+            self._steps[idx] = d.get("steps", 0)
+            for k in self._state:
+                self._state[k][idx] = d[k]
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: | op u8 | table u16 | n u32 | dim u32 | ids | f32 payload |
+# ---------------------------------------------------------------------------
+
+_HDR = struct.Struct("<BHII")
+_OP_PULL, _OP_PUSH, _OP_LEN, _OP_STOP = 1, 2, 3, 4
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_msg(sock, op, table, ids=None, payload=None):
+    ids = np.asarray(ids if ids is not None else [], np.int64)
+    pay = np.asarray(payload if payload is not None else [], np.float32)
+    dim = pay.shape[1] if pay.ndim == 2 else 0
+    body = ids.tobytes() + pay.tobytes()
+    sock.sendall(_HDR.pack(op, table, len(ids), dim)
+                 + struct.pack("<I", len(body)) + body)
+
+
+def _recv_msg(sock):
+    op, table, n, dim = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    (blen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    body = _recv_exact(sock, blen)
+    ids = np.frombuffer(body[:8 * n], np.int64)
+    pay = np.frombuffer(body[8 * n:], np.float32)
+    if dim:
+        pay = pay.reshape(-1, dim)
+    return op, table, ids, pay
+
+
+class _PSHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server = self.server.ps            # EmbeddingPSServer
+        sock = self.request
+        try:
+            while True:
+                op, table, ids, pay = _recv_msg(sock)
+                if op == _OP_PULL:
+                    rows = server.tables[table].pull(ids)
+                    _send_msg(sock, _OP_PULL, table, payload=rows)
+                elif op == _OP_PUSH:
+                    server.tables[table].push(ids, pay)
+                    _send_msg(sock, _OP_PUSH, table)
+                elif op == _OP_LEN:
+                    n = len(server.tables[table])
+                    _send_msg(sock, _OP_LEN, table,
+                              ids=np.asarray([n], np.int64))
+                elif op == _OP_STOP:
+                    _send_msg(sock, _OP_STOP, table)
+                    self.server.shutdown_requested = True
+                    # shutdown() must come from another thread
+                    threading.Thread(target=self.server.shutdown,
+                                     daemon=True).start()
+                    return
+        except (ConnectionError, OSError):
+            return
+
+
+class EmbeddingPSServer:
+    """One PS process: owns the local shard of every sparse table and
+    serves PULL/PUSH over TCP (threaded; SparseTable locks make
+    concurrent worker pushes the reference's async-SGD)."""
+
+    def __init__(self, tables, host="127.0.0.1", port=0):
+        self.tables = list(tables)
+        srv = socketserver.ThreadingTCPServer((host, port), _PSHandler,
+                                              bind_and_activate=False)
+        srv.daemon_threads = True
+        srv.allow_reuse_address = True
+        srv.server_bind()
+        srv.server_activate()
+        srv.ps = self
+        srv.shutdown_requested = False
+        self._srv = srv
+        self.endpoint = "%s:%d" % srv.server_address
+
+    def serve_forever(self):
+        self._srv.serve_forever(poll_interval=0.05)
+
+    def serve_in_thread(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class _RemoteShard:
+    """Client-side stub with the SparseTable pull/push surface."""
+
+    def __init__(self, endpoint, table_id):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._table = table_id
+        self._lock = threading.Lock()
+
+    def _rpc(self, op, ids=None, payload=None):
+        with self._lock:
+            _send_msg(self._sock, op, self._table, ids, payload)
+            return _recv_msg(self._sock)
+
+    def pull(self, ids):
+        _, _, _, rows = self._rpc(_OP_PULL, ids=ids)
+        return rows
+
+    def push(self, ids, grads):
+        self._rpc(_OP_PUSH, ids=ids, payload=grads)
+
+    def __len__(self):
+        _, _, ids, _ = self._rpc(_OP_LEN)
+        return int(ids[0])
+
+    def stop_server(self):
+        try:
+            self._rpc(_OP_STOP)
+        except ConnectionError:
+            pass
+
+    def close(self):
+        self._sock.close()
+
+
+class PSClient:
+    """Worker-side view of one sharded table: routes pull/push by
+    id % n_shards and reassembles rows in request order.
+
+    shards: list of SparseTable (in-process) or _RemoteShard stubs —
+    the routing math is identical, so single-host training and tests
+    exercise the same code the socket deployment runs.
+    """
+
+    def __init__(self, shards):
+        self.shards = list(shards)
+        # shard RPCs are independent — issue them concurrently so a
+        # lookup pays one network round trip, not n_shards serialized
+        # ones (each _RemoteShard already serializes on its own socket)
+        self._pool = (ThreadPoolExecutor(max_workers=len(self.shards))
+                      if len(self.shards) > 1 else None)
+
+    @property
+    def n_shards(self):
+        return len(self.shards)
+
+    def _fanout(self, fn, per_shard):
+        """[(s, args)] -> {s: fn(shard_s, *args)}, concurrently."""
+        if self._pool is None:
+            return {s: fn(self.shards[s], *a) for s, a in per_shard}
+        futs = {s: self._pool.submit(fn, self.shards[s], *a)
+                for s, a in per_shard}
+        return {s: f.result() for s, f in futs.items()}
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).ravel()
+        owner = shard_of(ids, self.n_shards)
+        # global ids go to the shard unchanged (tables are keyed hash
+        # maps): row init stays a function of (seed, global id) alone,
+        # so re-sharding to a different server count reproduces every
+        # untouched row
+        sels = {s: np.nonzero(owner == s)[0] for s in range(self.n_shards)}
+        got = self._fanout(lambda sh, sel: sh.pull(ids[sel]),
+                           [(s, (sel,)) for s, sel in sels.items()
+                            if len(sel)])
+        rows = None
+        for s, g in got.items():
+            if rows is None:
+                rows = np.empty((len(ids), g.shape[1]), np.float32)
+            rows[sels[s]] = g
+        return rows if rows is not None else np.empty((0, 0), np.float32)
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads, np.float32)
+        owner = shard_of(ids, self.n_shards)
+        self._fanout(
+            lambda sh, sel: sh.push(ids[sel], grads[sel]),
+            [(s, (np.nonzero(owner == s)[0],)) for s in range(self.n_shards)
+             if np.any(owner == s)])
+
+    def __len__(self):
+        return sum(len(s) for s in self.shards)
+
+
+# ---------------------------------------------------------------------------
+# worker-side layer + step wrapper
+# ---------------------------------------------------------------------------
+
+
+class DistributedEmbedding:
+    """Host-RAM sparse embedding fronting a jitted device step.
+
+    lookup(ids) uniques the batch ids, PULLs rows once per unique id,
+    and returns (unique_rows, inverse) — feed both to the jitted step,
+    gather rows[inverse] INSIDE jit (cheap device gather), and return
+    the grad wrt unique_rows as a step output for apply_grads().
+
+    reference: paddle.distributed.ps DistributedEmbedding /
+    paddle.static.nn.sparse_embedding (the_one_ps.py pull/push flow).
+    """
+
+    def __init__(self, client, dim):
+        self.client = client
+        self.dim = dim
+
+    def lookup(self, ids):
+        ids = np.asarray(ids, np.int64)
+        uniq, inv = np.unique(ids.ravel(), return_inverse=True)
+        rows = self.client.pull(uniq)
+        return rows, inv.reshape(ids.shape).astype(np.int32), uniq
+
+    def apply_grads(self, uniq, grad_rows):
+        self.client.push(uniq, np.asarray(grad_rows, np.float32))
+
+
+def sparse_embedding_step(loss_fn):
+    """Wrap loss_fn(rows_gathered, *args) -> loss into a step taking
+    (unique_rows, inverse, *args) and returning (loss, grad_unique_rows)
+    — the pieces DistributedEmbedding needs around a jitted call. The
+    returned fn is jit-compatible (inverse is a static-shape int array).
+    """
+    import jax
+
+    def step(rows, inv, *args):
+        def f(r):
+            return loss_fn(r[inv], *args)
+        loss, g = jax.value_and_grad(f)(rows)
+        return loss, g
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# role runtime (API parity: paddle.distributed.fleet PS entry points)
+# ---------------------------------------------------------------------------
+
+_runtime = {}
+
+
+def _endpoints():
+    eps = os.environ.get("PT_PS_ENDPOINTS", "")
+    return [e for e in eps.split(",") if e]
+
+
+def init_server(tables=None, port=None, host=None):
+    """Start this process's PS shard. tables: list of SparseTable (or
+    (dim, optimizer, lr) tuples); host/port: bind address (default:
+    parsed from PT_PS_ENDPOINTS[PT_PS_RANK], else loopback+ephemeral).
+
+    Workers on OTHER hosts must be able to reach the advertised
+    endpoint, so when one is configured the server binds all interfaces
+    (the endpoint's host names how clients dial in, not necessarily a
+    local interface name — e.g. a load-balanced DNS name)."""
+    tabs = []
+    for t in (tables or [SparseTable(8)]):
+        tabs.append(t if isinstance(t, SparseTable) else SparseTable(*t))
+    if port is None:
+        eps, rank = _endpoints(), int(os.environ.get("PT_PS_RANK", "0"))
+        port = int(eps[rank].rsplit(":", 1)[1]) if eps else 0
+        if host is None and eps:
+            host = "0.0.0.0"
+    srv = EmbeddingPSServer(tabs, host=host or "127.0.0.1", port=port)
+    _runtime["server"] = srv
+    return srv
+
+
+def run_server():
+    """Blocking serve loop (reference: fleet.run_server)."""
+    srv = _runtime.get("server") or init_server()
+    srv.serve_forever()
+
+
+def init_worker(n_tables=1):
+    """Connect to every endpoint in PT_PS_ENDPOINTS; returns one
+    PSClient per table (a single client when n_tables == 1)."""
+    eps = _endpoints()
+    if not eps:
+        raise RuntimeError(
+            "init_worker: PT_PS_ENDPOINTS is empty. For single-process "
+            "training build PSClient([SparseTable(...)]) directly — the "
+            "socket tier is only for multi-process host-RAM tables.")
+    clients = [PSClient([_RemoteShard(e, t) for e in eps])
+               for t in range(n_tables)]
+    _runtime["clients"] = clients
+    return clients[0] if n_tables == 1 else clients
+
+
+def stop_worker(stop_servers=False):
+    for c in _runtime.pop("clients", []):
+        for s in c.shards:
+            if stop_servers:
+                s.stop_server()
+            s.close()
+
+
+class TheOnePSRuntime:
+    """Role wrapper (reference: the_one_ps.TheOnePSRuntime): PT_PS_ROLE
+    in {server, worker} picks the entry point."""
+
+    def __init__(self, tables=None):
+        self.tables = tables
+        self.role = os.environ.get("PT_PS_ROLE", "worker")
+
+    def run(self):
+        if self.role == "server":
+            init_server(self.tables)
+            run_server()
+            return None
+        return init_worker()
