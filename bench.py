@@ -243,6 +243,16 @@ def main():
         params, opt, loss = step(params, opt, jnp.asarray(0), data)
         jax.block_until_ready(loss)
     except Exception as e:
+        # HBM OOM is a CONFIG failure, not a pallas failure: retrying
+        # with the XLA attention path would recompile, OOM again, and
+        # burn a tunnel window for nothing. Die fast so autotune marks
+        # the trial and moves on.
+        msg = str(e)
+        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg \
+                or "out of memory" in msg:
+            print(f"# config OOM ({type(e).__name__}): "
+                  + msg.splitlines()[0][:200], file=sys.stderr)
+            sys.exit(7)
         print(f"# pallas path failed ({type(e).__name__}); "
               "retrying with PT_DISABLE_PALLAS=1", file=sys.stderr)
         pallas_fallback = True
