@@ -246,10 +246,14 @@ def main():
         # HBM OOM is a CONFIG failure, not a pallas failure: retrying
         # with the XLA attention path would recompile, OOM again, and
         # burn a tunnel window for nothing. Die fast so autotune marks
-        # the trial and moves on.
+        # the trial and moves on. Scoped-VMEM / Mosaic exhaustion is
+        # different — that IS a pallas block-config failure and the XLA
+        # fallback below would succeed, so let it through.
         msg = str(e)
-        if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg \
-                or "out of memory" in msg:
+        low = msg.lower()
+        oom = "resource_exhausted" in low or "out of memory" in low
+        vmem = "vmem" in low or "mosaic" in low or "scoped" in low
+        if oom and not vmem:
             print(f"# config OOM ({type(e).__name__}): "
                   + msg.splitlines()[0][:200], file=sys.stderr)
             sys.exit(7)

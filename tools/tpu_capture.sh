@@ -17,15 +17,28 @@ export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 
 alive() {
-  timeout 150 python -c \
-    "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null
+  # device init alone is NOT enough: the 2026-07-31 window died
+  # "half-alive" — devices listed fine while the remote_compile service
+  # refused connections, burning 1800s per compile attempt. Probe with
+  # a tiny compile + execute, with the persistent disk cache DISABLED
+  # for the probe process so a cache hit can never mask a dead compile
+  # service.
+  env -u JAX_COMPILATION_CACHE_DIR timeout 300 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+x = jnp.ones((2, 1024), jnp.int32)
+assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096
+" 2>/dev/null
 }
+alive || { echo "CAPTURE_ABORT tunnel half-alive (compile canary failed)"; exit 2; }
 
 # skip re-validation when a fresh passing result exists (a re-fired
 # capture after a tunnel drop must spend its window on what's missing)
 SKIP_VALIDATE=0
 python - <<'EOF' && SKIP_VALIDATE=1
 import json, os, sys, time
+if not os.path.exists("TPU_VALIDATION.json"):
+    sys.exit(1)
 st = os.stat("TPU_VALIDATION.json")
 ok = json.load(open("TPU_VALIDATION.json")).get("ok") is True
 sys.exit(0 if (ok and time.time() - st.st_mtime < 6 * 3600) else 1)
@@ -57,6 +70,7 @@ alive || { echo "CAPTURE_ABORT tunnel dead after step 4"; exit 2; }
 
 # 5. serving throughput on-chip, fp then int8 KV cache
 timeout 1800 python bench_models.py serving 2>&1 | tail -2
+alive || { echo "CAPTURE_ABORT tunnel dead mid step 5"; exit 2; }
 PT_SERVE_CACHE=int8 timeout 1800 python bench_models.py serving 2>&1 | tail -2
 alive || { echo "CAPTURE_ABORT tunnel dead after step 5"; exit 2; }
 
