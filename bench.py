@@ -257,6 +257,14 @@ def main():
             print(f"# config OOM ({type(e).__name__}): "
                   + msg.splitlines()[0][:200], file=sys.stderr)
             sys.exit(7)
+        if os.environ.get("PT_BENCH_NO_FALLBACK") == "1":
+            # autotune trials: a pallas-rejected number would be
+            # discarded as pallas_fallback anyway — skip the expensive
+            # XLA recompile and fail the trial immediately
+            print(f"# pallas path failed ({type(e).__name__}) and "
+                  "PT_BENCH_NO_FALLBACK=1; failing trial without XLA "
+                  "retry: " + msg.splitlines()[0][:200], file=sys.stderr)
+            sys.exit(8)
         print(f"# pallas path failed ({type(e).__name__}); "
               "retrying with PT_DISABLE_PALLAS=1", file=sys.stderr)
         pallas_fallback = True

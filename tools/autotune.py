@@ -104,6 +104,9 @@ def run_trial(cfg, trials):
     # stale TUNED.json inside the bench child, mislabeling the trial
     env = dict(os.environ,
                _PT_BENCH_GUARDED="1",  # we are the watchdog
+               # a pallas-fallback number would be discarded below —
+               # don't let the child burn trial time on the XLA retry
+               PT_BENCH_NO_FALLBACK="1",
                PT_BENCH_SKIP_VALIDATE="1",
                PT_BENCH_BATCH=str(cfg["batch"]),
                PT_BENCH_SEQ=str(cfg["seq"]),

@@ -2,10 +2,14 @@
 # Poll the TPU tunnel; every time it comes alive, run the on-chip
 # capture suite (tools/tpu_capture.sh). r4: windows are SHORT (~18 min
 # observed), so the loop keeps watching after a capture attempt and
-# re-fires on the next window until the round's key artifacts exist:
+# re-fires on the next window until ALL the round's key artifacts exist:
 #   - TPU_VALIDATION.json with ok:true
 #   - a TPU (non-cpu) llama entry in BENCH_HISTORY.jsonl newer than
 #     this script's start
+#   - a real (non-smoke) TUNED.json from an on-chip autotune search —
+#     without this gate a window that banks validation+bench then dies
+#     before step 7 would retire the watch with the strict-MFU search
+#     never run
 # The JAX persistent compilation cache makes re-fired captures skip
 # straight to execution for anything already compiled in a previous
 # window.
@@ -35,12 +39,36 @@ try:
             bench = True
 except Exception:
     pass
-sys.exit(0 if (ok and bench) else 1)
+tuned = False
+try:
+    t = json.load(open("TUNED.json"))
+    # fresh (this watch run, not a committed file from a previous
+    # round) AND the full A/B/C search finished — a mid-search tunnel
+    # death persists best-so-far with partial stages, and later windows
+    # should finish the job
+    tuned = (not t.get("smoke")) and "C" in t.get("stages_done", []) \
+        and t.get("ts", 0) >= start
+except Exception:
+    pass
+sys.exit(0 if (ok and bench and tuned) else 1)
 EOF
 }
 
+probe() {
+  # device init + uncached tiny compile: a half-alive tunnel (devices
+  # list fine, remote_compile refusing — observed 2026-07-31) must read
+  # as DOWN here, so capture never launches into a window where every
+  # compile burns ~1800s. Disk cache disabled so a hit can't mask it.
+  env -u JAX_COMPILATION_CACHE_DIR timeout 300 python -c "
+import jax, jax.numpy as jnp
+assert jax.devices()[0].platform == 'tpu'
+x = jnp.ones((2, 1024), jnp.int32)
+assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096
+" 2>>"$LOG"
+}
+
 for i in $(seq 1 140); do
-  if timeout 150 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>>"$LOG"; then
+  if probe; then
     echo "TPU alive at probe $i ($(date -u +%FT%TZ))" | tee -a "$LOG"
     bash tools/tpu_capture.sh 2>&1 | tee -a tpu_capture.log
     echo "CAPTURE_EXIT=${PIPESTATUS[0]} (probe $i)" | tee -a "$LOG"
