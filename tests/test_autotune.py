@@ -22,7 +22,7 @@ SMOKE_CHILD = os.path.join(ROOT, "tools", "_tune_smoke_child.py")
 
 
 def run_tuner(tmp_path, fault=None, fault_block_q=None, timeout_s="30",
-              dead_trip=None):
+              dead_trip=None, stages=None):
     out = str(tmp_path / "TUNED.json")
     env = dict(os.environ, PT_TUNE_SMOKE="1", PT_TUNE_OUT=out,
                PT_TUNE_TRIAL_TIMEOUT=timeout_s)
@@ -32,6 +32,9 @@ def run_tuner(tmp_path, fault=None, fault_block_q=None, timeout_s="30",
     env.pop("PT_SMOKE_FAULT", None)
     env.pop("PT_SMOKE_FAULT_BLOCK_Q", None)
     env.pop("PT_TUNE_CHILD", None)
+    env.pop("PT_TUNE_STAGES", None)
+    if stages is not None:
+        env["PT_TUNE_STAGES"] = stages
     if fault:
         env["PT_SMOKE_FAULT"] = fault
     if fault_block_q is not None:
@@ -265,3 +268,43 @@ class TestParallelSearch:
             par = json.load(f)["parallel"]
         assert par["best"]["dp"] * par["best"]["tp"] * par["best"]["pp"] == 8
         assert all(row["step_time_s"] > 0 for row in par["ranking"])
+
+
+def test_staged_split_a_then_bc(tmp_path):
+    """The capture chain runs PT_TUNE_STAGES=A early and =BC later: the
+    BC pass must refine the recorded stage-A winner (not restart A) and
+    keep 'A' on the stages_done record."""
+    r, data = run_tuner(tmp_path, stages="A")
+    assert r.returncode == 0, r.stderr
+    assert data["stages_done"] == ["A"]
+    assert (data["best"]["batch"], data["best"]["remat"]) == (24, "dots")
+    assert "block_q" not in data["best"]
+
+    # the refine guard refuses smoke results as defaults; flip the flag
+    # to simulate the prior pass having been a real on-chip search
+    out = tmp_path / "TUNED.json"
+    d = json.loads(out.read_text())
+    d["smoke"] = False
+    out.write_text(json.dumps(d))
+
+    r, data = run_tuner(tmp_path, stages="BC")
+    assert r.returncode == 0, r.stderr
+    assert data["stages_done"] == ["A", "B", "C"]
+    best = data["best"]
+    assert (best["batch"], best["remat"]) == (24, "dots")
+    assert (best["block_q"], best["block_k"]) == (256, 512)
+    assert best["n_micro"] == 2
+    assert best["tok_s"] == 15850.0
+    # stage A's 12-trial record is carried over (marked prior, so the
+    # OOM/fail evidence survives the staged split) and was NOT re-run:
+    # only the winner was re-measured, + 4 stage-B + 2 stage-C trials
+    prior = [t for t in data["trials"] if t.get("prior")]
+    live = [t for t in data["trials"] if not t.get("prior")]
+    assert len(prior) == 12 and len(live) == 7
+    assert data["n_trials"] == 19
+
+
+def test_staged_bc_without_prior_a_refuses(tmp_path):
+    r, data = run_tuner(tmp_path, stages="BC")
+    assert r.returncode == 1
+    assert "needs a prior" in r.stderr

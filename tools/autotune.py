@@ -31,6 +31,12 @@ Env knobs:
   PT_TUNE_CHILD     — path to the per-trial child script
   PT_TUNE_OUT       — output path override for the winner JSON
   PT_TUNE_TRIAL_TIMEOUT — per-trial wall clock (seconds)
+  PT_TUNE_STAGES    — subset of "ABC" to run (default all): the capture
+                      chain runs a stage-A-only pass early so a short
+                      tunnel window still sweeps the big levers (batch x
+                      remat x fused_ce) before the long-tail benches;
+                      the later full pass re-measures cheaply off the
+                      compile cache
 """
 from __future__ import annotations
 
@@ -98,6 +104,11 @@ def _resolved(cfg):
 def run_trial(cfg, trials):
     """One bench.py child at `cfg`; returns the parsed JSON line or None."""
     for t in trials:
+        if t.get("prior"):
+            # record carried over from an earlier staged pass for the
+            # persisted trials log — not a full result (no extra),
+            # never serve it as a measurement
+            continue
         if _resolved(t["cfg"]) == _resolved(cfg):
             return t["result"]  # already measured this round
     # pin EVERY knob explicitly: an unset env var would fall back to a
@@ -172,6 +183,31 @@ def score(res):
     return res["value"] if res else -1.0
 
 
+def _tuned_defaults_for_refine():
+    """(cfg, stages_done, prior_trials) recorded by a prior non-smoke
+    search in this output file — lets PT_TUNE_STAGES=BC refine an
+    earlier stage-A pass without re-running it. Requires stage A to
+    have actually COMPLETED: a best persisted mid-stage-A (timeout kill
+    between consider() and done.append) must not let the refine pass
+    mark the search finished with most of the grid unsearched."""
+    try:
+        with open(TUNED) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None, [], []
+    if data.get("smoke") or "best" not in data \
+            or "A" not in data.get("stages_done", []):
+        return None, [], []
+    cfg = {k: v for k, v in data["best"].items()
+           if k not in ("tok_s", "mfu", "mfu_legacy")}
+    prior = [{"cfg": t["cfg"], "prior": True,
+              "result": ({"value": t["tok_s"]} if t.get("tok_s") is not None
+                         else None),
+              "error": t.get("error")}
+             for t in data.get("trials", [])]
+    return cfg, list(data.get("stages_done", [])), prior
+
+
 def _merge_tuned(updates):
     """Atomically merge top-level keys into TUNED.json, preserving
     whatever other stages wrote there."""
@@ -195,9 +231,11 @@ def persist(best_cfg, best_res, trials, done):
                   mfu=best_res["extra"]["mfu"],
                   mfu_legacy=best_res["extra"].get("mfu_legacy")),
         stages_done=done, n_trials=len(trials), smoke=SMOKE,
-        trials=[{"cfg": t["cfg"],
-                 "tok_s": t["result"]["value"] if t["result"] else None,
-                 "error": t.get("error")} for t in trials],
+        trials=[dict({"cfg": t["cfg"],
+                      "tok_s": t["result"]["value"] if t["result"] else None,
+                      "error": t.get("error")},
+                     **({"prior": True} if t.get("prior") else {}))
+                for t in trials],
         ts=time.time()))
     print(f"{os.path.basename(TUNED)} <- {data['best']}", flush=True)
 
@@ -435,50 +473,74 @@ def main():
     # 8 (16 OOM'd in r2). fused_ce avoids the
     # (B,S,V) logits materialization, so it both speeds the head and
     # frees HBM that may admit configs the plain head OOMs on.
+    stages = os.environ.get("PT_TUNE_STAGES", "ABC").upper()
+    if not stages or not set(stages) <= set("ABC"):
+        print(f"autotune: invalid PT_TUNE_STAGES={stages!r} "
+              "(want a non-empty subset of 'ABC')", file=sys.stderr)
+        sys.exit(2)
     try:
-        print("stage A: batch x remat x fused_ce", flush=True)
-        stage_a = [
-            {"batch": 16, "remat": "true", "fused_ce": True},   # warm anchor
-            {"batch": 8, "remat": "dots", "fused_ce": True},    # predicted win
-            {"batch": 16, "remat": "dots", "fused_ce": True},
-            {"batch": 12, "remat": "dots", "fused_ce": True},
-            {"batch": 8, "remat": "false", "fused_ce": True},
-            {"batch": 24, "remat": "true", "fused_ce": True},
-            {"batch": 32, "remat": "true", "fused_ce": True},
-            {"batch": 16, "remat": "true", "fused_ce": False},
-            {"batch": 8, "remat": "dots", "fused_ce": False},
-            {"batch": 24, "remat": "dots", "fused_ce": True},
-            {"batch": 32, "remat": "dots", "fused_ce": True},
-            {"batch": 8, "remat": "false", "fused_ce": False},
-        ]
-        for cfg in stage_a:
-            consider(dict(cfg, seq=seq))
-        if best_res is None:
-            print("autotune: every stage-A trial failed; aborting",
-                  file=sys.stderr)
-            sys.exit(1)
-        done.append("A")
-        persist(best_cfg, best_res, trials, done)
+        if "A" in stages:
+            print("stage A: batch x remat x fused_ce", flush=True)
+            stage_a = [
+                {"batch": 16, "remat": "true", "fused_ce": True},  # warm
+                {"batch": 8, "remat": "dots", "fused_ce": True},   # predicted
+                {"batch": 16, "remat": "dots", "fused_ce": True},
+                {"batch": 12, "remat": "dots", "fused_ce": True},
+                {"batch": 8, "remat": "false", "fused_ce": True},
+                {"batch": 24, "remat": "true", "fused_ce": True},
+                {"batch": 32, "remat": "true", "fused_ce": True},
+                {"batch": 16, "remat": "true", "fused_ce": False},
+                {"batch": 8, "remat": "dots", "fused_ce": False},
+                {"batch": 24, "remat": "dots", "fused_ce": True},
+                {"batch": 32, "remat": "dots", "fused_ce": True},
+                {"batch": 8, "remat": "false", "fused_ce": False},
+            ]
+            for cfg in stage_a:
+                consider(dict(cfg, seq=seq))
+            if best_res is None:
+                print("autotune: every stage-A trial failed; aborting",
+                      file=sys.stderr)
+                sys.exit(1)
+            done.append("A")
+            persist(best_cfg, best_res, trials, done)
+        else:
+            # B/C refine the recorded stage-A winner from this window
+            prev, prev_done, prior = _tuned_defaults_for_refine()
+            if not prev:
+                print("autotune: PT_TUNE_STAGES without A needs a prior "
+                      "non-smoke TUNED.json with stage A completed",
+                      file=sys.stderr)
+                sys.exit(1)
+            done.extend(prev_done)   # keep earlier stages on the record
+            trials.extend(prior)     # and their trial log (marked prior)
+            best_cfg = prev
+            best_res = run_trial(dict(prev), trials)
+            if best_res is None:
+                print("autotune: could not re-measure the recorded best",
+                      file=sys.stderr)
+                sys.exit(1)
 
-        # stage B: flash block sizes at the winner (must divide seq)
-        print("stage B: flash block_q/block_k", flush=True)
-        a_win = dict(best_cfg)
-        for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 256),
-                       (512, 512)):
-            consider(dict(a_win, block_q=bq, block_k=bk))
-        done.append("B")
-        persist(best_cfg, best_res, trials, done)
+        if "B" in stages:
+            # stage B: flash block sizes at the winner (must divide seq)
+            print("stage B: flash block_q/block_k", flush=True)
+            a_win = dict(best_cfg)
+            for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 256),
+                           (512, 512)):
+                consider(dict(a_win, block_q=bq, block_k=bk))
+            done.append("B")
+            persist(best_cfg, best_res, trials, done)
 
-        # stage C: gradient accumulation (true grad-accum scan in
-        # make_train_step — trades peak activation memory for a serial
-        # loop; can unlock bigger batch or lighter remat)
-        print("stage C: n_micro grad accumulation", flush=True)
-        b_win = dict(best_cfg)
-        for nm in (2, 4):
-            if b_win["batch"] % nm == 0:
-                consider(dict(b_win, n_micro=nm))
-        done.append("C")
-        persist(best_cfg, best_res, trials, done)
+        if "C" in stages:
+            # stage C: gradient accumulation (true grad-accum scan in
+            # make_train_step — trades peak activation memory for a
+            # serial loop; can unlock bigger batch or lighter remat)
+            print("stage C: n_micro grad accumulation", flush=True)
+            b_win = dict(best_cfg)
+            for nm in (2, 4):
+                if b_win["batch"] % nm == 0:
+                    consider(dict(b_win, n_micro=nm))
+            done.append("C")
+            persist(best_cfg, best_res, trials, done)
     except TunnelDead as e:
         print(f"autotune: aborting search — {e}; "
               f"stages completed: {done or 'none'}", file=sys.stderr)
@@ -488,6 +550,10 @@ def main():
         # tripped the breaker — TUNED.json must explain why the search
         # stopped, not just stderr
         persist(best_cfg, best_res, trials, list(done))
+    if best_res is None:
+        print("autotune: no stages ran (PT_TUNE_STAGES=%r)" % stages,
+              file=sys.stderr)
+        sys.exit(1)
     print(json.dumps({"best": best_cfg, "tok_s": best_res["value"],
                       "mfu": best_res["extra"]["mfu"]}))
 

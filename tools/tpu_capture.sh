@@ -68,6 +68,16 @@ PT_BENCH_SKIP_VALIDATE=1 PT_FUSED_CE=1 PT_BENCH_DOCS=4 \
   PT_BENCH_TIMEOUT=3300 timeout 3600 python bench.py 2>&1 | tail -2
 alive || { echo "CAPTURE_ABORT tunnel dead after step 4"; exit 2; }
 
+# 5a. autotune stage A FIRST (batch x remat x fused_ce — the strict-MFU
+#     levers): a window that dies during the long-tail benches below
+#     must not take the headline search with it. Stage B/C refine later.
+PT_TUNE_STAGES=A PT_TUNE_TRIAL_TIMEOUT=2700 timeout 7200 \
+  python tools/autotune.py 2>&1 | tail -6
+alive || { echo "CAPTURE_ABORT tunnel dead after step 5a"; exit 2; }
+
+# (no separate re-bench: the winning stage-A trial is itself a bench.py
+# child, so its tokens/sec entry is already in BENCH_HISTORY.jsonl)
+
 # 5. serving throughput on-chip, fp then int8 KV cache
 timeout 1800 python bench_models.py serving 2>&1 | tail -2
 alive || { echo "CAPTURE_ABORT tunnel dead mid step 5"; exit 2; }
@@ -80,12 +90,11 @@ for m in resnet50 bert moe input; do
   alive || { echo "CAPTURE_ABORT tunnel dead during step 6 ($m)"; exit 2; }
 done
 
-# 7. autotune: batch/remat/fused-CE/block/n_micro search, persists the
-#    winner to TUNED.json (bench.py picks it up as its defaults).
-#    Trial timeout sized for slow tunnel compiles; the search
-#    checkpoints every improvement, so a mid-search death keeps the
-#    best-so-far.
-PT_TUNE_TRIAL_TIMEOUT=2700 timeout 14400 python tools/autotune.py 2>&1 | tail -8
+# 7. autotune stage B/C: refine the stage-5a winner (flash blocks,
+#    n_micro). Checkpoints every improvement, so a mid-search death
+#    keeps the best-so-far.
+PT_TUNE_STAGES=BC PT_TUNE_TRIAL_TIMEOUT=2700 timeout 10800 \
+  python tools/autotune.py 2>&1 | tail -8
 
 # 8. final headline at the tuned defaults
 alive && PT_BENCH_SKIP_VALIDATE=1 timeout 3600 python bench.py 2>&1 | tail -1
