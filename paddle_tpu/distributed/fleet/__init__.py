@@ -7,6 +7,8 @@ bookkeeping over one jax.sharding.Mesh.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 
@@ -99,14 +101,43 @@ class HybridCommunicateGroup:
         return self._shape
 
 
+class PaddleCloudRoleMaker:
+    """Role resolution for parameter-server launches (reference:
+    fleet/base/role_maker.py — reads TRAINING_ROLE & co from the cloud
+    launcher). Ours reads PT_PS_ROLE (preferred) or TRAINING_ROLE:
+    'server'/'pserver' puts this process in the server tier, anything
+    else makes it a worker."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        role = os.environ.get("PT_PS_ROLE",
+                              os.environ.get("TRAINING_ROLE", "worker"))
+        self._role = role.lower()
+
+    def is_server(self):
+        return not self._is_collective and \
+            self._role in ("server", "pserver", "ps")
+
+    def is_worker(self):
+        return not self.is_server()
+
+
 class _Fleet:
     def __init__(self):
         self._strategy = None
         self._hcg = None
         self._mesh = None
         self._is_initialized = False
+        self._role_maker = None
 
     def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        self._role_maker = role_maker
+        if role_maker is not None and role_maker.is_server():
+            # PS server tier: no TPU mesh — the process only hosts
+            # host-RAM SparseTable shards (distributed/ps_impl.py)
+            self._strategy = strategy or DistributedStrategy()
+            self._is_initialized = True
+            return self
         self._strategy = strategy or DistributedStrategy()
         hc = self._strategy.hybrid_configs
         n = jax.device_count()
@@ -153,19 +184,37 @@ class _Fleet:
         """Normalized pipeline schedule from
         strategy.pipeline_configs['schedule_mode'] (reference:
         fleet/meta_optimizers/pipeline_optimizer.py:55 — 'F-then-B' is
-        GPipe, '1F1B' is one-forward-one-backward). Consumed by
-        models.llama_spmd.make_train_step(schedule=None)."""
+        GPipe, '1F1B' is one-forward-one-backward) combined with
+        hybrid_configs['pp_configs'/'virtual_pp_degree' (reference:
+        pipeline_parallel.py:1309 interleaved virtual stages). Consumed
+        by models.llama_spmd.make_train_step(schedule=None)."""
         cfgs = getattr(self._strategy, "pipeline_configs", None) or {}
         mode = str(cfgs.get("schedule_mode", "F-then-B"))
-        table = {"1f1b": "1f1b", "f-then-b": "gpipe"}
+        table = {"1f1b": "1f1b", "f-then-b": "gpipe",
+                 "interleave": "interleave"}
         if mode.lower() not in table:
             # never silently downgrade: a user who asked for a schedule
-            # we don't implement (e.g. interleaved virtual stages) must
-            # not discover it via an OOM from the wrong memory profile
+            # we don't implement must not discover it via an OOM from
+            # the wrong memory profile
             raise ValueError(
                 f"pipeline_configs schedule_mode={mode!r} is not "
-                "supported: use '1F1B' or 'F-then-B' (GPipe)")
-        return table[mode.lower()]
+                "supported: use '1F1B', 'F-then-B' (GPipe), or "
+                "'interleave'")
+        sched = table[mode.lower()]
+        if sched == "1f1b" and self.virtual_pp_degree() > 1:
+            # reference semantics: 1F1B + virtual_pp_degree>1 IS the
+            # interleaved schedule
+            sched = "interleave"
+        return sched
+
+    def virtual_pp_degree(self):
+        """hybrid_configs virtual pipeline degree (vpp chunks per
+        stage); 1 = plain schedules."""
+        hc = getattr(self._strategy, "hybrid_configs", None) or {}
+        pp_cfgs = hc.get("pp_configs") or {}
+        if isinstance(pp_cfgs, dict) and "virtual_pp_degree" in pp_cfgs:
+            return int(pp_cfgs["virtual_pp_degree"] or 1)
+        return int(hc.get("virtual_pp_degree", 1) or 1)
 
     def distributed_model(self, model):
         from ..parallel_wrappers import DataParallel
@@ -185,8 +234,34 @@ class _Fleet:
         from ..collective import barrier
         barrier()
 
+    # ---- parameter-server role entry points (reference: fleet.init_server/
+    # run_server/init_worker/stop_worker driving the_one_ps.TheOnePSRuntime;
+    # ours delegate to distributed/ps_impl.py — see docs/distributed.md)
+    def is_server(self):
+        return self._role_maker is not None and self._role_maker.is_server()
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker.is_worker()
+
+    def init_server(self, tables=None, **kw):
+        from .. import ps
+        return ps.init_server(tables, **kw)
+
+    def run_server(self):
+        from .. import ps
+        return ps.run_server()
+
+    def init_worker(self, n_tables=1):
+        from .. import ps
+        return ps.init_worker(n_tables)
+
     def stop_worker(self):
-        pass
+        # role_maker-less processes count as workers (the hybrid flow:
+        # collective dense SPMD + PT_PS_ENDPOINTS sparse tables) — their
+        # PS client sockets must close too
+        if self.is_worker():
+            from .. import ps
+            ps.stop_worker()
 
     def save_inference_model(self, *a, **k):
         pass
@@ -202,16 +277,32 @@ distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
 worker_index = fleet.worker_index
 worker_num = fleet.worker_num
+is_server = fleet.is_server
+is_worker = fleet.is_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+init_worker = fleet.init_worker
+stop_worker = fleet.stop_worker
 
 
 class UserDefinedRoleMaker:
-    def __init__(self, *a, **k):
-        pass
+    """Explicit-role variant (reference: fleet/base/role_maker.py):
+    role is 'server'/'pserver' or 'worker' (case-insensitive)."""
 
+    def __init__(self, current_id=0, role="worker", worker_num=1,
+                 server_endpoints=None, **k):
+        self.current_id = current_id
+        self.worker_num = worker_num
+        self.server_endpoints = server_endpoints or []
+        self._role = str(role).lower()
 
-class PaddleCloudRoleMaker:
-    def __init__(self, is_collective=True, **k):
-        self.is_collective = is_collective
+    def is_server(self):
+        return self._role in ("server", "pserver", "ps")
+
+    def is_worker(self):
+        return not self.is_server()
+
+# NB: PaddleCloudRoleMaker (env-driven roles) is defined above _Fleet.
 
 
 from ...parallel.pp import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: E402,F401

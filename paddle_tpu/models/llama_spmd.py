@@ -346,6 +346,10 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             from ..distributed.fleet import fleet as _fleet
             if getattr(_fleet, "_is_initialized", False):
                 schedule = _fleet.pipeline_schedule()
+                if schedule == "interleave":
+                    fleet_vpp = _fleet.virtual_pp_degree()
+                    if fleet_vpp > 1:      # else keep the caller's vpp
+                        vpp = fleet_vpp
         except ImportError:  # pragma: no cover
             pass
     use_pp = mesh.shape.get("pp", 1) > 1

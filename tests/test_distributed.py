@@ -701,3 +701,68 @@ class TestRingAttentionChunked:
         g_full = jax.jit(jax.grad(lambda a: loss(a, None)))(q)
         assert np.allclose(np.asarray(g_chunk), np.asarray(g_full),
                            atol=1e-4)
+
+
+class TestFleetPSRole:
+    """PS role flow through the fleet API (reference: fleet.init with a
+    role_maker + is_server/init_server/run_server/init_worker driving
+    the_one_ps.TheOnePSRuntime; ours delegates to distributed/ps_impl)."""
+
+    def test_role_maker_env(self, monkeypatch):
+        from paddle_tpu.distributed import fleet
+        monkeypatch.setenv("PT_PS_ROLE", "server")
+        rm = fleet.PaddleCloudRoleMaker(is_collective=False)
+        assert rm.is_server() and not rm.is_worker()
+        monkeypatch.setenv("PT_PS_ROLE", "worker")
+        rm = fleet.PaddleCloudRoleMaker(is_collective=False)
+        assert rm.is_worker() and not rm.is_server()
+        # collective launches are never servers regardless of env
+        monkeypatch.setenv("PT_PS_ROLE", "server")
+        rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert not rm.is_server()
+
+    def test_server_init_skips_mesh(self, monkeypatch):
+        from paddle_tpu.distributed import fleet
+        monkeypatch.setenv("PT_PS_ROLE", "server")
+        rm = fleet.PaddleCloudRoleMaker(is_collective=False)
+        f = fleet._Fleet()
+        f.init(role_maker=rm, is_collective=False)
+        assert f.is_server() and f._mesh is None and f._is_initialized
+
+    def test_worker_flow_over_socket_server(self, monkeypatch):
+        """fleet.init_server/init_worker round-trip on one host."""
+        import numpy as _np
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.ps import SparseTable
+        monkeypatch.setenv("PT_PS_ROLE", "worker")
+        f = fleet._Fleet()
+        f.init(role_maker=fleet.PaddleCloudRoleMaker(is_collective=False),
+               is_collective=False)
+        assert f.is_worker() and not f.is_server()
+        srv = f.init_server([SparseTable(4, optimizer="sgd", lr=1.0,
+                                         seed=0)], port=0)
+        srv.serve_in_thread()
+        try:
+            monkeypatch.setenv("PT_PS_ENDPOINTS", srv.endpoint)
+            client = f.init_worker()
+            r0 = client.pull([11])[0].copy()
+            client.push([11], _np.asarray([[1.0, 0.0, 0.0, 0.0]],
+                                          _np.float32))
+            assert abs(client.pull([11])[0][0] - (r0[0] - 1.0)) < 1e-6
+            f.stop_worker()
+        finally:
+            srv.close()
+
+    def test_interleave_schedule_mapping(self):
+        from paddle_tpu.distributed import fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 4, "pp_degree": 2,
+                                   "pp_configs": {"virtual_pp_degree": 2}}
+        strategy.pipeline_configs = {"schedule_mode": "1F1B"}
+        fleet.init(is_collective=True, strategy=strategy)
+        # reference semantics: 1F1B + virtual_pp_degree>1 IS interleave
+        assert fleet.fleet.pipeline_schedule() == "interleave"
+        assert fleet.fleet.virtual_pp_degree() == 2
+        strategy.pipeline_configs = {"schedule_mode": "interleave"}
+        fleet.init(is_collective=True, strategy=strategy)
+        assert fleet.fleet.pipeline_schedule() == "interleave"

@@ -198,6 +198,16 @@ def _tuned_defaults_for_refine():
     if data.get("smoke") or "best" not in data \
             or "A" not in data.get("stages_done", []):
         return None, [], []
+    # PT_TUNE_MIN_TS (set by tpu_capture.sh to its own start time)
+    # rejects a stale winner from a previous window: if THIS window's
+    # stage-A pass banked nothing, refining last week's best would
+    # stamp the search complete without the grid ever being swept today
+    min_ts = float(os.environ.get("PT_TUNE_MIN_TS", "0") or 0)
+    if data.get("ts", 0) < min_ts:
+        print(f"autotune: recorded best is older than PT_TUNE_MIN_TS "
+              f"({data.get('ts')} < {min_ts}); not refining it",
+              file=sys.stderr)
+        return None, [], []
     cfg = {k: v for k, v in data["best"].items()
            if k not in ("tok_s", "mfu", "mfu_legacy")}
     prior = [{"cfg": t["cfg"], "prior": True,
@@ -511,7 +521,10 @@ def main():
                       "non-smoke TUNED.json with stage A completed",
                       file=sys.stderr)
                 sys.exit(1)
-            done.extend(prev_done)   # keep earlier stages on the record
+            # keep earlier stages on the record, minus the ones this
+            # pass re-runs (a BC refine over a full ABC file must not
+            # persist ['A','B','C','B','C'])
+            done.extend(s for s in prev_done if s not in stages)
             trials.extend(prior)     # and their trial log (marked prior)
             best_cfg = prev
             best_res = run_trial(dict(prev), trials)
@@ -550,10 +563,6 @@ def main():
         # tripped the breaker — TUNED.json must explain why the search
         # stopped, not just stderr
         persist(best_cfg, best_res, trials, list(done))
-    if best_res is None:
-        print("autotune: no stages ran (PT_TUNE_STAGES=%r)" % stages,
-              file=sys.stderr)
-        sys.exit(1)
     print(json.dumps({"best": best_cfg, "tok_s": best_res["value"],
                       "mfu": best_res["extra"]["mfu"]}))
 

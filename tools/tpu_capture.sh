@@ -15,6 +15,9 @@
 cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+# step 7's BC refine must only build on a stage-A winner banked by THIS
+# capture run (see autotune._tuned_defaults_for_refine)
+export PT_TUNE_MIN_TS=$(date +%s)
 
 alive() {
   # device init alone is NOT enough: the 2026-07-31 window died
