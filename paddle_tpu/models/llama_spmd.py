@@ -30,7 +30,8 @@ from ..ops.flashmask_attention import flashmask_attention_bhsd
 from ..parallel.pp import (pipeline_apply, pipeline_train_1f1b,
                            pipeline_train_interleaved, group_stages,
                            group_virtual_stages, ungroup_virtual_stages)
-from ..parallel.ring import ring_attention_local
+from ..parallel.ring import ring_attention
+from ..parallel.ulysses import ulysses_attention
 from .llama import LlamaConfig
 
 
@@ -110,10 +111,19 @@ def doc_end_indices(doc_ids):
     return end.astype(jnp.int32)[:, None, :, None]
 
 
-def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
+def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None,
+                  sp_impl="ring", mesh=None):
     """One decoder layer, pure. h: (B, S, H). rope: (cos, sin) or
     (cos, sin, sri) where sri is a FlashMask startend_row_indices
-    tensor (B, 1, S_k, n) for packed-document attention."""
+    tensor (B, 1, S_k, n) for packed-document attention.
+
+    sp_impl: context-parallel scheme when sp_axis is set — "ring"
+    (K/V rotation, scales past head count) or "ulysses" (all-to-all
+    head<->sequence re-shard, full local flash kernel; needs
+    heads % sp == 0). See parallel/ulysses.py for the trade. The
+    attention is wrapped in its own shard_map over `mesh` (required
+    with sp_axis): plain jit/GSPMD never binds named axes, so the
+    _local collectives cannot be called bare from here."""
     c = config
     cos, sin = rope[0], rope[1]
     sri = rope[2] if len(rope) > 2 else None
@@ -128,11 +138,20 @@ def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
     v = (x @ lp["wv"]).reshape(b, s, nkv, hd).swapaxes(1, 2)
     q, k = apply_rotary_emb(q, k, cos[None, None], sin[None, None])
     rep = nh // nkv
-    if rep > 1:
+    if rep > 1 and not (sp_axis is not None and sp_impl == "ulysses"):
+        # ulysses takes GQA K/V unrepeated: it moves them over ICI at
+        # kv width and repeats after the re-shard (rep× fewer wire
+        # bytes); every other path wants full-head K/V here
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     if sp_axis is not None:
-        o = ring_attention_local(q, k, v, axis_name=sp_axis, causal=True)
+        if mesh is None:
+            raise ValueError(
+                "decoder_layer(sp_axis=...) needs the mesh: the "
+                "context-parallel attention runs under its own "
+                "shard_map; without it the named axis is unbound")
+        attn = ulysses_attention if sp_impl == "ulysses" else ring_attention
+        o = attn(q, k, v, mesh, sp_axis, causal=True)
     elif sri is not None:
         # packed-document pretraining: causal within each document,
         # blocked across documents — flashmask kernel, no dense mask
@@ -149,7 +168,8 @@ def decoder_layer(lp, h, rope, config: LlamaConfig, sp_axis=None):
 
 
 def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
-            remat=True, sp_axis=None, doc_ids=None, return_hidden=False):
+            remat=True, sp_axis=None, doc_ids=None, return_hidden=False,
+            sp_impl="ring"):
     """→ logits (B, S, V). Uses pipeline when mesh has pp>1, else scan.
 
     doc_ids: optional (B, S) contiguous document ids for packed-sequence
@@ -174,13 +194,22 @@ def forward(params, input_ids, config: LlamaConfig, mesh=None, n_micro=None,
                 "yet — use doc_ids without pp, or pp without doc_ids")
         if sp_axis is not None:
             raise NotImplementedError(
-                "packed-document flashmask + ring sequence parallelism "
-                "is not supported: ring_attention_local has no document "
-                "mask — drop sp_axis or doc_ids")
+                "packed-document flashmask + sequence parallelism is "
+                "not supported: neither the ring nor the ulysses "
+                "context-parallel attention carries a document mask — "
+                "drop sp_axis or doc_ids")
         extra = (cos, sin, doc_end_indices(doc_ids))
     h = jnp.take(params["embed"], input_ids, axis=0)
 
-    layer = functools.partial(decoder_layer, config=c, sp_axis=sp_axis)
+    use_pp_ = mesh is not None and mesh.shape.get("pp", 1) > 1
+    if sp_axis is not None and use_pp_:
+        raise NotImplementedError(
+            "sequence parallelism inside the pp pipeline is not "
+            "supported: the attention's shard_map cannot nest inside "
+            "the pipeline's — shard sequence on a pp=1 mesh, or drop "
+            "sp_axis")
+    layer = functools.partial(decoder_layer, config=c, sp_axis=sp_axis,
+                              sp_impl=sp_impl, mesh=mesh)
     if remat == "dots":
         # save matmul outputs, recompute only elementwise — ~MFU win over
         # full remat when activations still fit in HBM
@@ -253,16 +282,16 @@ def _resolve_fused_ce(fused_ce):
 
 
 def loss_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
-            sp_axis=None, fused_ce=False):
+            sp_axis=None, fused_ce=False, sp_impl="ring"):
     """batch: (input_ids, labels) or (input_ids, labels, doc_ids) for
     packed-document pretraining. Labels < 0 are ignored (masked mean)."""
     s, n = loss_sum_fn(params, batch, config, mesh, n_micro, remat, sp_axis,
-                       fused_ce=fused_ce)
+                       fused_ce=fused_ce, sp_impl=sp_impl)
     return s / jnp.maximum(n, 1.0)
 
 
 def loss_sum_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
-                sp_axis=None, fused_ce=False):
+                sp_axis=None, fused_ce=False, sp_impl="ring"):
     """(nll_sum, valid_count) variant — the grad-accumulation path
     accumulates these so microbatches are weighted by their VALID token
     counts, keeping n_micro=k exactly equal to the one-shot step even
@@ -275,10 +304,10 @@ def loss_sum_fn(params, batch, config, mesh=None, n_micro=None, remat=True,
     doc_ids = batch[2] if len(batch) > 2 else None
     if fused_ce:
         h = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
-                    doc_ids=doc_ids, return_hidden=True)
+                    doc_ids=doc_ids, return_hidden=True, sp_impl=sp_impl)
         return _fused_masked_nll(h, params["lm_head"], labels)
     logits = forward(params, input_ids, config, mesh, n_micro, remat, sp_axis,
-                     doc_ids=doc_ids)
+                     doc_ids=doc_ids, sp_impl=sp_impl)
     return _masked_nll(logits, labels)
 
 
@@ -316,7 +345,7 @@ def adamw_update(params, grads, state, lr, step, b1=0.9, b2=0.95, eps=1e-8,
 
 def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
                     clip_norm=1.0, lr=3e-4, sp_axis=None, donate=True,
-                    schedule=None, fused_ce=None, vpp=2):
+                    schedule=None, fused_ce=None, vpp=2, sp_impl="ring"):
     """Build the jitted 4D-parallel train step.
 
     (params, opt_state, step, batch) → (params, opt_state, loss)
@@ -348,8 +377,17 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
                 schedule = _fleet.pipeline_schedule()
                 if schedule == "interleave":
                     fleet_vpp = _fleet.virtual_pp_degree()
-                    if fleet_vpp > 1:      # else keep the caller's vpp
-                        vpp = fleet_vpp
+                    if fleet_vpp <= 1:
+                        # never silently pick a virtual degree the user
+                        # didn't configure (fleet policy: no silent
+                        # downgrades/upgrades of the memory profile)
+                        raise ValueError(
+                            "schedule_mode 'interleave' needs "
+                            "hybrid_configs pp_configs virtual_pp_degree "
+                            ">= 2 (got "
+                            f"{fleet_vpp}); set it, or pass vpp= "
+                            "explicitly with schedule='interleave'")
+                    vpp = fleet_vpp
         except ImportError:  # pragma: no cover
             pass
     use_pp = mesh.shape.get("pp", 1) > 1
@@ -373,11 +411,16 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             raise NotImplementedError(
                 "packed-document flashmask + 1F1B pipeline is not "
                 "supported yet (see forward()'s doc_ids + pp note)")
+        if sp_axis is not None:
+            raise NotImplementedError(
+                "sequence parallelism inside the 1F1B/interleave "
+                "pipeline is not supported (see forward()'s sp + pp "
+                "note)")
         input_ids, labels = batch[0], batch[1]
         s = input_ids.shape[1]
         cos, sin = rope_cos_sin(s, c.hidden_size // c.num_attention_heads,
                                 c.rope_theta, jnp.float32)
-        layer = functools.partial(decoder_layer, config=c, sp_axis=sp_axis)
+        layer = functools.partial(decoder_layer, config=c)
         if remat == "dots":
             layer = jax.checkpoint(
                 layer,
@@ -447,8 +490,13 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
                 acc_s, acc_n, acc_g = acc
 
                 def sum_only(p):
-                    s, n = loss_sum_fn(p, mb_batch, config, None, None,
-                                       remat, sp_axis, fused_ce=fused_ce)
+                    # mesh only when sp is on (the attention shard_map
+                    # needs it); None otherwise keeps the microbatch
+                    # forward off the pp pipeline path
+                    s, n = loss_sum_fn(p, mb_batch, config,
+                                       mesh if sp_axis else None, None,
+                                       remat, sp_axis, fused_ce=fused_ce,
+                                       sp_impl=sp_impl)
                     return s, n
                 (s, n), g = jax.value_and_grad(sum_only, has_aux=True)(params)
                 acc_g = jax.tree_util.tree_map(
@@ -464,8 +512,9 @@ def make_train_step(config, mesh, batch_spec=P("dp"), n_micro=None, remat=True,
             grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(
-                params, batch, config, mesh if use_pp else None, n_micro,
-                remat, sp_axis, fused_ce)
+                params, batch, config,
+                mesh if (use_pp or sp_axis) else None, n_micro,
+                remat, sp_axis, fused_ce, sp_impl)
         if clip_norm is not None:
             leaves = jax.tree_util.tree_leaves(grads)
             gn = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))

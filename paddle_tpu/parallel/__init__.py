@@ -14,4 +14,5 @@ from .tp import (  # noqa: F401
 from .pp import pipeline_apply, stack_layer_params, group_stages, LayerDesc, \
     PipelineLayer  # noqa: F401
 from .ring import ring_attention, ring_attention_local, sequence_shard  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_local  # noqa: F401
 from .moe import MoELayer, moe_ffn_apply, top_k_gating  # noqa: F401
