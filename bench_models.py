@@ -177,9 +177,12 @@ def bench_serving(on_tpu):
         raise SystemExit(
             f"PT_SERVE_CACHE={cache_dtype!r} unsupported; use 'int8' or "
             "unset (pool stores the model dtype)")
+    # PT_SERVE_SPEC=G: prompt-lookup speculative decoding, G-token
+    # verify chunks (greedy-exact; see llama_serving.verify_step)
+    spec = int(os.environ.get("PT_SERVE_SPEC", "0") or 0)
     eng = ServingEngine(params, cfg, max_seqs=max_seqs,
                         max_seq_len=max_seq_len, page_size=page, dtype=dtype,
-                        cache_dtype=cache_dtype)
+                        cache_dtype=cache_dtype, spec_decode=spec)
     rng = np.random.RandomState(0)
     for i in range(nreq):
         plen = int(rng.randint(8, 64)) if on_tpu else 3
@@ -190,11 +193,17 @@ def bench_serving(on_tpu):
     done = eng.run() if hasattr(eng, "run") else None
     dt = time.perf_counter() - t0
     total_new = sum(len(r.output) for r in done)
-    return {"decode_tokens_per_sec": round(total_new / dt, 1),
-            "requests": nreq, "new_tokens": total_new, "batch": max_seqs,
-            "cache_dtype": cache_dtype or str(jnp.dtype(dtype).name),
-            "step_time_s": round(dt / max(total_new, 1), 5),
-            "loss": 0.0}
+    out = {"decode_tokens_per_sec": round(total_new / dt, 1),
+           "requests": nreq, "new_tokens": total_new, "batch": max_seqs,
+           "cache_dtype": cache_dtype or str(jnp.dtype(dtype).name),
+           "step_time_s": round(dt / max(total_new, 1), 5),
+           "loss": 0.0}
+    if spec > 1:
+        out["spec_decode"] = spec
+        out["device_steps"] = eng.device_steps
+        out["spec_accept_rate"] = round(
+            eng.spec_accepted / max(eng.spec_drafted, 1), 3)
+    return out
 
 
 def bench_input(on_tpu):

@@ -40,6 +40,33 @@ def _rms(x, w, eps):
             * w.astype(jnp.float32)).astype(x.dtype)
 
 
+def _scatter_kv(kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant):
+    """Write kt/vt (KVH, *idx, D) into layer li of the K/V pools at
+    (page_ids, off) — *idx is page_ids/off's shape — quantizing on write
+    when the pool is int8 (per-token scales ride in ksp/vsp). Single
+    source for decode_step's one-token and verify_step's G-token
+    scatters so the int8 path can never drift between them. Returns
+    (kp, vp, ksp, vsp, kl, vl, ksl, vsl): the updated stacks plus this
+    layer's views for the attention read."""
+    kl = jax.lax.dynamic_index_in_dim(kp, li, 0, keepdims=False)
+    vl = jax.lax.dynamic_index_in_dim(vp, li, 0, keepdims=False)
+    ksl = vsl = None
+    if quant:
+        kt, kts = quantize_kv(kt)
+        vt, vts = quantize_kv(vt)
+        ksl = jax.lax.dynamic_index_in_dim(ksp, li, 0, keepdims=False)
+        vsl = jax.lax.dynamic_index_in_dim(vsp, li, 0, keepdims=False)
+        ksl = ksl.at[:, page_ids, off].set(kts)
+        vsl = vsl.at[:, page_ids, off].set(vts)
+        ksp = jax.lax.dynamic_update_index_in_dim(ksp, ksl, li, 0)
+        vsp = jax.lax.dynamic_update_index_in_dim(vsp, vsl, li, 0)
+    kl = kl.at[:, page_ids, off].set(kt.astype(kl.dtype))
+    vl = vl.at[:, page_ids, off].set(vt.astype(vl.dtype))
+    kp = jax.lax.dynamic_update_index_in_dim(kp, kl, li, 0)
+    vp = jax.lax.dynamic_update_index_in_dim(vp, vl, li, 0)
+    return kp, vp, ksp, vsp, kl, vl, ksl, vsl
+
+
 # ---------------------------------------------------------------------------
 # jitted compute
 # ---------------------------------------------------------------------------
@@ -165,24 +192,10 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
         v = (x @ lp["wv"]).reshape(B, 1, nkv, hd).swapaxes(1, 2)
         q, k = apply_rotary_emb(q, k, cos[:, None], sin[:, None])
         # write this token's K/V: (B, KVH, D) → pool[li][:, page_ids, off]
-        kl = jax.lax.dynamic_index_in_dim(kp, li, 0, keepdims=False)
-        vl = jax.lax.dynamic_index_in_dim(vp, li, 0, keepdims=False)
         kt = k[:, :, 0].swapaxes(0, 1)                      # (KVH, B, D)
         vt = v[:, :, 0].swapaxes(0, 1)
-        ksl = vsl = None
-        if quant:
-            kt, kts = quantize_kv(kt)
-            vt, vts = quantize_kv(vt)
-            ksl = jax.lax.dynamic_index_in_dim(ksp, li, 0, keepdims=False)
-            vsl = jax.lax.dynamic_index_in_dim(vsp, li, 0, keepdims=False)
-            ksl = ksl.at[:, page_ids, off].set(kts)
-            vsl = vsl.at[:, page_ids, off].set(vts)
-            ksp = jax.lax.dynamic_update_index_in_dim(ksp, ksl, li, 0)
-            vsp = jax.lax.dynamic_update_index_in_dim(vsp, vsl, li, 0)
-        kl = kl.at[:, page_ids, off].set(kt.astype(kl.dtype))
-        vl = vl.at[:, page_ids, off].set(vt.astype(vl.dtype))
-        kp = jax.lax.dynamic_update_index_in_dim(kp, kl, li, 0)
-        vp = jax.lax.dynamic_update_index_in_dim(vp, vl, li, 0)
+        kp, vp, ksp, vsp, kl, vl, ksl, vsl = _scatter_kv(
+            kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
         o = paged_attention(q[:, :, 0], kl, vl, page_table, lengths,
                             use_pallas=use_pallas, interpret=interpret,
                             k_scale=ksl, v_scale=vsl)       # (B, QH, D)
@@ -198,6 +211,119 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
     logits = h[:, 0] @ params["lm_head"]
     return k_pool, v_pool, k_scale, v_scale, logits
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "page_size"))
+def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
+                n_tok, active, config: LlamaConfig, page_size,
+                k_scale=None, v_scale=None):
+    """Speculative-decoding verify: G chunk tokens per slot in ONE
+    forward — every matmul runs at (B, G, ...) so one weight read
+    covers G tokens, which is where the speculative speedup comes from
+    (reference parity: PaddleNLP speculative decoding / "inference with
+    reference" draft-verify flow).
+
+    tokens: (B, G) = [pending next_token, draft_1 .. draft_{G-1}],
+    right-padded per slot; n_tok: (B,) real chunk length (1..G) — padded
+    positions write their K/V to the trash page (their page-table slots
+    may not exist, and a default 0 entry would corrupt another slot's
+    page 0). lengths: (B,) cache length BEFORE this chunk (chunk token g
+    lands at position lengths+g — NB different convention from
+    decode_step, which takes lengths pre-advanced); active: (B,) bool.
+
+    Real chunk tokens' K/V are written to the pool; entries past the
+    host-side accepted prefix simply sit beyond the slot's length,
+    masked from every future read and overwritten when those positions
+    are legitimately reached. Returns (k_pool, v_pool, k_scale, v_scale,
+    logits (B, G, V)) — logits[:, g] follows chunk token g.
+
+    Attention gathers the slot's pages into a contiguous (B, S_max)
+    key/value view and runs a masked dense block (G x S_max scores, G
+    small) — one read of the same KV bytes paged attention reads; the
+    page gather is the acknowledged cost vs a multi-query paged pallas
+    kernel (the single-token kernel stays the steady-state decode path).
+    """
+    c = config
+    nh, nkv = c.num_attention_heads, c.num_key_value_heads
+    hd = c.hidden_size // nh
+    B, G = tokens.shape
+    Pn = k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    S_pad = n_pages * page_size
+    quant = k_scale is not None
+
+    pos = lengths[:, None] + jnp.arange(G)[None, :]          # (B, G)
+    cos, sin = rope_cos_sin(None, hd, base=c.rope_theta,
+                            position_ids=pos)                # (B, G, hd)
+    h = jnp.take(params["embed"], tokens, axis=0)            # (B, G, H)
+
+    page_ids = page_table[jnp.arange(B)[:, None], pos // page_size]
+    real = active[:, None] & (jnp.arange(G)[None, :] < n_tok[:, None])
+    page_ids = jnp.where(real, page_ids, Pn - 1)             # trash page
+    off = pos % page_size                                    # (B, G)
+    # key mask: token g attends to absolute positions 0..lengths+g
+    key_pos = jnp.arange(S_pad)[None, None, :]               # (1, 1, S)
+    mask = key_pos <= pos[:, :, None]                        # (B, G, S)
+
+    def layer(carry, xs):
+        h, kp, vp, ksp, vsp = carry
+        lp, li = xs
+        x = _rms(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, G, nh, hd).swapaxes(1, 2)
+        k = (x @ lp["wk"]).reshape(B, G, nkv, hd).swapaxes(1, 2)
+        v = (x @ lp["wv"]).reshape(B, G, nkv, hd).swapaxes(1, 2)
+        q, k = apply_rotary_emb(q, k, cos[:, None], sin[:, None])
+        kt = k.swapaxes(0, 1)                                # (KVH, B, G, D)
+        vt = v.swapaxes(0, 1)
+        kp, vp, ksp, vsp, kl, vl, ksl, vsl = _scatter_kv(
+            kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
+
+        # contiguous (B, KVH, S_pad, D) view of this slot's pages
+        ks = kl[:, page_table].reshape(nkv, B, S_pad, hd).swapaxes(0, 1)
+        vs = vl[:, page_table].reshape(nkv, B, S_pad, hd).swapaxes(0, 1)
+        if quant:
+            kss = ksl[:, page_table].reshape(nkv, B, S_pad, 1).swapaxes(0, 1)
+            vss = vsl[:, page_table].reshape(nkv, B, S_pad, 1).swapaxes(0, 1)
+            ks = ks.astype(jnp.float32) * kss
+            vs = vs.astype(jnp.float32) * vss
+        if nh != nkv:
+            ks = jnp.repeat(ks, nh // nkv, axis=1)
+            vs = jnp.repeat(vs, nh // nkv, axis=1)
+        scores = jnp.einsum("bhgd,bhsd->bhgs", q.astype(jnp.float32),
+                            ks.astype(jnp.float32)) / math.sqrt(hd)
+        scores = jnp.where(mask[:, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhgs,bhsd->bhgd", probs, vs.astype(jnp.float32))
+        o = o.swapaxes(1, 2).reshape(B, G, nh * hd)
+        h = h + o.astype(h.dtype) @ lp["wo"]
+        x = _rms(h, lp["ln2"], c.rms_norm_eps)
+        mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return (h + mlp, kp, vp, ksp, vsp), None
+
+    L = k_pool.shape[0]
+    (h, k_pool, v_pool, k_scale, v_scale), _ = jax.lax.scan(
+        layer, (h, k_pool, v_pool, k_scale, v_scale),
+        (params["layers"], jnp.arange(L)))
+    h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    logits = h @ params["lm_head"]
+    return k_pool, v_pool, k_scale, v_scale, logits
+
+
+def prompt_lookup_draft(ctx, G, ngram=2):
+    """Draft continuation tokens by n-gram lookup in the request's own
+    context (reference parity: PaddleNLP "inference with reference" —
+    speculative decoding without a draft model). Finds the most recent
+    earlier occurrence of the trailing `ngram` tokens and proposes the
+    up-to-G tokens that followed it. Returns [] when no match."""
+    L = len(ctx)
+    if L < ngram + 1:
+        return []
+    key = list(ctx[-ngram:])
+    for i in range(L - ngram - 1, -1, -1):
+        if list(ctx[i:i + ngram]) == key:
+            return [int(t) for t in ctx[i + ngram:i + ngram + G]]
+    return []
 
 
 # ---------------------------------------------------------------------------
@@ -263,7 +389,8 @@ class ServingEngine:
     def __init__(self, params, config: LlamaConfig, max_seqs=4,
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
                  use_pallas=None, interpret=False, num_pages=None,
-                 cache_dtype=None, preempt_policy="offload"):
+                 cache_dtype=None, preempt_policy="offload",
+                 spec_decode=0, spec_ngram=2):
         c = config
         self.params = params
         self.config = c
@@ -296,6 +423,17 @@ class ServingEngine:
         self.preempt_policy = preempt_policy
         self.preemptions = 0
         self.prefill_tokens = 0  # total tokens ever run through prefill
+        # speculative decoding (reference: PaddleNLP speculative /
+        # "inference with reference"): spec_decode = chunk width G —
+        # each device step verifies 1 pending + up to G-1 prompt-lookup
+        # drafted tokens for greedy requests. 0/1 = plain decode.
+        self.spec_decode = int(spec_decode)
+        self.spec_ngram = int(spec_ngram)
+        if self.spec_decode < 0:
+            raise ValueError(f"spec_decode={spec_decode}: want >= 0")
+        self.spec_drafted = 0    # draft tokens fed to verify
+        self.spec_accepted = 0   # draft tokens accepted
+        self.device_steps = 0    # decode/verify device calls
         self._order = 0
         kvh = c.num_key_value_heads
         hd = c.hidden_size // c.num_attention_heads
@@ -361,16 +499,26 @@ class ServingEngine:
                       if self._slots[s] is None]
         # admit only what both slots AND kv pages can hold — popping a
         # request we cannot scatter would silently drop it
-        # reserve pages that active slots will need at this step's page
-        # boundary — otherwise an admission can fill the pool and become
-        # the immediate preemption victim (full prefill wasted)
-        growth_need = sum(
-            1 for s in range(self.max_seqs)
-            if self._slots[s] is not None
-            and int(self.lengths[s]) > 0
-            and int(self.lengths[s]) % self.page_size == 0
-            and len(self._seq_pages[s]) * self.page_size
-            <= int(self.lengths[s]))
+        # reserve pages that active slots will need at this step —
+        # otherwise an admission can fill the pool and become the
+        # immediate preemption victim (full prefill wasted). Plain
+        # decode grows one page exactly at a boundary; a spec verify
+        # chunk can need pages for up to G new positions at once.
+        if self.spec_decode > 1:
+            G = self.spec_decode
+            growth_need = sum(
+                max(0, -(-(min(int(self.lengths[s]) + G, self.max_seq_len))
+                         // self.page_size) - len(self._seq_pages[s]))
+                for s in range(self.max_seqs)
+                if self._slots[s] is not None)
+        else:
+            growth_need = sum(
+                1 for s in range(self.max_seqs)
+                if self._slots[s] is not None
+                and int(self.lengths[s]) > 0
+                and int(self.lengths[s]) % self.page_size == 0
+                and len(self._seq_pages[s]) * self.page_size
+                <= int(self.lengths[s]))
         free_pages = len(self._free) - growth_need
         take = 0
         for req in self._waiting[:len(free_slots)]:
@@ -576,6 +724,8 @@ class ServingEngine:
     def step(self):
         """One decode step for all active slots; returns #active."""
         self._admit()
+        if self.spec_decode > 1:
+            return self._spec_step()
         # page-growth pass with preemption: a slot about to cross a page
         # boundary must get a page; when the (oversubscribed) pool is
         # dry, evict the most recent admission rather than dying deep in
@@ -622,6 +772,98 @@ class ServingEngine:
             tok = req.pick(rows[s]) if s in rows else int(greedy_nxt[s])
             req.output.append(tok)
             req.next_token = tok
+            if req.done:
+                self.finished.append(req)
+                self._release(s)
+        self.device_steps += 1
+        return len(active_slots)
+
+    def _spec_step(self):
+        """One speculative verify step: drafts up to G-1 tokens per
+        greedy slot by prompt lookup, verifies the whole chunk in one
+        forward, emits the accepted prefix + one model token. Exactly
+        reproduces plain greedy decode (the model token at the first
+        draft divergence is the token plain decode would have picked)."""
+        G = self.spec_decode
+        active_slots = [s for s, r in enumerate(self._slots)
+                        if r is not None]
+        if not active_slots:
+            return 0
+        tokens = np.zeros((self.max_seqs, G), np.int64)
+        n_tok = np.ones((self.max_seqs,), np.int32)
+        active = np.zeros((self.max_seqs,), bool)
+        for s in active_slots:
+            req = self._slots[s]
+            active[s] = True
+            tokens[s, 0] = req.next_token
+            cur = int(self.lengths[s])
+            room = self.max_seq_len - cur - 1
+            budget = min(G - 1, room,
+                         req.max_new_tokens - len(req.output))
+            if req.temperature == 0.0 and budget > 0:
+                # context = everything decided so far incl. the pending
+                # next_token (it's the tail the n-gram keys off)
+                ctx = req.prompt + req.output
+                draft = prompt_lookup_draft(ctx, budget, self.spec_ngram)
+                for j, t in enumerate(draft):
+                    tokens[s, 1 + j] = t
+                n_tok[s] = 1 + len(draft)
+                self.spec_drafted += len(draft)
+        # page growth: every REAL chunk position needs its page now
+        for s in active_slots:
+            if self._slots[s] is None:
+                continue   # evicted by a preemption for an earlier slot
+            need = -(-(int(self.lengths[s]) + int(n_tok[s]))
+                     // self.page_size)
+            while len(self._seq_pages[s]) < need:
+                while not self._free:
+                    if not self._preempt_one(exclude=s):
+                        raise RuntimeError(
+                            "serving: KV page pool exhausted with a "
+                            "single active sequence — num_pages is too "
+                            "small for max_seq_len")
+                self._alloc_pages(s, 1)
+        active_slots = [s for s, r in enumerate(self._slots)
+                        if r is not None]
+        for s in range(self.max_seqs):
+            if s not in active_slots:
+                active[s] = False
+        if not active_slots:
+            return 0
+        (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+         logits) = verify_step(
+            self.params, self.k_pool, self.v_pool, self.page_table,
+            self.lengths, jnp.asarray(tokens), jnp.asarray(n_tok),
+            jnp.asarray(active), self.config, self.page_size,
+            k_scale=self.k_scale, v_scale=self.v_scale)
+        self.device_steps += 1
+        greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)
+        sampled = {s: np.asarray(logits[s, 0])
+                   for s in active_slots
+                   if self._slots[s].temperature > 0.0}
+        for s in active_slots:
+            req = self._slots[s]
+            n = int(n_tok[s])
+            if s in sampled:
+                outs = [req.pick(sampled[s])]
+                n = 1
+            else:
+                outs = [int(t) for t in greedy_nxt[s, :n]]
+            # accept drafts while they match the model's own choices
+            a = 0
+            while a < n - 1 and tokens[s, a + 1] == outs[a]:
+                a += 1
+            self.spec_accepted += a
+            emitted = 0
+            for j in range(a + 1):
+                req.output.append(outs[j])
+                req.next_token = outs[j]
+                emitted += 1
+                if req.done:
+                    break
+            # cache retains chunk tokens 0..emitted-1 (the pending token
+            # + the drafts CONSUMED to produce the emissions)
+            self.lengths = self.lengths.at[s].add(emitted)
             if req.done:
                 self.finished.append(req)
                 self._release(s)

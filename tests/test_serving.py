@@ -395,3 +395,159 @@ class TestPreemptOffload:
             outs.append({r.rid: r.output for r in done})
         assert outs[0]["g"] == outs[1]["g"]
         assert outs[0]["s"] == outs[1]["s"]
+
+
+class TestSpeculativeDecoding:
+    """Prompt-lookup speculative decoding (reference: PaddleNLP
+    speculative / 'inference with reference'): one verify forward per
+    chunk, exact greedy equivalence, fewer device steps on repetitive
+    text."""
+
+    def test_prompt_lookup_draft(self):
+        from paddle_tpu.models.llama_serving import prompt_lookup_draft
+        ctx = [1, 2, 3, 4, 1, 2]
+        assert prompt_lookup_draft(ctx, 3, ngram=2) == [3, 4, 1]
+        assert prompt_lookup_draft(ctx, 1, ngram=2) == [3]
+        assert prompt_lookup_draft([1, 2, 3], 4, ngram=2) == []  # no match
+        assert prompt_lookup_draft([5], 4, ngram=2) == []        # too short
+        # most RECENT earlier occurrence wins
+        ctx2 = [7, 8, 1, 7, 8, 2, 7, 8]
+        assert prompt_lookup_draft(ctx2, 2, ngram=2) == [2, 7]
+
+    def test_spec_greedy_exact_match_and_fewer_steps(self, params):
+        # a highly repetitive prompt: prompt-lookup drafts well, so the
+        # engine must finish in strictly fewer device steps while
+        # emitting EXACTLY the plain-decode tokens
+        prompt = [3, 9, 4, 3, 9, 4, 3, 9, 4, 3, 9]
+        n_new = 16
+        ref = greedy_reference(params, prompt, n_new)
+
+        base = ServingEngine(params, CFG, max_seqs=2, max_seq_len=128,
+                             page_size=8, use_pallas=False)
+        base.submit(Request("p", prompt, max_new_tokens=n_new))
+        base.run()
+        assert base.finished[0].output == ref
+
+        spec = ServingEngine(params, CFG, max_seqs=2, max_seq_len=128,
+                             page_size=8, use_pallas=False, spec_decode=4)
+        spec.submit(Request("s", prompt, max_new_tokens=n_new))
+        spec.run()
+        assert spec.finished[0].output == ref
+        assert spec.device_steps < base.device_steps, (
+            spec.device_steps, base.device_steps)
+        assert spec.spec_accepted > 0
+
+    def test_spec_matches_on_random_prompts(self, params):
+        # non-repetitive prompts: drafts often rejected — output must
+        # STILL match plain greedy exactly, batch of 3 with different
+        # lengths
+        rng = np.random.RandomState(7)
+        prompts = [list(map(int, rng.randint(0, 64, n)))
+                   for n in (5, 11, 8)]
+        refs = [greedy_reference(params, p, 10) for p in prompts]
+        eng = ServingEngine(params, CFG, max_seqs=3, max_seq_len=128,
+                            page_size=8, use_pallas=False, spec_decode=3)
+        for i, p in enumerate(prompts):
+            eng.submit(Request(f"r{i}", p, max_new_tokens=10))
+        eng.run()
+        got = {r.rid: r.output for r in eng.finished}
+        for i, ref in enumerate(refs):
+            assert got[f"r{i}"] == ref, f"request r{i} diverged"
+
+    def test_spec_mixed_with_sampling_and_eos(self, params):
+        # sampling requests ride the verify step un-drafted and stay
+        # seeded-deterministic; eos mid-chunk stops exactly like plain
+        prompt = [2, 4, 2, 4, 2, 4, 2]
+        ref = greedy_reference(params, prompt, 12)
+        eos = ref[5]
+        plain = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                              page_size=8, use_pallas=False)
+        plain.submit(Request("g", prompt, max_new_tokens=12, eos_id=eos))
+        plain.submit(Request("t", prompt, max_new_tokens=6,
+                             temperature=0.8, top_k=8, seed=11))
+        plain.run()
+        spec = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                             page_size=8, use_pallas=False, spec_decode=4)
+        spec.submit(Request("g", prompt, max_new_tokens=12, eos_id=eos))
+        spec.submit(Request("t", prompt, max_new_tokens=6,
+                            temperature=0.8, top_k=8, seed=11))
+        spec.run()
+        pg = {r.rid: r.output for r in plain.finished}
+        sg = {r.rid: r.output for r in spec.finished}
+        assert sg["g"] == pg["g"]          # eos honored mid-chunk
+        assert sg["t"] == pg["t"]          # seeded sampling unchanged
+
+    def test_spec_int8_cache(self, params):
+        prompt = [3, 9, 4, 3, 9, 4, 3, 9, 4]
+        fp = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                           page_size=8, use_pallas=False, spec_decode=4)
+        fp.submit(Request("a", prompt, max_new_tokens=8))
+        fp.run()
+        q = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                          page_size=8, use_pallas=False, spec_decode=4,
+                          cache_dtype="int8")
+        q.submit(Request("a", prompt, max_new_tokens=8))
+        q.run()
+        # int8 quant noise may flip a token eventually; prefix must agree
+        a, b = fp.finished[0].output, q.finished[0].output
+        assert a[:4] == b[:4]
+
+    def test_verify_step_equals_sequential_decode(self, params):
+        """Device-level: one verify_step over a 3-token chunk produces
+        the same logits trajectory and pool state as 3 decode_steps."""
+        from paddle_tpu.models.llama_serving import (decode_step,
+                                                     verify_step)
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False)
+        eng.submit(Request("a", [1, 5, 9, 3], max_new_tokens=8))
+        eng._admit()
+        chunk = [int(eng._slots[0].next_token), 7, 2]
+        # pages for the chunk
+        need = -(-(int(eng.lengths[0]) + 3) // eng.page_size)
+        while len(eng._seq_pages[0]) < need:
+            eng._alloc_pages(0, 1)
+        n_tok = jnp.asarray([3, 0], jnp.int32)
+        active = jnp.asarray([True, False])
+        toks = jnp.asarray([[chunk[0], chunk[1], chunk[2]], [0, 0, 0]],
+                           jnp.int64)
+        k1, v1, _, _, logits_v = verify_step(
+            eng.params, eng.k_pool, eng.v_pool, eng.page_table,
+            eng.lengths, toks, n_tok, active, CFG, eng.page_size)
+
+        ks, vs = eng.k_pool, eng.v_pool
+        lens = eng.lengths
+        seq_logits = []
+        for g in range(3):
+            lens = lens.at[0].add(1)
+            ks, vs, _, _, lg = decode_step(
+                eng.params, ks, vs, eng.page_table, lens,
+                jnp.asarray([chunk[g], 0], jnp.int64), active, CFG,
+                eng.page_size, use_pallas=False)
+            seq_logits.append(lg[0])
+        for g in range(3):
+            np.testing.assert_allclose(np.asarray(logits_v[0, g]),
+                                       np.asarray(seq_logits[g]),
+                                       atol=2e-4)
+        # trash page (last) holds masked junk by design — exclude it
+        np.testing.assert_allclose(np.asarray(k1[:, :, :-1]),
+                                   np.asarray(ks[:, :, :-1]), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(v1[:, :, :-1]),
+                                   np.asarray(vs[:, :, :-1]), atol=2e-5)
+
+    def test_spec_oversubscribed_pool_no_page_leak(self, params):
+        """Spec decode + preemption: pool accounting must balance after
+        all requests finish (a stale-slot alloc would leak pages)."""
+        eng = ServingEngine(params, CFG, max_seqs=3, max_seq_len=64,
+                            page_size=8, use_pallas=False, spec_decode=4,
+                            num_pages=12)   # < worst case 3*8+1
+        prompt = [3, 9, 4, 3, 9, 4, 3, 9]
+        for i in range(4):
+            eng.submit(Request(f"o{i}", prompt, max_new_tokens=20))
+        eng.run()
+        assert len(eng.finished) == 4
+        ref = greedy_reference(params, prompt, 20)
+        for r in eng.finished:
+            assert r.output == ref
+        # every page back on the free list (trash page never joins)
+        assert sorted(eng._free) == list(range(12 - 1))
+        assert all(not p for p in eng._seq_pages.values())

@@ -81,10 +81,12 @@ alive || { echo "CAPTURE_ABORT tunnel dead after step 5a"; exit 2; }
 # (no separate re-bench: the winning stage-A trial is itself a bench.py
 # child, so its tokens/sec entry is already in BENCH_HISTORY.jsonl)
 
-# 5. serving throughput on-chip, fp then int8 KV cache
+# 5. serving throughput on-chip: fp, int8 KV cache, speculative decode
 timeout 1800 python bench_models.py serving 2>&1 | tail -2
 alive || { echo "CAPTURE_ABORT tunnel dead mid step 5"; exit 2; }
 PT_SERVE_CACHE=int8 timeout 1800 python bench_models.py serving 2>&1 | tail -2
+alive || { echo "CAPTURE_ABORT tunnel dead mid step 5 (int8)"; exit 2; }
+PT_SERVE_SPEC=4 timeout 1800 python bench_models.py serving 2>&1 | tail -2
 alive || { echo "CAPTURE_ABORT tunnel dead after step 5"; exit 2; }
 
 # 6. remaining per-model benches
