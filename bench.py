@@ -305,9 +305,12 @@ def main():
     mfu_legacy = flops_legacy * tok_per_sec / peak
 
     attn_label = f"flashmask-{docs}doc" if docs > 0 else "flash-attn"
+    remat_label = {True: "remat", False: "no-remat"}.get(
+        remat, f"remat-{remat}")
     result = {
         "metric": f"llama-{f'{seq}x{batch}' if on_tpu else 'tiny'} pretrain "
-                  f"tokens/sec/chip ({gen}, bf16, {attn_label}, remat)",
+                  f"tokens/sec/chip ({gen}, bf16, {attn_label}, "
+                  f"{remat_label})",
         "value": round(tok_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
