@@ -390,7 +390,7 @@ class ServingEngine:
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
                  use_pallas=None, interpret=False, num_pages=None,
                  cache_dtype=None, preempt_policy="offload",
-                 spec_decode=0, spec_ngram=2):
+                 spec_decode=0, spec_ngram=2, chunked_prefill=False):
         c = config
         self.params = params
         self.config = c
@@ -431,6 +431,16 @@ class ServingEngine:
         self.spec_ngram = int(spec_ngram)
         if self.spec_decode < 0:
             raise ValueError(f"spec_decode={spec_decode}: want >= 0")
+        # chunked prefill (reference parity: PaddleNLP/vLLM split-fuse):
+        # admissions feed their prompt G tokens per verify step instead
+        # of one monolithic prefill, so decoding requests never stall
+        # behind a long prompt. Rides the spec verify chunk — needs
+        # spec_decode >= 2 (G is the chunk width).
+        self.chunked_prefill = bool(chunked_prefill)
+        if self.chunked_prefill and self.spec_decode < 2:
+            raise ValueError(
+                "chunked_prefill rides the spec verify chunk: set "
+                "spec_decode >= 2 (the chunk width)")
         self.spec_drafted = 0    # draft tokens fed to verify
         self.spec_accepted = 0   # draft tokens accepted
         self.device_steps = 0    # decode/verify device calls
@@ -506,11 +516,22 @@ class ServingEngine:
         # chunk can need pages for up to G new positions at once.
         if self.spec_decode > 1:
             G = self.spec_decode
-            growth_need = sum(
-                max(0, -(-(min(int(self.lengths[s]) + G, self.max_seq_len))
-                         // self.page_size) - len(self._seq_pages[s]))
-                for s in range(self.max_seqs)
-                if self._slots[s] is not None)
+            def _reserve(s):
+                r = self._slots[s]
+                if self._prefilling(r):
+                    # a mid-prefill slot is NOT evictable, so its whole
+                    # remaining prompt must stay reserved — lazily
+                    # allocated, but spoken for (otherwise two long
+                    # prompts admit concurrently into a pool that can
+                    # hold only one and deadlock with no victim)
+                    horizon = len(r._pf_feed)
+                else:
+                    horizon = min(int(self.lengths[s]) + G,
+                                  self.max_seq_len)
+                return max(0, -(-horizon // self.page_size)
+                           - len(self._seq_pages[s]))
+            growth_need = sum(_reserve(s) for s in range(self.max_seqs)
+                              if self._slots[s] is not None)
         else:
             growth_need = sum(
                 1 for s in range(self.max_seqs)
@@ -543,11 +564,23 @@ class ServingEngine:
         all_slots = free_slots[:take]
         # host-offloaded victims resume by scattering their saved pages
         # back — no prefill compute; everything else joins one varlen
-        # prefill batch
+        # prefill batch (or, under chunked_prefill, starts feeding its
+        # prompt G tokens per verify step so decoders never stall)
         reqs, slots = [], []
         for slot, req in zip(all_slots, all_reqs):
             if getattr(req, "_offload", None) is not None:
                 self._restore_into(slot, req)
+            elif self.chunked_prefill:
+                req._pf_feed = self._feed_ids(req)
+                req._pf_cursor = 0
+                # a recompute-resume keeps its pending next_token — the
+                # final chunk must not re-sample it
+                req._pf_sample = not getattr(req, "_resume", False)
+                req._resume = False
+                req.slot = slot
+                req._admit_order = self._order
+                self._order += 1
+                self._slots[slot] = req
             else:
                 reqs.append(req)
                 slots.append(slot)
@@ -573,7 +606,6 @@ class ServingEngine:
         logits, k_all, v_all = prefill_varlen(
             self.params, jnp.asarray(ids), jnp.asarray(cu), self.config,
             use_pallas=self._use_pallas, interpret=self._interpret)
-        nxt = np.asarray(jnp.argmax(logits, axis=-1))
         for i, (slot, req) in enumerate(zip(slots, reqs)):
             a, b = int(cu[i]), int(cu[i + 1])
             self._scatter_prompt(slot, k_all[:, :, a:b], v_all[:, :, a:b],
@@ -581,20 +613,13 @@ class ServingEngine:
             req.slot = slot
             req._admit_order = self._order
             self._order += 1
+            self._slots[slot] = req
             if getattr(req, "_resume", False):
                 # resuming after preemption: next_token was already
                 # sampled before eviction — do NOT re-sample it
                 req._resume = False
             else:
-                # first token honors the request's sampling params too
-                tok = req.pick(np.asarray(logits[i])) \
-                    if req.temperature > 0.0 else int(nxt[i])
-                req.next_token = tok
-                req.output.append(tok)
-            self._slots[slot] = req
-            if req.done:
-                self.finished.append(req)
-                self._release(slot)
+                self._seed_first_token(slot, req, np.asarray(logits[i]))
 
     def _scatter_prompt(self, slot, kq, vq, S):
         """Scatter a prompt's per-layer K/V (L, KVH, S, D) into fresh
@@ -644,18 +669,12 @@ class ServingEngine:
         req.slot = slot
         req._admit_order = self._order
         self._order += 1
+        self._slots[slot] = req
         if getattr(req, "_resume", False):
             req._resume = False  # next_token survives from before eviction
         else:
-            row = np.asarray(logits).reshape(-1)
-            first = req.pick(row) if req.temperature > 0.0 \
-                else int(np.argmax(row))
-            req.next_token = first
-            req.output.append(first)
-        self._slots[slot] = req
-        if req.done:  # e.g. max_new_tokens == 1
-            self.finished.append(req)
-            self._release(slot)
+            self._seed_first_token(slot, req,
+                                   np.asarray(logits).reshape(-1))
 
     def _preempt_one(self, exclude):
         """Evict the most-recently admitted active request (never
@@ -665,8 +684,12 @@ class ServingEngine:
         back, no recompute); under "recompute" resume re-prefills
         prompt + generated-so-far. Returns False when nothing can be
         evicted."""
+        # mid-chunked-prefill slots are not evictable: their cache state
+        # is a prompt prefix with no pending token, which neither resume
+        # path models (they hold few pages that early anyway)
         victims = [s for s, r in enumerate(self._slots)
-                   if r is not None and s != exclude]
+                   if r is not None and s != exclude
+                   and not self._prefilling(r)]
         if not victims:
             return False
         s = max(victims, key=lambda v: self._slots[v]._admit_order)
@@ -719,6 +742,24 @@ class ServingEngine:
         req._admit_order = self._order
         self._order += 1
         self._slots[slot] = req
+
+    @staticmethod
+    def _prefilling(req):
+        """True while a chunked-prefill admission still has prompt
+        tokens to feed."""
+        feed = getattr(req, "_pf_feed", None)
+        return feed is not None and req._pf_cursor < len(feed)
+
+    def _seed_first_token(self, slot, req, row):
+        """Sample/argmax the first generated token from the prefill's
+        final-position logits `row` (np, (V,)) — single source for the
+        monolithic, varlen-batch, and chunked prefill completions."""
+        tok = req.pick(row) if req.temperature > 0.0 else int(np.argmax(row))
+        req.next_token = tok
+        req.output.append(tok)
+        if req.done:  # e.g. max_new_tokens == 1
+            self.finished.append(req)
+            self._release(slot)
 
     # -- decode loop ------------------------------------------------------
     def step(self):
@@ -795,6 +836,14 @@ class ServingEngine:
         for s in active_slots:
             req = self._slots[s]
             active[s] = True
+            if self._prefilling(req):
+                # chunked prefill: the chunk is the next G prompt tokens
+                feed, cur = req._pf_feed, req._pf_cursor
+                n = min(G, len(feed) - cur)
+                tokens[s, :n] = feed[cur:cur + n]
+                n_tok[s] = n
+                self.prefill_tokens += n
+                continue
             tokens[s, 0] = req.next_token
             cur = int(self.lengths[s])
             room = self.max_seq_len - cur - 1
@@ -819,9 +868,10 @@ class ServingEngine:
                 while not self._free:
                     if not self._preempt_one(exclude=s):
                         raise RuntimeError(
-                            "serving: KV page pool exhausted with a "
-                            "single active sequence — num_pages is too "
-                            "small for max_seq_len")
+                            "serving: KV page pool exhausted with no "
+                            "evictable sequence (mid-prefill slots are "
+                            "not victims) — num_pages is too small for "
+                            "max_seq_len")
                 self._alloc_pages(s, 1)
         active_slots = [s for s, r in enumerate(self._slots)
                         if r is not None]
@@ -840,10 +890,20 @@ class ServingEngine:
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)
         sampled = {s: np.asarray(logits[s, 0])
                    for s in active_slots
-                   if self._slots[s].temperature > 0.0}
+                   if self._slots[s].temperature > 0.0
+                   and not self._prefilling(self._slots[s])}
         for s in active_slots:
             req = self._slots[s]
             n = int(n_tok[s])
+            if self._prefilling(req):
+                # chunk fed; emit nothing until the prompt is complete,
+                # then the final position's logits seed generation
+                req._pf_cursor += n
+                self.lengths = self.lengths.at[s].add(n)
+                if req._pf_cursor >= len(req._pf_feed) and req._pf_sample:
+                    self._seed_first_token(s, req,
+                                           np.asarray(logits[s, n - 1]))
+                continue
             if s in sampled:
                 outs = [req.pick(sampled[s])]
                 n = 1

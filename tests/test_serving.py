@@ -551,3 +551,87 @@ class TestSpeculativeDecoding:
         # every page back on the free list (trash page never joins)
         assert sorted(eng._free) == list(range(12 - 1))
         assert all(not p for p in eng._seq_pages.values())
+
+
+class TestChunkedPrefill:
+    """Chunked prefill over the verify chunk (reference parity:
+    PaddleNLP/vLLM split-fuse): prompts feed G tokens per step so
+    decoders never stall behind a long prompt; outputs stay exact."""
+
+    def test_requires_spec(self, params):
+        with pytest.raises(ValueError, match="spec_decode"):
+            ServingEngine(params, CFG, chunked_prefill=True)
+
+    def test_chunked_matches_dense(self, params):
+        prompt = list(np.random.RandomState(3).randint(1, 64, 21))
+        ref = greedy_reference(params, prompt, 8)
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False, spec_decode=4,
+                            chunked_prefill=True)
+        eng.submit(Request("c", prompt, max_new_tokens=8))
+        done = eng.run()
+        assert done[0].output == ref
+        # prompt fed in ceil(21/4)=6 chunks, all through verify steps
+        assert eng.prefill_tokens == 21
+
+    def test_decode_interleaves_with_long_prefill(self, params):
+        """A decoding request must EMIT tokens during the very steps a
+        long prompt is still chunk-feeding — not merely coexist."""
+        short, long = [5, 3], list(np.random.RandomState(4).randint(1, 64, 40))
+        ref_s = greedy_reference(params, short, 10)
+        ref_l = greedy_reference(params, long, 6)
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False, spec_decode=4,
+                            chunked_prefill=True)
+        eng.submit(Request("short", short, max_new_tokens=10))
+        eng.step()   # admits short, feeds its first chunk
+        eng.submit(Request("long", long, max_new_tokens=6))
+        progressed_during_prefill = 0
+        for _ in range(40):
+            sreq = next((r for r in eng._slots
+                         if r is not None and r.rid == "short"), None)
+            lreq = next((r for r in eng._slots
+                         if r is not None and r.rid == "long"), None)
+            before = len(sreq.output) if sreq is not None else None
+            mid_prefill = lreq is not None and eng._prefilling(lreq)
+            if not eng.step():
+                break
+            if (before is not None and mid_prefill
+                    and sreq.output and len(sreq.output) > before):
+                progressed_during_prefill += 1
+        got = {r.rid: r.output for r in eng.finished}
+        assert got["short"] == ref_s and got["long"] == ref_l
+        assert progressed_during_prefill > 0, (
+            "short emitted nothing while the long prompt prefilled")
+
+    def test_chunked_with_sampling_and_mixed_batch(self, params):
+        prompt = list(np.random.RandomState(5).randint(1, 64, 17))
+        plain = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                              page_size=8, use_pallas=False)
+        plain.submit(Request("t", prompt, max_new_tokens=5,
+                             temperature=0.7, top_k=8, seed=3))
+        plain.run()
+        chunked = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                                page_size=8, use_pallas=False,
+                                spec_decode=4, chunked_prefill=True)
+        chunked.submit(Request("t", prompt, max_new_tokens=5,
+                               temperature=0.7, top_k=8, seed=3))
+        chunked.run()
+        assert chunked.finished[0].output == plain.finished[0].output
+
+    def test_two_long_prompts_small_pool_no_deadlock(self, params):
+        """Admission must reserve a chunked prompt's REMAINING pages:
+        with a pool that holds only one long prompt, the second queues
+        instead of deadlocking mid-prefill (no evictable victim)."""
+        long_a = list(np.random.RandomState(8).randint(1, 64, 40))
+        long_b = list(np.random.RandomState(9).randint(1, 64, 40))
+        refs = {"a": greedy_reference(params, long_a, 4),
+                "b": greedy_reference(params, long_b, 4)}
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=48,
+                            page_size=8, use_pallas=False, spec_decode=4,
+                            chunked_prefill=True, num_pages=10)
+        eng.submit(Request("a", long_a, max_new_tokens=4))
+        eng.submit(Request("b", long_b, max_new_tokens=4))
+        done = eng.run(max_steps=300)
+        got = {r.rid: r.output for r in done}
+        assert got == refs
