@@ -1,0 +1,136 @@
+"""DLRM-style recommendation model over host-RAM sparse tables.
+
+Reference parity: the reference's rec-sys stack — PaddleRec models
+driven by paddle.distributed.ps (the_one_ps.py) with
+paddle.static.nn.sparse_embedding feature tables — is WHY the PS tier
+exists. TPU-native split:
+
+  * sparse feature embeddings live in host-RAM SparseTable shards
+    (distributed/ps_impl.py — beyond-HBM capacity, per-row optimizer),
+    pulled per batch as plain inputs;
+  * the dense tower (bottom MLP over dense features, pairwise feature
+    interaction, top MLP) is a pure jitted function on device — its
+    params train with any device optimizer;
+  * one step = host pull → device fwd+bwd (grads for BOTH dense params
+    and the pulled rows) → host push. No side effects under jit.
+
+Model shape follows the standard DLRM: bottom MLP embeds dense
+features to the embedding dim, dot-product interaction across all
+(sparse + dense) feature vectors, top MLP on [dense_vec, interactions]
+→ CTR logit.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _mlp_params(rng, dims):
+    ps = []
+    for i in range(len(dims) - 1):
+        scale = (2.0 / dims[i]) ** 0.5
+        ps.append({"w": jnp.asarray(rng.randn(dims[i], dims[i + 1]) * scale,
+                                    jnp.float32),
+                   "b": jnp.zeros((dims[i + 1],), jnp.float32)})
+    return ps
+
+
+def _mlp(params, x, final_act=True):
+    for i, lp in enumerate(params):
+        x = x @ lp["w"] + lp["b"]
+        if i < len(params) - 1 or final_act:
+            x = jax.nn.relu(x)
+    return x
+
+
+class DLRMConfig:
+    def __init__(self, emb_dim=16, n_sparse=8, dense_dim=13,
+                 bottom=(64, 32), top=(64, 32)):
+        self.emb_dim = emb_dim
+        self.n_sparse = n_sparse          # sparse feature fields
+        self.dense_dim = dense_dim        # continuous features
+        self.bottom = tuple(bottom)
+        self.top = tuple(top)
+
+
+def init_dense_params(cfg: DLRMConfig, seed=0):
+    """Device-side (tower) params; the embedding tables live in the PS."""
+    rng = np.random.RandomState(seed)
+    n_vec = cfg.n_sparse + 1              # + the bottom-MLP dense vector
+    n_int = n_vec * (n_vec - 1) // 2      # upper-triangle interactions
+    return {
+        "bottom": _mlp_params(rng, (cfg.dense_dim,) + cfg.bottom
+                              + (cfg.emb_dim,)),
+        "top": _mlp_params(rng, (cfg.emb_dim + n_int,) + cfg.top + (1,)),
+    }
+
+
+def dlrm_forward(dense_params, emb_rows, dense_x, cfg: DLRMConfig):
+    """emb_rows: (B, n_sparse, emb_dim) pulled rows; dense_x:
+    (B, dense_dim). → logits (B,)."""
+    dv = _mlp(dense_params["bottom"], dense_x)          # (B, E)
+    vecs = jnp.concatenate([dv[:, None], emb_rows], 1)  # (B, F, E)
+    inter = jnp.einsum("bfe,bge->bfg", vecs, vecs)      # (B, F, F)
+    iu, ju = np.triu_indices(vecs.shape[1], k=1)
+    feats = jnp.concatenate([dv, inter[:, iu, ju]], -1)
+    return _mlp(dense_params["top"], feats,
+                final_act=False)[..., 0]                # (B,)
+
+
+def make_dlrm_step(cfg: DLRMConfig, lr=0.01):
+    """Jitted (dense_params, unique_rows, inverse, dense_x, labels) →
+    (new_dense_params, grad_unique_rows, loss). Dense tower trains with
+    plain SGD in-step; the caller pushes grad_unique_rows to the PS
+    (whose per-row rule may be sgd/adagrad/adam independently)."""
+
+    @jax.jit
+    def step(dense_params, rows, inv, dense_x, labels):
+        def loss_fn(dp, r):
+            emb = r[inv]                              # (B, n_sparse, E)
+            logit = dlrm_forward(dp, emb, dense_x, cfg)
+            return jnp.mean(
+                jax.nn.softplus(jnp.where(labels > 0, -logit, logit)))
+        loss, (g_dense, g_rows) = jax.value_and_grad(
+            loss_fn, (0, 1))(dense_params, rows)
+        new_dense = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, dense_params, g_dense)
+        return new_dense, g_rows, loss
+
+    return step
+
+
+class DLRMTrainer:
+    """Host loop wiring the PS pull/push around the jitted step.
+
+    client: distributed.ps PSClient over the sparse tables (one shared
+    table keyed by hashed (field, id) — the reference's distributed
+    sparse_embedding convention); ids: (B, n_sparse) int64 feature ids
+    (globally unique per field, e.g. pre-hashed with a field salt).
+    """
+
+    def __init__(self, cfg: DLRMConfig, client, seed=0, lr=0.01):
+        from ..distributed.ps import DistributedEmbedding
+        self.cfg = cfg
+        self.emb = DistributedEmbedding(client, cfg.emb_dim)
+        self.dense_params = init_dense_params(cfg, seed)
+        self.step_fn = make_dlrm_step(cfg, lr=lr)
+
+    def train_step(self, ids, dense_x, labels):
+        rows, inv, uniq = self.emb.lookup(ids)
+        # pad the unique-row axis to a power-of-two bucket: its length
+        # is data-dependent (distinct ids per batch), and an unpadded
+        # shape would trigger one XLA compile per distinct count
+        U = len(uniq)
+        cap = 1 << max(0, math.ceil(math.log2(max(U, 1))))
+        if cap > U:
+            rows = np.concatenate(
+                [rows, np.zeros((cap - U, rows.shape[1]), rows.dtype)])
+        self.dense_params, g_rows, loss = self.step_fn(
+            self.dense_params, jnp.asarray(rows), jnp.asarray(inv),
+            jnp.asarray(dense_x, jnp.float32),
+            jnp.asarray(labels, jnp.float32))
+        self.emb.apply_grads(uniq, np.asarray(g_rows)[:U])
+        return float(loss)

@@ -519,11 +519,11 @@ class ServingEngine:
             def _reserve(s):
                 r = self._slots[s]
                 if self._prefilling(r):
-                    # a mid-prefill slot is NOT evictable, so its whole
-                    # remaining prompt must stay reserved — lazily
-                    # allocated, but spoken for (otherwise two long
-                    # prompts admit concurrently into a pool that can
-                    # hold only one and deadlock with no victim)
+                    # keep a mid-prefill slot's whole remaining prompt
+                    # reserved (lazily allocated, but spoken for):
+                    # admitting a second long prompt into pages the
+                    # first will certainly need would just thrash
+                    # admit -> evict cycles
                     horizon = len(r._pf_feed)
                 else:
                     horizon = min(int(self.lengths[s]) + G,
@@ -573,9 +573,11 @@ class ServingEngine:
             elif self.chunked_prefill:
                 req._pf_feed = self._feed_ids(req)
                 req._pf_cursor = 0
-                # a recompute-resume keeps its pending next_token — the
-                # final chunk must not re-sample it
-                req._pf_sample = not getattr(req, "_resume", False)
+                # seed the first token iff it was never seeded: a
+                # resumed DECODING request keeps its pending next_token
+                # (output non-empty), while a fresh request or a victim
+                # evicted mid-prefill (output still empty) needs one
+                req._pf_sample = not req.output
                 req._resume = False
                 req.slot = slot
                 req._admit_order = self._order
@@ -684,12 +686,12 @@ class ServingEngine:
         back, no recompute); under "recompute" resume re-prefills
         prompt + generated-so-far. Returns False when nothing can be
         evicted."""
-        # mid-chunked-prefill slots are not evictable: their cache state
-        # is a prompt prefix with no pending token, which neither resume
-        # path models (they hold few pages that early anyway)
+        # mid-chunked-prefill slots ARE evictable: their chunk state
+        # (_pf_feed/_pf_cursor) lives on the Request, so offload resumes
+        # the feed exactly where it stopped (cursor == saved length) and
+        # recompute re-feeds the prompt from the start
         victims = [s for s, r in enumerate(self._slots)
-                   if r is not None and s != exclude
-                   and not self._prefilling(r)]
+                   if r is not None and s != exclude]
         if not victims:
             return False
         s = max(victims, key=lambda v: self._slots[v]._admit_order)
@@ -868,10 +870,9 @@ class ServingEngine:
                 while not self._free:
                     if not self._preempt_one(exclude=s):
                         raise RuntimeError(
-                            "serving: KV page pool exhausted with no "
-                            "evictable sequence (mid-prefill slots are "
-                            "not victims) — num_pages is too small for "
-                            "max_seq_len")
+                            "serving: KV page pool exhausted with a "
+                            "single active sequence — num_pages is too "
+                            "small for max_seq_len")
                 self._alloc_pages(s, 1)
         active_slots = [s for s, r in enumerate(self._slots)
                         if r is not None]

@@ -1,0 +1,80 @@
+"""DLRM over PS sparse tables (models/dlrm.py; reference: PaddleRec
+models on the_one_ps + paddle.static.nn.sparse_embedding)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.distributed.ps import PSClient, SparseTable
+from paddle_tpu.models.dlrm import (DLRMConfig, DLRMTrainer,
+                                    dlrm_forward, init_dense_params)
+
+
+CFG = DLRMConfig(emb_dim=8, n_sparse=4, dense_dim=5, bottom=(16,),
+                 top=(16,))
+
+
+def _batch(rng, b=32, vocab=500):
+    # per-field salted ids so fields never collide in the shared table
+    ids = rng.randint(0, vocab, (b, CFG.n_sparse)).astype(np.int64)
+    ids += np.arange(CFG.n_sparse, dtype=np.int64)[None] * 1_000_003
+    dense = rng.randn(b, CFG.dense_dim).astype(np.float32)
+    # learnable synthetic CTR: label depends on one dense feature and
+    # on whether the first sparse id is even
+    y = ((dense[:, 0] + (ids[:, 0] % 2) * 1.5 - 0.7) > 0).astype(np.float32)
+    return ids, dense, y
+
+
+class TestDLRM:
+    def test_forward_shapes(self):
+        rng = np.random.RandomState(0)
+        dp = init_dense_params(CFG, seed=0)
+        rows = jnp.asarray(rng.randn(6, CFG.n_sparse, CFG.emb_dim),
+                           jnp.float32)
+        x = jnp.asarray(rng.randn(6, CFG.dense_dim), jnp.float32)
+        logit = dlrm_forward(dp, rows, x, CFG)
+        assert logit.shape == (6,)
+        assert np.isfinite(np.asarray(logit)).all()
+
+    def test_trains_on_synthetic_ctr(self):
+        rng = np.random.RandomState(1)
+        client = PSClient([SparseTable(CFG.emb_dim, optimizer="adagrad",
+                                       lr=0.05, seed=2)
+                           for _ in range(2)])
+        tr = DLRMTrainer(CFG, client, seed=0, lr=0.05)
+        first = last = None
+        for it in range(60):
+            ids, dense, y = _batch(rng)
+            loss = tr.train_step(ids, dense, y)
+            if it == 0:
+                first = loss
+            last = loss
+        assert np.isfinite(last)
+        assert last < first * 0.75, (first, last)
+        # the PS materialized only touched rows, sharded across servers
+        assert 0 < len(client) <= 60 * 32 * CFG.n_sparse
+        assert all(len(s) > 0 for s in client.shards)
+
+    def test_sparse_signal_is_learned(self):
+        """Accuracy beats a dense-only model on a label that depends on
+        a sparse id — proof the embedding path carries signal."""
+        rng = np.random.RandomState(3)
+        client = PSClient([SparseTable(CFG.emb_dim, optimizer="adagrad",
+                                       lr=0.1, seed=4)])
+        tr = DLRMTrainer(CFG, client, seed=1, lr=0.05)
+        # small id space so ids repeat and embeddings get many updates
+        def small_batch():
+            ids = rng.randint(0, 40, (64, CFG.n_sparse)).astype(np.int64)
+            ids += np.arange(CFG.n_sparse, dtype=np.int64)[None] * 1_000_003
+            dense = rng.randn(64, CFG.dense_dim).astype(np.float32) * 0.1
+            y = (ids[:, 0] % 2).astype(np.float32)   # purely sparse signal
+            return ids, dense, y
+        for _ in range(150):
+            ids, dense, y = small_batch()
+            tr.train_step(ids, dense, y)
+        ids, dense, y = small_batch()
+        rows, inv, _ = tr.emb.lookup(ids)
+        logit = dlrm_forward(tr.dense_params,
+                             jnp.asarray(rows)[jnp.asarray(inv)],
+                             jnp.asarray(dense), CFG)
+        acc = float(np.mean((np.asarray(logit) > 0) == (y > 0)))
+        assert acc > 0.9, acc

@@ -635,3 +635,26 @@ class TestChunkedPrefill:
         done = eng.run(max_steps=300)
         got = {r.rid: r.output for r in done}
         assert got == refs
+
+    def test_mid_prefill_slot_is_evictable(self, params):
+        """Decode growth under pool pressure may evict a mid-prefill
+        neighbor; both requests still finish with exact outputs (the
+        victim resumes its feed via offload, or re-feeds via
+        recompute)."""
+        for policy in ("offload", "recompute"):
+            deco = list(np.random.RandomState(10).randint(1, 64, 6))
+            long_p = list(np.random.RandomState(11).randint(1, 64, 32))
+            ref_d = greedy_reference(params, deco, 26)
+            ref_l = greedy_reference(params, long_p, 4)
+            eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=40,
+                                page_size=8, use_pallas=False,
+                                spec_decode=4, chunked_prefill=True,
+                                num_pages=7, preempt_policy=policy)
+            eng.submit(Request("d", deco, max_new_tokens=26))
+            for _ in range(3):
+                eng.step()      # d decoding, holds pages
+            eng.submit(Request("l", long_p, max_new_tokens=4))
+            done = eng.run(max_steps=400)
+            got = {r.rid: r.output for r in done}
+            assert got["d"] == ref_d, policy
+            assert got["l"] == ref_l, policy
