@@ -101,11 +101,23 @@ def _tuned_defaults():
     return data.get("best", {})
 
 
-def _last_tpu_history():
-    """Most recent TPU entry from BENCH_HISTORY.jsonl, or None."""
+def _tpu_history():
+    """(most recent, best-strict-MFU) TPU entries from
+    BENCH_HISTORY.jsonl — after an autotune sweep the most RECENT entry
+    can be a mediocre trial config, so the best entry must ride along
+    or a tunnel-down driver run understates the real headline."""
     here = os.path.dirname(os.path.abspath(__file__))
     path = os.path.join(here, "BENCH_HISTORY.jsonl")
-    last = None
+    last = best = None
+
+    def _pick(e):
+        out = {k: e[k] for k in
+               ("metric", "value", "unit", "vs_baseline", "ts", "batch",
+                "seq", "remat", "fused_ce", "n_micro", "docs") if k in e}
+        out["mfu"] = e["extra"].get("mfu")
+        out["mfu_legacy"] = e["extra"].get("mfu_legacy")
+        return out
+
     try:
         with open(path) as f:
             for line in f:
@@ -119,15 +131,21 @@ def _last_tpu_history():
                 # llama-headline entries only (they carry top-level
                 # batch/seq); bench_models.py rows must not masquerade as
                 # the pretrain datapoint
-                if e.get("extra", {}).get("backend") not in (None, "cpu") \
-                        and "batch" in e and "seq" in e:
-                    last = {k: e[k] for k in
-                            ("metric", "value", "unit", "vs_baseline",
-                             "ts", "batch", "seq", "remat") if k in e}
-                    last["mfu"] = e["extra"].get("mfu")
+                if e.get("extra", {}).get("backend") in (None, "cpu") \
+                        or "batch" not in e or "seq" not in e:
+                    continue
+                last = _pick(e)
+                # pre-r3 entries recorded LEGACY mfu under the "mfu"
+                # key (no mfu_legacy field) — comparing that against
+                # strict values would crown a stale legacy number, so
+                # only strict-convention entries compete for "best"
+                if e.get("extra", {}).get("mfu") is not None and \
+                        e["extra"].get("mfu_legacy") is not None and \
+                        (best is None or e["extra"]["mfu"] > best["mfu"]):
+                    best = _pick(e)
     except OSError:
-        return None
-    return last
+        return None, None
+    return last, best
 
 
 def main():
@@ -325,20 +343,23 @@ def main():
     }
     if not on_tpu:
         # the chip tunnel comes and goes; if it is down right now, surface
-        # the most recent REAL TPU measurement (clearly labeled with its
-        # timestamp) alongside the smoke number instead of erasing it
-        last = _last_tpu_history()
+        # the most recent AND the best REAL TPU measurements (clearly
+        # labeled with timestamps) alongside the smoke number instead of
+        # erasing them
+        last, best = _tpu_history()
         if last is not None:
             result["extra"]["last_tpu_measured"] = last
+        if best is not None:
+            result["extra"]["best_tpu_measured"] = best
     print(json.dumps(result))
     # perf-regression history: tests/test_perf_guard.py compares the last
     # two same-backend/same-config entries
     try:
         # history entry: shallow-copy extra WITHOUT the nested
-        # last_tpu_measured report field (it would re-embed the previous
-        # TPU entry into every CPU line)
+        # last/best_tpu_measured report fields (they would re-embed
+        # previous TPU entries into every CPU line)
         extra = {k: v for k, v in result["extra"].items()
-                 if k != "last_tpu_measured"}
+                 if k not in ("last_tpu_measured", "best_tpu_measured")}
         hist = dict(result, extra=extra, ts=time.time(), batch=batch,
                     seq=seq, remat=str(remat), n_micro=n_micro,
                     docs=docs or None, fused_ce=fused_ce,
