@@ -137,10 +137,13 @@ def _tpu_history():
                 last = _pick(e)
                 # pre-r3 entries recorded LEGACY mfu under the "mfu"
                 # key (no mfu_legacy field) — comparing that against
-                # strict values would crown a stale legacy number, so
-                # only strict-convention entries compete for "best"
+                # strict values would crown a stale legacy number — and
+                # a pallas_fallback run executed the XLA path, which
+                # must never be presented as the pallas headline: both
+                # sit out the "best" competition
                 if e.get("extra", {}).get("mfu") is not None and \
                         e["extra"].get("mfu_legacy") is not None and \
+                        not e["extra"].get("pallas_fallback") and \
                         (best is None or e["extra"]["mfu"] > best["mfu"]):
                     best = _pick(e)
     except OSError:
@@ -162,7 +165,7 @@ def main():
         # a half-wedged tunnel can hang (or die) AFTER device init, which
         # would leave the driver with no output line at all. Run the real
         # bench in a guarded child; on timeout OR crash fall back to the
-        # CPU smoke (which still surfaces last_tpu_measured).
+        # CPU smoke (which still surfaces last/best_tpu_measured).
         import subprocess
         env = dict(os.environ, _PT_BENCH_GUARDED="1")
         try:
