@@ -275,8 +275,46 @@ def bench_input(on_tpu):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_dlrm(on_tpu):
+    """DLRM rec-sys train step: host PS pull/push racing the jitted
+    dense tower (reference: PaddleRec on the_one_ps). The number to
+    watch is examples/sec with the PS round-trip included."""
+    from paddle_tpu.distributed.ps import PSClient, SparseTable
+    from paddle_tpu.models.dlrm import DLRMConfig, DLRMTrainer
+
+    if on_tpu:
+        cfg = DLRMConfig(emb_dim=64, n_sparse=26, dense_dim=13,
+                         bottom=(512, 256), top=(512, 256))
+        bs, iters, vocab, shards = 4096, 10, 1_000_000, 4
+    else:
+        cfg = DLRMConfig(emb_dim=8, n_sparse=4, dense_dim=5, bottom=(16,),
+                         top=(16,))
+        bs, iters, vocab, shards = 128, 3, 1000, 2
+    client = PSClient([SparseTable(cfg.emb_dim, optimizer="adagrad",
+                                   lr=0.05, seed=s) for s in range(shards)])
+    tr = DLRMTrainer(cfg, client, seed=0, lr=0.05)
+    rng = np.random.RandomState(0)
+
+    def batch():
+        ids = rng.randint(0, vocab, (bs, cfg.n_sparse)).astype(np.int64)
+        ids += np.arange(cfg.n_sparse, dtype=np.int64)[None] * (vocab * 2 + 1)
+        dense = rng.randn(bs, cfg.dense_dim).astype(np.float32)
+        y = (rng.rand(bs) > 0.7).astype(np.float32)
+        return ids, dense, y
+
+    loss = tr.train_step(*batch())     # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = tr.train_step(*batch())
+    dt = (time.perf_counter() - t0) / iters
+    return {"examples_per_sec": round(bs / dt, 1), "batch": bs,
+            "rows_materialized": len(client), "shards": shards,
+            "step_time_s": round(dt, 4), "loss": float(loss)}
+
+
 BENCHES = {"resnet50": bench_resnet50, "bert": bench_bert, "moe": bench_moe,
-           "serving": bench_serving, "input": bench_input}
+           "serving": bench_serving, "input": bench_input,
+           "dlrm": bench_dlrm}
 
 
 def main():

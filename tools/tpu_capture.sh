@@ -90,7 +90,7 @@ PT_SERVE_SPEC=4 timeout 1800 python bench_models.py serving 2>&1 | tail -2
 alive || { echo "CAPTURE_ABORT tunnel dead after step 5"; exit 2; }
 
 # 6. remaining per-model benches
-for m in resnet50 bert moe input; do
+for m in resnet50 bert moe input dlrm; do
   timeout 1800 python bench_models.py "$m" 2>&1 | tail -2
   alive || { echo "CAPTURE_ABORT tunnel dead during step 6 ($m)"; exit 2; }
 done
