@@ -11,7 +11,10 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
 import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 # NB: pin CPU via jax.config, NOT the JAX_PLATFORMS env var — the env var

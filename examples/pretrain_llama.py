@@ -10,6 +10,9 @@ failure detection, and the libptio-style packed-token data path.
 from __future__ import annotations
 
 import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 _os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 # default to CPU unless explicitly aimed at the chip: the axon TPU tunnel
