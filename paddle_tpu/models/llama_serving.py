@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
-from ..ops.paged_attention import paged_attention, quantize_kv
+from ..ops.paged_attention import (paged_attention, paged_verify_attention,
+                                   quantize_kv)
 from ..ops.varlen_attention import (flash_attention_varlen,
                                     seg_ids_from_cu_seqlens)
 from .llama import LlamaConfig
@@ -214,9 +215,11 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("config", "page_size"))
+                   static_argnames=("config", "page_size", "use_pallas",
+                                    "interpret"))
 def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
                 n_tok, active, config: LlamaConfig, page_size,
+                use_pallas=False, interpret=False,
                 k_scale=None, v_scale=None):
     """Speculative-decoding verify: G chunk tokens per slot in ONE
     forward — every matmul runs at (B, G, ...) so one weight read
@@ -238,19 +241,17 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
     are legitimately reached. Returns (k_pool, v_pool, k_scale, v_scale,
     logits (B, G, V)) — logits[:, g] follows chunk token g.
 
-    Attention gathers the slot's pages into a contiguous (B, S_max)
-    key/value view and runs a masked dense block (G x S_max scores, G
-    small) — one read of the same KV bytes paged attention reads; the
-    page gather is the acknowledged cost vs a multi-query paged pallas
-    kernel (the single-token kernel stays the steady-state decode path).
+    Attention runs the multi-query paged kernel
+    (ops/paged_attention.paged_verify_attention): pages stream
+    HBM→VMEM via scalar-prefetch index maps with a per-row causal
+    limit — no contiguous gather of the cache. Off-TPU the XLA
+    reference (gather + masked dense block) runs instead.
     """
     c = config
     nh, nkv = c.num_attention_heads, c.num_key_value_heads
     hd = c.hidden_size // nh
     B, G = tokens.shape
     Pn = k_pool.shape[2]
-    n_pages = page_table.shape[1]
-    S_pad = n_pages * page_size
     quant = k_scale is not None
 
     pos = lengths[:, None] + jnp.arange(G)[None, :]          # (B, G)
@@ -262,9 +263,6 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
     real = active[:, None] & (jnp.arange(G)[None, :] < n_tok[:, None])
     page_ids = jnp.where(real, page_ids, Pn - 1)             # trash page
     off = pos % page_size                                    # (B, G)
-    # key mask: token g attends to absolute positions 0..lengths+g
-    key_pos = jnp.arange(S_pad)[None, None, :]               # (1, 1, S)
-    mask = key_pos <= pos[:, :, None]                        # (B, G, S)
 
     def layer(carry, xs):
         h, kp, vp, ksp, vsp = carry
@@ -278,23 +276,11 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
         vt = v.swapaxes(0, 1)
         kp, vp, ksp, vsp, kl, vl, ksl, vsl = _scatter_kv(
             kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
-
-        # contiguous (B, KVH, S_pad, D) view of this slot's pages
-        ks = kl[:, page_table].reshape(nkv, B, S_pad, hd).swapaxes(0, 1)
-        vs = vl[:, page_table].reshape(nkv, B, S_pad, hd).swapaxes(0, 1)
-        if quant:
-            kss = ksl[:, page_table].reshape(nkv, B, S_pad, 1).swapaxes(0, 1)
-            vss = vsl[:, page_table].reshape(nkv, B, S_pad, 1).swapaxes(0, 1)
-            ks = ks.astype(jnp.float32) * kss
-            vs = vs.astype(jnp.float32) * vss
-        if nh != nkv:
-            ks = jnp.repeat(ks, nh // nkv, axis=1)
-            vs = jnp.repeat(vs, nh // nkv, axis=1)
-        scores = jnp.einsum("bhgd,bhsd->bhgs", q.astype(jnp.float32),
-                            ks.astype(jnp.float32)) / math.sqrt(hd)
-        scores = jnp.where(mask[:, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhgs,bhsd->bhgd", probs, vs.astype(jnp.float32))
+        # q: (B, QH, G, D); per-row causal limit base+g inside the op
+        o = paged_verify_attention(q, kl, vl, page_table, lengths,
+                                   use_pallas=use_pallas,
+                                   interpret=interpret,
+                                   k_scale=ksl, v_scale=vsl)
         o = o.swapaxes(1, 2).reshape(B, G, nh * hd)
         h = h + o.astype(h.dtype) @ lp["wo"]
         x = _rms(h, lp["ln2"], c.rms_norm_eps)
@@ -886,6 +872,7 @@ class ServingEngine:
             self.params, self.k_pool, self.v_pool, self.page_table,
             self.lengths, jnp.asarray(tokens), jnp.asarray(n_tok),
             jnp.asarray(active), self.config, self.page_size,
+            use_pallas=self._use_pallas, interpret=self._interpret,
             k_scale=self.k_scale, v_scale=self.v_scale)
         self.device_steps += 1
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)

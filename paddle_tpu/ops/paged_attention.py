@@ -68,16 +68,11 @@ def dequantize_kv(q, scale):
 # ---------------------------------------------------------------------------
 # Reference (XLA) implementation
 # ---------------------------------------------------------------------------
-def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
-                              sm_scale=None, k_scale=None, v_scale=None):
-    """q: (B, QH, D); pages: (KVH, P, page, D); page_table: (B, pages_per_seq);
-    lengths: (B,). k_scale/v_scale: (KVH, P, page, 1) fp32 when the
-    pages are int8-quantized. Returns (B, QH, D)."""
-    b, qh, d = q.shape
-    kvh, _, page, _ = k_pages.shape
-    group = qh // kvh
-    scale = sm_scale if sm_scale is not None else d ** -0.5
-    # gather this batch's pages: (B, KVH, pages_per_seq*page, D)
+def _gather_pages(k_pages, v_pages, page_table, k_scale, v_scale):
+    """(B, KVH, pages_per_seq*page, D) contiguous dequantized views of
+    each sequence's pages — shared by both XLA reference paths."""
+    b = page_table.shape[0]
+    kvh, _, _, d = k_pages.shape
     k = jnp.swapaxes(k_pages[:, page_table], 0, 1).reshape(b, kvh, -1, d)
     v = jnp.swapaxes(v_pages[:, page_table], 0, 1).reshape(b, kvh, -1, d)
     if k_scale is not None:  # dequantize the gathered slices only
@@ -85,6 +80,19 @@ def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
         vs = jnp.swapaxes(v_scale[:, page_table], 0, 1).reshape(b, kvh, -1, 1)
         k = dequantize_kv(k, ks)
         v = dequantize_kv(v, vs)
+    return k, v
+
+
+def paged_attention_reference(q, k_pages, v_pages, page_table, lengths,
+                              sm_scale=None, k_scale=None, v_scale=None):
+    """q: (B, QH, D); pages: (KVH, P, page, D); page_table: (B, pages_per_seq);
+    lengths: (B,). k_scale/v_scale: (KVH, P, page, 1) fp32 when the
+    pages are int8-quantized. Returns (B, QH, D)."""
+    b, qh, d = q.shape
+    kvh = k_pages.shape[0]
+    group = qh // kvh
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    k, v = _gather_pages(k_pages, v_pages, page_table, k_scale, v_scale)
     qg = q.reshape(b, kvh, group, d).astype(jnp.float32)
     s = jnp.einsum("bhgd,bhkd->bhgk", qg, k.astype(jnp.float32)) * scale
     mask = jnp.arange(s.shape[-1])[None, None, None] < lengths[:, None, None,
@@ -243,6 +251,180 @@ def paged_attention(q, k_pages, v_pages, page_table, lengths, sm_scale=None,
     if pad:
         o = o[:, :, :group]
     return o.reshape(b, qh, d)
+
+
+# ---------------------------------------------------------------------------
+# Multi-query (verify-chunk) paged attention — speculative decoding /
+# chunked prefill: G chunk tokens per sequence attend against the paged
+# cache in one kernel, token g seeing keys 0 .. base+g (its own position
+# included; the chunk's K/V were scattered into the pages beforehand).
+# Same page-streaming structure as the decode kernel, with a per-ROW
+# column limit instead of a single per-sequence one.
+# ---------------------------------------------------------------------------
+def paged_verify_reference(q, k_pages, v_pages, page_table, base_lengths,
+                           sm_scale=None, k_scale=None, v_scale=None):
+    """q: (B, QH, G, D); pages as in paged_attention; base_lengths: (B,)
+    cache length BEFORE the chunk. Returns (B, QH, G, D)."""
+    b, qh, g, d = q.shape
+    kvh = k_pages.shape[0]
+    group = qh // kvh
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    k, v = _gather_pages(k_pages, v_pages, page_table, k_scale, v_scale)
+    qg = q.reshape(b, kvh, group, g, d).astype(jnp.float32)
+    s = jnp.einsum("bhxgd,bhkd->bhxgk", qg, k.astype(jnp.float32)) * scale
+    cols = jnp.arange(s.shape[-1])[None, None, None, None]
+    limit = (base_lengths[:, None, None, None, None]
+             + jnp.arange(g)[None, None, None, :, None] + 1)
+    s = jnp.where(cols < limit, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhxgk,bhkd->bhxgd", p, v.astype(jnp.float32))
+    return o.reshape(b, qh, g, d).astype(q.dtype)
+
+
+def _verify_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, page_size, n_pages,
+                   n_tok, ks_ref=None, vs_ref=None):
+    """q rows are (group_pad * n_tok): r = gg * n_tok + g — token
+    g = r % n_tok sees columns < base + g + 1."""
+    del ptab_ref
+    bi = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    base = len_ref[bi]
+
+    @pl.when(pi * page_size < base + n_tok)  # skip fully-masked pages
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)   # (group_pad*n_tok, d)
+        k = k_ref[0, 0].astype(jnp.float32)   # (page, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = pi * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        g_row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % n_tok
+        s = jnp.where(cols < base + g_row + 1, s, NEG_INF)
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - _fit_lanes(m_new, s.shape[-1]))
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * _fit_lanes(alpha, acc_ref.shape[-1]) + \
+            jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(pi == n_pages - 1)
+    def _fin():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] /
+                       _fit_lanes(l_safe, o_ref.shape[-1])).astype(o_ref.dtype)
+
+
+def _verify_quant_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                         vs_ref, o_ref, acc_ref, m_ref, l_ref, **kw):
+    _verify_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, ks_ref=ks_ref, vs_ref=vs_ref,
+                   **kw)
+
+
+def paged_verify_attention(q, k_pages, v_pages, page_table, base_lengths,
+                           sm_scale=None, use_pallas=None, interpret=None,
+                           k_scale=None, v_scale=None):
+    """Verify-chunk attention over a paged KV cache.
+
+    q: (B, QH, G, D); pages/page_table as paged_attention;
+    base_lengths: (B,) cache length BEFORE the chunk (token g of the
+    chunk sits at absolute position base+g and may attend through
+    itself). int8 pages take k_scale/v_scale exactly like the decode
+    kernel. Returns (B, QH, G, D).
+
+    This is the pallas replacement for the gather-based dense verify
+    block: pages stream HBM→VMEM via scalar-prefetch index maps (no
+    materialized contiguous copy), masked pages are skipped, and every
+    q row of the (group × G) block shares the one page read.
+    """
+    b, qh, g, d = q.shape
+    kvh = k_pages.shape[0]
+    group = qh // kvh
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if interpret is None:
+        interpret = False
+    if not use_pallas and not interpret:
+        return paged_verify_reference(q, k_pages, v_pages, page_table,
+                                      base_lengths, scale, k_scale, v_scale)
+    # rows: r = gg * G + g (head-major) so r % G recovers the token.
+    # Pad whole head-groups until (group_pad * G) hits the sublane tile
+    # (8): the smallest e with (group+e)*G % 8 == 0 is e = (-group) mod
+    # (8 / gcd(G, 8)) — padding a partial group would break the r % G
+    # token mapping, and an unaligned row block is a Mosaic rejection.
+    import math as _math
+    r_mod = MIN_GROUP // _math.gcd(g, MIN_GROUP)
+    extra_groups = (-group) % r_mod
+    group_pad = group + extra_groups
+    q5 = q.reshape(b, kvh, group, g, d)
+    if extra_groups:
+        q5 = jnp.pad(q5, ((0, 0), (0, 0), (0, extra_groups), (0, 0), (0, 0)))
+    q4 = q5.reshape(b, kvh, group_pad * g, d)
+
+    page_size = k_pages.shape[2]
+    n_pages = page_table.shape[1]
+    quant = k_scale is not None
+    page_spec = pl.BlockSpec((1, 1, page_size, d),
+                             lambda bi, hi, pi, ptab, lens:
+                             (hi, ptab[bi, pi], Z, Z))
+    in_specs = [
+        pl.BlockSpec((1, 1, group_pad * g, d),
+                     lambda bi, hi, pi, ptab, lens: (bi, hi, Z, Z)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [page_table.astype(jnp.int32),
+                base_lengths.astype(jnp.int32), q4, k_pages, v_pages]
+    if quant:
+        scale_spec = pl.BlockSpec((1, 1, page_size, 1),
+                                  lambda bi, hi, pi, ptab, lens:
+                                  (hi, ptab[bi, pi], Z, Z))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, kvh, n_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, group_pad * g, d),
+                               lambda bi, hi, pi, ptab, lens: (bi, hi, Z, Z)),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad * g, d), jnp.float32),
+            pltpu.VMEM((group_pad * g, LANES), jnp.float32),
+            pltpu.VMEM((group_pad * g, LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _verify_quant_kernel if quant else _verify_kernel,
+        scale=np.float32(scale), page_size=page_size, n_pages=n_pages,
+        n_tok=g)
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, group_pad * g, d), q.dtype),
+        interpret=interpret,
+    )(*operands)
+    o = o.reshape(b, kvh, group_pad, g, d)[:, :, :group]
+    return o.reshape(b, qh, g, d)
 
 
 # ---------------------------------------------------------------------------

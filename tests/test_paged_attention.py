@@ -209,3 +209,84 @@ class TestPagedKVCache:
             p = np.exp(s - s.max()); p /= p.sum()
             expect = p @ vs[:, kh]
             assert np.allclose(np.asarray(out[0, h]), expect, atol=1e-4)
+
+
+class TestPagedVerifyAttention:
+    """Multi-query verify kernel (speculative decoding / chunked
+    prefill): G chunk tokens per sequence, per-row causal limit."""
+
+    def _setup(self, b=3, qh=8, kvh=4, d=64, page=16, num_pages=32,
+               ppseq=4, g=4, seed=0, quant=False):
+        from paddle_tpu.ops.paged_attention import quantize_kv
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(b, qh, g, d), jnp.float32) * 0.3
+        kp = jnp.asarray(rng.randn(kvh, num_pages, page, d),
+                         jnp.float32) * 0.3
+        vp = jnp.asarray(rng.randn(kvh, num_pages, page, d),
+                         jnp.float32) * 0.3
+        table = jnp.asarray(rng.permutation(num_pages)[:b * ppseq]
+                            .reshape(b, ppseq), jnp.int32)
+        # base lengths chosen so base+g stays within the owned pages
+        base = jnp.asarray([5, 17, page * ppseq - g], jnp.int32)[:b]
+        ks = vs = None
+        if quant:
+            kp, ks = quantize_kv(kp)
+            vp, vs = quantize_kv(vp)
+        return q, kp, vp, table, base, ks, vs
+
+    def test_interpret_matches_reference(self):
+        from paddle_tpu.ops.paged_attention import (paged_verify_attention,
+                                                    paged_verify_reference)
+        q, kp, vp, table, base, _, _ = self._setup()
+        ref = paged_verify_reference(q, kp, vp, table, base)
+        out = paged_verify_attention(q, kp, vp, table, base,
+                                     use_pallas=True, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_int8_interpret_matches_reference(self):
+        from paddle_tpu.ops.paged_attention import (paged_verify_attention,
+                                                    paged_verify_reference)
+        q, kp, vp, table, base, ks, vs = self._setup(quant=True)
+        ref = paged_verify_reference(q, kp, vp, table, base,
+                                     k_scale=ks, v_scale=vs)
+        out = paged_verify_attention(q, kp, vp, table, base,
+                                     use_pallas=True, interpret=True,
+                                     k_scale=ks, v_scale=vs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4)
+
+    def test_chunk_matches_sequential_single_token(self):
+        """Token g of the chunk == a single-token decode issued at
+        length base+g+1 (the ground truth the verify path must equal)."""
+        from paddle_tpu.ops.paged_attention import (paged_attention,
+                                                    paged_verify_reference)
+        q, kp, vp, table, base, _, _ = self._setup(b=2, g=3)
+        out = paged_verify_reference(q, kp, vp, table, base)
+        for g in range(3):
+            single = paged_attention(q[:, :, g], kp, vp, table,
+                                     base + g + 1, use_pallas=False)
+            np.testing.assert_allclose(np.asarray(out[:, :, g]),
+                                       np.asarray(single), atol=2e-5)
+
+    def test_gqa_row_padding(self):
+        """group*G not a sublane multiple: whole head-groups pad until
+        (group_pad*G) % 8 == 0 so the r % G token mapping survives AND
+        the TPU tile constraint holds for every (group, G)."""
+        import math as _math
+        from paddle_tpu.ops.paged_attention import (MIN_GROUP,
+                                                    paged_verify_attention,
+                                                    paged_verify_reference)
+        # (group, G) picked to produce awkward row counts: 2*3=6,
+        # 2*5=10, 3*3=9 — none are sublane multiples pre-padding
+        for qh, kvh, g in ((4, 2, 3), (4, 2, 5), (6, 2, 3)):
+            group = qh // kvh
+            r_mod = MIN_GROUP // _math.gcd(g, MIN_GROUP)
+            group_pad = group + ((-group) % r_mod)
+            assert (group_pad * g) % MIN_GROUP == 0, (qh, kvh, g)
+            q, kp, vp, table, base, _, _ = self._setup(qh=qh, kvh=kvh, g=g)
+            ref = paged_verify_reference(q, kp, vp, table, base)
+            out = paged_verify_attention(q, kp, vp, table, base,
+                                         use_pallas=True, interpret=True)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5, err_msg=str((qh, kvh, g)))

@@ -202,8 +202,24 @@ def paged_decode():
     # and the quantized result tracks the fp result within quant noise
     err_qfp = max_err(oq_r, o_r)
     assert err_qfp < 0.05, f"int8-vs-fp decode err {err_qfp}"
-    return {"max_err": round(err, 6), "max_err_int8": round(err_q, 6),
-            "int8_vs_fp": round(err_qfp, 6)}
+
+    # multi-query verify kernel (speculative decoding / chunked
+    # prefill): per-row causal limit, G chunk tokens per sequence —
+    # distinct code path from the single-token kernel, chip-proven here
+    from paddle_tpu.ops.paged_attention import (paged_verify_attention,
+                                                paged_verify_reference)
+    errs_v = {}
+    base = jnp.asarray([90, 10, 120, 60], jnp.int32)
+    for G in (4, 3):   # 3: odd chunk exercises the row-padding path
+        qv = jnp.asarray(rng.randn(b, qh, G, d), jnp.float32) * 0.3
+        ov_p = paged_verify_attention(qv, k_pages, v_pages, table, base,
+                                      use_pallas=True)
+        ov_r = paged_verify_reference(qv, k_pages, v_pages, table, base)
+        err_v = max_err(ov_p, ov_r)
+        assert err_v < 2e-3, f"verify-chunk G={G} err {err_v}"
+        errs_v[f"verify_chunk_g{G}"] = round(err_v, 6)
+    return dict({"max_err": round(err, 6), "max_err_int8": round(err_q, 6),
+                 "int8_vs_fp": round(err_qfp, 6)}, **errs_v)
 
 
 def flashmask_fwd_bwd():
