@@ -106,14 +106,11 @@ def make_decode_step(forward_fn, max_len):
     return jax.jit(step, static_argnums=(6, 7))
 
 
-def sample_logits_np(logits_row, temperature, top_k, top_p, rng=None):
-    """Host-side (numpy) twin of _sample_logits above — used by the
-    serving engine's per-request sampling (each request carries its own
-    seeded RNG, which the jit'd jax path cannot). Keep the two in sync:
-    temperature=0 → greedy; top_k then top_p filtering; same
-    include-crossing-token top_p convention."""
-    if temperature <= 0.0:
-        return int(np.argmax(logits_row))
+def filtered_probs_np(logits_row, temperature, top_k, top_p):
+    """The sampling distribution a request actually draws from:
+    temperature scaling, then top_k, then top_p filtering (same
+    include-crossing-token convention as _sample_logits). Requires
+    temperature > 0."""
     logits = np.asarray(logits_row, np.float64) / temperature
     k = int(top_k)
     if k > 0:
@@ -130,5 +127,17 @@ def sample_logits_np(logits_row, temperature, top_k, top_p, rng=None):
         mask = np.zeros_like(probs)
         mask[keep] = probs[keep]
         probs = mask / mask.sum()
+    return probs
+
+
+def sample_logits_np(logits_row, temperature, top_k, top_p, rng=None):
+    """Host-side (numpy) twin of _sample_logits above — used by the
+    serving engine's per-request sampling (each request carries its own
+    seeded RNG, which the jit'd jax path cannot). Keep the two in sync:
+    temperature=0 → greedy; top_k then top_p filtering; same
+    include-crossing-token top_p convention."""
+    if temperature <= 0.0:
+        return int(np.argmax(logits_row))
+    probs = filtered_probs_np(logits_row, temperature, top_k, top_p)
     rng = rng or np.random
     return int(rng.choice(len(probs), p=probs))

@@ -32,6 +32,7 @@ from ..ops.paged_attention import (paged_attention, paged_verify_attention,
                                    quantize_kv)
 from ..ops.varlen_attention import (flash_attention_varlen,
                                     seg_ids_from_cu_seqlens)
+from .generation import filtered_probs_np
 from .llama import LlamaConfig
 
 
@@ -296,6 +297,51 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
     return k_pool, v_pool, k_scale, v_scale, logits
 
 
+def speculative_sample(prob_rows, drafts, rng):
+    """Rejection-sampled acceptance for a deterministic draft sequence
+    (reference parity: speculative sampling, Leviathan et al. / the
+    reference's speculative-decoding sampling path).
+
+    prob_rows: the request's filtered sampling distributions — row g
+    applies AFTER consuming chunk token g. Either a sequence of (V,)
+    arrays or a callable g -> (V,) array; rows are materialized
+    LAZILY, so a first-draft rejection (the common case at low
+    acceptance rates) computes one row, not all n — filtering is an
+    O(V log V) host sort at vocab 32k+. drafts: (n-1,) proposed tokens
+    d_1..d_{n-1} (chunk tokens 1..n-1); rng: the request's
+    np.random.RandomState.
+
+    Accept d_{g+1} with probability p_g(d_{g+1}) (the draft proposal is
+    a point mass, so min(1, p/q) = p(d)); on rejection sample from the
+    renormalized residual p_g with d removed. Either way every emitted
+    token is marginally distributed EXACTLY as p_g — the output
+    distribution equals plain (non-speculative) sampling, while
+    accepted drafts advance several tokens per verify step.
+
+    Returns (tokens, n_accepted): up to n emitted tokens (accepted
+    drafts + one final sample)."""
+    row = prob_rows if callable(prob_rows) else prob_rows.__getitem__
+    out = []
+    n = len(drafts) + 1
+    for g in range(n - 1):
+        p = row(g)
+        d = int(drafts[g])
+        if rng.rand() < p[d]:
+            out.append(d)           # accepted: token IS the draft
+            continue
+        resid = p.copy()
+        resid[d] = 0.0
+        tot = resid.sum()
+        if tot <= 0.0:              # p was a point mass on d — forced
+            out.append(d)
+            continue
+        out.append(int(rng.choice(len(resid), p=resid / tot)))
+        return out, g               # divergence: stop consuming drafts
+    p_last = row(n - 1)
+    out.append(int(rng.choice(len(p_last), p=p_last)))
+    return out, n - 1
+
+
 def prompt_lookup_draft(ctx, G, ngram=2):
     """Draft continuation tokens by n-gram lookup in the request's own
     context (reference parity: PaddleNLP "inference with reference" —
@@ -376,7 +422,8 @@ class ServingEngine:
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
                  use_pallas=None, interpret=False, num_pages=None,
                  cache_dtype=None, preempt_policy="offload",
-                 spec_decode=0, spec_ngram=2, chunked_prefill=False):
+                 spec_decode=0, spec_ngram=2, chunked_prefill=False,
+                 spec_sample=False):
         c = config
         self.params = params
         self.config = c
@@ -427,6 +474,14 @@ class ServingEngine:
             raise ValueError(
                 "chunked_prefill rides the spec verify chunk: set "
                 "spec_decode >= 2 (the chunk width)")
+        # spec_sample: draft for SAMPLED requests too, accepted by
+        # rejection sampling (speculative_sample) — the output
+        # DISTRIBUTION equals plain sampling exactly, but the rng
+        # consumption (hence the seeded trajectory) differs from the
+        # non-speculative engine, so it is opt-in
+        self.spec_sample = bool(spec_sample)
+        if self.spec_sample and self.spec_decode < 2:
+            raise ValueError("spec_sample needs spec_decode >= 2")
         self.spec_drafted = 0    # draft tokens fed to verify
         self.spec_accepted = 0   # draft tokens accepted
         self.device_steps = 0    # decode/verify device calls
@@ -837,7 +892,7 @@ class ServingEngine:
             room = self.max_seq_len - cur - 1
             budget = min(G - 1, room,
                          req.max_new_tokens - len(req.output))
-            if req.temperature == 0.0 and budget > 0:
+            if budget > 0 and (req.temperature == 0.0 or self.spec_sample):
                 # context = everything decided so far incl. the pending
                 # next_token (it's the tail the n-gram keys off)
                 ctx = req.prompt + req.output
@@ -876,7 +931,7 @@ class ServingEngine:
             k_scale=self.k_scale, v_scale=self.v_scale)
         self.device_steps += 1
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)
-        sampled = {s: np.asarray(logits[s, 0])
+        sampled = {s: np.asarray(logits[s, :int(n_tok[s])])
                    for s in active_slots
                    if self._slots[s].temperature > 0.0
                    and not self._prefilling(self._slots[s])}
@@ -892,20 +947,28 @@ class ServingEngine:
                     self._seed_first_token(s, req,
                                            np.asarray(logits[s, n - 1]))
                 continue
-            if s in sampled:
-                outs = [req.pick(sampled[s])]
-                n = 1
+            if s in sampled and n > 1:
+                # speculative sampling: distributionally exact; rows
+                # filter lazily (rejection at g touches g+1 rows only)
+                rows = sampled[s]
+                outs, a = speculative_sample(
+                    lambda g: filtered_probs_np(rows[g], req.temperature,
+                                                req.top_k, req.top_p),
+                    tokens[s, 1:n], req.rng)
+            elif s in sampled:
+                outs, a = [req.pick(sampled[s][0])], 0
             else:
                 outs = [int(t) for t in greedy_nxt[s, :n]]
-            # accept drafts while they match the model's own choices
-            a = 0
-            while a < n - 1 and tokens[s, a + 1] == outs[a]:
-                a += 1
+                # accept drafts while they match the model's own choices
+                a = 0
+                while a < n - 1 and tokens[s, a + 1] == outs[a]:
+                    a += 1
+                outs = outs[:a + 1]
             self.spec_accepted += a
             emitted = 0
-            for j in range(a + 1):
-                req.output.append(outs[j])
-                req.next_token = outs[j]
+            for tok in outs:
+                req.output.append(tok)
+                req.next_token = tok
                 emitted += 1
                 if req.done:
                     break
