@@ -1,0 +1,348 @@
+// libptps — native parameter-server shard (reference parity: the
+// reference's PS tier is C++ BRPC services,
+// paddle/fluid/distributed/ps/service/brpc_ps_server.cc; ours speaks
+// the length-prefixed protocol of paddle_tpu/distributed/ps_impl.py so
+// the Python PSClient/_RemoteShard works against either backend).
+//
+// One process-level table per server object: sparse rows keyed by
+// int64 id, materialized on first pull with a deterministic
+// splitmix64+Box-Muller init (deterministic per (seed, id), like the
+// Python backend — the two backends' init STREAMS differ, which is
+// fine: a table lives its whole life on one backend).
+//
+// Wire protocol (little-endian), one request/response per message:
+//   header: u8 op | u16 table | u32 n_ids | u32 dim
+//   u32 body_len
+//   body:   n_ids * i64 ids, then f32 payload
+// ops: 1=PULL (reply payload rows), 2=PUSH (ids+grads, reply empty),
+//      3=LEN (reply one i64 id = row count), 4=STOP (reply empty,
+//      shut the server down).
+//
+// Per-row optimizers match ps_impl.SparseTable: 0=sgd, 1=adagrad,
+// 2=adam (per-row bias-correction step count).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t OP_PULL = 1, OP_PUSH = 2, OP_LEN = 3, OP_STOP = 4;
+
+uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+struct Table {
+  int dim;
+  int opt;  // 0 sgd, 1 adagrad, 2 adam
+  float lr, init_scale, beta1, beta2, eps;
+  int64_t seed;
+  std::unordered_map<int64_t, size_t> slot;
+  std::vector<float> rows, g2, m, v;
+  std::vector<int64_t> steps;
+  std::mutex mu;
+
+  size_t ensure(int64_t id) {
+    auto it = slot.find(id);
+    if (it != slot.end()) return it->second;
+    size_t s = slot.size();
+    slot.emplace(id, s);
+    size_t base = rows.size();
+    rows.resize(base + dim);
+    // deterministic init, two uniforms per normal. Mix the id through
+    // splitmix64 FIRST: a plain linear key would make adjacent ids'
+    // streams overlap (key(id+1) = key(id)+1), correlating neighboring
+    // rows' inits — rec-sys ids are typically dense.
+    uint64_t key = splitmix64(static_cast<uint64_t>(seed) ^
+                              splitmix64(static_cast<uint64_t>(id)));
+    for (int j = 0; j < dim; ++j) {
+      uint64_t a = splitmix64(key + 2 * j + 1);
+      uint64_t b = splitmix64(key + 2 * j + 2);
+      double u1 = (static_cast<double>(a >> 11) + 1.0) / 9007199254740993.0;
+      double u2 = static_cast<double>(b >> 11) / 9007199254740992.0;
+      double n = std::sqrt(-2.0 * std::log(u1)) *
+                 std::cos(2.0 * M_PI * u2);
+      rows[base + j] = static_cast<float>(n * init_scale);
+    }
+    if (opt == 1) g2.resize(base + dim, 0.f);
+    if (opt == 2) {
+      m.resize(base + dim, 0.f);
+      v.resize(base + dim, 0.f);
+    }
+    steps.resize(s + 1, 0);
+    return s;
+  }
+
+  void pull(const int64_t* ids, uint32_t n, float* out) {
+    std::lock_guard<std::mutex> g(mu);
+    for (uint32_t i = 0; i < n; ++i) {
+      size_t s = ensure(ids[i]);
+      std::memcpy(out + static_cast<size_t>(i) * dim,
+                  rows.data() + s * dim, sizeof(float) * dim);
+    }
+  }
+
+  void push(const int64_t* ids, uint32_t n, const float* grads) {
+    // scatter-add duplicates first (dense embedding backward
+    // semantics), then apply the rule once per unique id
+    std::unordered_map<int64_t, std::vector<float>> sum;
+    sum.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      auto& acc = sum[ids[i]];
+      if (acc.empty()) acc.assign(dim, 0.f);
+      const float* g = grads + static_cast<size_t>(i) * dim;
+      for (int j = 0; j < dim; ++j) acc[j] += g[j];
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    for (auto& kv : sum) {
+      size_t s = ensure(kv.first);
+      float* r = rows.data() + s * dim;
+      const float* g = kv.second.data();
+      if (opt == 0) {
+        for (int j = 0; j < dim; ++j) r[j] -= lr * g[j];
+      } else if (opt == 1) {
+        float* a = g2.data() + s * dim;
+        for (int j = 0; j < dim; ++j) {
+          a[j] += g[j] * g[j];
+          r[j] -= lr * g[j] / (std::sqrt(a[j]) + eps);
+        }
+      } else {
+        steps[s] += 1;
+        double t = static_cast<double>(steps[s]);
+        double c1 = 1.0 - std::pow(beta1, t);
+        double c2 = 1.0 - std::pow(beta2, t);
+        float* mm = m.data() + s * dim;
+        float* vv = v.data() + s * dim;
+        for (int j = 0; j < dim; ++j) {
+          mm[j] = beta1 * mm[j] + (1.f - beta1) * g[j];
+          vv[j] = beta2 * vv[j] + (1.f - beta2) * g[j] * g[j];
+          double mh = mm[j] / c1, vh = vv[j] / c2;
+          r[j] -= static_cast<float>(lr * mh / (std::sqrt(vh) + eps));
+        }
+      }
+    }
+  }
+};
+
+struct Server {
+  Table table;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  // connection threads are DETACHED; we track their fds (to shutdown
+  // on stop) and a live counter (to know when they have all exited) —
+  // no unbounded vector of dead joinable threads
+  std::mutex fd_mu;
+  std::vector<int> conn_fds;
+  std::atomic<int> live_conns{0};
+
+  void shutdown_listener() {
+    std::lock_guard<std::mutex> g(fd_mu);
+    if (listen_fd >= 0) ::shutdown(listen_fd, SHUT_RDWR);
+  }
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+#pragma pack(push, 1)
+struct Header {
+  uint8_t op;
+  uint16_t table;
+  uint32_t n;
+  uint32_t dim;
+};
+#pragma pack(pop)
+
+bool send_msg(int fd, uint8_t op, uint16_t table, uint32_t n_ids,
+              uint32_t dim, const void* ids, const void* payload,
+              size_t payload_bytes) {
+  Header h{op, table, n_ids, dim};
+  uint32_t blen =
+      static_cast<uint32_t>(n_ids * sizeof(int64_t) + payload_bytes);
+  if (!write_all(fd, &h, sizeof(h))) return false;
+  if (!write_all(fd, &blen, 4)) return false;
+  if (n_ids && !write_all(fd, ids, n_ids * sizeof(int64_t))) return false;
+  if (payload_bytes && !write_all(fd, payload, payload_bytes)) return false;
+  return true;
+}
+
+void handle_conn(Server* srv, int fd) {
+  for (;;) {
+    Header h;
+    uint32_t blen;
+    if (!read_exact(fd, &h, sizeof(h)) || !read_exact(fd, &blen, 4)) break;
+    constexpr uint32_t MAX_BODY = 1u << 30;
+    if (blen > MAX_BODY) break;
+    std::vector<char> body(blen);
+    if (blen && !read_exact(fd, body.data(), blen)) break;
+    Table& t = srv->table;
+    // strict body validation (the Python tier raises on shape
+    // mismatch; a dim-mismatched client must not cause OOB reads)
+    const uint64_t ids_bytes = static_cast<uint64_t>(h.n) * sizeof(int64_t);
+    uint64_t want_payload = 0;
+    if (h.op == OP_PUSH)
+      want_payload = static_cast<uint64_t>(h.n) * t.dim * sizeof(float);
+    if ((h.op == OP_PULL && blen != ids_bytes) ||
+        (h.op == OP_PUSH && blen != ids_bytes + want_payload) ||
+        ((h.op == OP_LEN || h.op == OP_STOP) && blen != 0))
+      break;
+    const auto* ids = reinterpret_cast<const int64_t*>(body.data());
+    const auto* pay =
+        reinterpret_cast<const float*>(body.data() + ids_bytes);
+    if (h.op == OP_PULL) {
+      std::vector<float> out(static_cast<size_t>(h.n) * t.dim);
+      t.pull(ids, h.n, out.data());
+      if (!send_msg(fd, OP_PULL, h.table, 0,
+                    static_cast<uint32_t>(t.dim), nullptr, out.data(),
+                    out.size() * sizeof(float)))
+        break;
+    } else if (h.op == OP_PUSH) {
+      t.push(ids, h.n, pay);
+      if (!send_msg(fd, OP_PUSH, h.table, 0, 0, nullptr, nullptr, 0)) break;
+    } else if (h.op == OP_LEN) {
+      int64_t sz;
+      {
+        std::lock_guard<std::mutex> g(t.mu);
+        sz = static_cast<int64_t>(t.slot.size());
+      }
+      if (!send_msg(fd, OP_LEN, h.table, 1, 0, &sz, nullptr, 0)) break;
+    } else if (h.op == OP_STOP) {
+      send_msg(fd, OP_STOP, h.table, 0, 0, nullptr, nullptr, 0);
+      srv->stopping.store(true);
+      srv->shutdown_listener();  // wake the accept loop (fd_mu-guarded)
+      break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+  {
+    std::lock_guard<std::mutex> g(srv->fd_mu);
+    auto& v = srv->conn_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+  }
+  srv->live_conns.fetch_sub(1);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptps_create(int dim, int opt, float lr, long long seed,
+                  float init_scale, float beta1, float beta2, float eps) {
+  auto* srv = new Server();
+  srv->table.dim = dim;
+  srv->table.opt = opt;
+  srv->table.lr = lr;
+  srv->table.seed = seed;
+  srv->table.init_scale = init_scale;
+  srv->table.beta1 = beta1;
+  srv->table.beta2 = beta2;
+  srv->table.eps = eps;
+  return srv;
+}
+
+// bind + listen + spawn the accept loop; returns the bound port, or -1
+int ptps_serve(void* handle, int port) {
+  auto* srv = static_cast<Server*>(handle);
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 64) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  srv->listen_fd = fd;
+  srv->port = ntohs(addr.sin_port);
+  srv->accept_thread = std::thread([srv] {
+    while (!srv->stopping.load()) {
+      int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
+      if (cfd < 0) break;
+      {
+        std::lock_guard<std::mutex> g(srv->fd_mu);
+        srv->conn_fds.push_back(cfd);
+      }
+      srv->live_conns.fetch_add(1);
+      std::thread(handle_conn, srv, cfd).detach();
+    }
+  });
+  return srv->port;
+}
+
+long long ptps_size(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> g(srv->table.mu);
+  return static_cast<long long>(srv->table.slot.size());
+}
+
+void ptps_stop(void* handle) {
+  auto* srv = static_cast<Server*>(handle);
+  srv->stopping.store(true);
+  srv->shutdown_listener();
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(srv->fd_mu);
+    if (srv->listen_fd >= 0) {
+      ::close(srv->listen_fd);
+      srv->listen_fd = -1;
+    }
+    // kick every open connection out of its blocking read — without
+    // this, close() deadlocks while any client is still connected
+    for (int fd : srv->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  // wait for the detached conn threads to drain (they must not touch
+  // Server memory after ptps_destroy frees it)
+  while (srv->live_conns.load() > 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+}
+
+void ptps_destroy(void* handle) {
+  ptps_stop(handle);
+  delete static_cast<Server*>(handle);
+}
+
+}  // extern "C"

@@ -17,6 +17,7 @@ design notes):
 """
 from paddle_tpu.distributed.ps_impl import (  # noqa: F401
     DistributedEmbedding,
+    CppPSServer,
     EmbeddingPSServer,
     PSClient,
     SparseTable,
@@ -30,7 +31,8 @@ from paddle_tpu.distributed.ps_impl import (  # noqa: F401
 )
 
 __all__ = [
-    "DistributedEmbedding", "EmbeddingPSServer", "PSClient", "SparseTable",
+    "CppPSServer", "DistributedEmbedding", "EmbeddingPSServer", "PSClient",
+    "SparseTable",
     "TheOnePSRuntime", "init_server", "init_worker", "run_server",
     "shard_of", "sparse_embedding_step", "stop_worker",
 ]

@@ -41,7 +41,8 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 __all__ = [
-    "SparseTable", "PSClient", "EmbeddingPSServer", "DistributedEmbedding",
+    "SparseTable", "PSClient", "EmbeddingPSServer", "CppPSServer",
+    "DistributedEmbedding",
     "sparse_embedding_step", "init_server", "run_server", "init_worker",
     "stop_worker", "TheOnePSRuntime", "shard_of",
 ]
@@ -269,6 +270,87 @@ class EmbeddingPSServer:
     def close(self):
         self._srv.shutdown()
         self._srv.server_close()
+
+
+_PTPS = None
+
+
+def _load_ptps():
+    """ctypes binding for the native PS shard (csrc/ptps.cpp; builds
+    lazily like the other csrc libraries)."""
+    global _PTPS
+    if _PTPS is not None:
+        return _PTPS
+    import ctypes
+    import subprocess
+    csrc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc")
+    so = os.path.join(csrc, "libptps.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", csrc, "libptps.so"], check=True,
+                       capture_output=True)
+    lib = ctypes.CDLL(so)
+    lib.ptps_create.restype = ctypes.c_void_p
+    lib.ptps_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_float,
+                                ctypes.c_longlong, ctypes.c_float,
+                                ctypes.c_float, ctypes.c_float,
+                                ctypes.c_float]
+    lib.ptps_serve.restype = ctypes.c_int
+    lib.ptps_serve.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptps_size.restype = ctypes.c_longlong
+    lib.ptps_size.argtypes = [ctypes.c_void_p]
+    lib.ptps_stop.argtypes = [ctypes.c_void_p]
+    lib.ptps_destroy.argtypes = [ctypes.c_void_p]
+    _PTPS = lib
+    return lib
+
+
+_CPP_OPT = {"sgd": 0, "adagrad": 1, "adam": 2}
+
+
+class CppPSServer:
+    """Native PS shard (csrc/ptps.cpp — the C++ tier the reference's
+    BRPC services occupy): one sparse table served over the SAME wire
+    protocol as EmbeddingPSServer, so PSClient/_RemoteShard work
+    unchanged against either backend. Row init is deterministic per
+    (seed, id) but its stream differs from the numpy backend — a table
+    lives its whole life on one backend."""
+
+    def __init__(self, dim, optimizer="adagrad", lr=0.01, seed=0,
+                 init_scale=0.01, beta1=0.9, beta2=0.999, eps=1e-8,
+                 port=0):
+        if optimizer not in _CPP_OPT:
+            raise ValueError(f"unknown sparse optimizer: {optimizer!r}")
+        lib = _load_ptps()
+        self._lib = lib
+        self._h = lib.ptps_create(int(dim), _CPP_OPT[optimizer],
+                                  float(lr), int(seed), float(init_scale),
+                                  float(beta1), float(beta2), float(eps))
+        bound = lib.ptps_serve(self._h, int(port))
+        if bound < 0:
+            lib.ptps_destroy(self._h)
+            self._h = None
+            raise OSError("libptps: could not bind a listening socket")
+        self.endpoint = f"127.0.0.1:{bound}"
+
+    def _handle(self):
+        if self._h is None:
+            raise RuntimeError("CppPSServer is closed")
+        return self._h
+
+    def __len__(self):
+        return int(self._lib.ptps_size(self._handle()))
+
+    def serve_in_thread(self):
+        """API parity with EmbeddingPSServer: the native accept loop is
+        already running in its own thread."""
+        self._handle()
+        return None
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ptps_destroy(self._h)
+            self._h = None
 
 
 class _RemoteShard:

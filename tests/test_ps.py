@@ -223,3 +223,100 @@ class TestDistributedEmbedding:
         np.testing.assert_allclose(
             np.stack([client.pull([i])[0] for i in range(vocab)]),
             dense, atol=1e-5)
+
+
+class TestCppPSServer:
+    """Native shard (csrc/ptps.cpp) behind the same wire protocol."""
+
+    def test_protocol_interop_and_rules(self):
+        from paddle_tpu.distributed.ps_impl import CppPSServer
+        srv = CppPSServer(4, optimizer="sgd", lr=0.5, seed=3)
+        try:
+            sh = _RemoteShard(srv.endpoint, 0)
+            r0 = sh.pull([5, 9])
+            assert r0.shape == (2, 4)
+            # deterministic init per (seed, id)
+            np.testing.assert_array_equal(sh.pull([5])[0], r0[0])
+            g = np.asarray([[1.0, -2.0, 0.5, 0.0]], np.float32)
+            sh.push([5], g)
+            np.testing.assert_allclose(sh.pull([5])[0], r0[0] - 0.5 * g[0],
+                                       rtol=1e-6)
+            # duplicate ids scatter-add before the rule
+            r9 = sh.pull([9])[0].copy()
+            sh.push([9, 9], np.ones((2, 4), np.float32))
+            np.testing.assert_allclose(sh.pull([9])[0], r9 - 0.5 * 2.0,
+                                       rtol=1e-6)
+            assert len(sh) == 2 and len(srv) == 2
+            sh.close()
+        finally:
+            srv.close()
+
+    def test_adam_rule_matches_python_table(self):
+        """Same grads on an existing row: the C++ adam update must track
+        the Python SparseTable's exactly (init rows differ by design —
+        compare the DELTAS)."""
+        from paddle_tpu.distributed.ps_impl import CppPSServer
+        lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+        srv = CppPSServer(3, optimizer="adam", lr=lr, beta1=b1, beta2=b2,
+                          eps=eps, seed=0)
+        py = SparseTable(3, optimizer="adam", lr=lr, beta1=b1, beta2=b2,
+                         eps=eps, seed=0)
+        try:
+            sh = _RemoteShard(srv.endpoint, 0)
+            c0 = sh.pull([7])[0].copy()
+            p0 = py.pull([7])[0].copy()
+            for step in range(1, 4):
+                g = np.asarray([[0.5 * step, -1.0, 0.25]], np.float32)
+                sh.push([7], g)
+                py.push([7], g)
+            np.testing.assert_allclose(sh.pull([7])[0] - c0,
+                                       py.pull([7])[0] - p0, atol=1e-6)
+            sh.close()
+        finally:
+            srv.close()
+
+    def test_sharded_client_mixed_backends(self):
+        """PSClient spanning one C++ shard and one Python shard — the
+        routing/protocol layer must not care."""
+        from paddle_tpu.distributed.ps_impl import (CppPSServer,
+                                                    EmbeddingPSServer)
+        cpp = CppPSServer(4, optimizer="sgd", lr=0.1, seed=1)
+        pysrv = EmbeddingPSServer([SparseTable(4, optimizer="sgd", lr=0.1,
+                                               seed=1)])
+        pysrv.serve_in_thread()
+        try:
+            client = PSClient([_RemoteShard(cpp.endpoint, 0),
+                               _RemoteShard(pysrv.endpoint, 0)])
+            ids = np.asarray([0, 1, 2, 3, 8, 11], np.int64)
+            rows = client.pull(ids)
+            assert rows.shape == (6, 4)
+            g = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+            before = rows.copy()
+            client.push(ids, g)
+            after = client.pull(ids)
+            np.testing.assert_allclose(after, before - 0.1 * g, rtol=1e-5)
+            for s in client.shards:
+                s.close()
+        finally:
+            cpp.close()
+            pysrv.close()
+
+    def test_close_with_open_connection_does_not_hang(self):
+        """close() must kick connected clients out of their blocking
+        reads instead of dead-waiting on them."""
+        import threading
+        from paddle_tpu.distributed.ps_impl import CppPSServer
+        srv = CppPSServer(4, optimizer="sgd", lr=0.1, seed=0)
+        sh = _RemoteShard(srv.endpoint, 0)
+        sh.pull([1])                  # connection is live and idle
+        done = threading.Event()
+
+        def closer():
+            srv.close()
+            done.set()
+        t = threading.Thread(target=closer, daemon=True)
+        t.start()
+        assert done.wait(timeout=10), "CppPSServer.close() hung"
+        sh.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            len(srv)
