@@ -2,11 +2,13 @@
 
     python examples/train_dlrm_ps.py                 # in-process shards
     python examples/train_dlrm_ps.py --sockets       # real TCP PS tier
+    python examples/train_dlrm_ps.py --cpp           # native C++ shards
 
 Shows: host-RAM SparseTable shards (per-row adagrad), the
-DistributedEmbedding pull/push flow around a jitted dense tower, and
-the same run over the socket tier the multi-process deployment uses
-(docs/distributed.md § Parameter-server mode).
+DistributedEmbedding pull/push flow around a jitted dense tower, the
+same run over the socket tier the multi-process deployment uses, and
+the libptps native backend (docs/distributed.md § Parameter-server
+mode).
 """
 from __future__ import annotations
 
@@ -36,6 +38,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sockets", action="store_true",
                     help="run the shards behind the real TCP PS tier")
+    ap.add_argument("--cpp", action="store_true",
+                    help="native C++ shards (csrc/ptps.cpp) instead of "
+                         "the Python tier (implies --sockets)")
     ap.add_argument("--steps", type=int, default=40)
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--shards", type=int, default=2)
@@ -43,21 +48,29 @@ def main():
 
     cfg = DLRMConfig(emb_dim=16, n_sparse=8, dense_dim=13,
                      bottom=(64, 32), top=(64, 32))
-    tables = [ps.SparseTable(cfg.emb_dim, optimizer="adagrad", lr=0.05,
-                             seed=s) for s in range(args.shards)]
+    def mk_table(s):
+        return ps.SparseTable(cfg.emb_dim, optimizer="adagrad", lr=0.05,
+                              seed=s)
+
     servers = []
-    if args.sockets:
-        for t in tables:
-            srv = ps.EmbeddingPSServer([t])
+    if args.cpp:
+        for s in range(args.shards):
+            servers.append(ps.CppPSServer(cfg.emb_dim, optimizer="adagrad",
+                                          lr=0.05, seed=s))
+    elif args.sockets:
+        for s in range(args.shards):
+            srv = ps.EmbeddingPSServer([mk_table(s)])
             srv.serve_in_thread()
             servers.append(srv)
+    if servers:
         _os.environ["PT_PS_ENDPOINTS"] = ",".join(s.endpoint
                                                   for s in servers)
         client = ps.init_worker()
-        print(f"PS tier: {len(servers)} socket servers "
+        print(f"PS tier: {len(servers)} "
+              f"{'native C++' if args.cpp else 'python'} socket servers "
               f"({_os.environ['PT_PS_ENDPOINTS']})")
     else:
-        client = ps.PSClient(tables)
+        client = ps.PSClient([mk_table(s) for s in range(args.shards)])
 
     tr = DLRMTrainer(cfg, client, seed=0, lr=0.05)
     rng = np.random.RandomState(0)
@@ -80,8 +93,8 @@ def main():
     print(f"{args.steps * args.batch / dt:.0f} examples/s "
           f"(PS round-trip included)")
 
-    if args.sockets:
-        ps.stop_worker(stop_servers=True)
+    if servers:
+        ps.stop_worker()
         for s in servers:
             s.close()
 
