@@ -48,15 +48,15 @@ def main():
 
     cfg = DLRMConfig(emb_dim=16, n_sparse=8, dense_dim=13,
                      bottom=(64, 32), top=(64, 32))
+    spec = dict(optimizer="adagrad", lr=0.05)   # one spec, all backends
+
     def mk_table(s):
-        return ps.SparseTable(cfg.emb_dim, optimizer="adagrad", lr=0.05,
-                              seed=s)
+        return ps.SparseTable(cfg.emb_dim, seed=s, **spec)
 
     servers = []
     if args.cpp:
         for s in range(args.shards):
-            servers.append(ps.CppPSServer(cfg.emb_dim, optimizer="adagrad",
-                                          lr=0.05, seed=s))
+            servers.append(ps.CppPSServer(cfg.emb_dim, seed=s, **spec))
     elif args.sockets:
         for s in range(args.shards):
             srv = ps.EmbeddingPSServer([mk_table(s)])

@@ -313,6 +313,10 @@ int ptps_serve(void* handle, int port) {
   return srv->port;
 }
 
+int ptps_stopping(void* handle) {
+  return static_cast<Server*>(handle)->stopping.load() ? 1 : 0;
+}
+
 long long ptps_size(void* handle) {
   auto* srv = static_cast<Server*>(handle);
   std::lock_guard<std::mutex> g(srv->table.mu);

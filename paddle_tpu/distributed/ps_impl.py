@@ -286,9 +286,11 @@ def _load_ptps():
     csrc = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "csrc")
     so = os.path.join(csrc, "libptps.so")
-    if not os.path.exists(so):
-        subprocess.run(["make", "-C", csrc, "libptps.so"], check=True,
-                       capture_output=True)
+    # run make unconditionally: the rule depends on ptps.cpp, so a
+    # fresh .so is a no-op while a stale one (older ABI, missing
+    # symbols) gets rebuilt instead of crashing symbol resolution
+    subprocess.run(["make", "-C", csrc, "libptps.so"], check=True,
+                   capture_output=True)
     lib = ctypes.CDLL(so)
     lib.ptps_create.restype = ctypes.c_void_p
     lib.ptps_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_float,
@@ -299,6 +301,8 @@ def _load_ptps():
     lib.ptps_serve.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.ptps_size.restype = ctypes.c_longlong
     lib.ptps_size.argtypes = [ctypes.c_void_p]
+    lib.ptps_stopping.restype = ctypes.c_int
+    lib.ptps_stopping.argtypes = [ctypes.c_void_p]
     lib.ptps_stop.argtypes = [ctypes.c_void_p]
     lib.ptps_destroy.argtypes = [ctypes.c_void_p]
     _PTPS = lib
@@ -346,6 +350,15 @@ class CppPSServer:
         already running in its own thread."""
         self._handle()
         return None
+
+    def serve_forever(self):
+        """Block until a client sends STOP — or another thread calls
+        close() (re-reads the handle each poll so a cross-thread close
+        exits cleanly instead of polling freed memory)."""
+        import time
+        self._handle()
+        while self._h is not None and not self._lib.ptps_stopping(self._h):
+            time.sleep(0.05)
 
     def close(self):
         if self._h is not None:
@@ -508,31 +521,67 @@ def _endpoints():
     return [e for e in eps.split(",") if e]
 
 
-def init_server(tables=None, port=None, host=None):
+def init_server(tables=None, port=None, host=None, backend=None):
     """Start this process's PS shard. tables: list of SparseTable (or
     (dim, optimizer, lr) tuples); host/port: bind address (default:
     parsed from PT_PS_ENDPOINTS[PT_PS_RANK], else loopback+ephemeral).
 
+    backend (default: the PT_PS_BACKEND env, else "python"): "cpp"
+    serves the shard from libptps (csrc/ptps.cpp) — same wire protocol,
+    native table + optimizer. The C++ backend hosts ONE table per
+    server built from the first table's (dim, optimizer, lr, seed)
+    spec; it binds all interfaces by construction.
+
     Workers on OTHER hosts must be able to reach the advertised
-    endpoint, so when one is configured the server binds all interfaces
-    (the endpoint's host names how clients dial in, not necessarily a
-    local interface name — e.g. a load-balanced DNS name)."""
+    endpoint, so when one is configured the python server binds all
+    interfaces (the endpoint's host names how clients dial in, not
+    necessarily a local interface name — e.g. a load-balanced DNS
+    name)."""
     tabs = []
     for t in (tables or [SparseTable(8)]):
         tabs.append(t if isinstance(t, SparseTable) else SparseTable(*t))
+    explicit_host = host
     if port is None:
         eps, rank = _endpoints(), int(os.environ.get("PT_PS_RANK", "0"))
         port = int(eps[rank].rsplit(":", 1)[1]) if eps else 0
         if host is None and eps:
             host = "0.0.0.0"
-    srv = EmbeddingPSServer(tabs, host=host or "127.0.0.1", port=port)
+    backend = backend or os.environ.get("PT_PS_BACKEND", "python")
+    if backend == "cpp":
+        if explicit_host is not None:
+            raise ValueError(
+                "backend='cpp' always binds all interfaces (libptps); "
+                "an explicit host would be silently ignored — drop it "
+                "or use the python backend for loopback-only shards")
+        if len(tabs) != 1:
+            raise ValueError(
+                "backend='cpp' hosts one table per server process — "
+                f"got {len(tabs)}; run one server per table")
+        t = tabs[0]
+        if len(t):
+            raise ValueError(
+                "backend='cpp' cannot adopt rows already materialized "
+                "in a python SparseTable — pass a fresh table spec")
+        srv = CppPSServer(t.dim, optimizer=t.optimizer, lr=t.lr,
+                          seed=t.seed, init_scale=t.init_scale,
+                          beta1=t.beta1, beta2=t.beta2, eps=t.eps,
+                          port=port)
+    elif backend == "python":
+        srv = EmbeddingPSServer(tabs, host=host or "127.0.0.1", port=port)
+    else:
+        raise ValueError(f"unknown PS backend {backend!r}: "
+                         "use 'python' or 'cpp'")
     _runtime["server"] = srv
     return srv
 
 
 def run_server():
     """Blocking serve loop (reference: fleet.run_server)."""
-    srv = _runtime.get("server") or init_server()
+    # NB explicit None check: servers define __len__, so a fresh (empty)
+    # server is FALSY and `or` would silently start a second one
+    srv = _runtime.get("server")
+    if srv is None:
+        srv = init_server()
     srv.serve_forever()
 
 

@@ -320,3 +320,49 @@ class TestCppPSServer:
         sh.close()
         with pytest.raises(RuntimeError, match="closed"):
             len(srv)
+
+    def test_fleet_backend_cpp_roundtrip(self):
+        """init_server(backend='cpp') + run_server in a real process,
+        stopped by the client's STOP — the fleet PS flow over libptps."""
+        code = textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, os.environ["REPO"])
+            from paddle_tpu.distributed.ps_impl import (SparseTable,
+                                                        init_server,
+                                                        run_server)
+            srv = init_server([SparseTable(2, optimizer="sgd", lr=1.0,
+                                           seed=0)], port=0, backend="cpp")
+            print(srv.endpoint, flush=True)
+            run_server()
+        """)
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             env=dict(os.environ, REPO=os.path.dirname(
+                                 os.path.dirname(os.path.abspath(__file__))),
+                                 JAX_PLATFORMS="cpu"),
+                             stdout=subprocess.PIPE, text=True)
+        try:
+            endpoint = p.stdout.readline().strip()
+            assert ":" in endpoint, f"no endpoint: {endpoint!r}"
+            sh = _RemoteShard(endpoint, 0)
+            r0 = sh.pull([3])[0].copy()
+            sh.push([3], np.asarray([[1.0, 2.0]], np.float32))
+            np.testing.assert_allclose(sh.pull([3])[0], r0 - [1.0, 2.0],
+                                       rtol=1e-6)
+            sh.stop_server()
+            sh.close()
+            assert p.wait(timeout=15) == 0
+        finally:
+            if p.poll() is None:
+                p.kill()
+
+    def test_backend_validation(self):
+        from paddle_tpu.distributed.ps_impl import init_server
+        with pytest.raises(ValueError, match="unknown PS backend"):
+            init_server([SparseTable(2)], port=0, backend="rust")
+        with pytest.raises(ValueError, match="one table"):
+            init_server([SparseTable(2), SparseTable(2)], port=0,
+                        backend="cpp")
+        t = SparseTable(2)
+        t.pull([1])
+        with pytest.raises(ValueError, match="materialized"):
+            init_server([t], port=0, backend="cpp")
