@@ -367,7 +367,8 @@ class Request:
     top_k/top_p restrict the candidate set before sampling."""
 
     def __init__(self, rid, prompt_ids, max_new_tokens=64, eos_id=None,
-                 temperature=0.0, top_k=0, top_p=1.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None,
+                 logprobs=False):
         self.rid = rid
         self.prompt = list(prompt_ids)
         self.max_new_tokens = max_new_tokens
@@ -380,12 +381,27 @@ class Request:
         self.output = []
         self.slot = None
         self.next_token = None
+        # logprobs=True: record log p(token | context) under the RAW
+        # model distribution for every emitted token (reference parity:
+        # the predictor's return_full_hidden/logprob outputs; vLLM
+        # convention — raw softmax, not the filtered sampling dist)
+        self.want_logprobs = bool(logprobs)
+        self.logprobs = [] if logprobs else None
 
     def pick(self, logits_row):
         """Select the next token from this request's logits row."""
         from .generation import sample_logits_np
         return sample_logits_np(logits_row, self.temperature, self.top_k,
                                 self.top_p, self.rng)
+
+    def note_logprob(self, tok, logits_row):
+        """Record the raw-model logprob of an emitted token."""
+        if not self.want_logprobs:
+            return
+        x = np.asarray(logits_row, np.float64)
+        x = x - x.max()
+        self.logprobs.append(
+            float(x[tok] - np.log(np.exp(x).sum())))
 
     @property
     def done(self):
@@ -800,6 +816,7 @@ class ServingEngine:
         tok = req.pick(row) if req.temperature > 0.0 else int(np.argmax(row))
         req.next_token = tok
         req.output.append(tok)
+        req.note_logprob(tok, row)
         if req.done:  # e.g. max_new_tokens == 1
             self.finished.append(req)
             self._release(slot)
@@ -846,16 +863,19 @@ class ServingEngine:
             interpret=self._interpret, k_scale=self.k_scale,
             v_scale=self.v_scale)
         # all-greedy fast path: argmax on device, transfer max_seqs ints;
-        # only sampling requests pull their [vocab] logits row to host
-        sampled = [s for s in active_slots
-                   if self._slots[s].temperature > 0.0]
+        # only sampling/logprobs requests pull their [vocab] row to host
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))
-        rows = {s: np.asarray(logits[s]) for s in sampled}
+        rows = {s: np.asarray(logits[s]) for s in active_slots
+                if self._slots[s].temperature > 0.0
+                or self._slots[s].want_logprobs}
         for s in active_slots:
             req = self._slots[s]
-            tok = req.pick(rows[s]) if s in rows else int(greedy_nxt[s])
+            tok = req.pick(rows[s]) if req.temperature > 0.0 \
+                else int(greedy_nxt[s])
             req.output.append(tok)
             req.next_token = tok
+            if req.want_logprobs:
+                req.note_logprob(tok, rows[s])
             if req.done:
                 self.finished.append(req)
                 self._release(s)
@@ -931,10 +951,15 @@ class ServingEngine:
             k_scale=self.k_scale, v_scale=self.v_scale)
         self.device_steps += 1
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)
-        sampled = {s: np.asarray(logits[s, :int(n_tok[s])])
-                   for s in active_slots
-                   if self._slots[s].temperature > 0.0
-                   and not self._prefilling(self._slots[s])}
+        # one rows dict for everyone who needs host rows: sampling
+        # requests AND logprobs requests (emission j's logprob comes
+        # from chunk row j); pure-greedy no-logprobs slots stay on the
+        # device-argmax fast path
+        rows_by_slot = {s: np.asarray(logits[s, :int(n_tok[s])])
+                        for s in active_slots
+                        if (self._slots[s].temperature > 0.0
+                            or self._slots[s].want_logprobs)
+                        and not self._prefilling(self._slots[s])}
         for s in active_slots:
             req = self._slots[s]
             n = int(n_tok[s])
@@ -947,16 +972,16 @@ class ServingEngine:
                     self._seed_first_token(s, req,
                                            np.asarray(logits[s, n - 1]))
                 continue
-            if s in sampled and n > 1:
+            rows = rows_by_slot.get(s)
+            if req.temperature > 0.0 and n > 1:
                 # speculative sampling: distributionally exact; rows
                 # filter lazily (rejection at g touches g+1 rows only)
-                rows = sampled[s]
                 outs, a = speculative_sample(
                     lambda g: filtered_probs_np(rows[g], req.temperature,
                                                 req.top_k, req.top_p),
                     tokens[s, 1:n], req.rng)
-            elif s in sampled:
-                outs, a = [req.pick(sampled[s][0])], 0
+            elif req.temperature > 0.0:
+                outs, a = [req.pick(rows[0])], 0
             else:
                 outs = [int(t) for t in greedy_nxt[s, :n]]
                 # accept drafts while they match the model's own choices
@@ -966,9 +991,11 @@ class ServingEngine:
                 outs = outs[:a + 1]
             self.spec_accepted += a
             emitted = 0
-            for tok in outs:
+            for j, tok in enumerate(outs):
                 req.output.append(tok)
                 req.next_token = tok
+                if req.want_logprobs:
+                    req.note_logprob(tok, rows[j])
                 emitted += 1
                 if req.done:
                     break
