@@ -44,6 +44,8 @@ def main():
     ap.add_argument("--cache", choices=["fp", "int8"], default="fp")
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--new-tokens", type=int, default=32)
+    ap.add_argument("--logprobs", action="store_true",
+                    help="record per-token raw-model logprobs")
     args = ap.parse_args()
 
     cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=8,
@@ -61,7 +63,8 @@ def main():
         # mix greedy and sampled requests in one batch
         kw = {} if i % 3 else {"temperature": 0.8, "top_k": 16, "seed": i}
         eng.submit(Request(f"req{i}", prompt,
-                           max_new_tokens=args.new_tokens, **kw))
+                           max_new_tokens=args.new_tokens,
+                           logprobs=args.logprobs, **kw))
 
     t0 = time.perf_counter()
     done = eng.run()
@@ -75,6 +78,8 @@ def main():
               f"{eng.spec_accepted} accepted ({rate:.0%})")
     for r in done[:3]:
         print(f"  {r.rid}: {r.output[:10]}{'...' if len(r.output) > 10 else ''}")
+        if r.logprobs is not None:
+            print(f"    logprobs: {[round(x, 3) for x in r.logprobs[:6]]}...")
 
 
 if __name__ == "__main__":
