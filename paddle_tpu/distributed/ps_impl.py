@@ -35,6 +35,7 @@ import os
 import socket
 import socketserver
 import struct
+import sys
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
@@ -202,9 +203,33 @@ def _send_msg(sock, op, table, ids=None, payload=None):
                  + struct.pack("<I", len(body)) + body)
 
 
+_MAX_BODY = 1 << 30
+
+
 def _recv_msg(sock):
     op, table, n, dim = _HDR.unpack(_recv_exact(sock, _HDR.size))
     (blen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    # strict validation mirroring ptps.cpp's handle_conn: a malformed
+    # frame must read as a clean protocol error (the handler drops the
+    # connection), not an np ValueError escaping a handler thread nor a
+    # 4 GiB allocation from a garbage length field — cap BEFORE reading
+    if blen > _MAX_BODY:
+        raise ConnectionError(f"ps wire: body {blen}B exceeds cap")
+    if blen < 8 * n:
+        raise ConnectionError(
+            f"ps wire: body {blen}B shorter than {n} ids")
+    pay_bytes = blen - 8 * n
+    if pay_bytes % 4 or (dim and (pay_bytes // 4) % dim):
+        raise ConnectionError(
+            f"ps wire: payload {pay_bytes}B not a (n, dim={dim}) "
+            "float32 matrix")
+    if op == _OP_PUSH and pay_bytes != 4 * n * dim:
+        # a PUSH with fewer grad rows than ids would otherwise
+        # broadcast one row across all n table rows in push() —
+        # silent corruption; the C++ tier rejects this exact frame
+        raise ConnectionError(
+            f"ps wire: push payload {pay_bytes}B != {n} x dim={dim} "
+            "float32 rows")
     body = _recv_exact(sock, blen)
     ids = np.frombuffer(body[:8 * n], np.int64)
     pay = np.frombuffer(body[8 * n:], np.float32)
@@ -237,6 +262,12 @@ class _PSHandler(socketserver.BaseRequestHandler):
                     threading.Thread(target=self.server.shutdown,
                                      daemon=True).start()
                     return
+        except (ValueError, IndexError) as e:
+            # dim-mismatched push vs the served table, or a table id the
+            # server doesn't host: drop the connection cleanly (the C++
+            # tier validates against t.dim and breaks the same way)
+            print(f"ps server: protocol error, dropping connection: {e}",
+                  file=sys.stderr)
         except (ConnectionError, OSError):
             return
 
@@ -298,7 +329,8 @@ def _load_ptps():
                                 ctypes.c_float, ctypes.c_float,
                                 ctypes.c_float]
     lib.ptps_serve.restype = ctypes.c_int
-    lib.ptps_serve.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptps_serve.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int]
     lib.ptps_size.restype = ctypes.c_longlong
     lib.ptps_size.argtypes = [ctypes.c_void_p]
     lib.ptps_stopping.restype = ctypes.c_int
@@ -322,20 +354,27 @@ class CppPSServer:
 
     def __init__(self, dim, optimizer="adagrad", lr=0.01, seed=0,
                  init_scale=0.01, beta1=0.9, beta2=0.999, eps=1e-8,
-                 port=0):
+                 port=0, host="127.0.0.1"):
         if optimizer not in _CPP_OPT:
             raise ValueError(f"unknown sparse optimizer: {optimizer!r}")
         lib = _load_ptps()
         self._lib = lib
+        self._h_lock = threading.Lock()
         self._h = lib.ptps_create(int(dim), _CPP_OPT[optimizer],
                                   float(lr), int(seed), float(init_scale),
                                   float(beta1), float(beta2), float(eps))
-        bound = lib.ptps_serve(self._h, int(port))
+        # host="" binds all interfaces — only do that when remote
+        # workers must dial in (trusted network; docs/distributed.md).
+        # ptps_serve only parses dotted-quad, so resolve DNS names here
+        # (the python backend accepts them via socketserver)
+        if host and not host.replace(".", "").isdigit():
+            host = socket.gethostbyname(host)
+        bound = lib.ptps_serve(self._h, (host or "").encode(), int(port))
         if bound < 0:
             lib.ptps_destroy(self._h)
             self._h = None
             raise OSError("libptps: could not bind a listening socket")
-        self.endpoint = f"127.0.0.1:{bound}"
+        self.endpoint = f"{host or '127.0.0.1'}:{bound}"
 
     def _handle(self):
         if self._h is None:
@@ -343,7 +382,8 @@ class CppPSServer:
         return self._h
 
     def __len__(self):
-        return int(self._lib.ptps_size(self._handle()))
+        with self._h_lock:
+            return int(self._lib.ptps_size(self._handle()))
 
     def serve_in_thread(self):
         """API parity with EmbeddingPSServer: the native accept loop is
@@ -353,17 +393,23 @@ class CppPSServer:
 
     def serve_forever(self):
         """Block until a client sends STOP — or another thread calls
-        close() (re-reads the handle each poll so a cross-thread close
-        exits cleanly instead of polling freed memory)."""
+        close(). Each poll snapshots the handle AND calls into the
+        native lib under _h_lock: the check-then-call would otherwise
+        race a concurrent close() ptps_destroy-ing the handle between
+        the two (the old pattern only narrowed that window)."""
         import time
         self._handle()
-        while self._h is not None and not self._lib.ptps_stopping(self._h):
+        while True:
+            with self._h_lock:
+                if self._h is None or self._lib.ptps_stopping(self._h):
+                    return
             time.sleep(0.05)
 
     def close(self):
-        if self._h is not None:
-            self._lib.ptps_destroy(self._h)
-            self._h = None
+        with self._h_lock:
+            if self._h is not None:
+                self._lib.ptps_destroy(self._h)
+                self._h = None
 
 
 class _RemoteShard:
@@ -530,7 +576,7 @@ def init_server(tables=None, port=None, host=None, backend=None):
     serves the shard from libptps (csrc/ptps.cpp) — same wire protocol,
     native table + optimizer. The C++ backend hosts ONE table per
     server built from the first table's (dim, optimizer, lr, seed)
-    spec; it binds all interfaces by construction.
+    spec and rejects frames addressed to any other table id.
 
     Workers on OTHER hosts must be able to reach the advertised
     endpoint, so when one is configured the python server binds all
@@ -540,7 +586,6 @@ def init_server(tables=None, port=None, host=None, backend=None):
     tabs = []
     for t in (tables or [SparseTable(8)]):
         tabs.append(t if isinstance(t, SparseTable) else SparseTable(*t))
-    explicit_host = host
     if port is None:
         eps, rank = _endpoints(), int(os.environ.get("PT_PS_RANK", "0"))
         port = int(eps[rank].rsplit(":", 1)[1]) if eps else 0
@@ -548,15 +593,12 @@ def init_server(tables=None, port=None, host=None, backend=None):
             host = "0.0.0.0"
     backend = backend or os.environ.get("PT_PS_BACKEND", "python")
     if backend == "cpp":
-        if explicit_host is not None:
-            raise ValueError(
-                "backend='cpp' always binds all interfaces (libptps); "
-                "an explicit host would be silently ignored — drop it "
-                "or use the python backend for loopback-only shards")
         if len(tabs) != 1:
             raise ValueError(
                 "backend='cpp' hosts one table per server process — "
-                f"got {len(tabs)}; run one server per table")
+                f"got {len(tabs)}; multi-table workers "
+                "(init_worker(n_tables>1)) need the python backend — "
+                "every endpoint must serve every table id")
         t = tabs[0]
         if len(t):
             raise ValueError(
@@ -565,7 +607,7 @@ def init_server(tables=None, port=None, host=None, backend=None):
         srv = CppPSServer(t.dim, optimizer=t.optimizer, lr=t.lr,
                           seed=t.seed, init_scale=t.init_scale,
                           beta1=t.beta1, beta2=t.beta2, eps=t.eps,
-                          port=port)
+                          port=port, host=host or "127.0.0.1")
     elif backend == "python":
         srv = EmbeddingPSServer(tabs, host=host or "127.0.0.1", port=port)
     else:

@@ -9,6 +9,9 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from .._core.tensor import Tensor
+from ..optimizer.rules import LarsMomentum as LarsMomentumOptimizer  # noqa: F401
+# (reference: python/paddle/incubate/optimizer/__init__.py:18 exports
+# LarsMomentumOptimizer from lars_momentum.py)
 
 
 class ExponentialMovingAverage:

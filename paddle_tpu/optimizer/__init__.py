@@ -2,6 +2,6 @@
 from .optimizer import Optimizer  # noqa: F401
 from .rules import (  # noqa: F401
     SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta, RMSProp, Lamb,
-    NAdam, RAdam, ASGD, Rprop, Lion, LBFGS,
+    NAdam, RAdam, ASGD, Rprop, Lion, LBFGS, LarsMomentum,
 )
 from . import lr  # noqa: F401

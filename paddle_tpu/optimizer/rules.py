@@ -185,6 +185,57 @@ class Lamb(Optimizer, _DecoupledWeightDecayMixin):
         return p - lr * trust * r, slots
 
 
+class LarsMomentum(Optimizer):
+    """Layer-wise adaptive rate scaling + momentum (reference:
+    python/paddle/incubate/optimizer/lars_momentum.py:25,
+    paddle/phi/kernels/gpu/lars_momentum_kernel.cu):
+
+        local_lr = lr * lars_coeff * ||p|| /
+                   (||g|| + lars_weight_decay * ||p|| + eps)
+        v        = mu * v + local_lr * (g + lars_weight_decay * p)
+        p        = p - v
+
+    (epsilon guards the local_lr division, per the reference's
+    documented purpose "avoid Division by Zero when calculate local
+    lr" — its docstring typesets eps inside the velocity term, but the
+    division guard is the semantic.)
+
+    The reference's per-layer exclude_from_weight_decay name list is
+    not carried here (the functional rule sees arrays, not names);
+    construct a second LarsMomentum(lars_weight_decay=0.0) for the
+    excluded parameter group instead.
+    """
+    _slot_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, regularization=None,
+                 grad_clip=None, name=None, epsilon=0.0, multi_precision=False,
+                 rescale_grad=1.0, **kw):
+        super().__init__(learning_rate, parameters, regularization, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._rescale_grad = rescale_grad
+
+    def _rule(self, p, g, slots, lr):
+        g = g * self._rescale_grad
+        p_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+        g_norm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+        denom = g_norm + self._lars_wd * p_norm + self._epsilon
+        # gate on g_norm (not denom): the reference kernel falls back to
+        # plain lr when EITHER norm is zero, so a zero-grad param decays
+        # at lr*wd, not at the coeff/wd-scaled rate
+        local_lr = jnp.where((p_norm > 0) & (g_norm > 0),
+                             lr * self._lars_coeff * p_norm /
+                             jnp.maximum(denom, 1e-30), lr)
+        v = self._momentum * slots["velocity"] \
+            + local_lr * (g + self._lars_wd * p)
+        slots["velocity"] = v
+        return p - v, slots
+
+
 class NAdam(Optimizer):
     _slot_names = ("moment1", "moment2")
 

@@ -44,6 +44,39 @@ class TestRules:
         o.step()  # v=1.9 → p=-2.9
         assert np.allclose(p.numpy(), [-2.9], atol=1e-6)
 
+    def test_lars_momentum_analytic(self):
+        # reference lars_momentum.py:25 update equations, one step by hand:
+        # local_lr = lr*coeff*||p||/(||g|| + wd*||p||)
+        # v = mu*0 + local_lr*(g + wd*p);  p -= v
+        p0, g0 = np.array([3.0, 4.0], np.float32), np.array([0.6, 0.8],
+                                                            np.float32)
+        lr, coeff, wd, mu = 0.5, 0.1, 0.25, 0.9
+        p = pt.Parameter(pt.to_tensor(p0)._value)
+        o = opt_mod.LarsMomentum(learning_rate=lr, momentum=mu,
+                                 lars_coeff=coeff, lars_weight_decay=wd,
+                                 parameters=[p])
+        p.grad = pt.to_tensor(g0)
+        o.step()
+        local_lr = lr * coeff * 5.0 / (1.0 + wd * 5.0)   # ||p||=5, ||g||=1
+        v1 = local_lr * (g0 + wd * p0)
+        assert np.allclose(p.numpy(), p0 - v1, atol=1e-6)
+        p.grad = pt.to_tensor(g0)
+        o.step()  # momentum carries v1
+        p1 = p0 - v1
+        local_lr2 = lr * coeff * np.linalg.norm(p1) / (
+            np.linalg.norm(g0) + wd * np.linalg.norm(p1))
+        v2 = mu * v1 + local_lr2 * (g0 + wd * p1)
+        assert np.allclose(p.numpy(), p1 - v2, atol=1e-6)
+
+    def test_lars_converges(self):
+        # wd=0 so the fixed point is the quadratic minimum itself; the
+        # trust ratio makes the approach multiplicative (rate ~lr*coeff
+        # per step), hence the larger step budget than plain SGD needs
+        got, target = run_opt(opt_mod.LarsMomentum, steps=600, lr=1.0,
+                              momentum=0.5, lars_coeff=0.05,
+                              lars_weight_decay=0.0)
+        assert np.allclose(got, target, atol=0.05), got
+
     def test_adam_first_step_is_lr(self):
         p = pt.Parameter(pt.to_tensor([0.0])._value)
         o = opt_mod.Adam(learning_rate=0.01, parameters=[p])

@@ -251,6 +251,25 @@ class TestCppPSServer:
         finally:
             srv.close()
 
+    def test_rejects_nonzero_table_id(self):
+        """A C++ server hosts exactly one table; a frame addressed to
+        table 1 must be rejected (connection dropped), not silently
+        routed into table 0 (ADVICE r4: cross-table corruption)."""
+        from paddle_tpu.distributed.ps_impl import CppPSServer
+        srv = CppPSServer(4, optimizer="sgd", lr=0.5, seed=3)
+        try:
+            bad = _RemoteShard(srv.endpoint, 1)
+            with pytest.raises((ConnectionError, OSError)):
+                bad.pull([5])
+            bad.close()
+            # table 0 still served, untouched
+            ok = _RemoteShard(srv.endpoint, 0)
+            assert ok.pull([5]).shape == (1, 4)
+            assert len(srv) == 1
+            ok.close()
+        finally:
+            srv.close()
+
     def test_adam_rule_matches_python_table(self):
         """Same grads on an existing row: the C++ adam update must track
         the Python SparseTable's exactly (init rows differ by design —
