@@ -21,6 +21,7 @@
 // Per-row optimizers match ps_impl.SparseTable: 0=sgd, 1=adagrad,
 // 2=adam (per-row bias-correction step count).
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -30,15 +31,20 @@
 #include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <fstream>
 #include <mutex>
+#include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
 
 namespace {
 
-constexpr uint8_t OP_PULL = 1, OP_PUSH = 2, OP_LEN = 3, OP_STOP = 4;
+constexpr uint8_t OP_PULL = 1, OP_PUSH = 2, OP_LEN = 3, OP_STOP = 4,
+                  OP_SAVE = 5, OP_LOAD = 6;
+constexpr uint32_t MAX_PATH_LEN = 4096;
 
 uint64_t splitmix64(uint64_t x) {
   x += 0x9E3779B97F4A7C15ULL;
@@ -137,6 +143,89 @@ struct Table {
       }
     }
   }
+
+  // checkpoint: own binary format ("PTPS1"), written atomically
+  // (tmp + rename). A table lives its whole life on one backend, so
+  // this is NOT interchange format with the Python .npz shards —
+  // restore a cpp checkpoint onto a cpp server.
+  bool save(const char* path) {
+    std::lock_guard<std::mutex> lk(mu);
+    // mu serializes saves within this server; the pid qualifier keeps
+    // two server PROCESSES checkpointing to one shared-fs path from
+    // interleaving writes into the same tmp file
+    std::string tmp = std::string(path) + ".tmp." +
+                      std::to_string(::getpid());
+    std::ofstream f(tmp, std::ios::binary | std::ios::trunc);
+    if (!f) return false;
+    const char magic[6] = {'P', 'T', 'P', 'S', '1', '\0'};
+    int64_t n = static_cast<int64_t>(slot.size());
+    f.write(magic, 6);
+    f.write(reinterpret_cast<const char*>(&dim), sizeof(dim));
+    f.write(reinterpret_cast<const char*>(&opt), sizeof(opt));
+    f.write(reinterpret_cast<const char*>(&n), sizeof(n));
+    for (const auto& kv : slot) {
+      f.write(reinterpret_cast<const char*>(&kv.first), sizeof(int64_t));
+      f.write(reinterpret_cast<const char*>(rows.data() + kv.second * dim),
+              sizeof(float) * dim);
+      if (opt == 1)
+        f.write(reinterpret_cast<const char*>(g2.data() + kv.second * dim),
+                sizeof(float) * dim);
+      else if (opt == 2) {
+        f.write(reinterpret_cast<const char*>(m.data() + kv.second * dim),
+                sizeof(float) * dim);
+        f.write(reinterpret_cast<const char*>(v.data() + kv.second * dim),
+                sizeof(float) * dim);
+        f.write(reinterpret_cast<const char*>(&steps[kv.second]),
+                sizeof(int64_t));
+      }
+    }
+    f.flush();
+    if (!f) return false;
+    f.close();
+    // fsync before rename or the "crash never corrupts the previous
+    // checkpoint" guarantee is a lie under delayed allocation (the
+    // Python tier does flush+fsync for the same reason)
+    int fd = ::open(tmp.c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    bool synced = ::fsync(fd) == 0;
+    ::close(fd);
+    return synced && ::rename(tmp.c_str(), path) == 0;
+  }
+
+  bool load(const char* path) {
+    std::ifstream f(path, std::ios::binary);
+    if (!f) return false;
+    char magic[6];
+    int fdim, fopt;
+    int64_t n;
+    f.read(magic, 6);
+    f.read(reinterpret_cast<char*>(&fdim), sizeof(fdim));
+    f.read(reinterpret_cast<char*>(&fopt), sizeof(fopt));
+    f.read(reinterpret_cast<char*>(&n), sizeof(n));
+    if (!f || std::memcmp(magic, "PTPS1", 5) != 0 || fdim != dim ||
+        fopt != opt)
+      return false;
+    std::lock_guard<std::mutex> lk(mu);
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t id;
+      f.read(reinterpret_cast<char*>(&id), sizeof(id));
+      if (!f) return false;
+      size_t s = ensure(id);
+      f.read(reinterpret_cast<char*>(rows.data() + s * dim),
+             sizeof(float) * dim);
+      if (opt == 1)
+        f.read(reinterpret_cast<char*>(g2.data() + s * dim),
+               sizeof(float) * dim);
+      else if (opt == 2) {
+        f.read(reinterpret_cast<char*>(m.data() + s * dim),
+               sizeof(float) * dim);
+        f.read(reinterpret_cast<char*>(v.data() + s * dim),
+               sizeof(float) * dim);
+        f.read(reinterpret_cast<char*>(&steps[s]), sizeof(int64_t));
+      }
+    }
+    return static_cast<bool>(f);
+  }
 };
 
 struct Server {
@@ -226,8 +315,18 @@ void handle_conn(Server* srv, int fd) {
       want_payload = static_cast<uint64_t>(h.n) * t.dim * sizeof(float);
     if ((h.op == OP_PULL && blen != ids_bytes) ||
         (h.op == OP_PUSH && blen != ids_bytes + want_payload) ||
-        ((h.op == OP_LEN || h.op == OP_STOP) && blen != 0))
+        ((h.op == OP_LEN || h.op == OP_STOP) && blen != 0) ||
+        ((h.op == OP_SAVE || h.op == OP_LOAD) &&
+         (h.n != 0 || h.dim != 0 || blen == 0 || blen >= MAX_PATH_LEN)))
       break;
+    if (h.op == OP_SAVE || h.op == OP_LOAD) {
+      std::string path(body.data(), blen);
+      bool ok = h.op == OP_SAVE ? t.save(path.c_str())
+                                : t.load(path.c_str());
+      if (!ok) break;  // client reads the drop as the failure signal
+      if (!send_msg(fd, h.op, h.table, 0, 0, nullptr, nullptr, 0)) break;
+      continue;
+    }
     const auto* ids = reinterpret_cast<const int64_t*>(body.data());
     const auto* pay =
         reinterpret_cast<const float*>(body.data() + ids_bytes);
@@ -326,6 +425,14 @@ int ptps_serve(void* handle, const char* host, int port) {
     }
   });
   return srv->port;
+}
+
+int ptps_save(void* handle, const char* path) {
+  return static_cast<Server*>(handle)->table.save(path) ? 0 : -1;
+}
+
+int ptps_load(void* handle, const char* path) {
+  return static_cast<Server*>(handle)->table.load(path) ? 0 : -1;
 }
 
 int ptps_stopping(void* handle) {

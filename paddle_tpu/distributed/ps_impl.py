@@ -49,6 +49,10 @@ __all__ = [
 ]
 
 
+_save_seq = 0
+_save_seq_lock = threading.Lock()
+
+
 def shard_of(ids, n_shards):
     """Server shard owning each id (stable modulo placement)."""
     return np.asarray(ids) % n_shards
@@ -66,22 +70,36 @@ class SparseTable:
     hash tables, not dense arrays): id -> slot index into growing numpy
     arrays. Unseen rows are initialized deterministically from
     (seed, id) so restarts and re-shards reproduce them exactly.
+
+    Feature-entry accessor (reference: CtrAccessor config in
+    the_one_ps.proto / ps/utils/ps_program_builder.py): with
+    entry_threshold > 0, a row's embedding only participates after its
+    feature has been SEEN that many times — pulls below threshold
+    return zeros and pushes only count the show, so one-off junk
+    features never materialize trainable state. show_decay_rate < 1
+    ages show counts via decay_shows() (call once per pass/epoch);
+    shrink() then drops rows whose decayed count fell below threshold
+    — the reference's table shrink for bounding rec-sys table growth.
     """
 
     GROW = 1024
 
     def __init__(self, dim, optimizer="adagrad", lr=0.01, seed=0,
-                 init_scale=0.01, beta1=0.9, beta2=0.999, eps=1e-8):
+                 init_scale=0.01, beta1=0.9, beta2=0.999, eps=1e-8,
+                 entry_threshold=0, show_decay_rate=1.0):
         self.dim = int(dim)
         self.optimizer = optimizer
         if optimizer not in ("sgd", "adagrad", "adam"):
             raise ValueError(f"unknown sparse optimizer: {optimizer!r}")
         self.lr, self.seed, self.init_scale = float(lr), int(seed), init_scale
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.entry_threshold = float(entry_threshold)
+        self.show_decay_rate = float(show_decay_rate)
         self._slot = {}                       # id -> row index
         self._rows = np.empty((0, dim), np.float32)
         self._state = {}                      # name -> per-row state array
         self._steps = np.empty((0,), np.int64)  # adam bias-correction t
+        self._shows = np.empty((0,), np.float32)  # accessor show counts
         self._lock = threading.Lock()
         if optimizer == "adagrad":
             self._state["g2"] = np.empty((0, dim), np.float32)
@@ -111,21 +129,31 @@ class SparseTable:
                     self._state[k] = st
                 self._steps = np.resize(self._steps, (cap,))
                 self._steps[n0:] = 0
+                self._shows = np.resize(self._shows, (cap,))
+                self._shows[n0:] = 0.0
             for j, id_ in enumerate(new):
                 self._slot[id_] = n0 + j
                 self._rows[n0 + j] = self._init_row(id_)
                 for k in self._state:
                     self._state[k][n0 + j] = 0.0
                 self._steps[n0 + j] = 0
+                self._shows[n0 + j] = 0.0
         return np.fromiter((self._slot[i] for i in ids), np.int64,
                            count=len(ids))
 
     def pull(self, ids):
-        """rows (n, dim) for int64 ids (duplicates allowed)."""
+        """rows (n, dim) for int64 ids (duplicates allowed). Each pull
+        counts one show per occurrence; below-threshold rows read as
+        zeros (embedding not yet created, reference CtrAccessor entry
+        semantics)."""
         ids = np.asarray(ids, np.int64)
         with self._lock:
             idx = self._ensure(ids.tolist())
-            return self._rows[idx].copy()
+            np.add.at(self._shows, idx, 1.0)
+            out = self._rows[idx].copy()
+            if self.entry_threshold > 0:
+                out[self._shows[idx] < self.entry_threshold] = 0.0
+            return out
 
     def push(self, ids, grads):
         """Apply per-row rule to summed-by-id gradients (scatter-add:
@@ -141,6 +169,15 @@ class SparseTable:
         np.add.at(g, inv, grads)
         with self._lock:
             idx = self._ensure(uniq.tolist())
+            if self.entry_threshold > 0:
+                # below-threshold rows: the pull returned zeros, so the
+                # incoming gradient is for an embedding that does not
+                # exist yet — drop it (the show was already counted)
+                live = self._shows[idx] >= self.entry_threshold
+                if not live.all():
+                    idx, g = idx[live], g[live]
+                    if not len(idx):
+                        return
             if self.optimizer == "sgd":
                 self._rows[idx] -= self.lr * g
             elif self.optimizer == "adagrad":
@@ -157,23 +194,96 @@ class SparseTable:
                 vhat = v[idx] / (1 - self.beta2 ** t)
                 self._rows[idx] -= self.lr * mhat / (np.sqrt(vhat) + self.eps)
 
+    def decay_shows(self, rate=None):
+        """Age every row's show count (reference: CtrAccessor
+        show_click_decay_rate, applied once per pass)."""
+        rate = self.show_decay_rate if rate is None else float(rate)
+        with self._lock:
+            self._shows *= rate
+
+    def shrink(self, threshold=None):
+        """Drop rows whose (decayed) show count fell below threshold —
+        the reference's table shrink. Returns #rows dropped. Surviving
+        rows keep their optimizer state; dropped ids re-materialize
+        from the deterministic init if seen again."""
+        threshold = self.entry_threshold if threshold is None \
+            else float(threshold)
+        with self._lock:
+            keep = [(i, s) for i, s in self._slot.items()
+                    if self._shows[s] >= threshold]
+            dropped = len(self._slot) - len(keep)
+            if not dropped:
+                return 0
+            old_idx = np.asarray([s for _, s in keep], np.int64)
+            self._slot = {i: j for j, (i, _) in enumerate(keep)}
+            n = len(keep)
+            self._rows[:n] = self._rows[old_idx]
+            for k in self._state:
+                self._state[k][:n] = self._state[k][old_idx]
+            self._steps[:n] = self._steps[old_idx]
+            self._shows[:n] = self._shows[old_idx]
+            return dropped
+
     def state_dict(self):
         with self._lock:
             ids = np.fromiter(self._slot.keys(), np.int64, len(self._slot))
             idx = np.fromiter(self._slot.values(), np.int64, len(self._slot))
             out = {"ids": ids, "rows": self._rows[idx].copy(),
-                   "steps": self._steps[idx].copy()}
+                   "steps": self._steps[idx].copy(),
+                   "shows": self._shows[idx].copy()}
             for k, st in self._state.items():
                 out[k] = st[idx].copy()
             return out
 
     def load_state_dict(self, d):
+        # validate BEFORE mutating (ptps.cpp checks fdim/fopt the same
+        # way): a mismatched checkpoint must raise cleanly, not leave a
+        # half-restored table with fresh-materialized ids and stale
+        # optimizer state
+        rows = np.asarray(d["rows"])
+        if rows.ndim != 2 or rows.shape[1] != self.dim:
+            raise ValueError(
+                f"checkpoint rows {rows.shape} do not match table "
+                f"dim={self.dim}")
+        missing = [k for k in self._state if k not in d]
+        if missing:
+            raise ValueError(
+                f"checkpoint lacks {missing} state for the "
+                f"{self.optimizer!r} optimizer — saved by a different "
+                "optimizer?")
+        if len(rows) != len(np.asarray(d["ids"])):
+            raise ValueError("checkpoint ids/rows length mismatch")
         with self._lock:
             idx = self._ensure([int(i) for i in d["ids"]])
             self._rows[idx] = d["rows"]
             self._steps[idx] = d.get("steps", 0)
+            if "shows" in d:
+                self._shows[idx] = d["shows"]
             for k in self._state:
                 self._state[k][idx] = d[k]
+
+    def save(self, path):
+        """Atomic checkpoint of this shard (same tmp+rename guarantee
+        as utils/checkpoint.py — a crash mid-write never corrupts the
+        previous checkpoint). Reference: the_one_ps table save paths."""
+        d = self.state_dict()
+        # pid+tid+counter: two concurrent SAVE RPCs for the same path
+        # (separate handler threads, one process) must not interleave
+        # writes into one tmp file and rename the mix over a good ckpt
+        with _save_seq_lock:
+            global _save_seq
+            _save_seq += 1
+            seq = _save_seq
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}.{seq}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **d)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def load(self, path):
+        with np.load(path) as d:
+            self.load_state_dict({k: d[k] for k in d.files})
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +292,11 @@ class SparseTable:
 
 _HDR = struct.Struct("<BHII")
 _OP_PULL, _OP_PUSH, _OP_LEN, _OP_STOP = 1, 2, 3, 4
+# SAVE/LOAD carry a server-side filesystem path as a raw utf-8 body
+# (n = dim = 0) — checkpoint/restore is triggered by the trainer but
+# executed where the table lives (reference: the_one_ps save/load)
+_OP_SAVE, _OP_LOAD = 5, 6
+_MAX_PATH = 4096
 
 
 def _recv_exact(sock, n):
@@ -203,6 +318,12 @@ def _send_msg(sock, op, table, ids=None, payload=None):
                  + struct.pack("<I", len(body)) + body)
 
 
+def _send_raw(sock, op, table, data: bytes):
+    """SAVE/LOAD frames: raw body, n = dim = 0."""
+    sock.sendall(_HDR.pack(op, table, 0, 0)
+                 + struct.pack("<I", len(data)) + data)
+
+
 _MAX_BODY = 1 << 30
 
 
@@ -215,6 +336,10 @@ def _recv_msg(sock):
     # 4 GiB allocation from a garbage length field — cap BEFORE reading
     if blen > _MAX_BODY:
         raise ConnectionError(f"ps wire: body {blen}B exceeds cap")
+    if op in (_OP_SAVE, _OP_LOAD):
+        if n or dim or blen > _MAX_PATH:
+            raise ConnectionError("ps wire: malformed save/load frame")
+        return op, table, _recv_exact(sock, blen), None
     if blen < 8 * n:
         raise ConnectionError(
             f"ps wire: body {blen}B shorter than {n} ids")
@@ -255,6 +380,12 @@ class _PSHandler(socketserver.BaseRequestHandler):
                     n = len(server.tables[table])
                     _send_msg(sock, _OP_LEN, table,
                               ids=np.asarray([n], np.int64))
+                elif op == _OP_SAVE:
+                    server.tables[table].save(ids.decode())
+                    _send_msg(sock, _OP_SAVE, table)
+                elif op == _OP_LOAD:
+                    server.tables[table].load(ids.decode())
+                    _send_msg(sock, _OP_LOAD, table)
                 elif op == _OP_STOP:
                     _send_msg(sock, _OP_STOP, table)
                     self.server.shutdown_requested = True
@@ -333,6 +464,10 @@ def _load_ptps():
                                ctypes.c_int]
     lib.ptps_size.restype = ctypes.c_longlong
     lib.ptps_size.argtypes = [ctypes.c_void_p]
+    lib.ptps_save.restype = ctypes.c_int
+    lib.ptps_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptps_load.restype = ctypes.c_int
+    lib.ptps_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ptps_stopping.restype = ctypes.c_int
     lib.ptps_stopping.argtypes = [ctypes.c_void_p]
     lib.ptps_stop.argtypes = [ctypes.c_void_p]
@@ -391,6 +526,18 @@ class CppPSServer:
         self._handle()
         return None
 
+    def save(self, path):
+        """Atomic checkpoint in the native PTPS1 format (NOT the
+        python .npz — a table lives its whole life on one backend)."""
+        with self._h_lock:
+            if self._lib.ptps_save(self._handle(), str(path).encode()):
+                raise OSError(f"libptps: save to {path!r} failed")
+
+    def load(self, path):
+        with self._h_lock:
+            if self._lib.ptps_load(self._handle(), str(path).encode()):
+                raise OSError(f"libptps: load from {path!r} failed")
+
     def serve_forever(self):
         """Block until a client sends STOP — or another thread calls
         close(). Each poll snapshots the handle AND calls into the
@@ -433,6 +580,19 @@ class _RemoteShard:
     def push(self, ids, grads):
         self._rpc(_OP_PUSH, ids=ids, payload=grads)
 
+    def save(self, path):
+        """Server-side checkpoint of this shard to `path` (a path on
+        the SERVER's filesystem — multi-host deployments point it at
+        shared storage)."""
+        with self._lock:
+            _send_raw(self._sock, _OP_SAVE, self._table, path.encode())
+            _recv_msg(self._sock)
+
+    def load(self, path):
+        with self._lock:
+            _send_raw(self._sock, _OP_LOAD, self._table, path.encode())
+            _recv_msg(self._sock)
+
     def __len__(self):
         _, _, ids, _ = self._rpc(_OP_LEN)
         return int(ids[0])
@@ -456,13 +616,26 @@ class PSClient:
     exercise the same code the socket deployment runs.
     """
 
-    def __init__(self, shards):
+    def __init__(self, shards, async_push=False, max_inflight=64):
         self.shards = list(shards)
         # shard RPCs are independent — issue them concurrently so a
         # lookup pays one network round trip, not n_shards serialized
         # ones (each _RemoteShard already serializes on its own socket)
         self._pool = (ThreadPoolExecutor(max_workers=len(self.shards))
-                      if len(self.shards) > 1 else None)
+                      if len(self.shards) > 1 or async_push else None)
+        # async_push (reference: the async update mode of the PS
+        # runtime — trainers don't wait for the push ack): push()
+        # returns once the RPCs are QUEUED; flush() drains. Bounded so
+        # a fast trainer can't build an unbounded grad backlog. Two
+        # staleness/ordering caveats, both inherent to async-SGD: pulls
+        # of just-pushed ids may observe pre-update rows, and queued
+        # pushes to the SAME shard may apply out of submission order
+        # (exactly commutative for sgd's sum; a reordering for
+        # adagrad/adam, whose async application is nondeterministic in
+        # the reference too).
+        self._async = bool(async_push)
+        self._inflight = []
+        self._max_inflight = int(max_inflight)
 
     @property
     def n_shards(self):
@@ -498,10 +671,46 @@ class PSClient:
         ids = np.asarray(ids, np.int64).ravel()
         grads = np.asarray(grads, np.float32)
         owner = shard_of(ids, self.n_shards)
+        per_shard = [(s, (np.nonzero(owner == s)[0],))
+                     for s in range(self.n_shards) if np.any(owner == s)]
+        if self._async:
+            while len(self._inflight) >= self._max_inflight:
+                self._inflight.pop(0).result()
+            self._inflight.extend(
+                self._pool.submit(
+                    lambda sh, sel: sh.push(ids[sel], grads[sel]),
+                    self.shards[s], *a)
+                for s, a in per_shard)
+            return
+        self._fanout(lambda sh, sel: sh.push(ids[sel], grads[sel]),
+                     per_shard)
+
+    def flush(self):
+        """Drain async pushes; re-raises the first shard error."""
+        pending, self._inflight = self._inflight, []
+        for f in pending:
+            f.result()
+
+    def save(self, dirpath):
+        """Checkpoint every shard (shard{i}.npz under dirpath). Local
+        SparseTables write from this process; remote shards write
+        server-side — multi-host deployments need dirpath on shared
+        storage. Atomic per shard (tmp+rename)."""
+        self.flush()
+        os.makedirs(dirpath, exist_ok=True)
         self._fanout(
-            lambda sh, sel: sh.push(ids[sel], grads[sel]),
-            [(s, (np.nonzero(owner == s)[0],)) for s in range(self.n_shards)
-             if np.any(owner == s)])
+            lambda sh, p: sh.save(p),
+            [(s, (os.path.join(dirpath, f"shard{s}.npz"),))
+             for s in range(self.n_shards)])
+
+    def load(self, dirpath):
+        # drain queued async pushes FIRST: a stale push applied after
+        # its shard's restore would silently overwrite checkpoint rows
+        self.flush()
+        self._fanout(
+            lambda sh, p: sh.load(p),
+            [(s, (os.path.join(dirpath, f"shard{s}.npz"),))
+             for s in range(self.n_shards)])
 
     def __len__(self):
         return sum(len(s) for s in self.shards)

@@ -69,6 +69,24 @@ def _scatter_kv(kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant):
     return kp, vp, ksp, vsp, kl, vl, ksl, vsl
 
 
+def _attn_tp(fn, mesh, quant):
+    """shard_map wrapper for the paged attention kernels under tensor
+    parallelism: attention is embarrassingly parallel over heads, so
+    each tp rank runs the unmodified kernel on its local Q heads
+    (P(None, 'tp')) against its local KV heads (P('tp')) — GQA group
+    ratios survive because the engine requires nh % tp == kvh % tp == 0.
+    Everything around the kernel (matmuls, scatters, MLP) stays under
+    GSPMD; only the pallas call needs the manual region (reference: the
+    block_multi_head_attention kernel under fleet TP,
+    paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu +
+    distributed/fleet/meta_parallel/parallel_layers/mp_layers.py)."""
+    from jax.sharding import PartitionSpec as P
+    qs, kvs, rep = P(None, "tp"), P("tp"), P(None)
+    in_specs = (qs, kvs, kvs, rep, rep) + ((kvs, kvs) if quant else ())
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=qs,
+                         check_vma=False)
+
+
 # ---------------------------------------------------------------------------
 # jitted compute
 # ---------------------------------------------------------------------------
@@ -156,10 +174,10 @@ def prefill_varlen(params, input_ids, cu_seqlens, config: LlamaConfig,
 
 @functools.partial(jax.jit,
                    static_argnames=("config", "use_pallas", "page_size",
-                                    "interpret"))
+                                    "interpret", "mesh"))
 def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
                 active, config: LlamaConfig, page_size, use_pallas=False,
-                interpret=False, k_scale=None, v_scale=None):
+                interpret=False, k_scale=None, v_scale=None, mesh=None):
     """One token for every slot.
 
     k_pool/v_pool: (L, KVH, P, page, D); tokens: (B,) current input token;
@@ -198,9 +216,19 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
         vt = v[:, :, 0].swapaxes(0, 1)
         kp, vp, ksp, vsp, kl, vl, ksl, vsl = _scatter_kv(
             kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
-        o = paged_attention(q[:, :, 0], kl, vl, page_table, lengths,
-                            use_pallas=use_pallas, interpret=interpret,
-                            k_scale=ksl, v_scale=vsl)       # (B, QH, D)
+        if mesh is not None:
+            def _attn(q_, kl_, vl_, pt_, ln_, *sc):
+                return paged_attention(
+                    q_, kl_, vl_, pt_, ln_, use_pallas=use_pallas,
+                    interpret=interpret, k_scale=sc[0] if sc else None,
+                    v_scale=sc[1] if sc else None)
+            args = (q[:, :, 0], kl, vl, page_table, lengths) \
+                + ((ksl, vsl) if quant else ())
+            o = _attn_tp(_attn, mesh, quant)(*args)         # (B, QH, D)
+        else:
+            o = paged_attention(q[:, :, 0], kl, vl, page_table, lengths,
+                                use_pallas=use_pallas, interpret=interpret,
+                                k_scale=ksl, v_scale=vsl)   # (B, QH, D)
         h = h + o.reshape(B, 1, -1).astype(h.dtype) @ lp["wo"]
         x = _rms(h, lp["ln2"], c.rms_norm_eps)
         mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
@@ -217,11 +245,11 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
 
 @functools.partial(jax.jit,
                    static_argnames=("config", "page_size", "use_pallas",
-                                    "interpret"))
+                                    "interpret", "mesh"))
 def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
                 n_tok, active, config: LlamaConfig, page_size,
                 use_pallas=False, interpret=False,
-                k_scale=None, v_scale=None):
+                k_scale=None, v_scale=None, mesh=None):
     """Speculative-decoding verify: G chunk tokens per slot in ONE
     forward — every matmul runs at (B, G, ...) so one weight read
     covers G tokens, which is where the speculative speedup comes from
@@ -278,10 +306,20 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
         kp, vp, ksp, vsp, kl, vl, ksl, vsl = _scatter_kv(
             kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
         # q: (B, QH, G, D); per-row causal limit base+g inside the op
-        o = paged_verify_attention(q, kl, vl, page_table, lengths,
-                                   use_pallas=use_pallas,
-                                   interpret=interpret,
-                                   k_scale=ksl, v_scale=vsl)
+        if mesh is not None:
+            def _attn(q_, kl_, vl_, pt_, ln_, *sc):
+                return paged_verify_attention(
+                    q_, kl_, vl_, pt_, ln_, use_pallas=use_pallas,
+                    interpret=interpret, k_scale=sc[0] if sc else None,
+                    v_scale=sc[1] if sc else None)
+            args = (q, kl, vl, page_table, lengths) \
+                + ((ksl, vsl) if quant else ())
+            o = _attn_tp(_attn, mesh, quant)(*args)
+        else:
+            o = paged_verify_attention(q, kl, vl, page_table, lengths,
+                                       use_pallas=use_pallas,
+                                       interpret=interpret,
+                                       k_scale=ksl, v_scale=vsl)
         o = o.swapaxes(1, 2).reshape(B, G, nh * hd)
         h = h + o.astype(h.dtype) @ lp["wo"]
         x = _rms(h, lp["ln2"], c.rms_norm_eps)
@@ -439,8 +477,32 @@ class ServingEngine:
                  use_pallas=None, interpret=False, num_pages=None,
                  cache_dtype=None, preempt_policy="offload",
                  spec_decode=0, spec_ngram=2, chunked_prefill=False,
-                 spec_sample=False):
+                 spec_sample=False, mesh=None):
         c = config
+        # mesh with a 'tp' axis: tensor-parallel serving — weights get
+        # megatron NamedShardings (llama_spmd.param_specs), the KV pool
+        # shards over its KV-head axis, the paged kernels run per-rank
+        # under shard_map (_attn_tp) and everything else partitions via
+        # GSPMD. Admission/eviction logic is untouched: page_table and
+        # lengths stay replicated host-visible arrays. This is how a
+        # model larger than one chip serves (reference: fleet TP under
+        # the predictor, mp_layers.py + block_multihead_attention).
+        self._mesh = None
+        if mesh is not None and mesh.shape.get("tp", 1) > 1:
+            tp = mesh.shape["tp"]
+            if c.num_attention_heads % tp or c.num_key_value_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide num_attention_heads="
+                    f"{c.num_attention_heads} and num_key_value_heads="
+                    f"{c.num_key_value_heads} (degenerate GQA shardings "
+                    "are not supported)")
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            from . import llama_spmd as _spmd
+            params = _spmd.place_params(params, c, mesh, pp=False)
+            self._mesh = mesh
+            self._pool_sharding = NamedSharding(mesh, P(None, "tp"))
+            self._repl_sharding = NamedSharding(mesh, P())
         self.params = params
         self.config = c
         self.page_size = page_size
@@ -512,6 +574,7 @@ class ServingEngine:
         self.cache_quant = cache_dtype in ("int8", jnp.int8)
         pool_dtype = jnp.int8 if self.cache_quant else \
             (cache_dtype or dtype)
+        self.num_pages = num_pages
         pshape = (L, kvh, num_pages, page_size, hd)
         self.k_pool = jnp.zeros(pshape, pool_dtype)
         self.v_pool = jnp.zeros(pshape, pool_dtype)
@@ -520,8 +583,23 @@ class ServingEngine:
             self.v_scale = jnp.zeros(pshape[:-1] + (1,), jnp.float32)
         else:
             self.k_scale = self.v_scale = None
-        self.page_table = jnp.zeros((max_seqs, self.pages_per_seq), jnp.int32)
-        self.lengths = jnp.zeros((max_seqs,), jnp.int32)
+        # page_table/lengths are HOST numpy state, transferred once per
+        # device call: the admission/growth bookkeeping reads and writes
+        # them element-wise every step, and each element access on a
+        # device array is a blocking host<->device round trip (~31 eager
+        # dispatches per step measured on CPU; on TPU each is a tunnel
+        # latency) — the whole tables are a few hundred bytes, so one
+        # jnp.asarray per step is strictly cheaper
+        self.page_table = np.zeros((max_seqs, self.pages_per_seq), np.int32)
+        self.lengths = np.zeros((max_seqs,), np.int32)
+        if self._mesh is not None:
+            self.k_pool = jax.device_put(self.k_pool, self._pool_sharding)
+            self.v_pool = jax.device_put(self.v_pool, self._pool_sharding)
+            if self.cache_quant:
+                self.k_scale = jax.device_put(self.k_scale,
+                                              self._pool_sharding)
+                self.v_scale = jax.device_put(self.v_scale,
+                                              self._pool_sharding)
         # trash page (last) never enters the free list
         self._free = list(range(num_pages - 2, -1, -1))
         self._seq_pages = {s: [] for s in range(max_seqs)}
@@ -531,6 +609,13 @@ class ServingEngine:
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
         self._use_pallas = use_pallas
+        # prefill under tp runs the jnp attention (GSPMD partitions it
+        # over heads automatically); only the paged decode/verify
+        # kernels get the manual shard_map region. Prefill is
+        # matmul-bound, so XLA's fused attention is near-parity there —
+        # the pallas win is the decode path's page streaming.
+        self._use_pallas_prefill = False if self._mesh is not None \
+            else use_pallas
         self._interpret = interpret
 
     # -- request admission ------------------------------------------------
@@ -664,11 +749,16 @@ class ServingEngine:
         cu[take + 1:] = off  # unused tail: zero-length segments
         logits, k_all, v_all = prefill_varlen(
             self.params, jnp.asarray(ids), jnp.asarray(cu), self.config,
-            use_pallas=self._use_pallas, interpret=self._interpret)
+            use_pallas=self._use_pallas_prefill, interpret=self._interpret)
+        # ONE bucket-shaped scatter for the whole packed buffer: per-
+        # request slices would give every distinct prompt length its own
+        # scatter shape, and each shape is a fresh XLA compile (~100 ms
+        # on CPU, a tunnel round-trip on TPU) — measured 96 compiles in
+        # 65 steps before this, drowning steady-state decode
+        pg, off = self._packed_indices(k_all.shape[2])
         for i, (slot, req) in enumerate(zip(slots, reqs)):
-            a, b = int(cu[i]), int(cu[i + 1])
-            self._scatter_prompt(slot, k_all[:, :, a:b], v_all[:, :, a:b],
-                                 lens[i])
+            a = int(cu[i])
+            self._fill_indices(pg, off, slot, a, lens[i])
             req.slot = slot
             req._admit_order = self._order
             self._order += 1
@@ -679,16 +769,30 @@ class ServingEngine:
                 req._resume = False
             else:
                 self._seed_first_token(slot, req, np.asarray(logits[i]))
+        self._scatter_packed(k_all, v_all, pg, off)
 
-    def _scatter_prompt(self, slot, kq, vq, S):
-        """Scatter a prompt's per-layer K/V (L, KVH, S, D) into fresh
-        pages for `slot` and set its length."""
+    def _packed_indices(self, t):
+        """Fresh (page, offset) index arrays of length t, pointing at
+        the trash page — bucket-static shapes keep the scatter compile
+        count at one per bucket."""
+        pg = np.full((t,), self.num_pages - 1, np.int32)
+        off = (np.arange(t) % self.page_size).astype(np.int32)
+        return pg, off
+
+    def _fill_indices(self, pg, off, slot, start, S):
+        """Point positions start..start+S at slot's freshly-allocated
+        pages and set its length."""
         n_pages = -(-S // self.page_size)
         self._seq_pages[slot] = []
         pages = self._alloc_pages(slot, n_pages)
         pos = np.arange(S)
-        pg = np.asarray(pages)[pos // self.page_size]
-        off = pos % self.page_size
+        pg[start:start + S] = np.asarray(pages)[pos // self.page_size]
+        off[start:start + S] = pos % self.page_size
+        self.lengths[slot] = S
+
+    def _scatter_packed(self, kq, vq, pg, off):
+        """Scatter packed per-layer K/V (L, KVH, T, D) into the pools
+        at (pg, off) — trash-page tail positions absorb the padding."""
         if self.cache_quant:
             kq, ks = quantize_kv(kq)
             vq, vs = quantize_kv(vq)
@@ -698,7 +802,15 @@ class ServingEngine:
             kq.astype(self.k_pool.dtype))
         self.v_pool = self.v_pool.at[:, :, pg, off].set(
             vq.astype(self.v_pool.dtype))
-        self.lengths = self.lengths.at[slot].set(S)
+
+    def _scatter_prompt(self, slot, kq, vq, S):
+        """Scatter one prompt's per-layer K/V (L, KVH, T>=S, D) into
+        fresh pages for `slot`; positions past S land on the trash
+        page (pass the PADDED buffer — slicing to S would recompile
+        per prompt length)."""
+        pg, off = self._packed_indices(kq.shape[2])
+        self._fill_indices(pg, off, slot, 0, S)
+        self._scatter_packed(kq, vq, pg, off)
 
     def _alloc_pages(self, slot, n):
         if len(self._free) < n:
@@ -709,7 +821,7 @@ class ServingEngine:
         self._seq_pages[slot].extend(pages)
         start = len(self._seq_pages[slot]) - n
         for i, pg in enumerate(pages):
-            self.page_table = self.page_table.at[slot, start + i].set(pg)
+            self.page_table[slot, start + i] = pg
         return pages
 
     def _prefill_into(self, slot, req: Request):
@@ -723,8 +835,8 @@ class ServingEngine:
         ids[0, :S] = feed
         logits, k_all, v_all = prefill(self.params, jnp.asarray(ids),
                                        jnp.asarray(S), c,
-                                       use_pallas=self._use_pallas)
-        self._scatter_prompt(slot, k_all[:, :, :S], v_all[:, :, :S], S)
+                                       use_pallas=self._use_pallas_prefill)
+        self._scatter_prompt(slot, k_all, v_all, S)
         req.slot = slot
         req._admit_order = self._order
         self._order += 1
@@ -754,19 +866,25 @@ class ServingEngine:
         s = max(victims, key=lambda v: self._slots[v]._admit_order)
         req = self._slots[s]
         if self.preempt_policy == "offload":
-            pg = np.asarray(self._seq_pages[s])
+            n_pg = len(self._seq_pages[s])
+            # gather at the FIXED pages_per_seq width (tail reads the
+            # trash page, sliced off after the transfer): a per-count
+            # gather shape would be a fresh XLA compile per eviction size
+            pg = np.full((self.pages_per_seq,), self.num_pages - 1,
+                         np.int32)
+            pg[:n_pg] = self._seq_pages[s]
             req._offload = {
                 "len": int(self.lengths[s]),
                 # actual page count, NOT ceil(len/page_size): a victim
                 # evicted right after its boundary growth already holds
                 # the next (still-empty) page
-                "pages": len(pg),
-                "k": np.asarray(self.k_pool[:, :, pg]),
-                "v": np.asarray(self.v_pool[:, :, pg]),
+                "pages": n_pg,
+                "k": np.asarray(self.k_pool[:, :, pg])[:, :, :n_pg],
+                "v": np.asarray(self.v_pool[:, :, pg])[:, :, :n_pg],
                 "ks": None if self.k_scale is None else
-                      np.asarray(self.k_scale[:, :, pg]),
+                      np.asarray(self.k_scale[:, :, pg])[:, :, :n_pg],
                 "vs": None if self.v_scale is None else
-                      np.asarray(self.v_scale[:, :, pg]),
+                      np.asarray(self.v_scale[:, :, pg])[:, :, :n_pg],
             }
         req._resume = True
         req.slot = None
@@ -784,17 +902,27 @@ class ServingEngine:
         n_pages = o["pages"]
         self._seq_pages[slot] = []
         pages = self._alloc_pages(slot, n_pages)
-        pg = np.asarray(pages)
+        # scatter at the fixed pages_per_seq width (tail -> trash page),
+        # mirroring the offload gather: one compile total, not one per
+        # restored page count
+        ppseq = self.pages_per_seq
+        pg = np.full((ppseq,), self.num_pages - 1, np.int32)
+        pg[:n_pages] = pages
+
+        def pad(a):
+            out = np.zeros(a.shape[:2] + (ppseq,) + a.shape[3:], a.dtype)
+            out[:, :, :n_pages] = a
+            return out
         self.k_pool = self.k_pool.at[:, :, pg].set(
-            jnp.asarray(o["k"], self.k_pool.dtype))
+            jnp.asarray(pad(o["k"]), self.k_pool.dtype))
         self.v_pool = self.v_pool.at[:, :, pg].set(
-            jnp.asarray(o["v"], self.v_pool.dtype))
+            jnp.asarray(pad(o["v"]), self.v_pool.dtype))
         if self.cache_quant:
             self.k_scale = self.k_scale.at[:, :, pg].set(
-                jnp.asarray(o["ks"], jnp.float32))
+                jnp.asarray(pad(o["ks"]), jnp.float32))
             self.v_scale = self.v_scale.at[:, :, pg].set(
-                jnp.asarray(o["vs"], jnp.float32))
-        self.lengths = self.lengths.at[slot].set(S)
+                jnp.asarray(pad(o["vs"]), jnp.float32))
+        self.lengths[slot] = S
         req._offload = None
         req._resume = False
         req.slot = slot
@@ -853,15 +981,15 @@ class ServingEngine:
             tokens[s] = req.next_token
         active = np.zeros((self.max_seqs,), bool)
         active[active_slots] = True
-        self.lengths = jnp.where(jnp.asarray(active), self.lengths + 1,
-                                 self.lengths)
+        self.lengths = np.where(active, self.lengths + 1, self.lengths)
         (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
          logits) = decode_step(
-            self.params, self.k_pool, self.v_pool, self.page_table,
-            self.lengths, jnp.asarray(tokens), jnp.asarray(active),
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+            jnp.asarray(tokens), jnp.asarray(active),
             self.config, self.page_size, use_pallas=self._use_pallas,
             interpret=self._interpret, k_scale=self.k_scale,
-            v_scale=self.v_scale)
+            v_scale=self.v_scale, mesh=self._mesh)
         # all-greedy fast path: argmax on device, transfer max_seqs ints;
         # only sampling/logprobs requests pull their [vocab] row to host
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))
@@ -944,11 +1072,12 @@ class ServingEngine:
             return 0
         (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
          logits) = verify_step(
-            self.params, self.k_pool, self.v_pool, self.page_table,
-            self.lengths, jnp.asarray(tokens), jnp.asarray(n_tok),
+            self.params, self.k_pool, self.v_pool,
+            jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+            jnp.asarray(tokens), jnp.asarray(n_tok),
             jnp.asarray(active), self.config, self.page_size,
             use_pallas=self._use_pallas, interpret=self._interpret,
-            k_scale=self.k_scale, v_scale=self.v_scale)
+            k_scale=self.k_scale, v_scale=self.v_scale, mesh=self._mesh)
         self.device_steps += 1
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)
         # one rows dict for everyone who needs host rows: sampling
@@ -967,7 +1096,7 @@ class ServingEngine:
                 # chunk fed; emit nothing until the prompt is complete,
                 # then the final position's logits seed generation
                 req._pf_cursor += n
-                self.lengths = self.lengths.at[s].add(n)
+                self.lengths[s] += n
                 if req._pf_cursor >= len(req._pf_feed) and req._pf_sample:
                     self._seed_first_token(s, req,
                                            np.asarray(logits[s, n - 1]))
@@ -1001,7 +1130,7 @@ class ServingEngine:
                     break
             # cache retains chunk tokens 0..emitted-1 (the pending token
             # + the drafts CONSUMED to produce the emissions)
-            self.lengths = self.lengths.at[s].add(emitted)
+            self.lengths[s] += emitted
             if req.done:
                 self.finished.append(req)
                 self._release(s)
@@ -1010,7 +1139,7 @@ class ServingEngine:
     def _release(self, slot):
         self._free.extend(reversed(self._seq_pages[slot]))
         self._seq_pages[slot] = []
-        self.lengths = self.lengths.at[slot].set(0)
+        self.lengths[slot] = 0
         self._slots[slot] = None
 
     def run(self, max_steps=10000):

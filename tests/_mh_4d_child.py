@@ -1,15 +1,19 @@
-"""Child for test_multihost 4D runs: 2 processes x 4 local CPU devices
-= 8 global devices, with the MODEL-parallel axis spanning the process
+"""Child for test_multihost 4D runs: N processes x local CPU devices
+= 8 global devices, with MODEL-parallel axes spanning the process
 boundary (VERDICT r3 item 6 — the reference's multi-node TP/PP launch,
 ours over jax.distributed + XLA collectives).
 
-argv[1] selects the spanning axis:
+argv[1] selects the spanning axis (2 procs x 4 local devices):
   tp   — mesh (tp=2, dp=4), tp pairs are (0,4),(1,5)...: every tp
          collective crosses processes.
   pp   — mesh (pp=2, dp=4), GPipe scan pipeline: every ppermute hop
          crosses processes.
   pp1f1b — same mesh, 1F1B schedule: activations forward AND gradients
          backward cross processes every tick.
+  4p   — 4 procs x 2 local devices, mesh (pp=2, dp=2, tp=2) laid out so
+         BOTH tp pairs and pp hops cross process boundaries, with the
+         interleaved-1F1B schedule (VERDICT r5 item 10: the full 4D
+         layout over a 4-node-shaped launch).
 
 The full llama_spmd train step runs 2 steps on a dp-sharded global
 batch; the loss trajectory must match a single-device local reference
@@ -19,7 +23,8 @@ the same math.
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                           + os.environ.get("_MH_LOCAL_DEVICES", "4"))
 
 import jax  # noqa: E402
 
@@ -54,7 +59,8 @@ def main():
     mode = sys.argv[1]
     steps = 2
     E.init_parallel_env()
-    assert jax.process_count() == 2 and jax.device_count() == 8
+    assert jax.process_count() == (4 if mode == "4p" else 2) \
+        and jax.device_count() == 8
 
     cfg = LlamaConfig.tiny(vocab=64, hidden=32, layers=4, heads=4,
                            kv_heads=4, ffn=64)
@@ -69,6 +75,17 @@ def main():
     elif mode == "pp1f1b":
         mesh = Mesh(devices.reshape(2, 4), ("pp", "dp"))
         kw = dict(n_micro=2, schedule="1f1b")
+    elif mode == "4p":
+        # 4 procs x 2 local devices; process p owns global ids 2p, 2p+1.
+        # Layout [pp, dp, tp] = [[[0,2],[1,3]], [[4,6],[5,7]]]: a pp hop
+        # is procs {0,1} <-> {2,3} and a tp pair is (0,2)/(1,3)/... —
+        # every model-parallel collective crosses a process boundary,
+        # only dp pairs stay intra-process-adjacent. Interleave (vpp=2,
+        # layers=4) runs two virtual stages per pp rank, so activations
+        # cross processes twice per microbatch direction.
+        ids = np.array([[[0, 2], [1, 3]], [[4, 6], [5, 7]]])
+        mesh = Mesh(devices[ids], ("pp", "dp", "tp"))
+        kw = dict(n_micro=2, schedule="interleave", vpp=2)
     else:
         raise SystemExit(f"unknown mode {mode}")
     use_pp = "pp" in mesh.shape

@@ -37,6 +37,21 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     assert "spec_speedup" in out
 
 
+def test_serving_load_bench_structure(monkeypatch):
+    # scaled-down load sweep: the driver-visible table must carry all
+    # four configs with sane latency percentiles
+    bm = _load_bench_models()
+    monkeypatch.setenv("PT_BENCH_LOAD_REQS", "6")
+    out = bm.bench_serving_load(on_tpu=False)
+    assert set(out["configs"]) == {"fp", "fp_spec", "int8", "int8_spec"}
+    for name, c in out["configs"].items():
+        assert c["tokens_per_sec"] > 0, (name, c)
+        assert 0 <= c["ttft_p50_ms"] <= c["ttft_p99_ms"], (name, c)
+        assert 0 <= c["tpot_p50_ms"] <= c["tpot_p99_ms"], (name, c)
+        assert c["new_tokens"] > 0
+    assert out["requests"] == 6
+
+
 def test_plain_bench_unaffected(monkeypatch):
     bm = _load_bench_models()
     monkeypatch.delenv("PT_SERVE_SPEC", raising=False)

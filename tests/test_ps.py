@@ -385,3 +385,141 @@ class TestCppPSServer:
         t.pull([1])
         with pytest.raises(ValueError, match="materialized"):
             init_server([t], port=0, backend="cpp")
+
+
+class TestAccessorAndCheckpoint:
+    """Feature-entry accessors + table save/load (VERDICT r5 item 5;
+    reference: the_one_ps.py table save/load paths, the_one_ps.proto
+    CtrAccessor config)."""
+
+    def test_entry_threshold_gates_embedding(self):
+        t = SparseTable(4, optimizer="sgd", lr=0.5, seed=1,
+                        entry_threshold=3)
+        # first two sightings: embedding not created — zeros, grads dropped
+        assert np.allclose(t.pull([7]), 0.0)
+        t.push([7], np.ones((1, 4), np.float32))
+        assert np.allclose(t.pull([7]), 0.0)
+        # third sighting crosses the threshold: deterministic init appears
+        r = t.pull([7])
+        ref = SparseTable(4, optimizer="sgd", lr=0.5, seed=1)
+        np.testing.assert_array_equal(r, ref.pull([7]))
+        # and training applies now
+        t.push([7], np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(t.pull([7]), r - 0.5, rtol=1e-6)
+
+    def test_show_decay_and_shrink(self):
+        t = SparseTable(4, entry_threshold=2, show_decay_rate=0.5)
+        for _ in range(4):
+            t.pull([1])          # shows: 4
+        t.pull([2])              # shows: 1
+        assert len(t) == 2
+        t.decay_shows()          # 1 -> 2.0, 2 -> 0.5
+        assert t.shrink() == 1   # id 2 dropped
+        assert len(t) == 1
+        # survivor's row is intact and still addressable
+        assert t.pull([1]).shape == (1, 4)
+
+    def test_table_save_load_atomic(self, tmp_path):
+        t = SparseTable(4, optimizer="adam", lr=0.1, seed=2)
+        t.pull([5, 9, 13])
+        t.push([5, 9], np.ones((2, 4), np.float32))
+        p = str(tmp_path / "shard.npz")
+        t.save(p)
+        t2 = SparseTable(4, optimizer="adam", lr=0.1, seed=2)
+        t2.load(p)
+        np.testing.assert_array_equal(t2.pull([5, 9, 13]), t.pull([5, 9, 13]))
+        # adam state carried: same next step on both
+        g = np.full((1, 4), 0.3, np.float32)
+        t.push([5], g)
+        t2.push([5], g)
+        np.testing.assert_array_equal(t2.pull([5]), t.pull([5]))
+
+    def test_client_save_load_over_sockets(self, tmp_path):
+        # a 2-shard python socket deployment checkpoints server-side,
+        # dies, and a FRESH deployment restores to identical state
+        srvs = [EmbeddingPSServer([SparseTable(4, optimizer="adagrad",
+                                               lr=0.1, seed=s)],
+                                  host="127.0.0.1", port=0)
+                for s in range(2)]
+        for s in srvs:
+            s.serve_in_thread()
+        cli = PSClient([_RemoteShard(s.endpoint, 0) for s in srvs])
+        ids = [3, 8, 11, 14]
+        cli.pull(ids)
+        cli.push(ids, np.ones((4, 4), np.float32))
+        ck = str(tmp_path / "ps_ckpt")
+        cli.save(ck)
+        before = cli.pull(ids)
+        for s in srvs:
+            s.close()                      # crash the whole tier
+
+        srvs2 = [EmbeddingPSServer([SparseTable(4, optimizer="adagrad",
+                                                lr=0.1, seed=s)],
+                                   host="127.0.0.1", port=0)
+                 for s in range(2)]
+        for s in srvs2:
+            s.serve_in_thread()
+        cli2 = PSClient([_RemoteShard(s.endpoint, 0) for s in srvs2])
+        cli2.load(ck)
+        np.testing.assert_array_equal(cli2.pull(ids), before)
+        # training continues identically: adagrad state was restored
+        cli2.push(ids, np.ones((4, 4), np.float32))
+        ref = PSClient([SparseTable(4, optimizer="adagrad", lr=0.1, seed=s)
+                        for s in range(2)])
+        ref.pull(ids)
+        ref.push(ids, np.ones((4, 4), np.float32))
+        ref.pull(ids)   # align show counts (pull-counted)
+        ref.push(ids, np.ones((4, 4), np.float32))
+        np.testing.assert_allclose(cli2.pull(ids), ref.pull(ids), rtol=1e-6)
+        for s in srvs2:
+            s.close()
+
+    def test_cpp_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.ps_impl import CppPSServer
+        srv = CppPSServer(4, optimizer="adam", lr=0.1, seed=5)
+        try:
+            sh = _RemoteShard(srv.endpoint, 0)
+            sh.pull([2, 6])
+            sh.push([2, 6], np.ones((2, 4), np.float32))
+            before = sh.pull([2, 6])
+            p = str(tmp_path / "cpp_shard.bin")
+            sh.save(p)       # over the wire, server-side write
+            sh.close()
+        finally:
+            srv.close()
+        srv2 = CppPSServer(4, optimizer="adam", lr=0.1, seed=5)
+        try:
+            srv2.load(p)     # local (ctypes) restore path
+            sh2 = _RemoteShard(srv2.endpoint, 0)
+            np.testing.assert_array_equal(sh2.pull([2, 6]), before)
+            # adam moments restored: next push matches a never-crashed twin
+            sh2.push([2], np.full((1, 4), 0.2, np.float32))
+            after = sh2.pull([2])
+            sh2.close()
+        finally:
+            srv2.close()
+        twin = CppPSServer(4, optimizer="adam", lr=0.1, seed=5)
+        try:
+            tw = _RemoteShard(twin.endpoint, 0)
+            tw.pull([2, 6])
+            tw.push([2, 6], np.ones((2, 4), np.float32))
+            tw.push([2], np.full((1, 4), 0.2, np.float32))
+            np.testing.assert_allclose(after, tw.pull([2]), rtol=1e-6)
+            tw.close()
+        finally:
+            twin.close()
+
+    def test_async_push_equivalence_after_flush(self):
+        ids = np.arange(24, dtype=np.int64)
+        g = np.random.RandomState(0).randn(24, 4).astype(np.float32)
+        sync = PSClient([SparseTable(4, optimizer="sgd", lr=0.1, seed=s)
+                         for s in range(2)])
+        asy = PSClient([SparseTable(4, optimizer="sgd", lr=0.1, seed=s)
+                        for s in range(2)], async_push=True)
+        for c in (sync, asy):
+            c.pull(ids)
+        for i in range(0, 24, 8):
+            sync.push(ids[i:i + 8], g[i:i + 8])
+            asy.push(ids[i:i + 8], g[i:i + 8])
+        asy.flush()
+        np.testing.assert_allclose(asy.pull(ids), sync.pull(ids), rtol=1e-6)

@@ -515,10 +515,10 @@ class TestSpeculativeDecoding:
             eng.lengths, toks, n_tok, active, CFG, eng.page_size)
 
         ks, vs = eng.k_pool, eng.v_pool
-        lens = eng.lengths
+        lens = np.array(eng.lengths)   # engine keeps host np state now
         seq_logits = []
         for g in range(3):
-            lens = lens.at[0].add(1)
+            lens[0] += 1
             ks, vs, _, _, lg = decode_step(
                 eng.params, ks, vs, eng.page_table, lens,
                 jnp.asarray([chunk[g], 0], jnp.int64), active, CFG,
@@ -786,3 +786,84 @@ class TestLogprobs:
         eng.submit(Request("a", [1, 2], max_new_tokens=3))
         done = eng.run()
         assert done[0].logprobs is None
+
+
+class TestTensorParallelServing:
+    """TP-sharded engine (VERDICT r4 item 3): weights under megatron
+    NamedShardings, KV pool sharded over KV heads, paged kernels under
+    shard_map — outputs must match the single-device engine token for
+    token (reference: fleet TP under the predictor, mp_layers.py +
+    block_multi_head_attention_kernel.cu)."""
+
+    PROMPTS = [[3, 7, 2, 9, 11], [5, 1, 4], [8, 8, 2, 6, 7, 1]]
+
+    def _mesh(self, tp):
+        from jax.sharding import Mesh
+        return Mesh(np.asarray(jax.devices()[:tp]).reshape(tp), ("tp",))
+
+    def _run(self, params, mesh, **kw):
+        eng = ServingEngine(params, CFG, max_seqs=3, max_seq_len=64,
+                            page_size=8, use_pallas=False, mesh=mesh, **kw)
+        for i, p in enumerate(self.PROMPTS):
+            eng.submit(Request(f"r{i}", p, max_new_tokens=10))
+        eng.run()
+        return {r.rid: r.output for r in eng.finished}
+
+    def test_tp2_greedy_matches_single_device(self, params):
+        assert self._run(params, self._mesh(2)) == self._run(params, None)
+
+    def test_tp2_int8_cache_matches(self, params):
+        assert self._run(params, self._mesh(2), cache_dtype="int8") == \
+            self._run(params, None, cache_dtype="int8")
+
+    def test_tp2_spec_decode_matches(self, params):
+        mesh = self._mesh(2)
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=128,
+                            page_size=8, use_pallas=False, mesh=mesh,
+                            spec_decode=4)
+        prompt = [3, 9, 4, 3, 9, 4, 3, 9, 4, 3, 9]
+        eng.submit(Request("s", prompt, max_new_tokens=16))
+        eng.run()
+        assert eng.finished[0].output == greedy_reference(params, prompt, 16)
+        assert eng.spec_accepted > 0
+
+    def test_tp2_pallas_interpret_kernels(self, params):
+        # the shard_map-wrapped pallas kernels (interpret mode off-TPU)
+        # agree with the jnp path under the same tp mesh
+        mesh = self._mesh(2)
+        got = self._run(params, mesh)
+        eng = ServingEngine(params, CFG, max_seqs=3, max_seq_len=64,
+                            page_size=8, use_pallas=True, interpret=True,
+                            mesh=mesh)
+        for i, p in enumerate(self.PROMPTS):
+            eng.submit(Request(f"r{i}", p, max_new_tokens=10))
+        eng.run()
+        assert {r.rid: r.output for r in eng.finished} == got
+
+    def test_tp2_offload_preemption(self, params):
+        # page pressure under tp: evict (host-gather sharded pages),
+        # resume (scatter back) — identical outputs to the unsharded,
+        # unpressured engine
+        mesh = self._mesh(2)
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                            page_size=8, num_pages=5, use_pallas=False,
+                            mesh=mesh, preempt_policy="offload")
+        eng.submit(Request("a", [3, 7, 2, 9], max_new_tokens=20))
+        eng.submit(Request("b", [1, 4, 6, 2], max_new_tokens=20))
+        got = {r.rid: r.output for r in eng.run(max_steps=500)}
+        assert eng.preemptions > 0
+        ref = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                            page_size=8, use_pallas=False)
+        ref.submit(Request("a", [3, 7, 2, 9], max_new_tokens=20))
+        ref.submit(Request("b", [1, 4, 6, 2], max_new_tokens=20))
+        assert got == {r.rid: r.output for r in ref.run(max_steps=500)}
+
+    def test_degenerate_gqa_sharding_rejected(self, params):
+        with pytest.raises(ValueError, match="num_key_value_heads"):
+            ServingEngine(params, CFG, max_seqs=2, mesh=self._mesh(4))
+
+    def test_dp_only_mesh_is_single_device(self, params):
+        # a mesh without a tp axis leaves the engine unsharded
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("dp",))
+        assert self._run(params, mesh) == self._run(params, None)

@@ -89,6 +89,11 @@ alive || { echo "CAPTURE_ABORT tunnel dead mid step 5 (int8)"; exit 2; }
 PT_SERVE_SPEC=4 timeout 1800 python bench_models.py serving 2>&1 | tail -2
 alive || { echo "CAPTURE_ABORT tunnel dead after step 5"; exit 2; }
 
+# 5b. serving under load: Poisson arrivals, TTFT/TPOT percentiles,
+#     fp/int8 x spec on/off in one table (VERDICT r5 item 4)
+timeout 2700 python bench_models.py serving_load 2>&1 | tail -2
+alive || { echo "CAPTURE_ABORT tunnel dead after step 5b"; exit 2; }
+
 # 6. remaining per-model benches
 for m in resnet50 bert moe input dlrm; do
   timeout 1800 python bench_models.py "$m" 2>&1 | tail -2
