@@ -522,10 +522,12 @@ def bench_dlrm(on_tpu):
     # shard count demonstrates the path runs, not how the PS fan-out
     # scales); headline = the default count
     sweep = {}
+    dt = loss = nrows = None
     for n in sorted({1, shards, shards * 2}):
-        dt_n, _, _ = run_shards(n)
+        dt_n, loss_n, nrows_n = run_shards(n)
         sweep[str(n)] = round(bs / dt_n, 1)
-    dt, loss, nrows = run_shards(shards)
+        if n == shards:   # the sweep already measured the headline run
+            dt, loss, nrows = dt_n, loss_n, nrows_n
     return {"examples_per_sec": round(bs / dt, 1), "batch": bs,
             "rows_materialized": nrows, "shards": shards,
             "examples_per_sec_by_shards": sweep,

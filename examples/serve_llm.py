@@ -46,15 +46,24 @@ def main():
     ap.add_argument("--new-tokens", type=int, default=32)
     ap.add_argument("--logprobs", action="store_true",
                     help="record per-token raw-model logprobs")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="tensor-parallel degree (0 = single device); "
+                         "shards weights + KV pool over a tp mesh")
     args = ap.parse_args()
 
     cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=8,
                            kv_heads=4, ffn=256, seq=256)
     params = M.init_params(cfg, seed=0)
+    mesh = None
+    if args.tp > 1:
+        import jax
+        from jax.sharding import Mesh
+        mesh = Mesh(np.asarray(jax.devices()[:args.tp]).reshape(args.tp),
+                    ("tp",))
     eng = ServingEngine(
         params, cfg, max_seqs=4, max_seq_len=256, page_size=16,
         cache_dtype="int8" if args.cache == "int8" else None,
-        spec_decode=args.spec, chunked_prefill=args.chunked)
+        spec_decode=args.spec, chunked_prefill=args.chunked, mesh=mesh)
 
     rng = np.random.RandomState(0)
     for i in range(args.requests):

@@ -31,7 +31,9 @@
 #include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <climits>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <mutex>
@@ -193,8 +195,13 @@ struct Table {
   }
 
   bool load(const char* path) {
-    std::ifstream f(path, std::ios::binary);
+    // buffer + validate the WHOLE file before touching live state: a
+    // truncated body must not leave a half-restored table being
+    // served (the Python tier validates before mutating too)
+    std::ifstream f(path, std::ios::binary | std::ios::ate);
     if (!f) return false;
+    const auto fsize = static_cast<uint64_t>(f.tellg());
+    f.seekg(0);
     char magic[6];
     int fdim, fopt;
     int64_t n;
@@ -203,28 +210,38 @@ struct Table {
     f.read(reinterpret_cast<char*>(&fopt), sizeof(fopt));
     f.read(reinterpret_cast<char*>(&n), sizeof(n));
     if (!f || std::memcmp(magic, "PTPS1", 5) != 0 || fdim != dim ||
-        fopt != opt)
+        fopt != opt || n < 0)
       return false;
+    const uint64_t hdr = 6 + sizeof(fdim) + sizeof(fopt) + sizeof(n);
+    uint64_t rec = sizeof(int64_t) + sizeof(float) * dim;  // id + row
+    if (opt == 1) rec += sizeof(float) * dim;              // g2
+    if (opt == 2) rec += 2 * sizeof(float) * dim + sizeof(int64_t);
+    if (fsize != hdr + static_cast<uint64_t>(n) * rec) return false;
+    std::vector<char> buf(static_cast<size_t>(n) * rec);
+    f.read(buf.data(), static_cast<std::streamsize>(buf.size()));
+    if (!f) return false;
     std::lock_guard<std::mutex> lk(mu);
+    const char* p = buf.data();
     for (int64_t i = 0; i < n; ++i) {
       int64_t id;
-      f.read(reinterpret_cast<char*>(&id), sizeof(id));
-      if (!f) return false;
+      std::memcpy(&id, p, sizeof(id));
+      p += sizeof(id);
       size_t s = ensure(id);
-      f.read(reinterpret_cast<char*>(rows.data() + s * dim),
-             sizeof(float) * dim);
-      if (opt == 1)
-        f.read(reinterpret_cast<char*>(g2.data() + s * dim),
-               sizeof(float) * dim);
-      else if (opt == 2) {
-        f.read(reinterpret_cast<char*>(m.data() + s * dim),
-               sizeof(float) * dim);
-        f.read(reinterpret_cast<char*>(v.data() + s * dim),
-               sizeof(float) * dim);
-        f.read(reinterpret_cast<char*>(&steps[s]), sizeof(int64_t));
+      std::memcpy(rows.data() + s * dim, p, sizeof(float) * dim);
+      p += sizeof(float) * dim;
+      if (opt == 1) {
+        std::memcpy(g2.data() + s * dim, p, sizeof(float) * dim);
+        p += sizeof(float) * dim;
+      } else if (opt == 2) {
+        std::memcpy(m.data() + s * dim, p, sizeof(float) * dim);
+        p += sizeof(float) * dim;
+        std::memcpy(v.data() + s * dim, p, sizeof(float) * dim);
+        p += sizeof(float) * dim;
+        std::memcpy(&steps[s], p, sizeof(int64_t));
+        p += sizeof(int64_t);
       }
     }
-    return static_cast<bool>(f);
+    return true;
   }
 };
 
@@ -232,6 +249,11 @@ struct Server {
   Table table;
   int listen_fd = -1;
   int port = 0;
+  // SAVE/LOAD confinement (matches ps_impl.EmbeddingPSServer): any
+  // path on loopback-bound servers, ckpt_root-contained paths
+  // otherwise, rejected when non-loopback with no root configured
+  bool loopback = false;
+  std::string ckpt_root;
   std::atomic<bool> stopping{false};
   std::thread accept_thread;
   // connection threads are DETACHED; we track their fds (to shutdown
@@ -291,6 +313,22 @@ bool send_msg(int fd, uint8_t op, uint16_t table, uint32_t n_ids,
   return true;
 }
 
+bool path_in_root(const std::string& path, const std::string& root) {
+  // realpath-resolve the candidate's DIRECTORY (the file itself may
+  // not exist yet for SAVE) so a symlink under the root can't escape
+  // it — matches the Python tier's os.path.realpath confinement
+  auto slash = path.find_last_of('/');
+  if (slash == std::string::npos || slash == 0) return false;
+  std::string dir = path.substr(0, slash);
+  std::string base = path.substr(slash + 1);
+  if (base.empty() || base == "." || base == "..") return false;
+  char resolved[PATH_MAX];
+  if (!::realpath(dir.c_str(), resolved)) return false;
+  std::string rdir(resolved);
+  return rdir == root ||
+         rdir.compare(0, root.size() + 1, root + "/") == 0;
+}
+
 void handle_conn(Server* srv, int fd) {
   for (;;) {
     Header h;
@@ -321,6 +359,12 @@ void handle_conn(Server* srv, int fd) {
       break;
     if (h.op == OP_SAVE || h.op == OP_LOAD) {
       std::string path(body.data(), blen);
+      if (!srv->ckpt_root.empty()) {
+        if (!path_in_root(path, srv->ckpt_root))
+          break;  // outside the configured checkpoint root
+      } else if (!srv->loopback) {
+        break;    // network-reachable server with no root: refuse
+      }
       bool ok = h.op == OP_SAVE ? t.save(path.c_str())
                                 : t.load(path.c_str());
       if (!ok) break;  // client reads the drop as the failure signal
@@ -412,6 +456,9 @@ int ptps_serve(void* handle, const char* host, int port) {
   ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
   srv->listen_fd = fd;
   srv->port = ntohs(addr.sin_port);
+  srv->loopback =
+      host && (std::strncmp(host, "127.", 4) == 0 ||
+               std::strcmp(host, "localhost") == 0);
   srv->accept_thread = std::thread([srv] {
     while (!srv->stopping.load()) {
       int cfd = ::accept(srv->listen_fd, nullptr, nullptr);
@@ -425,6 +472,10 @@ int ptps_serve(void* handle, const char* host, int port) {
     }
   });
   return srv->port;
+}
+
+void ptps_set_ckpt_root(void* handle, const char* dir) {
+  static_cast<Server*>(handle)->ckpt_root = dir ? dir : "";
 }
 
 int ptps_save(void* handle, const char* path) {

@@ -74,12 +74,15 @@ class SparseTable:
     Feature-entry accessor (reference: CtrAccessor config in
     the_one_ps.proto / ps/utils/ps_program_builder.py): with
     entry_threshold > 0, a row's embedding only participates after its
-    feature has been SEEN that many times — pulls below threshold
-    return zeros and pushes only count the show, so one-off junk
-    features never materialize trainable state. show_decay_rate < 1
-    ages show counts via decay_shows() (call once per pass/epoch);
-    shrink() then drops rows whose decayed count fell below threshold
-    — the reference's table shrink for bounding rec-sys table growth.
+    feature has been SEEN that many times. Each PULL counts one show
+    per occurrence (a pull = the feature appeared in a batch — the
+    analogue of the reference's pushed show signal); below-threshold
+    pulls return zeros and below-threshold pushes drop their gradient,
+    so one-off junk features never materialize trainable state.
+    show_decay_rate < 1 ages show counts via decay_shows() (call once
+    per pass/epoch); shrink() then drops rows whose decayed count fell
+    below threshold — the reference's table shrink for bounding
+    rec-sys table growth.
     """
 
     GROW = 1024
@@ -147,9 +150,11 @@ class SparseTable:
         zeros (embedding not yet created, reference CtrAccessor entry
         semantics)."""
         ids = np.asarray(ids, np.int64)
+        accessor_on = self.entry_threshold > 0 or self.show_decay_rate < 1.0
         with self._lock:
             idx = self._ensure(ids.tolist())
-            np.add.at(self._shows, idx, 1.0)
+            if accessor_on:   # np.add.at is slow; skip when feature off
+                np.add.at(self._shows, idx, 1.0)
             out = self._rows[idx].copy()
             if self.entry_threshold > 0:
                 out[self._shows[idx] < self.entry_threshold] = 0.0
@@ -327,7 +332,7 @@ def _send_raw(sock, op, table, data: bytes):
 _MAX_BODY = 1 << 30
 
 
-def _recv_msg(sock):
+def _recv_msg(sock, server_side=False):
     op, table, n, dim = _HDR.unpack(_recv_exact(sock, _HDR.size))
     (blen,) = struct.unpack("<I", _recv_exact(sock, 4))
     # strict validation mirroring ptps.cpp's handle_conn: a malformed
@@ -355,6 +360,12 @@ def _recv_msg(sock):
         raise ConnectionError(
             f"ps wire: push payload {pay_bytes}B != {n} x dim={dim} "
             "float32 rows")
+    if server_side and op in (_OP_PULL, _OP_LEN, _OP_STOP) and pay_bytes:
+        # request frames for these ops carry no payload (the C++ tier
+        # enforces blen == ids_bytes); the flag exists because CLIENT
+        # sides of the same ops DO see payloads in responses
+        raise ConnectionError(
+            f"ps wire: op {op} request with {pay_bytes}B payload")
     body = _recv_exact(sock, blen)
     ids = np.frombuffer(body[:8 * n], np.int64)
     pay = np.frombuffer(body[8 * n:], np.float32)
@@ -369,7 +380,7 @@ class _PSHandler(socketserver.BaseRequestHandler):
         sock = self.request
         try:
             while True:
-                op, table, ids, pay = _recv_msg(sock)
+                op, table, ids, pay = _recv_msg(sock, server_side=True)
                 if op == _OP_PULL:
                     rows = server.tables[table].pull(ids)
                     _send_msg(sock, _OP_PULL, table, payload=rows)
@@ -381,10 +392,10 @@ class _PSHandler(socketserver.BaseRequestHandler):
                     _send_msg(sock, _OP_LEN, table,
                               ids=np.asarray([n], np.int64))
                 elif op == _OP_SAVE:
-                    server.tables[table].save(ids.decode())
+                    server.tables[table].save(server.wire_ckpt_path(ids))
                     _send_msg(sock, _OP_SAVE, table)
                 elif op == _OP_LOAD:
-                    server.tables[table].load(ids.decode())
+                    server.tables[table].load(server.wire_ckpt_path(ids))
                     _send_msg(sock, _OP_LOAD, table)
                 elif op == _OP_STOP:
                     _send_msg(sock, _OP_STOP, table)
@@ -408,8 +419,16 @@ class EmbeddingPSServer:
     serves PULL/PUSH over TCP (threaded; SparseTable locks make
     concurrent worker pushes the reference's async-SGD)."""
 
-    def __init__(self, tables, host="127.0.0.1", port=0):
+    def __init__(self, tables, host="127.0.0.1", port=0, ckpt_dir=None):
         self.tables = list(tables)
+        # wire SAVE/LOAD write/read server-side files; confine them —
+        # the unauthenticated protocol must not hand network peers an
+        # arbitrary-file-write primitive. Loopback-bound servers accept
+        # any path (only local processes can reach them); non-loopback
+        # servers require ckpt_dir (PT_PS_CKPT_DIR via init_server) and
+        # reject paths outside it.
+        self._loopback = str(host).startswith("127.") or host == "localhost"
+        self._ckpt_dir = os.path.realpath(ckpt_dir) if ckpt_dir else None
         srv = socketserver.ThreadingTCPServer((host, port), _PSHandler,
                                               bind_and_activate=False)
         srv.daemon_threads = True
@@ -420,6 +439,23 @@ class EmbeddingPSServer:
         srv.shutdown_requested = False
         self._srv = srv
         self.endpoint = "%s:%d" % srv.server_address
+
+    def wire_ckpt_path(self, raw: bytes):
+        """Validate a SAVE/LOAD path from the wire; raises
+        ConnectionError (handler drops the connection) when the path is
+        not permitted under this server's confinement rule."""
+        path = raw.decode()
+        if self._ckpt_dir is not None:
+            real = os.path.realpath(path)
+            if not real.startswith(self._ckpt_dir + os.sep):
+                raise ConnectionError(
+                    f"ps wire: ckpt path {path!r} outside ckpt_dir")
+            return real
+        if not self._loopback:
+            raise ConnectionError(
+                "ps wire: SAVE/LOAD needs ckpt_dir on a non-loopback "
+                "server (set PT_PS_CKPT_DIR)")
+        return path
 
     def serve_forever(self):
         self._srv.serve_forever(poll_interval=0.05)
@@ -466,6 +502,8 @@ def _load_ptps():
     lib.ptps_size.argtypes = [ctypes.c_void_p]
     lib.ptps_save.restype = ctypes.c_int
     lib.ptps_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ptps_set_ckpt_root.restype = None
+    lib.ptps_set_ckpt_root.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ptps_load.restype = ctypes.c_int
     lib.ptps_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.ptps_stopping.restype = ctypes.c_int
@@ -489,7 +527,7 @@ class CppPSServer:
 
     def __init__(self, dim, optimizer="adagrad", lr=0.01, seed=0,
                  init_scale=0.01, beta1=0.9, beta2=0.999, eps=1e-8,
-                 port=0, host="127.0.0.1"):
+                 port=0, host="127.0.0.1", ckpt_dir=None):
         if optimizer not in _CPP_OPT:
             raise ValueError(f"unknown sparse optimizer: {optimizer!r}")
         lib = _load_ptps()
@@ -509,6 +547,9 @@ class CppPSServer:
             lib.ptps_destroy(self._h)
             self._h = None
             raise OSError("libptps: could not bind a listening socket")
+        if ckpt_dir:
+            lib.ptps_set_ckpt_root(
+                self._h, os.path.realpath(ckpt_dir).encode())
         self.endpoint = f"{host or '127.0.0.1'}:{bound}"
 
     def _handle(self):
@@ -676,10 +717,13 @@ class PSClient:
         if self._async:
             while len(self._inflight) >= self._max_inflight:
                 self._inflight.pop(0).result()
+            # slice AND copy now: the worker thread must not read the
+            # caller's arrays later — a trainer reusing a preallocated
+            # grad buffer would otherwise push the NEXT step's values
             self._inflight.extend(
                 self._pool.submit(
-                    lambda sh, sel: sh.push(ids[sel], grads[sel]),
-                    self.shards[s], *a)
+                    lambda sh, i, g: sh.push(i, g),
+                    self.shards[s], ids[a[0]].copy(), grads[a[0]].copy())
                 for s, a in per_shard)
             return
         self._fanout(lambda sh, sel: sh.push(ids[sel], grads[sel]),
@@ -816,9 +860,11 @@ def init_server(tables=None, port=None, host=None, backend=None):
         srv = CppPSServer(t.dim, optimizer=t.optimizer, lr=t.lr,
                           seed=t.seed, init_scale=t.init_scale,
                           beta1=t.beta1, beta2=t.beta2, eps=t.eps,
-                          port=port, host=host or "127.0.0.1")
+                          port=port, host=host or "127.0.0.1",
+                          ckpt_dir=os.environ.get("PT_PS_CKPT_DIR"))
     elif backend == "python":
-        srv = EmbeddingPSServer(tabs, host=host or "127.0.0.1", port=port)
+        srv = EmbeddingPSServer(tabs, host=host or "127.0.0.1", port=port,
+                                ckpt_dir=os.environ.get("PT_PS_CKPT_DIR"))
     else:
         raise ValueError(f"unknown PS backend {backend!r}: "
                          "use 'python' or 'cpp'")

@@ -523,3 +523,86 @@ class TestAccessorAndCheckpoint:
             asy.push(ids[i:i + 8], g[i:i + 8])
         asy.flush()
         np.testing.assert_allclose(asy.pull(ids), sync.pull(ids), rtol=1e-6)
+
+
+class TestWireHardening:
+    """Protocol-error paths: malformed frames and mismatched
+    checkpoints must drop cleanly, never corrupt or kill the server."""
+
+    def test_oversize_and_short_push_frames_dropped(self):
+        import socket as _socket
+        import struct as _struct
+        from paddle_tpu.distributed.ps_impl import _HDR
+        srv = EmbeddingPSServer([SparseTable(4)], host="127.0.0.1", port=0)
+        srv.serve_in_thread()
+        try:
+            host, port = srv.endpoint.rsplit(":", 1)
+            # 4 GiB length field: connection dropped before allocation
+            s = _socket.create_connection((host, int(port)))
+            s.sendall(_HDR.pack(1, 0, 2, 0) + _struct.pack("<I", 0xFFFFFFFF))
+            assert s.recv(1) == b""     # server closed on us
+            s.close()
+            # push with fewer grad rows than ids: dropped, no broadcast
+            s2 = _socket.create_connection((host, int(port)))
+            body = np.asarray([5, 9], np.int64).tobytes() \
+                + np.ones((1, 4), np.float32).tobytes()
+            s2.sendall(_HDR.pack(2, 0, 2, 4)
+                       + _struct.pack("<I", len(body)) + body)
+            assert s2.recv(1) == b""
+            s2.close()
+            # server alive and table untouched by either frame
+            sh = _RemoteShard(srv.endpoint, 0)
+            assert len(sh) == 0
+            sh.close()
+        finally:
+            srv.close()
+
+    def test_dim_mismatched_push_drops_connection(self):
+        srv = EmbeddingPSServer([SparseTable(4)], host="127.0.0.1", port=0)
+        srv.serve_in_thread()
+        try:
+            sh = _RemoteShard(srv.endpoint, 0)
+            with pytest.raises((ConnectionError, OSError)):
+                sh.push([5], np.ones((1, 2), np.float32))  # dim 2 != 4
+            sh.close()
+            sh2 = _RemoteShard(srv.endpoint, 0)     # server still serving
+            assert sh2.pull([5]).shape == (1, 4)
+            sh2.close()
+        finally:
+            srv.close()
+
+    def test_mismatched_checkpoint_rejected_before_mutation(self, tmp_path):
+        t4 = SparseTable(4, optimizer="sgd")
+        t4.pull([1])
+        p = str(tmp_path / "t4.npz")
+        t4.save(p)
+        t8 = SparseTable(8, optimizer="sgd")
+        with pytest.raises(ValueError, match="dim"):
+            t8.load(p)
+        assert len(t8) == 0      # nothing materialized
+        t_ag = SparseTable(4, optimizer="adagrad")
+        with pytest.raises(ValueError, match="optimizer"):
+            t_ag.load(p)        # sgd ckpt lacks g2 state
+        assert len(t_ag) == 0
+
+    def test_wire_ckpt_confined_to_ckpt_dir(self, tmp_path):
+        """With ckpt_dir set, wire SAVE/LOAD outside it is rejected
+        (the unauthenticated protocol must not be an arbitrary-file
+        write primitive); inside it works."""
+        srv = EmbeddingPSServer([SparseTable(4)], host="127.0.0.1",
+                                port=0, ckpt_dir=str(tmp_path))
+        srv.serve_in_thread()
+        try:
+            sh = _RemoteShard(srv.endpoint, 0)
+            sh.pull([3])
+            with pytest.raises((ConnectionError, OSError)):
+                sh.save("/tmp/outside_ckpt_dir.npz")
+            sh.close()
+            sh2 = _RemoteShard(srv.endpoint, 0)
+            inside = str(tmp_path / "ok.npz")
+            sh2.save(inside)
+            assert os.path.exists(inside)
+            sh2.close()
+        finally:
+            srv.close()
+        assert not os.path.exists("/tmp/outside_ckpt_dir.npz")
