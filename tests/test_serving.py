@@ -867,3 +867,19 @@ class TestTensorParallelServing:
         from jax.sharding import Mesh
         mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("dp",))
         assert self._run(params, mesh) == self._run(params, None)
+
+    def test_tp2_chunked_spec_int8_composition(self, params):
+        # the deepest feature stack in one engine: chunked prefill
+        # riding the spec verify chunk, int8 KV pool, all tp-sharded —
+        # still token-exact vs the single-device engine
+        prompt = list(np.random.RandomState(3).randint(1, 64, 21))
+        outs = []
+        for m in (None, self._mesh(2)):
+            eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                                page_size=8, use_pallas=False, mesh=m,
+                                spec_decode=4, chunked_prefill=True,
+                                cache_dtype="int8")
+            eng.submit(Request("c", prompt, max_new_tokens=10))
+            eng.run()
+            outs.append(eng.finished[0].output)
+        assert outs[0] == outs[1], outs
