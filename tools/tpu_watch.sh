@@ -59,7 +59,11 @@ probe() {
   # list fine, remote_compile refusing — observed 2026-07-31) must read
   # as DOWN here, so capture never launches into a window where every
   # compile burns ~1800s. Disk cache disabled so a hit can't mask it.
-  env -u JAX_COMPILATION_CACHE_DIR timeout 300 python -c "
+  # 180s: a live tunnel answers device init + the tiny uncached canary
+  # compile in well under 2 min; a dead one hangs to whatever timeout we
+  # give it, and that timeout plus the sleep below is the window-
+  # discovery latency (9 min/cycle was losing half an 18-min window)
+  env -u JAX_COMPILATION_CACHE_DIR timeout 180 python -c "
 import jax, jax.numpy as jnp
 assert jax.devices()[0].platform == 'tpu'
 x = jnp.ones((2, 1024), jnp.int32)
@@ -90,7 +94,7 @@ for i in $(seq 1 220); do
     state false
     echo "probe $i: tunnel down ($(date -u +%FT%TZ))" >>"$LOG"
   fi
-  sleep 230
+  sleep 90
 done
 echo "watch window exhausted ($(date -u +%FT%TZ))" | tee -a "$LOG"
 exit 1
