@@ -65,10 +65,12 @@ def test_full_search_finds_planted_peak(tmp_path):
 def test_dedup_skips_equivalent_configs(tmp_path):
     r, data = run_tuner(tmp_path)
     assert r.returncode == 0
-    # stage A: 12 trials (promise-ordered batch x remat x fused_ce list);
-    # stage B: 5 configs but (128,128) == the stage-A winner's
-    # effective knobs -> 4 measured; stage C: 2.
-    assert data["n_trials"] == 18
+    # stage A: 14 trials (promise-ordered batch x remat x fused_ce
+    # list, incl. the dots+n_micro=2 large-batch corners); stage B: 5
+    # configs but (128,128) == the stage-A winner's effective knobs ->
+    # 4 measured; stage C: n_micro=2 dedups against the stage-A peak
+    # (which carries n_micro=2 itself now) -> 1 measured.
+    assert data["n_trials"] == 19
     cfgs = [json.dumps(t["cfg"], sort_keys=True) for t in data["trials"]]
     assert len(set(cfgs)) == len(cfgs), "a config was measured twice"
 
@@ -76,7 +78,7 @@ def test_dedup_skips_equivalent_configs(tmp_path):
 def test_cpu_fallback_trips_dead_tunnel_breaker(tmp_path):
     # every child answers backend:"cpu" -> tunnel-death-shaped failures
     # -> the circuit breaker must abort the search after DEAD_TRIP (3)
-    # consecutive trials instead of burning TRIAL_TIMEOUT on all 12,
+    # consecutive trials instead of burning TRIAL_TIMEOUT on all 14,
     # with a non-zero exit and no winner written
     r, data = run_tuner(tmp_path, fault="cpu")
     assert r.returncode != 0
@@ -295,13 +297,14 @@ def test_staged_split_a_then_bc(tmp_path):
     assert (best["block_q"], best["block_k"]) == (256, 512)
     assert best["n_micro"] == 2
     assert best["tok_s"] == 15850.0
-    # stage A's 12-trial record is carried over (marked prior, so the
+    # stage A's 14-trial record is carried over (marked prior, so the
     # OOM/fail evidence survives the staged split) and was NOT re-run:
-    # only the winner was re-measured, + 4 stage-B + 2 stage-C trials
+    # only the winner was re-measured, + 4 stage-B + 1 stage-C trials
+    # (n_micro=2 dedups against the carried stage-A peak)
     prior = [t for t in data["trials"] if t.get("prior")]
     live = [t for t in data["trials"] if not t.get("prior")]
-    assert len(prior) == 12 and len(live) == 7
-    assert data["n_trials"] == 19
+    assert len(prior) == 14 and len(live) == 6
+    assert data["n_trials"] == 20
 
 
 def test_staged_bc_without_prior_a_refuses(tmp_path):

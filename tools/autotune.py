@@ -503,6 +503,14 @@ def main():
                 {"batch": 8, "remat": "dots", "fused_ce": False},
                 {"batch": 24, "remat": "dots", "fused_ce": True},
                 {"batch": 32, "remat": "dots", "fused_ce": True},
+                # grad accumulation halves peak activation memory, so
+                # dots may FIT at batches where the plain dots trials
+                # above OOM — stage C only refines the winner, so this
+                # corner is never reached unless tried here
+                {"batch": 24, "remat": "dots", "fused_ce": True,
+                 "n_micro": 2},
+                {"batch": 32, "remat": "dots", "fused_ce": True,
+                 "n_micro": 2},
                 {"batch": 8, "remat": "false", "fused_ce": False},
             ]
             for cfg in stage_a:

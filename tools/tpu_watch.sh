@@ -79,7 +79,13 @@ state() {
     && mv .tpu_state.json.tmp .tpu_state.json
 }
 
-for i in $(seq 1 220); do
+# wall-clock bound, not iteration count: a fail-fast down-probe
+# (connection refused) makes cycles ~100s while a hanging one takes
+# ~270s — an iteration budget would cut the watch's lifetime 3x
+# depending on HOW the tunnel is down
+i=0
+while [ $(($(date +%s) - START_TS)) -lt $((16 * 3600)) ]; do
+  i=$((i + 1))
   if probe; then
     state true
     echo "TPU alive at probe $i ($(date -u +%FT%TZ))" | tee -a "$LOG"
