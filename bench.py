@@ -21,6 +21,19 @@ PEAK_FLOPS = {
 }
 
 
+def _data_rng():
+    """Per-process random data seed (PT_BENCH_DATA_SEED pins it): the
+    axon serving terminal memoizes (executable, inputs) → output across
+    processes, so fixed-seed reruns of an already-benched config return
+    cached results without executing (observed 2026-08-01: impossible
+    'MFU 2.43' / step_time 0.21s on a config that honestly measures
+    1.38s). Fresh data defeats the memo while params stay seed-pinned
+    for comparability. Shared by bench.py and bench_models.py."""
+    s = os.environ.get("PT_BENCH_DATA_SEED")
+    seed = int(s) if s is not None else int.from_bytes(os.urandom(4), "little")
+    return np.random.RandomState(seed)
+
+
 def _tpu_alive():
     """Probe device init in a child so a wedged TPU tunnel can't hang the
     bench. Retries with growing timeouts and logs the child's stderr —
@@ -152,6 +165,11 @@ def _tpu_history():
                 if e.get("extra", {}).get("backend") in (None, "cpu") \
                         or "batch" not in e or "seq" not in e:
                     continue
+                if e.get("extra", {}).get("invalid"):
+                    # annotated-after-the-fact bogus measurement (e.g.
+                    # the 2026-08-01 terminal-memoization phantoms) —
+                    # never serve as last or best
+                    continue
                 last = _pick(e)
                 # pre-r3 entries recorded LEGACY mfu under the "mfu"
                 # key (no mfu_legacy field) — comparing that against
@@ -258,7 +276,7 @@ def main():
     step = M.make_train_step(cfg, mesh, n_micro=n_micro, remat=remat, lr=3e-4,
                              fused_ce=fused_ce)
 
-    rng = np.random.RandomState(0)
+    rng = _data_rng()  # random data per process: see _data_rng docstring
     x = rng.randint(0, cfg.vocab_size, (batch, seq))
     y = rng.randint(0, cfg.vocab_size, (batch, seq))
     # PT_BENCH_DOCS=N: packed-document pretrain — N equal documents per

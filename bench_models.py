@@ -17,7 +17,7 @@ import time
 
 import numpy as np
 
-from bench import PEAK_FLOPS, _tpu_alive
+from bench import PEAK_FLOPS, _data_rng, _tpu_alive
 
 
 def _mesh1():
@@ -56,7 +56,7 @@ def bench_resnet50(on_tpu):
         return ce(logits.astype("float32"), y)
 
     tr = Trainer(model, opt, loss_fn, mesh=_mesh1())
-    rng = np.random.RandomState(0)
+    rng = _data_rng()
     x = rng.randn(bs, 3, size, size).astype(
         np.float32 if not on_tpu else jnp.bfloat16)
     y = rng.randint(0, 1000, (bs,))
@@ -92,7 +92,7 @@ def bench_bert(on_tpu):
         return ce(logits.astype("float32"), y)
 
     tr = Trainer(model, opt, loss_fn, mesh=_mesh1())
-    rng = np.random.RandomState(0)
+    rng = _data_rng()
     ids = rng.randint(0, cfg.vocab_size, (bs, seq))
     y = rng.randint(0, 2, (bs,))
     dt, loss = _time_steps(tr, (ids, y), iters)
@@ -140,7 +140,7 @@ def bench_moe(on_tpu):
         return -picked.mean()
 
     tr = Trainer(model, opt, loss_fn, mesh=_mesh1())
-    rng = np.random.RandomState(0)
+    rng = _data_rng()
     ids = rng.randint(0, cfg.vocab_size, (bs, seq))
     dt, loss = _time_steps(tr, (ids, ids), iters)
     return {"tokens_per_sec": round(bs * seq / dt, 1), "batch": bs,
@@ -181,7 +181,7 @@ def bench_serving(on_tpu):
     # verify chunks (greedy-exact; see llama_serving.verify_step)
     spec = int(os.environ.get("PT_SERVE_SPEC", "0") or 0)
 
-    rng = np.random.RandomState(0)
+    rng = _data_rng()
     if spec > 1:
         # speculative decoding exists for workloads with n-gram
         # repetition (code, templated text, retrieval contexts);
@@ -300,7 +300,7 @@ def bench_serving_load(on_tpu):
         rate = 40.0
     params = M.init_params(cfg, seed=0, dtype=dtype)
 
-    rng = np.random.RandomState(0)
+    rng = _data_rng()
     arrivals = np.cumsum(rng.exponential(1.0 / rate, nreq))
     reqs = []
     for i in range(nreq):
@@ -432,7 +432,7 @@ def bench_input(on_tpu):
     bs, size, iters, n_img = (64, 224, 5, 512) if on_tpu else (8, 64, 2, 64)
     root = tempfile.mkdtemp(prefix="pt_jpeg_bench_")
     try:
-        rng = np.random.RandomState(0)
+        rng = _data_rng()
         for cls in range(4):
             cdir = os.path.join(root, f"class{cls}")
             os.makedirs(cdir)
@@ -498,7 +498,7 @@ def bench_dlrm(on_tpu):
         cfg = DLRMConfig(emb_dim=8, n_sparse=4, dense_dim=5, bottom=(16,),
                          top=(16,))
         bs, iters, vocab, shards = 128, 3, 1000, 2
-    rng = np.random.RandomState(0)
+    rng = _data_rng()
 
     def batch():
         ids = rng.randint(0, vocab, (bs, cfg.n_sparse)).astype(np.int64)

@@ -443,8 +443,13 @@ def _mk_kernel(fn, have_sri, **kw):
 
 def _seed_spec():
     if _HAS_PLTPU:
-        return pl.BlockSpec(memory_space=pltpu.SMEM)
-    return pl.BlockSpec((1,), lambda *_: (0,))  # pragma: no cover
+        # explicit index map: a memory_space-only BlockSpec gets a
+        # pallas-default map whose 0 constant is i64 under x64 — Mosaic
+        # rejects the transform func returning i64 (chip-observed:
+        # "func.return (i64)" legalization failure, TPU_VALIDATION r5)
+        return pl.BlockSpec((1,), lambda *_: (Z,),
+                            memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1,), lambda *_: (Z,))  # pragma: no cover
 
 
 def _seed_arr(seed):

@@ -310,7 +310,12 @@ def _verify_kernel(ptab_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                                 preferred_element_type=jnp.float32) * scale
         cols = pi * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        g_row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % n_tok
+        # np.int32 divisor, NOT the bare python int: `% n_tok` binds the
+        # int as a strong i64 const under x64, and Mosaic's int64->int32
+        # convert recurses forever (chip-observed RecursionError,
+        # TPU_VALIDATION r5).
+        g_row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) \
+            % np.int32(n_tok)
         s = jnp.where(cols < base + g_row + 1, s, NEG_INF)
         m_prev = m_ref[:]
         l_prev = l_ref[:]

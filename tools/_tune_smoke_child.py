@@ -49,12 +49,16 @@ def main():
                           "vs_baseline": 0.0, "extra": extra}))
         return
 
-    # Deterministic landscape, peaked at batch=24, remat=dots,
-    # fused_ce=True, (block_q, block_k)=(256, 512), n_micro=2.  Tests
-    # assert the staged search lands exactly there.
+    # Deterministic landscape, peaked at batch=64, remat=true,
+    # fused_ce=True, n_micro=2, (block_q, block_k)=(256, 512) — the
+    # shape the first honest on-chip stage-A pass suggested (2026-08-01:
+    # full-remat MFU climbs with batch, dots disappoints, the grad-accum
+    # corner wins at the HBM wall).  Tests assert the staged search
+    # lands exactly there.
     v = 10_000.0
-    v += {16: 500, 24: 2000, 32: 1200, 8: 100}.get(batch, 0)
-    v += {"dots": 1500, "true": 800, "false": 400}.get(remat, 0)
+    v += {8: 100, 16: 500, 24: 1400, 32: 1500, 40: 1700,
+          48: 2000, 64: 2200}.get(batch, 0)
+    v += {"true": 800, "dots": 600, "false": 400}.get(remat, 0)
     v += 1200 if fce else 0
     v += {(128, 128): 0, (256, 256): 600, (256, 512): 900,
           (512, 256): 300, (512, 512): 500}.get((bq, bk), 0)

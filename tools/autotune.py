@@ -491,27 +491,36 @@ def main():
     try:
         if "A" in stages:
             print("stage A: batch x remat x fused_ce", flush=True)
+            # 2026-08-01 on-chip evidence (first honest stage-A pass):
+            # full-remat MFU CLIMBS with batch — 16→0.33, 24→0.43,
+            # 32→0.60 strict — while dots at batch 8 disappointed
+            # (0.22). So the big-batch full-remat ladder leads, pushed
+            # to the OOM wall (48/64), with dots as the secondary
+            # branch. fused_ce avoids the (B,S,V) logits
+            # materialization, so it both speeds the head and frees
+            # HBM that may admit configs the plain head OOMs on.
             stage_a = [
-                {"batch": 16, "remat": "true", "fused_ce": True},  # warm
-                {"batch": 8, "remat": "dots", "fused_ce": True},   # predicted
-                {"batch": 16, "remat": "dots", "fused_ce": True},
-                {"batch": 12, "remat": "dots", "fused_ce": True},
-                {"batch": 8, "remat": "false", "fused_ce": True},
+                {"batch": 32, "remat": "true", "fused_ce": True},  # leader
+                {"batch": 48, "remat": "true", "fused_ce": True},
+                {"batch": 64, "remat": "true", "fused_ce": True},
+                {"batch": 32, "remat": "true", "fused_ce": False},
                 {"batch": 24, "remat": "true", "fused_ce": True},
-                {"batch": 32, "remat": "true", "fused_ce": True},
-                {"batch": 16, "remat": "true", "fused_ce": False},
-                {"batch": 8, "remat": "dots", "fused_ce": False},
-                {"batch": 24, "remat": "dots", "fused_ce": True},
+                {"batch": 40, "remat": "true", "fused_ce": True},
+                {"batch": 16, "remat": "true", "fused_ce": True},
                 {"batch": 32, "remat": "dots", "fused_ce": True},
+                {"batch": 48, "remat": "dots", "fused_ce": True},
+                {"batch": 16, "remat": "dots", "fused_ce": True},
+                {"batch": 8, "remat": "dots", "fused_ce": True},
+                {"batch": 16, "remat": "true", "fused_ce": False},
                 # grad accumulation halves peak activation memory, so
-                # dots may FIT at batches where the plain dots trials
-                # above OOM — stage C only refines the winner, so this
-                # corner is never reached unless tried here
-                {"batch": 24, "remat": "dots", "fused_ce": True,
+                # big-batch configs that OOM above may fit split into
+                # microbatches — stage C only refines the winner, so
+                # this corner is never reached unless tried here
+                {"batch": 64, "remat": "true", "fused_ce": True,
                  "n_micro": 2},
-                {"batch": 32, "remat": "dots", "fused_ce": True,
+                {"batch": 48, "remat": "dots", "fused_ce": True,
                  "n_micro": 2},
-                {"batch": 8, "remat": "false", "fused_ce": False},
+                {"batch": 8, "remat": "false", "fused_ce": True},
             ]
             for cfg in stage_a:
                 consider(dict(cfg, seq=seq))

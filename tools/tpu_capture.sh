@@ -26,11 +26,15 @@ alive() {
   # a tiny compile + execute, with the persistent disk cache DISABLED
   # for the probe process so a cache hit can never mask a dead compile
   # service.
+  # random canary VALUE: the terminal memoizes (executable, inputs) →
+  # output, so a constant canary could read alive from cache while the
+  # execute service is dead
   env -u JAX_COMPILATION_CACHE_DIR timeout 300 python -c "
-import jax, jax.numpy as jnp
+import random, jax, jax.numpy as jnp
 assert jax.devices()[0].platform == 'tpu'
-x = jnp.ones((2, 1024), jnp.int32)
-assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096
+n = random.randrange(1, 100000)
+x = jnp.full((2, 1024), n, jnp.int32)
+assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096 * n
 " 2>/dev/null
 }
 alive || { echo "CAPTURE_ABORT tunnel half-alive (compile canary failed)"; exit 2; }

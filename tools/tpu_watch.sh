@@ -63,11 +63,15 @@ probe() {
   # compile in well under 2 min; a dead one hangs to whatever timeout we
   # give it, and that timeout plus the sleep below is the window-
   # discovery latency (9 min/cycle was losing half an 18-min window)
+  # random canary VALUE: the serving terminal memoizes (executable,
+  # inputs) → output, so a constant canary could read as alive from
+  # cache while the execute service is dead
   env -u JAX_COMPILATION_CACHE_DIR timeout 180 python -c "
-import jax, jax.numpy as jnp
+import random, jax, jax.numpy as jnp
 assert jax.devices()[0].platform == 'tpu'
-x = jnp.ones((2, 1024), jnp.int32)
-assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096
+n = random.randrange(1, 100000)
+x = jnp.full((2, 1024), n, jnp.int32)
+assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096 * n
 " 2>>"$LOG"
 }
 
