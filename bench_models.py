@@ -208,8 +208,9 @@ def bench_serving(on_tpu):
             # spends too much of the budget in the pre-loop warm-in
             # where prompt-lookup drafts diverge from the model; the
             # loop regime that pays for drafting needs the longer run,
-            # exactly as the CPU branch above found at 256.
-            new_tok = max(new_tok, 64 * spec, 256)
+            # exactly as the CPU branch above found at 256. Capped so
+            # prompt (<64 tokens) + generation always fits the pool.
+            new_tok = min(max(new_tok, 256), max_seq_len - 64)
         prompts = []
         for _ in range(nreq):
             motif = list(map(int, rng.randint(1, cfg.vocab_size, 3)))

@@ -39,6 +39,32 @@ def _entries():
     return out
 
 
+def test_tpu_history_skips_invalid_entries(tmp_path, monkeypatch):
+    """bench.py._tpu_history must never surface an extra.invalid entry
+    (the 2026-08-01 terminal-memoization phantoms) as last OR best."""
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(_ROOT, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    rows = [
+        {"metric": "m", "value": 100.0, "unit": "t", "vs_baseline": 0.1,
+         "batch": 16, "seq": 2048,
+         "extra": {"backend": "tpu", "mfu": 0.30, "mfu_legacy": 0.33}},
+        {"metric": "m", "value": 9999.0, "unit": "t", "vs_baseline": 9.0,
+         "batch": 16, "seq": 2048,
+         "extra": {"backend": "tpu", "mfu": 2.4, "mfu_legacy": 2.7,
+                   "invalid": "terminal-memoization"}},
+    ]
+    hist.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    # point the module at tmp_path via its __file__ (patching
+    # os.path.dirname would hijack the shared posixpath module)
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    last, best = bench._tpu_history()
+    assert last["value"] == 100.0, "invalid entry served as last"
+    assert best["value"] == 100.0, "invalid entry served as best"
+
+
 def test_no_tpu_throughput_regression():
     tpu = [e for e in _entries()
            if e.get("extra", {}).get("backend") not in (None, "cpu")
@@ -61,11 +87,18 @@ def test_no_tpu_throughput_regression():
     # executed a different program — keep it out of normal groups.
     by_cfg = {}
     for e in tpu:
+        x = e.get("extra", {})
         by_cfg.setdefault((e.get("model", "llama"), e.get("batch"),
                            e.get("seq"), e.get("remat", "True"),
                            e.get("docs"), bool(e.get("fused_ce")))
                           + _TD.effective_knobs(e)
-                          + (bool(e.get("extra", {}).get("pallas_fallback")),),
+                          # serving entries: workload regime joins the
+                          # key (r5 raised spec new_tokens 2048→4096
+                          # total; cross-regime steps/s must not
+                          # regression-compare)
+                          + (x.get("cache_dtype"), x.get("spec_decode"),
+                             x.get("new_tokens"), x.get("requests"))
+                          + (bool(x.get("pallas_fallback")),),
                           []).append(e)
     comparable = [v for v in by_cfg.values() if len(v) >= 2]
     if not comparable:
