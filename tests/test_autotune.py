@@ -65,12 +65,12 @@ def test_full_search_finds_planted_peak(tmp_path):
 def test_dedup_skips_equivalent_configs(tmp_path):
     r, data = run_tuner(tmp_path)
     assert r.returncode == 0
-    # stage A: 15 trials (promise-ordered batch x remat x fused_ce
-    # list, incl. the n_micro=2 big-batch corners); stage B: 5
-    # configs but (128,128) == the stage-A winner's effective knobs ->
+    # stage A: every STAGE_A entry measured once; stage B: 5 configs
+    # but (128,128) == the stage-A winner's effective knobs ->
     # 4 measured; stage C: n_micro=2 dedups against the stage-A peak
     # (which carries n_micro=2 itself) -> 1 measured (n_micro=4).
-    assert data["n_trials"] == 20
+    n_stage_a = len(_load_tuner().STAGE_A)
+    assert data["n_trials"] == n_stage_a + 4 + 1
     cfgs = [json.dumps(t["cfg"], sort_keys=True) for t in data["trials"]]
     assert len(set(cfgs)) == len(cfgs), "a config was measured twice"
 
@@ -78,8 +78,8 @@ def test_dedup_skips_equivalent_configs(tmp_path):
 def test_cpu_fallback_trips_dead_tunnel_breaker(tmp_path):
     # every child answers backend:"cpu" -> tunnel-death-shaped failures
     # -> the circuit breaker must abort the search after DEAD_TRIP (3)
-    # consecutive trials instead of burning TRIAL_TIMEOUT on all 15,
-    # with a non-zero exit and no winner written
+    # consecutive trials instead of burning TRIAL_TIMEOUT on the whole
+    # STAGE_A list, with a non-zero exit and no winner written
     r, data = run_tuner(tmp_path, fault="cpu")
     assert r.returncode != 0
     assert "aborting search" in r.stderr and "consecutive" in r.stderr
@@ -300,14 +300,15 @@ def test_staged_split_a_then_bc(tmp_path):
     assert (best["block_q"], best["block_k"]) == (256, 512)
     assert best["n_micro"] == 2
     assert best["tok_s"] == 15350.0
-    # stage A's 15-trial record is carried over (marked prior, so the
+    # stage A's full trial record is carried over (marked prior, so the
     # OOM/fail evidence survives the staged split) and was NOT re-run:
     # only the winner was re-measured, + 4 stage-B + 1 stage-C trials
     # (n_micro=2 dedups against the carried stage-A peak)
+    n_stage_a = len(_load_tuner().STAGE_A)
     prior = [t for t in data["trials"] if t.get("prior")]
     live = [t for t in data["trials"] if not t.get("prior")]
-    assert len(prior) == 15 and len(live) == 6
-    assert data["n_trials"] == 21
+    assert len(prior) == n_stage_a and len(live) == 6
+    assert data["n_trials"] == n_stage_a + 6
 
 
 def test_staged_bc_without_prior_a_refuses(tmp_path):

@@ -92,6 +92,39 @@ def _load_defaults():
 
 _TD = _load_defaults()
 
+# Stage A: batch x remat x fused_ce, ordered by expected win so a short
+# tunnel window still measures the promising region first. 2026-08-01
+# on-chip evidence (first honest pass): full-remat MFU CLIMBS with
+# batch — 16→0.33, 24→0.43, 32→0.60 strict — while dots at batch 8
+# disappointed (0.22). So the big-batch full-remat ladder leads, pushed
+# to the OOM wall (48/64), with dots as the secondary branch. fused_ce
+# avoids the (B,S,V) logits materialization (speeds the head AND frees
+# HBM); fused-off rungs ride along at every leading batch so the lever
+# is quantified at whatever batch wins. The n_micro=2 corners exist
+# because grad accumulation halves peak activation memory and may fit
+# configs that OOM above — stage C only refines the winner, so those
+# corners are never reached unless tried here. Module-level so the
+# smoke tests derive trial counts instead of hardcoding them.
+STAGE_A = [
+    {"batch": 32, "remat": "true", "fused_ce": True},  # evidence leader
+    {"batch": 48, "remat": "true", "fused_ce": True},
+    {"batch": 64, "remat": "true", "fused_ce": True},
+    {"batch": 32, "remat": "true", "fused_ce": False},
+    {"batch": 48, "remat": "true", "fused_ce": False},
+    {"batch": 64, "remat": "true", "fused_ce": False},
+    {"batch": 24, "remat": "true", "fused_ce": True},
+    {"batch": 40, "remat": "true", "fused_ce": True},
+    {"batch": 16, "remat": "true", "fused_ce": True},
+    {"batch": 32, "remat": "dots", "fused_ce": True},
+    {"batch": 48, "remat": "dots", "fused_ce": True},
+    {"batch": 16, "remat": "dots", "fused_ce": True},
+    {"batch": 8, "remat": "dots", "fused_ce": True},
+    {"batch": 16, "remat": "true", "fused_ce": False},
+    {"batch": 64, "remat": "true", "fused_ce": True, "n_micro": 2},
+    {"batch": 48, "remat": "dots", "fused_ce": True, "n_micro": 2},
+    {"batch": 8, "remat": "false", "fused_ce": True},
+]
+
 
 def _resolved(cfg):
     """Dedup key over EFFECTIVE knobs: {batch,seq,remat} and the same
@@ -471,18 +504,6 @@ def main():
             # a mid-stage tunnel death must not lose the search
             persist(best_cfg, best_res, trials, list(done))
 
-    # stage A: batch x remat x fused_ce, ordered by expected win so a
-    # short tunnel window still measures the promising region first.
-    # Full remat charges ~33% extra matmul FLOPs; "dots" (save matmul
-    # outputs, recompute elementwise only) erases most of that but its
-    # saved activations (~0.7 GB per batch row at seq 2048 on the
-    # headline model) only fit HBM at small batch next to ~7 GB of
-    # params+opt — so the likely-to-fit dots candidates (batch 8-16) go
-    # first, the long-shot ones (24/32, expected OOM but cheap to let
-    # the guarded child prove it) go last, and remat=false runs only at
-    # 8 (16 OOM'd in r2). fused_ce avoids the
-    # (B,S,V) logits materialization, so it both speeds the head and
-    # frees HBM that may admit configs the plain head OOMs on.
     stages = os.environ.get("PT_TUNE_STAGES", "ABC").upper()
     if not stages or not set(stages) <= set("ABC"):
         print(f"autotune: invalid PT_TUNE_STAGES={stages!r} "
@@ -491,38 +512,7 @@ def main():
     try:
         if "A" in stages:
             print("stage A: batch x remat x fused_ce", flush=True)
-            # 2026-08-01 on-chip evidence (first honest stage-A pass):
-            # full-remat MFU CLIMBS with batch — 16→0.33, 24→0.43,
-            # 32→0.60 strict — while dots at batch 8 disappointed
-            # (0.22). So the big-batch full-remat ladder leads, pushed
-            # to the OOM wall (48/64), with dots as the secondary
-            # branch. fused_ce avoids the (B,S,V) logits
-            # materialization, so it both speeds the head and frees
-            # HBM that may admit configs the plain head OOMs on.
-            stage_a = [
-                {"batch": 32, "remat": "true", "fused_ce": True},  # leader
-                {"batch": 48, "remat": "true", "fused_ce": True},
-                {"batch": 64, "remat": "true", "fused_ce": True},
-                {"batch": 32, "remat": "true", "fused_ce": False},
-                {"batch": 24, "remat": "true", "fused_ce": True},
-                {"batch": 40, "remat": "true", "fused_ce": True},
-                {"batch": 16, "remat": "true", "fused_ce": True},
-                {"batch": 32, "remat": "dots", "fused_ce": True},
-                {"batch": 48, "remat": "dots", "fused_ce": True},
-                {"batch": 16, "remat": "dots", "fused_ce": True},
-                {"batch": 8, "remat": "dots", "fused_ce": True},
-                {"batch": 16, "remat": "true", "fused_ce": False},
-                # grad accumulation halves peak activation memory, so
-                # big-batch configs that OOM above may fit split into
-                # microbatches — stage C only refines the winner, so
-                # this corner is never reached unless tried here
-                {"batch": 64, "remat": "true", "fused_ce": True,
-                 "n_micro": 2},
-                {"batch": 48, "remat": "dots", "fused_ce": True,
-                 "n_micro": 2},
-                {"batch": 8, "remat": "false", "fused_ce": True},
-            ]
-            for cfg in stage_a:
+            for cfg in STAGE_A:
                 consider(dict(cfg, seq=seq))
             if best_res is None:
                 print("autotune: every stage-A trial failed; aborting",
