@@ -34,6 +34,21 @@ OUT_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "TPU_VALIDATION.json")
 
+# Fresh entropy per family unless pinned: the serving terminal memoizes
+# (executable, inputs) → output across processes, so a fixed-seed
+# re-validation of an unchanged kernel would "pass" from cache without
+# proving the chip still executes. Random inputs make every run a real
+# execution proof; the kernel-vs-reference comparison is unaffected
+# (both sides see the same inputs). PT_VALIDATE_SEED pins for repro.
+_PIN = os.environ.get("PT_VALIDATE_SEED")
+
+
+def _rng(family_ordinal):
+    if _PIN is not None:
+        return np.random.RandomState(int(_PIN) + family_ordinal)
+    return np.random.RandomState(
+        int.from_bytes(os.urandom(4), "little"))
+
 
 def _write(final_ok=None):
     """Progressive banking: a tunnel death mid-suite must still leave the
@@ -80,7 +95,7 @@ def flash_fwd_bwd():
     import jax.numpy as jnp
     from paddle_tpu.ops.flash_attention import (flash_attention_bhsd,
                                                 mha_reference)
-    rng = np.random.RandomState(0)
+    rng = _rng(0)
     errs = {}
     configs = [
         ((2, 4, 512, 64), True, jnp.float32),
@@ -127,7 +142,7 @@ def varlen_fwd_bwd():
     from paddle_tpu.ops.varlen_attention import (flash_attn_unpadded,
                                                  varlen_reference,
                                                  seg_ids_from_cu_seqlens)
-    rng = np.random.RandomState(1)
+    rng = _rng(1)
     h, d = 4, 64
     lens = [200, 56, 312, 8]
     cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
@@ -168,7 +183,7 @@ def paged_decode():
     import jax.numpy as jnp
     from paddle_tpu.ops.paged_attention import (paged_attention,
                                                 paged_attention_reference)
-    rng = np.random.RandomState(2)
+    rng = _rng(2)
     b, qh, kvh, d = 4, 8, 4, 64
     page_size, num_pages, pages_per_seq = 16, 64, 8
     q = jnp.asarray(rng.randn(b, qh, d), jnp.float32) * 0.3
@@ -227,7 +242,7 @@ def flashmask_fwd_bwd():
     import jax.numpy as jnp
     from paddle_tpu.ops.flashmask_attention import (flashmask_attention_bhsd,
                                                     flashmask_reference)
-    rng = np.random.RandomState(5)
+    rng = _rng(3)
     errs = {}
     configs = [
         ((2, 2, 512, 64), True, 1),    # document-causal cutoff
@@ -322,7 +337,7 @@ def flash_bf16_long():
     import jax.numpy as jnp
     from paddle_tpu.ops.flash_attention import (flash_attention_bhsd,
                                                 mha_reference)
-    rng = np.random.RandomState(3)
+    rng = _rng(4)
     b, h, s, d = 1, 4, 4096, 128
     q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.3
     k = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16) * 0.3
