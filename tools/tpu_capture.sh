@@ -15,9 +15,11 @@
 cd "$(dirname "$0")/.."
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
-# step 7's BC refine must only build on a stage-A winner banked by THIS
-# capture run (see autotune._tuned_defaults_for_refine)
-export PT_TUNE_MIN_TS=$(date +%s)
+# BC refine must only build on a stage-A winner banked by this WATCH
+# session (tpu_watch.sh exports its start; standalone runs fall back to
+# capture start) — a committed TUNED.json from a previous round must
+# not serve as the refine base (autotune._tuned_defaults_for_refine)
+export PT_TUNE_MIN_TS=${PT_TUNE_MIN_TS:-$(date +%s)}
 
 alive() {
   # device init alone is NOT enough: the 2026-07-31 window died
@@ -69,11 +71,25 @@ fi
 # 2. autotune stage A (batch x remat x fused_ce — the strict-MFU
 #    levers, 32/48/64 full-remat ladder first): a window that dies
 #    during the long-tail benches below must not take the headline
-#    search with it. Stage B/C refine later.
-PT_TUNE_STAGES=A PT_TUNE_TRIAL_TIMEOUT=2700 timeout 7200 \
+#    search with it. A FRESH stage-A result from an earlier window of
+#    this watch session is not re-run — the step jumps straight to the
+#    BC refine so multi-window rounds make forward progress.
+STAGE2=A
+python - <<'EOF' && STAGE2=BC
+import json, os, sys
+try:
+    d = json.load(open("TUNED.json"))
+except Exception:
+    sys.exit(1)
+min_ts = float(os.environ.get("PT_TUNE_MIN_TS", "0"))
+ok = (not d.get("smoke") and d.get("best")
+      and "A" in d.get("stages_done", []) and d.get("ts", 0) >= min_ts)
+sys.exit(0 if ok else 1)
+EOF
+PT_TUNE_STAGES=$STAGE2 PT_TUNE_TRIAL_TIMEOUT=2700 timeout 7200 \
   python tools/autotune.py 2>&1 | tail -6
 TUNE_RC=${PIPESTATUS[0]}
-[ "$TUNE_RC" != 0 ] && echo "stage A exited rc=$TUNE_RC (124=timeout); continuing"
+[ "$TUNE_RC" != 0 ] && echo "stage $STAGE2 exited rc=$TUNE_RC (124=timeout); continuing"
 alive || { echo "CAPTURE_ABORT tunnel dead after step 2"; exit 2; }
 
 # 3. headline AT the stage-A winner (TUNED.json best is honored
@@ -120,9 +136,12 @@ done
 
 # 7. autotune stage B/C: refine the step-2 stage-A winner (flash
 #    blocks, n_micro). Checkpoints every improvement, so a mid-search
-#    death keeps the best-so-far.
-PT_TUNE_STAGES=BC PT_TUNE_TRIAL_TIMEOUT=2700 timeout 10800 \
-  python tools/autotune.py 2>&1 | tail -8
+#    death keeps the best-so-far. Skipped when step 2 already ran the
+#    BC refine (fresh stage-A shortcut).
+if [ "$STAGE2" = A ]; then
+  PT_TUNE_STAGES=BC PT_TUNE_TRIAL_TIMEOUT=2700 timeout 10800 \
+    python tools/autotune.py 2>&1 | tail -8
+fi
 
 # 8. final headline at the tuned defaults
 alive && PT_BENCH_SKIP_VALIDATE=1 timeout 3600 python bench.py 2>&1 | tail -1

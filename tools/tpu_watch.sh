@@ -18,6 +18,11 @@ LOG=tpu_watch.log
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 START_TS=$(date +%s)
+# BC-refine freshness is scoped to this WATCH session: a stage-A winner
+# banked by an earlier window of the same session is a valid refine
+# base for a later window's capture (tpu_capture.sh defaults this to
+# its own start when run standalone)
+export PT_TUNE_MIN_TS=$START_TS
 
 have_artifacts() {
   python - "$START_TS" <<'EOF'
