@@ -63,12 +63,24 @@ def _run_backward(outputs, out_grads, inputs=None, accumulate_into_leaves=True,
         lst = cotangents.setdefault(key, [None] * len(t._node.raw_outputs))
         lst[t._out_idx] = g if lst[t._out_idx] is None else lst[t._out_idx] + g
 
+    hooked_leaves: dict[int, Tensor] = {}
+    pass_contrib: dict[int, object] = {}  # THIS pass's grad per hooked leaf
+
     def _accum_tensor(t: Tensor, g):
         if _float0_like(g):
             return
         if g.shape != tuple(t._value.shape):
             g = jnp.reshape(jnp.broadcast_to(g, t._value.shape), t._value.shape) \
                 if g.size == t.size else g
+        if getattr(t, "_leaf_hooks", None):
+            # hooks fire once per backward PASS with this pass's grad
+            # (not the cross-pass .grad accumulation), for backward()
+            # and grad() alike — contributions from multiple consumers
+            # sum here and the hook fires after the walk
+            k = id(t)
+            hooked_leaves[k] = t
+            pass_contrib[k] = g if k not in pass_contrib \
+                else pass_contrib[k] + g
         if id(t) in input_ids:
             direct[id(t)] = g if id(t) not in direct else direct[id(t)] + g
         if accumulate_into_leaves and (t.is_leaf or t._retain_grads):
@@ -89,6 +101,19 @@ def _run_backward(outputs, out_grads, inputs=None, accumulate_into_leaves=True,
         cts = cotangents.get(key)
         if cts is None or all(c is None for c in cts):
             continue
+        hooks = getattr(node, "_out_hooks", None)
+        if hooks:
+            # topo order guarantees every consumer has contributed, so
+            # cts[j] is the FULL gradient of output j here — the
+            # register_hook contract (fire once; a returned tensor
+            # replaces the grad seen upstream)
+            for j, slot in hooks.items():
+                if j < len(cts) and cts[j] is not None:
+                    for fn in list(slot.values()):
+                        r = fn(Tensor(cts[j], stop_gradient=True))
+                        if r is not None:
+                            cts[j] = r._value if isinstance(r, Tensor) \
+                                else jnp.asarray(r)
         in_grads = node.vjp(cts)
         for t, (pnode, pidx, sg), g in zip(node.input_tensors,
                                            node.input_links, in_grads):
@@ -108,6 +133,23 @@ def _run_backward(outputs, out_grads, inputs=None, accumulate_into_leaves=True,
                 _accum_tensor(t, g)
         if not retain_graph:
             cotangents[key] = None
+
+    for k, t in hooked_leaves.items():
+        g0 = pass_contrib[k]
+        g_new = g0
+        for fn in list(t._leaf_hooks.values()):
+            r = fn(Tensor(g_new, stop_gradient=True))
+            if r is not None:
+                g_new = r._value if isinstance(r, Tensor) else jnp.asarray(r)
+        if g_new is g0:
+            continue
+        # a replacement swaps only THIS pass's contribution — prior
+        # .grad accumulation and other inputs' grads stay intact
+        if accumulate_into_leaves and (t.is_leaf or t._retain_grads) \
+                and t.grad is not None:
+            t.grad = Tensor(t.grad._value - g0 + g_new, stop_gradient=True)
+        if k in direct:
+            direct[k] = direct[k] - g0 + g_new
 
     return direct
 

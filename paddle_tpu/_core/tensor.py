@@ -63,7 +63,7 @@ class TapeNode:
     """One recorded op. VJP is derived lazily via jax.vjp on the pure fn."""
 
     __slots__ = ("fn", "kwargs", "raw_inputs", "input_tensors", "raw_outputs",
-                 "multi", "name", "input_links", "_unpack")
+                 "multi", "name", "input_links", "_unpack", "_out_hooks")
 
     def __init__(self, fn, kwargs, raw_inputs, input_tensors, raw_outputs, multi, name):
         self.fn = fn
@@ -126,6 +126,25 @@ def _float0_like(g):
     return g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0)
 
 
+_HOOK_COUNTER = [0]
+
+
+def _next_hook_id():
+    _HOOK_COUNTER[0] += 1
+    return _HOOK_COUNTER[0]
+
+
+class _HookRemoveHelper:
+    """Returned by Tensor.register_hook — reference parity with
+    TensorHookRemoveHelper (remove() deregisters)."""
+
+    def __init__(self, slot, hid):
+        self._slot, self._hid = slot, hid
+
+    def remove(self):
+        return self._slot.pop(self._hid, None) is not None
+
+
 class Tensor:
     """paddle_tpu Tensor: value + autograd metadata.
 
@@ -135,7 +154,7 @@ class Tensor:
 
     __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_idx",
                  "name", "_retain_grads", "persistable", "dist_spec",
-                 "__weakref__")
+                 "_leaf_hooks", "__weakref__")
 
     def __init__(self, value, stop_gradient=True, name=None):
         self._value = value
@@ -147,6 +166,7 @@ class Tensor:
         self._retain_grads = False
         self.persistable = False
         self.dist_spec = None  # PartitionSpec over the global mesh (GSPMD)
+        self._leaf_hooks = None  # register_hook on leaves (dict id → fn)
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -266,9 +286,82 @@ class Tensor:
     def is_contiguous(self):
         return True
 
+    # -- misc parity (reference: base/dygraph/tensor_patch_methods.py) -------
+    def value(self):
+        """Reference parity: returns the underlying variable — here the
+        Tensor itself (there is no separate VarBase)."""
+        return self
+
+    def apply(self, func):
+        """Return func(self) (tensor_patch_methods.py:apply). Like the
+        reference, refuses tensors that require grad — apply is a
+        data-editing escape hatch, not a differentiable op."""
+        if not self.stop_gradient:
+            raise RuntimeError(
+                "Cannot apply function on a tensor that requires grad; "
+                "detach() first or use normal ops for a differentiable "
+                "path.")
+        return func(self)
+
+    def apply_(self, func):
+        """In-place apply: self <- func(self) (same grad guard)."""
+        out = self.apply(func)
+        v = out._value if isinstance(out, Tensor) else jnp.asarray(out)
+        self._replace(v.astype(self.dtype) if v.dtype != self.dtype else v)
+        return self
+
+    def to_dense(self):
+        """Dense tensors are their own dense form (SparseCooTensor
+        overrides; parity: sparse_to_dense)."""
+        return self
+
+    def to_sparse_coo(self, sparse_dim):
+        """Dense → COO with `sparse_dim` leading sparse axes
+        (tensor_patch_methods.py:1212 → sparse_to_sparse_coo)."""
+        from ..sparse import SparseCooTensor
+        from jax.experimental import sparse as jsparse
+        nd = self._value.ndim
+        if not 0 < sparse_dim <= nd:
+            raise ValueError(f"sparse_dim {sparse_dim} out of range for "
+                             f"{nd}-d tensor")
+        bcoo = jsparse.BCOO.fromdense(self._value, n_dense=nd - sparse_dim)
+        return SparseCooTensor(bcoo, stop_gradient=self.stop_gradient)
+
+    def __dlpack__(self, stream=None):
+        return self._value.__dlpack__()
+
+    def __dlpack_device__(self):
+        return self._value.__dlpack_device__()
+
     # -- autograd -----------------------------------------------------------
     def retain_grads(self):
         self._retain_grads = True
+
+    def gradient(self):
+        """Grad as a numpy array (None when no grad) — legacy dygraph
+        accessor (tensor_patch_methods.py:gradient)."""
+        return None if self.grad is None else np.asarray(self.grad._value)
+
+    def register_hook(self, hook):
+        """Backward hook: called with this tensor's gradient during
+        backward; returning a tensor replaces the gradient seen by
+        upstream ops (tensor_patch_methods.py:502). Fires ONCE with the
+        fully-accumulated gradient. Returns a remove() helper."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "Cannot register hook on a tensor with stop_gradient=True")
+        if self._node is not None:
+            hooks = getattr(self._node, "_out_hooks", None)
+            if hooks is None:
+                hooks = self._node._out_hooks = {}
+            slot = hooks.setdefault(self._out_idx, {})
+        else:
+            if self._leaf_hooks is None:
+                self._leaf_hooks = {}
+            slot = self._leaf_hooks
+        hid = _next_hook_id()
+        slot[hid] = hook
+        return _HookRemoveHelper(slot, hid)
 
     def clear_grad(self):
         self.grad = None

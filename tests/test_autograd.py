@@ -209,3 +209,115 @@ class TestInplaceTapeSafety:
         # and post-mutation consumers see x as a constant
         z = (x * x).sum()
         assert z.stop_gradient
+
+
+class TestRegisterHook:
+    """Tensor.register_hook parity (reference:
+    base/dygraph/tensor_patch_methods.py:502 — hook fires once with the
+    full gradient; a returned tensor replaces the upstream grad)."""
+
+    def test_leaf_hook_observes_accumulated_grad(self):
+        import paddle_tpu as pt
+        w = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+        seen = {}
+        h = w.register_hook(lambda g: seen.__setitem__("g", g.numpy()))
+        # two consumers: the hook must see the SUM of contributions
+        ((w * w).sum() + (3.0 * w).sum()).backward()
+        assert np.allclose(seen["g"], w.grad.numpy())
+        assert np.allclose(w.grad.numpy(), [7.0, 9.0])  # 2w + 3
+        assert h.remove() and not h.remove()
+
+    def test_intermediate_hook_replaces_grad(self):
+        import paddle_tpu as pt
+        w = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+        v = w * w
+        v.register_hook(lambda g: g * 10)
+        v.sum().backward()
+        assert np.allclose(w.grad.numpy(), [40.0, 60.0])  # 10 * 2w
+
+    def test_removed_hook_does_not_fire(self):
+        import paddle_tpu as pt
+        w = pt.to_tensor([1.0], stop_gradient=False)
+        v = w * 2.0
+        h = v.register_hook(lambda g: g * 100)
+        h.remove()
+        v.sum().backward()
+        assert np.allclose(w.grad.numpy(), [2.0])
+
+    def test_register_on_stopped_tensor_raises(self):
+        import paddle_tpu as pt
+        t = pt.to_tensor([1.0])
+        with pytest.raises(RuntimeError):
+            t.register_hook(lambda g: g)
+
+    def test_gradient_accessor(self):
+        import paddle_tpu as pt
+        w = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+        assert w.gradient() is None
+        (w * w).sum().backward()
+        assert np.allclose(w.gradient(), [2.0, 4.0])
+
+
+class TestTensorPatchParity:
+    """apply/apply_/value/to_dense/to_sparse_coo/__dlpack__ (reference
+    tensor_patch_methods list at base/dygraph/tensor_patch_methods.py:1440)."""
+
+    def test_apply_and_apply_(self):
+        import paddle_tpu as pt
+        y = pt.to_tensor([[1.0, 2.0]])
+        z = y.apply(lambda t: t * 3 + 2)
+        assert np.allclose(z.numpy(), [[5.0, 8.0]])
+        y.apply_(lambda t: t * 2)
+        assert np.allclose(y.numpy(), [[2.0, 4.0]])
+
+    def test_apply_refuses_grad_tensor(self):
+        import paddle_tpu as pt
+        w = pt.to_tensor([1.0], stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            w.apply(lambda t: t)
+
+    def test_to_sparse_coo_round_trip(self):
+        import paddle_tpu as pt
+        x = pt.to_tensor([[0.0, 2.0, 0.0], [3.0, 0.0, 4.0]])
+        sp = x.to_sparse_coo(2)
+        assert sp.nnz() == 3
+        assert np.allclose(sp.to_dense().numpy(), x.numpy())
+        d = pt.sparse.matmul(sp, pt.to_tensor(np.eye(3, dtype=np.float32)))
+        assert np.allclose(d.numpy(), x.numpy())
+
+    def test_value_and_dense_identity_and_dlpack(self):
+        import paddle_tpu as pt
+        x = pt.to_tensor([[1.0]])
+        assert x.value() is x and x.to_dense() is x
+        assert x.__dlpack__() is not None
+        assert isinstance(x.__dlpack_device__(), tuple)
+
+    def test_leaf_hook_sees_per_pass_grad_under_accumulation(self):
+        """Two backward passes without clear_grad: the hook fires with
+        each PASS's gradient, and a replacing hook swaps only that
+        pass's contribution into the accumulated .grad."""
+        import paddle_tpu as pt
+        w = pt.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        w.register_hook(lambda g: seen.append(float(g.numpy()[0])))
+        (w * 2.0).sum().backward()
+        (w * 2.0).sum().backward()
+        assert seen == [2.0, 2.0]           # per-pass, not 2 then 4
+        assert np.allclose(w.grad.numpy(), [4.0])
+
+    def test_replacing_leaf_hook_keeps_prior_accumulation(self):
+        import paddle_tpu as pt
+        w = pt.to_tensor([1.0], stop_gradient=False)
+        (w * 2.0).sum().backward()          # .grad = 2
+        h = w.register_hook(lambda g: g * 0)
+        (w * 2.0).sum().backward()          # pass contributes 0, not wipe
+        assert np.allclose(w.grad.numpy(), [2.0])
+        h.remove()
+
+    def test_leaf_hook_fires_under_grad_api(self):
+        import paddle_tpu as pt
+        w = pt.to_tensor([1.0], stop_gradient=False)
+        w.register_hook(lambda g: g * 10)
+        loss = (w * 2.0).sum()
+        (gw,) = pt.grad(loss, [w])
+        assert np.allclose(gw.numpy(), [20.0])
