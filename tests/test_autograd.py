@@ -321,3 +321,16 @@ class TestTensorPatchParity:
         loss = (w * 2.0).sum()
         (gw,) = pt.grad(loss, [w])
         assert np.allclose(gw.numpy(), [20.0])
+
+    def test_patch_method_surface(self):
+        """The reference's dygraph tensor patch list
+        (tensor_patch_methods.py:1440) — every method a dense Tensor
+        can honor exists here."""
+        import paddle_tpu as pt
+        t = pt.to_tensor([1.0])
+        for m in ("set_value", "backward", "clear_grad", "gradient",
+                  "apply_", "apply", "register_hook", "item", "to",
+                  "to_dense", "to_sparse_coo", "value", "cpu",
+                  "pin_memory", "__dlpack__", "__dlpack_device__",
+                  "__array__", "__getitem__", "__setitem__"):
+            assert hasattr(t, m), m
