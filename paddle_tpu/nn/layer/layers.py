@@ -311,6 +311,22 @@ class Layer:
                 dest[structured_name_prefix + name] = b
         return dest
 
+    def to_static_state_dict(self, destination=None, include_sublayers=True,
+                             structured_name_prefix="", use_hook=True,
+                             keep_vars=True):
+        """Reference parity (nn/layer/layers.py:2044): the static-graph
+        flavor of state_dict. There is no separate static VarBase here —
+        keep_vars=False detaches the entries from the tape, matching the
+        reference's variable conversion."""
+        d = self.state_dict(destination=destination,
+                            include_sublayers=include_sublayers,
+                            structured_name_prefix=structured_name_prefix,
+                            use_hook=use_hook)
+        if not keep_vars:
+            d = OrderedDict((k, v.detach() if isinstance(v, Tensor) else v)
+                            for k, v in d.items())
+        return d
+
     def set_state_dict(self, state_dict, use_structured_name=True):
         own = self.state_dict()
         missing, unexpected = [], []
