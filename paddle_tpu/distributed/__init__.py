@@ -11,6 +11,7 @@ from .collective import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import ps  # noqa: F401
+from . import rpc  # noqa: F401
 from .parallel_wrappers import DataParallel  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .auto_parallel import (  # noqa: F401

@@ -1,0 +1,59 @@
+"""Child for the cross-process RPC test: rank 0 calls a function ON
+rank 1 (and vice versa) through paddle_tpu.distributed.rpc.
+
+rpc.py is stdlib-only, so load it by FILE PATH instead of through the
+package: `import paddle_tpu` pulls jax, which takes tens of seconds on
+a box saturated by the test suite and has made this child time out."""
+import importlib.util
+import os
+
+_RPC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "paddle_tpu", "distributed", "rpc.py")
+_spec = importlib.util.spec_from_file_location("pt_rpc_standalone", _RPC_PATH)
+rpc = importlib.util.module_from_spec(_spec)
+import sys  # noqa: E402
+# register BEFORE exec: pickling WorkerInfo requires the class's module
+# be resolvable by name (both children register the same name)
+sys.modules[_spec.name] = rpc
+_spec.loader.exec_module(rpc)
+
+
+def mul(a, b):
+    return a * b
+
+
+def whoami():
+    return rpc.get_current_worker_info().name
+
+
+def boom():
+    raise ValueError("remote boom")
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(f"worker{rank}", rank=rank, world_size=2)
+    other = f"worker{1 - rank}"
+
+    assert rpc.rpc_sync(other, mul, args=(6, 7)) == 42
+    fut = rpc.rpc_async(other, whoami)
+    assert fut.wait() == other, fut
+
+    try:
+        rpc.rpc_sync(other, boom)
+    except ValueError as e:
+        assert "remote boom" in str(e)
+    else:
+        raise AssertionError("remote exception did not propagate")
+
+    infos = rpc.get_all_worker_infos()
+    assert [i.name for i in infos] == ["worker0", "worker1"]
+    assert rpc.get_worker_info(other).name == other
+
+    rpc.shutdown()
+    print(f"RPC_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

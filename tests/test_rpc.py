@@ -1,0 +1,243 @@
+"""paddle.distributed.rpc parity (reference python/paddle/distributed/
+rpc/rpc.py): named-worker function RPC over the TCPStore rendezvous.
+
+In-process tests drive two RpcAgent instances directly (the internals
+are instantiable precisely for this); the subprocess test exercises the
+real cross-process path end to end (children import the full package,
+so their startup is jax-import-heavy — hence the generous timeout).
+"""
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import paddle_tpu.distributed.rpc as rpc
+from paddle_tpu.distributed.rpc import RpcAgent, _TCPStore
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# module-level so pickle ships them by reference
+def _add(a, b):
+    return a + b
+
+
+def _sleep_then(x, secs):
+    time.sleep(secs)
+    return x
+
+
+def _fail(msg):
+    raise RuntimeError(msg)
+
+
+class TestTCPStore:
+    def test_set_get_add(self):
+        port = _free_port()
+        master = _TCPStore("127.0.0.1", port, True, timeout=10)
+        client = _TCPStore("127.0.0.1", port, False, timeout=10)
+        try:
+            client.set("k", {"a": 1})
+            assert master.get("k") == {"a": 1}
+            assert client.add("n", 2) == 2
+            assert master.add("n", 3) == 5
+            assert client.get("n") == 5
+        finally:
+            master.stop()
+
+    def test_get_blocks_until_set(self):
+        port = _free_port()
+        master = _TCPStore("127.0.0.1", port, True, timeout=10)
+        try:
+            out = {}
+
+            def waiter():
+                out["v"] = master.get("late")
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.2)
+            assert "v" not in out          # still blocked
+            master.set("late", 7)
+            t.join(timeout=5)
+            assert out["v"] == 7
+        finally:
+            master.stop()
+
+    def test_get_timeout_raises(self):
+        port = _free_port()
+        master = _TCPStore("127.0.0.1", port, True, timeout=10)
+        try:
+            with pytest.raises(TimeoutError):
+                master.get("never", timeout=0.4)
+        finally:
+            master.stop()
+
+
+def _two_agents(port):
+    store0 = _TCPStore("127.0.0.1", port, True, timeout=30)
+    store1 = _TCPStore("127.0.0.1", port, False, timeout=30)
+    out = {}
+
+    def boot(rank, store):
+        out[rank] = RpcAgent(f"w{rank}", rank, 2, store)
+
+    # both constructors barrier on each other -> bring up concurrently
+    t = threading.Thread(target=boot, args=(1, store1))
+    t.start()
+    boot(0, store0)
+    t.join(timeout=30)
+    return out[0], out[1], store0
+
+
+class TestRpcAgent:
+    def test_sync_async_both_directions(self):
+        a0, a1, store = _two_agents(_free_port())
+        try:
+            assert a0.invoke("w1", _add, (2, 3), None, -1).wait() == 5
+            assert a1.invoke("w0", _add, (10, 3), None, -1).wait() == 13
+            # self-call works too (reference world_size=1 examples)
+            assert a0.invoke("w0", _add, (1, 1), None, -1).wait() == 2
+        finally:
+            a0.stop(), a1.stop(), store.stop()
+
+    def test_async_overlaps(self):
+        """Structural overlap proof, no wall-clock bound (a loaded CI
+        box would flake a timing assert): short calls issued AFTER a
+        long call complete while it is still in flight."""
+        a0, a1, store = _two_agents(_free_port())
+        try:
+            t0 = time.perf_counter()
+            slow = a0.invoke("w1", _sleep_then, ("slow", 2.0), None, -1)
+            quick = [a0.invoke("w1", _sleep_then, (i, 0.01), None, -1)
+                     for i in range(3)]
+            assert [f.wait() for f in quick] == [0, 1, 2]
+            if time.perf_counter() - t0 < 1.5:
+                # quick calls finished while the long call was still in
+                # flight -> they overlapped (guarded so a pathologically
+                # slow box can't false-fail the structural check)
+                assert not slow._done.is_set()
+            assert slow.wait(10) == "slow"
+        finally:
+            a0.stop(), a1.stop(), store.stop()
+
+    def test_remote_exception_propagates_with_traceback(self):
+        a0, a1, store = _two_agents(_free_port())
+        try:
+            with pytest.raises(RuntimeError, match="kaboom"):
+                a0.invoke("w1", _fail, ("kaboom",), None, -1).wait()
+            try:
+                a0.invoke("w1", _fail, ("kaboom",), None, -1).wait()
+            except RuntimeError as e:
+                assert "remote traceback" in str(e)
+        finally:
+            a0.stop(), a1.stop(), store.stop()
+
+    def test_unknown_worker_and_timeout(self):
+        a0, a1, store = _two_agents(_free_port())
+        try:
+            with pytest.raises(ValueError, match="unknown worker"):
+                a0.invoke("nope", _add, (1, 2), None, -1).wait()
+            with pytest.raises(TimeoutError):
+                a0.invoke("w1", _sleep_then, (1, 3.0), None, 0.3).wait()
+        finally:
+            a0.stop(), a1.stop(), store.stop()
+
+    def test_worker_infos(self):
+        a0, a1, store = _two_agents(_free_port())
+        try:
+            infos = a0.all_worker_infos()
+            assert [i.name for i in infos] == ["w0", "w1"]
+            assert a0.worker_info().rank == 0
+            assert a0.worker_info("w1").rank == 1
+            assert infos[1].ip == "127.0.0.1"
+        finally:
+            a0.stop(), a1.stop(), store.stop()
+
+
+class TestModuleApi:
+    def test_world_size_one_lifecycle(self):
+        """reference rpc.py docstring example: single worker, self-call."""
+        rpc.init_rpc("solo", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{_free_port()}")
+        try:
+            assert rpc.rpc_sync("solo", _add, args=(2, 3)) == 5
+            fut = rpc.rpc_async("solo", _add, args=(4, 4))
+            assert fut.wait() == 8
+            me = rpc.get_current_worker_info()
+            assert me.name == "solo" and me.rank == 0
+            assert rpc.get_all_worker_infos() == [me]
+            assert rpc.get_worker_info("solo") == me
+        finally:
+            rpc.shutdown()
+        # shutdown is idempotent and re-init works
+        rpc.shutdown()
+        rpc.init_rpc("solo2", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{_free_port()}")
+        rpc.shutdown()
+
+    def test_uninitialized_raises(self):
+        with pytest.raises(RuntimeError, match="init_rpc"):
+            rpc.rpc_sync("x", _add, args=(1, 2))
+
+    def test_double_init_raises(self):
+        rpc.init_rpc("solo", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{_free_port()}")
+        try:
+            with pytest.raises(RuntimeError, match="already"):
+                rpc.init_rpc("again", rank=0, world_size=1,
+                             master_endpoint=f"127.0.0.1:{_free_port()}")
+        finally:
+            rpc.shutdown()
+
+
+def test_two_process_rpc():
+    """The real thing: two processes, rendezvous at the master, calls in
+    both directions, remote exception propagation, clean shutdown.
+
+    Retried on EADDRINUSE: the master port is picked by _free_port and
+    a sibling test process can grab it in the bind race window."""
+    child = os.path.join(HERE, "_rpc_child.py")
+    for attempt in range(3):
+        port = _free_port()
+        procs, outs, errs = [], [], []
+        try:
+            for rank in range(2):
+                env = dict(os.environ,
+                           PADDLE_TRAINER_ID=str(rank),
+                           PADDLE_MASTER_ENDPOINT=f"127.0.0.1:{port}")
+                procs.append(subprocess.Popen(
+                    [sys.executable, child], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True))
+            for p in procs:
+                # children load rpc.py by file path (stdlib-only, no
+                # jax import) so startup is fast even under suite load
+                out, err = p.communicate(timeout=120)
+                outs.append(out)
+                errs.append(err)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        if (attempt < 2
+                and any("Address already in use" in e for e in errs)):
+            continue
+        for rank, p in enumerate(procs):
+            assert p.returncode == 0, \
+                f"rank {rank} failed:\n{errs[rank][-2000:]}"
+            assert f"RPC_OK rank={rank}" in outs[rank]
+        return
