@@ -323,8 +323,11 @@ class Layer:
                             structured_name_prefix=structured_name_prefix,
                             use_hook=use_hook)
         if not keep_vars:
-            d = OrderedDict((k, v.detach() if isinstance(v, Tensor) else v)
-                            for k, v in d.items())
+            # detach IN PLACE: a caller-supplied destination must hold
+            # the same (detached) entries as the returned dict
+            for k, v in d.items():
+                if isinstance(v, Tensor):
+                    d[k] = v.detach()
         return d
 
     def set_state_dict(self, state_dict, use_structured_name=True):

@@ -274,6 +274,13 @@ def persist(best_cfg, best_res, trials, done):
                   mfu=best_res["extra"]["mfu"],
                   mfu_legacy=best_res["extra"].get("mfu_legacy")),
         stages_done=done, n_trials=len(trials), smoke=SMOKE,
+        # refresh provenance: _merge_tuned preserves unknown keys, so
+        # a hand-seeded "source" note from a previous window would
+        # otherwise survive and describe the WRONG measurement
+        source=(f"autotune search on this host (stages "
+                f"{','.join(done) or 'in-progress'}, "
+                f"{len(trials)} trials); best re-measured fresh, "
+                "not hand-seeded"),
         trials=[dict({"cfg": t["cfg"],
                       "tok_s": t["result"]["value"] if t["result"] else None,
                       "error": t.get("error")},
