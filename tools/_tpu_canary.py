@@ -1,0 +1,40 @@
+"""THE tunnel-aliveness canary — single source for every prober
+(tools/tpu_watch.sh, tools/tpu_capture.sh, tools/autotune._tunnel_alive).
+
+Exit 0 iff the tunnel can compile AND execute right now:
+  * persistent compilation cache disabled BEFORE importing jax, so a
+    disk-cache hit can never mask a dead remote-compile service (the
+    2026-07-31 "half-alive" mode: devices list fine, every compile
+    burns its full timeout);
+  * the canary VALUE is random, so the serving terminal's
+    (executable, inputs) -> output memoization can never mask a dead
+    execute service with a cached answer.
+
+Callers must wrap in a timeout (a dead tunnel hangs device init):
+    timeout 180 python tools/_tpu_canary.py
+"""
+import os
+import random
+import sys
+
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main():
+    if jax.devices()[0].platform != "tpu":
+        print("canary: not a TPU platform", file=sys.stderr)
+        return 1
+    n = random.randrange(1, 100000)
+    x = jnp.full((2, 1024), n, jnp.int32)
+    got = int(jax.jit(lambda a: (a * 2).sum())(x))
+    if got != 4096 * n:
+        print(f"canary: wrong result {got} != {4096 * n}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,6 +72,21 @@ class TunnelDead(RuntimeError):
     pass
 
 
+def _tunnel_alive(timeout=180):
+    """Run the shared canary (tools/_tpu_canary.py — uncached compile +
+    random-value execute) in a child process; False when it hangs or
+    fails. A child process because a dead tunnel hangs jax device
+    init."""
+    canary = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_tpu_canary.py")
+    try:
+        return subprocess.run([sys.executable, canary],
+                              capture_output=True,
+                              timeout=timeout).returncode == 0
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
 def _mark_trial(kind):
     """kind: 'ok' | 'dead' (timeout/cpu_fallback) | 'bad' (config)."""
     global _consec_dead
@@ -79,6 +94,12 @@ def _mark_trial(kind):
     if _consec_dead >= DEAD_TRIP:
         raise TunnelDead(
             f"{_consec_dead} consecutive timeout/cpu-fallback trials")
+    if kind == "dead" and not SMOKE and not _tunnel_alive():
+        # don't wait for DEAD_TRIP x TRIAL_TIMEOUT (2.25h at defaults):
+        # a 3-minute canary right after a timed-out trial settles
+        # whether the window died (2026-08-01: trial 2 of stage A hung
+        # 45 min on a tunnel that died after trial 1)
+        raise TunnelDead("post-trial canary failed (window died)")
 
 
 def _load_defaults():

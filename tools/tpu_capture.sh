@@ -22,22 +22,12 @@ mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 export PT_TUNE_MIN_TS=${PT_TUNE_MIN_TS:-$(date +%s)}
 
 alive() {
-  # device init alone is NOT enough: the 2026-07-31 window died
-  # "half-alive" — devices listed fine while the remote_compile service
-  # refused connections, burning 1800s per compile attempt. Probe with
-  # a tiny compile + execute, with the persistent disk cache DISABLED
-  # for the probe process so a cache hit can never mask a dead compile
-  # service.
-  # random canary VALUE: the terminal memoizes (executable, inputs) →
-  # output, so a constant canary could read alive from cache while the
-  # execute service is dead
-  env -u JAX_COMPILATION_CACHE_DIR timeout 300 python -c "
-import random, jax, jax.numpy as jnp
-assert jax.devices()[0].platform == 'tpu'
-n = random.randrange(1, 100000)
-x = jnp.full((2, 1024), n, jnp.int32)
-assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096 * n
-" 2>/dev/null
+  # shared canary (tools/_tpu_canary.py): uncached tiny compile +
+  # random-value execute — catches the "half-alive" mode (devices list
+  # fine, remote compile/execute dead) and defeats both the disk cache
+  # and the terminal's (executable, inputs) memoization. Single source
+  # for all three probers (watch / capture / autotune).
+  timeout 300 python tools/_tpu_canary.py 2>/dev/null
 }
 alive || { echo "CAPTURE_ABORT tunnel half-alive (compile canary failed)"; exit 2; }
 

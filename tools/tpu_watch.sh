@@ -60,24 +60,14 @@ EOF
 }
 
 probe() {
-  # device init + uncached tiny compile: a half-alive tunnel (devices
-  # list fine, remote_compile refusing — observed 2026-07-31) must read
-  # as DOWN here, so capture never launches into a window where every
-  # compile burns ~1800s. Disk cache disabled so a hit can't mask it.
-  # 180s: a live tunnel answers device init + the tiny uncached canary
-  # compile in well under 2 min; a dead one hangs to whatever timeout we
-  # give it, and that timeout plus the sleep below is the window-
-  # discovery latency (9 min/cycle was losing half an 18-min window)
-  # random canary VALUE: the serving terminal memoizes (executable,
-  # inputs) → output, so a constant canary could read as alive from
-  # cache while the execute service is dead
-  env -u JAX_COMPILATION_CACHE_DIR timeout 180 python -c "
-import random, jax, jax.numpy as jnp
-assert jax.devices()[0].platform == 'tpu'
-n = random.randrange(1, 100000)
-x = jnp.full((2, 1024), n, jnp.int32)
-assert int(jax.jit(lambda a: (a * 2).sum())(x)) == 4096 * n
-" 2>>"$LOG"
+  # shared canary (tools/_tpu_canary.py): uncached tiny compile +
+  # random-value execute — a half-alive tunnel (devices list fine,
+  # remote compile/execute dead — observed 2026-07-31) must read as
+  # DOWN here, and neither the disk cache nor the terminal's
+  # (executable, inputs) memoization can mask that. 180s: a live
+  # tunnel answers in well under 2 min; the timeout plus the sleep
+  # below is the window-discovery latency.
+  timeout 180 python tools/_tpu_canary.py 2>>"$LOG"
 }
 
 state() {
