@@ -148,6 +148,7 @@ def _gen_inplace():
         "erfinv", "exp", "expm1", "fill", "flatten", "floor",
         "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
         "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+        "index_add", "index_fill", "index_put",
         "lcm", "ldexp", "lerp", "less", "less_equal", "less_than",
         "lgamma", "log", "log10", "log1p", "log2", "logical_and",
         "logical_not", "logical_or", "logical_xor", "logit",

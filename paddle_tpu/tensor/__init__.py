@@ -130,6 +130,7 @@ for _fname in ["add", "subtract", "multiply", "divide", "clip", "scale", "floor"
                "floor_divide", "logical_and", "logical_or", "logical_not",
                "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor",
                "bitwise_not", "masked_fill", "nan_to_num",
+               "index_add", "index_fill", "index_put",
                "cumsum", "cumprod", "transpose", "cast"]:
     if hasattr(Tensor, _fname) and not hasattr(Tensor, _fname + "_"):
         setattr(Tensor, _fname + "_",

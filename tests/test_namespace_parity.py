@@ -53,6 +53,27 @@ class TestInplaceVariants:
         x.fill_(7.0)
         assert np.allclose(x.numpy(), 7.0)
 
+    def test_index_inplace_variants(self):
+        """paddle.index_add_/index_put_/index_fill_ (the last three
+        reference __all__ gaps, added via `__all__ +=` upstream so the
+        static regex above misses them): mutate the wrapper, return it,
+        and keep gradients flowing through the snapshot tape."""
+        idx = pt.to_tensor(np.array([0, 2]))
+        x = pt.to_tensor(np.zeros((3, 4), np.float32))
+        ret = pt.index_add_(x, idx, 0, pt.ones([2, 4]))
+        assert ret is x and float(x.numpy().sum()) == 8.0
+        x.index_fill_(idx, 0, 7.0)
+        assert np.allclose(x.numpy()[[0, 2]], 7.0)
+        pt.index_put_(x, (pt.to_tensor(np.array([1])),),
+                      pt.full([1, 4], 5.0))
+        assert np.allclose(x.numpy()[1], 5.0)
+        # grad flows to the pre-mutation producer
+        a = pt.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+        b = a * 2.0
+        b.index_add_(idx, 0, pt.ones([2, 4]))
+        b.sum().backward()
+        assert np.allclose(a.grad.numpy(), 2.0)
+
     def test_fill_random_inplace(self):
         pt.seed(0)
         y = pt.zeros([200])
