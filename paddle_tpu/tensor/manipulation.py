@@ -270,11 +270,13 @@ def index_add(x, index, axis, value, name=None):
 
 
 def index_fill(x, index, axis, value, name=None):
-    def fn(a, idx):
+    # value rides THROUGH apply (not captured) so a 0-d Tensor value
+    # keeps its gradient path (d value = count of filled positions)
+    def fn(a, idx, v):
         moved = jnp.moveaxis(a, int(axis), 0)
-        out = moved.at[idx].set(jnp.asarray(unwrap(value), a.dtype))
+        out = moved.at[idx].set(jnp.asarray(v, a.dtype))
         return jnp.moveaxis(out, 0, int(axis))
-    return apply(fn, x, index, name="index_fill")
+    return apply(fn, x, index, value, name="index_fill")
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
