@@ -9,6 +9,9 @@ reference's zero-copy tensor API; device placement is jax's.
 """
 from __future__ import annotations
 
+import os
+import shutil
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -16,7 +19,13 @@ import jax.numpy as jnp
 from .._core.tensor import Tensor, unwrap
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "DataType", "PredictorPool", "XpuConfig",
+           "convert_to_mixed_precision", "get_num_bytes_of_data_type",
+           "get_version", "get_trt_compile_version",
+           "get_trt_runtime_version",
+           # underscore name deliberately public: the reference exports
+           # it in paddle.inference.__all__ (inference/__init__.py:46)
+           "_get_phi_kernel_name"]
 
 
 class PrecisionType:
@@ -24,6 +33,46 @@ class PrecisionType:
     Half = "float16"
     Bfloat16 = "bfloat16"
     Int8 = "int8"
+
+
+class DataType:
+    """reference paddle_infer DataType enum (pybind/inference_api.cc)."""
+    FLOAT64 = "float64"
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT64 = "int64"
+    INT32 = "int32"
+    UINT8 = "uint8"
+    INT8 = "int8"
+    BOOL = "bool"
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    """reference: inference_api.cc GetNumBytesOfDataType."""
+    return int(np.dtype(
+        jnp.bfloat16 if str(dtype) == "bfloat16" else dtype).itemsize)
+
+
+def get_version() -> str:
+    """Framework version string (reference: paddle_infer::GetVersion)."""
+    from .. import version
+    return f"paddle_tpu version: {version.full_version}"
+
+
+def get_trt_compile_version():
+    """No TensorRT on TPU (deployment path = StableHLO/XLA AOT)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name: str) -> str:
+    """reference: maps fluid op names to PHI kernel names. The XLA
+    backend has no PHI registry; the op name is the kernel name."""
+    return op_name
 
 
 class PlaceType:
@@ -155,3 +204,123 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class PredictorPool:
+    """reference paddle_infer::services::PredictorPool — a main
+    predictor plus size-1 workers for thread-per-request serving.
+    Weights (immutable jax arrays) are shared; every pool member gets
+    its own IO handles so concurrent run() calls don't collide."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("PredictorPool size must be >= 1")
+        first = Predictor(config)
+        self._predictors = [first]
+        for _ in range(size - 1):
+            clone = Predictor.__new__(Predictor)
+            clone._model = first._model          # shared immutable weights
+            clone._n_inputs = first._n_inputs
+            clone._inputs = {f"x{i}": _IOHandle(f"x{i}")
+                             for i in range(first._n_inputs)}
+            clone._outputs = {}
+            self._predictors.append(clone)
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+    def __len__(self):
+        return len(self._predictors)
+
+
+class XpuConfig:
+    """reference XpuConfig (inference_api.cc): vendor-XPU knobs. On TPU
+    XLA owns device memory/streams, so these are recorded but inert."""
+
+    def __init__(self):
+        self.device_id = 0
+        self.l3_size = 0
+        self.l3_ptr = None
+        self.l3_autotune_size = 0
+        self.stream = None
+        self.conv_autotune_level = 0
+        self.fc_autotune_level = 0
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file,
+                               mixed_precision=PrecisionType.Half,
+                               backend=PlaceType.CPU, keep_io_types=True,
+                               black_list=None, white_list=None):
+    """Convert a saved fp32 model to mixed precision (reference:
+    python/paddle/inference/wrapper.py:98 over the C++
+    convert_to_mixed_precision pass).
+
+    TPU-native shape: the saved artifact is params (pdiparams) + an
+    optional jax.export StableHLO program (pdexport). Floating params
+    are cast to the target dtype and written to the mixed prefix —
+    halving storage/HBM for weights. When the archive reconstructs the
+    original Layer class, it then RUNS at the reduced precision; when
+    only the exported program is available, the program's baked compute
+    dtype is kept and TranslatedLayer casts the stored weights back at
+    the boundary (storage-only mixed precision — re-save with
+    input_spec under amp to bake reduced-precision compute).
+
+    black_list: parameter-name substrings kept at fp32 (the analogue of
+    the reference's per-op blacklist); white_list forces names in.
+    Model and params paths are honored independently (the reference
+    allows differing basenames, e.g. inference.pdmodel + params.pdiparams).
+    """
+    import pickle
+
+    def _with(p, suf):
+        """Full path for the given artifact: keep an explicit filename,
+        else treat p as a prefix."""
+        return p if p.endswith(suf) else p + suf
+
+    if mixed_precision == PrecisionType.Int8:
+        raise NotImplementedError(
+            "int8 deployment goes through paddle_tpu.quantization PTQ/"
+            "QAT, not convert_to_mixed_precision")
+    if mixed_precision == PrecisionType.Half:
+        target = np.float16
+    elif mixed_precision == PrecisionType.Bfloat16:
+        target = jnp.bfloat16
+    else:
+        raise ValueError(
+            f"mixed_precision must be PrecisionType.Half or .Bfloat16, "
+            f"got {mixed_precision!r} (a silent default would lossily "
+            "cast weights)")
+    black = set(black_list or ())
+    white = set(white_list or ())
+    src_model = _with(model_file, ".pdmodel")
+    src_params = _with(params_file or model_file, ".pdiparams")
+    dst_model = _with(mixed_model_file, ".pdmodel")
+    dst_params = _with(mixed_params_file or mixed_model_file, ".pdiparams")
+    with open(src_params, "rb") as f:
+        state = pickle.load(f)
+    with open(src_model, "rb") as f:
+        meta = pickle.load(f)
+
+    def keep_fp32(name):
+        return any(b in name for b in black) and \
+            not any(w in name for w in white)
+
+    cast = {}
+    for k, v in state.items():
+        arr = np.asarray(v)
+        if arr.dtype in (np.float32, np.float64) and not keep_fp32(k):
+            arr = np.asarray(jnp.asarray(arr, target))
+        cast[k] = arr
+    meta = dict(meta, mixed_precision=str(mixed_precision),
+                keep_io_types=bool(keep_io_types))
+    for p in (dst_model, dst_params):
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    with open(dst_params, "wb") as f:
+        pickle.dump(cast, f)
+    with open(dst_model, "wb") as f:
+        pickle.dump(meta, f)
+    src_export = src_model[:-len(".pdmodel")] + ".pdexport"
+    dst_export = dst_model[:-len(".pdmodel")] + ".pdexport"
+    if os.path.exists(src_export) and src_export != dst_export:
+        shutil.copyfile(src_export, dst_export)

@@ -156,6 +156,7 @@ class TranslatedLayer(Layer):
         super().__init__()
         self._state = state
         self._exported = exported
+        self._call_params = None   # aval-dtype-matched, built once
 
     def forward(self, *args):
         if self._exported is None:
@@ -163,10 +164,21 @@ class TranslatedLayer(Layer):
                 "TranslatedLayer: this archive has no exported program "
                 "(saved without input_spec); re-save with input_spec or "
                 "reconstruct the original class to run")
-        params = [unwrap(self._state[k]) for k in sorted(self._state)]
+        if self._call_params is None:
+            params = [unwrap(self._state[k]) for k in sorted(self._state)]
+            # params stored at a different precision than the exported
+            # program's avals (inference.convert_to_mixed_precision
+            # writes half/bf16 storage next to the unchanged program):
+            # cast back ONCE — the export's compute dtype is baked in,
+            # and re-casting per call would churn a full weight copy
+            # per request
+            avals = self._exported.in_avals[:len(params)]
+            self._call_params = [
+                p if p.dtype == a.dtype else jnp.asarray(p, a.dtype)
+                for p, a in zip(params, avals)]
         raws = [unwrap(a) if isinstance(a, Tensor) else jnp.asarray(a)
                 for a in args]
-        out = self._exported.call(*params, *raws)
+        out = self._exported.call(*self._call_params, *raws)
         return jax.tree_util.tree_map(Tensor, out)
 
 
@@ -259,6 +271,13 @@ def load(path, **configs):
             # the archive — a default-constructed container (Sequential())
             # would otherwise pass as an empty identity model
             if set(layer.state_dict().keys()) == set(state.keys()):
+                mixed = meta.get("mixed_precision")
+                if mixed:
+                    # a convert_to_mixed_precision archive must RUN at
+                    # the stored precision; set_state_dict alone would
+                    # cast the half/bf16 weights back up to the
+                    # freshly-built layer's fp32
+                    layer.to(dtype=mixed)
                 layer.set_state_dict({k: Tensor(jnp.asarray(v))
                                       for k, v in state.items()})
                 return layer

@@ -104,3 +104,131 @@ class TestDynamicBatchExport:
             out = loaded(x)
             assert out.shape == [b, 2]
             assert np.allclose(out.numpy(), net(x).numpy(), atol=1e-5)
+
+
+class TestInferenceAuxSurface:
+    """r5 additions (reference python/paddle/inference/__init__.py
+    __all__): DataType, PredictorPool, XpuConfig,
+    convert_to_mixed_precision, byte/version helpers."""
+
+    def test_datatype_and_bytes(self):
+        inf = pt.inference
+        assert inf.get_num_bytes_of_data_type(inf.DataType.FLOAT32) == 4
+        assert inf.get_num_bytes_of_data_type(inf.DataType.FLOAT16) == 2
+        assert inf.get_num_bytes_of_data_type(inf.DataType.BFLOAT16) == 2
+        assert inf.get_num_bytes_of_data_type(inf.DataType.INT64) == 8
+        assert inf.get_num_bytes_of_data_type(inf.DataType.BOOL) == 1
+
+    def test_versions(self):
+        assert "paddle_tpu" in pt.inference.get_version()
+        assert pt.inference.get_trt_compile_version() == (0, 0, 0)
+        assert pt.inference.get_trt_runtime_version() == (0, 0, 0)
+        assert pt.inference._get_phi_kernel_name("matmul") == "matmul"
+        pt.inference.XpuConfig().device_id = 1  # attr bag exists
+
+    def test_predictor_pool_shares_weights_separate_io(self, tmp_path):
+        net = _build()
+        path = str(tmp_path / "model")
+        pt.jit.save(net, path, input_spec=[pt.jit.InputSpec([None, 4],
+                                                            "float32")])
+        pool = pt.inference.PredictorPool(pt.inference.Config(path), 3)
+        assert len(pool) == 3
+        a, b = pool.retrieve(0), pool.retrieve(2)
+        assert a._model is b._model          # shared weights
+        assert a._inputs is not b._inputs    # private IO handles
+        xa, xb = np.random.randn(2, 4).astype(np.float32), \
+            np.random.randn(5, 4).astype(np.float32)
+        ra = a.run([xa])[0]
+        rb = b.run([xb])[0]
+        assert ra.shape == (2, 2) and rb.shape == (5, 2)
+        assert np.allclose(ra, net(pt.to_tensor(xa)).numpy(), atol=1e-5)
+        assert np.allclose(rb, net(pt.to_tensor(xb)).numpy(), atol=1e-5)
+
+    def test_convert_to_mixed_precision_half_storage(self, tmp_path):
+        import pickle
+        net = _build()
+        x = pt.randn([3, 4])
+        ref = net(x).numpy()
+        src = str(tmp_path / "fp32")
+        dst = str(tmp_path / "sub" / "half")
+        pt.jit.save(net, src, input_spec=[pt.jit.InputSpec([3, 4],
+                                                           "float32")])
+        pt.inference.convert_to_mixed_precision(
+            src + ".pdmodel", src + ".pdiparams",
+            dst + ".pdmodel", dst + ".pdiparams",
+            pt.inference.PrecisionType.Half, pt.inference.PlaceType.CPU,
+            black_list={"0.bias"})
+        state = pickle.load(open(dst + ".pdiparams", "rb"))
+        kinds = {k: v.dtype for k, v in state.items()}
+        assert all(v == np.float16 for k, v in kinds.items()
+                   if "0.bias" not in k), kinds
+        assert kinds[[k for k in kinds if "0.bias" in k][0]] == np.float32
+        # the mixed archive still RUNS (TranslatedLayer casts at the
+        # boundary of the exported program) and matches fp32 to half tol
+        pred = pt.inference.create_predictor(pt.inference.Config(dst))
+        out = pred.run([x.numpy()])[0]
+        assert np.allclose(out, ref, atol=2e-2), np.abs(out - ref).max()
+
+    def test_convert_bf16_via_reconstructed_class(self, tmp_path):
+        """With no exported program the archive reconstructs the class
+        when possible; a paddle_tpu-builtin Sequential won't match an
+        anonymous test net, so this exercises the params-only path."""
+        import pickle
+        net = _build()
+        src = str(tmp_path / "fp32")
+        dst = str(tmp_path / "bf16")
+        pt.jit.save(net, src)     # no input_spec -> params + meta only
+        pt.inference.convert_to_mixed_precision(
+            src + ".pdmodel", src + ".pdiparams",
+            dst + ".pdmodel", dst + ".pdiparams",
+            pt.inference.PrecisionType.Bfloat16, pt.inference.PlaceType.CPU)
+        state = pickle.load(open(dst + ".pdiparams", "rb"))
+        import ml_dtypes
+        assert all(v.dtype == ml_dtypes.bfloat16 for v in state.values())
+        meta = pickle.load(open(dst + ".pdmodel", "rb"))
+        assert meta["mixed_precision"] == "bfloat16"
+
+    def test_convert_mixed_reconstructed_class_runs_reduced(self, tmp_path):
+        """When the archive reconstructs the original class (LeNet has a
+        no-arg ctor), a mixed archive must RUN at the stored precision,
+        not get silently cast back up to fp32 by set_state_dict."""
+        net = pt.vision.models.LeNet()
+        src, dst = str(tmp_path / "fp32"), str(tmp_path / "half")
+        pt.jit.save(net, src)
+        pt.inference.convert_to_mixed_precision(
+            src + ".pdmodel", src + ".pdiparams",
+            dst + ".pdmodel", dst + ".pdiparams",
+            pt.inference.PrecisionType.Half, pt.inference.PlaceType.CPU)
+        loaded = pt.jit.load(dst)
+        assert type(loaded).__name__ == "LeNet"   # reconstruction path
+        for k, v in loaded.state_dict().items():
+            assert v.dtype == pt.float16, (k, v.dtype)
+        x = pt.randn([2, 1, 28, 28]).astype("float16")
+        out = loaded(x)
+        assert out.shape == [2, 10]
+        ref = net(pt.randn([2, 1, 28, 28]))  # just shape/health reference
+        assert np.isfinite(out.numpy()).all() and ref.shape == out.shape
+
+    def test_convert_rejects_silent_lossy_default(self, tmp_path):
+        net = _build()
+        src = str(tmp_path / "fp32")
+        pt.jit.save(net, src)
+        with pytest.raises(ValueError, match="Half or .Bfloat16"):
+            pt.inference.convert_to_mixed_precision(
+                src + ".pdmodel", src + ".pdiparams",
+                src + "x.pdmodel", src + "x.pdiparams",
+                pt.inference.PrecisionType.Float32,
+                pt.inference.PlaceType.CPU)
+
+    def test_convert_honors_distinct_basenames(self, tmp_path):
+        import pickle
+        net = _build()
+        d = tmp_path / "m"; d.mkdir()
+        pt.jit.save(net, str(d / "inference"))
+        (d / "inference.pdiparams").rename(d / "weights.pdiparams")
+        pt.inference.convert_to_mixed_precision(
+            str(d / "inference.pdmodel"), str(d / "weights.pdiparams"),
+            str(d / "out.pdmodel"), str(d / "mixed_w.pdiparams"),
+            pt.inference.PrecisionType.Half, pt.inference.PlaceType.CPU)
+        state = pickle.load(open(d / "mixed_w.pdiparams", "rb"))
+        assert all(v.dtype == np.float16 for v in state.values())
