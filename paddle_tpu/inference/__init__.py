@@ -158,9 +158,22 @@ class Predictor:
             n_state = len(self._model._state)
             n_in = len(exported.in_avals) - n_state
         self._n_inputs = n_in if n_in is not None else 1
+        self._init_io()
+
+    def _init_io(self):
         self._inputs = {f"x{i}": _IOHandle(f"x{i}")
                         for i in range(self._n_inputs)}
         self._outputs = {}
+
+    @classmethod
+    def _share_from(cls, other: "Predictor") -> "Predictor":
+        """Pool worker: shares the (immutable) loaded model, owns its IO
+        handles. Single construction path — a new Predictor field is
+        either copied here or the clone fails loudly, not at retrieve()."""
+        self = cls.__new__(cls)
+        self.__dict__.update(other.__dict__)
+        self._init_io()
+        return self
 
     def get_input_names(self):
         return list(self._inputs)
@@ -216,15 +229,8 @@ class PredictorPool:
         if size < 1:
             raise ValueError("PredictorPool size must be >= 1")
         first = Predictor(config)
-        self._predictors = [first]
-        for _ in range(size - 1):
-            clone = Predictor.__new__(Predictor)
-            clone._model = first._model          # shared immutable weights
-            clone._n_inputs = first._n_inputs
-            clone._inputs = {f"x{i}": _IOHandle(f"x{i}")
-                             for i in range(first._n_inputs)}
-            clone._outputs = {}
-            self._predictors.append(clone)
+        self._predictors = [first] + [Predictor._share_from(first)
+                                      for _ in range(size - 1)]
 
     def retrieve(self, idx: int) -> Predictor:
         return self._predictors[idx]
@@ -274,9 +280,15 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
     import pickle
 
     def _with(p, suf):
-        """Full path for the given artifact: keep an explicit filename,
-        else treat p as a prefix."""
-        return p if p.endswith(suf) else p + suf
+        """Full path for the given artifact: keep an explicit filename;
+        strip the OTHER artifact's suffix first so a model_file serving
+        as params fallback yields x.pdiparams, not x.pdmodel.pdiparams."""
+        if p.endswith(suf):
+            return p
+        for other in (".pdmodel", ".pdiparams"):
+            if p.endswith(other):
+                p = p[:-len(other)]
+        return p + suf
 
     if mixed_precision == PrecisionType.Int8:
         raise NotImplementedError(
@@ -285,7 +297,8 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
     if mixed_precision == PrecisionType.Half:
         target = np.float16
     elif mixed_precision == PrecisionType.Bfloat16:
-        target = jnp.bfloat16
+        import ml_dtypes
+        target = ml_dtypes.bfloat16   # a real numpy dtype: host-side cast
     else:
         raise ValueError(
             f"mixed_precision must be PrecisionType.Half or .Bfloat16, "
@@ -310,7 +323,9 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
     for k, v in state.items():
         arr = np.asarray(v)
         if arr.dtype in (np.float32, np.float64) and not keep_fp32(k):
-            arr = np.asarray(jnp.asarray(arr, target))
+            # host-side cast: a storage conversion must not round-trip
+            # every weight through the accelerator
+            arr = arr.astype(target)
         cast[k] = arr
     meta = dict(meta, mixed_precision=str(mixed_precision),
                 keep_io_types=bool(keep_io_types))

@@ -271,15 +271,20 @@ def load(path, **configs):
             # the archive — a default-constructed container (Sequential())
             # would otherwise pass as an empty identity model
             if set(layer.state_dict().keys()) == set(state.keys()):
-                mixed = meta.get("mixed_precision")
-                if mixed:
+                if meta.get("mixed_precision"):
                     # a convert_to_mixed_precision archive must RUN at
-                    # the stored precision; set_state_dict alone would
-                    # cast the half/bf16 weights back up to the
-                    # freshly-built layer's fp32
-                    layer.to(dtype=mixed)
-                layer.set_state_dict({k: Tensor(jnp.asarray(v))
-                                      for k, v in state.items()})
+                    # the STORED per-key precision: black_listed params
+                    # stay fp32 while the rest are half/bf16, so
+                    # neither set_state_dict (casts to the fresh
+                    # layer's fp32) nor a uniform .to(mixed) (casts
+                    # the protected fp32 params down) is right —
+                    # adopt each stored array's dtype directly
+                    own = layer.state_dict()
+                    for k, v in state.items():
+                        own[k]._replace(jnp.asarray(v))
+                else:
+                    layer.set_state_dict({k: Tensor(jnp.asarray(v))
+                                          for k, v in state.items()})
                 return layer
         except TypeError:
             pass

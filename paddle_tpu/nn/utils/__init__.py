@@ -90,3 +90,7 @@ def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=Non
     layer.register_forward_pre_hook(_compute)
     _compute(layer, None)
     return layer
+
+
+# reference nn/utils/__init__.py re-exports the clip helpers
+from ..clip import clip_grad_norm_, clip_grad_value_  # noqa: E402,F401

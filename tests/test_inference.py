@@ -209,6 +209,39 @@ class TestInferenceAuxSurface:
         ref = net(pt.randn([2, 1, 28, 28]))  # just shape/health reference
         assert np.isfinite(out.numpy()).all() and ref.shape == out.shape
 
+    def test_convert_blacklist_survives_class_reconstruction(self, tmp_path):
+        """Per-key precision must survive the reconstructed-class load:
+        black_listed params stay fp32 while the rest run fp16 (a
+        uniform .to(mixed) would downcast the protected ones)."""
+        net = pt.vision.models.LeNet()
+        src, dst = str(tmp_path / "fp32"), str(tmp_path / "mix")
+        pt.jit.save(net, src)
+        pt.inference.convert_to_mixed_precision(
+            src + ".pdmodel", src + ".pdiparams",
+            dst + ".pdmodel", dst + ".pdiparams",
+            pt.inference.PrecisionType.Half, pt.inference.PlaceType.CPU,
+            black_list={"bias"})
+        loaded = pt.jit.load(dst)
+        assert type(loaded).__name__ == "LeNet"
+        dts = {k: v.dtype for k, v in loaded.state_dict().items()}
+        assert any("bias" in k for k in dts)
+        for k, d in dts.items():
+            want = pt.float32 if "bias" in k else pt.float16
+            assert d == want, (k, d)
+
+    def test_convert_params_fallback_strips_model_suffix(self, tmp_path):
+        """params_file=None falls back to the model prefix — it must
+        read x.pdiparams, not x.pdmodel.pdiparams."""
+        import pickle
+        net = _build()
+        src = str(tmp_path / "m")
+        pt.jit.save(net, src)
+        pt.inference.convert_to_mixed_precision(
+            src + ".pdmodel", None, str(tmp_path / "o.pdmodel"), None,
+            pt.inference.PrecisionType.Half, pt.inference.PlaceType.CPU)
+        state = pickle.load(open(tmp_path / "o.pdiparams", "rb"))
+        assert all(v.dtype == np.float16 for v in state.values())
+
     def test_convert_rejects_silent_lossy_default(self, tmp_path):
         net = _build()
         src = str(tmp_path / "fp32")

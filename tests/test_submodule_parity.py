@@ -18,6 +18,7 @@ MODULES = [
     ("nn/__init__.py", "paddle_tpu.nn"),
     ("nn/functional/__init__.py", "paddle_tpu.nn.functional"),
     ("nn/initializer/__init__.py", "paddle_tpu.nn.initializer"),
+    ("nn/utils/__init__.py", "paddle_tpu.nn.utils"),
     ("linalg.py", "paddle_tpu.linalg"),
     ("fft.py", "paddle_tpu.fft"),
     ("signal.py", "paddle_tpu.signal"),
