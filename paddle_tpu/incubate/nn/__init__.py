@@ -340,3 +340,11 @@ class FusedMultiTransformer(Layer):
             epsilon=self.epsilon, dropout_rate=self.dropout_rate,
             activation=self.activation,
             training=self.training, cache_kvs=caches, attn_mask=attn_mask)
+
+
+# xformers-style memory-efficient attention. SUBMODULE bindings only —
+# re-exporting the function would shadow the module and break the
+# reference-style `paddle.incubate.nn.memory_efficient_attention.
+# memory_efficient_attention(...)` access path.
+from . import attn_bias  # noqa: E402,F401
+from . import memory_efficient_attention  # noqa: E402,F401

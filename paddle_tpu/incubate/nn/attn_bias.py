@@ -1,0 +1,283 @@
+"""xformers-style attention-bias types (reference:
+python/paddle/incubate/nn/attn_bias.py — itself the xformers
+AttentionBias hierarchy). These describe STRUCTURED masks so
+memory_efficient_attention can route each to the right TPU kernel
+instead of materializing an O(S^2) bias:
+
+  * LowerTriangularMask            -> causal flash kernel
+  * BlockDiagonal(Causal)Mask      -> varlen segment-id pallas kernel
+  * *WithTensorBias / padded-keys  -> XLA path with the materialized mask
+
+materialize() is provided for every type (it IS the spec of the mask),
+built functionally from interval/segment comparisons — no in-place
+slice writes, so it traces under jit.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, unwrap
+
+__all__ = [
+    "AttentionBias",
+    "LowerTriangularMask",
+    "LowerTriangularMaskWithTensorBias",
+    "SeqLenInfo",
+    "PaddedSeqLenInfo",
+    "BlockDiagonalMask",
+    "BlockDiagonalCausalMask",
+    "BlockDiagonalCausalWithOffsetPaddedKeysMask",
+]
+
+_NEG_INF = float("-inf")
+
+
+def _as_np_dtype(dtype):
+    if str(dtype) == "bfloat16":
+        import ml_dtypes
+        return ml_dtypes.bfloat16
+    return np.dtype(str(dtype))
+
+
+def _finish(mask_2d, shape, dtype):
+    """Broadcast a (Sq, Sk) mask to the requested shape as a Tensor."""
+    m = jnp.asarray(mask_2d, _as_np_dtype(dtype))
+    for _ in range(len(shape) - 2):
+        m = m[None]
+    return Tensor(jnp.broadcast_to(m, tuple(shape)))
+
+
+class AttentionBias(ABC):
+    @abstractmethod
+    def materialize(self, shape, dtype="float32"):
+        """Additive bias tensor of `shape` (0 where attending is allowed,
+        -inf where blocked)."""
+
+
+class LowerTriangularMask(AttentionBias):
+    def materialize(self, shape, dtype="float32"):
+        sq, sk = shape[-2], shape[-1]
+        m = np.where(np.tril(np.ones((sq, sk), bool)), 0.0, _NEG_INF)
+        return _finish(m.astype(np.float32), shape, dtype)
+
+    def add_bias(self, bias):
+        return LowerTriangularMaskWithTensorBias(bias)
+
+
+class LowerTriangularMaskWithTensorBias(LowerTriangularMask):
+    def __init__(self, bias):
+        self._bias = bias
+
+    def materialize(self, shape, dtype="float32"):
+        base = unwrap(super().materialize(shape, dtype))
+        return Tensor(base + jnp.asarray(unwrap(self._bias),
+                                         base.dtype))
+
+
+@dataclass
+class SeqLenInfo:
+    """Prefix-sum description of packed sequences (xformers SeqLenInfo):
+    seqstart[i] is the token offset where sequence i begins."""
+    seqstart: Tensor
+    max_seqlen: int
+    seqstart_py: list
+
+    def intervals(self):
+        yield from zip(self.seqstart_py, self.seqstart_py[1:])
+
+    @classmethod
+    def from_seqlens(cls, seqlens):
+        seqstart_py = [0]
+        max_seqlen = -1
+        for s in seqlens:
+            max_seqlen = max(max_seqlen, int(s))
+            seqstart_py.append(seqstart_py[-1] + int(s))
+        return cls(seqstart=Tensor(jnp.asarray(seqstart_py, jnp.int32)),
+                   max_seqlen=max_seqlen, seqstart_py=seqstart_py)
+
+    def seg_ids(self):
+        """(total,) int32 segment id per packed token — the varlen
+        kernel's native mask representation."""
+        lens = np.diff(self.seqstart_py)
+        return np.repeat(np.arange(len(lens)), lens).astype(np.int32)
+
+    def split(self, x, batch_sizes=None):
+        assert self.seqstart_py[-1] == x.shape[1] and x.shape[0] == 1, \
+            "split expects the packed (1, total, ...) layout"
+        if batch_sizes is None:
+            batch_sizes = [1] * (len(self.seqstart_py) - 1)
+        raw = unwrap(x)
+        out, it = [], 0
+        for bs in batch_sizes:
+            start = self.seqstart_py[it]
+            stop = self.seqstart_py[it + bs]
+            chunk = raw[:, start:stop]
+            out.append(Tensor(chunk.reshape(bs, -1, *chunk.shape[2:])))
+            it += bs
+        return out
+
+
+@dataclass
+class PaddedSeqLenInfo(SeqLenInfo):
+    """Blocks padded to a fixed stride; seqlen holds each block's ACTUAL
+    length (serving KV-page layout)."""
+    seqlen: Optional[Tensor] = None
+    seqlen_py: Sequence = ()
+
+    def intervals(self):
+        for (start, _), length in zip(
+                zip(self.seqstart_py, self.seqstart_py[1:]),
+                self.seqlen_py):
+            yield start, start + int(length)
+
+    @classmethod
+    def from_seqlens(cls, seqlens):
+        raise NotImplementedError(
+            "use SeqLenInfo.from_seqlens or "
+            "PaddedSeqLenInfo.from_seqlens_padded")
+
+    @classmethod
+    def from_seqlens_padded(cls, seqlens, padding):
+        assert all(int(s) <= padding for s in seqlens)
+        seqstart_py = list(range(0, len(seqlens) * padding + 1, padding))
+        return cls(seqstart=Tensor(jnp.asarray(seqstart_py, jnp.int32)),
+                   max_seqlen=max(int(s) for s in seqlens),
+                   seqstart_py=seqstart_py,
+                   seqlen=Tensor(jnp.asarray(list(seqlens), jnp.int32)),
+                   seqlen_py=list(seqlens))
+
+    def split(self, x, batch_sizes=None):
+        raise NotImplementedError
+
+
+@dataclass
+class BlockDiagonalMask(AttentionBias):
+    q_seqinfo: SeqLenInfo
+    k_seqinfo: SeqLenInfo
+    _batch_sizes: Optional[Sequence] = None
+
+    _causal = False
+
+    def materialize(self, shape, dtype="float32"):
+        assert shape[-1] == self.k_seqinfo.seqstart_py[-1]
+        assert shape[-2] == self.q_seqinfo.seqstart_py[-1]
+        # segment-id comparison instead of per-block slice writes
+        seg_q = self.q_seqinfo.seg_ids()
+        seg_k = self.k_seqinfo.seg_ids()
+        allowed = seg_q[:, None] == seg_k[None, :]
+        if self._causal:
+            # within-block causal: position inside own sequence
+            pos_q = np.arange(len(seg_q)) - np.asarray(
+                self.q_seqinfo.seqstart_py)[seg_q]
+            pos_k = np.arange(len(seg_k)) - np.asarray(
+                self.k_seqinfo.seqstart_py)[seg_k]
+            allowed &= pos_k[None, :] <= pos_q[:, None]
+        m = np.where(allowed, 0.0, _NEG_INF).astype(np.float32)
+        return _finish(m, shape, dtype)
+
+    @classmethod
+    def from_seqlens(cls, q_seqlen, kv_seqlen=None):
+        assert kv_seqlen is None or len(q_seqlen) == len(kv_seqlen)
+        q_seqinfo = SeqLenInfo.from_seqlens(q_seqlen)
+        if kv_seqlen is None or list(q_seqlen) == list(kv_seqlen):
+            k_seqinfo = q_seqinfo
+        else:
+            k_seqinfo = SeqLenInfo.from_seqlens(kv_seqlen)
+        return cls(q_seqinfo=q_seqinfo, k_seqinfo=k_seqinfo)
+
+    @classmethod
+    def from_tensor_list(cls, tensors):
+        batch_sizes = [t.shape[0] for t in tensors]
+        seqlens = []
+        for x in tensors:
+            seqlens.extend([x.shape[1]] * x.shape[0])
+        block = cls.from_seqlens(seqlens)
+        block._batch_sizes = batch_sizes
+        packed = jnp.concatenate(
+            [unwrap(x).reshape(1, -1, *x.shape[2:]) for x in tensors],
+            axis=1)
+        return block, Tensor(packed)
+
+    @classmethod
+    def from_tensor_lists_qkv(cls, tensors_q, tensors_k, tensors_v=None):
+        assert len(tensors_q) == len(tensors_k)
+        q_seqlens, kv_seqlens = [], []
+        for q, k in zip(tensors_q, tensors_k):
+            assert q.shape[0] == k.shape[0]
+            q_seqlens.extend([q.shape[1]] * q.shape[0])
+            kv_seqlens.extend([k.shape[1]] * k.shape[0])
+        block = cls.from_seqlens(q_seqlens, kv_seqlens)
+        block._batch_sizes = [x.shape[0] for x in tensors_q]
+
+        def pack(ts):
+            return Tensor(jnp.concatenate(
+                [unwrap(x).reshape(1, -1, *x.shape[2:]) for x in ts],
+                axis=1))
+
+        return (block, pack(tensors_q), pack(tensors_k),
+                pack(tensors_v) if tensors_v is not None else None)
+
+    def split_queries(self, tensor):
+        return self.q_seqinfo.split(tensor, self._batch_sizes)
+
+    def split_kv(self, tensor):
+        return self.k_seqinfo.split(tensor, self._batch_sizes)
+
+    def split(self, tensor):
+        assert self.q_seqinfo is self.k_seqinfo
+        return self.q_seqinfo.split(tensor, self._batch_sizes)
+
+    def make_causal(self):
+        return BlockDiagonalCausalMask(q_seqinfo=self.q_seqinfo,
+                                       k_seqinfo=self.k_seqinfo,
+                                       _batch_sizes=self._batch_sizes)
+
+
+@dataclass
+class BlockDiagonalCausalMask(BlockDiagonalMask):
+    _causal = True
+
+
+@dataclass
+class BlockDiagonalCausalWithOffsetPaddedKeysMask(AttentionBias):
+    """Per-block causal attention against PADDED key pages whose real
+    lengths live in k_seqinfo.seqlen — the serving decode/verify layout
+    (the paged-attention kernel serves the compiled engine; this type
+    is the eager/offline spec of the same mask)."""
+    q_seqinfo: SeqLenInfo
+    k_seqinfo: PaddedSeqLenInfo
+    causal_diagonal: Optional[Tensor] = None
+
+    @classmethod
+    def from_seqlens(cls, q_seqlen, kv_padding, kv_seqlen,
+                     causal_diagonal=None):
+        """reference attn_bias.py:265 — the canonical constructor."""
+        assert kv_seqlen is None or len(q_seqlen) == len(kv_seqlen)
+        return cls(q_seqinfo=SeqLenInfo.from_seqlens(q_seqlen),
+                   k_seqinfo=PaddedSeqLenInfo.from_seqlens_padded(
+                       kv_seqlen, kv_padding),
+                   causal_diagonal=causal_diagonal)
+
+    def materialize(self, shape, dtype="float32"):
+        assert shape[-1] == self.k_seqinfo.seqstart_py[-1]
+        assert shape[-2] == self.q_seqinfo.seqstart_py[-1]
+        tq = self.q_seqinfo.seqstart_py[-1]
+        tk = self.k_seqinfo.seqstart_py[-1]
+        m = np.full((tq, tk), _NEG_INF, np.float32)
+        diag = (np.asarray(unwrap(self.causal_diagonal)).tolist()
+                if self.causal_diagonal is not None else None)
+        for i, ((qs, qe), (ks, ke)) in enumerate(zip(
+                self.q_seqinfo.intervals(), self.k_seqinfo.intervals())):
+            nq, nk = qe - qs, ke - ks
+            off = int(diag[i]) if diag is not None else 0
+            # reference semantics: triu(full(-inf), diagonal=1+off) —
+            # allowed (0) where k - q <= off, TOP-left aligned
+            block = np.where(np.tril(np.ones((nq, nk), bool), k=off),
+                             0.0, _NEG_INF)
+            m[qs:qe, ks:ke] = block
+        return _finish(m, shape, dtype)

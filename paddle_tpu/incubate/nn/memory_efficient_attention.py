@@ -1,0 +1,164 @@
+"""memory_efficient_attention (reference:
+python/paddle/incubate/nn/memory_efficient_attention.py — the xformers
+API over a CUDA kernel).
+
+TPU-native routing — the bias TYPE picks the kernel, so the O(S^2)
+bias is only ever materialized when the caller hands us an arbitrary
+tensor bias:
+
+  bias type                                   | path
+  --------------------------------------------+------------------------
+  None                                        | flash kernel
+  LowerTriangularMask                         | flash kernel, causal
+  BlockDiagonalMask / BlockDiagonalCausalMask | varlen segment kernel
+                                              | (one call, no padding)
+  Tensor / LowerTriangularMaskWithTensorBias  | XLA attention + bias
+  BlockDiagonalCausalWithOffsetPaddedKeysMask | XLA attention with the
+                                              | materialized block mask
+                                              | (the compiled serving
+                                              | engine runs this shape
+                                              | on the paged kernel)
+
+query/key/value: (B, S, H, D); GQA (fewer KV heads) is repeated up.
+Dropout p follows the reference kernel's semantics (drops attention
+probabilities) via the flash/varlen wrappers' dropout path.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, unwrap
+from ..._core.tensor import apply
+from .attn_bias import (
+    BlockDiagonalCausalMask,
+    BlockDiagonalCausalWithOffsetPaddedKeysMask,
+    BlockDiagonalMask,
+    LowerTriangularMask,
+    LowerTriangularMaskWithTensorBias,
+)
+
+__all__ = ["memory_efficient_attention"]
+
+SUPPORTED_ATTN_BIAS_TYPES = {
+    type(None),
+    Tensor,
+    LowerTriangularMask,
+    LowerTriangularMaskWithTensorBias,
+    BlockDiagonalMask,
+    BlockDiagonalCausalMask,
+    BlockDiagonalCausalWithOffsetPaddedKeysMask,
+}
+
+
+def _xla_bias_attention(query, key, value, bias, p, scale, training):
+    """Generic additive-bias attention: natively differentiable, XLA
+    fuses the chain; used only when the mask is an arbitrary tensor."""
+    def fn(q, k, v, b):
+        qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+        kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+        vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+        hq, hk = qh.shape[1], kh.shape[1]
+        if hk != hq:
+            kh = jnp.repeat(kh, hq // hk, axis=1)
+            vh = jnp.repeat(vh, hq // hk, axis=1)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+        bf = jnp.asarray(b, jnp.float32)
+        finite = jnp.isfinite(bf)
+        # clamp -inf to a large finite negative BEFORE softmax: an -inf
+        # row makes the softmax vjp emit NaN that poisons ALL dk/dv even
+        # though the forward where() looks clean (same convention as
+        # nn/functional scaled_dot_product_attention)
+        s = s + jnp.where(finite, bf, -1e30)
+        pm = jax.nn.softmax(s, axis=-1)
+        # fully-masked query rows output 0, not the uniform average the
+        # clamped softmax would give
+        pm = jnp.where(finite.any(-1, keepdims=True), pm, 0.0)
+        if p > 0.0 and training:
+            from ..._core.state import prng
+            keep = jax.random.bernoulli(prng.next_key(), 1.0 - p, pm.shape)
+            pm = jnp.where(keep, pm / (1.0 - p), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", pm, vh)
+        return jnp.swapaxes(o, 1, 2).astype(q.dtype)
+
+    return apply(fn, query, key, value, bias,
+                 name="memory_efficient_attention")
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    assert type(attn_bias) in SUPPORTED_ATTN_BIAS_TYPES, \
+        f"unsupported attn_bias type {type(attn_bias)}"
+    d = query.shape[-1]
+    # scale=0 (or negative) is legal and meaningful — only None defaults
+    sc = 1.0 / math.sqrt(d) if scale is None else scale
+
+    if isinstance(attn_bias, (BlockDiagonalMask,)):
+        # packed varlen: ONE segment-kernel call, no padding, no S^2 mask
+        assert query.shape[0] == 1, \
+            "block-diagonal biases expect the packed (1, total, H, D) layout"
+        from ...ops.varlen_attention import flash_attn_unpadded
+        causal = isinstance(attn_bias, BlockDiagonalCausalMask)
+        if causal and (attn_bias.q_seqinfo.seqstart_py
+                       != attn_bias.k_seqinfo.seqstart_py):
+            # per-block causal with UNEQUAL q/k lengths: the varlen
+            # kernel's causal is bottom-right aligned, xformers' is
+            # top-left — only equal-length blocks agree
+            tq, tk = query.shape[1], key.shape[1]
+            h = query.shape[2]
+            bias = attn_bias.materialize((1, h, tq, tk), dtype="float32")
+            return _xla_bias_attention(query, key, value, bias, p, sc,
+                                       training)
+        cu_q = unwrap(attn_bias.q_seqinfo.seqstart)
+        cu_k = unwrap(attn_bias.k_seqinfo.seqstart)
+
+        def fn(q, k, v):
+            out, _ = flash_attn_unpadded(
+                q[0], k[0], v[0], cu_q, cu_k,
+                attn_bias.q_seqinfo.max_seqlen,
+                attn_bias.k_seqinfo.max_seqlen,
+                scale=sc, dropout=p, causal=causal, training=training)
+            return out[None]
+
+        return apply(fn, query, key, value,
+                     name="memory_efficient_attention")
+
+    if isinstance(attn_bias, LowerTriangularMaskWithTensorBias):
+        b, s_q, h = query.shape[0], query.shape[1], query.shape[2]
+        s_k = key.shape[1]
+        bias = attn_bias.materialize((b, h, s_q, s_k), dtype="float32")
+        return _xla_bias_attention(query, key, value, bias, p, sc, training)
+
+    if isinstance(attn_bias, BlockDiagonalCausalWithOffsetPaddedKeysMask):
+        assert query.shape[0] == 1, \
+            "padded-keys bias expects the packed (1, total, H, D) layout"
+        b, s_q, h = query.shape[0], query.shape[1], query.shape[2]
+        s_k = key.shape[1]
+        bias = attn_bias.materialize((b, h, s_q, s_k), dtype="float32")
+        return _xla_bias_attention(query, key, value, bias, p, sc, training)
+
+    if isinstance(attn_bias, Tensor):
+        return _xla_bias_attention(query, key, value, attn_bias, p, sc,
+                                   training)
+
+    causal = isinstance(attn_bias, LowerTriangularMask)
+    if causal and query.shape[1] != key.shape[1]:
+        # xformers' LowerTriangularMask is TOP-LEFT aligned; the flash
+        # kernel's causal mode is bottom-right (paddle convention).
+        # They agree iff Sq == Sk — rectangular goes via the bias path.
+        b, s_q, h = query.shape[0], query.shape[1], query.shape[2]
+        bias = attn_bias.materialize((b, h, s_q, key.shape[1]),
+                                     dtype="float32")
+        return _xla_bias_attention(query, key, value, bias, p, sc, training)
+
+    # None, or square LowerTriangularMask -> dense flash kernel
+    from ...ops.flash_attention import flash_attention as _flash
+
+    def fn(q, k, v):
+        out, _ = _flash(q, k, v, dropout=p, causal=causal,
+                        sm_scale=sc, training=training)
+        return out
+
+    return apply(fn, query, key, value, name="memory_efficient_attention")

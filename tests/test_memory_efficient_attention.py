@@ -1,0 +1,273 @@
+"""memory_efficient_attention + attn_bias (reference:
+python/paddle/incubate/nn/{memory_efficient_attention,attn_bias}.py —
+the xformers surface). Every structured bias is checked against the
+dense attention computed from its OWN materialize() output, so the
+kernel routing (flash / varlen segment kernel / XLA-bias) and the mask
+spec are verified against each other.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.nn.attn_bias import (
+    BlockDiagonalCausalMask,
+    BlockDiagonalCausalWithOffsetPaddedKeysMask,
+    BlockDiagonalMask,
+    LowerTriangularMask,
+    LowerTriangularMaskWithTensorBias,
+    PaddedSeqLenInfo,
+    SeqLenInfo,
+)
+from paddle_tpu.incubate.nn.memory_efficient_attention import (
+    memory_efficient_attention,
+)
+
+
+def _rand(*shape):
+    return pt.to_tensor(
+        (np.random.RandomState(sum(shape)).randn(*shape) * 0.3)
+        .astype(np.float32))
+
+
+def _dense_ref(q, k, v, bias_2d):
+    """Reference attention from a materialized additive bias."""
+    qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+    b, sq, h, d = qn.shape
+    sk = kn.shape[1]
+    out = np.empty_like(qn)
+    bias = np.asarray(bias_2d, np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            s = (qn[bi, :, hi] @ kn[bi, :, hi].T) / math.sqrt(d)
+            s = s + bias
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p = np.where(np.isfinite(s).any(-1, keepdims=True),
+                         p / p.sum(-1, keepdims=True), 0.0)
+            out[bi, :, hi] = p @ vn[bi, :, hi]
+    return out
+
+
+class TestSeqLenInfo:
+    def test_from_seqlens_and_intervals(self):
+        info = SeqLenInfo.from_seqlens([3, 5, 2])
+        assert info.seqstart_py == [0, 3, 8, 10]
+        assert info.max_seqlen == 5
+        assert list(info.intervals()) == [(0, 3), (3, 8), (8, 10)]
+
+    def test_split_round_trip(self):
+        info = SeqLenInfo.from_seqlens([3, 5])
+        x = _rand(1, 8, 2, 4)
+        a, b = info.split(x)
+        assert a.shape == [1, 3, 2, 4] and b.shape == [1, 5, 2, 4]
+        assert np.allclose(np.concatenate(
+            [a.numpy().reshape(1, -1, 2, 4), b.numpy().reshape(1, -1, 2, 4)],
+            axis=1), x.numpy())
+
+    def test_padded(self):
+        info = PaddedSeqLenInfo.from_seqlens_padded([2, 3], padding=4)
+        assert info.seqstart_py == [0, 4, 8]
+        assert list(info.intervals()) == [(0, 2), (4, 7)]
+        with pytest.raises(NotImplementedError):
+            PaddedSeqLenInfo.from_seqlens([1])
+
+
+class TestMaterialize:
+    def test_lower_triangular(self):
+        m = LowerTriangularMask().materialize((1, 1, 4, 4)).numpy()
+        assert (np.isfinite(m[0, 0]) == np.tril(np.ones((4, 4),
+                                                        bool))).all()
+
+    def test_block_diagonal(self):
+        mask = BlockDiagonalMask.from_seqlens([2, 3])
+        m = mask.materialize((5, 5)).numpy()
+        fin = np.isfinite(m)
+        want = np.zeros((5, 5), bool)
+        want[:2, :2] = True
+        want[2:, 2:] = True
+        assert (fin == want).all()
+
+    def test_block_diagonal_causal(self):
+        mask = BlockDiagonalMask.from_seqlens([2, 2]).make_causal()
+        assert isinstance(mask, BlockDiagonalCausalMask)
+        fin = np.isfinite(mask.materialize((4, 4)).numpy())
+        want = np.zeros((4, 4), bool)
+        want[0, 0] = want[1, 0] = want[1, 1] = True
+        want[2, 2] = want[3, 2] = want[3, 3] = True
+        assert (fin == want).all()
+
+    def test_padded_keys_offset(self):
+        mask = BlockDiagonalCausalWithOffsetPaddedKeysMask(
+            q_seqinfo=SeqLenInfo.from_seqlens([1, 1]),
+            k_seqinfo=PaddedSeqLenInfo.from_seqlens_padded([3, 2], 4),
+            causal_diagonal=pt.to_tensor(np.array([2, 1], np.int32)))
+        fin = np.isfinite(mask.materialize((2, 8)).numpy())
+        want = np.zeros((2, 8), bool)
+        want[0, :3] = True       # q0: keys 0..2 (offset 2, len 3)
+        want[1, 4:6] = True      # q1: keys 0..1 of block 1 (offset 1)
+        assert (fin == want).all(), fin
+
+
+class TestMemoryEfficientAttention:
+    @pytest.mark.parametrize("bias_kind", ["none", "ltm", "tensor",
+                                           "ltm_bias"])
+    def test_dense_kinds_match_reference(self, bias_kind):
+        b, s, h, d = 2, 16, 2, 8
+        q, k, v = _rand(b, s, h, d), _rand(b, s + 1, h, d), \
+            _rand(b, s + 1, h, d)
+        if bias_kind == "none":
+            bias_arg = None
+            bias_2d = np.zeros((s, s + 1), np.float32)
+        elif bias_kind == "ltm":
+            bias_arg = LowerTriangularMask()
+            bias_2d = np.asarray(
+                bias_arg.materialize((s, s + 1)).numpy())
+        elif bias_kind == "tensor":
+            bias_2d = (np.random.RandomState(0)
+                       .randn(s, s + 1).astype(np.float32))
+            bias_arg = pt.to_tensor(bias_2d[None, None])
+        else:
+            extra = (np.random.RandomState(1)
+                     .randn(s, s + 1).astype(np.float32))
+            bias_arg = LowerTriangularMaskWithTensorBias(
+                pt.to_tensor(extra[None, None]))
+            bias_2d = np.asarray(
+                bias_arg.materialize((1, 1, s, s + 1)).numpy())[0, 0]
+        out = memory_efficient_attention(q, k, v, attn_bias=bias_arg)
+        ref = _dense_ref(q, k, v, bias_2d)
+        assert np.allclose(out.numpy(), ref, atol=2e-3), \
+            np.abs(out.numpy() - ref).max()
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_block_diagonal_routes_to_varlen_kernel(self, causal):
+        lens = [5, 9, 2]
+        total, h, d = sum(lens), 2, 8
+        q, k, v = _rand(1, total, h, d), _rand(1, total, h, d), \
+            _rand(1, total, h, d)
+        mask = BlockDiagonalMask.from_seqlens(lens)
+        if causal:
+            mask = mask.make_causal()
+        out = memory_efficient_attention(q, k, v, attn_bias=mask)
+        bias_2d = np.asarray(mask.materialize((total, total)).numpy())
+        ref = _dense_ref(q, k, v, bias_2d)
+        assert np.allclose(out.numpy(), ref, atol=2e-3), \
+            np.abs(out.numpy() - ref).max()
+
+    def test_padded_keys_matches_reference(self):
+        pad, h, d = 4, 2, 8
+        klens = [3, 2]
+        q = _rand(1, 2, h, d)
+        k, v = _rand(1, len(klens) * pad, h, d), \
+            _rand(1, len(klens) * pad, h, d)
+        mask = BlockDiagonalCausalWithOffsetPaddedKeysMask(
+            q_seqinfo=SeqLenInfo.from_seqlens([1, 1]),
+            k_seqinfo=PaddedSeqLenInfo.from_seqlens_padded(klens, pad),
+            causal_diagonal=pt.to_tensor(np.array([2, 1], np.int32)))
+        out = memory_efficient_attention(q, k, v, attn_bias=mask)
+        ref = _dense_ref(q, k, v, np.asarray(
+            mask.materialize((2, len(klens) * pad)).numpy()))
+        assert np.allclose(out.numpy(), ref, atol=2e-3)
+
+    def test_gqa_heads_repeat(self):
+        q = _rand(2, 8, 4, 8)
+        k, v = _rand(2, 8, 2, 8), _rand(2, 8, 2, 8)
+        out = memory_efficient_attention(q, k, v,
+                                         attn_bias=LowerTriangularMask())
+        kr = pt.to_tensor(np.repeat(k.numpy(), 2, axis=2))
+        vr = pt.to_tensor(np.repeat(v.numpy(), 2, axis=2))
+        ref = memory_efficient_attention(q, kr, vr,
+                                         attn_bias=LowerTriangularMask())
+        assert np.allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+    def test_gradients_flow_block_diagonal(self):
+        lens = [4, 6]
+        total, h, d = sum(lens), 2, 8
+        qn = (np.random.RandomState(3).randn(1, total, h, d) * 0.3
+              ).astype(np.float32)
+        q = pt.to_tensor(qn, stop_gradient=False)
+        k, v = _rand(1, total, h, d), _rand(1, total, h, d)
+        mask = BlockDiagonalMask.from_seqlens(lens).make_causal()
+        out = memory_efficient_attention(q, k, v, attn_bias=mask)
+        out.sum().backward()
+        g = q.grad.numpy()
+        assert g.shape == qn.shape and np.isfinite(g).all()
+        assert np.abs(g).max() > 0
+
+    def test_from_tensor_list_round_trip(self):
+        a, b = _rand(2, 3, 2, 4), _rand(1, 5, 2, 4)
+        mask, packed = BlockDiagonalMask.from_tensor_list([a, b])
+        assert packed.shape == [1, 11, 2, 4]
+        sa, sb = mask.split(packed)
+        assert np.allclose(sa.numpy(), a.numpy())
+        assert np.allclose(sb.numpy(), b.numpy())
+
+    def test_dropout_zero_mean_preserved(self):
+        pt.seed(0)
+        q, k, v = _rand(1, 32, 2, 8), _rand(1, 32, 2, 8), \
+            _rand(1, 32, 2, 8)
+        out = memory_efficient_attention(q, k, v, p=0.5, training=True)
+        assert np.isfinite(out.numpy()).all()
+        # eval mode ignores p entirely
+        o1 = memory_efficient_attention(q, k, v, p=0.5, training=False)
+        o2 = memory_efficient_attention(q, k, v, p=0.0)
+        assert np.allclose(o1.numpy(), o2.numpy(), atol=1e-6)
+
+    def test_unsupported_bias_type_raises(self):
+        q = _rand(1, 4, 1, 4)
+        with pytest.raises(AssertionError, match="unsupported"):
+            memory_efficient_attention(q, q, q, attn_bias=object())
+
+    def test_block_diagonal_causal_unequal_lens_top_left(self):
+        """Causal blocks with q_len != kv_len: must follow xformers'
+        TOP-LEFT alignment (the varlen kernel's bottom-right causal
+        would differ), verified against materialize()."""
+        qlens, klens = [2, 3], [4, 6]
+        tq, tk, h, d = sum(qlens), sum(klens), 2, 8
+        q, k, v = _rand(1, tq, h, d), _rand(1, tk, h, d), \
+            _rand(1, tk, h, d)
+        mask = BlockDiagonalMask.from_seqlens(qlens, klens).make_causal()
+        out = memory_efficient_attention(q, k, v, attn_bias=mask)
+        ref = _dense_ref(q, k, v,
+                         np.asarray(mask.materialize((tq, tk)).numpy()))
+        assert np.allclose(out.numpy(), ref, atol=2e-3)
+
+    def test_fully_masked_row_clean_gradients(self):
+        """A padding-mask row of all -inf must yield zero output AND
+        NaN-free gradients for k/v (the softmax vjp of an -inf row
+        would otherwise poison every position's dk/dv)."""
+        s = 6
+        bias = np.zeros((s, s), np.float32)
+        bias[2, :] = float("-inf")          # row 2 attends nothing
+        q = _rand(1, s, 1, 4)
+        kn = (np.random.RandomState(9).randn(1, s, 1, 4) * 0.3
+              ).astype(np.float32)
+        k = pt.to_tensor(kn, stop_gradient=False)
+        v = _rand(1, s, 1, 4)
+        out = memory_efficient_attention(q, k, v,
+                                         attn_bias=pt.to_tensor(bias))
+        assert np.allclose(out.numpy()[0, 2], 0.0)
+        out.sum().backward()
+        assert np.isfinite(k.grad.numpy()).all()
+
+    def test_padded_keys_from_seqlens_constructor(self):
+        mask = BlockDiagonalCausalWithOffsetPaddedKeysMask.from_seqlens(
+            q_seqlen=[1, 1], kv_padding=4, kv_seqlen=[3, 2],
+            causal_diagonal=pt.to_tensor(np.array([2, 1], np.int32)))
+        fin = np.isfinite(mask.materialize((2, 8)).numpy())
+        assert fin[0, :3].all() and not fin[0, 3:].any()
+
+    def test_scale_zero_is_honored(self):
+        q, k, v = _rand(1, 4, 1, 8), _rand(1, 4, 1, 8), _rand(1, 4, 1, 8)
+        out = memory_efficient_attention(q, k, v, scale=0.0)
+        # zero logits -> uniform attention -> every row = mean of v
+        want = np.broadcast_to(v.numpy().mean(1, keepdims=True),
+                               v.numpy().shape)
+        assert np.allclose(out.numpy(), want, atol=1e-5)
+
+    def test_submodule_not_shadowed(self):
+        import paddle_tpu.incubate.nn as inn
+        import types
+        assert isinstance(inn.memory_efficient_attention, types.ModuleType)
+        assert callable(
+            inn.memory_efficient_attention.memory_efficient_attention)
