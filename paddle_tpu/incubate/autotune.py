@@ -1,0 +1,59 @@
+"""paddle.incubate.autotune.set_config parity (reference:
+python/paddle/incubate/autotune.py — toggles kernel/layout/dataloader
+auto-tuning in the fluid runtime).
+
+On TPU the equivalents are either always-on or owned elsewhere, so this
+records and validates the config and routes the one knob that has a
+live counterpart:
+
+  * kernel:    XLA:TPU autotunes tilings/fusion during compilation —
+               always on, nothing to enable.
+  * layout:    XLA picks layouts; NHWC-native convs are the default in
+               paddle_tpu.nn already.
+  * dataloader: tune_num_workers maps to the DataLoader's worker pool —
+               recorded here and read by paddle_tpu.io as a default.
+
+Offline search over the knobs XLA does NOT own (batch/remat/flash
+blocks/grad-accum) lives in tools/autotune.py.
+"""
+from __future__ import annotations
+
+import json
+
+__all__ = ["set_config"]
+
+_config = {"kernel": {"enable": True},
+           "layout": {"enable": True},
+           "dataloader": {"enable": False}}
+
+
+def set_config(config=None):
+    """reference autotune.py:47. Accepts a dict (or a path to a JSON
+    file) with any of the keys kernel / layout / dataloader; unknown
+    keys raise, matching the reference's warning-and-ignore but loudly
+    (a typo here silently disabling tuning is the failure mode)."""
+    global _config
+    if config is None:
+        for v in _config.values():
+            v["enable"] = True
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError(f"set_config expects dict, json path or None, "
+                        f"got {type(config)}")
+    unknown = set(config) - set(_config)
+    if unknown:
+        raise ValueError(f"unknown autotune sections {sorted(unknown)}; "
+                         f"valid: {sorted(_config)}")
+    for k, v in config.items():
+        if not isinstance(v, dict):
+            raise TypeError(f"section {k!r} must be a dict, got {type(v)}")
+        _config[k] = {**_config[k], **v}
+
+
+def get_config():
+    """Current autotune config (introspection helper; the reference
+    keeps this state internal to fluid)."""
+    return {k: dict(v) for k, v in _config.items()}

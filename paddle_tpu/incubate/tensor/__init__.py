@@ -1,0 +1,13 @@
+"""paddle.incubate.tensor (reference: python/paddle/incubate/tensor/):
+segment reductions + async host-offload manipulation APIs."""
+from . import math  # noqa: F401
+from . import manipulation  # noqa: F401
+from .math import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from .manipulation import (  # noqa: F401
+    async_offload, async_offload_with_offset, async_reload,
+    create_async_load,
+)
+
+__all__ = []

@@ -1,0 +1,144 @@
+"""Async host-offload APIs (reference: python/paddle/incubate/tensor/
+manipulation.py over core.AsyncLoad — the CUDA pinned-memory D2H/H2D
+copy engine used by sharding/offload strategies).
+
+TPU-native: jax dispatch is already asynchronous — `jax.device_put`
+returns immediately with a future-backed array and the transfer
+overlaps whatever compute is in flight, which is exactly the contract
+core.AsyncLoad provides via its background stream. `Task.synchronize`
+maps to `block_until_ready`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, unwrap
+
+__all__ = [
+    "create_async_load",
+    "async_offload",
+    "async_reload",
+    "async_offload_with_offset",
+]
+
+
+def _host_device():
+    """The host-RAM device (cpu backend). On a CPU-only run host and
+    device coincide — the API still holds, transfers are no-ops."""
+    try:
+        return jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return jax.devices()[0]
+
+
+class Task:
+    """Handle for an in-flight transfer (reference core AsyncLoad task:
+    is_completed / synchronize; cpu_synchronize kept as an alias)."""
+
+    def __init__(self, arrays):
+        self._arrays = arrays if isinstance(arrays, (list, tuple)) \
+            else [arrays]
+
+    def is_completed(self):
+        try:
+            return all(a.is_ready() for a in self._arrays)
+        except AttributeError:
+            return True
+
+    def synchronize(self):
+        for a in self._arrays:
+            jax.block_until_ready(a)
+
+    # reference spells the host-side wait cpu_synchronize
+    cpu_synchronize = synchronize
+    wait = synchronize
+
+
+class AsyncLoad:
+    """reference core.AsyncLoad. jax's async dispatch is the 'stream';
+    the loader additionally remembers each offloaded array's
+    accelerator-side placement so reload restores a SHARDED param to
+    its original layout instead of gathering everything onto device 0.
+    (Tracked per host array via weakref — Tensor has __slots__, so the
+    placement can't ride on the wrapper.)"""
+
+    def __init__(self):
+        import weakref
+        self._placements = weakref.WeakValueDictionary()   # id -> array
+        self._shardings = {}                               # id -> sharding
+
+    def offload(self, src):
+        raw = unwrap(src)
+        dst = jax.device_put(raw, _host_device())
+        import weakref
+        key = id(dst)
+        self._placements[key] = dst
+        self._shardings[key] = raw.sharding
+        weakref.finalize(dst, self._shardings.pop, key, None)
+        return Tensor(dst), Task(dst)
+
+    def reload(self, src):
+        raw = unwrap(src)
+        key = id(raw)
+        # the weak map guards against id reuse: only trust the stored
+        # sharding if the SAME array object is still registered
+        target = (self._shardings.get(key)
+                  if self._placements.get(key) is raw else None)
+        dst = jax.device_put(raw, target or jax.devices()[0])
+        return Tensor(dst), Task(dst)
+
+
+def create_async_load():
+    """reference manipulation.py:100."""
+    return AsyncLoad()
+
+
+def async_offload(src_tensor, async_load):
+    """Device → host-RAM copy, returned immediately as
+    (dest_tensor, task); task.synchronize() (or cpu_synchronize) blocks
+    until the bytes have landed (reference manipulation.py:105)."""
+    return async_load.offload(src_tensor)
+
+
+def async_reload(src_tensor, async_load):
+    """Host-RAM → device copy (reference manipulation.py:121)."""
+    return async_load.reload(src_tensor)
+
+
+def async_offload_with_offset(src_tensor, dst_tensor, src_offset,
+                              dst_offset, offload_size, async_loader):
+    """Partial 1-D offload: copy `offload_size` elements from
+    src[src_offset:] into dst[dst_offset:] (reference
+    manipulation.py:139). The scatter into dst is recorded immediately
+    (functional update through the Tensor wrapper); the returned task
+    gates on the underlying transfer."""
+    assert len(src_tensor.shape) == 1, "Only support 1-D tensor"
+    assert len(dst_tensor.shape) == 1, "Only support 1-D tensor"
+    assert src_tensor.dtype == dst_tensor.dtype, "Only support same dtype"
+    # explicit bounds: dynamic_slice/update_slice CLAMP out-of-range
+    # starts, which would silently copy/write the wrong elements
+    if not (0 <= src_offset and
+            src_offset + offload_size <= src_tensor.shape[0]):
+        raise ValueError(
+            f"src range [{src_offset}, {src_offset + offload_size}) out "
+            f"of bounds for length {src_tensor.shape[0]}")
+    if not (0 <= dst_offset and
+            dst_offset + offload_size <= dst_tensor.shape[0]):
+        raise ValueError(
+            f"dst range [{dst_offset}, {dst_offset + offload_size}) out "
+            f"of bounds for length {dst_tensor.shape[0]}")
+    raw_dst = unwrap(dst_tensor)
+    try:
+        dst_dev = list(raw_dst.devices())[0]
+    except Exception:
+        dst_dev = _host_device()
+    # land the chunk on dst's device first — mixing two COMMITTED
+    # placements inside one op is an error in jax
+    chunk = jax.device_put(
+        jax.lax.dynamic_slice(unwrap(src_tensor), (src_offset,),
+                              (offload_size,)),
+        dst_dev)
+    new_dst = jax.lax.dynamic_update_slice(raw_dst, chunk, (dst_offset,))
+    dst_tensor._replace(new_dst)
+    return Task(new_dst)

@@ -1,0 +1,9 @@
+"""Segment reductions (reference: python/paddle/incubate/tensor/math.py
+over phi segment_pool kernels). One implementation lives in
+paddle_tpu.geometric (jax.ops.segment_* — XLA scatter-reduce on TPU);
+these are the incubate-namespace bindings."""
+from ...geometric import (  # noqa: F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max"]

@@ -277,6 +277,20 @@ class DataLoader:
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn
+        if num_workers == 0:
+            # incubate.autotune dataloader section (reference: fluid's
+            # dataloader auto-tuning measures and adjusts num_workers;
+            # here the enabled flag upgrades an untuned default)
+            try:
+                from ..incubate.autotune import get_config
+                dl = get_config()["dataloader"]
+                if dl.get("enable"):
+                    import os as _os
+                    num_workers = int(dl.get(
+                        "num_workers",
+                        min(4, max(1, (_os.cpu_count() or 2) // 2))))
+            except Exception:
+                pass
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn

@@ -1,0 +1,134 @@
+"""paddle.incubate.tensor + incubate.autotune parity (reference:
+python/paddle/incubate/tensor/{math,manipulation}.py, autotune.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+inc = pt.incubate
+
+
+class TestSegmentBindings:
+    def test_segment_ops_match_geometric(self):
+        x = pt.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                  np.float32))
+        ids = pt.to_tensor(np.array([0, 0, 1]))
+        assert np.allclose(inc.tensor.segment_sum(x, ids).numpy(),
+                           [[4., 6.], [5., 6.]])
+        assert np.allclose(inc.tensor.segment_mean(x, ids).numpy(),
+                           [[2., 3.], [5., 6.]])
+        assert np.allclose(inc.tensor.segment_max(x, ids).numpy(),
+                           [[3., 4.], [5., 6.]])
+        assert np.allclose(inc.tensor.segment_min(x, ids).numpy(),
+                           [[1., 2.], [5., 6.]])
+
+
+class TestAsyncOffload:
+    def test_offload_reload_round_trip(self):
+        loader = inc.tensor.create_async_load()
+        src = pt.to_tensor(np.arange(16, dtype=np.float32))
+        host, task = inc.tensor.async_offload(src, loader)
+        assert task.is_completed() in (True, False)  # valid before sync
+        task.cpu_synchronize()
+        assert task.is_completed()
+        back, t2 = inc.tensor.async_reload(host, loader)
+        t2.synchronize()
+        assert np.allclose(back.numpy(), src.numpy())
+
+    def test_offload_with_offset(self):
+        loader = inc.tensor.create_async_load()
+        src = pt.to_tensor(np.arange(8, dtype=np.float32))
+        dst = pt.to_tensor(np.zeros(8, np.float32))
+        t = inc.tensor.async_offload_with_offset(src, dst, 2, 4, 3,
+                                                 loader)
+        t.wait()
+        assert dst.numpy().tolist() == [0, 0, 0, 0, 2, 3, 4, 0]
+
+    def test_offset_guards(self):
+        loader = inc.tensor.create_async_load()
+        a2d = pt.to_tensor(np.zeros((2, 2), np.float32))
+        b = pt.to_tensor(np.zeros(4, np.float32))
+        with pytest.raises(AssertionError, match="1-D"):
+            inc.tensor.async_offload_with_offset(a2d, b, 0, 0, 1, loader)
+        c = pt.to_tensor(np.zeros(4, np.int32))
+        with pytest.raises(AssertionError, match="dtype"):
+            inc.tensor.async_offload_with_offset(b, c, 0, 0, 1, loader)
+
+
+class TestAutotuneConfig:
+    def test_set_and_merge(self):
+        inc.autotune.set_config({"dataloader": {"enable": True,
+                                                "tuning_steps": 25}})
+        cfg = inc.autotune.get_config()
+        assert cfg["dataloader"]["enable"] is True
+        assert cfg["dataloader"]["tuning_steps"] == 25
+        inc.autotune.set_config(None)   # reset enables everything
+        assert inc.autotune.get_config()["dataloader"]["enable"] is True
+
+    def test_json_path(self, tmp_path):
+        p = tmp_path / "tune.json"
+        p.write_text('{"kernel": {"enable": false}}')
+        inc.autotune.set_config(str(p))
+        assert inc.autotune.get_config()["kernel"]["enable"] is False
+        inc.autotune.set_config(None)
+
+    def test_unknown_section_raises(self):
+        with pytest.raises(ValueError, match="unknown autotune"):
+            inc.autotune.set_config({"kernle": {}})
+        with pytest.raises(TypeError):
+            inc.autotune.set_config(42)
+
+
+class TestReviewRegressions:
+    def test_out_of_bounds_offsets_raise(self):
+        loader = inc.tensor.create_async_load()
+        src = pt.to_tensor(np.arange(8, dtype=np.float32))
+        dst = pt.to_tensor(np.zeros(8, np.float32))
+        with pytest.raises(ValueError, match="src range"):
+            inc.tensor.async_offload_with_offset(src, dst, 6, 0, 3, loader)
+        with pytest.raises(ValueError, match="dst range"):
+            inc.tensor.async_offload_with_offset(src, dst, 0, 7, 3, loader)
+
+    def test_scalar_rejected(self):
+        loader = inc.tensor.create_async_load()
+        s = pt.to_tensor(np.float32(1.0))
+        d = pt.to_tensor(np.zeros(4, np.float32))
+        with pytest.raises(AssertionError, match="1-D"):
+            inc.tensor.async_offload_with_offset(s, d, 0, 0, 1, loader)
+
+    def test_reload_restores_sharded_layout(self):
+        """Offload a mesh-sharded array; reload must restore the
+        ORIGINAL sharding, not gather onto device 0."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        devs = jax.devices()
+        if len(devs) < 2:
+            pytest.skip("needs the multi-device CPU mesh")
+        mesh = Mesh(np.array(devs[:2]), ("x",))
+        sh = NamedSharding(mesh, PartitionSpec("x"))
+        arr = jax.device_put(np.arange(8, dtype=np.float32), sh)
+        loader = inc.tensor.create_async_load()
+        host, t = inc.tensor.async_offload(pt.to_tensor(arr), loader)
+        t.synchronize()
+        back, t2 = inc.tensor.async_reload(host, loader)
+        t2.synchronize()
+        import paddle_tpu as _pt
+        raw = back._value
+        assert raw.sharding == sh, raw.sharding
+        assert np.allclose(np.asarray(raw), np.arange(8))
+
+    def test_autotune_enables_dataloader_workers(self):
+        inc.autotune.set_config({"dataloader": {"enable": True,
+                                                "num_workers": 2}})
+        try:
+            ds = pt.io.TensorDataset([pt.to_tensor(
+                np.arange(12, dtype=np.float32).reshape(12, 1))])
+            dl = pt.io.DataLoader(ds, batch_size=4)
+            assert dl.num_workers == 2
+            seen = sorted(float(b[0].numpy()[i, 0]) for b in dl
+                          for i in range(b[0].shape[0]))
+            assert seen == [float(i) for i in range(12)]
+        finally:
+            inc.autotune.set_config({"dataloader": {"enable": False}})
+        dl2 = pt.io.DataLoader(ds, batch_size=4)
+        assert dl2.num_workers == 0
