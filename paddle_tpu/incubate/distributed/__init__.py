@@ -1,0 +1,4 @@
+"""paddle.incubate.distributed (reference: python/paddle/incubate/
+distributed/) — the models.moe surface; fleet re-exports live in
+paddle_tpu.distributed.fleet."""
+from . import models  # noqa: F401

@@ -1,0 +1,59 @@
+"""reference: gate/gshard_gate.py — top-2 router with the GShard
+load-balance loss (E^2 * mean(c_e * m_e)), capacity limiting and
+random second-expert routing. Capacity limiting is a cumsum rank test
+(jit-friendly) instead of the reference's host-side limit_by_capacity
+kernel: slots past the per-expert capacity are marked -1, matching the
+reference's contract."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ......_core.tensor import Tensor, apply, unwrap
+from ......_core.state import prng
+from .naive_gate import NaiveGate
+
+
+def _limit_by_capacity(topk_idx, tot_expert, capacity):
+    """(T, k) expert ids -> same with over-capacity entries set to -1.
+    Rank = arrival order, slot-major (slot 0 of every token first),
+    via the shared expert_slot_positions helper."""
+    from ......parallel.moe import expert_slot_positions
+    pos = expert_slot_positions(topk_idx, tot_expert)
+    return jnp.where(pos < capacity, topk_idx, -1)
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        assert topk == 2, "topk should be 2 in gshard"
+        super().__init__(d_model, num_expert, world_size, topk=2)
+        self.capacity = capacity
+        self.random_routing = random_routing
+        self.group = group
+
+    def forward(self, x):
+        topk_val, topk_idx, gate_score = super().forward(
+            x, return_all_scores=True)
+        cap_rate = self.capacity[0 if self.training else 1]
+        capacity = math.ceil(cap_rate * x.shape[0])
+        tot = self.tot_expert
+
+        def aux(score, idx):
+            s = score.shape[0]
+            c_e = jnp.sum(jax.nn.one_hot(idx.reshape(-1), tot,
+                                         dtype=jnp.float32), axis=0) / s
+            m_e = jnp.mean(jax.nn.softmax(score, axis=1), axis=0)
+            return jnp.mean(c_e * m_e) * (self.num_expert ** 2)
+
+        self.set_loss(apply(aux, gate_score, topk_idx, name="gshard_aux"))
+
+        idx = _limit_by_capacity(unwrap(topk_idx), tot, capacity)
+        if self.random_routing and self.training:
+            # reference: the 2nd expert is kept only with probability
+            # proportional to its gate value (2*val > U[0,1])
+            u = jax.random.uniform(prng.next_key(),
+                                   (idx.shape[0],), jnp.float32)
+            keep2 = (2.0 * unwrap(topk_val)[:, 1] > u)
+            idx = idx.at[:, 1].set(jnp.where(keep2, idx[:, 1], -1))
+        return topk_val, Tensor(idx)

@@ -1,0 +1,18 @@
+"""reference: gate/naive_gate.py — plain linear router, top-k scores."""
+from ...... import nn
+from .base_gate import BaseGate
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores=False):
+        import paddle_tpu as pt
+        gate = self.gate(inp)
+        val, idx = pt.topk(gate, k=self.top_k, axis=-1)
+        if return_all_scores:
+            return val, idx, gate
+        return val, idx

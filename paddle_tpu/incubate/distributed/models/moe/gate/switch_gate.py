@@ -1,0 +1,53 @@
+"""reference: gate/switch_gate.py — Switch Transformer top-1 router:
+multiplicative uniform noise while training, softmax score, capacity
+limit, and the Switch aux loss E * sum(fraction_e * prob_e)."""
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ......_core.tensor import Tensor, apply, unwrap
+from ......_core.state import prng
+from .gshard_gate import _limit_by_capacity
+from .naive_gate import NaiveGate
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        assert topk == 1, "topk should be 1 in switch"
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+        self.group = group
+
+    def forward(self, inp):
+        score = self.gate(inp)
+        if self.training:
+            def noisy(s):
+                noise = jax.random.uniform(prng.next_key(), s.shape,
+                                           jnp.float32)
+                return s + (noise * 2 * self.switch_eps
+                            + 1.0 - self.switch_eps)
+            score = apply(noisy, score, name="switch_noise")
+        import paddle_tpu as pt
+        score = pt.nn.functional.softmax(score, axis=-1)
+        top1_val, top1_idx = pt.topk(score, k=1, axis=-1)
+
+        cap_rate = self.capacity[0 if self.training else 1]
+        capacity = math.ceil(cap_rate * inp.shape[0])
+        idx = _limit_by_capacity(unwrap(top1_idx), self.tot_expert,
+                                 capacity)
+        tot = self.tot_expert
+
+        def aux(sc, kept):
+            valid = jax.nn.one_hot(jnp.where(kept < 0, 0, kept)[:, 0],
+                                   tot, dtype=jnp.float32)
+            valid = valid * (kept[:, :1] >= 0)
+            fraction = jnp.sum(valid, axis=0) / jnp.maximum(
+                jnp.sum(valid), 1.0)
+            prob = jnp.mean(sc, axis=0)
+            return jnp.sum(fraction * prob) * tot
+
+        self.set_loss(apply(aux, score, Tensor(idx), name="switch_aux"))
+        return top1_val, Tensor(idx)

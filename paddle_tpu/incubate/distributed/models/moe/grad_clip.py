@@ -1,0 +1,75 @@
+"""ClipGradForMOEByGlobalNorm (reference: python/paddle/incubate/
+distributed/models/moe/grad_clip.py).
+
+Expert grads live only on their EP shard, so a plain global norm would
+double-count replicated params or miss remote expert norms. The
+reference splits params into normal/expert groups, all_reduces the
+expert-group squared norm over moe_group, and clips everything by the
+combined norm. Here the same split applies; the expert-group reduction
+uses our collective all_reduce when a group is given (on the SPMD path
+GSPMD already derives this — this class serves the eager tier)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....._core.tensor import Tensor
+from .....nn.clip import ClipGradBase
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+def _sq_norm(params_grads):
+    sq = [jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+          for p, g in params_grads
+          if g is not None and getattr(p, "need_clip", True)]
+    if not sq:
+        return None
+    return sum(sq[1:], sq[0])
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__()
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.moe_group = moe_group
+        if moe_group is not None and getattr(moe_group, "nranks", 1) > 1:
+            assert is_expert_param_func is not None, (
+                "When moe group size > 1, a function for selecting "
+                "expert params must be specified.")
+        self.is_expert_param_func = is_expert_param_func
+
+    def __str__(self):
+        return f"Gradient Clip By GlobalNorm, global_norm={self.clip_norm:f}"
+
+    def _dygraph_clip(self, params_grads):
+        normal, moe = [], []
+        if self.is_expert_param_func is not None:
+            for p, g in params_grads:
+                (moe if self.is_expert_param_func(p)
+                 else normal).append((p, g))
+        else:
+            normal = list(params_grads)
+
+        gn = _sq_norm(normal)
+        gm = _sq_norm(moe)
+        if gm is not None and self.moe_group is not None and \
+                getattr(self.moe_group, "nranks", 1) > 1:
+            from .....distributed import all_reduce
+            t = Tensor(gm)
+            all_reduce(t, group=self.moe_group)
+            gm = t._value
+        if gn is None and gm is None:
+            return params_grads
+        total = (gn if gm is None else
+                 gm if gn is None else gn + gm)
+        gnorm = jnp.sqrt(total)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._value * scale).astype(g.dtype))))
+        return out

@@ -19,6 +19,22 @@ from ..nn.layer.layers import Layer
 from ..nn.initializer import XavierUniform
 
 
+def expert_slot_positions(topk_idx, tot_expert):
+    """(T, k) expert ids (negatives = dropped) → (T, k) arrival rank of
+    each assignment within its expert's queue, slot-major (slot 0 of
+    every token first). THE shared rank computation for every
+    capacity-bounded dispatch in the tree (this module's fused gating,
+    incubate MoELayer's dispatch, the gshard gate's capacity limiter) —
+    the `-1` must apply after reducing the hot column, a pitfall that
+    has produced slot-collision bugs when re-derived by hand."""
+    T, k = topk_idx.shape
+    flat = jnp.where(topk_idx >= 0, topk_idx, tot_expert
+                     ).transpose(1, 0).reshape(-1)
+    onehot = jax.nn.one_hot(flat, tot_expert + 1, dtype=jnp.int32)
+    rank = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1) - 1
+    return rank.reshape(k, T).transpose(1, 0)
+
+
 def top_k_gating(logits, k, capacity, expert_axis_size=1):
     """logits (T, E) → dispatch (T, E, C) bool, combine (T, E, C) float,
     aux_loss (load-balance, Switch-style)."""

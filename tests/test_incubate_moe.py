@@ -1,0 +1,170 @@
+"""incubate.distributed.models.moe (reference: python/paddle/incubate/
+distributed/models/moe/): MoELayer over arbitrary expert Layers, the
+three gates, and the MoE-aware global-norm clip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.distributed.models.moe import (
+    BaseGate,
+    ClipGradForMOEByGlobalNorm,
+    GShardGate,
+    MoELayer,
+    NaiveGate,
+    SwitchGate,
+)
+
+
+class Expert(pt.nn.Layer):
+    def __init__(self, d, h, seed):
+        super().__init__()
+        pt.seed(seed)
+        self.a = pt.nn.Linear(d, h)
+        self.b = pt.nn.Linear(h, d)
+
+    def forward(self, x):
+        return self.b(pt.nn.functional.relu(self.a(x)))
+
+
+def _experts(d, h, n):
+    return pt.nn.LayerList([Expert(d, h, 100 + i) for i in range(n)])
+
+
+class TestGates:
+    def test_naive_gate_topk(self):
+        g = NaiveGate(8, 4, 1, topk=2)
+        val, idx = g(pt.randn([6, 8]))
+        assert val.shape == [6, 2] and idx.shape == [6, 2]
+        assert int(idx.numpy().max()) < 4
+
+    def test_gshard_gate_sets_loss_and_caps(self):
+        pt.seed(0)
+        g = GShardGate(8, 4, 1, random_routing=False)
+        g.eval()   # deterministic capacity rate
+        val, idx = g(pt.randn([32, 8]))
+        loss = g.get_loss()
+        assert loss is not None and float(loss.numpy()) >= 0
+        assert g.get_loss() is None          # cleared on read
+        assert idx.shape == [32, 2]
+
+    def test_limit_by_capacity_marks_minus_one(self):
+        """Direct check of the capacity limiter with a cap that BINDS
+        (the gate-level ceil(2.4*T) can never bind at world_size=1)."""
+        from paddle_tpu.incubate.distributed.models.moe.gate.gshard_gate \
+            import _limit_by_capacity
+        # 5 tokens all top-1 to expert 0, second choice expert 1
+        idx = np.array([[0, 1]] * 5, np.int64)
+        kept = np.asarray(_limit_by_capacity(idx, 2, capacity=3))
+        # slot-major order: all first-choices rank before second-choices
+        assert (kept[:3, 0] == 0).all() and (kept[3:, 0] == -1).all()
+        assert (kept[:3, 1] == 1).all() and (kept[3:, 1] == -1).all()
+
+    def test_switch_gate_top1(self):
+        pt.seed(0)
+        g = SwitchGate(8, 4, 1)
+        g.eval()
+        val, idx = g(pt.randn([16, 8]))
+        assert val.shape == [16, 1] and idx.shape == [16, 1]
+        assert float(val.numpy().min()) >= 0   # softmax scores
+        assert g.get_loss() is not None
+
+    def test_base_gate_raises(self):
+        with pytest.raises(NotImplementedError):
+            BaseGate(2, 1)(pt.randn([2, 4]))
+
+
+class TestMoELayer:
+    def test_naive_full_topk_equals_dense_mixture(self):
+        """top_k == num_experts with ample capacity drops nothing, so
+        the MoE output must equal the dense gate-weighted mixture
+        computed by hand (reference combine: raw topk values, no
+        renormalization)."""
+        d, h, n = 8, 16, 3
+        experts = _experts(d, h, n)
+        moe = MoELayer(d, experts, gate={"type": "naive", "top_k": n})
+        moe.capacity_factor = 10.0   # nothing dropped
+        pt.seed(7)
+        x = pt.randn([1, 5, d])
+        out = moe(x).numpy()
+
+        tokens = x.numpy().reshape(-1, d)
+        logits = moe.gate.gate(pt.to_tensor(tokens)).numpy()
+        want = np.zeros_like(tokens)
+        for e in range(n):
+            ye = experts[e](pt.to_tensor(tokens)).numpy()
+            want += logits[:, e:e + 1] * ye
+        assert np.allclose(out.reshape(-1, d), want, atol=1e-4), \
+            np.abs(out.reshape(-1, d) - want).max()
+
+    @pytest.mark.parametrize("kind", ["gshard", "switch", "naive"])
+    def test_all_gates_run_and_train(self, kind):
+        d = 8
+        experts = _experts(d, 16, 4)
+        moe = MoELayer(d, experts, gate={"type": kind})
+        x = pt.randn([2, 6, d])
+        y = moe(x)
+        assert y.shape == [2, 6, d]
+        assert np.isfinite(y.numpy()).all()
+        loss = (y ** 2).sum()
+        gate_loss = moe.gate.get_loss()
+        if gate_loss is not None:
+            loss = loss + gate_loss
+        loss.backward()
+        assert moe.gate.gate.weight.grad is not None
+        assert any(experts[e].a.weight.grad is not None
+                   for e in range(4))
+
+    def test_gate_instance_accepted_and_bad_config_rejected(self):
+        d = 8
+        experts = _experts(d, 16, 2)
+        g = NaiveGate(d, 2, 1, topk=1)
+        moe = MoELayer(d, experts, gate=g)
+        assert moe.top_k == 1 and moe.gate is g
+        # {"type": None} routes to NaiveGate with the requested top_k
+        # (reference moe_layer.py:370), NOT to the gshard default
+        moe_none = MoELayer(d, experts, gate={"type": None, "top_k": 1})
+        assert isinstance(moe_none.gate, NaiveGate)
+        assert not isinstance(moe_none.gate, GShardGate)
+        assert moe_none.top_k == 1
+        with pytest.raises(AssertionError):
+            MoELayer(d, experts, gate={"type": "bogus"})
+        with pytest.raises(AssertionError):
+            MoELayer(d, experts, gate=42)
+
+    def test_capacity_drops_produce_zero_rows(self):
+        """With capacity 1 slot per expert most tokens are dropped and
+        contribute exactly zero (reference: gather returns zeros for
+        dropped positions)."""
+        d = 4
+        experts = _experts(d, 8, 2)
+        moe = MoELayer(d, experts, gate={"type": "naive", "top_k": 1})
+        moe.capacity_factor = 1e-9   # capacity clamps to 1
+        x = pt.randn([1, 6, d])
+        y = moe(x).numpy().reshape(-1, d)
+        # at most 2 rows (1 per expert) are nonzero
+        nonzero = (np.abs(y).sum(-1) > 1e-7).sum()
+        assert nonzero <= 2, y
+
+
+class TestMoEClip:
+    def test_split_norm_matches_manual(self):
+        pa = pt.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        pb = pt.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+        ga = pt.to_tensor(np.full(4, 3.0, np.float32))
+        gb = pt.to_tensor(np.full(4, 4.0, np.float32))
+        experts = {id(pb)}
+        clip = ClipGradForMOEByGlobalNorm(
+            1.0, is_expert_param_func=lambda p: id(p) in experts)
+        out = clip._dygraph_clip([(pa, ga), (pb, gb)])
+        gnorm = np.sqrt((9.0 * 4) + (16.0 * 4))
+        for (_, g), orig in zip(out, (3.0, 4.0)):
+            assert np.allclose(g.numpy(), orig / gnorm, atol=1e-6)
+
+    def test_need_clip_false_passthrough(self):
+        lin = pt.nn.Linear(2, 2)     # Parameter carries need_clip
+        p = lin.weight
+        p.need_clip = False
+        g = pt.to_tensor(np.full((2, 2), 100.0, np.float32))
+        clip = ClipGradForMOEByGlobalNorm(1.0)
+        out = clip._dygraph_clip([(p, g)])
+        assert np.allclose(out[0][1].numpy(), 100.0)
