@@ -97,4 +97,5 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
 from .. import inference  # noqa: E402,F401  (reference re-exports it)
 from . import tensor  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
+from . import multiprocessing  # noqa: E402,F401
 from . import autotune  # noqa: E402,F401

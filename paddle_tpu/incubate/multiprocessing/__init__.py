@@ -1,0 +1,27 @@
+"""paddle.incubate.multiprocessing parity (reference:
+python/paddle/incubate/multiprocessing/{__init__,reductions}.py —
+shared-memory tensor passing between processes via ForkingPickler
+reductions over LoDTensor file descriptors).
+
+TPU-native shape: device arrays are owned by the XLA runtime and are
+not shareable across OS processes, so sharing happens at host level —
+a Tensor crossing a process boundary travels as a POSIX shared-memory
+block (multiprocessing.shared_memory): one copy into shm at send, one
+copy out at receive (the rebuilt tensor owns its memory so the sender
+can unlink; a device_put would copy regardless). The payload itself
+stays a few bytes — name/shape/dtype — instead of the tensor bytes.
+Gradients/tape state do not cross (same as the reference, which ships
+values only).
+
+Usage matches the reference: `import paddle_tpu.incubate.
+multiprocessing as mp` then use mp.Process/Queue/... — the module
+re-exports the stdlib multiprocessing namespace with the reductions
+installed.
+"""
+from .reductions import init_reductions
+
+__all__ = []
+
+from multiprocessing import *  # noqa: F401,F403
+
+init_reductions()

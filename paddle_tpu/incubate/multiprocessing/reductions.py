@@ -1,0 +1,153 @@
+"""ForkingPickler reductions for paddle_tpu.Tensor (reference:
+python/paddle/incubate/multiprocessing/reductions.py).
+
+Send side: the host view of the array is copied once into a POSIX
+shared-memory block; the pickle payload is (shm name, shape, dtype).
+Receive side: the child maps the block and materializes the tensor.
+Blocks are held by the sender until process exit (atexit sweep) —
+the reference's file_system strategy lifetime — because a payload can
+sit in a Queue long after the source tensor is gone; POSIX refcounting
+keeps receiver mappings valid past the unlink.
+
+bfloat16 rides as a raw uint16 view (multiprocessing.shared_memory is
+dtype-agnostic; ml_dtypes restores the view on rebuild).
+"""
+from __future__ import annotations
+
+import atexit
+from multiprocessing.reduction import ForkingPickler
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from ..._core.tensor import Tensor
+
+__all__ = ["init_reductions"]
+
+# sender-side keepalive: a pickle payload can sit in a Queue long after
+# the source tensor is gone, and unlinking before every receiver has
+# mapped breaks the rebuild (FileNotFoundError). The SENDER holds each
+# block in an LRU bounded by total bytes (reference: reductions.py's
+# _LRUSharedCache bounds the same lifetime problem) — beyond the
+# window the oldest blocks are unlinked, so a long-running producer
+# cannot fill /dev/shm; an undelivered payload older than the window
+# fails to rebuild, the same contract as the reference cache. The
+# atexit sweep unlinks the remainder at exit.
+from collections import OrderedDict
+
+_sent_blocks = OrderedDict()
+_sent_bytes = [0]
+_SHM_BYTES_CAP = int(__import__("os").environ.get(
+    "PT_MP_SHM_BYTES", str(1 << 30)))
+
+
+def _evict_over_cap():
+    while _sent_bytes[0] > _SHM_BYTES_CAP and len(_sent_blocks) > 1:
+        name = next(iter(_sent_blocks))
+        _release(name)
+
+
+def _cleanup_all():
+    for name in list(_sent_blocks):
+        _release(name)
+
+
+atexit.register(_cleanup_all)
+
+
+def _untrack(name):
+    """Drop a receiver-side resource_tracker claim (attach registers,
+    cpython bpo-39959): the sender's unlink() is the one true
+    unregister. Cost of the sender-owned lifetime: a SIGKILLed sender
+    leaks its blocks until reboot — the same profile as the
+    reference's file_system strategy."""
+    try:
+        from multiprocessing import resource_tracker
+        resource_tracker.unregister(name, "shared_memory")
+    except Exception:
+        pass
+
+
+def _np_view(arr):
+    """Byte-level host view: transports ANY dtype (bf16, float8, ...)
+    as raw uint8 bytes; the logical (shape, dtype name) ride in the
+    payload and the view is re-applied at rebuild."""
+    a = np.ascontiguousarray(np.atleast_1d(np.asarray(arr)))
+    return a.view(np.uint8), str(np.asarray(arr).dtype)
+
+
+def _np_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _rebuild_tensor(shm_name, shape, dtype_name, stop_gradient):
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        if shm_name not in _sent_blocks:
+            # cross-process receiver: attach registered the block in
+            # THIS process's tracker, but lifetime belongs to the
+            # sender (whose unlink() unregisters in ITS tracker) —
+            # drop the bogus claim or this process warns 'leaked' at
+            # shutdown. An in-process rebuild keeps the entry: it IS
+            # the sender's, and unlink() unregisters it exactly once.
+            _untrack(shm._name)
+        dt = _np_dtype(dtype_name)
+        nbytes = int(np.prod(shape)) * dt.itemsize if shape else dt.itemsize
+        base = np.ndarray((max(1, nbytes),), dtype=np.uint8,
+                          buffer=shm.buf)[:nbytes]
+        # one copy out of the mapping: the tensor owns its memory and
+        # the sender remains free to unlink (a jax device_put would
+        # copy anyway)
+        arr = np.array(base).view(dt).reshape(shape)
+    finally:
+        shm.close()
+    t = Tensor(arr)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+def _reduce_tensor(tensor):
+    host, dtype_name = _np_view(tensor.numpy())
+    shm = shared_memory.SharedMemory(create=True, size=max(1, host.nbytes))
+    view = np.ndarray(host.shape, dtype=np.uint8, buffer=shm.buf)
+    view[...] = host
+    _sent_blocks[shm.name] = shm
+    _sent_bytes[0] += shm.size
+    _evict_over_cap()
+    return (_rebuild_tensor,
+            (shm.name, tuple(tensor.shape), dtype_name,
+             bool(tensor.stop_gradient)))
+
+
+def _release(name):
+    shm = _sent_blocks.pop(name, None)
+    if shm is not None:
+        _sent_bytes[0] -= shm.size
+        try:
+            # forkserver children can SHARE the parent's tracker; a
+            # receiver's untrack then removed OUR entry from the shared
+            # set and unlink()'s unregister would KeyError-spam the
+            # tracker. Re-register first: no-op when the entry exists
+            # (set semantics), restores it when a receiver dropped it.
+            from multiprocessing import resource_tracker
+            resource_tracker.register(shm._name, "shared_memory")
+        except Exception:
+            pass
+        try:
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def init_reductions():
+    """reference reductions.py:243 — register the Tensor reducers on
+    ForkingPickler so mp.Queue/Pipe move tensors through shared
+    memory instead of pickling the bytes."""
+    ForkingPickler.register(Tensor, _reduce_tensor)
+    from ..._core.tensor import Parameter
+    ForkingPickler.register(Parameter, _reduce_tensor)

@@ -1,0 +1,38 @@
+"""Child for the multiprocessing-reductions test: reads a
+ForkingPickler payload from stdin (rebuilds the parent's tensor from
+its shared-memory block), doubles it, writes its own payload to
+stdout. The parent rebuilds from the CHILD's block — both directions
+of the cross-process path run."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import pickle  # noqa: E402
+import struct  # noqa: E402
+
+import numpy as np  # noqa: E402
+import paddle_tpu  # noqa: E402,F401
+import paddle_tpu.incubate.multiprocessing  # noqa: E402,F401
+
+
+def main():
+    from multiprocessing.reduction import ForkingPickler
+    (n,) = struct.unpack("<I", sys.stdin.buffer.read(4))
+    x = pickle.loads(sys.stdin.buffer.read(n))
+    assert np.allclose(x.numpy(), 21.0), x.numpy()
+    y = x * 2
+    payload = bytes(ForkingPickler.dumps(y))
+    sys.stdout.buffer.write(struct.pack("<I", len(payload)) + payload)
+    sys.stdout.buffer.flush()
+    # hold the process (and its shm block) until the parent confirms
+    # it rebuilt — the sender's block must outlive the read
+    assert sys.stdin.buffer.read(1) == b"k"
+    print("CHILD_OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

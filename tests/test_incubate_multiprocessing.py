@@ -1,0 +1,104 @@
+"""incubate.multiprocessing reductions (reference: python/paddle/
+incubate/multiprocessing/reductions.py): Tensors crossing process
+boundaries travel as shared-memory blocks, not pickled bytes."""
+import os
+import pickle
+import struct
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.incubate.multiprocessing  # noqa: F401  (registers)
+
+from multiprocessing.reduction import ForkingPickler
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+class TestReductions:
+    def test_payload_is_a_handle_not_the_bytes(self):
+        t = pt.to_tensor(np.zeros((512, 512), np.float32))  # 1 MiB
+        payload = bytes(ForkingPickler.dumps(t))
+        # the payload carries (shm name, shape, dtype), not the megabyte
+        assert len(payload) < 4096, len(payload)
+
+    def test_in_process_round_trip(self):
+        t = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        t2 = pickle.loads(bytes(ForkingPickler.dumps(t)))
+        assert np.allclose(t2.numpy(), t.numpy())
+        assert t2.stop_gradient == t.stop_gradient
+
+    def test_stop_gradient_preserved(self):
+        t = pt.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        t2 = pickle.loads(bytes(ForkingPickler.dumps(t)))
+        assert t2.stop_gradient is False
+
+    def test_bfloat16_rides_as_bits(self):
+        tb = pt.to_tensor(np.arange(4, dtype=np.float32)).astype("bfloat16")
+        tb2 = pickle.loads(bytes(ForkingPickler.dumps(tb)))
+        assert "bfloat16" in str(tb2.dtype)
+        assert np.allclose(tb2.astype("float32").numpy(),
+                           [0, 1, 2, 3])
+
+    def test_parameter_registered(self):
+        lin = pt.nn.Linear(3, 3)
+        p2 = pickle.loads(bytes(ForkingPickler.dumps(lin.weight)))
+        assert np.allclose(p2.numpy(), lin.weight.numpy())
+
+    def test_namespace_reexports_multiprocessing(self):
+        mp = pt.incubate.multiprocessing
+        assert callable(mp.Process) and callable(mp.Queue)
+
+
+def test_cross_process_both_directions():
+    """Parent block → child rebuild → child block → parent rebuild."""
+    child = os.path.join(HERE, "_mpshare_child.py")
+    t = pt.to_tensor(np.full(4, 21.0, np.float32))
+    payload = bytes(ForkingPickler.dumps(t))
+    p = subprocess.Popen([sys.executable, child], stdin=subprocess.PIPE,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        p.stdin.write(struct.pack("<I", len(payload)) + payload)
+        p.stdin.flush()
+        (n,) = struct.unpack("<I", p.stdout.read(4))
+        reply = pickle.loads(p.stdout.read(n))
+        assert np.allclose(reply.numpy(), 42.0), reply.numpy()
+        p.stdin.write(b"k")
+        p.stdin.flush()
+        _, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-2000:]
+        assert b"CHILD_OK" in err
+    finally:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+
+
+class TestReviewRegressions:
+    def test_float8_and_scalar_dtypes(self):
+        for dt in ("float8_e4m3fn", "float8_e5m2", "bfloat16"):
+            t = pt.to_tensor(np.array([1.0, 2.0, 3.0],
+                                      np.float32)).astype(dt)
+            t2 = pickle.loads(bytes(ForkingPickler.dumps(t)))
+            assert dt in str(t2.dtype), (dt, t2.dtype)
+            assert np.allclose(t2.astype("float32").numpy(),
+                               [1, 2, 3], atol=0.25)
+        # 0-d scalar
+        s = pt.to_tensor(np.float32(7.0))
+        s2 = pickle.loads(bytes(ForkingPickler.dumps(s)))
+        assert s2.shape == [] and float(s2.numpy()) == 7.0
+
+    def test_lru_cap_bounds_shm(self):
+        import paddle_tpu.incubate.multiprocessing.reductions as red
+        old_cap = red._SHM_BYTES_CAP
+        red._SHM_BYTES_CAP = 64 * 1024
+        try:
+            for _ in range(8):
+                t = pt.to_tensor(np.zeros(8192, np.float32))  # 32 KiB
+                bytes(ForkingPickler.dumps(t))
+            assert red._sent_bytes[0] <= red._SHM_BYTES_CAP + 32 * 1024
+            assert len(red._sent_blocks) <= 3
+        finally:
+            red._SHM_BYTES_CAP = old_cap
