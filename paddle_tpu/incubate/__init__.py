@@ -97,5 +97,20 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
 from .. import inference  # noqa: E402,F401  (reference re-exports it)
 from . import tensor  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
-from . import multiprocessing  # noqa: E402,F401
+from . import checkpoint  # noqa: E402,F401
 from . import autotune  # noqa: E402,F401
+
+
+def __getattr__(name):
+    if name == "multiprocessing":
+        # LAZY on purpose: importing the module runs init_reductions(),
+        # which globally rewires ForkingPickler for Tensors (shm-handle
+        # payloads, sender-held blocks). That is the documented OPT-IN
+        # contract — `import paddle_tpu.incubate.multiprocessing` —
+        # and must not happen on bare `import paddle_tpu`.
+        import importlib
+        mod = importlib.import_module(__name__ + ".multiprocessing")
+        globals()["multiprocessing"] = mod
+        return mod
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

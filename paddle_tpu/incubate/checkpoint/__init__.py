@@ -1,0 +1,5 @@
+"""paddle.incubate.checkpoint (reference: python/paddle/incubate/
+checkpoint/__init__.py re-exporting base auto_checkpoint)."""
+from . import auto_checkpoint  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,105 @@
+"""auto_checkpoint parity (reference: python/paddle/base/incubate/
+checkpoint/auto_checkpoint.py — PaddleCloud's env-driven epoch-resume
+loop: `for epoch in acp.train_epoch_range(N): ...` transparently skips
+epochs a previous incarnation of the job completed).
+
+TPU-native shape: the heavy state (params/opt/rng) already has an
+atomic resume story in paddle_tpu.utils.checkpoint; what this module
+adds is the reference's EPOCH-RANGE bookkeeping — a tiny status file,
+written atomically after each completed epoch, consulted at start.
+Enabled by env like the reference (theirs: PADDLE_RUNNING_ENV=
+PaddleCloud + job env; ours: PT_AUTO_CKPT_DIR pointing at the job's
+checkpoint directory). Without the env the range degrades to plain
+`range(max_epoch_num)`, exactly like the reference off PaddleCloud.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["AutoCheckpointChecker", "train_epoch_range"]
+
+
+class AutoCheckpointChecker:
+    """reference auto_checkpoint.py:70 — decides whether auto
+    checkpointing is active and where state lives."""
+
+    def __init__(self):
+        self._dir = os.environ.get("PT_AUTO_CKPT_DIR", "")
+        self.job_id = os.environ.get("PT_JOB_ID",
+                                     os.environ.get("PADDLE_JOB_ID",
+                                                    "default"))
+        try:
+            self.save_checkpoint_inter = int(os.environ.get(
+                "PT_CKPT_SAVE_INTER", "900"))
+        except ValueError:
+            self.save_checkpoint_inter = 900
+
+    def valid(self):
+        return bool(self._dir)
+
+    def get_job_path(self):
+        return os.path.join(self._dir, self.job_id)
+
+    def get_range_checkpoint_path(self, name):
+        return os.path.join(self.get_job_path(), f"range_{name}.json")
+
+
+def _get_checker():
+    return AutoCheckpointChecker()
+
+
+def _load_status(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {"epoch_no": -1}
+
+
+def _save_status(path, status):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(status, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
+                      name="0"):
+    """reference auto_checkpoint.py:615. Yields epoch indices,
+    SKIPPING epochs recorded complete by a previous run of the same
+    job; records completion after each yielded epoch's body finishes
+    (i.e. when the generator is resumed). Writes are throttled by
+    save_checkpoint_inter seconds (plus one final write on
+    exhaustion), so a kill re-runs the interrupted epoch AND any
+    epochs completed since the last banked write — set
+    save_checkpoint_inter=0 to bank every epoch and re-run only the
+    interrupted one."""
+    checker = _get_checker()
+    if not checker.valid():
+        # off-cloud: plain range, like the reference off PaddleCloud
+        yield from range(max_epoch_num)
+        return
+    inter = (checker.save_checkpoint_inter
+             if save_checkpoint_inter is None else save_checkpoint_inter)
+    path = checker.get_range_checkpoint_path(name)
+    status = _load_status(path)
+    start = int(status.get("epoch_no", -1)) + 1
+    last_write = time.monotonic()
+    dirty = False
+    for epoch in range(start, max_epoch_num):
+        yield epoch
+        # body completed — bank it (throttled)
+        status["epoch_no"] = epoch
+        dirty = True
+        now = time.monotonic()
+        if now - last_write >= inter:
+            _save_status(path, status)
+            last_write = now
+            dirty = False
+    if dirty:
+        _save_status(path, status)

@@ -33,23 +33,28 @@ __all__ = ["init_reductions"]
 # cannot fill /dev/shm; an undelivered payload older than the window
 # fails to rebuild, the same contract as the reference cache. The
 # atexit sweep unlinks the remainder at exit.
+import threading
 from collections import OrderedDict
 
 _sent_blocks = OrderedDict()
 _sent_bytes = [0]
+# mp.Queue serializes on its FEEDER thread, so two queues in one
+# process reduce concurrently — the cache accounting needs a lock
+_sent_lock = threading.Lock()
 _SHM_BYTES_CAP = int(__import__("os").environ.get(
     "PT_MP_SHM_BYTES", str(1 << 30)))
 
 
-def _evict_over_cap():
+def _evict_over_cap_locked():
     while _sent_bytes[0] > _SHM_BYTES_CAP and len(_sent_blocks) > 1:
         name = next(iter(_sent_blocks))
-        _release(name)
+        _release_locked(name)
 
 
 def _cleanup_all():
-    for name in list(_sent_blocks):
-        _release(name)
+    with _sent_lock:
+        for name in list(_sent_blocks):
+            _release_locked(name)
 
 
 atexit.register(_cleanup_all)
@@ -115,15 +120,47 @@ def _reduce_tensor(tensor):
     shm = shared_memory.SharedMemory(create=True, size=max(1, host.nbytes))
     view = np.ndarray(host.shape, dtype=np.uint8, buffer=shm.buf)
     view[...] = host
-    _sent_blocks[shm.name] = shm
-    _sent_bytes[0] += shm.size
-    _evict_over_cap()
+    with _sent_lock:
+        _sent_blocks[shm.name] = shm
+        _sent_bytes[0] += shm.size
+        _evict_over_cap_locked()
     return (_rebuild_tensor,
             (shm.name, tuple(tensor.shape), dtype_name,
              bool(tensor.stop_gradient)))
 
 
+def _rebuild_parameter(shm_name, shape, dtype_name, attrs):
+    t = _rebuild_tensor(shm_name, shape, dtype_name,
+                        stop_gradient=not attrs["trainable"])
+    from ..._core.tensor import Parameter
+    p = Parameter(t._value, name=attrs["name"],
+                  trainable=attrs["trainable"])
+    p.optimize_attr = attrs["optimize_attr"]
+    p.need_clip = attrs["need_clip"]
+    p.is_distributed = attrs["is_distributed"]
+    return p
+
+
+def _reduce_parameter(param):
+    """A Parameter must cross AS a Parameter: trainable/optimize_attr/
+    need_clip feed optimizers and clip on the receiving side (the
+    regularizer object does not cross — it may hold arbitrary
+    callables; the reference ships metadata only, same contract)."""
+    fn, (name, shape, dtype_name, _) = _reduce_tensor(param)
+    attrs = {"trainable": bool(param.trainable),
+             "optimize_attr": dict(param.optimize_attr or {}),
+             "need_clip": bool(param.need_clip),
+             "is_distributed": bool(param.is_distributed),
+             "name": getattr(param, "name", None)}
+    return (_rebuild_parameter, (name, shape, dtype_name, attrs))
+
+
 def _release(name):
+    with _sent_lock:
+        _release_locked(name)
+
+
+def _release_locked(name):
     shm = _sent_blocks.pop(name, None)
     if shm is not None:
         _sent_bytes[0] -= shm.size
@@ -150,4 +187,4 @@ def init_reductions():
     memory instead of pickling the bytes."""
     ForkingPickler.register(Tensor, _reduce_tensor)
     from ..._core.tensor import Parameter
-    ForkingPickler.register(Parameter, _reduce_tensor)
+    ForkingPickler.register(Parameter, _reduce_parameter)

@@ -1,0 +1,70 @@
+"""incubate.checkpoint.auto_checkpoint (reference: python/paddle/base/
+incubate/checkpoint/auto_checkpoint.py): env-driven epoch-range resume."""
+import os
+
+import pytest
+
+import paddle_tpu as pt
+
+acp = pt.incubate.checkpoint.auto_checkpoint
+
+
+@pytest.fixture
+def ckpt_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PT_AUTO_CKPT_DIR", str(tmp_path))
+    monkeypatch.setenv("PT_JOB_ID", "job1")
+    return tmp_path
+
+
+class TestTrainEpochRange:
+    def test_plain_range_without_env(self, monkeypatch):
+        monkeypatch.delenv("PT_AUTO_CKPT_DIR", raising=False)
+        assert list(acp.train_epoch_range(4)) == [0, 1, 2, 3]
+        assert not acp.AutoCheckpointChecker().valid()
+
+    def test_resume_rerun_incomplete_epoch(self, ckpt_env):
+        g = acp.train_epoch_range(5, save_checkpoint_inter=0)
+        seen = []
+        for e in g:
+            seen.append(e)
+            if e == 2:
+                g.close()          # die during epoch 2's handshake
+                break
+        assert seen == [0, 1, 2]
+        # epochs 0-1 banked; 2 not known complete -> re-run from 2
+        assert list(acp.train_epoch_range(5, save_checkpoint_inter=0)) \
+            == [2, 3, 4]
+        # exhausted job yields nothing on restart
+        assert list(acp.train_epoch_range(5, save_checkpoint_inter=0)) \
+            == []
+
+    def test_throttled_final_write(self, ckpt_env):
+        """A large save interval still banks the FINAL epoch, so a
+        finished job never re-runs."""
+        assert list(acp.train_epoch_range(3,
+                                          save_checkpoint_inter=10_000)) \
+            == [0, 1, 2]
+        assert list(acp.train_epoch_range(3,
+                                          save_checkpoint_inter=10_000)) \
+            == []
+
+    def test_ranges_isolated_by_name(self, ckpt_env):
+        assert list(acp.train_epoch_range(2, 0, name="a")) == [0, 1]
+        # a different range name has its own progress
+        assert list(acp.train_epoch_range(2, 0, name="b")) == [0, 1]
+        assert list(acp.train_epoch_range(2, 0, name="a")) == []
+
+    def test_jobs_isolated(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PT_AUTO_CKPT_DIR", str(tmp_path))
+        monkeypatch.setenv("PT_JOB_ID", "jobA")
+        assert list(acp.train_epoch_range(2, 0)) == [0, 1]
+        monkeypatch.setenv("PT_JOB_ID", "jobB")
+        assert list(acp.train_epoch_range(2, 0)) == [0, 1]
+
+    def test_status_file_is_atomic_json(self, ckpt_env):
+        list(acp.train_epoch_range(2, 0))
+        path = acp.AutoCheckpointChecker().get_range_checkpoint_path("0")
+        import json
+        assert json.load(open(path))["epoch_no"] == 1
+        assert not [f for f in os.listdir(os.path.dirname(path))
+                    if ".tmp." in f]

@@ -102,3 +102,36 @@ class TestReviewRegressions:
             assert len(red._sent_blocks) <= 3
         finally:
             red._SHM_BYTES_CAP = old_cap
+
+    def test_parameter_crosses_as_parameter(self):
+        from paddle_tpu._core.tensor import Parameter
+        lin = pt.nn.Linear(3, 3)
+        lin.weight.optimize_attr = {"learning_rate": 0.5}
+        lin.weight.need_clip = False
+        p2 = pickle.loads(bytes(ForkingPickler.dumps(lin.weight)))
+        assert isinstance(p2, Parameter)
+        assert p2.trainable and p2.optimize_attr["learning_rate"] == 0.5
+        assert p2.need_clip is False
+        assert np.allclose(p2.numpy(), lin.weight.numpy())
+
+    def test_reductions_are_opt_in(self):
+        """Bare `import paddle_tpu` must NOT rewire ForkingPickler —
+        only importing incubate.multiprocessing does."""
+        import subprocess, sys as _sys
+        code = (
+            "import jax; jax.config.update('jax_platforms','cpu')\n"
+            "import sys\n"
+            "import paddle_tpu\n"
+            "assert 'paddle_tpu.incubate.multiprocessing' not in "
+            "sys.modules, 'reductions auto-installed'\n"
+            "from multiprocessing.reduction import ForkingPickler\n"
+            "import pickle, numpy as np\n"
+            "t = paddle_tpu.to_tensor(np.ones(4, np.float32))\n"
+            "payload = bytes(ForkingPickler.dumps(t))\n"
+            "t2 = pickle.loads(payload)\n"
+            "assert np.allclose(t2.numpy(), 1.0)\n"
+            "print('OPT_IN_OK')\n")
+        r = subprocess.run([_sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "OPT_IN_OK" in r.stdout
