@@ -98,6 +98,7 @@ from .. import inference  # noqa: E402,F401  (reference re-exports it)
 from . import tensor  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
 from . import checkpoint  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
 from . import autotune  # noqa: E402,F401
 
 

@@ -1,0 +1,5 @@
+"""paddle.incubate.jit (reference: python/paddle/incubate/jit/
+{__init__,inference_decorator}.py)."""
+from .inference_decorator import inference  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,179 @@
+"""paddle.incubate.jit.inference parity (reference:
+python/paddle/incubate/jit/inference_decorator.py — wraps a function
+or method so its first call converts it to a static inference model
+under the Predictor and later calls run the compiled engine; the
+saved model caches across processes).
+
+TPU-native: trace-once jit IS the inference engine, so the decorator
+is a shape-keyed `jax.jit` over the unwrapped function with an
+optional PERSISTENT cache — with cache_static_model=True the traced
+program is serialized via jax.export to save_model_dir (default
+~/.cache/paddle_tpu/inference_models/<fn>) and a later process
+deserializes instead of retracing, the cross-process compile cache
+the reference gets from its saved inference model. TRT/CINN/IR knobs
+are accepted and ignored (XLA owns those jobs here); precision_mode
+'float16'/'bfloat16' casts floating inputs at the boundary.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, unwrap
+
+__all__ = ["inference"]
+
+
+class InferenceEngine:
+    def __init__(self, func, used_as_at_decorator, cache_static_model=False,
+                 save_model_dir=None, precision_mode=None, **knobs):
+        self.func = func
+        self.used_as_at_decorator = used_as_at_decorator
+        self.sig = inspect.signature(func)
+        if any(p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+               for p in self.sig.parameters.values()):
+            raise ValueError(
+                f"your function named {func.__name__} definition has * or "
+                "** args, please modify your function definition")
+        self.arg_names = list(self.sig.parameters)
+        if used_as_at_decorator:
+            assert self.arg_names and self.arg_names[0] == "self"
+        self.cache_static_model = bool(cache_static_model)
+        if self.cache_static_model and used_as_at_decorator:
+            # a method's compiled program bakes in ONE instance's
+            # weights; a disk cache shared across instances/processes
+            # would silently serve the wrong model's outputs
+            raise NotImplementedError(
+                "cache_static_model=True on a METHOD is unsupported: the "
+                "exported program captures one instance's weights. Use "
+                "paddle_tpu.jit.save + inference.Predictor for "
+                "cross-process model caching.")
+        if save_model_dir is None:
+            save_model_dir = os.path.join(
+                Path.home(), ".cache", "paddle_tpu", "inference_models")
+        # identity goes beyond __name__: two same-named functions with
+        # identical shapes must not load each other's exports
+        import hashlib
+        ident = hashlib.sha1(
+            f"{func.__module__}.{getattr(func, '__qualname__', func.__name__)}"
+            .encode()).hexdigest()[:8]
+        self.save_model_dir = os.path.join(
+            save_model_dir, f"{func.__name__}_{ident}")
+        self.precision_mode = precision_mode
+        self._compiled = {}     # key -> callable(*raws) -> raws
+
+    # -- helpers -------------------------------------------------------
+    def _cast(self, raw):
+        if self.precision_mode in ("float16", "bfloat16") and \
+                jnp.issubdtype(raw.dtype, jnp.floating):
+            return raw.astype(self.precision_mode)
+        return raw
+
+    def _key(self, tensor_args, static_args):
+        return (tuple((tuple(a.shape), str(a.dtype)) for a in tensor_args),
+                tuple(sorted(static_args.items())))
+
+    def _export_path(self, key):
+        import hashlib
+        h = hashlib.sha1(repr(key).encode()).hexdigest()[:16]
+        return os.path.join(self.save_model_dir, f"infer_{h}.pdexport")
+
+    def _build(self, key, tensor_args, static_args, self_obj):
+        """Compile (or load) the program for this shape signature."""
+        path = self._export_path(key)
+        if self.cache_static_model and os.path.exists(path):
+            from jax import export as jexport
+            with open(path, "rb") as f:
+                exported = jexport.deserialize(f.read())
+            return lambda *raws: exported.call(*raws)
+
+        def pure(*raws):
+            args = [Tensor(r) for r in raws]
+            it = iter(args)
+            call = []
+            for name in self.arg_names:
+                if name == "self":
+                    continue
+                call.append(static_args[name] if name in static_args
+                            else next(it))
+            out = (self.func(self_obj, *call) if self_obj is not None
+                   else self.func(*call))
+            return jax.tree_util.tree_map(
+                lambda t: unwrap(t) if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        jitted = jax.jit(pure)
+        if self.cache_static_model:
+            from jax import export as jexport
+            structs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                       for a in tensor_args]
+            exported = jexport.export(jitted)(*structs)
+            os.makedirs(self.save_model_dir, exist_ok=True)
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(exported.serialize())
+            os.replace(tmp, path)
+        return jitted
+
+    # -- call ----------------------------------------------------------
+    def run(self, self_obj, *args, **kwargs):
+        # real signature binding: defaults apply, typo'd/unknown kwargs
+        # raise TypeError exactly like the undecorated function
+        if self.used_as_at_decorator:
+            ba = self.sig.bind(self_obj, *args, **kwargs)
+        else:
+            ba = self.sig.bind(*args, **kwargs)
+        ba.apply_defaults()
+        tensor_args, static_args = [], {}
+        for name in self.arg_names:
+            if name == "self":
+                continue
+            v = ba.arguments[name]
+            if isinstance(v, Tensor):
+                tensor_args.append(self._cast(unwrap(v)))
+            elif hasattr(v, "shape") and hasattr(v, "dtype"):
+                tensor_args.append(self._cast(jnp.asarray(v)))
+            else:
+                static_args[name] = v
+        # id(self_obj): every instance gets its own compilation — the
+        # traced closure bakes THIS instance's weights in
+        key = (id(self_obj), *self._key(tensor_args, static_args))
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build(key, tensor_args, static_args, self_obj)
+            self._compiled[key] = fn
+        out = fn(*tensor_args)
+        return jax.tree_util.tree_map(Tensor, out)
+
+
+def inference(function=None, cache_static_model=False, **kwargs):
+    """reference inference_decorator.py. Use bare (`@inference`) or
+    configured (`@inference(cache_static_model=True)`), on functions or
+    methods. Shape changes retrace (and re-cache) automatically."""
+    def decorate(func):
+        used_as_at = "self" in inspect.signature(func).parameters
+        engine = InferenceEngine(func, used_as_at,
+                                 cache_static_model=cache_static_model,
+                                 **kwargs)
+
+        if used_as_at:
+            @functools.wraps(func)
+            def method(self, *args, **kw):
+                return engine.run(self, *args, **kw)
+            method._inference_engine = engine
+            return method
+
+        @functools.wraps(func)
+        def wrapper(*args, **kw):
+            return engine.run(None, *args, **kw)
+        wrapper._inference_engine = engine
+        return wrapper
+
+    if function is not None:
+        return decorate(function)
+    return decorate
