@@ -65,7 +65,12 @@ class InferenceEngine:
         self.save_model_dir = os.path.join(
             save_model_dir, f"{func.__name__}_{ident}")
         self.precision_mode = precision_mode
-        self._compiled = {}     # key -> callable(*raws) -> raws
+        self._compiled = {}     # function-form: key -> callable(*raws)
+        # method-form: per-INSTANCE caches that die with the instance
+        # (compiled closures bake the instance's weights; a map keyed
+        # by id would pin every instance alive forever)
+        import weakref
+        self._per_instance = weakref.WeakKeyDictionary()
 
     # -- helpers -------------------------------------------------------
     def _cast(self, raw):
@@ -75,8 +80,11 @@ class InferenceEngine:
         return raw
 
     def _key(self, tensor_args, static_args):
+        # repr() the static values: config args are often lists/dicts,
+        # which would make the key unhashable
         return (tuple((tuple(a.shape), str(a.dtype)) for a in tensor_args),
-                tuple(sorted(static_args.items())))
+                tuple(sorted((k, repr(v))
+                             for k, v in static_args.items())))
 
     def _export_path(self, key):
         import hashlib
@@ -92,17 +100,34 @@ class InferenceEngine:
                 exported = jexport.deserialize(f.read())
             return lambda *raws: exported.call(*raws)
 
+        # hold the instance WEAKLY: the cache value must not keep its
+        # own WeakKeyDictionary key alive. The jitted executable bakes
+        # the weights as trace-time constants; only a RE-trace (rare:
+        # jax weak-type promotion) needs the instance again.
+        import weakref
+        self_ref = weakref.ref(self_obj) if self_obj is not None else None
+
         def pure(*raws):
             args = [Tensor(r) for r in raws]
             it = iter(args)
-            call = []
-            for name in self.arg_names:
+            pos, kw = [], {}
+            for name, param in self.sig.parameters.items():
                 if name == "self":
                     continue
-                call.append(static_args[name] if name in static_args
-                            else next(it))
-            out = (self.func(self_obj, *call) if self_obj is not None
-                   else self.func(*call))
+                v = static_args[name] if name in static_args else next(it)
+                if param.kind == param.KEYWORD_ONLY:
+                    kw[name] = v    # a bare '*' makes these kw-only
+                else:
+                    pos.append(v)
+            if self_ref is not None:
+                obj = self_ref()
+                if obj is None:
+                    raise RuntimeError(
+                        "inference: the decorated method's instance was "
+                        "garbage-collected before a retrace")
+                out = self.func(obj, *pos, **kw)
+            else:
+                out = self.func(*pos, **kw)
             return jax.tree_util.tree_map(
                 lambda t: unwrap(t) if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
@@ -140,13 +165,17 @@ class InferenceEngine:
                 tensor_args.append(self._cast(jnp.asarray(v)))
             else:
                 static_args[name] = v
-        # id(self_obj): every instance gets its own compilation — the
-        # traced closure bakes THIS instance's weights in
-        key = (id(self_obj), *self._key(tensor_args, static_args))
-        fn = self._compiled.get(key)
+        key = self._key(tensor_args, static_args)
+        # per-instance cache for methods (the traced closure bakes THIS
+        # instance's weights; entries die with the instance). The key
+        # itself is instance-free so the persistent export path stays
+        # stable across processes.
+        cache = (self._compiled if self_obj is None
+                 else self._per_instance.setdefault(self_obj, {}))
+        fn = cache.get(key)
         if fn is None:
             fn = self._build(key, tensor_args, static_args, self_obj)
-            self._compiled[key] = fn
+            cache[key] = fn
         out = fn(*tensor_args)
         return jax.tree_util.tree_map(Tensor, out)
 

@@ -1,0 +1,8 @@
+"""paddle.incubate.layers (reference: python/paddle/incubate/layers/)."""
+from . import nn  # noqa: F401
+from .nn import (  # noqa: F401
+    partial_concat, partial_sum, pow2_decay_with_linear_warmup,
+    shuffle_batch,
+)
+
+__all__ = []

@@ -1,0 +1,138 @@
+"""Selected incubate.layers ops (reference: python/paddle/incubate/
+layers/nn.py — fluid contrib layers). The general-purpose ones are
+implemented TPU-native; the static-graph rec-sys specials that create
+global program state through LayerHelper (pyramid hash, tdm samplers,
+rank_attention, batch_fc, fused_bn_add_act, seqpool_cvm) raise with
+guidance — their jobs are covered by the PS tier + standard layers."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..._core.tensor import Tensor, apply, unwrap
+from ..._core.state import prng
+from ...optimizer.lr import LRScheduler
+
+__all__ = [
+    "shuffle_batch",
+    "partial_concat",
+    "partial_sum",
+    "pow2_decay_with_linear_warmup",
+]
+
+
+def shuffle_batch(x, seed=None):
+    """reference nn.py:274: randomly permute the leading dims' rows
+    (last dim rides along). seed=None draws from the framework PRNG
+    stream; an int seed is deterministic."""
+    # draw the key OUTSIDE fn: the tape's backward re-executes fn for
+    # its vjp, and a fresh next_key() there would backprop through a
+    # DIFFERENT permutation than the forward ran
+    if seed is None:
+        key = prng.next_key()
+    else:
+        key = jax.random.PRNGKey(int(unwrap(seed))
+                                 if isinstance(seed, Tensor)
+                                 else int(seed))
+
+    def fn(a):
+        lead = a.shape[:-1]
+        flat = a.reshape(-1, a.shape[-1])
+        perm = jax.random.permutation(key, flat.shape[0])
+        return flat[perm].reshape(*lead, a.shape[-1])
+    return apply(fn, x, name="shuffle_batch")
+
+
+def _col_slice(ts, start_index, length):
+    widths = {t.shape[1] for t in ts}
+    if len(widths) != 1:
+        # numpy slicing would silently CLAMP a narrower tensor's slice,
+        # concatenating/summing the wrong shape with no error
+        raise ValueError(
+            f"partial op: all inputs must share the column count, got "
+            f"{sorted(widths)}")
+    ncol = ts[0].shape[1]
+    start = start_index if start_index >= 0 else start_index + ncol
+    stop = ncol if length < 0 else start + length
+    if not (0 <= start <= ncol and start <= stop <= ncol):
+        raise ValueError(
+            f"partial op: slice [{start}:{stop}) out of bounds for "
+            f"{ncol} columns")
+    return start, stop
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """reference nn.py:346: per-tensor column slice, concatenated along
+    dim 1. 2-D inputs only (the reference's documented contract)."""
+    ts = input if isinstance(input, (list, tuple)) else [input]
+    for t in ts:
+        if len(t.shape) != 2:
+            raise ValueError("partial_concat only supports 2-D tensors")
+    start, stop = _col_slice(ts, start_index, length)
+
+    def fn(*raws):
+        return jnp.concatenate([r[:, start:stop] for r in raws], axis=1)
+    return apply(fn, *ts, name="partial_concat")
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """reference nn.py:426: per-tensor column slice, summed elementwise."""
+    ts = input if isinstance(input, (list, tuple)) else [input]
+    for t in ts:
+        if len(t.shape) != 2:
+            raise ValueError("partial_sum only supports 2-D tensors")
+    start, stop = _col_slice(ts, start_index, length)
+
+    def fn(*raws):
+        acc = raws[0][:, start:stop]
+        for r in raws[1:]:
+            acc = acc + r[:, start:stop]
+        return acc
+    return apply(fn, *ts, name="partial_sum")
+
+
+class Pow2DecayWithLinearWarmup(LRScheduler):
+    """The schedule behind reference nn.py:1297 (a static-graph op
+    updating an lr variable in place): linear warmup 0 → base_lr over
+    warmup_steps, then a squared decay down to end_lr at total_steps."""
+
+    def __init__(self, warmup_steps, total_steps, base_lr, end_lr,
+                 last_epoch=-1, verbose=False):
+        assert warmup_steps <= total_steps, \
+            "warmup_steps cannot be larger than total_steps"
+        self.warmup_steps = float(warmup_steps)
+        self.total_steps = float(total_steps)
+        self.end_lr = float(end_lr)
+        super().__init__(base_lr, last_epoch, verbose)
+
+    def get_lr(self):
+        step = max(0, self.last_epoch)
+        if step < self.warmup_steps:
+            return self.base_lr * (step + 1) / self.warmup_steps
+        frac = min(1.0, (step - self.warmup_steps)
+                   / max(1.0, self.total_steps - self.warmup_steps))
+        factor = (1.0 - frac) ** 2
+        return (self.base_lr - self.end_lr) * factor + self.end_lr
+
+
+def pow2_decay_with_linear_warmup(warmup_steps, total_steps, base_lr,
+                                  end_lr, dtype="float32", name=None):
+    """reference nn.py:1297. The reference raises in dygraph and only
+    works as a static op; here the schedule is a first-class
+    LRScheduler usable anywhere an optimizer takes one."""
+    return Pow2DecayWithLinearWarmup(warmup_steps, total_steps,
+                                     base_lr, end_lr)
+
+
+def __getattr__(name):
+    _STATIC_ONLY = {"fused_seqpool_cvm", "search_pyramid_hash",
+                    "tdm_child", "tdm_sampler", "rank_attention",
+                    "batch_fc", "fused_bn_add_act", "correlation",
+                    "fused_embedding_seq_pool", "multiclass_nms2"}
+    if name in _STATIC_ONLY:
+        raise NotImplementedError(
+            f"incubate.layers.{name} is a fluid static-graph contrib op "
+            "that creates program-global state; on paddle_tpu use the "
+            "equivalent standard surface (PS tier for sparse rec-sys "
+            "tables, nn layers + XLA fusion for fused blocks)")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

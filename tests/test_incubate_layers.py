@@ -1,0 +1,118 @@
+"""incubate.layers (reference: python/paddle/incubate/layers/nn.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+L = pt.incubate.layers
+
+
+class TestPartialOps:
+    def test_partial_concat_doc_example(self):
+        x = pt.to_tensor(np.array([[0, 1, 2], [3, 4, 5]], np.float32))
+        y = pt.to_tensor(np.array([[6, 7, 8], [9, 10, 11]], np.float32))
+        out = L.partial_concat([x, y], start_index=0, length=2)
+        assert out.numpy().tolist() == [[0, 1, 6, 7], [3, 4, 9, 10]]
+
+    def test_partial_sum_doc_example(self):
+        x = pt.to_tensor(np.array([[0, 1, 2], [3, 4, 5]], np.float32))
+        y = pt.to_tensor(np.array([[6, 7, 8], [9, 10, 11]], np.float32))
+        out = L.partial_sum([x, y], start_index=0, length=2)
+        assert out.numpy().tolist() == [[6, 8], [12, 14]]
+
+    def test_negative_start_and_full_length(self):
+        x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        out = L.partial_concat([x], start_index=-2, length=-1)
+        assert out.numpy().tolist() == [[1, 2], [4, 5]]
+
+    def test_out_of_bounds_raises(self):
+        x = pt.to_tensor(np.zeros((2, 3), np.float32))
+        with pytest.raises(ValueError, match="out of bounds"):
+            L.partial_sum([x], start_index=2, length=5)
+        with pytest.raises(ValueError, match="2-D"):
+            L.partial_concat([pt.zeros([2, 2, 2])])
+
+    def test_gradients_flow(self):
+        x = pt.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+        y = pt.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+        L.partial_sum([x, y], 1, 2).sum().backward()
+        assert x.grad.numpy().tolist() == [[0, 1, 1], [0, 1, 1]]
+        assert y.grad.numpy().tolist() == [[0, 1, 1], [0, 1, 1]]
+
+
+class TestShuffleBatch:
+    def test_rows_preserved(self):
+        x = pt.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+        out = L.shuffle_batch(x, seed=2019)
+        assert sorted(map(tuple, out.numpy().tolist())) == \
+            [(0, 1), (2, 3), (4, 5), (6, 7)]
+
+    def test_seed_determinism(self):
+        x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+        a = L.shuffle_batch(x, seed=7).numpy()
+        b = L.shuffle_batch(x, seed=7).numpy()
+        assert np.allclose(a, b)
+
+    def test_nd_last_dim_rides(self):
+        x = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(2, 3, 2))
+        out = L.shuffle_batch(x, seed=0)
+        assert out.shape == [2, 3, 2]
+        rows = out.numpy().reshape(-1, 2)
+        assert sorted(map(tuple, rows.tolist())) == \
+            sorted(map(tuple, x.numpy().reshape(-1, 2).tolist()))
+
+
+class TestPow2Decay:
+    def test_warmup_then_squared_decay(self):
+        s = L.pow2_decay_with_linear_warmup(10, 110, 0.1, 0.001)
+        lrs = []
+        for _ in range(110):
+            lrs.append(s())
+            s.step()
+        # linear warmup reaches base_lr at the end of warmup
+        assert abs(lrs[9] - 0.1) < 1e-9
+        assert lrs[0] < lrs[4] < lrs[9]
+        # squared decay: monotonic down to end_lr
+        assert all(a >= b for a, b in zip(lrs[9:], lrs[10:]))
+        assert abs(lrs[-1] - 0.001) < 5e-3
+
+    def test_warmup_gt_total_rejected(self):
+        with pytest.raises(AssertionError):
+            L.pow2_decay_with_linear_warmup(100, 10, 0.1, 0.0)
+
+
+def test_static_only_ops_raise_with_guidance():
+    for name in ("batch_fc", "rank_attention", "tdm_sampler",
+                 "fused_bn_add_act", "search_pyramid_hash"):
+        with pytest.raises(NotImplementedError, match="static-graph"):
+            getattr(L.nn, name)
+    with pytest.raises(AttributeError):
+        L.nn.totally_unknown_op
+
+
+class TestReviewRegressions:
+    def test_shuffle_batch_gradients_follow_forward_permutation(self):
+        """seed=None: the tape's vjp re-executes the op fn — the key
+        must be drawn OUTSIDE so backward uses the SAME permutation."""
+        pt.seed(0)
+        xn = np.arange(8, dtype=np.float32).reshape(4, 2)
+        x = pt.to_tensor(xn, stop_gradient=False)
+        out = L.shuffle_batch(x)           # seed=None path
+        w = pt.to_tensor(np.array([[1.], [2.], [3.], [4.]], np.float32))
+        (out * w).sum().backward()
+        # find where each input row landed; its grad must equal that
+        # row's weight
+        on = out.numpy()
+        g = x.grad.numpy()
+        for i in range(4):
+            j = next(j for j in range(4)
+                     if np.allclose(on[j], xn[i]))
+            assert np.allclose(g[i], w.numpy()[j]), (i, j, g)
+
+    def test_mismatched_widths_rejected(self):
+        a = pt.zeros([2, 5])
+        b = pt.zeros([2, 3])
+        with pytest.raises(ValueError, match="column count"):
+            L.partial_concat([a, b], 0, 4)
+        with pytest.raises(ValueError, match="column count"):
+            L.partial_sum([a, b], 0, 2)

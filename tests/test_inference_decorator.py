@@ -155,3 +155,71 @@ class TestReviewRegressions:
                 @inference(cache_static_model=True)
                 def fwd(self, x):
                     return x
+
+    def test_keyword_only_params(self):
+        @inference
+        def f(x, *, temperature=2.0):
+            return x / temperature
+
+        x = pt.to_tensor(np.full(3, 6.0, np.float32))
+        assert np.allclose(f(x).numpy(), 3.0)
+        assert np.allclose(f(x, temperature=3.0).numpy(), 2.0)
+
+    def test_unhashable_static_args(self):
+        @inference
+        def f(x, sizes):
+            return x * float(sum(sizes))
+
+        x = pt.to_tensor(np.ones(2, np.float32))
+        assert np.allclose(f(x, [1, 2]).numpy(), 3.0)
+        assert np.allclose(f(x, [1, 2, 3]).numpy(), 6.0)
+
+    def test_persistent_cache_key_is_process_stable(self, tmp_path):
+        """The export filename must not depend on id(None)/ASLR — a
+        second process has to compute the SAME path."""
+        import subprocess, sys as _sys
+        code = f"""
+import jax; jax.config.update('jax_platforms','cpu')
+import sys, os; sys.path.insert(0, {os.getcwd()!r})
+import numpy as np
+import paddle_tpu as pt
+from paddle_tpu.incubate.jit import inference
+@inference(cache_static_model=True, save_model_dir={str(tmp_path)!r})
+def fn(x):
+    return x * 3.0
+out = fn(pt.to_tensor(np.ones(4, np.float32)))
+assert np.allclose(out.numpy(), 3.0)
+print("EXPORTS:" + ";".join(sorted(
+    f for d in os.listdir({str(tmp_path)!r})
+    for f in os.listdir(os.path.join({str(tmp_path)!r}, d)))))
+"""
+        runs = []
+        for _ in range(2):
+            r = subprocess.run([_sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=300)
+            assert r.returncode == 0, r.stderr[-2000:]
+            runs.append([ln for ln in r.stdout.splitlines()
+                         if ln.startswith("EXPORTS:")][0])
+        # same single export file in both processes — the second LOADED
+        # instead of writing a second orphan
+        assert runs[0] == runs[1] and runs[0].count(".pdexport") == 1, runs
+
+    def test_instances_garbage_collect(self):
+        import gc, weakref
+
+        class M(pt.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = pt.nn.Linear(4, 4)
+
+            @inference
+            def fwd(self, x):
+                return self.lin(x)
+
+        m = M()
+        m.fwd(pt.randn([2, 4]))
+        ref = weakref.ref(m)
+        del m
+        gc.collect()
+        assert ref() is None, "engine cache pinned the instance"
