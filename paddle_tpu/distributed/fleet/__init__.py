@@ -456,3 +456,6 @@ class MultiSlotDataGenerator:
 class MultiSlotStringDataGenerator:
     def __init__(self, *a, **kw):
         raise NotImplementedError(_PS_DATAGEN_MSG)
+
+
+from . import utils  # noqa: E402,F401  (fleet.utils: LocalFS/HDFSClient/recompute)
