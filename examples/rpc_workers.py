@@ -1,0 +1,66 @@
+"""Named-worker RPC: a two-process control plane.
+
+    python examples/rpc_workers.py
+
+Shows: paddle_tpu.distributed.rpc (reference paddle.distributed.rpc) —
+rank 0 spawns rank 1, both rendezvous at a master TCP store, and the
+driver farms Python work (here: tokenization-ish string chores and a
+numpy reduction) to the worker by NAME, sync and async. This is the
+host-side control plane; device compute stays on the SPMD path.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu.distributed.rpc as rpc  # noqa: E402
+
+
+def chunk_lengths(texts):
+    return [len(t.split()) for t in texts]
+
+
+def square_sum(n):
+    return sum(i * i for i in range(n))
+
+
+def main():
+    if os.environ.get("RPC_RANK") == "1":
+        rpc.init_rpc("worker", rank=1, world_size=2)
+        rpc.shutdown()          # serves until the driver's barrier
+        return
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ, RPC_RANK="1",
+               PADDLE_MASTER_ENDPOINT=f"127.0.0.1:{port}")
+    worker = subprocess.Popen([sys.executable, __file__], env=env)
+    try:
+        rpc.init_rpc("driver", rank=0, world_size=2,
+                     master_endpoint=f"127.0.0.1:{port}")
+        print("workers:", [i.name for i in rpc.get_all_worker_infos()])
+        out = rpc.rpc_sync("worker", chunk_lengths,
+                           args=(["to the moon", "paddle on tpu"],))
+        print("chunk_lengths on worker ->", out)
+        futs = [rpc.rpc_async("worker", square_sum, args=(n,))
+                for n in (10, 100, 1000)]
+        print("square sums ->", [f.wait() for f in futs])
+        rpc.shutdown()
+        worker.wait(timeout=60)
+    finally:
+        # a driver-side failure must not mask the real error with a
+        # wait timeout, nor orphan the worker in its 900s rendezvous
+        if worker.poll() is None:
+            worker.kill()
+            worker.wait()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()
