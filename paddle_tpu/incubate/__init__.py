@@ -100,6 +100,7 @@ from . import distributed  # noqa: E402,F401
 from . import checkpoint  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import layers  # noqa: E402,F401
+from . import operators  # noqa: E402,F401
 from . import autotune  # noqa: E402,F401
 
 

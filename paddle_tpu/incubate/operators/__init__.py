@@ -1,0 +1,10 @@
+"""paddle.incubate.operators (reference: python/paddle/incubate/
+operators/): the softmax_mask_fuse pair lives at the incubate top
+level (reference re-exports); ResNetUnit here."""
+from .resnet_unit import ResNetUnit  # noqa: F401
+from .. import (  # noqa: F401
+    graph_khop_sampler, graph_reindex, graph_sample_neighbors,
+    graph_send_recv, softmax_mask_fuse, softmax_mask_fuse_upper_triangle,
+)
+
+__all__ = []

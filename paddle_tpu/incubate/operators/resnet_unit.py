@@ -1,0 +1,85 @@
+"""ResNetUnit (reference: python/paddle/incubate/operators/
+resnet_unit.py — a cudnnv8 fused conv+BN(+add)+act block).
+
+TPU-native: the unit is the same conv → BN → (+shortcut) → act
+composition over our Conv2D/BatchNorm layers; "fused" is XLA's job —
+under jit the whole unit compiles into fused convolution/normalization
+kernels, which is exactly what the cudnnv8 runtime fusion buys the
+reference. Semantics (including has_shortcut vs fuse_add) follow the
+reference's forward: out = act(BN(conv(x)) + residual) where residual
+is BN(conv(z)) when has_shortcut else z when fuse_add.
+"""
+from __future__ import annotations
+
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.layers import Layer
+from ...nn.layer.norm import BatchNorm2D
+from ...nn import functional as F
+
+__all__ = ["ResNetUnit"]
+
+_ACTS = {"relu": F.relu, "identity": None, None: None}
+
+
+class ResNetUnit(Layer):
+    def __init__(self, num_channels_x, num_filters, filter_size,
+                 stride=1, momentum=0.9, eps=1e-5, data_format="NHWC",
+                 act="relu", fuse_add=False, has_shortcut=False,
+                 use_global_stats=False, is_test=False,
+                 filter_x_attr=None, scale_x_attr=None, bias_x_attr=None,
+                 moving_mean_x_name=None, moving_var_x_name=None,
+                 num_channels_z=1, stride_z=1, filter_z_attr=None,
+                 scale_z_attr=None, bias_z_attr=None,
+                 moving_mean_z_name=None, moving_var_z_name=None):
+        super().__init__()
+        if data_format not in ("NHWC", "NCHW"):
+            raise ValueError(
+                f"conv_format must be one of {{'NHWC', 'NCHW'}}, but got "
+                f"conv_format='{data_format}'")
+        if act not in _ACTS:
+            raise ValueError(f"ResNetUnit only supports act in "
+                             f"{sorted(k for k in _ACTS if k)}, got {act!r}")
+        self._fuse_add = fuse_add
+        self._has_shortcut = has_shortcut
+        self._act = act
+        padding = (filter_size - 1) // 2
+        self.conv_x = Conv2D(num_channels_x, num_filters, filter_size,
+                             stride=stride, padding=padding,
+                             weight_attr=filter_x_attr, bias_attr=False,
+                             data_format=data_format)
+        self.bn_x = BatchNorm2D(num_filters, momentum=momentum,
+                                epsilon=eps, weight_attr=scale_x_attr,
+                                bias_attr=bias_x_attr,
+                                data_format=data_format,
+                                use_global_stats=use_global_stats)
+        if has_shortcut:
+            self.conv_z = Conv2D(num_channels_z, num_filters, 1,
+                                 stride=stride_z, padding=0,
+                                 weight_attr=filter_z_attr,
+                                 bias_attr=False,
+                                 data_format=data_format)
+            self.bn_z = BatchNorm2D(num_filters, momentum=momentum,
+                                    epsilon=eps, weight_attr=scale_z_attr,
+                                    bias_attr=bias_z_attr,
+                                    data_format=data_format,
+                                    use_global_stats=use_global_stats)
+        else:
+            self.conv_z = None
+            self.bn_z = None
+        if is_test:
+            # reference is_test=True: inference behavior from
+            # construction — moving statistics, no buffer mutation
+            self.eval()
+
+    def forward(self, x, z=None):
+        out = self.bn_x(self.conv_x(x))
+        if self._has_shortcut:
+            if z is None:
+                raise ValueError("has_shortcut=True requires z")
+            out = out + self.bn_z(self.conv_z(z))
+        elif self._fuse_add:
+            if z is None:
+                raise ValueError("fuse_add=True requires z")
+            out = out + z
+        fn = _ACTS[self._act]
+        return fn(out) if fn is not None else out

@@ -20,7 +20,11 @@ class _BatchNormBase(Layer):
         self._momentum = momentum
         self._epsilon = epsilon
         self._data_format = data_format
-        self._use_global_stats = use_global_stats
+        # False and None are EQUIVALENT in dygraph (reference
+        # BatchNorm semantics): both mean "batch stats while training,
+        # moving stats in eval". A literal False reaching F.batch_norm
+        # would force batch statistics even in eval mode.
+        self._use_global_stats = use_global_stats or None
         self.weight = self.create_parameter(
             shape=[num_features], attr=weight_attr,
             default_initializer=Constant(1.0)) if weight_attr is not False else None

@@ -1,0 +1,95 @@
+"""incubate.operators.ResNetUnit (reference: python/paddle/incubate/
+operators/resnet_unit.py — the cudnnv8 fused block; here XLA fuses the
+same conv+BN(+add)+act composition)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.operators import ResNetUnit
+
+
+class TestResNetUnit:
+    def test_shortcut_branch_matches_unfused(self):
+        pt.seed(0)
+        u = ResNetUnit(8, 16, 3, stride=2, data_format="NHWC",
+                       has_shortcut=True, num_channels_z=8, stride_z=2)
+        x = pt.randn([2, 16, 16, 8])
+        out = u(x, x)
+        assert out.shape == [2, 8, 8, 16]
+        manual = pt.nn.functional.relu(
+            u.bn_x(u.conv_x(x)) + u.bn_z(u.conv_z(x)))
+        assert np.allclose(out.numpy(), manual.numpy(), atol=1e-5)
+
+    def test_fuse_add_branch(self):
+        pt.seed(1)
+        u = ResNetUnit(8, 8, 3, fuse_add=True, data_format="NHWC")
+        x, z = pt.randn([2, 12, 12, 8]), pt.randn([2, 12, 12, 8])
+        out = u(x, z)
+        manual = pt.nn.functional.relu(u.bn_x(u.conv_x(x)) + z)
+        assert np.allclose(out.numpy(), manual.numpy(), atol=1e-5)
+
+    def test_plain_branch_nchw_identity_act(self):
+        pt.seed(2)
+        u = ResNetUnit(4, 8, 3, data_format="NCHW", act="identity")
+        x = pt.randn([2, 4, 10, 10])
+        out = u(x)
+        manual = u.bn_x(u.conv_x(x))
+        assert np.allclose(out.numpy(), manual.numpy(), atol=1e-5)
+
+    def test_train_eval_statistics(self):
+        u = ResNetUnit(4, 8, 3, data_format="NHWC")
+        x = pt.randn([4, 8, 8, 4]) * 3.0 + 1.0
+        u.train()
+        u(x)
+        mean_after = u.bn_x._mean.numpy().copy()
+        assert np.abs(mean_after).sum() > 0      # running stats updated
+        u.eval()
+        before = u.bn_x._mean.numpy().copy()
+        u(x)
+        assert np.allclose(u.bn_x._mean.numpy(), before)  # frozen in eval
+
+    def test_gradients_flow(self):
+        u = ResNetUnit(4, 8, 3, data_format="NHWC", has_shortcut=True,
+                       num_channels_z=4)
+        xn = np.random.RandomState(0).randn(2, 8, 8, 4).astype(np.float32)
+        x = pt.to_tensor(xn, stop_gradient=False)
+        u(x, x).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+        assert u.conv_x.weight.grad is not None
+        assert u.conv_z.weight.grad is not None
+
+    def test_guards(self):
+        with pytest.raises(ValueError, match="conv_format"):
+            ResNetUnit(4, 8, 3, data_format="NHCW")
+        with pytest.raises(ValueError, match="act"):
+            ResNetUnit(4, 8, 3, act="gelu")
+        u = ResNetUnit(4, 8, 3, has_shortcut=True, num_channels_z=4)
+        with pytest.raises(ValueError, match="requires z"):
+            u(pt.randn([1, 8, 8, 4]))
+
+    def test_is_test_gives_inference_behavior(self):
+        u = ResNetUnit(4, 8, 3, data_format="NHWC", is_test=True)
+        assert not u.training
+        x = pt.randn([2, 8, 8, 4]) * 2.0
+        before = u.bn_x._mean.numpy().copy()
+        u(x)
+        assert np.allclose(u.bn_x._mean.numpy(), before)
+
+    def test_use_global_stats_false_equals_none_in_eval(self):
+        """dygraph semantics: False and None both mean batch stats in
+        train, MOVING stats in eval (a literal False must not force
+        batch statistics into eval mode)."""
+        pt.seed(5)
+        a = pt.nn.BatchNorm2D(4, use_global_stats=False,
+                              data_format="NHWC")
+        b = pt.nn.BatchNorm2D(4, use_global_stats=None,
+                              data_format="NHWC")
+        x = pt.randn([2, 6, 6, 4]) * 3.0 + 1.0
+        for m in (a, b):
+            m.train(); m(x); m.eval()
+        oa, ob = a(x).numpy(), b(x).numpy()
+        assert np.allclose(oa, ob, atol=1e-6)
+        # and eval output is NOT the batch-normalized x (which would be
+        # ~zero-mean): moving stats differ from batch stats after one
+        # momentum update
+        assert np.abs(oa.mean()) > 1e-3
