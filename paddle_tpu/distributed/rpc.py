@@ -245,7 +245,7 @@ class RpcAgent:
     run several agents in one process; the module-level API drives a
     process singleton like the reference agent."""
 
-    def __init__(self, name, rank, world_size, store):
+    def __init__(self, name, rank, world_size, store, barrier=True):
         self.name = name
         self.rank = rank
         self.world_size = world_size
@@ -258,6 +258,12 @@ class RpcAgent:
             max_workers=int(os.environ.get("PT_RPC_THREADS", "8")),
             thread_name_prefix=f"pt-rpc-out-{name}")
         self._stop = threading.Event()
+        # inbound calls may arrive while this process is still mid-
+        # rendezvous (a peer's barrier only proves RANK 0 finished, not
+        # everyone): hold them until the agent is fully wired — for
+        # init_rpc, until the module-level _agent is published, so a
+        # remote fn calling get_current_worker_info() can't race it
+        self._ready = threading.Event()
         host = os.environ.get("PT_RPC_BIND", "127.0.0.1")
         endpoint = os.environ.get("PADDLE_WORKER_ENDPOINT")
         if endpoint:
@@ -291,7 +297,10 @@ class RpcAgent:
                 seen.add(info.name)
                 infos.append(WorkerInfo(*info))
             self._infos = {i.name: i for i in infos}
-            self.barrier()  # all servers up before anyone issues a call
+            if barrier:
+                self._ready.set()
+                # all servers up before anyone issues a call
+                self.barrier()
         except BaseException:
             # a half-built agent must not hold its port/threads — a
             # same-process retry would die with EADDRINUSE
@@ -313,6 +322,7 @@ class RpcAgent:
     def _handle(self, conn):
         try:
             with conn:
+                self._ready.wait(timeout=900)
                 fn, args, kwargs = pickle.loads(_recv_frame(conn))
                 try:
                     out = ("ok", fn(*args, **kwargs))
@@ -413,10 +423,25 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     timeout = float(os.environ.get("FLAGS_stop_check_timeout", "900"))
     store = _TCPStore(host, int(port), rank == 0, timeout=timeout)
     try:
-        _agent = RpcAgent(name, rank, world_size, store)
+        # publish the agent BEFORE the all-up barrier: our server
+        # thread starts serving during rendezvous, and a fast peer may
+        # deliver a call (which resolves module state like
+        # get_current_worker_info through _agent) the moment ITS
+        # barrier completes — publishing after would race that call
+        # into 'init_rpc() has not been called'
+        agent = RpcAgent(name, rank, world_size, store, barrier=False)
+        _agent = agent
+        agent._ready.set()   # inbound handlers may now resolve _agent
+        agent.barrier()
     except BaseException:
+        _agent = None
         # a failed init must release the master port so a corrected
-        # retry in this process doesn't hit EADDRINUSE
+        # retry in this process doesn't hit EADDRINUSE; the half-built
+        # agent must release its port/threads too
+        try:
+            agent.stop()
+        except Exception:   # incl. NameError when RpcAgent() itself threw
+            pass
         store.stop()
         raise
     return _agent
