@@ -477,12 +477,20 @@ def get_current_worker_info():
 
 def shutdown():
     """Barrier (all outstanding work done everywhere), stop the server,
-    destroy the agent (rpc.py:316). Master's store stops last."""
+    destroy the agent (rpc.py:316). Master's store stops last.
+
+    The agent stays PUBLISHED through the barrier: a fast rank reaches
+    shutdown while slower peers are still issuing calls, and those
+    inbound calls may resolve module state (get_current_worker_info) —
+    un-publishing first made them fail with 'init_rpc() has not been
+    called' under load (the start-side twin of this race is handled by
+    the _ready gate)."""
     global _agent
     if _agent is None:
         return
-    agent, _agent = _agent, None
-    agent.barrier()
+    agent = _agent
+    agent.barrier()          # every peer is done issuing work
+    _agent = None
     agent.stop()
     if agent.rank == 0:
         agent._store.stop()

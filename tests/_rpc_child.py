@@ -37,6 +37,13 @@ def main():
     other = f"worker{1 - rank}"
 
     assert rpc.rpc_sync(other, mul, args=(6, 7)) == 42
+    if rank == 0 and os.environ.get("RPC_CHILD_SKEW"):
+        # widen the finish-line skew: rank 1 races ahead into
+        # shutdown() and must KEEP serving module-state calls while it
+        # waits in the shutdown barrier (regression for the
+        # '_agent unset before barrier' race)
+        import time
+        time.sleep(float(os.environ["RPC_CHILD_SKEW"]))
     fut = rpc.rpc_async(other, whoami)
     assert fut.wait() == other, fut
 

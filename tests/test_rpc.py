@@ -241,3 +241,32 @@ def test_two_process_rpc():
                 f"rank {rank} failed:\n{errs[rank][-2000:]}"
             assert f"RPC_OK rank={rank}" in outs[rank]
         return
+
+
+def test_two_process_rpc_with_finish_skew():
+    """Rank 1 sprints to shutdown() while rank 0 is still issuing
+    module-state calls (get_current_worker_info): the agent must stay
+    published through the shutdown barrier. This skew reproduced the
+    full-suite 'init_rpc() has not been called' failure
+    deterministically before the fix."""
+    child = os.path.join(HERE, "_rpc_child.py")
+    port = _free_port()
+    procs = []
+    try:
+        for rank in range(2):
+            env = dict(os.environ,
+                       PADDLE_TRAINER_ID=str(rank),
+                       PADDLE_MASTER_ENDPOINT=f"127.0.0.1:{port}",
+                       RPC_CHILD_SKEW="1.5")
+            procs.append(subprocess.Popen(
+                [sys.executable, child], env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        for rank, p in enumerate(procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"rank {rank} failed:\n{err[-2000:]}"
+            assert f"RPC_OK rank={rank}" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
