@@ -142,7 +142,7 @@ def _eager_psum(raw, op, mesh, spec, axes):
     """Real reduction of a sharded eager array: each shard is one
     participant (paddle rank semantics); result is the reduced shard,
     replicated over the reduced axes."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map  # jax.experimental.shard_map is deprecated in 0.8
 
     fn = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
           ReduceOp.MIN: lax.pmin, ReduceOp.AVG: lax.pmean}.get(op)
@@ -265,7 +265,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         return out
     mesh, spec, axes = _eager_mesh_axes(raw, ax)
     if mesh is not None and axes:
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map  # jax.experimental.shard_map is deprecated in 0.8
         a, dim = _resolve_group_axis(mesh, spec, axes, ax, "reduce_scatter")
         if dim != 0:
             raise NotImplementedError(
