@@ -237,6 +237,13 @@ def bench_serving(on_tpu):
                             max_seq_len=max_seq_len, page_size=page,
                             dtype=dtype, cache_dtype=cache_dtype,
                             spec_decode=spec_g)
+        # serving-runtime telemetry rides the same engine hooks the
+        # HTTP frontend uses; the timed run's snapshot ships in the
+        # artifact so the driver sees TTFT/occupancy, not just tok/s
+        from paddle_tpu.serving.metrics import (EngineMetrics,
+                                                MetricsRegistry)
+        eng._bench_registry = MetricsRegistry()
+        eng.metrics = EngineMetrics(eng._bench_registry)
         for i, prompt in enumerate(prompts):
             eng.submit(Request(f"r{i}", prompt, max_new_tokens=nt))
         t0 = time.perf_counter()
@@ -253,12 +260,28 @@ def bench_serving(on_tpu):
                      + (eng.k_scale.nbytes + eng.v_scale.nbytes
                         if eng.cache_quant else 0))
     capacity_tokens = (eng.num_pages - 1) * eng.page_size
+    snap = eng._bench_registry.snapshot()
     out = {"decode_tokens_per_sec": round(total_new / dt, 1),
            "requests": nreq, "new_tokens": total_new, "batch": max_seqs,
            "cache_dtype": cache_dtype or str(jnp.dtype(dtype).name),
            "kv_pool_bytes": pool_bytes,
            "kv_bytes_per_token": round(pool_bytes / capacity_tokens, 1),
            "step_time_s": round(dt / max(total_new, 1), 5),
+           "metrics": {
+               "ttft_p50_s": round(snap["pt_serving_ttft_seconds"]["p50"], 5),
+               "ttft_p99_s": round(snap["pt_serving_ttft_seconds"]["p99"], 5),
+               "ttft_count": snap["pt_serving_ttft_seconds"]["count"],
+               "tpot_p50_s": round(snap["pt_serving_tpot_seconds"]["p50"], 6),
+               "queue_depth_peak":
+                   snap["pt_serving_queue_depth_peak"]["value"],
+               "batch_occupancy":
+                   snap["pt_serving_batch_occupancy"]["value"],
+               "generated_tokens":
+                   snap["pt_serving_generated_tokens"]["value"],
+               "device_steps": snap["pt_serving_device_steps"]["value"],
+               "preemptions": snap["pt_serving_preemptions"]["value"],
+               "page_allocs": snap["pt_serving_page_allocs"]["value"],
+           },
            "loss": 0.0}
     if spec > 1:
         # plain decode on the IDENTICAL workload, same engine config —

@@ -322,7 +322,15 @@ class RpcAgent:
     def _handle(self, conn):
         try:
             with conn:
-                self._ready.wait(timeout=900)
+                if not self._ready.wait(timeout=900):
+                    # the gate never opened (init failed or wedged):
+                    # refuse the call instead of executing against a
+                    # half-initialized agent
+                    _send_frame(conn, pickle.dumps(
+                        ("exc", RuntimeError(
+                            f"rpc: agent {self.name!r} not ready within "
+                            "900s; refusing inbound call"))))
+                    return
                 fn, args, kwargs = pickle.loads(_recv_frame(conn))
                 try:
                     out = ("ok", fn(*args, **kwargs))

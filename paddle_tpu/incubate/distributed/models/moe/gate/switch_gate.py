@@ -44,9 +44,12 @@ class SwitchGate(NaiveGate):
             valid = jax.nn.one_hot(jnp.where(kept < 0, 0, kept)[:, 0],
                                    tot, dtype=jnp.float32)
             valid = valid * (kept[:, :1] >= 0)
-            fraction = jnp.sum(valid, axis=0) / jnp.maximum(
-                jnp.sum(valid), 1.0)
-            prob = jnp.mean(sc, axis=0)
+            # reference normalizes BOTH factors by the capacity-kept
+            # assignment count (valid_idx.numel()), not by T — the
+            # scales only coincide while the cap never binds
+            kept_n = jnp.maximum(jnp.sum(valid), 1.0)
+            fraction = jnp.sum(valid, axis=0) / kept_n
+            prob = jnp.sum(sc, axis=0) / kept_n
             return jnp.sum(fraction * prob) * tot
 
         self.set_loss(apply(aux, score, Tensor(idx), name="switch_aux"))

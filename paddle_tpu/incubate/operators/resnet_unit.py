@@ -42,6 +42,13 @@ class ResNetUnit(Layer):
         self._fuse_add = fuse_add
         self._has_shortcut = has_shortcut
         self._act = act
+        # op-level contract (reference resnet_unit op): the kernel
+        # reads use_global_stats alongside is_test — False is the
+        # DEFAULT "batch stats in train, moving stats in test" mode,
+        # unlike the dygraph BatchNorm layer where an explicit False
+        # forces trainable (mini-batch) statistics even in eval. Map
+        # the op default to the layer's None before constructing BN.
+        use_global_stats = use_global_stats or None
         padding = (filter_size - 1) // 2
         self.conv_x = Conv2D(num_channels_x, num_filters, filter_size,
                              stride=stride, padding=padding,

@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..profiler import record_span
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
 from ..ops.paged_attention import (paged_attention, paged_verify_attention,
@@ -419,6 +421,12 @@ class Request:
         self.output = []
         self.slot = None
         self.next_token = None
+        # runtime accounting (paddle_tpu.serving): cancellation flag is
+        # honored at step boundaries; timestamps feed TTFT/TPOT metrics
+        self.cancelled = False
+        self._t_submit = None
+        self._t_first = None
+        self._t_last = None
         # logprobs=True: record log p(token | context) under the RAW
         # model distribution for every emitted token (reference parity:
         # the predictor's return_full_hidden/logprob outputs; vLLM
@@ -563,6 +571,10 @@ class ServingEngine:
         self.spec_drafted = 0    # draft tokens fed to verify
         self.spec_accepted = 0   # draft tokens accepted
         self.device_steps = 0    # decode/verify device calls
+        # optional telemetry sink (paddle_tpu.serving.metrics
+        # EngineMetrics duck type): the step loop reports TTFT/TPOT,
+        # occupancy, page stats, and preemptions into it. None = free.
+        self.metrics = None
         self._order = 0
         kvh = c.num_key_value_heads
         hd = c.hidden_size // c.num_attention_heads
@@ -619,10 +631,11 @@ class ServingEngine:
         self._interpret = interpret
 
     # -- request admission ------------------------------------------------
-    def submit(self, req: Request):
-        """Validate-or-reject now; queue what fits. Raises ValueError
-        for requests that could NEVER run (clear engine-level error
-        instead of a deep PagedKVCache failure mid-decode)."""
+    def validate(self, req: Request):
+        """Raise ValueError for a request that could NEVER run (clear
+        engine-level error instead of a deep PagedKVCache failure
+        mid-decode). Separated from submit() so frontends can
+        admit-or-refuse before queueing."""
         S = len(req.prompt)
         if S == 0:
             raise ValueError("serving: empty prompt")
@@ -633,7 +646,87 @@ class ServingEngine:
                 f"{self.max_seq_len}; truncate the prompt, lower "
                 "max_new_tokens, or build the engine with a larger "
                 "max_seq_len")
+
+    def submit(self, req: Request):
+        """Validate-or-reject now; queue what fits."""
+        self.validate(req)
+        if req._t_submit is None:
+            req._t_submit = time.perf_counter()
         self._waiting.append(req)
+        m = self.metrics
+        if m is not None:
+            m.on_submit(self)
+
+    def cancel(self, req: Request):
+        """Cancel a queued or active request: queued requests leave
+        the waiting queue immediately; an active slot is released (its
+        pages return to the pool) at the next step() boundary. Either
+        way the request lands in `finished` with req.cancelled=True
+        and whatever output it already produced. NOT thread-safe —
+        call from the thread driving step() (the scheduler's pump).
+        Returns True if the request was queued or active."""
+        req.cancelled = True
+        if req in self._waiting:
+            self._waiting.remove(req)
+            req._offload = None
+            self.finished.append(req)
+            m = self.metrics
+            if m is not None:
+                m.on_cancel("queued")
+            return True
+        return req.slot is not None
+
+    def _sweep_cancelled(self):
+        """Release slots (and drop queued entries) whose requests were
+        cancelled since the last step."""
+        m = self.metrics
+        for s, r in enumerate(self._slots):
+            if r is not None and r.cancelled:
+                self.finished.append(r)
+                self._release(s)
+                r.slot = None
+                if m is not None:
+                    m.on_cancel("active")
+        if any(r.cancelled for r in self._waiting):
+            keep = []
+            for r in self._waiting:
+                if r.cancelled:
+                    r._offload = None
+                    self.finished.append(r)
+                    if m is not None:
+                        m.on_cancel("queued")
+                else:
+                    keep.append(r)
+            self._waiting = keep
+
+    def _note_emit(self, req: Request, n: int):
+        """Token-emission accounting: first emission closes the TTFT
+        clock (from submit, queueing included), later ones feed the
+        per-token latency histogram."""
+        m = self.metrics
+        if m is None or n <= 0:
+            return
+        now = time.perf_counter()
+        if req._t_first is None:
+            req._t_first = now
+            if req._t_submit is not None:
+                m.observe_ttft(now - req._t_submit)
+        elif req._t_last is not None:
+            m.observe_tpot((now - req._t_last) / n)
+        req._t_last = now
+        m.on_tokens(n)
+
+    def _note_finish(self, req: Request):
+        m = self.metrics
+        if m is not None:
+            dt = None if req._t_submit is None \
+                else time.perf_counter() - req._t_submit
+            m.on_finish(req, dt)
+
+    def _note_step(self, n_active: int):
+        m = self.metrics
+        if m is not None:
+            m.on_step(self, n_active)
 
     @staticmethod
     def _feed_ids(req):
@@ -747,9 +840,11 @@ class ServingEngine:
             off += lens[i]
             cu[i + 1] = off
         cu[take + 1:] = off  # unused tail: zero-length segments
-        logits, k_all, v_all = prefill_varlen(
-            self.params, jnp.asarray(ids), jnp.asarray(cu), self.config,
-            use_pallas=self._use_pallas_prefill, interpret=self._interpret)
+        with record_span("serving.prefill"):
+            logits, k_all, v_all = prefill_varlen(
+                self.params, jnp.asarray(ids), jnp.asarray(cu),
+                self.config, use_pallas=self._use_pallas_prefill,
+                interpret=self._interpret)
         # ONE bucket-shaped scatter for the whole packed buffer: per-
         # request slices would give every distinct prompt length its own
         # scatter shape, and each shape is a fresh XLA compile (~100 ms
@@ -822,6 +917,9 @@ class ServingEngine:
         start = len(self._seq_pages[slot]) - n
         for i, pg in enumerate(pages):
             self.page_table[slot, start + i] = pg
+        m = self.metrics
+        if m is not None:
+            m.on_page_alloc(n)
         return pages
 
     def _prefill_into(self, slot, req: Request):
@@ -833,9 +931,10 @@ class ServingEngine:
                      1 << math.ceil(math.log2(max(S, 1))))
         ids = np.zeros((1, bucket), np.int64)
         ids[0, :S] = feed
-        logits, k_all, v_all = prefill(self.params, jnp.asarray(ids),
-                                       jnp.asarray(S), c,
-                                       use_pallas=self._use_pallas_prefill)
+        with record_span("serving.prefill"):
+            logits, k_all, v_all = prefill(
+                self.params, jnp.asarray(ids), jnp.asarray(S), c,
+                use_pallas=self._use_pallas_prefill)
         self._scatter_prompt(slot, k_all, v_all, S)
         req.slot = slot
         req._admit_order = self._order
@@ -891,6 +990,9 @@ class ServingEngine:
         self._waiting.insert(0, req)
         self._release(s)
         self.preemptions += 1
+        m = self.metrics
+        if m is not None:
+            m.on_preempt(self.preempt_policy)
         return True
 
     def _restore_into(self, slot, req: Request):
@@ -945,13 +1047,16 @@ class ServingEngine:
         req.next_token = tok
         req.output.append(tok)
         req.note_logprob(tok, row)
+        self._note_emit(req, 1)
         if req.done:  # e.g. max_new_tokens == 1
             self.finished.append(req)
+            self._note_finish(req)
             self._release(slot)
 
     # -- decode loop ------------------------------------------------------
     def step(self):
         """One decode step for all active slots; returns #active."""
+        self._sweep_cancelled()
         self._admit()
         if self.spec_decode > 1:
             return self._spec_step()
@@ -982,14 +1087,15 @@ class ServingEngine:
         active = np.zeros((self.max_seqs,), bool)
         active[active_slots] = True
         self.lengths = np.where(active, self.lengths + 1, self.lengths)
-        (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-         logits) = decode_step(
-            self.params, self.k_pool, self.v_pool,
-            jnp.asarray(self.page_table), jnp.asarray(self.lengths),
-            jnp.asarray(tokens), jnp.asarray(active),
-            self.config, self.page_size, use_pallas=self._use_pallas,
-            interpret=self._interpret, k_scale=self.k_scale,
-            v_scale=self.v_scale, mesh=self._mesh)
+        with record_span("serving.decode_step"):
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+             logits) = decode_step(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                jnp.asarray(tokens), jnp.asarray(active),
+                self.config, self.page_size, use_pallas=self._use_pallas,
+                interpret=self._interpret, k_scale=self.k_scale,
+                v_scale=self.v_scale, mesh=self._mesh)
         # all-greedy fast path: argmax on device, transfer max_seqs ints;
         # only sampling/logprobs requests pull their [vocab] row to host
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))
@@ -1004,10 +1110,13 @@ class ServingEngine:
             req.next_token = tok
             if req.want_logprobs:
                 req.note_logprob(tok, rows[s])
+            self._note_emit(req, 1)
             if req.done:
                 self.finished.append(req)
+                self._note_finish(req)
                 self._release(s)
         self.device_steps += 1
+        self._note_step(len(active_slots))
         return len(active_slots)
 
     def _spec_step(self):
@@ -1070,14 +1179,16 @@ class ServingEngine:
                 active[s] = False
         if not active_slots:
             return 0
-        (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-         logits) = verify_step(
-            self.params, self.k_pool, self.v_pool,
-            jnp.asarray(self.page_table), jnp.asarray(self.lengths),
-            jnp.asarray(tokens), jnp.asarray(n_tok),
-            jnp.asarray(active), self.config, self.page_size,
-            use_pallas=self._use_pallas, interpret=self._interpret,
-            k_scale=self.k_scale, v_scale=self.v_scale, mesh=self._mesh)
+        with record_span("serving.verify_step"):
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+             logits) = verify_step(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                jnp.asarray(tokens), jnp.asarray(n_tok),
+                jnp.asarray(active), self.config, self.page_size,
+                use_pallas=self._use_pallas, interpret=self._interpret,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+                mesh=self._mesh)
         self.device_steps += 1
         greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)
         # one rows dict for everyone who needs host rows: sampling
@@ -1131,9 +1242,12 @@ class ServingEngine:
             # cache retains chunk tokens 0..emitted-1 (the pending token
             # + the drafts CONSUMED to produce the emissions)
             self.lengths[s] += emitted
+            self._note_emit(req, emitted)
             if req.done:
                 self.finished.append(req)
+                self._note_finish(req)
                 self._release(s)
+        self._note_step(len(active_slots))
         return len(active_slots)
 
     def _release(self, slot):

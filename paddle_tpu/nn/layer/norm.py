@@ -20,11 +20,13 @@ class _BatchNormBase(Layer):
         self._momentum = momentum
         self._epsilon = epsilon
         self._data_format = data_format
-        # False and None are EQUIVALENT in dygraph (reference
-        # BatchNorm semantics): both mean "batch stats while training,
-        # moving stats in eval". A literal False reaching F.batch_norm
-        # would force batch statistics even in eval mode.
-        self._use_global_stats = use_global_stats or None
+        # reference contract (functional/norm.py trainable_statistics):
+        # None = batch stats in train, moving stats in eval; explicit
+        # False = mini-batch statistics ALWAYS, eval included. Pass it
+        # through untouched — F.batch_norm implements exactly that
+        # split, and collapsing False into None silently changed eval
+        # numerics for users who asked for trainable statistics.
+        self._use_global_stats = use_global_stats
         self.weight = self.create_parameter(
             shape=[num_features], attr=weight_attr,
             default_initializer=Constant(1.0)) if weight_attr is not False else None

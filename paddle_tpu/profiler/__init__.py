@@ -161,6 +161,20 @@ class Profiler:
         self.stop()
 
 
+def record_span(name):
+    """A RecordEvent as a with-block: annotates the device trace (when
+    one is being captured) and feeds the host event ring (when tracing
+    is enabled). The serving engine wraps its prefill/decode/verify
+    device calls in these, so a Profiler session over a serving
+    workload attributes wall-clock to engine phases. Near-free when no
+    profiler is active.
+
+        with profiler.record_span("serving.decode_step"):
+            ...
+    """
+    return RecordEvent(name)
+
+
 class RecordEvent:
     def __init__(self, name, event_type=None):
         self.name = name

@@ -1,0 +1,40 @@
+"""paddle_tpu.serving — production serving runtime over the
+continuous-batching engine (`models/llama_serving.ServingEngine`).
+
+Layers (docs/serving.md has the architecture):
+
+  * `metrics`   — counters/gauges/histograms registry; Prometheus text
+                  exposition + JSON snapshot; `EngineMetrics` is the
+                  hook object the engine's step loop reports into.
+  * `scheduler` — thread-safe bounded request queue with priority
+                  classes, deadlines/TTLs, cancellation, backpressure
+                  (`BackpressureError`), and graceful drain.
+  * `server`    — stdlib ThreadingHTTPServer frontend: streaming
+                  `/v1/completions`, `/healthz`, `/metrics`.
+  * `client`    — stdlib HTTP client, SSE streaming included.
+
+This package never imports the model/engine modules at import time —
+the engine arrives as a constructor argument — so
+`import paddle_tpu.serving` stays cheap and cycle-free.
+"""
+from __future__ import annotations
+
+from . import client, metrics, scheduler, server  # noqa: F401
+from .client import ServingClient, ServingHTTPError  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter, EngineMetrics, Gauge, Histogram, MetricsRegistry,
+)
+from .scheduler import (  # noqa: F401
+    BackpressureError, DeadlineExceededError, RequestScheduler,
+    SchedulerClosedError, SchedulerError, ServingRequest,
+)
+from .server import ServingServer  # noqa: F401
+
+__all__ = [
+    "client", "metrics", "scheduler", "server",
+    "ServingClient", "ServingHTTPError",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineMetrics",
+    "RequestScheduler", "ServingRequest", "SchedulerError",
+    "BackpressureError", "DeadlineExceededError", "SchedulerClosedError",
+    "ServingServer",
+]

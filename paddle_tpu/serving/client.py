@@ -1,0 +1,107 @@
+"""Stdlib HTTP client for the serving frontend (`serving/server.py`).
+
+Token-id in, token-id out — the wire protocol is tokenizer-free, like
+the server. Streaming completions iterate Server-Sent-Events as the
+engine emits chunks; everything else is one JSON round trip.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+
+__all__ = ["ServingClient", "ServingHTTPError"]
+
+
+class ServingHTTPError(RuntimeError):
+    """Non-2xx response; carries the status and decoded body."""
+
+    def __init__(self, status, body):
+        self.status = status
+        self.body = body
+        msg = body.get("error", body) if isinstance(body, dict) else body
+        super().__init__(f"HTTP {status}: {msg}")
+
+    @property
+    def retriable(self):
+        return self.status in (429, 503)
+
+
+class ServingClient:
+    def __init__(self, host="127.0.0.1", port=8000, timeout=120.0):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+
+    def _request(self, method, path, body=None):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        headers = {}
+        payload = None
+        if body is not None:
+            payload = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        conn.request(method, path, body=payload, headers=headers)
+        return conn, conn.getresponse()
+
+    def _json_call(self, method, path, body=None):
+        conn, resp = self._request(method, path, body)
+        try:
+            data = resp.read()
+            try:
+                decoded = json.loads(data)
+            except json.JSONDecodeError:
+                decoded = data.decode(errors="replace")
+            if resp.status >= 400:
+                raise ServingHTTPError(resp.status, decoded)
+            return decoded
+        finally:
+            conn.close()
+
+    # -- endpoints ----------------------------------------------------
+    def healthz(self):
+        return self._json_call("GET", "/healthz")
+
+    def metrics(self):
+        """JSON snapshot of the server's metrics registry."""
+        return self._json_call("GET", "/metrics?format=json")
+
+    def metrics_text(self):
+        """Prometheus text exposition."""
+        conn, resp = self._request("GET", "/metrics")
+        try:
+            body = resp.read().decode()
+            if resp.status >= 400:
+                raise ServingHTTPError(resp.status, body)
+            return body
+        finally:
+            conn.close()
+
+    def complete(self, prompt_ids, **params):
+        """Blocking completion; returns the response dict
+        ({"tokens": [...], "state": ..., ...})."""
+        body = dict(params, prompt=list(map(int, prompt_ids)),
+                    stream=False)
+        return self._json_call("POST", "/v1/completions", body)
+
+    def stream_complete(self, prompt_ids, **params):
+        """Generator of SSE event dicts: token chunks as
+        {"tokens": [...]}, then a final {"done": true, ...} event."""
+        body = dict(params, prompt=list(map(int, prompt_ids)),
+                    stream=True)
+        conn, resp = self._request("POST", "/v1/completions", body)
+        try:
+            if resp.status >= 400:
+                data = resp.read()
+                try:
+                    decoded = json.loads(data)
+                except json.JSONDecodeError:
+                    decoded = data.decode(errors="replace")
+                raise ServingHTTPError(resp.status, decoded)
+            # http.client undoes the chunked framing; reassemble SSE
+            # events (data: <json>\n\n) line by line
+            for line in resp:
+                line = line.strip()
+                if line.startswith(b"data: "):
+                    yield json.loads(line[len(b"data: "):])
+        finally:
+            conn.close()

@@ -1,0 +1,335 @@
+"""Serving metrics: a counters/gauges/histograms registry with
+Prometheus text exposition and a JSON snapshot API.
+
+The registry is the single source for every number the serving runtime
+publishes — TTFT, per-token latency, queue depth, batch occupancy,
+preemption and page-allocation stats (reference: the predictor's
+serving telemetry; vLLM exposes the same catalog over /metrics).
+`EngineMetrics` is the engine-facing half: `ServingEngine.metrics`
+duck-types against it, so `models/llama_serving.py` never imports this
+package (no cycle — the engine works bare, the runtime instruments it).
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "EngineMetrics", "DEFAULT_BUCKETS"]
+
+# latency buckets in seconds: sub-ms CPU decode steps up to multi-second
+# queued TTFTs all land in a populated bucket
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                   0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name, help="", lock=None):
+        self.name = name
+        self.help = help
+        self._lock = lock or threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic count (Prometheus counter)."""
+    kind = "counter"
+
+    def __init__(self, name, help="", lock=None):
+        super().__init__(name, help, lock)
+        self._v = 0.0
+
+    def inc(self, n=1.0):
+        if n < 0:
+            raise ValueError(f"counter {self.name}: inc({n}) < 0")
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._v
+
+    def _render(self, out):
+        out.append(f"{self.name}_total {_fmt(self._v)}")
+
+    def _snap(self):
+        return {"type": "counter", "value": self._v}
+
+
+class Gauge(_Metric):
+    """Point-in-time value (Prometheus gauge)."""
+    kind = "gauge"
+
+    def __init__(self, name, help="", lock=None):
+        super().__init__(name, help, lock)
+        self._v = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._v = float(v)
+
+    def set_to_max(self, v):
+        """Peak tracking: keep the high-water mark."""
+        with self._lock:
+            if v > self._v:
+                self._v = float(v)
+
+    def inc(self, n=1.0):
+        with self._lock:
+            self._v += n
+
+    def dec(self, n=1.0):
+        self.inc(-n)
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._v
+
+    def _render(self, out):
+        out.append(f"{self.name} {_fmt(self._v)}")
+
+    def _snap(self):
+        return {"type": "gauge", "value": self._v}
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus exposition shape);
+    percentiles for the JSON snapshot are interpolated inside the
+    landing bucket, which is exact enough for dashboards and tests."""
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS, lock=None):
+        super().__init__(name, help, lock)
+        self._bounds = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self._bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self._counts[bisect.bisect_left(self._bounds, v)] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def percentile(self, q):
+        """Interpolated q-th percentile (q in [0, 100]); 0.0 when empty."""
+        with self._lock:
+            if self._count == 0:
+                return 0.0
+            target = self._count * q / 100.0
+            seen = 0
+            lo = 0.0
+            for i, n in enumerate(self._counts):
+                hi = self._bounds[i] if i < len(self._bounds) \
+                    else (self._bounds[-1] if self._bounds else lo)
+                if seen + n >= target:
+                    if n == 0:
+                        return hi
+                    return lo + (hi - lo) * (target - seen) / n
+                seen += n
+                lo = hi
+            return lo
+
+    def _render(self, out):
+        cum = 0
+        for i, b in enumerate(self._bounds):
+            cum += self._counts[i]
+            out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+        cum += self._counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {_fmt(self._sum)}")
+        out.append(f"{self.name}_count {self._count}")
+
+    def _snap(self):
+        cum, buckets = 0, {}
+        for i, b in enumerate(self._bounds):
+            cum += self._counts[i]
+            buckets[_fmt(b)] = cum
+        buckets["+Inf"] = cum + self._counts[-1]
+        return {"type": "histogram", "count": self._count,
+                "sum": self._sum, "p50": self.percentile(50),
+                "p90": self.percentile(90), "p99": self.percentile(99),
+                "buckets": buckets}
+
+
+def _fmt(v):
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Thread-safe get-or-create registry of named metrics."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, lock=threading.Lock(), **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name, help=""):
+        return self._get(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def render_prometheus(self):
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out = []
+        for m in metrics:
+            if m.help:
+                out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            with m._lock:
+                m._render(out)
+        return "\n".join(out) + "\n"
+
+    def snapshot(self):
+        """JSON-serializable dict of every metric's current state."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m._snap() for m in metrics}
+
+
+class EngineMetrics:
+    """The hook object `ServingEngine.metrics` duck-types against.
+
+    The engine calls these from the thread driving `step()`; every
+    method funnels into registry metrics, so a scrape from any other
+    thread sees a consistent snapshot. `external_queue=True` (set by
+    RequestScheduler) hands queue-depth ownership to the scheduler,
+    whose queue sits in front of the engine's."""
+
+    def __init__(self, registry=None, external_queue=False):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        self._external_queue = external_queue
+        r = self.registry
+        self.ttft = r.histogram(
+            "pt_serving_ttft_seconds", "Submit-to-first-token latency.")
+        self.tpot = r.histogram(
+            "pt_serving_tpot_seconds", "Per-output-token latency.")
+        self.e2e = r.histogram(
+            "pt_serving_e2e_seconds", "Submit-to-completion latency.")
+        self.queue_depth = r.gauge(
+            "pt_serving_queue_depth", "Requests waiting for a slot.")
+        self.queue_depth_peak = r.gauge(
+            "pt_serving_queue_depth_peak", "High-water queue depth.")
+        self.batch_occupancy = r.gauge(
+            "pt_serving_batch_occupancy",
+            "Active slots / max_seqs at the last step.")
+        self.active = r.gauge(
+            "pt_serving_active_requests", "Requests holding a slot.")
+        self.pages_free = r.gauge(
+            "pt_serving_kv_pages_free", "KV pages in the free list.")
+        self.pages_total = r.gauge(
+            "pt_serving_kv_pages_total",
+            "Allocatable KV pages (excludes the trash page).")
+        self.prefill_tokens = r.gauge(
+            "pt_serving_prefill_tokens", "Cumulative prefilled tokens.")
+        self.steps = r.counter(
+            "pt_serving_device_steps", "Decode/verify device calls.")
+        self.tokens = r.counter(
+            "pt_serving_generated_tokens", "Output tokens emitted.")
+        self.preemptions = r.counter(
+            "pt_serving_preemptions", "Requests evicted mid-flight.")
+        self.page_allocs = r.counter(
+            "pt_serving_page_allocs", "KV pages handed out.")
+        self.accepted = r.counter(
+            "pt_serving_requests_accepted", "Requests admitted.")
+        self.rejected = r.counter(
+            "pt_serving_requests_rejected",
+            "Requests refused by admission control (backpressure).")
+        self.completed = r.counter(
+            "pt_serving_requests_completed", "Requests finished.")
+        self.cancelled = r.counter(
+            "pt_serving_requests_cancelled", "Requests cancelled.")
+        self.expired = r.counter(
+            "pt_serving_requests_expired", "Requests past deadline.")
+
+    # -- engine-facing hooks (called from the step()-driving thread) --
+    def on_submit(self, engine):
+        # with an external queue the scheduler already counted the
+        # admission (engine.submit here is just the feed step)
+        if not self._external_queue:
+            self.accepted.inc()
+            depth = len(engine._waiting)
+            self.queue_depth.set(depth)
+            self.queue_depth_peak.set_to_max(depth)
+
+    def on_step(self, engine, n_active):
+        self.steps.inc()
+        self.batch_occupancy.set(n_active / max(engine.max_seqs, 1))
+        self.active.set(n_active)
+        self.pages_free.set(len(engine._free))
+        self.pages_total.set(engine.num_pages - 1)
+        self.prefill_tokens.set(engine.prefill_tokens)
+        if not self._external_queue:
+            depth = len(engine._waiting)
+            self.queue_depth.set(depth)
+            self.queue_depth_peak.set_to_max(depth)
+
+    def observe_ttft(self, dt):
+        self.ttft.observe(dt)
+
+    def observe_tpot(self, dt):
+        self.tpot.observe(dt)
+
+    def on_tokens(self, n):
+        self.tokens.inc(n)
+
+    def on_preempt(self, policy):
+        self.preemptions.inc()
+
+    def on_page_alloc(self, n):
+        self.page_allocs.inc(n)
+
+    def on_finish(self, req, dt=None):
+        self.completed.inc()
+        if dt is not None:
+            self.e2e.observe(dt)
+
+    def on_cancel(self, where):
+        self.cancelled.inc()
+
+    # -- scheduler-facing hooks --
+    def on_reject(self):
+        self.rejected.inc()
+
+    def on_expire(self):
+        self.expired.inc()
+
+    def set_queue_depth(self, depth):
+        self.queue_depth.set(depth)
+        self.queue_depth_peak.set_to_max(depth)

@@ -1,0 +1,384 @@
+"""Multi-tenant request scheduler over the continuous-batching engine.
+
+`RequestScheduler` turns `models/llama_serving.ServingEngine` — a
+single-threaded step loop — into a runtime that concurrent frontends
+can submit to:
+
+  * admission control: a bounded queue per priority class; a full
+    queue raises `BackpressureError` (explicit 429-style rejection,
+    never a silent drop);
+  * deadlines: each request may carry a TTL — queued requests past
+    their deadline are expired without touching the engine, running
+    ones are cancelled at the next step boundary;
+  * priority classes: "high" / "normal" / "low" — the pump feeds the
+    engine highest-class-first whenever a slot frees up (the engine's
+    own FIFO is never allowed to stack, so a late high-priority
+    arrival cannot be inverted by it);
+  * graceful drain: `shutdown(drain=True)` stops admissions, lets
+    in-flight work finish, then parks the pump thread.
+
+The engine itself is NOT thread-safe and is only ever touched by the
+pump thread; cross-thread communication is flag-based (cancel marks)
+plus per-request chunk queues, all under one condition variable.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+
+from .metrics import EngineMetrics, MetricsRegistry
+
+__all__ = ["RequestScheduler", "ServingRequest", "SchedulerError",
+           "BackpressureError", "DeadlineExceededError",
+           "SchedulerClosedError", "PRIORITIES"]
+
+PRIORITIES = ("high", "normal", "low")
+
+
+class SchedulerError(RuntimeError):
+    pass
+
+
+class BackpressureError(SchedulerError):
+    """Admission refused: the bounded queue is full. HTTP frontends
+    map this to 429 with Retry-After."""
+
+    def __init__(self, msg, retry_after_s=1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(SchedulerError):
+    """The request's TTL elapsed before it completed."""
+
+
+class SchedulerClosedError(SchedulerError):
+    """submit() after shutdown() began."""
+
+
+class ServingRequest:
+    """Handle a submitter holds: stream tokens as they are emitted, or
+    block for the full result. Terminal states: "done", "cancelled",
+    "expired", "failed"."""
+
+    def __init__(self, sched, req, priority, deadline):
+        self._sched = sched
+        self.req = req                  # engine-level Request
+        self.rid = req.rid
+        self.priority = priority
+        self.deadline = deadline        # absolute time.monotonic() or None
+        self.state = "queued"
+        self.error = None
+        self.t_submit = time.monotonic()
+        self.t_first_token = None
+        self.t_done = None
+        self.chunks = queue.Queue()     # lists of token ids; None = EOS
+        self._emitted = 0
+        self._cancel_requested = False
+        self._cancel_applied = False
+        self._expired = False
+        self._done = threading.Event()
+
+    @property
+    def output(self):
+        return list(self.req.output)
+
+    def cancel(self):
+        """Request cancellation; applied by the pump at the next step
+        boundary. Returns False if already terminal."""
+        return self._sched._request_cancel(self)
+
+    def stream(self, timeout=None):
+        """Yield lists of newly emitted token ids until the request
+        reaches a terminal state; raises the terminal error (deadline,
+        failure) if there is one."""
+        while True:
+            chunk = self.chunks.get(timeout=timeout)
+            if chunk is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield chunk
+
+    def result(self, timeout=None):
+        """Block until terminal; return the full output token list."""
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(f"request {self.rid}: not done")
+        if self.error is not None:
+            raise self.error
+        return self.output
+
+
+class RequestScheduler:
+    """Thread-safe frontend over one ServingEngine (see module doc)."""
+
+    def __init__(self, engine, max_queue=64, metrics=None,
+                 idle_poll_s=0.02, start=True):
+        self._engine = engine
+        self.max_queue = int(max_queue)
+        if self.max_queue < 1:
+            raise ValueError(f"max_queue={max_queue}: want >= 1")
+        registry = metrics if isinstance(metrics, MetricsRegistry) \
+            else None
+        self.metrics = EngineMetrics(registry, external_queue=True)
+        self.registry = self.metrics.registry
+        # the engine reports TTFT/TPOT/occupancy itself through the
+        # same hook object; the scheduler owns queue depth + rejections
+        engine.metrics = self.metrics
+        self._idle_poll_s = idle_poll_s
+        self._cond = threading.Condition()
+        self._queues = {p: deque() for p in PRIORITIES}
+        self._inflight = {}             # id(engine Request) -> handle
+        self._fin_seen = len(engine.finished)
+        self._rid = itertools.count()
+        self._closed = False
+        self._paused = False
+        self._drained = threading.Event()
+        self._drained.set()
+        self._thread = threading.Thread(target=self._pump,
+                                        name="pt-serving-pump",
+                                        daemon=True)
+        if start:
+            self._thread.start()
+
+    # -- submission (any thread) --------------------------------------
+    def submit(self, prompt_ids, *, rid=None, max_new_tokens=64,
+               eos_id=None, temperature=0.0, top_k=0, top_p=1.0,
+               seed=None, logprobs=False, priority="normal",
+               ttl_s=None):
+        """Admit-or-refuse NOW: raises BackpressureError on a full
+        queue, SchedulerClosedError during shutdown, ValueError for a
+        request the engine could never run. Returns a ServingRequest."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority={priority!r}: want one of {PRIORITIES}")
+        if ttl_s is not None and ttl_s <= 0:
+            raise ValueError(f"ttl_s={ttl_s}: want > 0 or None")
+        from ..models.llama_serving import Request
+        req = Request(rid if rid is not None
+                      else f"sr{next(self._rid)}",
+                      prompt_ids, max_new_tokens=max_new_tokens,
+                      eos_id=eos_id, temperature=temperature,
+                      top_k=top_k, top_p=top_p, seed=seed,
+                      logprobs=logprobs)
+        self._engine.validate(req)      # never-fits -> ValueError, now
+        deadline = None if ttl_s is None else time.monotonic() + ttl_s
+        with self._cond:
+            if self._closed:
+                raise SchedulerClosedError(
+                    "serving: scheduler is shutting down")
+            depth = self._queued_locked()
+            if depth >= self.max_queue:
+                self.metrics.on_reject()
+                raise BackpressureError(
+                    f"serving: queue full ({depth}/{self.max_queue}); "
+                    "retry later")
+            sr = ServingRequest(self, req, priority, deadline)
+            # TTFT clock starts at scheduler admission, so queueing
+            # latency is part of the number (the engine stamps only if
+            # unset)
+            req._t_submit = time.perf_counter()
+            self.metrics.accepted.inc()
+            self._queues[priority].append(sr)
+            self._drained.clear()
+            self.metrics.set_queue_depth(self._queued_locked())
+            self._cond.notify_all()
+        return sr
+
+    def cancel(self, sr):
+        return self._request_cancel(sr)
+
+    def _request_cancel(self, sr):
+        with self._cond:
+            if sr.state not in ("queued", "running"):
+                return False
+            sr._cancel_requested = True
+            self._cond.notify_all()
+        return True
+
+    # -- operational controls -----------------------------------------
+    def pause(self):
+        """Stop feeding the engine (in-flight work keeps stepping);
+        queued work accumulates — deterministic backpressure for tests
+        and for load-shedding drills."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self):
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def drain(self, timeout=None):
+        """Block until no queued and no in-flight work remains."""
+        return self._drained.wait(timeout=timeout)
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop admissions; with drain=True let in-flight and queued
+        requests finish, else cancel everything. Joins the pump thread;
+        returns True when it exited within `timeout`."""
+        with self._cond:
+            self._closed = True
+            self._paused = False
+            if not drain:
+                for q in self._queues.values():
+                    for sr in q:
+                        sr._cancel_requested = True
+                for sr in self._inflight.values():
+                    sr._cancel_requested = True
+            self._cond.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    def stats(self):
+        with self._cond:
+            return {
+                "queued": self._queued_locked(),
+                "active": sum(1 for r in self._engine._slots
+                              if r is not None),
+                "engine_waiting": len(self._engine._waiting),
+                "inflight": len(self._inflight),
+                "closed": self._closed,
+                "paused": self._paused,
+                "device_steps": self._engine.device_steps,
+                "preemptions": self._engine.preemptions,
+            }
+
+    # -- pump (single thread; sole owner of the engine) ----------------
+    def _queued_locked(self):
+        return sum(len(q) for q in self._queues.values())
+
+    def _pop_next_locked(self):
+        for p in PRIORITIES:
+            if self._queues[p]:
+                return self._queues[p].popleft()
+        return None
+
+    def _expire_and_cancel_locked(self):
+        now = time.monotonic()
+        for p in PRIORITIES:
+            q = self._queues[p]
+            keep = deque()
+            for sr in q:
+                if sr._cancel_requested:
+                    self.metrics.on_cancel("queued")
+                    self._finalize(sr, "cancelled")
+                elif sr.deadline is not None and now > sr.deadline:
+                    self.metrics.on_expire()
+                    self._finalize(sr, "expired")
+                else:
+                    keep.append(sr)
+            self._queues[p] = keep
+        for sr in list(self._inflight.values()):
+            expired = sr.deadline is not None and now > sr.deadline
+            if expired and not sr._expired:
+                sr._expired = True
+                self.metrics.on_expire()
+            if (expired or sr._cancel_requested) and \
+                    not sr._cancel_applied:
+                sr._cancel_applied = True
+                # pump thread owns the engine: safe to mutate its queue
+                self._engine.cancel(sr.req)
+
+    def _feed_locked(self):
+        if self._paused:
+            return
+        eng = self._engine
+        room = sum(1 for r in eng._slots if r is None) \
+            - len(eng._waiting)
+        while room > 0:
+            sr = self._pop_next_locked()
+            if sr is None:
+                break
+            eng.submit(sr.req)
+            sr.state = "running"
+            self._inflight[id(sr.req)] = sr
+            room -= 1
+
+    def _publish(self):
+        """Push newly emitted tokens to each in-flight handle and
+        finalize whatever the engine finished. Pump-thread only."""
+        with self._cond:
+            for sr in list(self._inflight.values()):
+                n = len(sr.req.output)
+                if n > sr._emitted:
+                    if sr.t_first_token is None:
+                        sr.t_first_token = time.monotonic()
+                    sr.chunks.put(list(sr.req.output[sr._emitted:n]))
+                    sr._emitted = n
+            fin = self._engine.finished
+            while self._fin_seen < len(fin):
+                req = fin[self._fin_seen]
+                self._fin_seen += 1
+                sr = self._inflight.pop(id(req), None)
+                if sr is None:
+                    continue        # submitted around the scheduler
+                if getattr(sr, "_expired", False):
+                    self._finalize(sr, "expired")
+                elif req.cancelled:
+                    self._finalize(sr, "cancelled")
+                else:
+                    self._finalize(sr, "done")
+            self.metrics.set_queue_depth(self._queued_locked())
+            if not self._queued_locked() and not self._inflight:
+                self._drained.set()
+                self._cond.notify_all()
+
+    def _finalize(self, sr, state):
+        sr.state = state
+        sr.t_done = time.monotonic()
+        if state == "expired":
+            sr.error = DeadlineExceededError(
+                f"request {sr.rid}: deadline exceeded after "
+                f"{sr.t_done - sr.t_submit:.3f}s "
+                f"({len(sr.req.output)} tokens emitted)")
+        n = len(sr.req.output)
+        if n > sr._emitted:
+            sr.chunks.put(list(sr.req.output[sr._emitted:n]))
+            sr._emitted = n
+        sr.chunks.put(None)
+        sr._done.set()
+
+    def _engine_has_work(self):
+        return (any(r is not None for r in self._engine._slots)
+                or bool(self._engine._waiting))
+
+    def _pump(self):
+        while True:
+            with self._cond:
+                self._expire_and_cancel_locked()
+                self._feed_locked()
+                if not self._engine_has_work():
+                    if self._closed and not self._queued_locked():
+                        break
+                    # park until a submission/cancel/shutdown pokes us
+                    # (or queued work is unfeedable: paused / no slot);
+                    # the timeout bounds queued-deadline expiry latency
+                    self._cond.wait(timeout=self._idle_poll_s)
+                    continue
+            try:
+                self._engine.step()
+            except Exception as e:  # noqa: BLE001 — fail requests
+                self._fail_all(e)
+                continue
+            self._publish()
+        self._publish()
+
+    def _fail_all(self, exc):
+        """An engine step blew up: fail every in-flight request rather
+        than hanging their streams, and release the engine's state."""
+        with self._cond:
+            eng = self._engine
+            for s in range(eng.max_seqs):
+                if eng._slots[s] is not None:
+                    eng._release(s)
+            eng._waiting.clear()
+            for sr in list(self._inflight.values()):
+                sr.error = SchedulerError(
+                    f"engine step failed: {exc!r}")
+                self._finalize(sr, "failed")
+            self._inflight.clear()

@@ -1,0 +1,229 @@
+"""Stdlib-only HTTP frontend for the serving runtime.
+
+`ServingServer` mounts a `RequestScheduler` behind a
+`ThreadingHTTPServer` (one thread per connection — the engine itself
+stays single-threaded behind the scheduler's pump):
+
+  * `POST /v1/completions` — JSON body; `"stream": true` streams
+    Server-Sent-Events over chunked transfer, one event per emitted
+    token chunk;
+  * `GET /healthz` — liveness + queue/occupancy snapshot;
+  * `GET /metrics` — Prometheus text exposition
+    (`?format=json` returns the registry's JSON snapshot).
+
+Backpressure maps to HTTP: a full queue is 429 with Retry-After,
+shutdown is 503, a request the engine can never run is 400, a
+deadline that expires before the first token is 504.
+
+Everything runs under `JAX_PLATFORMS=cpu` too, so an in-process test
+can drive a real server end-to-end without a chip.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .scheduler import (BackpressureError, RequestScheduler,
+                        SchedulerClosedError)
+
+__all__ = ["ServingServer", "CompletionHandler"]
+
+
+class CompletionHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "paddle-tpu-serving/0.1"
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    @property
+    def sched(self) -> RequestScheduler:
+        return self.server.scheduler
+
+    # -- helpers ------------------------------------------------------
+    def _json(self, code, obj, headers=()):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _chunk(self, data: bytes):
+        self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+
+    def _event(self, obj):
+        self._chunk(b"data: " + json.dumps(obj).encode() + b"\n\n")
+        self.wfile.flush()
+
+    # -- routes -------------------------------------------------------
+    def do_GET(self):
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            st = self.sched.stats()
+            st["status"] = "draining" if st.pop("closed") else "ok"
+            self._json(200, st)
+        elif path == "/metrics":
+            if "format=json" in query:
+                self._json(200, self.sched.registry.snapshot())
+            else:
+                body = self.sched.registry.render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+        else:
+            self._json(404, {"error": f"no route {path!r}"})
+
+    def do_POST(self):
+        if self.path.partition("?")[0] != "/v1/completions":
+            self._json(404, {"error": f"no route {self.path!r}"})
+            return
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            prompt = body["prompt"]
+            if not isinstance(prompt, list) or \
+                    not all(isinstance(t, int) for t in prompt):
+                raise ValueError(
+                    "prompt must be a list of token ids (ints); this "
+                    "server is tokenizer-free")
+        except (KeyError, ValueError, json.JSONDecodeError) as e:
+            self._json(400, {"error": f"bad request: {e}"})
+            return
+        stream = bool(body.get("stream", False))
+        try:
+            sr = self.sched.submit(
+                prompt,
+                max_new_tokens=int(body.get("max_tokens", 16)),
+                eos_id=body.get("eos_id"),
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+                seed=body.get("seed"),
+                logprobs=bool(body.get("logprobs", False)),
+                priority=body.get("priority", "normal"),
+                ttl_s=body.get("ttl_s"))
+        except BackpressureError as e:
+            self._json(429, {"error": str(e)},
+                       headers=(("Retry-After",
+                                 str(max(int(e.retry_after_s), 1))),))
+            return
+        except SchedulerClosedError as e:
+            self._json(503, {"error": str(e)})
+            return
+        except (TypeError, ValueError) as e:
+            self._json(400, {"error": str(e)})
+            return
+        if stream:
+            self._stream(sr)
+        else:
+            self._blocking(sr)
+
+    def _final(self, sr):
+        out = {"id": sr.rid, "state": sr.state,
+               "tokens": sr.output, "n": len(sr.req.output)}
+        if sr.req.logprobs is not None:
+            out["logprobs"] = sr.req.logprobs
+        return out
+
+    def _blocking(self, sr):
+        try:
+            sr.result()
+        except Exception:  # terminal state carries the story
+            pass
+        if sr.state == "expired" and not sr.req.output:
+            self._json(504, {"error": str(sr.error), "id": sr.rid,
+                             "state": "expired"})
+            return
+        if sr.state == "failed":
+            self._json(500, {"error": str(sr.error), "id": sr.rid,
+                             "state": "failed"})
+            return
+        self._json(200, self._final(sr))
+
+    def _stream(self, sr):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            try:
+                for chunk in sr.stream():
+                    self._event({"id": sr.rid, "tokens": chunk})
+            except Exception as e:  # deadline/engine failure mid-stream
+                self._event({"id": sr.rid, "error": str(e),
+                             "state": sr.state, "done": True})
+            else:
+                self._event(dict(self._final(sr), done=True))
+            self._chunk(b"")        # terminating zero-length chunk
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away: stop paying for its tokens
+            sr.cancel()
+            self.close_connection = True
+
+
+class ServingServer:
+    """Own the scheduler + HTTP listener pair.
+
+    Accepts a ready-made RequestScheduler or a bare ServingEngine
+    (wrapped with `max_queue`). `port=0` binds an ephemeral port —
+    read it back from `.port` (how the tests run hermetically)."""
+
+    def __init__(self, engine_or_scheduler, host="127.0.0.1", port=8000,
+                 max_queue=64):
+        if isinstance(engine_or_scheduler, RequestScheduler):
+            self.scheduler = engine_or_scheduler
+        else:
+            self.scheduler = RequestScheduler(engine_or_scheduler,
+                                              max_queue=max_queue)
+        self.httpd = ThreadingHTTPServer((host, port), CompletionHandler)
+        self.httpd.daemon_threads = True
+        self.httpd.scheduler = self.scheduler
+        self._thread = None
+
+    @property
+    def host(self):
+        return self.httpd.server_address[0]
+
+    @property
+    def port(self):
+        return self.httpd.server_address[1]
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="pt-serving-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self):
+        self.httpd.serve_forever()
+
+    def stop(self, drain=True, timeout=None):
+        """Graceful stop: close admissions and drain (or cancel)
+        in-flight work first, so streaming responses complete; then
+        tear down the listener. Returns True if the pump exited."""
+        done = self.scheduler.shutdown(drain=drain, timeout=timeout)
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        return done
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -35,6 +35,20 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     # the artifact carries its own comparison point
     assert out["plain_decode_tokens_per_sec"] > 0
     assert "spec_speedup" in out
+    _assert_metrics_snapshot(out)
+
+
+def _assert_metrics_snapshot(out):
+    """bench_serving must ship the serving-runtime metrics snapshot —
+    the driver-visible artifact carries TTFT/occupancy/preemption
+    telemetry, not just tokens/sec."""
+    m = out["metrics"]
+    assert m["ttft_count"] == out["requests"]
+    assert 0 < m["ttft_p50_s"] <= m["ttft_p99_s"]
+    assert m["generated_tokens"] == out["new_tokens"]
+    assert m["device_steps"] > 0
+    assert m["tpot_p50_s"] >= 0
+    assert 0 <= m["batch_occupancy"] <= 1
 
 
 def test_serving_load_bench_structure(monkeypatch):
@@ -59,3 +73,4 @@ def test_plain_bench_unaffected(monkeypatch):
     out = bm.bench_serving(on_tpu=False)
     assert out["decode_tokens_per_sec"] > 0
     assert "spec_decode" not in out
+    _assert_metrics_snapshot(out)

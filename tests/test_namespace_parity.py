@@ -129,3 +129,54 @@ class TestNewOps:
         assert ret is x
         assert np.allclose(x.numpy(), [1.0, -2.0, 3.0])
         assert cond.numpy().dtype == bool  # condition untouched
+
+
+class TestServingNamespace:
+    """paddle_tpu.serving package hygiene: the export surface stays
+    consistent and the package imports without dragging the model/
+    engine modules in (cycle- and cost-free frontends)."""
+
+    def test_all_consistent_and_unique(self):
+        import paddle_tpu.serving as sv
+        assert len(sv.__all__) == len(set(sv.__all__)), "dup in __all__"
+        for name in sv.__all__:
+            assert getattr(sv, name, None) is not None, name
+        for sub in (sv.scheduler, sv.metrics, sv.server, sv.client):
+            assert sorted(sub.__all__) == sorted(set(sub.__all__))
+            for name in sub.__all__:
+                assert hasattr(sub, name), f"{sub.__name__}.{name}"
+            # everything a submodule exports is reachable from the
+            # package top (one import site for users)
+            for name in sub.__all__:
+                assert hasattr(sv, name) or hasattr(sv, sub.__name__.rsplit(".", 1)[-1])
+
+    def test_import_cycle_free(self):
+        """The serving package must not import the engine/model modules
+        at module level — the engine arrives as a constructor argument,
+        which is what keeps paddle_tpu.serving <-> paddle_tpu.models
+        cycle-free and `import paddle_tpu.serving` cheap. AST-scan every
+        module's top-level imports (fast: no fresh interpreter)."""
+        import ast
+        import paddle_tpu.serving as sv
+        pkg_dir = os.path.dirname(sv.__file__)
+        for fname in sorted(os.listdir(pkg_dir)):
+            if not fname.endswith(".py"):
+                continue
+            tree = ast.parse(open(os.path.join(pkg_dir, fname)).read())
+            for node in ast.walk(tree):
+                # only MODULE-level imports are cycle hazards; imports
+                # inside functions (e.g. scheduler.submit's Request)
+                # resolve lazily and are fine
+                if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                    continue
+                if node.col_offset != 0:
+                    continue
+                names = [a.name for a in node.names]
+                mod = getattr(node, "module", None) or ""
+                banned = ("models", "ops", "nn", "vision")
+                hit = [n for n in ([mod] + names)
+                       if any(n == b or n.startswith(b + ".")
+                              for b in banned)]
+                assert not hit, (f"{fname}: module-level import of "
+                                 f"{hit} would couple the serving "
+                                 "frontend to the engine")
