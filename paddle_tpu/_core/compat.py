@@ -1,0 +1,40 @@
+"""Version compatibility shims for the jax API surface.
+
+`shard_map` graduated out of jax.experimental in 0.8 with two renames:
+`check_rep` -> `check_vma`, and the manual-axes selection flipped from
+`auto={axes left automatic}` to `axis_names={axes made manual}`. The
+tree is written against the new spelling; this shim lets it run on the
+0.4.x experimental API as well.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def axis_size(axis_name):
+    """lax.axis_size appeared after 0.4.x; the old spelling is the
+    constant-folded psum of 1 over the axis (static under trace)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map(fn, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    # axis_names (new API) leaves the other mesh axes automatic; the
+    # 0.4.x `auto=` equivalent is unimplemented for eager calls and
+    # miscompiles some gradient graphs, so run all-manual instead.
+    # Equivalent for every in-tree call site: their in/out_specs
+    # replicate the non-manual axes and the bodies are rank-local
+    # (only collectives over the named axis), so each auto-axis rank
+    # computes the same replica either way.
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)

@@ -1,0 +1,51 @@
+"""tpulint — TPU-hostility static analysis for paddle_tpu.
+
+The classic ways a JAX/TPU program gets slow are invisible in a diff:
+a `.numpy()` deep in the decode loop silently serializes the pipeline,
+a Python branch on a traced value retraces per shape, an `np.random`
+call inside a jitted body breaks determinism, a lock held across a
+device call stalls every other thread. tpulint is an AST pass that
+catches these *classes* at review time, over the whole tree, with no
+runtime or profile needed.
+
+Usage (library):
+
+    from paddle_tpu.analysis import lint_paths
+    findings, nfiles = lint_paths(["paddle_tpu/"])
+
+Usage (CLI):
+
+    python tools/tpulint.py paddle_tpu/ [--format json]
+
+Rules (see docs/static_analysis.md for bad/good examples):
+
+  TPL001  host-sync in a hot path        (error)
+  TPL002  retrace hazard in jitted code  (warning)
+  TPL003  untraced randomness            (error)
+  TPL004  lock discipline in serving/    (warning)
+  TPL005  eager block_until_ready        (warning)
+  TPL006  mutable default / import-time device allocation (error)
+
+Suppress a reviewed finding inline with a justification:
+
+    x = np.asarray(lengths)  # tpulint: disable=TPL001 -- host-side table
+
+or on the line above (`# tpulint: disable-next-line=TPL001 -- why`),
+or file-wide (`# tpulint: disable-file=TPL002 -- why`).
+"""
+from __future__ import annotations
+
+from .engine import Finding, Rule, Severity, all_rules, get_rule, register
+from .config import LintConfig, DEFAULT_CONFIG
+from .runner import lint_file, lint_paths, lint_source
+from .reporting import render_json, render_text
+
+# importing .rules registers every built-in rule with the engine
+from . import rules as _rules  # noqa: F401  (registration side effect)
+
+__all__ = [
+    "Finding", "Rule", "Severity", "LintConfig", "DEFAULT_CONFIG",
+    "all_rules", "get_rule", "register",
+    "lint_file", "lint_paths", "lint_source",
+    "render_json", "render_text",
+]

@@ -1,0 +1,92 @@
+"""tpulint command line (the body of tools/tpulint.py).
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/config error.
+Deliberately importable without jax — the linter is pure stdlib ast,
+so CI boxes without an accelerator stack can run it.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .config import LintConfig
+from .engine import all_rules, get_rule
+from .reporting import render_json, render_text
+from .runner import lint_paths
+
+# rule registration side effect
+from . import rules as _rules  # noqa: F401
+
+
+def _select_rules(only, disable):
+    selected = all_rules()
+    if only:
+        wanted = {r.strip().upper() for r in only.split(",") if r.strip()}
+        _validate(wanted)
+        selected = [r for r in selected if r.id in wanted]
+    if disable:
+        dropped = {r.strip().upper() for r in disable.split(",")
+                   if r.strip()}
+        _validate(dropped)
+        selected = [r for r in selected if r.id not in dropped]
+    return selected
+
+
+def _validate(ids):
+    known = {r.id for r in all_rules()}
+    unknown = ids - known
+    if unknown:
+        raise SystemExit(
+            f"tpulint: unknown rule(s) {sorted(unknown)}; "
+            f"known: {sorted(known)}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tpulint",
+        description="TPU-hostility static analysis for paddle_tpu "
+                    "(host syncs, retrace hazards, untraced RNG, lock "
+                    "discipline, import-time device work)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--rules", metavar="TPL001,TPL002",
+                    help="run only these rules")
+    ap.add_argument("--disable", metavar="TPL005",
+                    help="skip these rules")
+    ap.add_argument("--config", metavar="FILE.json",
+                    help="JSON overlay for hot modules / bench paths / "
+                         "lock scope / severities")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="include suppressed findings in text output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id}  {r.severity.value:7s} {r.name}")
+            print(f"        {r.rationale}")
+        return 0
+
+    if not args.paths:
+        ap.error("no paths given (try: tpulint paddle_tpu/)")
+
+    try:
+        config = LintConfig.from_json(args.config) if args.config \
+            else LintConfig.default()
+        rules = _select_rules(args.rules, args.disable)
+    except (OSError, ValueError) as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+
+    findings, nfiles = lint_paths(args.paths, config=config, rules=rules)
+    if args.format == "json":
+        print(render_json(findings, nfiles))
+    else:
+        print(render_text(findings, nfiles,
+                          show_suppressed=args.show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Repo-level lint configuration.
+
+The interesting judgement calls — *which* non-jitted code counts as a
+hot path, *where* an eager `block_until_ready` is legitimate, *which*
+packages get lock-discipline analysis — live here rather than in the
+rules, so a deployment can retarget tpulint with a JSON file instead
+of forking rule code (`tools/tpulint.py --config my.json`).
+
+All patterns are `fnmatch` globs matched against the forward-slash
+path of the scanned file (both the full path and every suffix of it,
+so `serving/*.py` matches `/root/repo/paddle_tpu/serving/scheduler.py`).
+"""
+from __future__ import annotations
+
+import fnmatch
+import json
+from dataclasses import dataclass, field
+
+from .engine import Severity
+
+
+def _match(patterns, path):
+    p = path.replace("\\", "/")
+    parts = p.split("/")
+    cands = {p} | {"/".join(parts[i:]) for i in range(len(parts))}
+    return any(fnmatch.fnmatch(c, pat) for pat in patterns for c in cands)
+
+
+@dataclass
+class LintConfig:
+    # Modules whose plain (non-jit) functions still count as hot for
+    # TPL001's host-sync checks: the serving runtime's step/pump loops
+    # run per decode step, so a stray device->host pull there costs a
+    # tunnel round trip per token.
+    hot_modules: list = field(default_factory=list)
+    # function (or Class.method) names inside hot_modules that form
+    # the actual per-step loop; empty = every function in the module.
+    hot_functions: list = field(default_factory=list)
+    # Where an eager block_until_ready is the *point* (benchmarks,
+    # profilers, device warm-up) rather than a pipeline stall.
+    bench_paths: list = field(default_factory=list)
+    # Packages that get TPL004 lock-discipline analysis.
+    lock_scope: list = field(default_factory=list)
+    # Files skipped entirely.
+    exclude: list = field(default_factory=list)
+    # Per-rule severity overrides: {"TPL002": "info"}.
+    severity: dict = field(default_factory=dict)
+
+    # ---- queries used by the rules -----------------------------------
+    def is_hot_module(self, path):
+        return _match(self.hot_modules, path)
+
+    def is_hot_function(self, qualname):
+        """qualname is 'func' or 'Class.method'."""
+        if not self.hot_functions:
+            return True
+        leaf = qualname.rsplit(".", 1)[-1]
+        return any(fnmatch.fnmatch(qualname, pat)
+                   or fnmatch.fnmatch(leaf, pat)
+                   for pat in self.hot_functions)
+
+    def is_bench_path(self, path):
+        return _match(self.bench_paths, path)
+
+    def in_lock_scope(self, path):
+        return _match(self.lock_scope, path)
+
+    def is_excluded(self, path):
+        return _match(self.exclude, path)
+
+    def severity_for(self, rule_id, default):
+        s = self.severity.get(rule_id)
+        return Severity.parse(s) if s is not None else default
+
+    # ---- construction -------------------------------------------------
+    @classmethod
+    def default(cls):
+        return cls(
+            hot_modules=[
+                "paddle_tpu/serving/*.py",
+                "paddle_tpu/models/llama_serving.py",
+            ],
+            hot_functions=[
+                # ServingEngine per-token loop + its helpers
+                "ServingEngine.step", "ServingEngine._spec_step",
+                "ServingEngine._prefill_step", "ServingEngine._admit",
+                "ServingEngine._seed_first_token",
+                # scheduler pump + publish run once per engine step
+                "RequestScheduler._pump", "RequestScheduler._publish",
+                "RequestScheduler._feed_locked",
+            ],
+            bench_paths=[
+                "bench*.py", "tools/*.py", "tests/*.py", "examples/*.py",
+                "paddle_tpu/profiler/*.py", "paddle_tpu/utils/__init__.py",
+                "paddle_tpu/device/*.py",
+            ],
+            lock_scope=["paddle_tpu/serving/*.py"],
+            exclude=[],
+            severity={},
+        )
+
+    @classmethod
+    def from_json(cls, path):
+        """Overlay a JSON config file onto the defaults; list fields
+        replace, the severity dict merges."""
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        cfg = cls.default()
+        for key in ("hot_modules", "hot_functions", "bench_paths",
+                    "lock_scope", "exclude"):
+            if key in data:
+                setattr(cfg, key, list(data[key]))
+        if "severity" in data:
+            cfg.severity.update(data["severity"])
+        unknown = set(data) - {"hot_modules", "hot_functions",
+                               "bench_paths", "lock_scope", "exclude",
+                               "severity"}
+        if unknown:
+            raise ValueError(f"tpulint config: unknown keys {sorted(unknown)}")
+        return cfg
+
+
+DEFAULT_CONFIG = LintConfig.default()

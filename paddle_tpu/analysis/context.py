@@ -1,0 +1,224 @@
+"""Per-file analysis context: one parse, shared by every rule.
+
+Builds the pieces rules keep needing:
+
+  * parent links on every AST node (`node._tpul_parent`);
+  * the set of function defs that are *traced* — decorated with
+    jit/pjit/pmap/shard_map/to_static (directly or via
+    functools.partial), wrapped by a module-level `g = jax.jit(f)`,
+    or nested inside such a function;
+  * per-function qualnames ('Class.method') for the hot-function
+    config match;
+  * import aliases, so `import jax.numpy as jnp` and
+    `from numpy import asarray` both resolve to canonical roots.
+"""
+from __future__ import annotations
+
+import ast
+
+
+_TRACE_WRAPPERS = {
+    "jit", "pjit", "pmap", "shard_map", "to_static", "checkpoint",
+    "remat", "grad", "value_and_grad", "vmap",
+}
+
+
+def dotted_name(node):
+    """ast expr -> 'a.b.c' when it is a plain attribute chain, else ''."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _decorator_traces(dec):
+    """True when a decorator expression compiles the function body.
+
+    Handles `@jax.jit`, `@jit`, `@pt.jit.to_static`,
+    `@functools.partial(jax.jit, static_argnames=...)`, and
+    `@jax.jit(...)`-style calls.
+    """
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn.rsplit(".", 1)[-1] == "partial":
+            return any(_decorator_traces(a) for a in dec.args)
+        dec_name = fn
+    else:
+        dec_name = dotted_name(dec)
+    leaf = dec_name.rsplit(".", 1)[-1] if dec_name else ""
+    return leaf in _TRACE_WRAPPERS
+
+
+class FileContext:
+    def __init__(self, path, source, config):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.config = config
+        self.tree = ast.parse(source, filename=path)
+        self._link_parents()
+        self.import_aliases = self._scan_imports()
+        self.traced_functions = self._find_traced_functions()
+        self.qualnames = self._build_qualnames()
+
+    # -------------------------------------------------------------- infra
+    def line(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def _link_parents(self):
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._tpul_parent = parent
+
+    def parents(self, node):
+        p = getattr(node, "_tpul_parent", None)
+        while p is not None:
+            yield p
+            p = getattr(p, "_tpul_parent", None)
+
+    def enclosing_function(self, node):
+        for p in self.parents(node):
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return p
+        return None
+
+    # ------------------------------------------------------------ imports
+    def _scan_imports(self):
+        """alias -> canonical dotted root, e.g. {'jnp': 'jax.numpy',
+        'np': 'numpy', 'asarray': 'numpy.asarray'}."""
+        aliases = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+        return aliases
+
+    def resolve(self, node):
+        """Canonical dotted name of a call target / attribute chain,
+        with the leading alias expanded: `jnp.zeros` -> 'jax.numpy.zeros'."""
+        name = dotted_name(node)
+        if not name:
+            return ""
+        head, _, rest = name.partition(".")
+        root = self.import_aliases.get(head, head)
+        return f"{root}.{rest}" if rest else root
+
+    # ----------------------------------------------------- traced regions
+    def _find_traced_functions(self):
+        traced = set()
+        # by decorator
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_decorator_traces(d) for d in node.decorator_list):
+                    traced.add(node)
+        # by wrapping call anywhere in the module: g = jax.jit(f) /
+        # self._step = jax.jit(step, donate_argnums=...)
+        defs = {n.name: n for n in ast.walk(self.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = self.resolve(node.func).rsplit(".", 1)[-1]
+            if leaf not in _TRACE_WRAPPERS and leaf != "partial":
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    if leaf in _TRACE_WRAPPERS:
+                        traced.add(defs[arg.id])
+        # nested defs inherit the traced context
+        out = set(traced)
+        for fn in traced:
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.add(sub)
+        return out
+
+    def in_traced_code(self, node):
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.traced_functions:
+                return fn
+            fn = self.enclosing_function(fn)
+        return None
+
+    # --------------------------------------------------------- qualnames
+    def _build_qualnames(self):
+        quals = {}
+
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    quals[child] = ".".join(stack + [child.name])
+                    visit(child, stack + [child.name])
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, stack + [child.name])
+                else:
+                    visit(child, stack)
+
+        visit(self.tree, [])
+        return quals
+
+    def qualname(self, fn):
+        return self.qualnames.get(fn, getattr(fn, "name", "<module>"))
+
+    def in_hot_function(self, node):
+        """Innermost named function, when this file is a configured hot
+        module and the function matches the hot-function list."""
+        if not self.config.is_hot_module(self.path):
+            return None
+        fn = self.enclosing_function(node)
+        if fn is None:
+            return None
+        return fn if self.config.is_hot_function(self.qualname(fn)) else None
+
+    # ------------------------------------------------------------ helpers
+    def function_params(self, fn):
+        a = fn.args
+        names = [p.arg for p in
+                 a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def expr_mentions_shape(self, node):
+        """Does the expression read `.shape`/`.ndim`/`.size` or call
+        len()? — the static-but-shape-dependent values whose use in
+        Python control flow means one retrace per distinct shape."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr in ("shape", "ndim", "size"):
+                return True
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Name) and sub.func.id == "len":
+                return True
+        return False
+
+    def expr_mentions_param(self, node, params):
+        """Does the expression (transitively) read one of `params`,
+        other than through .shape/.ndim/len()?"""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in params:
+                parent = getattr(sub, "_tpul_parent", None)
+                if isinstance(parent, ast.Attribute) and \
+                        parent.attr in ("shape", "ndim", "size", "dtype"):
+                    continue
+                if isinstance(parent, ast.Call) and \
+                        isinstance(parent.func, ast.Name) and \
+                        parent.func.id == "len":
+                    continue
+                return True
+        return False

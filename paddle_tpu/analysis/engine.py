@@ -1,0 +1,162 @@
+"""Rule engine core: findings, severities, the rule registry, and the
+inline-suppression grammar.
+
+A rule is a class with a `check(ctx) -> iterable[Finding]` method over
+one parsed file (`context.FileContext`). Registration is declarative —
+defining a subclass with `@register` adds it to the global table the
+runner and CLI iterate.
+"""
+from __future__ import annotations
+
+import enum
+import re
+from dataclasses import dataclass, field
+
+
+class Severity(enum.Enum):
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    @classmethod
+    def parse(cls, s):
+        try:
+            return cls(str(s).lower())
+        except ValueError:
+            raise ValueError(
+                f"unknown severity {s!r}: want one of "
+                f"{[m.value for m in cls]}") from None
+
+
+@dataclass
+class Finding:
+    """One diagnostic. `line`/`col` are 1-based/0-based like CPython's
+    ast; `context` is the stripped source line for human output."""
+    rule: str
+    severity: Severity
+    path: str
+    line: int
+    col: int
+    message: str
+    context: str = ""
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def to_dict(self):
+        d = {
+            "rule": self.rule,
+            "severity": self.severity.value,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "context": self.context,
+        }
+        if self.suppressed:
+            d["suppressed"] = True
+            if self.suppress_reason:
+                d["suppress_reason"] = self.suppress_reason
+        return d
+
+
+class Rule:
+    """Base class. Subclasses set `id` (TPLnnn), `name`, `severity`,
+    and a one-line `rationale` used by --list-rules and the docs."""
+    id: str = ""
+    name: str = ""
+    severity: Severity = Severity.WARNING
+    rationale: str = ""
+
+    def check(self, ctx):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def finding(self, ctx, node, message, severity=None):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule=self.id,
+            severity=severity or ctx.config.severity_for(self.id,
+                                                         self.severity),
+            path=ctx.path,
+            line=line,
+            col=col,
+            message=message,
+            context=ctx.line(line).strip(),
+        )
+
+
+_REGISTRY: dict = {}
+
+
+def register(cls):
+    """Class decorator: add a Rule subclass to the global registry."""
+    if not cls.id or not re.fullmatch(r"TPL\d{3}", cls.id):
+        raise ValueError(f"rule {cls.__name__}: id {cls.id!r} must be TPLnnn")
+    if cls.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    _REGISTRY[cls.id] = cls()
+    return cls
+
+
+def all_rules():
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def get_rule(rule_id):
+    return _REGISTRY[rule_id]
+
+
+# ---------------------------------------------------------------- suppression
+# Grammar (comment anywhere on the physical line):
+#   # tpulint: disable=TPL001[,TPL004|all] [-- justification]
+#   # tpulint: disable-next-line=TPL001 [-- justification]
+#   # tpulint: disable-file=TPL002 [-- justification]
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(disable(?:-next-line|-file)?)\s*=\s*"
+    r"([A-Za-z0-9_,\s]+?)\s*(?:--\s*(.*))?$")
+
+
+@dataclass
+class Suppressions:
+    """Per-file index of inline suppressions."""
+    by_line: dict = field(default_factory=dict)       # line -> (set, reason)
+    file_wide: set = field(default_factory=set)
+    file_reasons: dict = field(default_factory=dict)
+
+    @classmethod
+    def scan(cls, source_lines):
+        sup = cls()
+        for i, text in enumerate(source_lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            kind, ids_s, reason = m.group(1), m.group(2), m.group(3) or ""
+            ids = {t.strip().upper() for t in ids_s.split(",") if t.strip()}
+            if kind == "disable-file":
+                sup.file_wide |= ids
+                for r in ids:
+                    sup.file_reasons[r] = reason
+            else:
+                line = i + 1 if kind == "disable-next-line" else i
+                cur, old_reason = sup.by_line.get(line, (set(), ""))
+                sup.by_line[line] = (cur | ids, reason or old_reason)
+        return sup
+
+    def match(self, finding):
+        """Return (suppressed, reason) for a finding."""
+        if "ALL" in self.file_wide or finding.rule in self.file_wide:
+            return True, self.file_reasons.get(
+                finding.rule, self.file_reasons.get("ALL", ""))
+        ids, reason = self.by_line.get(finding.line, (set(), ""))
+        if "ALL" in ids or finding.rule in ids:
+            return True, reason
+        return False, ""
+
+
+def apply_suppressions(findings, suppressions):
+    for f in findings:
+        hit, reason = suppressions.match(f)
+        if hit:
+            f.suppressed = True
+            f.suppress_reason = reason
+    return findings

@@ -1,0 +1,55 @@
+"""Text and JSON renderers for lint results.
+
+JSON schema (stable; tests/test_tpulint.py pins it):
+
+    {
+      "version": 1,
+      "files_scanned": <int>,
+      "findings": [ {rule, severity, path, line, col, message,
+                     context, suppressed?, suppress_reason?} ],
+      "counts": {"<rule>": <unsuppressed count>},
+      "suppressed": <int>,
+      "clean": <bool>          # no unsuppressed findings
+    }
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+
+def _active(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+def render_text(findings, files_scanned, show_suppressed=False):
+    out = []
+    shown = findings if show_suppressed else _active(findings)
+    for f in shown:
+        tag = " [suppressed]" if f.suppressed else ""
+        out.append(f"{f.path}:{f.line}:{f.col}: {f.rule} "
+                   f"{f.severity.value}: {f.message}{tag}")
+        if f.context:
+            out.append(f"    {f.context}")
+    active = _active(findings)
+    counts = Counter(f.rule for f in active)
+    summary = ", ".join(f"{r}×{n}" for r, n in sorted(counts.items()))
+    nsup = len(findings) - len(active)
+    out.append(
+        f"tpulint: {len(active)} finding(s) in {files_scanned} file(s)"
+        + (f" [{summary}]" if summary else "")
+        + (f", {nsup} suppressed" if nsup else ""))
+    return "\n".join(out)
+
+
+def render_json(findings, files_scanned):
+    active = _active(findings)
+    doc = {
+        "version": 1,
+        "files_scanned": files_scanned,
+        "findings": [f.to_dict() for f in findings],
+        "counts": dict(Counter(f.rule for f in active)),
+        "suppressed": len(findings) - len(active),
+        "clean": not active,
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)

@@ -1,0 +1,7 @@
+"""Built-in tpulint rules. Importing this package registers every rule
+with the engine registry (paddle_tpu.analysis.engine)."""
+from . import host_sync    # TPL001, TPL005   # noqa: F401
+from . import retrace      # TPL002           # noqa: F401
+from . import rng          # TPL003           # noqa: F401
+from . import locks        # TPL004           # noqa: F401
+from . import imports      # TPL006           # noqa: F401

@@ -1,0 +1,132 @@
+"""TPL006 — mutable default arguments and import-time device work.
+
+Mutable defaults are the classic shared-state footgun; in a framework
+they additionally leak across jit boundaries (the default is part of
+the cached signature by identity). Module-level `jnp.*` / device_put
+calls initialize the backend at *import* time — they grab the TPU
+runtime (or crash in a CPU-only driver process) before the program
+chose a platform, and make `import paddle_tpu` cost a device round
+trip.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule, Severity, register
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = ("list", "dict", "set", "bytearray", "defaultdict",
+                  "OrderedDict", "deque", "Counter")
+
+# Call roots that allocate on / initialize the device backend.
+_DEVICE_ALLOC_PREFIXES = (
+    "jax.numpy.", "jax.device_put", "jax.devices", "jax.local_devices",
+    "jax.random.", "jax.device_count", "jax.local_device_count",
+    "jax.eval_shape",
+)
+# jnp helpers that are pure metadata (no allocation) — allowed.
+_DEVICE_ALLOC_EXEMPT = (
+    "jax.numpy.dtype", "jax.numpy.issubdtype", "jax.numpy.promote_types",
+    "jax.numpy.finfo", "jax.numpy.iinfo",
+)
+
+
+@register
+class ImportHygieneRule(Rule):
+    id = "TPL006"
+    name = "mutable-default-or-import-time-device-work"
+    severity = Severity.ERROR
+    rationale = ("mutable defaults alias across calls (and across the "
+                 "jit cache); module-level jnp/device calls init the "
+                 "backend at import time")
+
+    def check(self, ctx):
+        yield from self._check_defaults(ctx)
+        yield from self._check_import_time(ctx)
+
+    # -- mutable default args -------------------------------------------
+    def _check_defaults(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            a = node.args
+            name = getattr(node, "name", "<lambda>")
+            for d in list(a.defaults) + [x for x in a.kw_defaults
+                                         if x is not None]:
+                if isinstance(d, _MUTABLE_LITERALS):
+                    yield self.finding(
+                        ctx, d,
+                        f"mutable default argument in `{name}`: shared "
+                        "across every call — default to None and build "
+                        "inside")
+                elif isinstance(d, ast.Call) and \
+                        isinstance(d.func, ast.Name) and \
+                        d.func.id in _MUTABLE_CTORS:
+                    yield self.finding(
+                        ctx, d,
+                        f"mutable default argument `{d.func.id}()` in "
+                        f"`{name}`: evaluated once at def time and "
+                        "shared — default to None and build inside")
+
+    # -- import-time device allocation ----------------------------------
+    def _check_import_time(self, ctx):
+        for node in self._import_time_nodes(ctx.tree):
+            for sub in self._walk_skipping_lambdas(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                target = ctx.resolve(sub.func)
+                if not target or target in _DEVICE_ALLOC_EXEMPT:
+                    continue
+                if any(target == p or target.startswith(p)
+                       for p in _DEVICE_ALLOC_PREFIXES):
+                    yield self.finding(
+                        ctx, sub,
+                        f"`{target}` at module import time initializes "
+                        "the device backend before the program picked "
+                        "one — allocate lazily (inside a function or "
+                        "cached property)")
+
+    def _walk_skipping_lambdas(self, node):
+        """ast.walk, but lambda bodies are deferred (not import time)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            for child in ast.iter_child_nodes(n):
+                if not isinstance(child, ast.Lambda):
+                    stack.append(child)
+
+    def _import_time_nodes(self, tree):
+        """Statements executed when the module is imported: module and
+        class bodies (descending through module-level if/try/with/for),
+        but never function bodies. For a def, only its decorators and
+        defaults run at import time."""
+        stack = [s for s in tree.body]
+        while stack:
+            stmt = stack.pop()
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in stmt.decorator_list:
+                    yield dec
+                for d in stmt.args.defaults:
+                    yield d
+                for d in stmt.args.kw_defaults:
+                    if d is not None:
+                        yield d
+            elif isinstance(stmt, ast.ClassDef):
+                stack.extend(stmt.body)
+            elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                                   ast.Try)):
+                for attr in ("body", "orelse", "finalbody"):
+                    stack.extend(getattr(stmt, attr, []) or [])
+                for h in getattr(stmt, "handlers", []) or []:
+                    stack.extend(h.body)
+                for sub in ("test", "iter"):
+                    node = getattr(stmt, sub, None)
+                    if node is not None:
+                        yield node
+                for item in getattr(stmt, "items", []) or []:
+                    yield item.context_expr
+            else:
+                yield stmt

@@ -1,0 +1,160 @@
+"""TPL004 — lock discipline in the serving runtime.
+
+Two hazards in thread-heavy code driving a TPU engine:
+
+  1. a shared attribute written under `self._lock` in one method and
+     bare in another — the bare write races the locked readers;
+  2. an engine/device call made while holding the lock — a decode
+     step is milliseconds of device time, so every submitter blocks
+     on the condition variable for the whole step.
+
+Scope is configured (`lock_scope`, default `paddle_tpu/serving/`).
+Convention: methods named `*_locked` document "caller holds the
+lock" and are treated as locked context.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..context import dotted_name
+from ..engine import Rule, Severity, register
+
+_LOCK_TYPES = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+
+# Call leafs that can occupy the device / block for a step while the
+# lock is held. `step` and `generate` are the engine entry points.
+_BLOCKING_LEAFS = {"step", "generate", "block_until_ready",
+                   "device_get", "sleep"}
+
+
+def _self_attr(node):
+    """'attr' when node is `self.attr`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, cls):
+        self.cls = cls
+        self.locks = set()          # attr names holding a Lock/Condition
+        self.locked_writes = {}     # attr -> first write node under lock
+        self.bare_writes = []       # (attr, node, method)
+        self.locked_calls = []      # (node, method, lock_attr)
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "TPL004"
+    name = "lock-discipline"
+    severity = Severity.WARNING
+    rationale = ("shared attrs written bare race their locked readers; "
+                 "engine/device calls under a lock stall every thread "
+                 "for a full device step")
+
+    def check(self, ctx):
+        if not ctx.config.in_lock_scope(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    # ------------------------------------------------------------------
+    def _check_class(self, ctx, cls):
+        info = self._scan(ctx, cls)
+        if not info.locks:
+            return
+        shared = set(info.locked_writes)
+        for attr, node, method in info.bare_writes:
+            if attr in shared:
+                yield self.finding(
+                    ctx, node,
+                    f"`self.{attr}` is written under the lock in "
+                    f"`{self._owner(ctx, info, attr)}` but bare in "
+                    f"`{method.name}`: racing the locked readers — "
+                    "take the lock or document single-thread ownership")
+        for node, method, lock_attr in info.locked_calls:
+            yield self.finding(
+                ctx, node,
+                f"engine/device call while holding `self.{lock_attr}` "
+                f"in `{method.name}`: every other thread blocks for "
+                "the whole device step — move the call outside the "
+                "lock and publish results after")
+
+    def _owner(self, ctx, info, attr):
+        node = info.locked_writes[attr]
+        fn = ctx.enclosing_function(node)
+        return fn.name if fn is not None else "<module>"
+
+    # ------------------------------------------------------------------
+    def _scan(self, ctx, cls):
+        info = _ClassInfo(cls)
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # pass 1: which attrs hold locks
+        for m in methods:
+            for node in ast.walk(m):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    leaf = dotted_name(node.value.func).rsplit(".", 1)[-1]
+                    if leaf in _LOCK_TYPES:
+                        for t in node.targets:
+                            attr = _self_attr(t)
+                            if attr:
+                                info.locks.add(attr)
+        if not info.locks:
+            return info
+        # pass 2: classify writes + calls by locked-ness
+        for m in methods:
+            is_init = m.name == "__init__"
+            locked_by_name = m.name.endswith("_locked")
+            for node in ast.walk(m):
+                lock_attr = self._held_lock(ctx, node, info.locks, m)
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None or attr in info.locks:
+                            continue
+                        if lock_attr or locked_by_name:
+                            info.locked_writes.setdefault(attr, node)
+                        elif not is_init:
+                            info.bare_writes.append((attr, node, m))
+                elif isinstance(node, ast.Call) and lock_attr:
+                    if self._is_blocking_call(ctx, node):
+                        info.locked_calls.append((node, m, lock_attr))
+        return info
+
+    def _held_lock(self, ctx, node, locks, method):
+        """Name of the lock attr whose `with self.<lock>:` encloses
+        `node` (searching only within `method`)."""
+        for p in ctx.parents(node):
+            if p is method:
+                break
+            if isinstance(p, ast.With):
+                for item in p.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        expr = expr.func
+                        # with self._cond.acquire_timeout(...) style
+                        if isinstance(expr, ast.Attribute):
+                            expr = expr.value
+                    attr = _self_attr(expr)
+                    if attr in locks:
+                        return attr
+        return None
+
+    def _is_blocking_call(self, ctx, call):
+        name = dotted_name(call.func)
+        if not name:
+            return False
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf not in _BLOCKING_LEAFS:
+            return False
+        # `self._cond.wait(timeout=...)` etc. are how condition vars
+        # are used; don't confuse them with blocking device work.
+        return True

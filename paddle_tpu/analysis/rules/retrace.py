@@ -1,0 +1,176 @@
+"""TPL002 — retrace hazards in jitted code.
+
+jit caches one executable per (shapes, dtypes, static-arg values)
+signature. Python control flow on traced values either crashes
+(TracerBoolConversionError) or — when keyed off `.shape`/`len()` —
+silently compiles a fresh executable per distinct shape: the retrace
+storm that turns a serving warm-up into minutes of XLA time.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..context import dotted_name
+from ..engine import Rule, Severity, register
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+
+
+@register
+class RetraceRule(Rule):
+    id = "TPL002"
+    name = "retrace-hazard"
+    severity = Severity.WARNING
+    rationale = ("Python control flow on traced values/shapes inside "
+                 "jit compiles one executable per distinct signature")
+
+    def check(self, ctx):
+        for fn in ctx.traced_functions:
+            params = ctx.function_params(fn)
+            yield from self._check_control_flow(ctx, fn, params)
+            yield from self._check_format_deps(ctx, fn, params)
+        yield from self._check_static_args(ctx)
+
+    # -- Python control flow over traced/shape values -------------------
+    def _check_control_flow(self, ctx, fn, params):
+        for node in ast.walk(fn):
+            # nested defs are traced too and visited on their own pass
+            if ctx.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, (ast.If, ast.While)):
+                yield from self._flag_test(ctx, node.test, params,
+                                           kind=type(node).__name__.lower())
+            elif isinstance(node, ast.IfExp):
+                yield from self._flag_test(ctx, node.test, params,
+                                           kind="conditional expression")
+            elif isinstance(node, ast.For):
+                yield from self._flag_loop(ctx, node, params)
+
+    def _flag_test(self, ctx, test, params, kind):
+        # `x is None` / isinstance() / flag-style names are static
+        # Python: branching on them is how jit code selects variants.
+        if self._is_static_test(ctx, test, params):
+            return
+        if ctx.expr_mentions_shape(test):
+            yield self.finding(
+                ctx, test,
+                f"`{kind}` on a shape-dependent value in a jitted body: "
+                "one retrace per distinct shape — pad to a bucket or "
+                "use lax.cond/jnp.where")
+        elif ctx.expr_mentions_param(test, params):
+            yield self.finding(
+                ctx, test,
+                f"`{kind}` on a possibly-traced value in a jitted body: "
+                "crashes under trace or silently retraces — use "
+                "lax.cond/jnp.where, or mark the argument static")
+
+    def _flag_loop(self, ctx, node, params):
+        it = node.iter
+        # for i in range(x.shape[0]) — unrolled shape-dependent loop
+        if isinstance(it, ast.Call) and \
+                dotted_name(it.func) in ("range", "reversed"):
+            for arg in it.args:
+                if ctx.expr_mentions_shape(arg):
+                    yield self.finding(
+                        ctx, node,
+                        "`for` over a shape-dependent range in a jitted "
+                        "body: unrolls into the HLO and retraces per "
+                        "shape — use lax.fori_loop/lax.scan")
+                    return
+        elif ctx.expr_mentions_param(it, params) and \
+                not ctx.expr_mentions_shape(it):
+            yield self.finding(
+                ctx, node,
+                "`for` directly over a traced value in a jitted body: "
+                "unrolls (or crashes) under trace — use lax.scan")
+
+    def _is_static_test(self, ctx, test, params):
+        if isinstance(test, ast.Compare) and \
+                any(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in test.ops):
+            return True
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                leaf = dotted_name(sub.func).rsplit(".", 1)[-1]
+                if leaf in ("isinstance", "hasattr", "callable",
+                            "issubclass"):
+                    return True
+        return False
+
+    # -- shape/tracer leakage through f-strings and dict keys -----------
+    def _check_format_deps(self, ctx, fn, params):
+        for node in ast.walk(fn):
+            if ctx.enclosing_function(node) is not fn:
+                continue
+            if isinstance(node, ast.JoinedStr):
+                for val in node.values:
+                    if isinstance(val, ast.FormattedValue) and \
+                            (ctx.expr_mentions_shape(val.value) or
+                             ctx.expr_mentions_param(val.value, params)):
+                        yield self.finding(
+                            ctx, node,
+                            "f-string over a traced/shape value in a "
+                            "jitted body: formatting concretizes — move "
+                            "logging out of the traced region")
+                        break
+            elif isinstance(node, ast.Subscript) and \
+                    ctx.expr_mentions_shape(node.slice):
+                parent = getattr(node, "_tpul_parent", None)
+                if isinstance(parent, (ast.Assign, ast.AugAssign)) or \
+                        isinstance(node.slice, (ast.Tuple, ast.Attribute)):
+                    yield self.finding(
+                        ctx, node,
+                        "shape-keyed lookup in a jitted body: the key "
+                        "changes per input shape, so the trace is "
+                        "shape-dependent — hoist it to the caller")
+
+    # -- non-hashable static args ---------------------------------------
+    def _check_static_args(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            static_names = set()
+            for dec in node.decorator_list:
+                static_names |= self._static_names_of(ctx, dec, node)
+            if not static_names:
+                continue
+            a = node.args
+            pos = a.posonlyargs + a.args
+            defaults = dict(zip([p.arg for p in pos[len(pos)
+                                                   - len(a.defaults):]],
+                                a.defaults))
+            defaults.update({p.arg: d for p, d in
+                             zip(a.kwonlyargs, a.kw_defaults)
+                             if d is not None})
+            for name in sorted(static_names):
+                d = defaults.get(name)
+                if d is not None and isinstance(d, _MUTABLE_LITERALS):
+                    yield self.finding(
+                        ctx, d,
+                        f"static arg `{name}` of jitted `{node.name}` "
+                        "defaults to a non-hashable value: every call "
+                        "misses the jit cache (unhashable) or keys on "
+                        "identity — use a tuple/frozen config")
+
+    def _static_names_of(self, ctx, dec, fn):
+        """Names listed in static_argnames=/static_argnums= of a jit
+        decorator (possibly spelled via functools.partial)."""
+        if not isinstance(dec, ast.Call):
+            return set()
+        names = set()
+        a = fn.args
+        pos = [p.arg for p in a.posonlyargs + a.args]
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, str):
+                        names.add(sub.value)
+            elif kw.arg == "static_argnums":
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Constant) and \
+                            isinstance(sub.value, int) and \
+                            0 <= sub.value < len(pos):
+                        names.add(pos[sub.value])
+        return names

@@ -1,0 +1,49 @@
+"""TPL003 — untraced randomness inside traced code.
+
+`np.random.*` / `random.*` execute once at TRACE time: the sampled
+value is baked into the compiled executable as a constant, so every
+subsequent call replays the same "random" numbers, and different
+hosts trace different constants — silent determinism and parity
+breakage. Traced code must thread `jax.random` keys.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Rule, Severity, register
+
+_HOST_RNG_ROOTS = ("numpy.random", "random")
+
+
+@register
+class UntracedRandomRule(Rule):
+    id = "TPL003"
+    name = "untraced-randomness"
+    severity = Severity.ERROR
+    rationale = ("host RNG inside a traced body is baked in as a "
+                 "trace-time constant — non-deterministic across "
+                 "hosts, constant across calls")
+
+    def check(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.in_traced_code(node) is None:
+                continue
+            target = ctx.resolve(node.func)
+            if not target:
+                continue
+            if target.startswith("numpy.random.") or \
+                    target == "numpy.random":
+                yield self.finding(
+                    ctx, node,
+                    f"`{target}` inside a jitted body runs at trace "
+                    "time: the value is a compiled-in constant — "
+                    "thread a jax.random key instead")
+            elif target.startswith("random.") and \
+                    ctx.import_aliases.get("random") == "random":
+                yield self.finding(
+                    ctx, node,
+                    f"stdlib `{target}` inside a jitted body runs at "
+                    "trace time: the value is a compiled-in constant "
+                    "— thread a jax.random key instead")

@@ -1,0 +1,62 @@
+"""Drive the rules over files/trees and fold in suppressions."""
+from __future__ import annotations
+
+import os
+
+from .config import DEFAULT_CONFIG
+from .context import FileContext
+from .engine import (Finding, Severity, all_rules, apply_suppressions,
+                     Suppressions)
+
+
+def lint_source(source, path="<string>", config=None, rules=None):
+    """Lint one source string. Returns all findings, with suppressed
+    ones marked (filter on `f.suppressed` for the gate)."""
+    config = config or DEFAULT_CONFIG
+    try:
+        ctx = FileContext(path, source, config)
+    except SyntaxError as e:
+        return [Finding(rule="TPL000", severity=Severity.ERROR, path=path,
+                        line=e.lineno or 1, col=(e.offset or 1) - 1,
+                        message=f"syntax error: {e.msg}")]
+    selected = rules if rules is not None else all_rules()
+    findings = []
+    for rule in selected:
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return apply_suppressions(findings,
+                              Suppressions.scan(ctx.lines))
+
+
+def lint_file(path, config=None, rules=None):
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, path=path, config=config, rules=rules)
+
+
+def iter_python_files(paths, config=None):
+    config = config or DEFAULT_CONFIG
+    for p in paths:
+        if os.path.isfile(p):
+            if not config.is_excluded(p):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in ("__pycache__", ".git"))
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(root, name)
+                if not config.is_excluded(full):
+                    yield full
+
+
+def lint_paths(paths, config=None, rules=None):
+    """Lint files/directories. Returns (findings, files_scanned)."""
+    config = config or DEFAULT_CONFIG
+    findings, nfiles = [], 0
+    for path in iter_python_files(paths, config):
+        nfiles += 1
+        findings.extend(lint_file(path, config=config, rules=rules))
+    return findings, nfiles

@@ -48,7 +48,7 @@ class Task:
 
     def synchronize(self):
         for a in self._arrays:
-            jax.block_until_ready(a)
+            jax.block_until_ready(a)  # tpulint: disable=TPL005 -- Task.synchronize() is an explicit wait
 
     # reference spells the host-side wait cpu_synchronize
     cpu_synchronize = synchronize

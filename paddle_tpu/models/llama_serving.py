@@ -26,6 +26,7 @@ import time
 import numpy as np
 import jax
 import jax.numpy as jnp
+from .._core.compat import shard_map
 
 from ..profiler import record_span
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
@@ -85,8 +86,8 @@ def _attn_tp(fn, mesh, quant):
     from jax.sharding import PartitionSpec as P
     qs, kvs, rep = P(None, "tp"), P("tp"), P(None)
     in_specs = (qs, kvs, kvs, rep, rep) + ((kvs, kvs) if quant else ())
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=qs,
-                         check_vma=False)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=qs,
+                     check_vma=False)
 
 
 # ---------------------------------------------------------------------------
@@ -219,11 +220,13 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
         kp, vp, ksp, vsp, kl, vl, ksl, vsl = _scatter_kv(
             kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
         if mesh is not None:
-            def _attn(q_, kl_, vl_, pt_, ln_, *sc):
+            # scales arrive as explicit defaulted params (not a *sc
+            # truthiness branch): the arity is fixed by `quant`, which
+            # is static, so the trace has no value-dependent control flow
+            def _attn(q_, kl_, vl_, pt_, ln_, ks_=None, vs_=None):
                 return paged_attention(
                     q_, kl_, vl_, pt_, ln_, use_pallas=use_pallas,
-                    interpret=interpret, k_scale=sc[0] if sc else None,
-                    v_scale=sc[1] if sc else None)
+                    interpret=interpret, k_scale=ks_, v_scale=vs_)
             args = (q[:, :, 0], kl, vl, page_table, lengths) \
                 + ((ksl, vsl) if quant else ())
             o = _attn_tp(_attn, mesh, quant)(*args)         # (B, QH, D)
@@ -309,11 +312,11 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
             kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
         # q: (B, QH, G, D); per-row causal limit base+g inside the op
         if mesh is not None:
-            def _attn(q_, kl_, vl_, pt_, ln_, *sc):
+            # see prefill `_attn`: fixed arity instead of *sc truthiness
+            def _attn(q_, kl_, vl_, pt_, ln_, ks_=None, vs_=None):
                 return paged_verify_attention(
                     q_, kl_, vl_, pt_, ln_, use_pallas=use_pallas,
-                    interpret=interpret, k_scale=sc[0] if sc else None,
-                    v_scale=sc[1] if sc else None)
+                    interpret=interpret, k_scale=ks_, v_scale=vs_)
             args = (q, kl, vl, page_table, lengths) \
                 + ((ksl, vsl) if quant else ())
             o = _attn_tp(_attn, mesh, quant)(*args)
@@ -851,6 +854,14 @@ class ServingEngine:
         # on CPU, a tunnel round-trip on TPU) — measured 96 compiles in
         # 65 steps before this, drowning steady-state decode
         pg, off = self._packed_indices(k_all.shape[2])
+        # every admitted request's first-token logits row comes over in
+        # one batched device_get — np.asarray(logits[i]) inside the loop
+        # was a blocking round trip per admission (tpulint TPL001)
+        seed_idx = [i for i, req in enumerate(reqs)
+                    if not getattr(req, "_resume", False)]
+        seed_rows = dict(zip(seed_idx, jax.device_get(  # tpulint: disable=TPL001 -- one batched transfer per admission wave
+            logits[jnp.asarray(seed_idx, jnp.int32)]))) \
+            if seed_idx else {}
         for i, (slot, req) in enumerate(zip(slots, reqs)):
             a = int(cu[i])
             self._fill_indices(pg, off, slot, a, lens[i])
@@ -863,7 +874,7 @@ class ServingEngine:
                 # sampled before eviction — do NOT re-sample it
                 req._resume = False
             else:
-                self._seed_first_token(slot, req, np.asarray(logits[i]))
+                self._seed_first_token(slot, req, seed_rows[i])
         self._scatter_packed(k_all, v_all, pg, off)
 
     def _packed_indices(self, t):
@@ -1097,11 +1108,18 @@ class ServingEngine:
                 interpret=self._interpret, k_scale=self.k_scale,
                 v_scale=self.v_scale, mesh=self._mesh)
         # all-greedy fast path: argmax on device, transfer max_seqs ints;
-        # only sampling/logprobs requests pull their [vocab] row to host
-        greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))
-        rows = {s: np.asarray(logits[s]) for s in active_slots
-                if self._slots[s].temperature > 0.0
-                or self._slots[s].want_logprobs}
+        # only sampling/logprobs requests pull their [vocab] row to host.
+        # ONE batched device_get for everything the host loop needs this
+        # step — the previous per-slot np.asarray calls were 1 + n_sampling
+        # blocking round trips per emitted token (tpulint TPL001).
+        need_rows = [s for s in active_slots
+                     if self._slots[s].temperature > 0.0
+                     or self._slots[s].want_logprobs]
+        greedy_nxt, row_vals = jax.device_get(  # tpulint: disable=TPL001 -- the single batched transfer the step loop needs
+            (jnp.argmax(logits, axis=-1),
+             logits[jnp.asarray(need_rows, jnp.int32)]
+             if need_rows else None))
+        rows = {} if row_vals is None else dict(zip(need_rows, row_vals))
         for s in active_slots:
             req = self._slots[s]
             tok = req.pick(rows[s]) if req.temperature > 0.0 \
@@ -1190,16 +1208,35 @@ class ServingEngine:
                 k_scale=self.k_scale, v_scale=self.v_scale,
                 mesh=self._mesh)
         self.device_steps += 1
-        greedy_nxt = np.asarray(jnp.argmax(logits, axis=-1))  # (B, G)
         # one rows dict for everyone who needs host rows: sampling
         # requests AND logprobs requests (emission j's logprob comes
         # from chunk row j); pure-greedy no-logprobs slots stay on the
-        # device-argmax fast path
-        rows_by_slot = {s: np.asarray(logits[s, :int(n_tok[s])])
-                        for s in active_slots
-                        if (self._slots[s].temperature > 0.0
-                            or self._slots[s].want_logprobs)
-                        and not self._prefilling(self._slots[s])}
+        # device-argmax fast path. All host pulls for this step — the
+        # argmax grid, the sampling/logprobs rows, and the final-chunk
+        # row that seeds a finishing prefill — ride ONE batched
+        # device_get instead of a blocking np.asarray per slot (TPL001).
+        need_rows = [s for s in active_slots
+                     if (self._slots[s].temperature > 0.0
+                         or self._slots[s].want_logprobs)
+                     and not self._prefilling(self._slots[s])]
+        seed_slots = [s for s in active_slots
+                      if self._prefilling(self._slots[s])
+                      and self._slots[s]._pf_cursor + int(n_tok[s])
+                      >= len(self._slots[s]._pf_feed)
+                      and self._slots[s]._pf_sample]
+        greedy_nxt, row_vals, seed_vals = jax.device_get(  # tpulint: disable=TPL001 -- the single batched transfer the verify loop needs
+            (jnp.argmax(logits, axis=-1),                 # (B, G)
+             logits[jnp.asarray(need_rows, jnp.int32)]
+             if need_rows else None,
+             logits[jnp.asarray(seed_slots, jnp.int32),
+                    jnp.asarray([int(n_tok[s]) - 1 for s in seed_slots],
+                                jnp.int32)]
+             if seed_slots else None))
+        rows_by_slot = {} if row_vals is None else \
+            {s: row_vals[i][:int(n_tok[s])]
+             for i, s in enumerate(need_rows)}
+        seed_rows = {} if seed_vals is None else \
+            dict(zip(seed_slots, seed_vals))
         for s in active_slots:
             req = self._slots[s]
             n = int(n_tok[s])
@@ -1209,8 +1246,7 @@ class ServingEngine:
                 req._pf_cursor += n
                 self.lengths[s] += n
                 if req._pf_cursor >= len(req._pf_feed) and req._pf_sample:
-                    self._seed_first_token(s, req,
-                                           np.asarray(logits[s, n - 1]))
+                    self._seed_first_token(s, req, seed_rows[s])
                 continue
             rows = rows_by_slot.get(s)
             if req.temperature > 0.0 and n > 1:

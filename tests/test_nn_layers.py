@@ -411,7 +411,12 @@ class TestPoolGradUnderJit:
             pt.nn.Flatten(),
             pt.nn.Linear(4 * 16 * 16, 5),
         )
-        opt = pt.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+        # lr=0.1 with momentum=0.9 (effective lr ~1.0) overshoots on a
+        # 2-sample batch for some inits (incl. the conftest seed); the
+        # trainer trajectory is bit-identical to a hand-rolled jax momentum
+        # loop, so keep the step stable rather than assert on an
+        # oscillating one.
+        opt = pt.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
                                     parameters=model.parameters())
         ce = pt.nn.CrossEntropyLoss()
         tr = Trainer(model, opt, lambda m, b: ce(m(b[0]), b[1]),

@@ -1,0 +1,515 @@
+"""tpulint unit tests: per-rule positive/negative fixtures, the
+suppression grammar, config targeting, and the JSON output schema."""
+import json
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import (DEFAULT_CONFIG, LintConfig, all_rules,
+                                 lint_source, render_json)
+
+
+def run(src, path="paddle_tpu/nn/x.py", config=None, rules=None):
+    findings = lint_source(textwrap.dedent(src), path=path,
+                           config=config or LintConfig.default(),
+                           rules=rules)
+    return [f for f in findings if not f.suppressed]
+
+
+def rule_ids(src, **kw):
+    return sorted({f.rule for f in run(src, **kw)})
+
+
+HOT = LintConfig.default()
+HOT.hot_modules = ["hotmod.py"]
+HOT.hot_functions = ["Engine.step"]
+
+LOCKED = LintConfig.default()
+LOCKED.lock_scope = ["locked_mod.py"]
+
+
+# ---------------------------------------------------------------- registry
+def test_six_rules_registered():
+    assert [r.id for r in all_rules()] == [
+        "TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006"]
+
+
+# ---------------------------------------------------------------- TPL001
+class TestHostSync:
+    def test_fires_on_numpy_call_in_jit(self):
+        assert rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                return x.numpy()
+        """) == ["TPL001"]
+
+    def test_fires_on_np_asarray_in_jit(self):
+        assert rule_ids("""
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return np.asarray(x) + 1
+        """) == ["TPL001"]
+
+    def test_fires_on_item_and_device_get(self):
+        out = run("""
+            import jax
+            @jax.jit
+            def f(x):
+                a = x.item()
+                return jax.device_get(a)
+        """)
+        assert [f.rule for f in out] == ["TPL001", "TPL001"]
+
+    def test_fires_on_float_of_traced_param(self):
+        assert rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x)
+        """) == ["TPL001"]
+
+    def test_silent_on_float_of_shape(self):
+        assert rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                return x.reshape(int(x.shape[0]) * 2)
+        """) == []
+
+    def test_silent_on_jnp_asarray_in_jit(self):
+        assert rule_ids("""
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def f(x):
+                return jnp.asarray(x)
+        """) == []
+
+    def test_silent_outside_jit_and_hot_paths(self):
+        assert rule_ids("""
+            import numpy as np
+            def f(x):
+                return np.asarray(x)
+        """) == []
+
+    def test_fires_in_configured_hot_function(self):
+        assert rule_ids("""
+            class Engine:
+                def step(self):
+                    return self.logits.numpy()
+        """, path="hotmod.py", config=HOT) == ["TPL001"]
+
+    def test_silent_in_non_hot_function_of_hot_module(self):
+        assert rule_ids("""
+            class Engine:
+                def debug_dump(self):
+                    return self.logits.numpy()
+        """, path="hotmod.py", config=HOT) == []
+
+    def test_detects_jit_via_wrapping_call(self):
+        assert rule_ids("""
+            import jax
+            def step(x):
+                return x.numpy()
+            fast_step = jax.jit(step)
+        """) == ["TPL001"]
+
+
+# ---------------------------------------------------------------- TPL002
+class TestRetrace:
+    def test_fires_on_shape_branch(self):
+        assert "TPL002" in rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x.shape[0] > 4:
+                    return x
+                return -x
+        """)
+
+    def test_fires_on_traced_value_branch(self):
+        assert "TPL002" in rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+
+    def test_fires_on_shape_range_loop(self):
+        assert "TPL002" in rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                acc = 0
+                for i in range(x.shape[0]):
+                    acc = acc + x[i]
+                return acc
+        """)
+
+    def test_fires_on_fstring_over_traced(self):
+        assert "TPL002" in rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                name = f"val={x}"
+                return x
+        """)
+
+    def test_fires_on_mutable_static_arg_default(self):
+        assert "TPL002" in rule_ids("""
+            import functools
+            import jax
+            @functools.partial(jax.jit, static_argnames=("cfg",))
+            def f(x, cfg=[1, 2]):
+                return x
+        """)
+
+    def test_silent_on_none_and_isinstance_branches(self):
+        assert rule_ids("""
+            import jax
+            @jax.jit
+            def f(x, w=None):
+                if w is None:
+                    return x
+                if isinstance(x, tuple):
+                    return x[0]
+                return x + w
+        """) == []
+
+    def test_silent_on_static_range_loop(self):
+        assert rule_ids("""
+            import jax
+            @jax.jit
+            def f(x):
+                for i in range(4):
+                    x = x + i
+                return x
+        """) == []
+
+    def test_silent_outside_jit(self):
+        assert rule_ids("""
+            def f(x):
+                if x.shape[0] > 4:
+                    return x
+                return -x
+        """) == []
+
+
+# ---------------------------------------------------------------- TPL003
+class TestUntracedRandom:
+    def test_fires_on_np_random_in_jit(self):
+        assert rule_ids("""
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                return x + np.random.normal(size=3)
+        """) == ["TPL003"]
+
+    def test_fires_on_stdlib_random_in_jit(self):
+        assert rule_ids("""
+            import random
+            import jax
+            @jax.jit
+            def f(x):
+                return x * random.random()
+        """) == ["TPL003"]
+
+    def test_silent_on_jax_random(self):
+        assert rule_ids("""
+            import jax
+            @jax.jit
+            def f(x, key):
+                return x + jax.random.normal(key, x.shape)
+        """) == []
+
+    def test_silent_on_np_random_outside_jit(self):
+        assert rule_ids("""
+            import numpy as np
+            def init(shape):
+                return np.random.normal(size=shape)
+        """) == []
+
+
+# ---------------------------------------------------------------- TPL004
+class TestLockDiscipline:
+    def test_fires_on_bare_write_of_locked_attr(self):
+        out = run("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+                def racy(self):
+                    self._n = 5
+        """, path="locked_mod.py", config=LOCKED)
+        assert [f.rule for f in out] == ["TPL004"]
+        assert "racy" in out[0].message
+
+    def test_fires_on_engine_step_under_lock(self):
+        out = run("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._state = 0
+                def sync(self):
+                    with self._lock:
+                        self._state = 1
+                def bad(self):
+                    with self._lock:
+                        self.engine.step()
+        """, path="locked_mod.py", config=LOCKED)
+        assert [f.rule for f in out] == ["TPL004"]
+        assert "device step" in out[0].message
+
+    def test_silent_when_disciplined(self):
+        assert rule_ids("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+                def dec(self):
+                    with self._lock:
+                        self._n -= 1
+                def work(self):
+                    self.engine.step()
+        """, path="locked_mod.py", config=LOCKED) == []
+
+    def test_locked_suffix_convention_counts_as_held(self):
+        assert rule_ids("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._depth = 0
+                def _feed_locked(self):
+                    self._depth += 1
+                def pump(self):
+                    with self._cond:
+                        self._feed_locked()
+        """, path="locked_mod.py", config=LOCKED) == []
+
+    def test_out_of_scope_module_not_analyzed(self):
+        assert rule_ids("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+                def inc(self):
+                    with self._lock:
+                        self._n += 1
+                def racy(self):
+                    self._n = 5
+        """, path="paddle_tpu/nn/x.py", config=LOCKED) == []
+
+
+# ---------------------------------------------------------------- TPL005
+class TestEagerBlock:
+    def test_fires_in_library_code(self):
+        assert rule_ids("""
+            def run(x):
+                return x.block_until_ready()
+        """) == ["TPL005"]
+
+    def test_fires_on_module_level_jax_block(self):
+        assert rule_ids("""
+            import jax
+            def warm(a):
+                jax.block_until_ready(a)
+        """) == ["TPL005"]
+
+    def test_silent_in_bench_paths(self):
+        assert rule_ids("""
+            def run(x):
+                return x.block_until_ready()
+        """, path="bench_models.py") == []
+
+
+# ---------------------------------------------------------------- TPL006
+class TestImportHygiene:
+    def test_fires_on_mutable_default(self):
+        assert rule_ids("""
+            def f(x, acc=[]):
+                acc.append(x)
+                return acc
+        """) == ["TPL006"]
+
+    def test_fires_on_dict_call_default(self):
+        assert rule_ids("""
+            def f(x, opts=dict()):
+                return opts
+        """) == ["TPL006"]
+
+    def test_fires_on_module_level_device_alloc(self):
+        assert rule_ids("""
+            import jax.numpy as jnp
+            CACHE = jnp.zeros((8, 8))
+        """) == ["TPL006"]
+
+    def test_fires_on_class_level_device_alloc(self):
+        assert rule_ids("""
+            import jax
+            class M:
+                KEY = jax.random.key(0)
+        """) == ["TPL006"]
+
+    def test_silent_on_none_default_and_lazy_alloc(self):
+        assert rule_ids("""
+            import jax.numpy as jnp
+            def f(x, acc=None):
+                if acc is None:
+                    acc = []
+                return jnp.zeros((8,))
+        """) == []
+
+    def test_silent_on_metadata_helpers(self):
+        assert rule_ids("""
+            import jax.numpy as jnp
+            EPS = jnp.finfo(jnp.float32)
+        """) == []
+
+
+# ------------------------------------------------------------ suppressions
+class TestSuppressions:
+    SRC = """
+        import jax
+        @jax.jit
+        def f(x):
+            return x.numpy(){comment}
+    """
+
+    def test_same_line_disable(self):
+        src = self.SRC.format(
+            comment="  # tpulint: disable=TPL001 -- test harness pull")
+        findings = lint_source(textwrap.dedent(src))
+        assert len(findings) == 1
+        assert findings[0].suppressed
+        assert findings[0].suppress_reason == "test harness pull"
+
+    def test_disable_next_line(self):
+        src = """
+            import jax
+            @jax.jit
+            def f(x):
+                # tpulint: disable-next-line=TPL001 -- reviewed
+                return x.numpy()
+        """
+        assert run(src) == []
+
+    def test_disable_file(self):
+        src = """
+            # tpulint: disable-file=TPL001 -- fixture file
+            import jax
+            @jax.jit
+            def f(x):
+                return x.numpy()
+        """
+        assert run(src) == []
+
+    def test_disable_all_keyword(self):
+        src = self.SRC.format(comment="  # tpulint: disable=all")
+        assert run(src) == []
+
+    def test_wrong_rule_does_not_silence(self):
+        src = self.SRC.format(comment="  # tpulint: disable=TPL005")
+        assert rule_ids(src) == ["TPL001"]
+
+    def test_multiple_rules_one_comment(self):
+        src = """
+            import jax
+            import numpy as np
+            @jax.jit
+            def f(x):
+                if x > 0:  # tpulint: disable=TPL002 -- static flag
+                    return np.asarray(x)  # tpulint: disable=TPL001 -- reviewed
+                return x
+        """
+        assert run(src) == []
+
+
+# ------------------------------------------------------------- JSON output
+class TestJsonOutput:
+    def test_schema(self):
+        src = textwrap.dedent("""
+            import jax
+            @jax.jit
+            def f(x):
+                return x.numpy()
+        """)
+        findings = lint_source(src, path="paddle_tpu/nn/x.py")
+        doc = json.loads(render_json(findings, files_scanned=1))
+        assert set(doc) == {"version", "files_scanned", "findings",
+                            "counts", "suppressed", "clean"}
+        assert doc["version"] == 1
+        assert doc["files_scanned"] == 1
+        assert doc["clean"] is False
+        assert doc["counts"] == {"TPL001": 1}
+        (f,) = doc["findings"]
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "message", "context"}
+        assert f["rule"] == "TPL001"
+        assert f["severity"] == "error"
+        assert f["path"] == "paddle_tpu/nn/x.py"
+        assert f["line"] == 5 and isinstance(f["col"], int)
+
+    def test_clean_and_suppressed_counts(self):
+        src = textwrap.dedent("""
+            import jax
+            @jax.jit
+            def f(x):
+                return x.numpy()  # tpulint: disable=TPL001 -- ok
+        """)
+        doc = json.loads(render_json(lint_source(src), files_scanned=1))
+        assert doc["clean"] is True
+        assert doc["suppressed"] == 1
+        assert doc["findings"][0]["suppressed"] is True
+        assert doc["findings"][0]["suppress_reason"] == "ok"
+
+
+# ------------------------------------------------------------------ errors
+def test_syntax_error_is_a_finding():
+    out = lint_source("def f(:\n", path="broken.py")
+    assert out[0].rule == "TPL000"
+    assert out[0].severity.value == "error"
+
+
+def test_severity_override_via_config():
+    cfg = LintConfig.default()
+    cfg.severity = {"TPL001": "info"}
+    src = """
+        import jax
+        @jax.jit
+        def f(x):
+            return x.numpy()
+    """
+    (f,) = run(src, config=cfg)
+    assert f.severity.value == "info"
+
+
+def test_rule_subset_selection():
+    src = """
+        import jax
+        import numpy as np
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return np.asarray(x)
+            return x
+    """
+    from paddle_tpu.analysis import get_rule
+    only_002 = run(src, rules=[get_rule("TPL002")])
+    assert {f.rule for f in only_002} == {"TPL002"}

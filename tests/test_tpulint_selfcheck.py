@@ -1,0 +1,67 @@
+"""CI gate: the paddle_tpu tree must stay tpulint-clean.
+
+Runs the real CLI (tools/tpulint.py) over paddle_tpu/ exactly as a
+reviewer would, so the tier-1 pytest run doubles as the lint gate:
+any new unsuppressed host-sync / retrace / RNG / lock / import-time
+finding fails this test with the linter's own report as the message.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TPULINT = os.path.join(REPO, "tools", "tpulint.py")
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, TPULINT, *args], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+
+
+def test_tree_is_tpulint_clean():
+    proc = _run("paddle_tpu/", "--format", "json")
+    doc = json.loads(proc.stdout)
+    active = [f for f in doc["findings"] if not f.get("suppressed")]
+    report = "\n".join(
+        f"{f['path']}:{f['line']}: {f['rule']} {f['message']}"
+        for f in active)
+    assert proc.returncode == 0 and doc["clean"], (
+        "tpulint found new TPU-hostile code — fix it or add a "
+        "justified `# tpulint: disable=<RULE> -- why` suppression:\n"
+        + report)
+    # the gate must actually have looked at the tree
+    assert doc["files_scanned"] > 150
+
+
+def test_suppressions_carry_justifications():
+    """Every inline suppression in the tree must give a reason (the
+    `-- why` tail), so disables stay reviewable."""
+    proc = _run("paddle_tpu/", "--format", "json")
+    doc = json.loads(proc.stdout)
+    bare = [f for f in doc["findings"]
+            if f.get("suppressed") and not f.get("suppress_reason")]
+    assert not bare, (
+        "suppressions without a justification:\n" + "\n".join(
+            f"{f['path']}:{f['line']}: {f['rule']}" for f in bare))
+
+
+def test_cli_reports_findings_with_exit_1(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.numpy()\n")
+    proc = _run(str(bad))
+    assert proc.returncode == 1
+    assert "TPL001" in proc.stdout
+
+
+def test_cli_list_rules():
+    proc = _run("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005",
+                "TPL006"):
+        assert rid in proc.stdout

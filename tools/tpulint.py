@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""tpulint — TPU-hostility static analysis over the paddle_tpu tree.
+
+    python tools/tpulint.py paddle_tpu/ [--format json] [--list-rules]
+
+Thin launcher: the implementation lives in paddle_tpu/analysis/. The
+linter is pure stdlib ast, and this launcher loads it as a standalone
+package (bypassing paddle_tpu/__init__.py) so CI boxes without an
+accelerator stack can still run it. See docs/static_analysis.md for
+the rule catalogue.
+"""
+import importlib
+import importlib.util
+import os
+import sys
+
+
+def _load_analysis():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkgdir = os.path.join(root, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_tpulint_analysis", os.path.join(pkgdir, "__init__.py"),
+        submodule_search_locations=[pkgdir])
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules["_tpulint_analysis"] = pkg
+    spec.loader.exec_module(pkg)
+    return importlib.import_module("_tpulint_analysis.cli")
+
+
+if __name__ == "__main__":
+    sys.exit(_load_analysis().main())
