@@ -491,9 +491,11 @@ def _apply(fn, kwargs, *args, name=None, multi=False, nondiff=()):
     is_multi = multi or isinstance(out, (tuple, list))
     outs = tuple(out) if is_multi else (out,)
     if tracing and not any(_is_tracer(o) for o in outs if o is not None):
-        # host dispatch-level span (async device work not awaited)
+        # host dispatch-level span (async device work not awaited),
+        # stamped with the current request's trace id when one is bound
         tr.record(name or fn.__name__, _perf_counter() - t0,
-                  getattr(outs[0], "shape", None))
+                  getattr(outs[0], "shape", None),
+                  trace_id=_current_trace_id())
 
     if _op_observer is not None and not any(
             _is_tracer(o) for o in outs if o is not None):
@@ -535,6 +537,22 @@ def _get_trace():
         except ImportError:  # pragma: no cover - partial interpreter teardown
             return None
     return _TRACE_MOD
+
+
+_TC_MOD = None
+
+
+def _current_trace_id():
+    """Lazy observability.trace_context import (same pattern as
+    _get_trace); only reached when tracing is enabled."""
+    global _TC_MOD
+    if _TC_MOD is None:
+        try:
+            from ..observability import trace_context as _t
+            _TC_MOD = _t
+        except ImportError:  # pragma: no cover - partial teardown
+            return None
+    return _TC_MOD.current_trace_id()
 
 
 # Register Tensor as a pytree so it can cross jit/pjit boundaries directly.

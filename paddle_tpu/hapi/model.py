@@ -2,12 +2,25 @@
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
 from .._core.tensor import Tensor
 from ..io import DataLoader
 from .. import callbacks as cb_mod
+from ..observability.logging import get_logger
+
+
+def _live_device_bytes():
+    """Bytes held by live device arrays (jax.live_arrays walks every
+    undeleted buffer — called only at log_freq cadence)."""
+    try:
+        import jax
+        return int(sum(getattr(a, "nbytes", 0) or 0
+                       for a in jax.live_arrays()))
+    except Exception:
+        return None
 
 
 class Model:
@@ -95,11 +108,22 @@ class Model:
             for step, data in enumerate(loader):
                 cbs.on_train_batch_begin(step)
                 inputs, labels = _split_data(data)
+                t0 = time.perf_counter()
                 res = self.train_batch(inputs, labels,
                                        update=(it + 1) % accumulate_grad_batches == 0)
+                dt = time.perf_counter() - t0
                 logs = self._pack_logs(res)
                 cbs.on_train_batch_end(step, logs)
                 it += 1
+                if log_freq and it % log_freq == 0:
+                    # structured step record (flight recorder always;
+                    # the log stream when PADDLE_TPU_LOG is wired)
+                    get_logger("hapi").event(
+                        "train.step", epoch=epoch, step=step, iter=it,
+                        loss=logs.get("loss"), step_time_s=dt,
+                        samples_per_s=(batch_size / dt) if dt > 0
+                        else None,
+                        live_device_bytes=_live_device_bytes())
                 if num_iters is not None and it >= num_iters:
                     break
             cbs.on_epoch_end(epoch, logs)

@@ -176,7 +176,14 @@ class InferenceEngine:
         if fn is None:
             fn = self._build(key, tensor_args, static_args, self_obj)
             cache[key] = fn
+        import time as _time
+        t0 = _time.perf_counter()
         out = fn(*tensor_args)
+        # compile telemetry: the shape key IS the cache key, so a new
+        # key is a (re)trace — counted + timed in the global registry
+        from ...observability.compile_telemetry import REGISTRY
+        REGISTRY.note_call(f"incubate.inference:{self.func.__qualname__}",
+                           key, _time.perf_counter() - t0)
         return jax.tree_util.tree_map(Tensor, out)
 
 

@@ -8,6 +8,7 @@ from __future__ import annotations
 import functools
 import os
 import pickle
+import time
 
 import numpy as np
 import jax
@@ -60,6 +61,14 @@ class StaticFunction:
                     else str(type(a)))
         return tuple(sig(a) for a in args)
 
+    def _note_call(self, key, elapsed_s):
+        """Compile telemetry: the shape key IS jit's cache key, so a
+        first-seen key is a compile (counted, timed, retrace-warned)."""
+        from ..observability.compile_telemetry import REGISTRY
+        name = getattr(self._function, "__qualname__",
+                       self._function.__name__)
+        REGISTRY.note_call(f"to_static:{name}", key, elapsed_s)
+
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
             return self._function(*args, **kwargs)
@@ -82,7 +91,9 @@ class StaticFunction:
                         is_leaf=lambda t: isinstance(t, Tensor))
                 self._jitted[key] = jax.jit(pure)
             raws = tuple(unwrap(a) if isinstance(a, Tensor) else a for a in args)
+            t0 = time.perf_counter()
             out = self._jitted[key](*raws)
+            self._note_call(key, time.perf_counter() - t0)
             return jax.tree_util.tree_map(Tensor, out)
         # Layer method: functional over (params, buffers, inputs)
         key = self._key(args)
@@ -101,7 +112,9 @@ class StaticFunction:
             self._jitted[key] = jax.jit(pure)
         params, buffers = layer.functional_state()
         raws = tuple(unwrap(a) if isinstance(a, Tensor) else a for a in args)
+        t0 = time.perf_counter()
         out = self._jitted[key](params, buffers, *raws)
+        self._note_call(key, time.perf_counter() - t0)
         return jax.tree_util.tree_map(Tensor, out)
 
     def concrete_program_specify_input_spec(self, *a, **k):

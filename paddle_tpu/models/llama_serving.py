@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from .._core.compat import shard_map
 
+from ..observability import flight_recorder as _flight
+from ..observability.compile_telemetry import track_jit
 from ..profiler import record_span
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
@@ -338,6 +340,16 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
     logits = h @ params["lm_head"]
     return k_pool, v_pool, k_scale, v_scale, logits
+
+
+# compile telemetry: each entry point reports compiles/retraces (new
+# arg-shape signature == a fresh XLA compile) to the observability
+# registry — `pt_compile_*` on /metrics, compile events in the flight
+# recorder, and a retrace-storm warning when a shape churns per call
+prefill = track_jit("serving.prefill")(prefill)
+prefill_varlen = track_jit("serving.prefill_varlen")(prefill_varlen)
+decode_step = track_jit("serving.decode_step")(decode_step)
+verify_step = track_jit("serving.verify_step")(verify_step)
 
 
 def speculative_sample(prob_rows, drafts, rng):
@@ -800,6 +812,11 @@ class ServingEngine:
             return
         all_reqs = [self._waiting.pop(0) for _ in range(take)]
         all_slots = free_slots[:take]
+        _flight.record(
+            "engine.admit", rids=[str(r.rid) for r in all_reqs],
+            resumed=sum(1 for r in all_reqs
+                        if getattr(r, "_offload", None) is not None),
+            free_pages=len(self._free))
         # host-offloaded victims resume by scattering their saved pages
         # back — no prefill compute; everything else joins one varlen
         # prefill batch (or, under chunked_prefill, starts feeding its
@@ -999,6 +1016,10 @@ class ServingEngine:
         req._resume = True
         req.slot = None
         self._waiting.insert(0, req)
+        _flight.record(
+            "engine.preempt", rid=str(req.rid),
+            policy=self.preempt_policy, slot=s,
+            tokens=len(req.output), pages=len(self._seq_pages[s]))
         self._release(s)
         self.preemptions += 1
         m = self.metrics

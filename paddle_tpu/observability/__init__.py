@@ -1,0 +1,50 @@
+"""paddle_tpu.observability — runtime observability layer.
+
+The static half of "why was this step slow" is tpulint
+(`paddle_tpu.analysis`); this package is the runtime half
+(docs/observability.md has the architecture):
+
+  * `compile_telemetry` — a registry every jit entry point reports to:
+    compiles / retraces per function with arg-shape signatures, compile
+    seconds, a retrace-storm warning (runtime TPL002), and
+    `pt_compile_*` Prometheus exposition.
+  * `trace_context`    — contextvar-propagated trace ids + parent/child
+    spans, so every event recorded while serving a request carries that
+    request's identity.
+  * `logging`          — structured JSON log lines with per-event-type
+    rate limiting; every event also lands in the flight recorder.
+  * `flight_recorder`  — a bounded ring of recent structured events
+    (spans, compiles, scheduler decisions, errors) dumped to JSON on
+    SIGTERM / fault / `/debug/flightrecorder`.
+  * `chrome_trace`     — chrome://tracing export of recorded spans,
+    one named row per trace id, flow events stitching each request.
+
+Import cost: stdlib only at import time (jax is imported lazily inside
+signature hashing), so `import paddle_tpu.observability` is safe from
+anywhere — including the serving stack's innermost loops.
+"""
+from __future__ import annotations
+
+from . import (  # noqa: F401
+    chrome_trace, compile_telemetry, flight_recorder, trace_context,
+)
+from . import logging as logging  # noqa: F401,PLC0414 — stdlib-shadowing by design
+from .chrome_trace import chrome_trace_doc  # noqa: F401
+from .compile_telemetry import (  # noqa: F401
+    CompileRegistry, signature_of, track_jit, tracked,
+)
+from .flight_recorder import FlightRecorder, RECORDER  # noqa: F401
+from .logging import StructuredLogger, get_logger  # noqa: F401
+from .trace_context import (  # noqa: F401
+    Span, bind, current_trace_id, new_trace_id, span,
+)
+
+__all__ = [
+    "chrome_trace", "compile_telemetry", "flight_recorder",
+    "trace_context", "logging",
+    "CompileRegistry", "tracked", "track_jit", "signature_of",
+    "FlightRecorder", "RECORDER",
+    "StructuredLogger", "get_logger",
+    "Span", "bind", "span", "new_trace_id", "current_trace_id",
+    "chrome_trace_doc",
+]

@@ -825,9 +825,12 @@ class PipelineLayer:
             # under jit: shard_map with partial-manual axes (pp manual,
             # the mesh's other axes auto) only composes with GSPMD
             # inside a traced computation; eager would reject them
-            self._pipeline_fns[seg] = jax.jit(functools.partial(
-                pipeline_apply, layer_fn=layer_fn, mesh=self.mesh,
-                pp_axis=self.pp_axis, n_micro=self.n_micro))
+            from ..observability.compile_telemetry import track_jit
+            self._pipeline_fns[seg] = track_jit(
+                f"parallel.pipeline_apply:{seg[0]}-{seg[1]}")(
+                jax.jit(functools.partial(
+                    pipeline_apply, layer_fn=layer_fn, mesh=self.mesh,
+                    pp_axis=self.pp_axis, n_micro=self.n_micro)))
         return self._pipeline_fns[seg]
 
     def _staged_forward(self, x):

@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .._core.tensor import Tensor, unwrap
 from .._core.state import prng
+from ..observability.compile_telemetry import track_jit
 from .mesh import fsdp_spec, get_mesh
 
 
@@ -129,8 +130,11 @@ class Trainer:
             return new_params, new_state, new_buffers, loss
 
         if self.mesh is None:
-            return jax.jit(train_step,
-                           donate_argnums=(0, 1) if donate else ())
+            # compile telemetry: a stable batch shape compiles once; a
+            # churning one shows up as retraces on pt_compile_* metrics
+            return track_jit("parallel.train_step")(
+                jax.jit(train_step,
+                        donate_argnums=(0, 1) if donate else ()))
 
         pspecs = {n: NamedSharding(self.mesh, s)
                   for n, s in self.param_specs.items()}
@@ -145,11 +149,11 @@ class Trainer:
                 lambda s: NamedSharding(self.mesh, s), self.batch_spec,
                 is_leaf=lambda x: isinstance(x, P))
 
-        return jax.jit(
+        return track_jit("parallel.train_step")(jax.jit(
             train_step,
             in_shardings=(pspecs, sspecs, None, None, None, bspec),
             out_shardings=(pspecs, sspecs, None, repl),
-            donate_argnums=(0, 1) if donate else ())
+            donate_argnums=(0, 1) if donate else ()))
 
     # ------------------------------------------------------------------
     def step(self, batch):

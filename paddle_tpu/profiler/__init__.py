@@ -3,6 +3,18 @@
 Wraps jax.profiler: traces are Perfetto/XPlane (TensorBoard-compatible),
 replacing the reference's CUPTI/nvprof collection. summary() reports
 host-side op timings from our dispatch-layer TraceEvent ring.
+
+Scheduled capture: `Profiler(scheduler=make_scheduler(...))` drives
+CLOSED → READY → RECORD windows from `step()` — warmup (READY) events
+are excluded from the exported session, each RECORD window ends by
+firing `on_trace_ready` (and, with an `export_chrome_tracing` handler,
+writing this session's chrome-tracing JSON), and `repeat` cycles each
+produce their own export.
+
+Spans (`record_span` / `RecordEvent`) carry the observability layer's
+trace context: the current request's trace id plus parent/child span
+ids, and every finished span also lands in the crash flight recorder
+(`paddle_tpu.observability.flight_recorder`).
 """
 from __future__ import annotations
 
@@ -57,12 +69,19 @@ def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler: export each finished RECORD window's
+    host-side trace as chrome-tracing JSON under `dir_name` (one file
+    per window: <worker>.pt_trace.<n>.json)."""
     def handler(prof):
         prof._export_dir = dir_name
+        prof._export_worker = worker_name
+        prof._export_session()
     return handler
 
 
 export_protobuf = export_chrome_tracing
+
+_RECORDING = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
 
 
 class Profiler:
@@ -70,42 +89,79 @@ class Profiler:
                  record_shapes=False, profile_memory=False, timer_only=False,
                  emit_nvtx=False, custom_device_types=None, with_flops=False):
         self._dir = os.environ.get("PADDLE_TPU_PROFILE_DIR", "/tmp/pt_profile")
+        self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
-        self._active = False
+        self._active = False        # a jax.profiler device trace is live
+        self._recording = False     # a host RECORD window is open
+        self._state = ProfilerState.CLOSED
         self._step = 0
         self._step_times = []
         self._last = None
+        self._export_dir = None
+        self._export_worker = None
+        self._export_seq = 0
 
-    def start(self):
-        # host event ring: sessions enable tracing for their duration
-        # (restoring the prior state on stop) and export only events
-        # recorded after this timestamp — earlier sessions' spans must
-        # not leak into this session's trace
-        from ..utils import trace as _trace
-        self._prev_trace_enabled = _trace.enabled()
-        _trace.enable()
+    # -- capture windows ----------------------------------------------
+    def _open_window(self):
+        # host event ring: windows export only events recorded after
+        # this timestamp — earlier sessions' spans must not leak in
         self._t_session = time.time()
+        self._recording = True
         if not self._timer_only:
             try:
                 jax.profiler.start_trace(self._dir)
                 self._active = True
             except Exception:
                 self._active = False
-        self._last = time.perf_counter()
 
-    def stop(self):
+    def _close_window(self, ready=True):
         if self._active:
             try:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
             self._active = False
+        self._recording = False
+        if ready and self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def _export_session(self):
+        """Write the current window's chrome trace into the handler's
+        dir (wired by export_chrome_tracing); returns the path."""
+        if not self._export_dir:
+            return None
+        os.makedirs(self._export_dir, exist_ok=True)
+        worker = self._export_worker or f"host_{os.getpid()}"
+        self._export_seq += 1
+        path = os.path.join(self._export_dir,
+                            f"{worker}.pt_trace.{self._export_seq}.json")
+        self.export(path)
+        return path
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        from ..utils import trace as _trace
+        self._prev_trace_enabled = _trace.enabled()
+        _trace.enable()
+        self._t_session = time.time()
+        if self._scheduler is not None:
+            self._state = self._scheduler(0)
+        else:
+            self._state = ProfilerState.RECORD
+        if self._state in _RECORDING:
+            self._open_window()
+        self._last = time.perf_counter()
+
+    def stop(self):
+        if self._recording:
+            self._close_window(ready=True)
+        elif self._scheduler is None and self._on_trace_ready:
+            self._on_trace_ready(self)   # legacy: handler always fires
+        self._state = ProfilerState.CLOSED
         if not getattr(self, "_prev_trace_enabled", True):
             from ..utils import trace as _trace
             _trace.disable()
-        if self._on_trace_ready:
-            self._on_trace_ready(self)
 
     def step(self, num_samples=None):
         now = time.perf_counter()
@@ -113,6 +169,23 @@ class Profiler:
             self._step_times.append(now - self._last)
         self._last = now
         self._step += 1
+        if self._scheduler is None:
+            return
+        old = self._state
+        new = self._scheduler(self._step)
+        self._state = new
+        if self._recording and (old is ProfilerState.RECORD_AND_RETURN
+                                or new not in _RECORDING):
+            # the window just finished (AND_RETURN marks the last
+            # recorded step of a cycle): hand the trace over now, so a
+            # `repeat` schedule exports one file per cycle
+            self._close_window(ready=True)
+        if new in _RECORDING and not self._recording:
+            self._open_window()
+
+    @property
+    def current_state(self):
+        return self._state
 
     def step_info(self, unit=None):
         if not self._step_times:
@@ -138,20 +211,24 @@ class Profiler:
                 "tracing 'json' is implemented (XLA device traces are "
                 "XPlane dumps under the profiler dir)")
         import json as _json
+
+        from ..observability.chrome_trace import chrome_trace_doc
         from ..utils import trace as _trace
         t0 = getattr(self, "_t_session", 0.0)
-        evts = []
-        for name, dur, shape, ts_end in _trace.events():
-            if ts_end < t0:
+        spans = []
+        for ev in _trace.events():
+            if ev.ts_end < t0:
                 continue  # a previous session's span
-            e = {"name": name, "ph": "X", "pid": 0, "tid": 0,
-                 "ts": (ts_end - dur) * 1e6, "dur": dur * 1e6}
-            if shape is not None:
-                e["args"] = {"shape": str(shape)}
-            evts.append(e)
+            args = dict(ev.args or {})
+            if ev.shape is not None:
+                args["shape"] = str(ev.shape)
+            spans.append({"name": ev.name, "t_start": ev.ts_end - ev.dur,
+                          "dur_s": ev.dur, "trace_id": ev.trace_id,
+                          "span_id": ev.span_id,
+                          "parent_id": ev.parent_id,
+                          "args": args or None})
         with open(path, "w") as f:
-            _json.dump({"traceEvents": evts,
-                        "displayTimeUnit": "ms"}, f)
+            _json.dump(chrome_trace_doc(spans), f)
 
     def __enter__(self):
         self.start()
@@ -161,28 +238,32 @@ class Profiler:
         self.stop()
 
 
-def record_span(name):
+def record_span(name, args=None):
     """A RecordEvent as a with-block: annotates the device trace (when
-    one is being captured) and feeds the host event ring (when tracing
-    is enabled). The serving engine wraps its prefill/decode/verify
-    device calls in these, so a Profiler session over a serving
-    workload attributes wall-clock to engine phases. Near-free when no
-    profiler is active.
+    one is being captured), feeds the host event ring (when tracing
+    is enabled), and drops a span — stamped with the current trace
+    context — into the crash flight recorder. The serving engine wraps
+    its prefill/decode/verify device calls in these, so a Profiler
+    session over a serving workload attributes wall-clock to engine
+    phases. Near-free when no profiler is active.
 
         with profiler.record_span("serving.decode_step"):
             ...
     """
-    return RecordEvent(name)
+    return RecordEvent(name, args=args)
 
 
 class RecordEvent:
-    def __init__(self, name, event_type=None):
+    def __init__(self, name, event_type=None, args=None):
         self.name = name
+        self.args = args
         self._ctx = None
-        self._t0 = None
+        self._span = None
 
     def begin(self):
-        self._t0 = time.perf_counter()
+        from ..observability import trace_context as _tc
+        self._span = _tc.span(self.name, args=self.args)
+        self._span.__enter__()
         try:
             self._ctx = jax.profiler.TraceAnnotation(self.name)
             self._ctx.__enter__()
@@ -193,13 +274,12 @@ class RecordEvent:
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
-        if self._t0 is not None:
-            # feed the host ring (gated: Profiler.start enables tracing
+        if self._span is not None:
+            # feeds the host ring (gated: Profiler.start enables tracing
             # for its session; PADDLE_TPU_TRACE=1 enables it globally)
-            from ..utils import trace as _trace
-            if _trace.enabled():
-                _trace.record(self.name, time.perf_counter() - self._t0)
-            self._t0 = None
+            # and the flight recorder (always; bounded ring)
+            self._span.__exit__(None, None, None)
+            self._span = None
 
     def __enter__(self):
         self.begin()

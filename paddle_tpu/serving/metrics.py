@@ -242,6 +242,9 @@ class EngineMetrics:
             "pt_serving_tpot_seconds", "Per-output-token latency.")
         self.e2e = r.histogram(
             "pt_serving_e2e_seconds", "Submit-to-completion latency.")
+        self.step_seconds = r.histogram(
+            "pt_serving_step_seconds",
+            "Wall time of one engine step (prefill+decode/verify).")
         self.queue_depth = r.gauge(
             "pt_serving_queue_depth", "Requests waiting for a slot.")
         self.queue_depth_peak = r.gauge(
@@ -324,6 +327,9 @@ class EngineMetrics:
         self.cancelled.inc()
 
     # -- scheduler-facing hooks --
+    def observe_step(self, dt):
+        self.step_seconds.observe(dt)
+
     def on_reject(self):
         self.rejected.inc()
 

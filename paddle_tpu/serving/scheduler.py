@@ -29,6 +29,9 @@ import threading
 import time
 from collections import deque
 
+from ..observability import flight_recorder as _flight
+from ..observability import trace_context as _tc
+from ..observability.logging import get_logger
 from .metrics import EngineMetrics, MetricsRegistry
 
 __all__ = ["RequestScheduler", "ServingRequest", "SchedulerError",
@@ -64,15 +67,19 @@ class ServingRequest:
     block for the full result. Terminal states: "done", "cancelled",
     "expired", "failed"."""
 
-    def __init__(self, sched, req, priority, deadline):
+    def __init__(self, sched, req, priority, deadline, trace_id=None):
         self._sched = sched
         self.req = req                  # engine-level Request
         self.rid = req.rid
+        # request-scoped trace identity: everything this request causes
+        # (spans, flight events, log lines) carries this id
+        self.trace_id = trace_id or _tc.current_trace_id() or str(req.rid)
         self.priority = priority
         self.deadline = deadline        # absolute time.monotonic() or None
         self.state = "queued"
         self.error = None
         self.t_submit = time.monotonic()
+        self.t_admitted = None          # pump fed the engine
         self.t_first_token = None
         self.t_done = None
         self.chunks = queue.Queue()     # lists of token ids; None = EOS
@@ -128,6 +135,7 @@ class RequestScheduler:
         # the engine reports TTFT/TPOT/occupancy itself through the
         # same hook object; the scheduler owns queue depth + rejections
         engine.metrics = self.metrics
+        self._log = get_logger("serving")
         self._idle_poll_s = idle_poll_s
         self._cond = threading.Condition()
         self._queues = {p: deque() for p in PRIORITIES}
@@ -148,7 +156,7 @@ class RequestScheduler:
     def submit(self, prompt_ids, *, rid=None, max_new_tokens=64,
                eos_id=None, temperature=0.0, top_k=0, top_p=1.0,
                seed=None, logprobs=False, priority="normal",
-               ttl_s=None):
+               ttl_s=None, trace_id=None):
         """Admit-or-refuse NOW: raises BackpressureError on a full
         queue, SchedulerClosedError during shutdown, ValueError for a
         request the engine could never run. Returns a ServingRequest."""
@@ -173,10 +181,18 @@ class RequestScheduler:
             depth = self._queued_locked()
             if depth >= self.max_queue:
                 self.metrics.on_reject()
+                _flight.record("sched.reject", rid=str(req.rid),
+                               trace_id=trace_id, priority=priority,
+                               depth=depth, max_queue=self.max_queue)
                 raise BackpressureError(
                     f"serving: queue full ({depth}/{self.max_queue}); "
                     "retry later")
-            sr = ServingRequest(self, req, priority, deadline)
+            sr = ServingRequest(self, req, priority, deadline,
+                                trace_id=trace_id)
+            _flight.record("sched.submit", rid=str(sr.rid),
+                           trace_id=sr.trace_id, priority=priority,
+                           ttl_s=ttl_s, prompt_tokens=len(req.prompt),
+                           depth=depth)
             # TTFT clock starts at scheduler admission, so queueing
             # latency is part of the number (the engine stamps only if
             # unset)
@@ -266,9 +282,14 @@ class RequestScheduler:
             for sr in q:
                 if sr._cancel_requested:
                     self.metrics.on_cancel("queued")
+                    _flight.record("sched.cancel", rid=str(sr.rid),
+                                   trace_id=sr.trace_id, where="queued")
                     self._finalize(sr, "cancelled")
                 elif sr.deadline is not None and now > sr.deadline:
                     self.metrics.on_expire()
+                    _flight.record("sched.expire", rid=str(sr.rid),
+                                   trace_id=sr.trace_id, where="queued",
+                                   queued_s=now - sr.t_submit)
                     self._finalize(sr, "expired")
                 else:
                     keep.append(sr)
@@ -278,6 +299,9 @@ class RequestScheduler:
             if expired and not sr._expired:
                 sr._expired = True
                 self.metrics.on_expire()
+                _flight.record("sched.expire", rid=str(sr.rid),
+                               trace_id=sr.trace_id, where="running",
+                               tokens=len(sr.req.output))
             if (expired or sr._cancel_requested) and \
                     not sr._cancel_applied:
                 sr._cancel_applied = True
@@ -296,6 +320,10 @@ class RequestScheduler:
                 break
             eng.submit(sr.req)
             sr.state = "running"
+            sr.t_admitted = time.monotonic()
+            _flight.record("sched.admit", rid=str(sr.rid),
+                           trace_id=sr.trace_id, priority=sr.priority,
+                           queued_s=sr.t_admitted - sr.t_submit)
             self._inflight[id(sr.req)] = sr
             room -= 1
 
@@ -341,7 +369,45 @@ class RequestScheduler:
             sr.chunks.put(list(sr.req.output[sr._emitted:n]))
             sr._emitted = n
         sr.chunks.put(None)
+        self._emit_request_spans(sr, state)
         sr._done.set()
+
+    def _emit_request_spans(self, sr, state):
+        """Reconstruct the request's phase timeline — queued → prefill
+        (admission to first token) → decode — as spans sharing its
+        trace id, so a chrome export shows the whole life of the
+        request on one row. Assembled here, at the terminal state,
+        because the phase boundaries were stamped on three different
+        threads; monotonic deltas are re-anchored to wall clock."""
+        now_w, now_m = time.time(), time.monotonic()
+
+        def wall(tm):
+            return now_w - (now_m - tm)
+        t_end = sr.t_done if sr.t_done is not None else now_m
+        attrs = {"rid": str(sr.rid), "state": state,
+                 "priority": sr.priority,
+                 "tokens": len(sr.req.output)}
+        q_end = sr.t_admitted if sr.t_admitted is not None else t_end
+        _tc.record_span_event(
+            "request.queued", q_end - sr.t_submit,
+            trace_id=sr.trace_id, t_end=wall(q_end), args=attrs)
+        if sr.t_admitted is not None:
+            p_end = sr.t_first_token \
+                if sr.t_first_token is not None else t_end
+            _tc.record_span_event(
+                "request.prefill", p_end - sr.t_admitted,
+                trace_id=sr.trace_id, t_end=wall(p_end), args=attrs)
+        if sr.t_first_token is not None:
+            _tc.record_span_event(
+                "request.decode", t_end - sr.t_first_token,
+                trace_id=sr.trace_id, t_end=wall(t_end), args=attrs)
+        _flight.record(
+            "request.done", rid=str(sr.rid), trace_id=sr.trace_id,
+            state=state, tokens=len(sr.req.output),
+            queued_s=q_end - sr.t_submit,
+            ttft_s=None if sr.t_first_token is None
+            else sr.t_first_token - sr.t_submit,
+            e2e_s=t_end - sr.t_submit)
 
     def _engine_has_work(self):
         return (any(r is not None for r in self._engine._slots)
@@ -360,17 +426,27 @@ class RequestScheduler:
                     # the timeout bounds queued-deadline expiry latency
                     self._cond.wait(timeout=self._idle_poll_s)
                     continue
+            t0 = time.perf_counter()
             try:
-                self._engine.step()
+                n_active = self._engine.step()
             except Exception as e:  # noqa: BLE001 — fail requests
                 self._fail_all(e)
                 continue
+            dt = time.perf_counter() - t0
+            self.metrics.observe_step(dt)
+            # rate-limited structured step record (always lands in the
+            # flight recorder; hits the log stream when one is wired)
+            self._log.event(
+                "serving.step", step_s=dt, active=n_active,
+                queue_depth=self.metrics.queue_depth.value,
+                device_steps=self._engine.device_steps)
             self._publish()
         self._publish()
 
     def _fail_all(self, exc):
         """An engine step blew up: fail every in-flight request rather
         than hanging their streams, and release the engine's state."""
+        self._log.event("engine.error", level="error", error=repr(exc))
         with self._cond:
             eng = self._engine
             for s in range(eng.max_seqs):

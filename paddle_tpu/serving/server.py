@@ -6,10 +6,17 @@ stays single-threaded behind the scheduler's pump):
 
   * `POST /v1/completions` — JSON body; `"stream": true` streams
     Server-Sent-Events over chunked transfer, one event per emitted
-    token chunk;
+    token chunk; an `X-Request-Id` header (or generated id) becomes
+    the request's trace id, echoed back and stamped on every span;
   * `GET /healthz` — liveness + queue/occupancy snapshot;
-  * `GET /metrics` — Prometheus text exposition
-    (`?format=json` returns the registry's JSON snapshot).
+  * `GET /metrics` — Prometheus text exposition, serving registry +
+    compile telemetry (`?format=json` returns the JSON snapshot);
+  * `GET /debug/flightrecorder` — JSON dump of the crash flight
+    recorder ring (`?dump=1` also writes it to disk);
+  * `GET /debug/trace` — chrome://tracing JSON of recent spans, one
+    named row per request id;
+  * `GET /debug/stacks` — every live thread's Python stack (who is
+    holding the pump / a lock right now).
 
 Backpressure maps to HTTP: a full queue is 429 with Retry-After,
 shutdown is 503, a request the engine can never run is 400, a
@@ -24,6 +31,10 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..observability import chrome_trace as _chrome
+from ..observability import compile_telemetry as _compile
+from ..observability import flight_recorder as _flight
+from ..observability import trace_context as _tc
 from .scheduler import (BackpressureError, RequestScheduler,
                         SchedulerClosedError)
 
@@ -68,15 +79,32 @@ class CompletionHandler(BaseHTTPRequestHandler):
             self._json(200, st)
         elif path == "/metrics":
             if "format=json" in query:
-                self._json(200, self.sched.registry.snapshot())
+                snap = self.sched.registry.snapshot()
+                snap["pt_compile"] = _compile.snapshot()
+                self._json(200, snap)
             else:
-                body = self.sched.registry.render_prometheus().encode()
+                body = (self.sched.registry.render_prometheus()
+                        + _compile.render_prometheus()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+        elif path == "/debug/flightrecorder":
+            snap = _flight.snapshot()
+            if "dump=1" in query:
+                snap["path"] = _flight.dump(reason="/debug/flightrecorder")
+            self._json(200, snap)
+        elif path == "/debug/trace":
+            self._json(200, _chrome.from_flight_recorder())
+        elif path == "/debug/stacks":
+            body = _flight.thread_stacks().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._json(404, {"error": f"no route {path!r}"})
 
@@ -97,18 +125,24 @@ class CompletionHandler(BaseHTTPRequestHandler):
             self._json(400, {"error": f"bad request: {e}"})
             return
         stream = bool(body.get("stream", False))
+        # request-scoped trace id: honor the client's X-Request-Id so
+        # its spans correlate with the caller's own tracing; otherwise
+        # mint one. Echoed back on every response.
+        trace_id = self.headers.get("X-Request-Id") or _tc.new_trace_id("req")
         try:
-            sr = self.sched.submit(
-                prompt,
-                max_new_tokens=int(body.get("max_tokens", 16)),
-                eos_id=body.get("eos_id"),
-                temperature=float(body.get("temperature", 0.0)),
-                top_k=int(body.get("top_k", 0)),
-                top_p=float(body.get("top_p", 1.0)),
-                seed=body.get("seed"),
-                logprobs=bool(body.get("logprobs", False)),
-                priority=body.get("priority", "normal"),
-                ttl_s=body.get("ttl_s"))
+            with _tc.bind(trace_id):
+                sr = self.sched.submit(
+                    prompt,
+                    max_new_tokens=int(body.get("max_tokens", 16)),
+                    eos_id=body.get("eos_id"),
+                    temperature=float(body.get("temperature", 0.0)),
+                    top_k=int(body.get("top_k", 0)),
+                    top_p=float(body.get("top_p", 1.0)),
+                    seed=body.get("seed"),
+                    logprobs=bool(body.get("logprobs", False)),
+                    priority=body.get("priority", "normal"),
+                    ttl_s=body.get("ttl_s"),
+                    trace_id=trace_id)
         except BackpressureError as e:
             self._json(429, {"error": str(e)},
                        headers=(("Retry-After",
@@ -127,31 +161,34 @@ class CompletionHandler(BaseHTTPRequestHandler):
 
     def _final(self, sr):
         out = {"id": sr.rid, "state": sr.state,
-               "tokens": sr.output, "n": len(sr.req.output)}
+               "tokens": sr.output, "n": len(sr.req.output),
+               "trace_id": sr.trace_id}
         if sr.req.logprobs is not None:
             out["logprobs"] = sr.req.logprobs
         return out
 
     def _blocking(self, sr):
+        hdrs = (("X-Request-Id", sr.trace_id),)
         try:
             sr.result()
         except Exception:  # terminal state carries the story
             pass
         if sr.state == "expired" and not sr.req.output:
             self._json(504, {"error": str(sr.error), "id": sr.rid,
-                             "state": "expired"})
+                             "state": "expired"}, headers=hdrs)
             return
         if sr.state == "failed":
             self._json(500, {"error": str(sr.error), "id": sr.rid,
-                             "state": "failed"})
+                             "state": "failed"}, headers=hdrs)
             return
-        self._json(200, self._final(sr))
+        self._json(200, self._final(sr), headers=hdrs)
 
     def _stream(self, sr):
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("X-Request-Id", sr.trace_id)
         self.end_headers()
         try:
             try:
@@ -201,6 +238,10 @@ class ServingServer:
         return f"http://{self.host}:{self.port}"
 
     def start(self):
+        # crash evidence: SIGTERM dumps the flight-recorder ring,
+        # faulthandler prints all stacks on a hard fault (idempotent;
+        # signal part is skipped off the main thread)
+        _flight.install()
         self._thread = threading.Thread(
             target=self.httpd.serve_forever,
             name="pt-serving-http", daemon=True)

@@ -3,15 +3,32 @@
 Lightweight host-side event ring the dispatch layer can feed; replaces
 the reference's host tracer (paddle/fluid/platform/profiler). Enable
 with PADDLE_TPU_TRACE=1 or trace.enable().
+
+Events carry optional span identity (trace_id/span_id/parent_id, fed
+by paddle_tpu.observability.trace_context) so a chrome export groups a
+request's spans on one row; plain dispatch-layer op records leave them
+None and cost exactly what they used to.
 """
 from __future__ import annotations
 
 import collections
 import os
 import time
+from typing import NamedTuple
 
 _RING = collections.deque(maxlen=100_000)
 _ENABLED = os.environ.get("PADDLE_TPU_TRACE", "0") == "1"
+
+
+class TraceEvent(NamedTuple):
+    name: str
+    dur: float                  # seconds
+    shape: object               # op result shape, or None
+    ts_end: float               # time.time() at completion
+    trace_id: object = None
+    span_id: object = None
+    parent_id: object = None
+    args: object = None
 
 
 def enable():
@@ -28,8 +45,11 @@ def enabled():
     return _ENABLED
 
 
-def record(name, dur_s, shape=None):
-    _RING.append((name, dur_s, shape, time.time()))
+def record(name, dur_s, shape=None, *, trace_id=None, span_id=None,
+           parent_id=None, args=None, ts_end=None):
+    _RING.append(TraceEvent(name, dur_s, shape,
+                            time.time() if ts_end is None else ts_end,
+                            trace_id, span_id, parent_id, args))
 
 
 def clear():
@@ -42,9 +62,9 @@ def events():
 
 def summary(top=30):
     agg = {}
-    for name, dur, _, _ in _RING:
-        tot, cnt = agg.get(name, (0.0, 0))
-        agg[name] = (tot + dur, cnt + 1)
+    for ev in _RING:
+        tot, cnt = agg.get(ev.name, (0.0, 0))
+        agg[ev.name] = (tot + ev.dur, cnt + 1)
     rows = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top]
     lines = [f"{'op':<32}{'calls':>8}{'total_ms':>12}{'avg_us':>12}"]
     for name, (tot, cnt) in rows:

@@ -1,0 +1,159 @@
+"""Profiler sessions: host trace-ring isolation, the PADDLE_TPU_TRACE
+global enable, chrome-export schema, the export_chrome_tracing handler
+(it must actually write the trace), and scheduler-driven capture
+windows (make_scheduler → CLOSED/READY/RECORD with skip_first/repeat).
+"""
+import importlib.util
+import json
+import os
+
+import paddle_tpu as pt
+from paddle_tpu import profiler
+from paddle_tpu.utils import trace
+
+
+class TestTraceRingSessions:
+    def test_second_session_does_not_export_first_sessions_spans(
+            self, tmp_path):
+        """Session isolation: the ring is shared, but each Profiler
+        session exports only events recorded after its own start
+        (the _t_session filter)."""
+        with profiler.Profiler(timer_only=True) as p1:
+            with profiler.record_span("first-session-only"):
+                pass
+        path1 = str(tmp_path / "t1.json")
+        p1.export(path1)
+        assert "first-session-only" in open(path1).read()
+
+        with profiler.Profiler(timer_only=True) as p2:
+            with profiler.record_span("second-session-only"):
+                pass
+        path2 = str(tmp_path / "t2.json")
+        p2.export(path2)
+        raw2 = open(path2).read()
+        assert "second-session-only" in raw2
+        assert "first-session-only" not in raw2
+
+    def test_global_env_enable(self, monkeypatch):
+        """PADDLE_TPU_TRACE=1 enables the ring at import time — no
+        Profiler session needed. Loaded as a fresh module instance so
+        the env var is actually read."""
+        monkeypatch.setenv("PADDLE_TPU_TRACE", "1")
+        src = os.path.join(os.path.dirname(trace.__file__), "trace.py")
+        spec = importlib.util.spec_from_file_location("_trace_fresh", src)
+        fresh = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fresh)
+        assert fresh.enabled()
+        fresh.record("global-span", 0.001)
+        assert "global-span" in fresh.summary()
+        monkeypatch.setenv("PADDLE_TPU_TRACE", "0")
+        fresh2 = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fresh2)
+        assert not fresh2.enabled()
+
+    def test_chrome_export_schema(self, tmp_path):
+        """The export is valid Trace Event Format: every event carries
+        name/ph/pid/tid/ts, complete events carry dur, and span
+        identity rides in args."""
+        from paddle_tpu.observability import trace_context as tc
+        with profiler.Profiler(timer_only=True) as p:
+            with tc.bind("schema-req"):
+                with profiler.record_span("schema-span"):
+                    _ = (pt.ones([8, 8]) @ pt.ones([8, 8])).numpy()
+        path = str(tmp_path / "schema.json")
+        p.export(path)
+        doc = json.loads(open(path).read())
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        assert evs, "empty export"
+        for e in evs:
+            assert {"name", "ph", "pid", "tid"} <= set(e)
+            if e["ph"] == "X":
+                assert "ts" in e and "dur" in e
+        tagged = [e for e in evs if e["ph"] == "X"
+                  and e.get("args", {}).get("trace_id") == "schema-req"]
+        assert any(e["name"] == "schema-span" for e in tagged)
+        # the tagged row is named after the trace id
+        row = {e["tid"] for e in tagged}
+        names = {e["tid"]: e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert all("schema-req" in names[t] for t in row)
+
+
+class TestExportChromeTracingHandler:
+    def test_handler_exports_this_sessions_trace(self, tmp_path):
+        """export_chrome_tracing was a silent no-op (it only set
+        _export_dir); the handler must now write the session's chrome
+        trace into dir_name."""
+        out = str(tmp_path / "traces")
+        prof = profiler.Profiler(
+            timer_only=True,
+            on_trace_ready=profiler.export_chrome_tracing(
+                out, worker_name="w0"))
+        with prof:
+            with profiler.record_span("handler-span"):
+                pass
+            prof.step()
+        files = os.listdir(out)
+        assert files == ["w0.pt_trace.1.json"], files
+        raw = open(os.path.join(out, files[0])).read()
+        assert "handler-span" in raw
+        json.loads(raw)
+
+
+class TestScheduledCapture:
+    def test_full_cycle_with_skip_first_and_repeat(self, tmp_path):
+        """scheduler=make_scheduler(...) drives capture windows from
+        step(): warmup (READY) spans are excluded, each cycle fires
+        on_trace_ready once and exports its own file, and after
+        `repeat` cycles the profiler stays CLOSED."""
+        out = str(tmp_path / "sched")
+        fired = []
+        export = profiler.export_chrome_tracing(out, worker_name="w")
+
+        def handler(prof):
+            fired.append(prof._step)
+            export(prof)
+
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2,
+                                        repeat=2, skip_first=1)
+        prof = profiler.Profiler(timer_only=True, scheduler=sched)
+        prof._on_trace_ready = handler
+        # states per step i: 0 CLOSED, 1 CLOSED, 2 READY, 3 RECORD,
+        # 4 RECORD_AND_RETURN, 5 CLOSED, 6 READY, 7 RECORD,
+        # 8 RECORD_AND_RETURN, 9+ CLOSED (repeat exhausted)
+        with prof:
+            for i in range(10):
+                with profiler.record_span(f"sched-span-{i}"):
+                    pass
+                prof.step()
+        assert len(fired) == 2, fired
+        assert prof.current_state is profiler.ProfilerState.CLOSED
+        files = sorted(os.listdir(out))
+        assert files == ["w.pt_trace.1.json", "w.pt_trace.2.json"]
+        first = open(os.path.join(out, files[0])).read()
+        second = open(os.path.join(out, files[1])).read()
+        # window 1 captured exactly steps 3-4; window 2 steps 7-8
+        for i in (3, 4):
+            assert f"sched-span-{i}" in first
+        for i in (0, 1, 2, 5, 6, 7, 8, 9):
+            assert f"sched-span-{i}" not in first, i
+        for i in (7, 8):
+            assert f"sched-span-{i}" in second
+        for i in (0, 1, 2, 3, 4, 5, 6, 9):
+            assert f"sched-span-{i}" not in second, i
+
+    def test_closed_schedule_records_nothing(self, tmp_path):
+        """A scheduler that never reaches RECORD must never fire the
+        handler nor capture spans."""
+        fired = []
+        prof = profiler.Profiler(
+            timer_only=True,
+            scheduler=lambda step: profiler.ProfilerState.CLOSED,
+            on_trace_ready=lambda p: fired.append(1))
+        with prof:
+            for _ in range(3):
+                with profiler.record_span("never-captured"):
+                    pass
+                prof.step()
+        assert not fired

@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""ptdump — pretty-print paddle_tpu observability dumps.
+
+Accepts either artifact the runtime produces and figures out which it
+got:
+
+  * a flight-recorder dump (`/debug/flightrecorder`, SIGTERM, or
+    `flight_recorder.dump()`): prints the header, per-kind event
+    counts, compile telemetry rollup, and the tail of the ring;
+  * a chrome-tracing export (`/debug/trace`, `Profiler.export`, or an
+    `export_chrome_tracing` handler file): prints per-span aggregates
+    and per-trace (request) timelines.
+
+Pure stdlib — runs anywhere, no jax needed.
+
+  python tools/ptdump.py /tmp/pt_flightrecorder-1234.json
+  python tools/ptdump.py trace.json --tail 50 --kind compile
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt_ts(ts):
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(ts)) \
+            + f".{int((ts % 1) * 1000):03d}"
+    except Exception:
+        return str(ts)
+
+
+def _fmt_fields(ev, skip=("kind", "ts", "seq")):
+    parts = []
+    for k, v in ev.items():
+        if k in skip:
+            continue
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder dumps
+# ---------------------------------------------------------------------------
+def print_flight(doc, tail=30, kind=None, out=sys.stdout):
+    w = out.write
+    w(f"flight recorder dump — pid {doc.get('pid')} "
+      f"at {_fmt_ts(doc.get('dumped_at', 0))} "
+      f"(reason: {doc.get('reason', '?')})\n")
+    w(f"  ring: {len(doc.get('events', []))} events held, "
+      f"{doc.get('dropped', 0)} rotated out, "
+      f"capacity {doc.get('capacity')}\n")
+    comp = doc.get("compile") or {}
+    if comp:
+        w(f"  compile: {comp.get('compiles', 0)} compiles, "
+          f"{comp.get('retraces', 0)} retraces, "
+          f"{comp.get('compile_seconds', 0):.3f}s across "
+          f"{comp.get('functions', 0)} functions\n")
+    events = doc.get("events", [])
+    by_kind = {}
+    for e in events:
+        by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
+    w("  by kind: " + ", ".join(f"{k}={n}" for k, n in
+                                sorted(by_kind.items())) + "\n")
+    if kind:
+        events = [e for e in events if e.get("kind") == kind]
+        w(f"  filtered kind={kind}: {len(events)} events\n")
+    w(f"--- last {min(tail, len(events))} events ---\n")
+    for e in events[-tail:]:
+        w(f"{_fmt_ts(e.get('ts', 0))} [{e.get('kind', '?'):>8}] "
+          f"{_fmt_fields(e)}\n")
+
+
+# ---------------------------------------------------------------------------
+# chrome traces
+# ---------------------------------------------------------------------------
+def print_chrome(doc, tail=30, out=sys.stdout):
+    w = out.write
+    evs = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    w(f"chrome trace — {len(evs)} complete events\n")
+    if not evs:
+        return
+    t0 = min(e["ts"] for e in evs)
+    t1 = max(e["ts"] + e.get("dur", 0) for e in evs)
+    w(f"  wall span: {(t1 - t0) / 1e3:.3f} ms\n")
+    agg = {}
+    for e in evs:
+        tot, cnt = agg.get(e["name"], (0.0, 0))
+        agg[e["name"]] = (tot + e.get("dur", 0), cnt + 1)
+    w(f"--- by span name ---\n")
+    w(f"{'span':<36}{'calls':>8}{'total_ms':>12}{'avg_us':>12}\n")
+    for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+        w(f"{name:<36}{cnt:>8}{tot / 1e3:>12.3f}{tot / cnt:>12.1f}\n")
+    traces = {}
+    for e in evs:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid is not None:
+            traces.setdefault(tid, []).append(e)
+    if traces:
+        w(f"--- by trace id ({len(traces)} traces) ---\n")
+        for tid, tevs in sorted(traces.items()):
+            tevs.sort(key=lambda e: e["ts"])
+            start = tevs[0]["ts"]
+            end = max(e["ts"] + e.get("dur", 0) for e in tevs)
+            w(f"{tid}: {len(tevs)} spans, {(end - start) / 1e3:.3f} ms\n")
+            for e in tevs[:tail]:
+                w(f"    +{(e['ts'] - start) / 1e3:>10.3f} ms "
+                  f"{e['name']:<28} {e.get('dur', 0) / 1e3:.3f} ms\n")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="ptdump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="flight-recorder dump or chrome trace")
+    ap.add_argument("--tail", type=int, default=30,
+                    help="events/spans to show (default 30)")
+    ap.add_argument("--kind", default=None,
+                    help="flight dumps: only this event kind")
+    args = ap.parse_args(argv)
+    with open(args.path) as f:
+        doc = json.load(f)
+    if "traceEvents" in doc:
+        print_chrome(doc, tail=args.tail)
+    elif "events" in doc:
+        print_flight(doc, tail=args.tail, kind=args.kind)
+    else:
+        sys.stderr.write(
+            "ptdump: unrecognized document (want a flight-recorder "
+            "dump with 'events' or a chrome trace with 'traceEvents')\n")
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
