@@ -246,9 +246,28 @@ def bench_serving(on_tpu):
         eng.metrics = EngineMetrics(eng._bench_registry)
         for i, prompt in enumerate(prompts):
             eng.submit(Request(f"r{i}", prompt, max_new_tokens=nt))
+        # device telemetry window: XLA-counted FLOPs issued by the
+        # prefill/decode/verify entry points during THIS timed run →
+        # measured MFU instead of an analytic-formula estimate
+        from paddle_tpu.observability import device_telemetry as _dt
+        mark = _dt.COSTS.issued_totals()
         t0 = time.perf_counter()
         done = eng.run()
         dt = time.perf_counter() - t0
+        issued = _dt.COSTS.issued_totals()
+        d_flops = issued["flops"] - mark["flops"]
+        eng._bench_device = {
+            "mfu": _dt.COSTS.mfu_over(d_flops, dt),
+            "flops": d_flops,
+            "phase_flops": {
+                name.replace("serving.", ""):
+                    v["flops"] - mark["per_fn"].get(
+                        name, {"flops": 0.0})["flops"]
+                for name, v in issued["per_fn"].items()
+                if name.startswith("serving.")
+                and v["flops"] - mark["per_fn"].get(
+                    name, {"flops": 0.0})["flops"] > 0},
+        }
         return eng, done, dt
 
     eng, done, dt = run_once(spec)
@@ -261,12 +280,21 @@ def bench_serving(on_tpu):
                         if eng.cache_quant else 0))
     capacity_tokens = (eng.num_pages - 1) * eng.page_size
     snap = eng._bench_registry.snapshot()
+    # HBM high-water (device allocator stats on chip; live-array walk
+    # everywhere) — the capacity number int8-cache claims are judged by
+    from paddle_tpu.observability import device_telemetry as _devtel
+    mem = _devtel.ACCOUNTANT.poll(force=True)
+    hbm_peak = mem.get("peak_bytes_in_use") or mem["live_peak_bytes"]
     out = {"decode_tokens_per_sec": round(total_new / dt, 1),
            "requests": nreq, "new_tokens": total_new, "batch": max_seqs,
            "cache_dtype": cache_dtype or str(jnp.dtype(dtype).name),
            "kv_pool_bytes": pool_bytes,
            "kv_bytes_per_token": round(pool_bytes / capacity_tokens, 1),
            "step_time_s": round(dt / max(total_new, 1), 5),
+           "mfu": round(eng._bench_device["mfu"], 6),
+           "xla_flops": eng._bench_device["flops"],
+           "phase_flops": eng._bench_device["phase_flops"],
+           "hbm_peak_bytes": int(hbm_peak),
            "metrics": {
                "ttft_p50_s": round(snap["pt_serving_ttft_seconds"]["p50"], 5),
                "ttft_p99_s": round(snap["pt_serving_ttft_seconds"]["p99"], 5),

@@ -3,8 +3,11 @@ numeric-health tooling for mixed-precision runs — nan/inf checks,
 per-op stats collection, accuracy comparison between runs.
 
 Tape-native: op stats come from counting recorded TapeNodes; the tensor
-checker validates op outputs as they are recorded (eager only — inside
-jit, XLA arrays are traced; use utils.watchdog NaN monitors there).
+checker validates op outputs as they are recorded. `check_numerics` is
+traced-code-safe: on a traced value it defers to
+`observability.health.traced_check` (async count into
+`pt_train_nonfinite_total`, no host sync); eager values keep
+raise-on-bad semantics with one batched transfer.
 """
 from __future__ import annotations
 
@@ -52,15 +55,39 @@ _op_stats: dict | None = None
 
 def check_numerics(tensor, op_type="", var_name="", debug_mode=None,
                    stack_height_limit=1):
-    """Raise if the tensor contains nan/inf (reference check_numerics)."""
-    v = np.asarray(unwrap(tensor))
-    bad = ~np.isfinite(v)
-    if bad.any():
+    """Raise if the tensor contains nan/inf (reference check_numerics).
+
+    Routed through the observability health layer: a TRACED value
+    (inside jit / to_static) gets the jit-safe fused check —
+    `health.traced_check` reports non-finite counts asynchronously via
+    `jax.debug.callback` into `pt_train_nonfinite_total` and the flight
+    recorder, with no host sync in the step's critical path (the old
+    np.asarray + int(bad.sum()) here was exactly tpulint TPL001). An
+    EAGER value keeps raise-on-bad semantics, but via one fused device
+    reduction + ONE batched transfer instead of three numpy round
+    trips over the full array."""
+    import jax
+
+    v = unwrap(tensor)
+    name = var_name or "tensor"
+    if isinstance(v, jax.core.Tracer):
+        from ..observability.health import traced_check
+        traced_check(v, name=f"check_numerics:{name}")
+        return tensor
+    vj = jnp.asarray(v)
+    if not jnp.issubdtype(vj.dtype, jnp.floating):
+        return tensor
+    nan_c, inf_c = map(int, jax.device_get(
+        (jnp.sum(jnp.isnan(vj)), jnp.sum(jnp.isinf(vj)))))
+    if nan_c or inf_c:
+        from ..observability.health import HEALTH
+        HEALTH.note_nonfinite(nan_c + inf_c, where=f"check_numerics:{name}",
+                              source="eager", op=op_type or None)
         raise FloatingPointError(
-            f"check_numerics: {int(bad.sum())}/{v.size} non-finite values "
-            f"in {var_name or 'tensor'}"
+            f"check_numerics: {nan_c + inf_c}/{vj.size} non-finite values "
+            f"in {name}"
             f"{f' (op {op_type})' if op_type else ''}: "
-            f"nan={int(np.isnan(v).sum())} inf={int(np.isinf(v).sum())}")
+            f"nan={nan_c} inf={inf_c}")
     return tensor
 
 

@@ -1,6 +1,13 @@
 """GradScaler (reference: python/paddle/amp/grad_scaler.py).
 
 Dynamic loss scaling for fp16; bf16 path is a no-op (TPU-native default).
+
+Found-inf telemetry: every overflow-skipped step reports to
+`observability.health` (`pt_amp_found_inf_total`, a flight-recorder
+`health` record, and a structured-log warning), so a run quietly
+backing its loss scale off is visible on `/metrics` instead of only
+in the loss curve. The overflow check itself is ONE fused device
+reduction + one transfer per unscale, not one `bool()` sync per param.
 """
 from __future__ import annotations
 
@@ -24,6 +31,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._found_inf_steps = 0   # lifetime skipped-step count
 
     def is_enable(self):
         return self._enable
@@ -42,14 +50,28 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        import jax
         inv = 1.0 / self._scale
-        found = False
+        unscaled = []
         for p in optimizer._parameter_list or []:
             if p.grad is not None:
-                g = p.grad._value.astype(jnp.float32) * inv
-                found = found or (not bool(jnp.all(jnp.isfinite(g))))
-                p.grad = Tensor(g.astype(p.grad.dtype))
+                unscaled.append((p, p.grad._value.astype(jnp.float32) * inv))
+        if not unscaled:
+            self._found_inf = False
+            return
+        # one fused finite check over every grad, ONE transfer — the
+        # per-param bool(jnp.all(...)) here was a sync per parameter
+        bad = jnp.zeros((), jnp.int32)
+        for _, g in unscaled:
+            bad = bad + jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+        found = bool(int(jax.device_get(bad)))
+        for p, g in unscaled:
+            p.grad = Tensor(g.astype(p.grad.dtype))
         self._found_inf = found
+        if found:
+            self._found_inf_steps += 1
+            from ..observability.health import HEALTH
+            HEALTH.note_found_inf(self._scale)
 
     def minimize(self, optimizer, loss):
         self.step(optimizer)
@@ -63,6 +85,11 @@ class GradScaler:
         if not self._found_inf:
             optimizer.step()
 
+    @property
+    def found_inf_steps(self):
+        """Lifetime count of overflow-skipped steps (telemetry)."""
+        return self._found_inf_steps
+
     def update(self):
         if not (self._enable and self._dynamic):
             return
@@ -70,8 +97,13 @@ class GradScaler:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:
+                old = self._scale
                 self._scale = max(self._scale * self._decr_ratio, 1.0)
                 self._bad_steps = 0
+                from ..observability.logging import get_logger
+                get_logger("health").event(
+                    "health.amp_scale_backoff", level="warning",
+                    old_scale=old, new_scale=self._scale)
         else:
             self._good_steps += 1
             self._bad_steps = 0

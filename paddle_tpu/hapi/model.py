@@ -9,18 +9,9 @@ import numpy as np
 from .._core.tensor import Tensor
 from ..io import DataLoader
 from .. import callbacks as cb_mod
+from ..observability import device_telemetry as _devtel
+from ..observability import health as _health
 from ..observability.logging import get_logger
-
-
-def _live_device_bytes():
-    """Bytes held by live device arrays (jax.live_arrays walks every
-    undeleted buffer — called only at log_freq cadence)."""
-    try:
-        import jax
-        return int(sum(getattr(a, "nbytes", 0) or 0
-                       for a in jax.live_arrays()))
-    except Exception:
-        return None
 
 
 class Model:
@@ -99,6 +90,11 @@ class Model:
                                       metrics=self._metric_names())
         cbs.on_train_begin()
         it = 0
+        # MFU window markers: FLOPs issued by tracked/jitted entry
+        # points between two log records, over the wall time between
+        # them (0.0 for a purely eager network — nothing tracked ran)
+        mfu_flops = _devtel.COSTS.issued_totals()["flops"]
+        mfu_t = time.perf_counter()
         for epoch in range(epochs):
             self.stop_training = False
             cbs.on_epoch_begin(epoch)
@@ -115,15 +111,34 @@ class Model:
                 logs = self._pack_logs(res)
                 cbs.on_train_batch_end(step, logs)
                 it += 1
+                if logs.get("loss") is not None:
+                    # free host-side health check (loss is already a
+                    # float): a non-finite loss bumps
+                    # pt_train_nonfinite_total + the flight recorder
+                    _health.note_host_loss(logs["loss"], where="hapi.fit")
                 if log_freq and it % log_freq == 0:
                     # structured step record (flight recorder always;
-                    # the log stream when PADDLE_TPU_LOG is wired)
+                    # the log stream when PADDLE_TPU_LOG is wired);
+                    # memory comes from the device-memory accountant
+                    # (allocator stats + live-array walk, peak kept),
+                    # MFU from the issued-FLOPs window since the last
+                    # record
+                    mem = _devtel.ACCOUNTANT.poll(force=True)
+                    now = time.perf_counter()
+                    flops = _devtel.COSTS.issued_totals()["flops"]
+                    mfu = _devtel.COSTS.mfu_over(flops - mfu_flops,
+                                                 now - mfu_t)
+                    mfu_flops, mfu_t = flops, now
                     get_logger("hapi").event(
                         "train.step", epoch=epoch, step=step, iter=it,
                         loss=logs.get("loss"), step_time_s=dt,
                         samples_per_s=(batch_size / dt) if dt > 0
                         else None,
-                        live_device_bytes=_live_device_bytes())
+                        live_device_bytes=mem["live_bytes"],
+                        hbm_peak_bytes=mem["live_peak_bytes"],
+                        bytes_in_use=mem.get("bytes_in_use"),
+                        mfu=mfu,
+                        nonfinite_total=_health.HEALTH.nonfinite_steps)
                 if num_iters is not None and it >= num_iters:
                     break
             cbs.on_epoch_end(epoch, logs)

@@ -180,10 +180,17 @@ class InferenceEngine:
         t0 = _time.perf_counter()
         out = fn(*tensor_args)
         # compile telemetry: the shape key IS the cache key, so a new
-        # key is a (re)trace — counted + timed in the global registry
+        # key is a (re)trace — counted + timed in the global registry;
+        # a compile also captures the executable's XLA cost/memory
+        # analysis and every call feeds the device-telemetry MFU window
+        from ...observability import device_telemetry as _dt
         from ...observability.compile_telemetry import REGISTRY
-        REGISTRY.note_call(f"incubate.inference:{self.func.__qualname__}",
-                           key, _time.perf_counter() - t0)
+        label = f"incubate.inference:{self.func.__qualname__}"
+        compiled = REGISTRY.note_call(label, key,
+                                      _time.perf_counter() - t0)
+        if compiled:
+            _dt.COSTS.capture(label, key, fn, tuple(tensor_args))
+        _dt.COSTS.note_executed(label, key)
         return jax.tree_util.tree_map(Tensor, out)
 
 

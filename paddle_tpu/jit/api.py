@@ -61,13 +61,20 @@ class StaticFunction:
                     else str(type(a)))
         return tuple(sig(a) for a in args)
 
-    def _note_call(self, key, elapsed_s):
+    def _note_call(self, key, elapsed_s, jitted=None, call_args=()):
         """Compile telemetry: the shape key IS jit's cache key, so a
-        first-seen key is a compile (counted, timed, retrace-warned)."""
+        first-seen key is a compile (counted, timed, retrace-warned).
+        A compile also captures the executable's XLA cost/memory
+        analysis, and every call feeds the MFU window."""
+        from ..observability import device_telemetry as _dt
         from ..observability.compile_telemetry import REGISTRY
         name = getattr(self._function, "__qualname__",
                        self._function.__name__)
-        REGISTRY.note_call(f"to_static:{name}", key, elapsed_s)
+        label = f"to_static:{name}"
+        compiled = REGISTRY.note_call(label, key, elapsed_s)
+        if compiled and jitted is not None:
+            _dt.COSTS.capture(label, key, jitted, call_args)
+        _dt.COSTS.note_executed(label, key)
 
     def __call__(self, *args, **kwargs):
         if not _to_static_enabled:
@@ -93,7 +100,8 @@ class StaticFunction:
             raws = tuple(unwrap(a) if isinstance(a, Tensor) else a for a in args)
             t0 = time.perf_counter()
             out = self._jitted[key](*raws)
-            self._note_call(key, time.perf_counter() - t0)
+            self._note_call(key, time.perf_counter() - t0,
+                            jitted=self._jitted[key], call_args=raws)
             return jax.tree_util.tree_map(Tensor, out)
         # Layer method: functional over (params, buffers, inputs)
         key = self._key(args)
@@ -114,7 +122,9 @@ class StaticFunction:
         raws = tuple(unwrap(a) if isinstance(a, Tensor) else a for a in args)
         t0 = time.perf_counter()
         out = self._jitted[key](params, buffers, *raws)
-        self._note_call(key, time.perf_counter() - t0)
+        self._note_call(key, time.perf_counter() - t0,
+                        jitted=self._jitted[key],
+                        call_args=(params, buffers) + raws)
         return jax.tree_util.tree_map(Tensor, out)
 
     def concrete_program_specify_input_spec(self, *a, **k):

@@ -18,6 +18,15 @@ The static half of "why was this step slow" is tpulint
     SIGTERM / fault / `/debug/flightrecorder`.
   * `chrome_trace`     — chrome://tracing export of recorded spans,
     one named row per trace id, flow events stitching each request.
+  * `device_telemetry` — XLA cost/memory analysis captured per compiled
+    entry point (FLOPs, bytes, HBM sizes), per-step MFU + roofline
+    gauges against a per-generation peak table, and a device-memory
+    accountant (`pt_mfu`, `pt_device_*` on `/metrics`).
+  * `health`           — jit-safe training-health monitoring: fused
+    loss/grad finite checks + grad-norm/update-ratio computed inside
+    traced step functions (one batched transfer per step), GradScaler
+    found-inf counters, and a NaN-blame pass naming the first
+    non-finite-producing layer (`pt_train_*`).
 
 Import cost: stdlib only at import time (jax is imported lazily inside
 signature hashing), so `import paddle_tpu.observability` is safe from
@@ -26,23 +35,33 @@ anywhere — including the serving stack's innermost loops.
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    chrome_trace, compile_telemetry, flight_recorder, trace_context,
+    chrome_trace, compile_telemetry, device_telemetry, flight_recorder,
+    health, trace_context,
 )
 from . import logging as logging  # noqa: F401,PLC0414 — stdlib-shadowing by design
 from .chrome_trace import chrome_trace_doc  # noqa: F401
 from .compile_telemetry import (  # noqa: F401
     CompileRegistry, signature_of, track_jit, tracked,
 )
+from .device_telemetry import (  # noqa: F401
+    ACCOUNTANT, COSTS, CostRegistry, MemoryAccountant, device_peaks,
+)
 from .flight_recorder import FlightRecorder, RECORDER  # noqa: F401
+from .health import (  # noqa: F401
+    HEALTH, TrainingHealthMonitor, health_stats, nan_blame,
+)
 from .logging import StructuredLogger, get_logger  # noqa: F401
 from .trace_context import (  # noqa: F401
     Span, bind, current_trace_id, new_trace_id, span,
 )
 
 __all__ = [
-    "chrome_trace", "compile_telemetry", "flight_recorder",
-    "trace_context", "logging",
+    "chrome_trace", "compile_telemetry", "device_telemetry",
+    "flight_recorder", "health", "trace_context", "logging",
     "CompileRegistry", "tracked", "track_jit", "signature_of",
+    "CostRegistry", "COSTS", "MemoryAccountant", "ACCOUNTANT",
+    "device_peaks",
+    "TrainingHealthMonitor", "HEALTH", "health_stats", "nan_blame",
     "FlightRecorder", "RECORDER",
     "StructuredLogger", "get_logger",
     "Span", "bind", "span", "new_trace_id", "current_trace_id",

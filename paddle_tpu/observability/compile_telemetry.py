@@ -156,8 +156,16 @@ class CompileRegistry:
                     sig = ("<unhashable>",)
                 t0 = time.perf_counter()
                 out = fn(*args, **kwargs)
-                self.note_call(label, sig,
-                               elapsed_s=time.perf_counter() - t0)
+                compiled = self.note_call(
+                    label, sig, elapsed_s=time.perf_counter() - t0)
+                # device cost accounting: a compile captures the new
+                # executable's XLA cost/memory analysis (shape-only
+                # AOT re-resolve — donated buffers are fine), and
+                # every call adds its known FLOPs to the MFU window
+                from . import device_telemetry as _dt
+                if compiled:
+                    _dt.COSTS.capture(label, sig, fn, args, kwargs)
+                _dt.COSTS.note_executed(label, sig)
                 return out
             wrapper.__wrapped__ = fn
             wrapper._pt_compile_name = label

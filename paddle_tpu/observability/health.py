@@ -1,0 +1,400 @@
+"""Training-health monitoring (aux subsystem: observability).
+
+Numerics checking in this stack predates jit-awareness:
+`amp.debugging.check_numerics` pulled every tensor to host
+(`np.asarray` + `int(bad.sum())` — exactly what tpulint TPL001
+rejects inside traced code) and `utils.watchdog.check_finite` ran one
+blocking `bool()` per pytree leaf. This module is the single
+jit-safe implementation both now route through:
+
+  * **traced helpers** (`nonfinite_count`, `health_stats`,
+    `traced_check`) — pure jnp reductions, safe inside any jitted
+    step function. `health_stats` fuses the whole per-step health
+    vector — loss, non-finite grad count, grad global norm,
+    param-update ratio — into a handful of device scalars computed
+    IN the existing traced train step, so observing them costs one
+    batched `device_get`, not a sync per tensor.
+  * **TrainingHealthMonitor** — the host half: one `observe()` per
+    step does that single transfer, updates the `pt_train_*`
+    counters/gauges, and feeds the flight recorder + structured log
+    when a step goes non-finite.
+  * **NaN blame** (`nan_blame`) — on demand, reruns one forward with
+    finite-probes hooked on every leaf sublayer and names the FIRST
+    layer that produced non-finite output from finite input (the
+    producer, not the victims downstream). One batched transfer for
+    all probes.
+  * **HEALTH** — module-global counters the GradScaler
+    (`pt_amp_found_inf_total`) and eager loops (`note_host_loss`)
+    also report into; rendered on `/metrics`.
+
+Import cost: stdlib only at import time (jax inside functions).
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+__all__ = [
+    "HEALTH", "HealthCounters", "TrainingHealthMonitor",
+    "nonfinite_count", "health_stats", "traced_check",
+    "nonfinite_report", "nan_blame", "note_host_loss",
+    "snapshot", "render_prometheus", "reset",
+]
+
+
+def _float_leaves(tree):
+    """Floating-point raw-array leaves of a pytree, Tensors unwrapped."""
+    import jax
+    import jax.numpy as jnp
+
+    def unwrap(t):
+        return t._value if hasattr(t, "_value") else t
+    leaves = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(unwrap, tree,
+                               is_leaf=lambda t: hasattr(t, "_value")))
+    return [l for l in leaves
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)]
+
+
+# ---------------------------------------------------------------------------
+# traced-safe device-side reductions
+# ---------------------------------------------------------------------------
+def nonfinite_count(tree):
+    """Total count of non-finite elements across all floating leaves —
+    one fused reduction per array, one int32 scalar out. Traced-safe."""
+    import jax.numpy as jnp
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.int32)
+    total = jnp.zeros((), jnp.int32)
+    for l in leaves:
+        total = total + jnp.sum(
+            ~jnp.isfinite(l.astype(jnp.float32))).astype(jnp.int32)
+    return total
+
+
+def _sumsq(leaves):
+    import jax.numpy as jnp
+    total = jnp.zeros((), jnp.float32)
+    for l in leaves:
+        lf = l.astype(jnp.float32)
+        total = total + jnp.sum(lf * lf)
+    return total
+
+
+def health_stats(loss, grads=None, params=None, new_params=None):
+    """The fused per-step health vector, computed INSIDE traced code:
+
+      loss          — the step loss as f32
+      nonfinite     — non-finite element count over loss + grads
+      grad_norm     — global L2 norm of the gradients
+      update_ratio  — ||new_params - params|| / ||params|| (optimizer
+                      step size relative to weight scale; the classic
+                      divergence early-warning)
+
+    Returns a dict of device scalars — hand it to
+    TrainingHealthMonitor.observe(), which does ONE batched transfer.
+    """
+    import jax.numpy as jnp
+    lv = loss._value if hasattr(loss, "_value") else loss
+    lv = jnp.asarray(lv, jnp.float32).reshape(())
+    stats = {"loss": lv, "nonfinite": nonfinite_count(lv)}
+    if grads is not None:
+        gleaves = _float_leaves(grads)
+        stats["nonfinite"] = stats["nonfinite"] + nonfinite_count(grads)
+        stats["grad_norm"] = jnp.sqrt(_sumsq(gleaves))
+    if params is not None and new_params is not None:
+        pleaves = _float_leaves(params)
+        nleaves = _float_leaves(new_params)
+        diff = [n - p for p, n in zip(pleaves, nleaves)]
+        psq = _sumsq(pleaves)
+        stats["update_ratio"] = jnp.sqrt(_sumsq(diff)) / \
+            jnp.sqrt(psq + jnp.float32(1e-12))
+    return stats
+
+
+def traced_check(value, name="tensor"):
+    """Traced-code-safe numerics check: one fused isfinite reduction,
+    surfaced through `jax.debug.callback` (async — no host sync on the
+    step's critical path, tpulint-clean). A non-finite count increments
+    `pt_train_nonfinite_total` and lands in the flight recorder; it
+    cannot raise from inside the trace — attach a
+    TrainingHealthMonitor(abort=True) host-side to turn counts into
+    exceptions at the step boundary."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    bad = jnp.sum(~jnp.isfinite(jnp.asarray(value).astype(jnp.float32)))
+    jax.debug.callback(
+        functools.partial(_on_traced_count, name=name), bad)
+    return value
+
+
+def _on_traced_count(bad, name):
+    n = int(bad)
+    if n:
+        HEALTH.note_nonfinite(n, where=name, source="traced_check")
+
+
+def nonfinite_report(tree, names=None):
+    """Host-side: per-leaf non-finite counts with ONE batched device
+    transfer (replaces utils.watchdog's per-leaf bool() round trips).
+    Returns [(index_or_name, count), ...] for offending leaves only."""
+    import jax
+    import jax.numpy as jnp
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return []
+    counts = jax.device_get(
+        jnp.stack([jnp.sum(~jnp.isfinite(l.astype(jnp.float32)))
+                   for l in leaves]))
+    out = []
+    for i, c in enumerate(counts):
+        if int(c):
+            out.append((names[i] if names else i, int(c)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# global counters (stdlib-only; rendered on /metrics)
+# ---------------------------------------------------------------------------
+class HealthCounters:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.nonfinite_steps = 0       # observations with any bad value
+        self.nonfinite_values = 0      # total bad elements seen
+        self.found_inf_steps = 0       # GradScaler skipped steps
+        self.last_loss = None
+        self.last_grad_norm = None
+        self.last_update_ratio = None
+        self.last_blame = None
+
+    def note_nonfinite(self, count, where="train", source="monitor",
+                       **fields):
+        with self._lock:
+            self.nonfinite_steps += 1
+            self.nonfinite_values += int(count)
+        from . import flight_recorder as _fr
+        from .logging import get_logger
+        _fr.record("health", event="nonfinite", where=where,
+                   source=source, count=int(count), **fields)
+        get_logger("health").event(
+            "health.nonfinite", level="warning", where=where,
+            source=source, count=int(count), **fields)
+
+    def note_found_inf(self, scale):
+        with self._lock:
+            self.found_inf_steps += 1
+            self.nonfinite_steps += 1
+        from . import flight_recorder as _fr
+        from .logging import get_logger
+        _fr.record("health", event="amp.found_inf", scale=float(scale))
+        get_logger("health").event(
+            "health.amp_found_inf", level="warning", scale=float(scale))
+
+    def note_gauges(self, loss=None, grad_norm=None, update_ratio=None):
+        with self._lock:
+            if loss is not None:
+                self.last_loss = float(loss)
+            if grad_norm is not None:
+                self.last_grad_norm = float(grad_norm)
+            if update_ratio is not None:
+                self.last_update_ratio = float(update_ratio)
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "nonfinite_steps": self.nonfinite_steps,
+                "nonfinite_values": self.nonfinite_values,
+                "found_inf_steps": self.found_inf_steps,
+                "last_loss": self.last_loss,
+                "last_grad_norm": self.last_grad_norm,
+                "last_update_ratio": self.last_update_ratio,
+                "last_blame": self.last_blame,
+            }
+
+    def render_prometheus(self):
+        s = self.snapshot()
+        out = [
+            "# HELP pt_train_nonfinite_total Train-health observations "
+            "that contained non-finite values (loss/grads/checks).",
+            "# TYPE pt_train_nonfinite_total counter",
+            f"pt_train_nonfinite_total {s['nonfinite_steps']}",
+            "# TYPE pt_train_nonfinite_values_total counter",
+            f"pt_train_nonfinite_values_total {s['nonfinite_values']}",
+            "# HELP pt_amp_found_inf_total GradScaler steps skipped for "
+            "inf/nan grads (dynamic loss scaling backed off).",
+            "# TYPE pt_amp_found_inf_total counter",
+            f"pt_amp_found_inf_total {s['found_inf_steps']}",
+        ]
+        for key, metric in (("last_loss", "pt_train_loss"),
+                            ("last_grad_norm", "pt_train_grad_norm"),
+                            ("last_update_ratio",
+                             "pt_train_update_ratio")):
+            v = s[key]
+            if v is not None and math.isfinite(v):
+                out.append(f"# TYPE {metric} gauge")
+                out.append(f"{metric} {v:.6g}")
+        return "\n".join(out) + "\n"
+
+    def reset(self):
+        with self._lock:
+            self.nonfinite_steps = 0
+            self.nonfinite_values = 0
+            self.found_inf_steps = 0
+            self.last_loss = None
+            self.last_grad_norm = None
+            self.last_update_ratio = None
+            self.last_blame = None
+
+
+HEALTH = HealthCounters()
+
+
+def note_host_loss(value, where="train"):
+    """Cheap eager-loop hook (hapi.Model.fit): `value` is already a
+    host float — no device traffic. Counts a non-finite loss."""
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return
+    HEALTH.note_gauges(loss=v)
+    if not math.isfinite(v):
+        HEALTH.note_nonfinite(1, where=where, source="host_loss")
+
+
+# ---------------------------------------------------------------------------
+# the monitor
+# ---------------------------------------------------------------------------
+class TrainingHealthMonitor:
+    """Host half of the per-step health check.
+
+        monitor = TrainingHealthMonitor()
+        # inside your traced step:  stats = health_stats(loss, grads,
+        #                                                params, new_p)
+        # after the step (host):    monitor.observe(stats, step=i)
+
+    `observe` does ONE batched device_get of the fused scalars; a
+    non-finite step bumps `pt_train_nonfinite_total`, lands in the
+    flight recorder, and (with abort=True) raises FloatingPointError.
+    """
+
+    def __init__(self, name="train", abort=False, counters=None):
+        self.name = name
+        self.abort = abort
+        self.counters = counters or HEALTH
+        self.last = None
+
+    stats = staticmethod(health_stats)
+
+    def observe(self, stats, step=None):
+        import jax
+        vals = jax.device_get(stats)     # one batched transfer
+        loss = float(vals.get("loss", 0.0))
+        nonfinite = int(vals.get("nonfinite", 0))
+        grad_norm = vals.get("grad_norm")
+        update_ratio = vals.get("update_ratio")
+        rec = {"loss": loss, "nonfinite": nonfinite, "step": step}
+        if grad_norm is not None:
+            rec["grad_norm"] = float(grad_norm)
+        if update_ratio is not None:
+            rec["update_ratio"] = float(update_ratio)
+        self.last = rec
+        self.counters.note_gauges(loss=loss, grad_norm=rec.get("grad_norm"),
+                                  update_ratio=rec.get("update_ratio"))
+        bad = nonfinite > 0 or not math.isfinite(loss)
+        if bad:
+            self.counters.note_nonfinite(
+                max(nonfinite, 1), where=self.name, source="monitor",
+                step=step, loss=loss)
+            if self.abort:
+                raise FloatingPointError(
+                    f"health[{self.name}]: step {step} produced "
+                    f"{nonfinite} non-finite values (loss={loss}); run "
+                    "observability.health.nan_blame(model, *inputs) to "
+                    "name the producing layer")
+        return rec
+
+    def blame(self, layer, *inputs, **kwargs):
+        return nan_blame(layer, *inputs, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# NaN blame: name the first non-finite producer in the layer tree
+# ---------------------------------------------------------------------------
+def _finite_flag(tree):
+    """Device scalar: True iff every floating leaf is entirely finite."""
+    import jax.numpy as jnp
+    leaves = _float_leaves(tree)
+    ok = jnp.asarray(True)
+    for l in leaves:
+        ok = ok & jnp.all(jnp.isfinite(l.astype(jnp.float32)))
+    return ok
+
+
+def nan_blame(layer, *inputs, **kwargs):
+    """Run ONE forward of `layer` with finite-probes on every leaf
+    sublayer (and the root); return a dict naming the first sublayer —
+    in execution order — whose output went non-finite while its inputs
+    were still finite (i.e. the producer). Probes stay on device until
+    a single batched transfer at the end.
+
+    Returns None when the forward is clean; otherwise
+    {"layer": name, "class": type name, "inputs_finite": bool}.
+    A non-finite *network input* blames the first victim with
+    inputs_finite=False, which tells you to look upstream of the net.
+    """
+    import jax
+    probes = []          # (name, class, in_ok, out_ok) in call order
+    hooks = []
+
+    def make_hook(name, cls):
+        def hook(l, inp, out):
+            probes.append((name, cls, _finite_flag(inp),
+                           _finite_flag(out)))
+        return hook
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        if next(iter(sub._sub_layers.values()), None) is not None:
+            continue             # containers: probe leaves only
+        hooks.append(sub.register_forward_post_hook(
+            make_hook(name or type(sub).__name__, type(sub).__name__)))
+    try:
+        layer(*inputs, **kwargs)
+    finally:
+        for h in hooks:
+            h.remove()
+    if not probes:
+        return None
+    flags = jax.device_get([(p[2], p[3]) for p in probes])  # ONE transfer
+    first_bad = None
+    for (name, cls, _, _), (in_ok, out_ok) in zip(probes, flags):
+        if not bool(out_ok):
+            hit = {"layer": name, "class": cls,
+                   "inputs_finite": bool(in_ok)}
+            if bool(in_ok):
+                first_bad = hit          # the producer — done
+                break
+            if first_bad is None:
+                first_bad = hit          # victim; keep looking upstream
+    if first_bad is not None:
+        HEALTH.last_blame = first_bad["layer"]
+        from . import flight_recorder as _fr
+        _fr.record("health", event="nan_blame", **first_bad)
+    return first_bad
+
+
+# ---------------------------------------------------------------------------
+# module-level exposition (mirrors compile_telemetry's shape)
+# ---------------------------------------------------------------------------
+def snapshot():
+    return HEALTH.snapshot()
+
+
+def render_prometheus():
+    return HEALTH.render_prometheus()
+
+
+def reset():
+    HEALTH.reset()

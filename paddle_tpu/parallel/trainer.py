@@ -39,10 +39,16 @@ def _leaf_spec(param_spec, leaf, param_shape):
 class Trainer:
     def __init__(self, model, optimizer, loss_fn, mesh=None, batch_spec=None,
                  sharding_stage=0, grad_clip_norm=None, base_seed=1234,
-                 donate=True):
+                 donate=True, health_monitor=None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        # observability.health.TrainingHealthMonitor (or duck type):
+        # when set, the traced step also returns the fused health
+        # scalars (loss/nonfinite/grad-norm/update-ratio) and step()
+        # feeds them to monitor.observe() — one batched transfer per
+        # step, computed in-graph (no per-tensor host syncs)
+        self.health_monitor = health_monitor
         self.mesh = mesh or get_mesh()
         if sharding_stage == 0:
             # group_sharded_parallel (ZeRO facade) marks the model/opt;
@@ -119,6 +125,8 @@ class Trainer:
             raw = loss._value if isinstance(loss, Tensor) else loss
             return raw.astype(jnp.float32), new_buffers
 
+        with_health = self.health_monitor is not None
+
         def train_step(params, opt_state, buffers, lr, key, batch):
             (loss, new_buffers), grads = jax.value_and_grad(
                 pure_loss, has_aux=True)(params, buffers, key, batch)
@@ -127,7 +135,14 @@ class Trainer:
                 grads, _ = ClipGradByGlobalNorm.functional(grads, clip_norm)
             new_params, new_state = optimizer.apply_gradients(
                 params, grads, opt_state, lr)
-            return new_params, new_state, new_buffers, loss
+            health = None
+            if with_health:
+                # fused in-graph health vector (observability.health):
+                # a handful of scalar reductions XLA fuses into the
+                # step — observed host-side with ONE batched transfer
+                from ..observability.health import health_stats
+                health = health_stats(loss, grads, params, new_params)
+            return new_params, new_state, new_buffers, loss, health
 
         if self.mesh is None:
             # compile telemetry: a stable batch shape compiles once; a
@@ -152,7 +167,10 @@ class Trainer:
         return track_jit("parallel.train_step")(jax.jit(
             train_step,
             in_shardings=(pspecs, sspecs, None, None, None, bspec),
-            out_shardings=(pspecs, sspecs, None, repl),
+            out_shardings=(pspecs, sspecs, None, repl,
+                           None if not with_health else
+                           {"loss": repl, "nonfinite": repl,
+                            "grad_norm": repl, "update_ratio": repl}),
             donate_argnums=(0, 1) if donate else ()))
 
     # ------------------------------------------------------------------
@@ -163,9 +181,12 @@ class Trainer:
             batch, is_leaf=lambda t: isinstance(t, Tensor))
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = jax.random.fold_in(jax.random.key(self.base_seed), self._step_count)
-        self.params, self.opt_state, self.buffers, loss = self._jit_step(
+        (self.params, self.opt_state, self.buffers, loss,
+         health) = self._jit_step(
             self.params, self.opt_state, self.buffers, lr, key, batch)
         self._step_count += 1
+        if self.health_monitor is not None and health is not None:
+            self.health_monitor.observe(health, step=self._step_count)
         from ..optimizer.lr import LRScheduler
         if isinstance(self.optimizer._learning_rate, LRScheduler):
             self.optimizer._learning_rate.step()

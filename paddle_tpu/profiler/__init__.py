@@ -92,6 +92,11 @@ class Profiler:
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
+        # profile_memory: poll the device-memory accountant on every
+        # recorded step — snapshots land as `device.memory` flight
+        # events next to the window's spans (reference: the profiler's
+        # MemoryView, rebuilt on memory_stats + live_arrays)
+        self._profile_memory = bool(profile_memory)
         self._active = False        # a jax.profiler device trace is live
         self._recording = False     # a host RECORD window is open
         self._state = ProfilerState.CLOSED
@@ -169,6 +174,9 @@ class Profiler:
             self._step_times.append(now - self._last)
         self._last = now
         self._step += 1
+        if self._profile_memory and self._recording:
+            from ..observability.device_telemetry import ACCOUNTANT
+            ACCOUNTANT.poll()   # rate-limited live-array walk
         if self._scheduler is None:
             return
         old = self._state

@@ -29,6 +29,7 @@ import threading
 import time
 from collections import deque
 
+from ..observability import device_telemetry as _devtel
 from ..observability import flight_recorder as _flight
 from ..observability import trace_context as _tc
 from ..observability.logging import get_logger
@@ -434,6 +435,11 @@ class RequestScheduler:
                 continue
             dt = time.perf_counter() - t0
             self.metrics.observe_step(dt)
+            # MFU: the tracked prefill/decode/verify calls this step
+            # issued a known number of XLA-counted FLOPs; dividing by
+            # the (synced) step wall time sets the pt_mfu gauge. Pure
+            # host arithmetic — no device traffic.
+            _devtel.note_step(dt)
             # rate-limited structured step record (always lands in the
             # flight recorder; hits the log stream when one is wired)
             self._log.event(

@@ -10,7 +10,8 @@ stays single-threaded behind the scheduler's pump):
     the request's trace id, echoed back and stamped on every span;
   * `GET /healthz` — liveness + queue/occupancy snapshot;
   * `GET /metrics` — Prometheus text exposition, serving registry +
-    compile telemetry (`?format=json` returns the JSON snapshot);
+    compile telemetry + device telemetry (`pt_mfu`, `pt_device_*`) +
+    training health (`?format=json` returns the JSON snapshot);
   * `GET /debug/flightrecorder` — JSON dump of the crash flight
     recorder ring (`?dump=1` also writes it to disk);
   * `GET /debug/trace` — chrome://tracing JSON of recent spans, one
@@ -33,7 +34,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..observability import chrome_trace as _chrome
 from ..observability import compile_telemetry as _compile
+from ..observability import device_telemetry as _devtel
 from ..observability import flight_recorder as _flight
+from ..observability import health as _health
 from ..observability import trace_context as _tc
 from .scheduler import (BackpressureError, RequestScheduler,
                         SchedulerClosedError)
@@ -81,10 +84,17 @@ class CompletionHandler(BaseHTTPRequestHandler):
             if "format=json" in query:
                 snap = self.sched.registry.snapshot()
                 snap["pt_compile"] = _compile.snapshot()
+                snap["pt_device"] = _devtel.snapshot()
+                snap["pt_health"] = _health.snapshot()
                 self._json(200, snap)
             else:
+                # scrape-cadence device telemetry: render_prometheus
+                # polls the memory accountant (live-array walk) here,
+                # on the HTTP thread — never on the pump's step path
                 body = (self.sched.registry.render_prometheus()
-                        + _compile.render_prometheus()).encode()
+                        + _compile.render_prometheus()
+                        + _devtel.render_prometheus()
+                        + _health.render_prometheus()).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
                                  "text/plain; version=0.0.4")

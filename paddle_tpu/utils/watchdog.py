@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import threading
 import time
-import traceback
 
 
 class HangWatchdog:
@@ -25,13 +24,22 @@ class HangWatchdog:
         self._thread = None
 
     def _default_on_hang(self):
-        import sys
-        frames = sys._current_frames()
+        # leave evidence BEFORE anything else: a hang record + full
+        # flight-recorder dump on disk, then every thread's stack —
+        # the same artifacts a serving crash leaves, so a wedged
+        # collective is debuggable after the process is killed
+        from ..observability import flight_recorder as _flight
+        _flight.record("watchdog.hang", name=self.name,
+                       timeout_s=self.timeout_s)
+        path = None
+        try:
+            path = _flight.dump(reason=f"watchdog:{self.name}")
+        except OSError:
+            pass
         print(f"[watchdog:{self.name}] no heartbeat for {self.timeout_s}s; "
-              f"dumping {len(frames)} thread stacks", flush=True)
-        for tid, frame in frames.items():
-            print(f"--- thread {tid} ---", flush=True)
-            traceback.print_stack(frame)
+              f"flight recorder dumped to {path}; thread stacks follow",
+              flush=True)
+        print(_flight.thread_stacks(), flush=True)
 
     def _run(self):
         while not self._stop.wait(min(self.timeout_s / 4, 10.0)):
@@ -60,22 +68,16 @@ class HangWatchdog:
 
 
 def check_finite(tree, name="tensors"):
-    """Raise if any array in the pytree has NaN/Inf. One fused device
-    reduction per array; cheap enough to run every N steps."""
-    import jax
-    import jax.numpy as jnp
-    from .._core.tensor import Tensor
-    leaves = jax.tree_util.tree_leaves(
-        jax.tree_util.tree_map(lambda t: t._value if isinstance(t, Tensor) else t,
-                               tree, is_leaf=lambda t: isinstance(t, Tensor)))
-    bad = []
-    for i, leaf in enumerate(leaves):
-        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
-            if not bool(jnp.all(jnp.isfinite(leaf.astype(jnp.float32)))):
-                bad.append(i)
+    """Raise if any array in the pytree has NaN/Inf. Delegates to the
+    observability health layer's batched report: one fused reduction
+    per array, ONE device transfer for the whole tree (the previous
+    local implementation synced once per leaf)."""
+    from ..observability.health import nonfinite_report
+    bad = nonfinite_report(tree)
     if bad:
         raise FloatingPointError(
-            f"non-finite values detected in {name} (leaf indices {bad})")
+            f"non-finite values detected in {name} "
+            f"(leaf indices {[i for i, _ in bad]})")
     return True
 
 

@@ -49,6 +49,16 @@ def _assert_metrics_snapshot(out):
     assert m["device_steps"] > 0
     assert m["tpot_p50_s"] >= 0
     assert 0 <= m["batch_occupancy"] <= 1
+    # device telemetry (PR 4): measured MFU from XLA-counted FLOPs over
+    # the timed run, per-phase FLOPs attribution, and the HBM high-water
+    assert 0 < out["mfu"] <= 1, out
+    assert out["xla_flops"] > 0
+    assert out["hbm_peak_bytes"] > 0
+    phases = out["phase_flops"]
+    assert "decode_step" in phases or "verify_step" in phases, phases
+    assert any(k.startswith("prefill") for k in phases), phases
+    assert all(v > 0 for v in phases.values())
+    assert sum(phases.values()) <= out["xla_flops"] + 1e-6
 
 
 def test_serving_load_bench_structure(monkeypatch):

@@ -32,12 +32,39 @@ def _fmt_ts(ts):
         return str(ts)
 
 
+def _human_bytes(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
+def _human_flops(n):
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(n) < 1000 or unit == "P":
+            return f"{n:.3g}{unit}FLOP"
+        n /= 1000.0
+
+
 def _fmt_fields(ev, skip=("kind", "ts", "seq")):
     parts = []
     for k, v in ev.items():
         if k in skip:
             continue
-        if isinstance(v, float):
+        # device-telemetry records carry raw counts; humanize them
+        if v is not None and (k.endswith("_bytes") or k == "bytes"):
+            v = _human_bytes(v)
+        elif v is not None and (k == "flops" or k.endswith("_flops")):
+            v = _human_flops(v)
+        elif isinstance(v, float):
             v = f"{v:.6g}"
         parts.append(f"{k}={v}")
     return " ".join(parts)
@@ -66,6 +93,38 @@ def print_flight(doc, tail=30, kind=None, out=sys.stdout):
         by_kind[e.get("kind", "?")] = by_kind.get(e.get("kind", "?"), 0) + 1
     w("  by kind: " + ", ".join(f"{k}={n}" for k, n in
                                 sorted(by_kind.items())) + "\n")
+    # device-telemetry rollups: latest memory snapshot, per-fn XLA
+    # costs, health incidents — the records PR 4's accountant/cost
+    # registry/monitor leave in the ring
+    mems = [e for e in events if e.get("kind") == "device.memory"]
+    if mems:
+        m = mems[-1]
+        w(f"  device memory (latest of {len(mems)}): "
+          f"live={_human_bytes(m.get('live_bytes'))} "
+          f"in {m.get('live_arrays')} arrays, "
+          f"peak={_human_bytes(m.get('live_peak_bytes'))}")
+        if m.get("bytes_in_use") is not None:
+            w(f", allocator={_human_bytes(m['bytes_in_use'])}"
+              f"/{_human_bytes(m.get('bytes_limit'))}")
+        w("\n")
+    costs = {}
+    for e in events:
+        if e.get("kind") == "device.cost":
+            costs[e.get("fn", "?")] = e      # latest signature wins
+    for fn, e in sorted(costs.items()):
+        hbm = sum(e.get(k) or 0 for k in
+                  ("argument_bytes", "output_bytes", "temp_bytes"))
+        w(f"  cost {fn}: {_human_flops(e.get('flops'))}"
+          f" {_human_bytes(e.get('bytes_accessed'))} accessed,"
+          f" hbm {_human_bytes(hbm)}\n")
+    health = [e for e in events if e.get("kind") == "health"]
+    if health:
+        bad = sum(e.get("count", 0) or 0 for e in health)
+        blames = [e for e in health if e.get("event") == "nan_blame"]
+        w(f"  health: {len(health)} incidents, {bad} non-finite values")
+        if blames:
+            w(f"; last blame: {blames[-1].get('layer')}")
+        w("\n")
     if kind:
         events = [e for e in events if e.get("kind") == kind]
         w(f"  filtered kind={kind}: {len(events)} events\n")
