@@ -180,9 +180,22 @@ def bench_serving(on_tpu):
     # PT_SERVE_SPEC=G: prompt-lookup speculative decoding, G-token
     # verify chunks (greedy-exact; see llama_serving.verify_step)
     spec = int(os.environ.get("PT_SERVE_SPEC", "0") or 0)
+    # PT_SERVE_PREFIX=1: shared-prefix workload over the prefix KV
+    # cache (serving/kvcache.py) — every prompt reuses one long common
+    # header (the system-prompt / few-shot pattern), so admissions
+    # after the first map the header's pages and prefill only the tail
+    prefix_mode = (os.environ.get("PT_SERVE_PREFIX", "") or "0") \
+        not in ("", "0")
 
     rng = _data_rng()
-    if spec > 1:
+    if prefix_mode:
+        if not on_tpu:
+            nreq = max(nreq, 4)
+        header = list(map(int, rng.randint(1, cfg.vocab_size, 3 * page)))
+        prompts = [header + list(map(int, rng.randint(
+            1, cfg.vocab_size, 4 if not on_tpu else 16)))
+            for _ in range(nreq)]
+    elif spec > 1:
         # speculative decoding exists for workloads with n-gram
         # repetition (code, templated text, retrieval contexts);
         # uniform-random prompts draft at ~0% acceptance and would show
@@ -236,7 +249,8 @@ def bench_serving(on_tpu):
         eng = ServingEngine(params, cfg, max_seqs=max_seqs,
                             max_seq_len=max_seq_len, page_size=page,
                             dtype=dtype, cache_dtype=cache_dtype,
-                            spec_decode=spec_g)
+                            spec_decode=spec_g,
+                            prefix_cache=prefix_mode)
         # serving-runtime telemetry rides the same engine hooks the
         # HTTP frontend uses; the timed run's snapshot ships in the
         # artifact so the driver sees TTFT/occupancy, not just tok/s
@@ -311,6 +325,14 @@ def bench_serving(on_tpu):
                "page_allocs": snap["pt_serving_page_allocs"]["value"],
            },
            "loss": 0.0}
+    if prefix_mode:
+        # the prefix cache's own ledger — the artifact must show the
+        # reuse the workload was built to exercise
+        pc = eng.prefix_cache
+        out["workload"] = "shared-prefix"
+        out["prefix_hit_rate"] = round(pc.hit_rate, 3)
+        out["tokens_reused"] = int(pc.tokens_reused)
+        out["prefix_evictions"] = int(pc.evictions)
     if spec > 1:
         # plain decode on the IDENTICAL workload, same engine config —
         # the artifact must carry its own comparison point

@@ -49,6 +49,9 @@ def main():
     ap.add_argument("--spec", type=int, default=0,
                     help="speculative chunk width G (0 = plain decode)")
     ap.add_argument("--cache", choices=["fp", "int8"], default="fp")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="share KV pages across prompts with a common "
+                         "prefix; admissions prefill only their suffix")
     args = ap.parse_args()
 
     cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=8,
@@ -57,7 +60,7 @@ def main():
     eng = ServingEngine(
         params, cfg, max_seqs=args.max_seqs, max_seq_len=256,
         page_size=16, cache_dtype="int8" if args.cache == "int8" else None,
-        spec_decode=args.spec)
+        spec_decode=args.spec, prefix_cache=args.prefix_cache)
     sched = RequestScheduler(eng, max_queue=args.max_queue)
     srv = ServingServer(sched, host=args.host, port=args.port).start()
     print(f"serving on {srv.url}  "

@@ -31,6 +31,9 @@ from .._core.compat import shard_map
 from ..observability import flight_recorder as _flight
 from ..observability.compile_telemetry import track_jit
 from ..profiler import record_span
+# host-side page bookkeeping only (numpy/stdlib — serving.kvcache never
+# imports model/engine code, so this direction stays cycle-free)
+from ..serving.kvcache import PagePool, PrefixCache
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
 from ..ops.paged_attention import (paged_attention, paged_verify_attention,
@@ -436,6 +439,9 @@ class Request:
         self.output = []
         self.slot = None
         self.next_token = None
+        # prompt tokens served from the prefix KV cache at admission
+        # (0 for cold admissions; surfaced in the HTTP usage block)
+        self.cached_tokens = 0
         # runtime accounting (paddle_tpu.serving): cancellation flag is
         # honored at step boundaries; timestamps feed TTFT/TPOT metrics
         self.cancelled = False
@@ -493,14 +499,24 @@ class ServingEngine:
         n_pages*page_size tokens of KV.
       * "recompute": pages are dropped; resume re-prefills
         prompt + generated-so-far (cheaper on host RAM, ~1 extra prefill
-        of compute per eviction)."""
+        of compute per eviction).
+
+    `prefix_cache=True` (serving/kvcache.py; docs/serving.md § Prefix
+    caching) indexes full KV pages by a chained block hash of their
+    token ids: admissions sharing a prompt prefix map the same
+    physical pages (ref-counted via the PagePool every page-lifetime
+    path runs through) and prefill ONLY their suffix — lengths are
+    pre-seeded to the cached token count and the suffix runs as one
+    bucket-shaped verify_step chunk over the cached pages. Refcount-0
+    pages that are still indexed park in an LRU that allocation
+    reclaims before the pool is declared empty."""
 
     def __init__(self, params, config: LlamaConfig, max_seqs=4,
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
                  use_pallas=None, interpret=False, num_pages=None,
                  cache_dtype=None, preempt_policy="offload",
                  spec_decode=0, spec_ngram=2, chunked_prefill=False,
-                 spec_sample=False, mesh=None):
+                 spec_sample=False, mesh=None, prefix_cache=False):
         c = config
         # mesh with a 'tp' axis: tensor-parallel serving — weights get
         # megatron NamedShardings (llama_spmd.param_specs), the KV pool
@@ -627,8 +643,18 @@ class ServingEngine:
                                               self._pool_sharding)
                 self.v_scale = jax.device_put(self.v_scale,
                                               self._pool_sharding)
-        # trash page (last) never enters the free list
-        self._free = list(range(num_pages - 2, -1, -1))
+        # single ref-count-aware allocator for EVERY page-lifetime path
+        # (admission, finish, cancel sweep, offload/restore). The trash
+        # page (last id) is outside the pool: never allocated, shared,
+        # indexed, or evicted. prefix_cache=True additionally indexes
+        # full pages by chained block hash so admissions sharing a
+        # prompt prefix map the same physical pages and prefill only
+        # their suffix (serving/kvcache.py; docs/serving.md).
+        self.prefix_cache = PrefixCache(page_size) if prefix_cache else None
+        self.pool = PagePool(num_pages - 1, cache=self.prefix_cache)
+        if self.prefix_cache is not None:
+            self.prefix_cache.on_evict = self._note_prefix_evict
+        self._index_suspend = False  # set while releasing failed slots
         self._seq_pages = {s: [] for s in range(max_seqs)}
         self._slots = [None] * max_seqs          # slot -> Request
         self._waiting = []
@@ -644,6 +670,16 @@ class ServingEngine:
         self._use_pallas_prefill = False if self._mesh is not None \
             else use_pallas
         self._interpret = interpret
+
+    @property
+    def _free(self):
+        """The pool's free list (compatibility view — tests and tools
+        poke it directly; engine code goes through `self.pool`)."""
+        return self.pool.free
+
+    @_free.setter
+    def _free(self, pages):
+        self.pool.free = list(pages)
 
     # -- request admission ------------------------------------------------
     def validate(self, req: Request):
@@ -790,7 +826,7 @@ class ServingEngine:
                 and int(self.lengths[s]) % self.page_size == 0
                 and len(self._seq_pages[s]) * self.page_size
                 <= int(self.lengths[s]))
-        free_pages = len(self._free) - growth_need
+        reserve = growth_need
         take = 0
         for req in self._waiting[:len(free_slots)]:
             ofl = getattr(req, "_offload", None)
@@ -800,13 +836,24 @@ class ServingEngine:
                         need * self.page_size <= ofl["len"]:
                     need += 1  # boundary growth this same step
             else:
-                feed_len = max(len(self._feed_ids(req)), 1)
-                need = -(-feed_len // self.page_size)
+                feed = self._feed_ids(req)
+                feed_len = max(len(feed), 1)
+                # acquire the cached prefix NOW (ref-counted) so a
+                # later candidate's allocation cannot evict it out
+                # from under this one; `need` then counts only the
+                # UNCACHED pages — cache-aware admission accounting
+                req._kv_match = self._cache_acquire(feed)
+                need = -(-feed_len // self.page_size) \
+                    - len(req._kv_match[0])
                 if feed_len % self.page_size == 0:
                     need += 1  # its own first decode boundary, same step
-            if need > free_pages:
+            # pool.available() counts free + reclaimable (rc==0 cached)
+            # pages; reviving a matched page above already removed it
+            # from the reclaimable side
+            if need > self.pool.available() - reserve:
+                self._cache_unacquire(req)
                 break
-            free_pages -= need
+            reserve += need
             take += 1
         if take == 0:
             return
@@ -823,6 +870,8 @@ class ServingEngine:
         # prompt G tokens per verify step so decoders never stall)
         reqs, slots = [], []
         for slot, req in zip(all_slots, all_reqs):
+            match = getattr(req, "_kv_match", None) or ([], 0)
+            req._kv_match = None
             if getattr(req, "_offload", None) is not None:
                 self._restore_into(slot, req)
             elif self.chunked_prefill:
@@ -838,7 +887,16 @@ class ServingEngine:
                 req._admit_order = self._order
                 self._order += 1
                 self._slots[slot] = req
+                if match[0]:
+                    # cached prefix: map the shared pages in and start
+                    # the chunk feed at the first uncached token
+                    self._map_prefix(slot, match)
+                    req._pf_cursor = match[1]
+                self._note_prefix_admit(req, match)
+            elif match[0]:
+                self._prefill_suffix_into(slot, req, match)
             else:
+                self._note_prefix_admit(req, match)
                 reqs.append(req)
                 slots.append(slot)
         take = len(reqs)
@@ -886,6 +944,9 @@ class ServingEngine:
             req._admit_order = self._order
             self._order += 1
             self._slots[slot] = req
+            # index BEFORE seeding: a max_new_tokens==1 request
+            # finishes (and releases) inside _seed_first_token
+            self._index_slot(slot, req)
             if getattr(req, "_resume", False):
                 # resuming after preemption: next_token was already
                 # sampled before eviction — do NOT re-sample it
@@ -936,11 +997,11 @@ class ServingEngine:
         self._scatter_packed(kq, vq, pg, off)
 
     def _alloc_pages(self, slot, n):
-        if len(self._free) < n:
+        if not self.pool.can_alloc(n):
             raise RuntimeError("serving: out of KV pages")
         if len(self._seq_pages[slot]) + n > self.pages_per_seq:
             raise RuntimeError("serving: sequence exceeds max_seq_len")
-        pages = [self._free.pop() for _ in range(n)]
+        pages = self.pool.alloc(n)
         self._seq_pages[slot].extend(pages)
         start = len(self._seq_pages[slot]) - n
         for i, pg in enumerate(pages):
@@ -968,6 +1029,7 @@ class ServingEngine:
         req._admit_order = self._order
         self._order += 1
         self._slots[slot] = req
+        self._index_slot(slot, req)
         if getattr(req, "_resume", False):
             req._resume = False  # next_token survives from before eviction
         else:
@@ -1102,7 +1164,7 @@ class ServingEngine:
             cur = int(self.lengths[s])
             if cur % self.page_size == 0 and cur > 0 and \
                     len(self._seq_pages[s]) * self.page_size <= cur:
-                while not self._free:
+                while not self.pool.can_alloc(1):
                     if not self._preempt_one(exclude=s):
                         raise RuntimeError(
                             "serving: KV page pool exhausted with a "
@@ -1204,7 +1266,7 @@ class ServingEngine:
             need = -(-(int(self.lengths[s]) + int(n_tok[s]))
                      // self.page_size)
             while len(self._seq_pages[s]) < need:
-                while not self._free:
+                while not self.pool.can_alloc(1):
                     if not self._preempt_one(exclude=s):
                         raise RuntimeError(
                             "serving: KV page pool exhausted with a "
@@ -1308,10 +1370,135 @@ class ServingEngine:
         return len(active_slots)
 
     def _release(self, slot):
-        self._free.extend(reversed(self._seq_pages[slot]))
+        req = self._slots[slot]
+        if req is not None:
+            # a finished/cancelled/preempted slot's KV is valid up to
+            # `lengths` — index its full pages so later admissions
+            # sharing the prefix skip their prefill
+            self._index_slot(slot, req)
+        # decref tail-first: deepest blocks park least-recently-used,
+        # so eviction reclaims children before the prefixes they need
+        self.pool.decref(reversed(self._seq_pages[slot]))
         self._seq_pages[slot] = []
         self.lengths[slot] = 0
         self._slots[slot] = None
+
+    # -- prefix KV cache (serving/kvcache.py) -----------------------------
+    def _cache_acquire(self, feed):
+        """Longest-prefix match for an admission candidate; matched
+        pages are ref-counted immediately, so nothing later in this
+        admission wave can evict them. Returns (pages, cached_tokens)."""
+        pc = self.prefix_cache
+        if pc is None:
+            return [], 0
+        pages, cached = pc.match(feed)
+        if pages:
+            self.pool.incref(pages)
+        return pages, cached
+
+    def _cache_unacquire(self, req):
+        """Admission did not take the candidate after all: drop its
+        acquired prefix (rc==0 pages fall back into the cache LRU)."""
+        match = getattr(req, "_kv_match", None)
+        if match and match[0]:
+            self.pool.decref(match[0])
+        req._kv_match = None
+
+    def _map_prefix(self, slot, match):
+        """Map already-acquired shared prefix pages into the slot's
+        page-table row and pre-seed its length to the cached token
+        count — the device only ever sees the suffix."""
+        pages, cached = match
+        self._seq_pages[slot] = list(pages)
+        for i, pg in enumerate(pages):
+            self.page_table[slot, i] = pg
+        self.lengths[slot] = cached
+
+    def _index_slot(self, slot, req):
+        """Index the slot's full pages under the chained block hash of
+        the tokens they hold (cache position i holds the KV of token
+        (prompt+output)[i]) so later admissions can share them."""
+        pc = self.prefix_cache
+        if pc is None or self._index_suspend:
+            return
+        L = int(self.lengths[slot])
+        toks = (list(req.prompt) + [int(t) for t in req.output])[:L]
+        pc.insert(toks, self._seq_pages[slot], L)
+
+    def _note_prefix_admit(self, req, match):
+        """Admission-time cache accounting. Only admitted requests
+        count — a queued candidate re-probed every step is not a
+        stream of lookups."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        cached = match[1]
+        req.cached_tokens = cached
+        pc.lookups += 1
+        if cached > 0:
+            pc.hits += 1
+            pc.tokens_reused += cached
+            _flight.record("kvcache.hit", rid=str(req.rid),
+                           cached_tokens=cached, pages=len(match[0]))
+        m = self.metrics
+        if m is not None:
+            m.on_prefix_lookup(cached)
+
+    def _note_prefix_evict(self, page):
+        m = self.metrics
+        if m is not None:
+            m.on_prefix_evict()
+
+    def _prefill_suffix_into(self, slot, req, match):
+        """Suffix-only prefill for a prefix-cache hit: the matched
+        pages are mapped in shared (ref-counted) and ONLY the
+        remaining tokens run through the device — one bucket-shaped
+        verify_step whose chunk attends to the cached pages through
+        the slot's page table. The chunk/cache split is exactly the
+        verify kernel's contract, so no new jitted entry point (and
+        no new compile telemetry surface) is needed; partial-page
+        prompt tails are part of the suffix and recomputed."""
+        pages, cached = match
+        feed = self._feed_ids(req)
+        suffix = feed[cached:]
+        n = len(suffix)
+        self.prefill_tokens += n
+        self._map_prefix(slot, match)
+        total = -(-len(feed) // self.page_size)
+        if total > len(pages):
+            self._alloc_pages(slot, total - len(pages))
+        # bucketed chunk width: one compile per bucket, not one per
+        # distinct suffix length (same reasoning as the packed
+        # prefill scatter above)
+        G = max(self.page_size, 1 << math.ceil(math.log2(max(n, 1))))
+        tokens = np.zeros((self.max_seqs, G), np.int64)
+        tokens[slot, :n] = suffix
+        n_tok = np.zeros((self.max_seqs,), np.int32)
+        n_tok[slot] = n
+        active = np.zeros((self.max_seqs,), bool)
+        active[slot] = True
+        with record_span("serving.prefill"):
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+             logits) = verify_step(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                jnp.asarray(tokens), jnp.asarray(n_tok),
+                jnp.asarray(active), self.config, self.page_size,
+                use_pallas=self._use_pallas, interpret=self._interpret,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+                mesh=self._mesh)
+        self.lengths[slot] = cached + n
+        req.slot = slot
+        req._admit_order = self._order
+        self._order += 1
+        self._slots[slot] = req
+        self._note_prefix_admit(req, match)
+        self._index_slot(slot, req)
+        if getattr(req, "_resume", False):
+            req._resume = False  # next_token survives from before eviction
+        else:
+            row = jax.device_get(logits[slot, n - 1])
+            self._seed_first_token(slot, req, row)
 
     def run(self, max_steps=10000):
         steps = 0

@@ -6,6 +6,10 @@ Layers (docs/serving.md has the architecture):
   * `metrics`   — counters/gauges/histograms registry; Prometheus text
                   exposition + JSON snapshot; `EngineMetrics` is the
                   hook object the engine's step loop reports into.
+  * `kvcache`   — ref-counted page pool + radix prefix cache: requests
+                  sharing a prompt prefix share physical KV pages and
+                  prefill only their suffix (host-side numpy, no
+                  device or model imports).
   * `scheduler` — thread-safe bounded request queue with priority
                   classes, deadlines/TTLs, cancellation, backpressure
                   (`BackpressureError`), and graceful drain.
@@ -19,8 +23,9 @@ the engine arrives as a constructor argument — so
 """
 from __future__ import annotations
 
-from . import client, metrics, scheduler, server  # noqa: F401
+from . import client, kvcache, metrics, scheduler, server  # noqa: F401
 from .client import ServingClient, ServingHTTPError  # noqa: F401
+from .kvcache import PagePool, PrefixCache  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram, MetricsRegistry,
 )
@@ -31,8 +36,9 @@ from .scheduler import (  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
-    "client", "metrics", "scheduler", "server",
+    "client", "kvcache", "metrics", "scheduler", "server",
     "ServingClient", "ServingHTTPError",
+    "PagePool", "PrefixCache",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineMetrics",
     "RequestScheduler", "ServingRequest", "SchedulerError",
     "BackpressureError", "DeadlineExceededError", "SchedulerClosedError",

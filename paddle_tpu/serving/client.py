@@ -2,22 +2,35 @@
 
 Token-id in, token-id out — the wire protocol is tokenizer-free, like
 the server. Streaming completions iterate Server-Sent-Events as the
-engine emits chunks; everything else is one JSON round trip.
+engine emits chunks; everything else is one JSON round trip. Responses
+carry an OpenAI-style `usage` block (`prompt_tokens`,
+`completion_tokens`, `cached_tokens` — the prompt prefix the server's
+KV cache served without prefill compute).
+
+Backpressure: a full server queue is HTTP 429 with `Retry-After`
+(`BackpressureError.retry_after_s` on the server side). With
+`retries=N` (opt-in; default 0 preserves raise-immediately) the client
+honors that hint — bounded retries with jittered sleeps — before
+surfacing `ServingHTTPError`.
 """
 from __future__ import annotations
 
 import http.client
 import json
+import random
+import time
 
 __all__ = ["ServingClient", "ServingHTTPError"]
 
 
 class ServingHTTPError(RuntimeError):
-    """Non-2xx response; carries the status and decoded body."""
+    """Non-2xx response; carries the status, decoded body, and (for
+    429) the server's Retry-After hint in seconds."""
 
-    def __init__(self, status, body):
+    def __init__(self, status, body, retry_after_s=None):
         self.status = status
         self.body = body
+        self.retry_after_s = retry_after_s
         msg = body.get("error", body) if isinstance(body, dict) else body
         super().__init__(f"HTTP {status}: {msg}")
 
@@ -26,11 +39,25 @@ class ServingHTTPError(RuntimeError):
         return self.status in (429, 503)
 
 
+def _retry_after(resp):
+    try:
+        v = resp.getheader("Retry-After")
+        return float(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
 class ServingClient:
-    def __init__(self, host="127.0.0.1", port=8000, timeout=120.0):
+    def __init__(self, host="127.0.0.1", port=8000, timeout=120.0,
+                 retries=0, retry_cap_s=5.0, _rng=None):
         self.host = host
         self.port = port
         self.timeout = timeout
+        # opt-in bounded retry on 429 backpressure (never on 503
+        # shutdown or 4xx request errors — those don't heal by waiting)
+        self.retries = int(retries)
+        self.retry_cap_s = float(retry_cap_s)
+        self._rng = _rng if _rng is not None else random.Random()
 
     def _request(self, method, path, body=None):
         conn = http.client.HTTPConnection(self.host, self.port,
@@ -43,6 +70,23 @@ class ServingClient:
         conn.request(method, path, body=payload, headers=headers)
         return conn, conn.getresponse()
 
+    def _with_retries(self, fn):
+        """Run fn(); on 429 sleep out the server's Retry-After (capped,
+        jittered to decorrelate a thundering herd) and try again, at
+        most `self.retries` extra times."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except ServingHTTPError as e:
+                if e.status != 429 or attempt >= self.retries:
+                    raise
+                hint = e.retry_after_s if e.retry_after_s is not None \
+                    else 1.0
+                time.sleep(min(hint, self.retry_cap_s)
+                           * (0.5 + self._rng.random()))
+                attempt += 1
+
     def _json_call(self, method, path, body=None):
         conn, resp = self._request(method, path, body)
         try:
@@ -52,7 +96,8 @@ class ServingClient:
             except json.JSONDecodeError:
                 decoded = data.decode(errors="replace")
             if resp.status >= 400:
-                raise ServingHTTPError(resp.status, decoded)
+                raise ServingHTTPError(resp.status, decoded,
+                                       retry_after_s=_retry_after(resp))
             return decoded
         finally:
             conn.close()
@@ -78,25 +123,38 @@ class ServingClient:
 
     def complete(self, prompt_ids, **params):
         """Blocking completion; returns the response dict
-        ({"tokens": [...], "state": ..., ...})."""
+        ({"tokens": [...], "state": ..., "usage": {...}, ...})."""
         body = dict(params, prompt=list(map(int, prompt_ids)),
                     stream=False)
-        return self._json_call("POST", "/v1/completions", body)
+        return self._with_retries(
+            lambda: self._json_call("POST", "/v1/completions", body))
 
     def stream_complete(self, prompt_ids, **params):
         """Generator of SSE event dicts: token chunks as
-        {"tokens": [...]}, then a final {"done": true, ...} event."""
+        {"tokens": [...]}, then a final {"done": true, ...} event
+        carrying the usage block. 429 retries happen before the first
+        byte is yielded (a stream, once started, is never replayed)."""
         body = dict(params, prompt=list(map(int, prompt_ids)),
                     stream=True)
-        conn, resp = self._request("POST", "/v1/completions", body)
-        try:
+
+        def _open():
+            conn, resp = self._request("POST", "/v1/completions", body)
             if resp.status >= 400:
-                data = resp.read()
                 try:
-                    decoded = json.loads(data)
-                except json.JSONDecodeError:
-                    decoded = data.decode(errors="replace")
-                raise ServingHTTPError(resp.status, decoded)
+                    data = resp.read()
+                    try:
+                        decoded = json.loads(data)
+                    except json.JSONDecodeError:
+                        decoded = data.decode(errors="replace")
+                    raise ServingHTTPError(
+                        resp.status, decoded,
+                        retry_after_s=_retry_after(resp))
+                finally:
+                    conn.close()
+            return conn, resp
+
+        conn, resp = self._with_retries(_open)
+        try:
             # http.client undoes the chunked framing; reassemble SSE
             # events (data: <json>\n\n) line by line
             for line in resp:

@@ -1,0 +1,251 @@
+"""Prefix KV cache: ref-counted page sharing over the paged KV pool.
+
+The serving engine (`models/llama_serving.ServingEngine`) stores KV in
+fixed-size pages addressed through per-slot `page_table` rows. Prompts
+in production traffic share long prefixes — system prompts, few-shot
+headers, multi-turn history — and every token of a shared prefix
+produces *identical* KV at identical positions. This module turns that
+into cache hits (reference parity: SGLang RadixAttention / vLLM
+automatic prefix caching; the Gemma-on-TPU serving study's "KV reuse
+wins TTFT" observation):
+
+  * `PagePool` — the single allocator every page-lifetime path goes
+    through (admission, finish, cancellation sweep, preemption
+    offload/restore). Pages are ref-counted so N concurrent requests
+    can map the same physical page into their page-table rows; a page
+    is reclaimable only at refcount 0.
+  * `PrefixCache` — indexes FULL pages by a chained block hash of
+    their token ids (radix-style: block i's key folds block i-1's key,
+    so a lookup is a longest-prefix walk). Refcount-0 pages that are
+    still indexed park in an LRU instead of the free list; allocation
+    reclaims them (evicting their index entries) before the pool is
+    declared empty.
+
+Everything here is host-side numpy/stdlib by design — the bookkeeping
+runs between device steps, never inside traced code, and must not add
+host<->device traffic (tpulint-clean, zero suppressions).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ..observability import flight_recorder as _flight
+
+__all__ = ["PagePool", "PrefixCache", "block_hash"]
+
+# chain seed: block 0's parent "hash"
+_SEED = 0x9E3779B9
+
+
+def block_hash(parent, block):
+    """Chained hash of one full page of token ids under its parent
+    block's hash. Module-level (not a method) so tests can patch in a
+    colliding function; entries store (parent, block) raw for
+    verification, so a collision degrades to a cache miss, never to
+    wrong KV."""
+    return hash((parent, block))
+
+
+class PrefixCache:
+    """Radix-style index of full KV pages by chained block hash.
+
+    An entry maps `hash(parent_key, page_tokens)` to the physical page
+    holding that block's KV. Entries exist only while the page does:
+    a page is indexed while live (refcount > 0) or parked in the LRU
+    (refcount 0, reclaimable); eviction removes the entry before the
+    page is re-issued. The trash page never reaches this class — the
+    pool only manages allocatable ids.
+    """
+
+    def __init__(self, page_size):
+        self.page_size = int(page_size)
+        self.entries = {}        # chained hash -> (page, parent, block)
+        self._page_key = {}      # indexed page -> chained hash
+        self._lru = OrderedDict()  # rc==0 indexed pages; oldest evicted first
+        # rollups (the engine's metrics hook mirrors these to /metrics)
+        self.lookups = 0
+        self.hits = 0
+        self.tokens_reused = 0
+        self.evictions = 0
+        self.on_evict = None     # callable(page), set by the engine
+
+    # -- radix walk ---------------------------------------------------
+    def _blocks(self, tokens, limit):
+        ps = self.page_size
+        for b in range(max(int(limit), 0) // ps):
+            yield tuple(int(t) for t in tokens[b * ps:(b + 1) * ps])
+
+    def match(self, tokens):
+        """Longest-prefix lookup: walk full blocks of `tokens` while
+        every block's entry exists AND verifies (raw token compare —
+        a hash collision falls back to no-reuse). Capped one token
+        short of len(tokens): the engine must always prefill at least
+        one suffix token to produce next-token logits.
+        Returns (pages, n_cached_tokens)."""
+        pages = []
+        parent = _SEED
+        for block in self._blocks(tokens, len(tokens) - 1):
+            h = block_hash(parent, block)
+            e = self.entries.get(h)
+            if e is None or e[1] != parent or e[2] != block:
+                break
+            pages.append(e[0])
+            parent = h
+        return pages, len(pages) * self.page_size
+
+    def insert(self, tokens, pages, limit):
+        """Index `pages[i]` under block i's chained hash, for every
+        full block below `limit` tokens. Existing verified entries are
+        kept (first writer wins — duplicate pages from a concurrent
+        cold admission stay private and free normally); a colliding
+        foreign entry stops the chain. Returns #entries added."""
+        parent = _SEED
+        added = 0
+        for i, block in enumerate(self._blocks(tokens, limit)):
+            h = block_hash(parent, block)
+            e = self.entries.get(h)
+            if e is None:
+                pg = int(pages[i])
+                # one key per page: never re-index a page that is
+                # already serving a different chain position
+                if pg not in self._page_key:
+                    self.entries[h] = (pg, parent, block)
+                    self._page_key[pg] = h
+                    added += 1
+            elif e[1] != parent or e[2] != block:
+                break            # collision: leave the foreign entry alone
+            parent = h
+        return added
+
+    # -- refcount-0 parking / revival / eviction ----------------------
+    def park(self, page):
+        """Pool callback at refcount 0: keep an indexed page
+        reclaimable-but-cached (MRU end of the LRU) instead of freeing
+        it. Returns False for unindexed pages (caller frees them)."""
+        key = self._page_key.get(page)
+        if key is None:
+            return False
+        self._lru[page] = key
+        return True
+
+    def revive(self, page):
+        """Pool callback when a cached page is re-shared (incref from
+        0): it leaves the LRU — no longer reclaimable."""
+        self._lru.pop(page, None)
+
+    def evict_lru(self):
+        """Reclaim the least-recently-parked page: its index entry is
+        removed (descendant entries become unreachable and age out)
+        and the page id is returned to the allocator."""
+        page, key = self._lru.popitem(last=False)
+        self.entries.pop(key, None)
+        del self._page_key[page]
+        self.evictions += 1
+        _flight.record("kvcache.evict", page=int(page),
+                       cached_pages=len(self._lru))
+        cb = self.on_evict
+        if cb is not None:
+            cb(int(page))
+        return page
+
+    # -- introspection ------------------------------------------------
+    def is_indexed(self, page):
+        return page in self._page_key
+
+    @property
+    def cached_pages(self):
+        """Refcount-0 pages currently parked (reclaimable)."""
+        return len(self._lru)
+
+    @property
+    def hit_rate(self):
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def stats(self):
+        return {"lookups": self.lookups, "hits": self.hits,
+                "hit_rate": self.hit_rate,
+                "tokens_reused": self.tokens_reused,
+                "evictions": self.evictions,
+                "entries": len(self.entries),
+                "cached_pages": len(self._lru)}
+
+
+class PagePool:
+    """Ref-counted allocator over the engine's allocatable page ids
+    (0..num_pages-1 — the engine's trash page is NOT in the pool and
+    can never be indexed, shared, or evicted).
+
+    Page lifetime: alloc() -> refcount 1 (exclusive owner);
+    incref() -> shared by another page-table row; decref() at release —
+    at 0 the page parks in the prefix cache's LRU if still indexed,
+    else returns to the free list. alloc() reclaims LRU pages before
+    declaring the pool empty, so a full cache never blocks admission.
+    """
+
+    def __init__(self, num_pages, cache=None):
+        self.num_pages = int(num_pages)
+        # pop() from the tail hands out page 0 first — same
+        # deterministic order as the engine's original free list
+        self.free = list(range(self.num_pages - 1, -1, -1))
+        self.refcount = np.zeros(self.num_pages, np.int32)
+        self.cache = cache
+
+    def available(self):
+        """Allocatable right now: free pages + reclaimable (rc==0)
+        cached pages. Admission accounting budgets against this."""
+        n = len(self.free)
+        if self.cache is not None:
+            n += self.cache.cached_pages
+        return n
+
+    def can_alloc(self, n):
+        return self.available() >= n
+
+    def alloc(self, n):
+        """Hand out n pages at refcount 1, evicting LRU-cached pages
+        as needed. Raises before mutating anything when the pool
+        genuinely cannot satisfy the request."""
+        if self.available() < n:
+            raise RuntimeError("serving: out of KV pages")
+        out = []
+        for _ in range(n):
+            if not self.free:
+                self.free.append(self.cache.evict_lru())
+            pg = self.free.pop()
+            self.refcount[pg] = 1
+            out.append(pg)
+        return out
+
+    def incref(self, pages):
+        """Share pages into another holder's page table. A cached
+        (rc==0) page is revived out of the LRU."""
+        for pg in pages:
+            if self.refcount[pg] == 0 and self.cache is not None:
+                self.cache.revive(pg)
+            self.refcount[pg] += 1
+
+    def decref(self, pages):
+        """Drop one holder. Refcounts can never go negative — an
+        underflow means a double-free in the engine and is a hard
+        error, not a silent corruption."""
+        for pg in pages:
+            rc = int(self.refcount[pg]) - 1
+            if rc < 0:
+                raise RuntimeError(
+                    f"kvcache: refcount underflow on page {int(pg)} "
+                    "(double release)")
+            self.refcount[pg] = rc
+            if rc == 0:
+                if self.cache is not None and self.cache.park(pg):
+                    continue
+                self.free.append(pg)
+
+    def counts(self):
+        """Conservation invariant probe: free + cached + live must
+        always equal num_pages."""
+        return {"free": len(self.free),
+                "cached": self.cache.cached_pages
+                if self.cache is not None else 0,
+                "live": int((self.refcount > 0).sum())}

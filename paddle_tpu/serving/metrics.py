@@ -7,7 +7,9 @@ preemption and page-allocation stats (reference: the predictor's
 serving telemetry; vLLM exposes the same catalog over /metrics).
 `EngineMetrics` is the engine-facing half: `ServingEngine.metrics`
 duck-types against it, so `models/llama_serving.py` never imports this
-package (no cycle — the engine works bare, the runtime instruments it).
+module (no cycle — the engine works bare, the runtime instruments it;
+the engine's only serving-package import is the host-side
+`serving.kvcache` bookkeeping, which imports no model code back).
 """
 from __future__ import annotations
 
@@ -280,6 +282,25 @@ class EngineMetrics:
             "pt_serving_requests_cancelled", "Requests cancelled.")
         self.expired = r.counter(
             "pt_serving_requests_expired", "Requests past deadline.")
+        # prefix KV cache (serving/kvcache.py): admission-time reuse
+        self.prefix_lookups = r.counter(
+            "pt_prefix_lookups",
+            "Admissions that consulted the prefix cache.")
+        self.prefix_hits = r.counter(
+            "pt_prefix_hits", "Admissions that matched a cached prefix.")
+        self.prefix_hit_rate = r.gauge(
+            "pt_prefix_hit_rate",
+            "Prefix-cache hit rate over admitted requests.")
+        self.prefix_tokens_reused = r.counter(
+            "pt_prefix_tokens_reused",
+            "Prompt tokens served from cached KV pages instead of "
+            "prefill compute.")
+        self.prefix_evictions = r.counter(
+            "pt_prefix_evictions",
+            "Cached rc==0 pages reclaimed by allocation.")
+        self.prefix_cached_pages = r.gauge(
+            "pt_prefix_cached_pages",
+            "Reclaimable rc==0 pages parked in the prefix cache.")
 
     # -- engine-facing hooks (called from the step()-driving thread) --
     def on_submit(self, engine):
@@ -298,6 +319,9 @@ class EngineMetrics:
         self.pages_free.set(len(engine._free))
         self.pages_total.set(engine.num_pages - 1)
         self.prefill_tokens.set(engine.prefill_tokens)
+        pc = getattr(engine, "prefix_cache", None)
+        if pc is not None:
+            self.prefix_cached_pages.set(pc.cached_pages)
         if not self._external_queue:
             depth = len(engine._waiting)
             self.queue_depth.set(depth)
@@ -325,6 +349,20 @@ class EngineMetrics:
 
     def on_cancel(self, where):
         self.cancelled.inc()
+
+    def on_prefix_lookup(self, cached_tokens):
+        """One admitted request consulted the prefix cache;
+        cached_tokens == 0 is a miss."""
+        self.prefix_lookups.inc()
+        if cached_tokens > 0:
+            self.prefix_hits.inc()
+            self.prefix_tokens_reused.inc(cached_tokens)
+        lk = self.prefix_lookups.value
+        self.prefix_hit_rate.set(self.prefix_hits.value / lk if lk
+                                 else 0.0)
+
+    def on_prefix_evict(self, n=1):
+        self.prefix_evictions.inc(n)
 
     # -- scheduler-facing hooks --
     def observe_step(self, dt):

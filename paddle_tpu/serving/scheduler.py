@@ -253,7 +253,7 @@ class RequestScheduler:
 
     def stats(self):
         with self._cond:
-            return {
+            st = {
                 "queued": self._queued_locked(),
                 "active": sum(1 for r in self._engine._slots
                               if r is not None),
@@ -264,6 +264,10 @@ class RequestScheduler:
                 "device_steps": self._engine.device_steps,
                 "preemptions": self._engine.preemptions,
             }
+            pc = getattr(self._engine, "prefix_cache", None)
+            if pc is not None:
+                st["prefix_cache"] = pc.stats()
+            return st
 
     # -- pump (single thread; sole owner of the engine) ----------------
     def _queued_locked(self):
@@ -455,9 +459,16 @@ class RequestScheduler:
         self._log.event("engine.error", level="error", error=repr(exc))
         with self._cond:
             eng = self._engine
-            for s in range(eng.max_seqs):
-                if eng._slots[s] is not None:
-                    eng._release(s)
+            # a failed step may have advanced lengths past K/V that
+            # never landed — releasing these slots must NOT index
+            # their pages into the prefix cache
+            eng._index_suspend = True
+            try:
+                for s in range(eng.max_seqs):
+                    if eng._slots[s] is not None:
+                        eng._release(s)
+            finally:
+                eng._index_suspend = False
             eng._waiting.clear()
             for sr in list(self._inflight.values()):
                 sr.error = SchedulerError(

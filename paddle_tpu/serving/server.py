@@ -172,7 +172,14 @@ class CompletionHandler(BaseHTTPRequestHandler):
     def _final(self, sr):
         out = {"id": sr.rid, "state": sr.state,
                "tokens": sr.output, "n": len(sr.req.output),
-               "trace_id": sr.trace_id}
+               "trace_id": sr.trace_id,
+               # OpenAI-style usage block; cached_tokens is the prompt
+               # prefix served from the KV cache instead of prefill
+               "usage": {
+                   "prompt_tokens": len(sr.req.prompt),
+                   "completion_tokens": len(sr.req.output),
+                   "cached_tokens":
+                       int(getattr(sr.req, "cached_tokens", 0) or 0)}}
         if sr.req.logprobs is not None:
             out["logprobs"] = sr.req.logprobs
         return out

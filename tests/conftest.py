@@ -29,3 +29,20 @@ def _seed():
     pt.seed(42)
     np.random.seed(42)
     yield
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_compile_caches():
+    """Release each module's compiled executables when it finishes.
+
+    This jaxlib's CPU backend_compile segfaults deterministically once
+    enough LoadedExecutables have accumulated in one process (the full
+    suite used to die mid-run in whatever module crossed the threshold
+    — the faulthandler stack bottoms out in XLA's LLVM JIT). Modules
+    rarely share jit cache entries, so dropping the caches between
+    modules costs almost nothing and keeps the resident-executable
+    count bounded."""
+    yield
+    import gc
+    jax.clear_caches()
+    gc.collect()

@@ -25,6 +25,7 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     bm = _load_bench_models()
     monkeypatch.setenv("PT_SERVE_SPEC", "4")
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
+    monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "ngram-repetitive"
     assert out["spec_accept_rate"] > 0, out
@@ -76,11 +77,29 @@ def test_serving_load_bench_structure(monkeypatch):
     assert out["requests"] == 6
 
 
+def test_prefix_bench_reuses_cached_pages(monkeypatch):
+    """PT_SERVE_PREFIX=1: every prompt shares one long header — the
+    bench artifact must show the prefix cache actually engaging
+    (nonzero hit rate and reused tokens), not just carry the fields."""
+    bm = _load_bench_models()
+    monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
+    monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
+    monkeypatch.setenv("PT_SERVE_PREFIX", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "shared-prefix"
+    assert out["prefix_hit_rate"] > 0, out
+    assert out["tokens_reused"] > 0, out
+    assert out["prefix_evictions"] >= 0
+    _assert_metrics_snapshot(out)
+
+
 def test_plain_bench_unaffected(monkeypatch):
     bm = _load_bench_models()
     monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
+    monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["decode_tokens_per_sec"] > 0
     assert "spec_decode" not in out
+    assert "prefix_hit_rate" not in out
     _assert_metrics_snapshot(out)

@@ -1,0 +1,477 @@
+"""Prefix KV cache (serving/kvcache.py): ref-counted page sharing,
+radix longest-prefix lookup, LRU eviction, and suffix-only prefill —
+unit invariants plus engine/HTTP end-to-end.
+
+Invariants under test (ISSUE 5):
+  * refcounts never go negative (double release is a hard error);
+  * the trash page is never indexed, cached, or evicted;
+  * free + cached(rc==0) + live == num_pages - 1 at every step;
+  * eviction order is LRU (and children before their prefixes);
+  * a hash collision on a block falls back to no-reuse, never wrong KV.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models import llama_spmd as M
+from paddle_tpu.models.llama_serving import ServingEngine, Request
+from paddle_tpu.serving.kvcache import PagePool, PrefixCache
+
+CFG = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                       ffn=64, seq=128)
+
+# a 2-page (16-token at page_size=8) shared prefix — the acceptance
+# scenario: system-prompt header + per-request tails
+PREFIX = list(range(1, 17))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(CFG, seed=0, dtype=jnp.float32)
+
+
+def greedy_reference(params, prompt, n_new):
+    ids = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = M.forward(params, jnp.asarray([ids]), CFG, mesh=None,
+                           remat=False)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+def make_engine(params, **kw):
+    kw.setdefault("max_seqs", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("use_pallas", False)
+    kw.setdefault("prefix_cache", True)
+    return ServingEngine(params, CFG, **kw)
+
+
+def assert_conserved(eng):
+    c = eng.pool.counts()
+    assert c["free"] + c["cached"] + c["live"] == eng.num_pages - 1, c
+
+
+class TestPagePool:
+    def test_alloc_decref_free_cycle(self):
+        pool = PagePool(4)
+        pages = pool.alloc(3)
+        assert sorted(pages) == [0, 1, 2] and len(pool.free) == 1
+        pool.decref(pages)
+        assert sorted(pool.free) == [0, 1, 2, 3]
+
+    def test_refcount_never_negative(self):
+        pool = PagePool(2)
+        (pg,) = pool.alloc(1)
+        pool.decref([pg])
+        with pytest.raises(RuntimeError, match="refcount underflow"):
+            pool.decref([pg])
+
+    def test_shared_page_needs_every_holder_to_release(self):
+        pool = PagePool(3)
+        (pg,) = pool.alloc(1)
+        pool.incref([pg])
+        assert pool.refcount[pg] == 2
+        pool.decref([pg])
+        assert pool.refcount[pg] == 1 and pg not in pool.free
+        pool.decref([pg])
+        assert pg in pool.free
+
+    def test_out_of_pages_raises_before_mutation(self):
+        pool = PagePool(2)
+        pool.alloc(2)
+        with pytest.raises(RuntimeError, match="out of KV pages"):
+            pool.alloc(1)
+        assert pool.counts() == {"free": 0, "cached": 0, "live": 2}
+
+
+class TestPrefixCache:
+    def _pool_cache(self, n=8, ps=4):
+        cache = PrefixCache(ps)
+        return PagePool(n, cache=cache), cache
+
+    def test_match_is_capped_below_the_full_prompt(self):
+        pool, cache = self._pool_cache(ps=2)
+        pages = pool.alloc(2)
+        cache.insert([1, 2, 3, 4], pages, 4)
+        # all 4 tokens indexed, but a 4-token lookup may match at most
+        # 1 block: the engine must always prefill >= 1 suffix token
+        assert cache.match([1, 2, 3, 4]) == (pages[:1], 2)
+        assert cache.match([1, 2, 3, 4, 5]) == (pages, 4)
+
+    def test_partial_page_tail_never_matches(self):
+        pool, cache = self._pool_cache(ps=4)
+        pages = pool.alloc(2)
+        cache.insert([1, 2, 3, 4, 5, 6], pages, 6)  # block 1 partial
+        assert cache.match([1, 2, 3, 4, 5, 6, 7, 8, 9]) == (pages[:1], 4)
+
+    def test_lru_eviction_order_children_first(self):
+        pool, cache = self._pool_cache(n=4, ps=2)
+        pages = pool.alloc(2)
+        cache.insert([1, 2, 3, 4], pages, 4)
+        # release tail-first (as the engine does): the deepest block
+        # parks least-recently-used and is reclaimed first, so a
+        # surviving parent stays useful for lookups
+        pool.decref(reversed(pages))
+        assert cache.evict_lru() == pages[1]
+        assert cache.match([1, 2, 9]) == (pages[:1], 2)  # parent intact
+        assert cache.evict_lru() == pages[0]
+        assert cache.match([1, 2, 9]) == ([], 0)
+        assert cache.evictions == 2
+
+    def test_lru_revival_on_reuse(self):
+        pool, cache = self._pool_cache(n=6, ps=2)
+        a = pool.alloc(1)
+        cache.insert([1, 2], a, 2)
+        b = pool.alloc(1)
+        cache.insert([7, 8], b, 2)
+        pool.decref(a)
+        pool.decref(b)              # LRU order: a then b
+        pool.incref(a)              # a revived (shared again)
+        assert cache.cached_pages == 1
+        assert cache.evict_lru() == b[0]  # a is NOT reclaimable
+
+    def test_collision_falls_back_to_no_reuse(self, monkeypatch):
+        from paddle_tpu.serving import kvcache as K
+        monkeypatch.setattr(K, "block_hash", lambda parent, block: 7)
+        pool, cache = self._pool_cache(ps=2)
+        p1 = pool.alloc(1)
+        cache.insert([1, 2], p1, 2)
+        # different block, same (constant) hash: raw-token verification
+        # must refuse the entry — no reuse, never wrong KV
+        assert cache.match([3, 4, 9]) == ([], 0)
+        # and inserting the colliding block leaves the original intact
+        p2 = pool.alloc(1)
+        cache.insert([3, 4], p2, 2)
+        assert cache.match([1, 2, 9]) == (p1, 2)
+        assert cache.match([3, 4, 9]) == ([], 0)
+
+    def test_one_key_per_page(self):
+        pool, cache = self._pool_cache(ps=2)
+        pages = pool.alloc(1)
+        cache.insert([1, 2], pages, 2)
+        # the same physical page can never serve a second chain slot
+        cache.insert([5, 6], pages, 2)
+        assert cache.match([5, 6, 9]) == ([], 0)
+        assert cache.match([1, 2, 9]) == (pages, 2)
+
+
+class TestEngineInvariants:
+    def test_trash_page_never_indexed_or_evicted(self, params):
+        eng = make_engine(params, max_seqs=2, max_seq_len=32, num_pages=9)
+        trash = eng.num_pages - 1
+        rng = np.random.RandomState(3)
+        # each request parks one distinct full page (plus the shared
+        # head) — 8 requests overflow the 8-page pool and force
+        # evictions through the alloc path
+        for i in range(8):
+            p = PREFIX[:10] + list(map(int, rng.randint(1, 64, 8)))
+            eng.submit(Request(f"r{i}", p, max_new_tokens=4))
+            eng.run()
+        pc = eng.prefix_cache
+        assert pc.evictions > 0          # pressure actually churned
+        assert trash not in pc._page_key and trash not in pc._lru
+        assert trash not in eng.pool.free
+        assert all(e[0] != trash for e in pc.entries.values())
+
+    def test_conservation_every_step(self, params):
+        eng = make_engine(params, max_seqs=2, max_seq_len=32, num_pages=9)
+        rng = np.random.RandomState(4)
+        for i in range(4):
+            p = PREFIX[:8] + list(map(int, rng.randint(1, 64, 6)))
+            eng.submit(Request(f"r{i}", p, max_new_tokens=6))
+        steps = 0
+        while eng.step():
+            assert_conserved(eng)
+            steps += 1
+            assert steps < 300
+        assert len(eng.finished) == 4
+        assert_conserved(eng)
+
+    def test_eviction_under_pressure_keeps_admission_live(self, params):
+        """Acceptance: with the cache full of rc==0 pages, new DISTINCT
+        prompts must still admit — allocation reclaims the LRU before
+        the pool is declared empty."""
+        eng = make_engine(params, max_seqs=2, max_seq_len=32, num_pages=9)
+        rng = np.random.RandomState(5)
+        for i in range(8):
+            p = list(map(int, rng.randint(1, 64, 17)))
+            expect = greedy_reference(params, p, 4)
+            eng.submit(Request(f"r{i}", p, max_new_tokens=4))
+            done = eng.run(max_steps=200)
+            assert done[-1].output == expect, f"r{i} diverged"
+            assert_conserved(eng)
+        assert eng.prefix_cache.evictions > 0
+        assert len(eng.finished) == 8
+
+
+class TestPrefixReuse:
+    def test_second_request_prefills_only_suffix(self, params):
+        """Acceptance e2e: two requests share a 2-page prefix — the
+        second's prefill runs ONLY the suffix (prefill-token
+        accounting + the kvcache.hit flight record prove it, and the
+        dense prefill entry points are never called), with output
+        token-identical to a cold engine."""
+        from paddle_tpu.observability import flight_recorder as _flight
+        from paddle_tpu.models import llama_serving as S
+        p1, p2 = PREFIX + [20, 21], PREFIX + [30, 31, 32]
+        ref = greedy_reference(params, p2, 6)
+        eng = make_engine(params)
+        eng.submit(Request("a", p1, max_new_tokens=6))
+        eng.run()
+        pt0 = eng.prefill_tokens
+        calls = {"n": 0}
+        orig_v, orig_s = S.prefill_varlen, S.prefill
+
+        def spy(orig):
+            def run(*a, **k):
+                calls["n"] += 1
+                return orig(*a, **k)
+            return run
+
+        S.prefill_varlen, S.prefill = spy(orig_v), spy(orig_s)
+        try:
+            eng.submit(Request("b", p2, max_new_tokens=6))
+            eng.run()
+        finally:
+            S.prefill_varlen, S.prefill = orig_v, orig_s
+        out = {r.rid: r for r in eng.finished}
+        assert out["b"].output == ref
+        assert out["b"].cached_tokens == len(PREFIX)
+        # only the 3-token suffix went through prefill compute,
+        # and not through the dense prefill fns at all
+        assert eng.prefill_tokens - pt0 == len(p2) - len(PREFIX)
+        assert calls["n"] == 0
+        hits = [e for e in _flight.RECORDER.events("kvcache.hit")
+                if e.get("rid") == "b"]
+        assert hits and hits[-1]["cached_tokens"] == len(PREFIX)
+
+    def test_cache_on_equals_cache_off(self, params):
+        """Token-identical outputs across a mixed shared-prefix
+        workload with the cache on vs off (suffix prefill through the
+        verify kernel vs monolithic dense prefill)."""
+        rng = np.random.RandomState(6)
+        prompts = [PREFIX + list(map(int, rng.randint(1, 64, n)))
+                   for n in (2, 3, 5, 1)]
+        outs = {}
+        for tag in (False, True):
+            eng = make_engine(params, prefix_cache=tag)
+            for i, p in enumerate(prompts):
+                eng.submit(Request(f"r{i}", p, max_new_tokens=6))
+            done = eng.run(max_steps=300)
+            outs[tag] = {r.rid: r.output for r in done}
+        assert outs[True] == outs[False]
+
+    def test_live_sharing_refcounts(self, params):
+        """A second request admitted while the first is still decoding
+        maps the SAME physical pages (rc==2); both finish exactly."""
+        p1, p2 = PREFIX + [20, 21], PREFIX + [30, 31, 32]
+        r1, r2 = (greedy_reference(params, p, 10) for p in (p1, p2))
+        eng = make_engine(params)
+        eng.submit(Request("a", p1, max_new_tokens=10))
+        for _ in range(3):
+            eng.step()
+        eng.submit(Request("b", p2, max_new_tokens=10))
+        eng.step()
+        sa = next(s for s, r in enumerate(eng._slots)
+                  if r is not None and r.rid == "a")
+        sb = next(s for s, r in enumerate(eng._slots)
+                  if r is not None and r.rid == "b")
+        shared = eng._seq_pages[sa][:2]
+        assert eng._seq_pages[sb][:2] == shared
+        assert all(eng.pool.refcount[p] == 2 for p in shared)
+        assert_conserved(eng)
+        done = eng.run()
+        out = {r.rid: r.output for r in done}
+        assert out["a"] == r1 and out["b"] == r2
+        assert_conserved(eng)
+
+    def test_sampled_request_reuses_prefix(self, params):
+        """Seeded sampling over a cached prefix matches the same seed
+        on a cold engine (the prefix KV is shared bit-identically)."""
+        p1, p2 = PREFIX + [20, 21], PREFIX + [30, 31]
+        outs = []
+        for cache in (False, True):
+            eng = make_engine(params, prefix_cache=cache)
+            eng.submit(Request("a", p1, max_new_tokens=6))
+            eng.run()
+            eng.submit(Request("s", p2, max_new_tokens=8,
+                               temperature=0.8, top_k=8, seed=123))
+            eng.run()
+            outs.append({r.rid: r.output for r in eng.finished})
+        assert outs[0] == outs[1]
+        assert_conserved(eng)
+
+    @pytest.mark.parametrize("kw", [
+        {"spec_decode": 4},
+        {"spec_decode": 4, "chunked_prefill": True},
+        {"cache_dtype": "int8"},
+        {"cache_dtype": "int8", "spec_decode": 4},
+    ], ids=["spec", "chunked", "int8", "int8-spec"])
+    def test_feature_compositions_stay_exact(self, params, kw):
+        p1, p2 = PREFIX + [20, 21], PREFIX + [30, 31, 32]
+        r1, r2 = (greedy_reference(params, p, 6) for p in (p1, p2))
+        eng = make_engine(params, **kw)
+        eng.submit(Request("a", p1, max_new_tokens=6))
+        eng.run()
+        eng.submit(Request("b", p2, max_new_tokens=6))
+        eng.run()
+        out = {r.rid: r.output for r in eng.finished}
+        assert out["a"] == r1 and out["b"] == r2
+        assert eng.prefix_cache.hits >= 1
+        assert_conserved(eng)
+
+    def test_chunked_prefill_feeds_only_the_suffix(self, params):
+        """Under chunked prefill a cache hit starts the chunk cursor at
+        the first uncached token — prefill_tokens counts the suffix."""
+        p1 = PREFIX + [20, 21]
+        p2 = PREFIX + list(range(30, 45))    # long uncached tail
+        ref = greedy_reference(params, p2, 5)
+        eng = make_engine(params, spec_decode=4, chunked_prefill=True)
+        eng.submit(Request("a", p1, max_new_tokens=5))
+        eng.run()
+        pt0 = eng.prefill_tokens
+        eng.submit(Request("b", p2, max_new_tokens=5))
+        eng.run()
+        out = {r.rid: r for r in eng.finished}
+        assert out["b"].output == ref
+        assert eng.prefill_tokens - pt0 == len(p2) - len(PREFIX)
+        assert out["b"].cached_tokens == len(PREFIX)
+
+    @pytest.mark.slow
+    def test_preemption_with_shared_pages(self, params):
+        """Oversubscribed pool + prefix cache: eviction/offload of
+        slots holding shared pages keeps outputs exact and the pool
+        balanced."""
+        pa, pb = [1, 5, 9, 3], [2, 6, 4, 8]
+        ra, rb = (greedy_reference(params, p, 24) for p in (pa, pb))
+        eng = make_engine(params, max_seqs=2, max_seq_len=32, num_pages=7)
+        eng.submit(Request("a", pa, max_new_tokens=24))
+        eng.submit(Request("b", pb, max_new_tokens=24))
+        done = eng.run(max_steps=500)
+        out = {r.rid: r.output for r in done}
+        assert out["a"] == ra and out["b"] == rb
+        assert eng.preemptions > 0
+        assert_conserved(eng)
+
+    @pytest.mark.slow
+    def test_recompute_resume_reuses_own_pages(self, params):
+        """A recompute-preempted victim's pages are indexed at
+        release, so its resume matches its OWN prefix and re-prefills
+        only the suffix — outputs stay exact, greedy and seeded."""
+        pa, pb = [1, 5, 9, 3], [2, 6, 4, 8]
+        ra, rb = (greedy_reference(params, p, 24) for p in (pa, pb))
+        eng = make_engine(params, max_seqs=2, max_seq_len=32,
+                          num_pages=7, preempt_policy="recompute")
+        eng.submit(Request("a", pa, max_new_tokens=24))
+        eng.submit(Request("b", pb, max_new_tokens=24))
+        done = eng.run(max_steps=500)
+        out = {r.rid: r.output for r in done}
+        assert out["a"] == ra and out["b"] == rb
+        assert eng.preemptions > 0
+        assert eng.prefix_cache.hits >= 1   # resume hit its own prefix
+        assert_conserved(eng)
+        # seeded sampling across recompute+cache resume: no re-sampling
+        eng2 = make_engine(params, max_seqs=2, max_seq_len=32,
+                           num_pages=7, preempt_policy="recompute")
+        ref_eng = make_engine(params, max_seqs=2, max_seq_len=32,
+                              prefix_cache=False)
+        for e in (eng2, ref_eng):
+            e.submit(Request("s", [3, 7, 2, 9], max_new_tokens=20,
+                             temperature=0.8, top_k=8, seed=123))
+            e.submit(Request("g", [1, 4, 6, 2], max_new_tokens=20))
+        o2 = {r.rid: r.output for r in eng2.run(max_steps=500)}
+        oref = {r.rid: r.output for r in ref_eng.run(max_steps=500)}
+        assert o2 == oref
+
+    def test_fully_cached_prompt_still_prefills_one_token(self, params):
+        """A prompt that is entirely full cached pages must still run
+        >= 1 suffix token (the engine needs next-token logits)."""
+        p = PREFIX + list(range(17, 25))     # 24 tokens = 3 full pages
+        ref = greedy_reference(params, p, 4)
+        eng = make_engine(params)
+        eng.submit(Request("a", p, max_new_tokens=4))
+        eng.run()
+        pt0 = eng.prefill_tokens
+        eng.submit(Request("b", list(p), max_new_tokens=4))
+        done = eng.run()
+        out = {r.rid: r for r in eng.finished}
+        assert out["b"].output == ref
+        # match capped at 2 of 3 full pages -> 8-token suffix
+        assert out["b"].cached_tokens == 16
+        assert eng.prefill_tokens - pt0 == 8
+
+
+class TestPrefixTensorParallel:
+    def test_tp2_prefix_cache_matches_single_device(self, params):
+        """Suffix prefill rides the same shard_map verify path as spec
+        decode — the tp-sharded engine with a cache hit stays
+        token-exact vs the unsharded engine."""
+        import jax
+        from jax.sharding import Mesh
+        p1, p2 = PREFIX + [20, 21], PREFIX + [30, 31, 32]
+
+        def run(mesh):
+            eng = make_engine(params, mesh=mesh)
+            eng.submit(Request("a", p1, max_new_tokens=8))
+            eng.run()
+            eng.submit(Request("b", p2, max_new_tokens=8))
+            eng.run()
+            assert eng.prefix_cache.hits >= 1
+            return {r.rid: r.output for r in eng.finished}
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("tp",))
+        assert run(None) == run(mesh)
+
+
+class TestPrefixHTTP:
+    def test_usage_block_and_metrics_endpoint(self, params):
+        """Acceptance e2e over HTTP: the second completion reports
+        cached_tokens in its usage block and /metrics shows a nonzero
+        pt_prefix_hit_rate."""
+        from paddle_tpu.serving import ServingClient, ServingServer
+        p1, p2 = PREFIX + [20, 21], PREFIX + [30, 31, 32]
+        eng = make_engine(params)
+        srv = ServingServer(eng, port=0).start()
+        try:
+            c = ServingClient(port=srv.port)
+            r1 = c.complete(p1, max_tokens=6)
+            assert r1["usage"] == {"prompt_tokens": len(p1),
+                                   "completion_tokens": 6,
+                                   "cached_tokens": 0}
+            r2 = c.complete(p2, max_tokens=6)
+            assert r2["usage"]["cached_tokens"] == len(PREFIX)
+            assert r2["usage"]["prompt_tokens"] == len(p2)
+            text = c.metrics_text()
+            rate = [l for l in text.splitlines()
+                    if l.startswith("pt_prefix_hit_rate ")]
+            assert rate and float(rate[0].split()[1]) > 0
+            snap = c.metrics()
+            assert snap["pt_prefix_tokens_reused"]["value"] == len(PREFIX)
+            assert snap["pt_prefix_hits"]["value"] == 1
+            # healthz surfaces the cache ledger
+            h = c.healthz()
+            assert h["prefix_cache"]["hits"] == 1
+        finally:
+            srv.stop(drain=True, timeout=30)
+
+    def test_streaming_final_event_carries_usage(self, params):
+        from paddle_tpu.serving import ServingClient, ServingServer
+        p1, p2 = PREFIX + [20, 21], PREFIX + [30, 31, 32]
+        eng = make_engine(params)
+        srv = ServingServer(eng, port=0).start()
+        try:
+            c = ServingClient(port=srv.port)
+            c.complete(p1, max_tokens=4)
+            events = list(c.stream_complete(p2, max_tokens=4))
+            final = events[-1]
+            assert final.get("done") is True
+            assert final["usage"]["cached_tokens"] == len(PREFIX)
+            assert final["usage"]["completion_tokens"] == 4
+        finally:
+            srv.stop(drain=True, timeout=30)

@@ -315,6 +315,129 @@ class TestHTTPServer:
             cl.healthz()
 
 
+class TestUsageBlock:
+    """OpenAI-style usage accounting on /v1/completions (blocking and
+    the final SSE event): prompt/completion/cached token counts."""
+
+    def test_blocking_response_usage(self, params):
+        eng = make_engine(params)
+        srv = ServingServer(eng, port=0).start()
+        try:
+            cl = ServingClient(port=srv.port)
+            out = cl.complete([1, 5, 9, 3, 7], max_tokens=6)
+            assert out["usage"] == {"prompt_tokens": 5,
+                                    "completion_tokens": 6,
+                                    "cached_tokens": 0}
+        finally:
+            srv.stop(drain=True, timeout=30)
+
+    def test_streaming_final_event_usage(self, params):
+        eng = make_engine(params)
+        srv = ServingServer(eng, port=0).start()
+        try:
+            cl = ServingClient(port=srv.port)
+            events = list(cl.stream_complete([2, 4, 6], max_tokens=5))
+            u = events[-1]["usage"]
+            assert u["prompt_tokens"] == 3
+            assert u["completion_tokens"] == 5 == len(events[-1]["tokens"])
+            assert u["cached_tokens"] == 0   # prefix cache off by default
+        finally:
+            srv.stop(drain=True, timeout=30)
+
+
+class TestClientRetries:
+    """Opt-in bounded retry on 429 backpressure, honoring the server's
+    Retry-After hint (BackpressureError.retry_after_s) with jitter."""
+
+    def _flaky(self, client, fail, retry_after=2.0):
+        calls = {"n": 0}
+
+        def fn(method, path, body=None):
+            calls["n"] += 1
+            if calls["n"] <= fail:
+                raise ServingHTTPError(429, {"error": "queue full"},
+                                       retry_after_s=retry_after)
+            return {"ok": True, "calls": calls["n"]}
+        client._json_call = fn
+        return calls
+
+    def test_retries_sleep_out_retry_after_with_jitter(self, monkeypatch):
+        from paddle_tpu.serving import client as C
+        sleeps = []
+        monkeypatch.setattr(C.time, "sleep", sleeps.append)
+        cl = ServingClient(retries=3)
+        calls = self._flaky(cl, fail=2, retry_after=2.0)
+        assert cl.complete([1, 2])["ok"] is True
+        assert calls["n"] == 3 and len(sleeps) == 2
+        # hint * jittered factor in [0.5, 1.5)
+        assert all(1.0 <= s < 3.0 for s in sleeps), sleeps
+
+    def test_retry_cap_bounds_server_hint(self, monkeypatch):
+        from paddle_tpu.serving import client as C
+        sleeps = []
+        monkeypatch.setattr(C.time, "sleep", sleeps.append)
+        cl = ServingClient(retries=1, retry_cap_s=0.5)
+        self._flaky(cl, fail=1, retry_after=60.0)
+        cl.complete([1, 2])
+        assert sleeps and all(s < 0.75 for s in sleeps)
+
+    def test_retries_exhausted_reraises(self, monkeypatch):
+        from paddle_tpu.serving import client as C
+        monkeypatch.setattr(C.time, "sleep", lambda s: None)
+        cl = ServingClient(retries=2)
+        calls = self._flaky(cl, fail=99)
+        with pytest.raises(ServingHTTPError) as ei:
+            cl.complete([1, 2])
+        assert ei.value.status == 429 and calls["n"] == 3
+
+    def test_default_is_raise_immediately(self):
+        cl = ServingClient()      # retries=0
+        calls = self._flaky(cl, fail=99)
+        with pytest.raises(ServingHTTPError):
+            cl.complete([1, 2])
+        assert calls["n"] == 1
+
+    def test_non_429_never_retried(self, monkeypatch):
+        from paddle_tpu.serving import client as C
+        monkeypatch.setattr(C.time, "sleep", lambda s: None)
+        cl = ServingClient(retries=5)
+        calls = {"n": 0}
+
+        def fn(method, path, body=None):
+            calls["n"] += 1
+            raise ServingHTTPError(503, {"error": "draining"})
+        cl._json_call = fn
+        with pytest.raises(ServingHTTPError):
+            cl.complete([1, 2])
+        assert calls["n"] == 1
+
+    def test_real_server_hint_parsed(self, params):
+        """A real 429 carries Retry-After; the client surfaces it as
+        retry_after_s on the error (what the retry loop sleeps on)."""
+        eng = make_engine(params)
+        srv = ServingServer(eng, port=0, max_queue=1).start()
+        srv.scheduler.pause()
+        cl = ServingClient(port=srv.port)
+        streams = []
+        try:
+            s = cl.stream_complete([1, 2, 3], max_tokens=4)
+            streams.append(s)
+            threading.Thread(target=lambda: next(s, None),
+                             daemon=True).start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    srv.scheduler.stats()["queued"] < 1:
+                time.sleep(0.01)
+            with pytest.raises(ServingHTTPError) as ei:
+                cl.complete([9, 9, 9], max_tokens=4)
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s is not None
+            assert ei.value.retry_after_s >= 1.0
+        finally:
+            srv.scheduler.resume()
+            srv.stop(drain=False, timeout=30)
+
+
 class TestMetricsRegistry:
     def test_counter_gauge_histogram_and_render(self):
         r = MetricsRegistry()
