@@ -186,6 +186,11 @@ def bench_serving(on_tpu):
     # after the first map the header's pages and prefill only the tail
     prefix_mode = (os.environ.get("PT_SERVE_PREFIX", "") or "0") \
         not in ("", "0")
+    # PT_SERVE_ROUTER=1: scale-out tier — a prefix-affinity router over
+    # 2 engine replicas vs ONE engine at equal total capacity, on a
+    # shared-system-prompt workload (serving/router.py)
+    if (os.environ.get("PT_SERVE_ROUTER", "") or "0") not in ("", "0"):
+        return _bench_serving_router(on_tpu, params, cfg, dtype)
 
     rng = _data_rng()
     if prefix_mode:
@@ -346,6 +351,113 @@ def bench_serving(on_tpu):
         out["plain_device_steps"] = peng.device_steps
         out["plain_decode_tokens_per_sec"] = round(ptotal / pdt, 1)
         out["spec_speedup"] = round((total_new / dt) / (ptotal / pdt), 3)
+    return out
+
+
+def _bench_serving_router(on_tpu, params, cfg, dtype):
+    """PT_SERVE_ROUTER=1: the scale-out serving tier. Two independent
+    engine replicas (own KV pool + prefix cache + scheduler pump each)
+    behind the prefix-affinity Router serve a shared-system-prompt
+    workload (G prompt groups, each group one hot header + distinct
+    tails); the comparison point is ONE engine at equal total capacity
+    (2x the slots and pages) on the identical prompts. The artifact
+    carries the router ledger (dispatches / affinity hit rate / spills
+    / failovers), aggregate tokens/sec for both topologies, and the
+    per-replica balance + prefix-hit-rate the affinity routing is
+    supposed to produce."""
+    from paddle_tpu.models.llama_serving import Request, ServingEngine
+    from paddle_tpu.serving import Router, build_replicas
+
+    if on_tpu:
+        per_seqs, groups, per_group, new_tok = 4, 8, 6, 64
+        max_seq_len, page, tail = 1024, 16, 16
+    else:
+        per_seqs, groups, per_group, new_tok = 2, 4, 3, 8
+        max_seq_len, page, tail = 64, 8, 4
+    rng = _data_rng()
+    headers = [list(map(int, rng.randint(1, cfg.vocab_size, 2 * page)))
+               for _ in range(groups)]
+    prompts = [h + list(map(int, rng.randint(1, cfg.vocab_size, tail)))
+               for h in headers for _ in range(per_group)]
+
+    def factory(i):
+        return ServingEngine(params, cfg, max_seqs=per_seqs,
+                             max_seq_len=max_seq_len, page_size=page,
+                             dtype=dtype, prefix_cache=True,
+                             use_pallas=None if on_tpu else False)
+
+    def run_router(warm=True):
+        if warm:
+            run_router(warm=False)   # compile cache warm, same shapes
+        router = Router(build_replicas(factory, 2,
+                                       max_queue=len(prompts)))
+        nt = new_tok if warm else 2
+        t0 = time.perf_counter()
+        handles = [router.submit(p, max_new_tokens=nt) for p in prompts]
+        outs = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+        if not warm:
+            router.shutdown(drain=True, timeout=60)
+        return router, outs, dt
+
+    def run_single(warm=True):
+        if warm:
+            run_single(warm=False)
+        eng = ServingEngine(params, cfg, max_seqs=2 * per_seqs,
+                            max_seq_len=max_seq_len, page_size=page,
+                            dtype=dtype, prefix_cache=True,
+                            use_pallas=None if on_tpu else False)
+        nt = new_tok if warm else 2
+        for i, p in enumerate(prompts):
+            eng.submit(Request(f"s{i}", p, max_new_tokens=nt))
+        t0 = time.perf_counter()
+        done = eng.run()
+        return eng, done, time.perf_counter() - t0
+
+    router, outs, rdt = run_router()
+    seng, sdone, sdt = run_single()
+    total = sum(len(o) for o in outs)
+    stotal = sum(len(r.output) for r in sdone)
+    rstats = router.stats()
+    per_replica = {}
+    n_disp = max(int(router.dispatches.value), 1)
+    for rid in router.replica_ids:
+        rep = router.replica(rid)
+        snap = rep.registry.snapshot()
+        rs = rstats["replicas"][rid]
+        per_replica[rid] = {
+            "dispatches": rs["dispatches"],
+            "share": round(rs["dispatches"] / n_disp, 3),
+            "prefix_hit_rate":
+                round(snap["pt_prefix_hit_rate"]["value"], 3),
+            "generated_tokens":
+                int(snap["pt_serving_generated_tokens"]["value"]),
+            "requests": rs["requests"],
+        }
+    shares = [v["share"] for v in per_replica.values()]
+    out = {
+        "workload": "router-shared-prefix",
+        "replicas": 2, "requests": len(prompts),
+        "groups": groups, "new_tokens": total,
+        "router_dispatches": int(router.dispatches.value),
+        "affinity_hit_rate": round(
+            router.affinity_hits.value / n_disp, 3),
+        "spills": int(router.spills.value),
+        "failovers": int(router.failovers.value),
+        # balance: smallest/largest replica share of dispatches (1.0 =
+        # perfectly even; group->replica placement is consistent-hash,
+        # so skew reflects the key distribution, not a bug)
+        "replica_balance": round(min(shares) / max(shares), 3)
+        if max(shares) > 0 else 0.0,
+        "per_replica": per_replica,
+        "aggregate_tokens_per_sec": round(total / rdt, 1),
+        "single_engine_tokens_per_sec": round(stotal / sdt, 1),
+        "router_speedup": round((total / rdt) / (stotal / sdt), 3),
+        "single_engine_prefix_hit_rate":
+            round(seng.prefix_cache.hit_rate, 3),
+        "loss": 0.0,
+    }
+    router.shutdown(drain=True, timeout=60)
     return out
 
 

@@ -52,19 +52,37 @@ def main():
     ap.add_argument("--prefix-cache", action="store_true",
                     help="share KV pages across prompts with a common "
                          "prefix; admissions prefill only their suffix")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="N>1: router mode — N independent engine "
+                         "replicas behind the prefix-affinity router "
+                         "(health-aware failover, per-replica /metrics "
+                         "labels); implies --prefix-cache per replica")
     args = ap.parse_args()
 
     cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=8,
                            kv_heads=4, ffn=256, seq=256)
     params = M.init_params(cfg, seed=0)
-    eng = ServingEngine(
-        params, cfg, max_seqs=args.max_seqs, max_seq_len=256,
-        page_size=16, cache_dtype="int8" if args.cache == "int8" else None,
-        spec_decode=args.spec, prefix_cache=args.prefix_cache)
-    sched = RequestScheduler(eng, max_queue=args.max_queue)
+
+    def make_engine(_i=0):
+        return ServingEngine(
+            params, cfg, max_seqs=args.max_seqs, max_seq_len=256,
+            page_size=16,
+            cache_dtype="int8" if args.cache == "int8" else None,
+            spec_decode=args.spec,
+            prefix_cache=args.prefix_cache or args.replicas > 1)
+
+    if args.replicas > 1:
+        from paddle_tpu.serving import Router, build_replicas
+        sched = Router(build_replicas(make_engine, args.replicas,
+                                      max_queue=args.max_queue))
+        mode = f"router x{args.replicas} replicas"
+    else:
+        sched = RequestScheduler(make_engine(), max_queue=args.max_queue)
+        mode = "single engine"
     srv = ServingServer(sched, host=args.host, port=args.port).start()
-    print(f"serving on {srv.url}  "
-          f"(POST /v1/completions, GET /healthz, GET /metrics)")
+    print(f"serving on {srv.url} [{mode}]  "
+          f"(POST /v1/completions, GET /healthz, GET /readyz, "
+          f"GET /metrics)")
 
     if args.forever:
         try:
@@ -105,13 +123,31 @@ def main():
         t.join()
 
     snap = cl.metrics()
-    ttft = snap["pt_serving_ttft_seconds"]
-    print(f"metrics: {int(snap['pt_serving_requests_completed']['value'])}"
-          f" completed, ttft p50 {ttft['p50'] * 1e3:.1f} ms"
-          f" p99 {ttft['p99'] * 1e3:.1f} ms, queue peak"
-          f" {int(snap['pt_serving_queue_depth_peak']['value'])},"
-          f" device steps"
-          f" {int(snap['pt_serving_device_steps']['value'])}")
+    if args.replicas > 1:
+        # router mode: per-replica snapshots ride under "replicas";
+        # the router's own ledger is flat
+        done = sum(int(s["pt_serving_requests_completed"]["value"])
+                   for s in snap["replicas"].values())
+        print(f"metrics: {done} completed over "
+              f"{len(snap['replicas'])} replicas, "
+              f"{int(snap['pt_router_dispatches']['value'])} dispatches"
+              f" ({int(snap['pt_router_affinity_hits']['value'])}"
+              f" affinity, {int(snap['pt_router_spills']['value'])}"
+              f" spills, {int(snap['pt_router_failovers']['value'])}"
+              f" failovers)")
+        for rid, s in snap["replicas"].items():
+            print(f"  {rid}: {int(s['pt_serving_requests_completed']['value'])}"
+                  f" completed, prefix hit rate"
+                  f" {s['pt_prefix_hit_rate']['value']:.2f}")
+    else:
+        ttft = snap["pt_serving_ttft_seconds"]
+        print(f"metrics: "
+              f"{int(snap['pt_serving_requests_completed']['value'])}"
+              f" completed, ttft p50 {ttft['p50'] * 1e3:.1f} ms"
+              f" p99 {ttft['p99'] * 1e3:.1f} ms, queue peak"
+              f" {int(snap['pt_serving_queue_depth_peak']['value'])},"
+              f" device steps"
+              f" {int(snap['pt_serving_device_steps']['value'])}")
     print("graceful stop:", srv.stop(drain=True, timeout=30))
 
 

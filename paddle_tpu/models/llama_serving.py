@@ -633,7 +633,10 @@ class ServingEngine:
         # dispatches per step measured on CPU; on TPU each is a tunnel
         # latency) — the whole tables are a few hundred bytes, so one
         # jnp.asarray per step is strictly cheaper
-        self.page_table = np.zeros((max_seqs, self.pages_per_seq), np.int32)
+        # unassigned entries point at the trash page, never page 0: a
+        # stale or default row must alias a page no live slot reads
+        self.page_table = np.full((max_seqs, self.pages_per_seq),
+                                  self.num_pages - 1, np.int32)
         self.lengths = np.zeros((max_seqs,), np.int32)
         if self._mesh is not None:
             self.k_pool = jax.device_put(self.k_pool, self._pool_sharding)
@@ -1181,11 +1184,19 @@ class ServingEngine:
         active = np.zeros((self.max_seqs,), bool)
         active[active_slots] = True
         self.lengths = np.where(active, self.lengths + 1, self.lengths)
+        # page_table/lengths go to the device as SNAPSHOTS (.copy(), a
+        # few hundred bytes): jnp.asarray may zero-copy a numpy buffer
+        # on CPU, and the host mutates both tables in place (release /
+        # admission) as soon as the logits land — while the same
+        # step's K/V scatter thunks can still be reading them under
+        # XLA's async thunk runtime. Observed as a rare (<1%)
+        # final-token corruption under concurrent serving load.
         with record_span("serving.decode_step"):
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
              logits) = decode_step(
                 self.params, self.k_pool, self.v_pool,
-                jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                jnp.asarray(self.page_table.copy()),
+                jnp.asarray(self.lengths.copy()),
                 jnp.asarray(tokens), jnp.asarray(active),
                 self.config, self.page_size, use_pallas=self._use_pallas,
                 interpret=self._interpret, k_scale=self.k_scale,
@@ -1284,7 +1295,8 @@ class ServingEngine:
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
              logits) = verify_step(
                 self.params, self.k_pool, self.v_pool,
-                jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                jnp.asarray(self.page_table.copy()),
+                jnp.asarray(self.lengths.copy()),
                 jnp.asarray(tokens), jnp.asarray(n_tok),
                 jnp.asarray(active), self.config, self.page_size,
                 use_pallas=self._use_pallas, interpret=self._interpret,
@@ -1381,6 +1393,9 @@ class ServingEngine:
         self.pool.decref(reversed(self._seq_pages[slot]))
         self._seq_pages[slot] = []
         self.lengths[slot] = 0
+        # re-point the freed row at the trash page: stale entries keep
+        # aliasing pages the pool may re-hand to other slots
+        self.page_table[slot, :] = self.num_pages - 1
         self._slots[slot] = None
 
     # -- prefix KV cache (serving/kvcache.py) -----------------------------
@@ -1481,7 +1496,8 @@ class ServingEngine:
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
              logits) = verify_step(
                 self.params, self.k_pool, self.v_pool,
-                jnp.asarray(self.page_table), jnp.asarray(self.lengths),
+                jnp.asarray(self.page_table.copy()),
+                jnp.asarray(self.lengths.copy()),
                 jnp.asarray(tokens), jnp.asarray(n_tok),
                 jnp.asarray(active), self.config, self.page_size,
                 use_pallas=self._use_pallas, interpret=self._interpret,

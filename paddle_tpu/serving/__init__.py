@@ -13,8 +13,15 @@ Layers (docs/serving.md has the architecture):
   * `scheduler` — thread-safe bounded request queue with priority
                   classes, deadlines/TTLs, cancellation, backpressure
                   (`BackpressureError`), and graceful drain.
+  * `replica`   — one engine + scheduler + metrics registry behind the
+                  transport-agnostic surface the router dispatches to.
+  * `router`    — scale-out tier: consistent-hash prefix-affinity
+                  dispatch across N replicas, least-loaded spill,
+                  circuit-breaker health, pre-output failover, and
+                  graceful per-replica drain.
   * `server`    — stdlib ThreadingHTTPServer frontend: streaming
-                  `/v1/completions`, `/healthz`, `/metrics`.
+                  `/v1/completions`, `/healthz`, `/readyz`,
+                  `/metrics`; mounts a scheduler OR a router.
   * `client`    — stdlib HTTP client, SSE streaming included.
 
 This package never imports the model/engine modules at import time —
@@ -23,12 +30,18 @@ the engine arrives as a constructor argument — so
 """
 from __future__ import annotations
 
-from . import client, kvcache, metrics, scheduler, server  # noqa: F401
+from . import (  # noqa: F401
+    client, kvcache, metrics, replica, router, scheduler, server,
+)
 from .client import ServingClient, ServingHTTPError  # noqa: F401
 from .kvcache import PagePool, PrefixCache  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram, MetricsRegistry,
 )
+from .replica import (  # noqa: F401
+    Replica, ReplicaKilledError, build_replicas,
+)
+from .router import Router, RouterRequest, prefix_key  # noqa: F401
 from .scheduler import (  # noqa: F401
     BackpressureError, DeadlineExceededError, RequestScheduler,
     SchedulerClosedError, SchedulerError, ServingRequest,
@@ -36,10 +49,13 @@ from .scheduler import (  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
-    "client", "kvcache", "metrics", "scheduler", "server",
+    "client", "kvcache", "metrics", "replica", "router", "scheduler",
+    "server",
     "ServingClient", "ServingHTTPError",
     "PagePool", "PrefixCache",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineMetrics",
+    "Replica", "ReplicaKilledError", "build_replicas",
+    "Router", "RouterRequest", "prefix_key",
     "RequestScheduler", "ServingRequest", "SchedulerError",
     "BackpressureError", "DeadlineExceededError", "SchedulerClosedError",
     "ServingServer",

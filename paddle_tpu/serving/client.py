@@ -12,6 +12,14 @@ Backpressure: a full server queue is HTTP 429 with `Retry-After`
 `retries=N` (opt-in; default 0 preserves raise-immediately) the client
 honors that hint — bounded retries with jittered sleeps — before
 surfacing `ServingHTTPError`.
+
+The same bounded budget also retries **connection-level** failures —
+refused, reset, or dropped before any response byte arrived
+(`ConnectionError`, `http.client.RemoteDisconnected`) — so a rolling
+replica restart behind the router is invisible to callers. This is
+safe for this protocol because a completion request is idempotent
+(deterministic generation for the given parameters) and streams are
+only ever retried before the first streamed byte.
 """
 from __future__ import annotations
 
@@ -21,6 +29,12 @@ import random
 import time
 
 __all__ = ["ServingClient", "ServingHTTPError"]
+
+# connection-level failures worth retrying: the server never saw the
+# request (refused) or dropped it before responding (reset / remote
+# disconnected during a restart). ConnectionError covers Refused,
+# Reset, Aborted, and BrokenPipe.
+_CONN_ERRORS = (ConnectionError, http.client.RemoteDisconnected)
 
 
 class ServingHTTPError(RuntimeError):
@@ -71,9 +85,12 @@ class ServingClient:
         return conn, conn.getresponse()
 
     def _with_retries(self, fn):
-        """Run fn(); on 429 sleep out the server's Retry-After (capped,
-        jittered to decorrelate a thundering herd) and try again, at
-        most `self.retries` extra times."""
+        """Run fn(); retry (at most `self.retries` extra times) on 429
+        backpressure — sleeping out the server's Retry-After, capped
+        and jittered to decorrelate a thundering herd — and on
+        connection refused/reset/disconnect with a short exponential
+        backoff (a replica restarting behind the router). Everything
+        else raises immediately."""
         attempt = 0
         while True:
             try:
@@ -84,6 +101,12 @@ class ServingClient:
                 hint = e.retry_after_s if e.retry_after_s is not None \
                     else 1.0
                 time.sleep(min(hint, self.retry_cap_s)
+                           * (0.5 + self._rng.random()))
+                attempt += 1
+            except _CONN_ERRORS:
+                if attempt >= self.retries:
+                    raise
+                time.sleep(min(0.05 * (2 ** attempt), self.retry_cap_s)
                            * (0.5 + self._rng.random()))
                 attempt += 1
 
@@ -105,6 +128,11 @@ class ServingClient:
     # -- endpoints ----------------------------------------------------
     def healthz(self):
         return self._json_call("GET", "/healthz")
+
+    def readyz(self):
+        """Readiness probe; raises ServingHTTPError(503) while the
+        server is paused or draining (liveness stays 200)."""
+        return self._json_call("GET", "/readyz")
 
     def metrics(self):
         """JSON snapshot of the server's metrics registry."""

@@ -273,6 +273,12 @@ class EngineMetrics:
             "pt_serving_page_allocs", "KV pages handed out.")
         self.accepted = r.counter(
             "pt_serving_requests_accepted", "Requests admitted.")
+        self.started = r.counter(
+            "pt_serving_requests_started",
+            "Requests fed to the engine (left the queue).")
+        self.failed = r.counter(
+            "pt_serving_requests_failed",
+            "Requests failed by an engine error.")
         self.rejected = r.counter(
             "pt_serving_requests_rejected",
             "Requests refused by admission control (backpressure).")
@@ -370,6 +376,15 @@ class EngineMetrics:
 
     def on_reject(self):
         self.rejected.inc()
+
+    def on_start(self):
+        """A queued request was fed to the engine."""
+        self.started.inc()
+
+    def on_fail(self):
+        """A request was failed by an engine error (the router's
+        failover trigger)."""
+        self.failed.inc()
 
     def on_expire(self):
         self.expired.inc()

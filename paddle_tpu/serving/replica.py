@@ -1,0 +1,145 @@
+"""One serving replica: an engine + scheduler pair behind a
+transport-agnostic surface the router dispatches to.
+
+A `Replica` owns one `ServingEngine` (its KV pool, prefix cache, pump
+state) wrapped in one `RequestScheduler` (its bounded queue and pump
+thread) plus a PRIVATE `MetricsRegistry` — nothing is shared between
+replicas, so N replicas are N independent failure domains in one
+process. The surface the router uses is deliberately small and
+carries no in-process types in its *semantics* (submit parameters and
+stats are plain data; only the returned request handle is local), so
+a future multi-host replica can implement the same methods over the
+existing rpc/collective layer without touching the router:
+
+  * `submit(prompt_ids, **params)` — admit-or-refuse now
+    (`BackpressureError` / `SchedulerClosedError` pass through);
+  * `stats()` / `load()` — queue depth, occupancy, and the
+    scheduler's monotonic request ledger (started/completed/failed),
+    which is what health tracking diffs;
+  * `ready()` — readiness (False while paused or draining), the
+    /readyz signal an external LB would consume;
+  * `pause()/resume()/shutdown(drain=)` — rolling-restart hooks;
+  * `kill()` — fault injection for failover drills and tests.
+
+The engine arrives as a constructor argument: this module imports no
+model code (the serving package stays cycle-free and cheap).
+"""
+from __future__ import annotations
+
+from .metrics import MetricsRegistry
+from .scheduler import RequestScheduler
+
+__all__ = ["Replica", "ReplicaKilledError", "build_replicas"]
+
+
+class ReplicaKilledError(RuntimeError):
+    """Injected engine failure (Replica.kill): every subsequent step
+    raises, so in-flight and queued requests fail and the router's
+    failover path takes over."""
+
+
+class Replica:
+    """In-process replica: one engine + scheduler + metrics registry.
+
+    `replica_id` is the stable identity used for consistent-hash ring
+    placement, the `replica=` label on aggregated /metrics, and
+    flight-recorder events.
+    """
+
+    def __init__(self, replica_id, engine, *, max_queue=64,
+                 metrics=None, idle_poll_s=0.02):
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        registry = metrics if metrics is not None else MetricsRegistry()
+        self.scheduler = RequestScheduler(engine, max_queue=max_queue,
+                                          metrics=registry,
+                                          idle_poll_s=idle_poll_s)
+
+    # -- identity / introspection -------------------------------------
+    @property
+    def registry(self):
+        return self.scheduler.registry
+
+    @property
+    def page_size(self):
+        """KV page size — the router's affinity keys hash block-aligned
+        prompt prefixes at this granularity (same chained block-hash
+        scheme the replica's own prefix cache indexes by)."""
+        return int(self.engine.page_size)
+
+    @property
+    def max_queue(self):
+        return self.scheduler.max_queue
+
+    def stats(self):
+        st = self.scheduler.stats()
+        st["replica_id"] = self.replica_id
+        st["ready"] = self.ready()
+        return st
+
+    def load(self):
+        """Queued + in-flight requests — the least-loaded spill order
+        sorts on this. One lock acquisition, cheap enough per
+        dispatch."""
+        st = self.scheduler.stats()
+        return st["queued"] + st["inflight"] + st["active"]
+
+    def ready(self):
+        return self.scheduler.readiness()[0]
+
+    # -- dispatch ------------------------------------------------------
+    def submit(self, prompt_ids, **params):
+        """Admit-or-refuse now; returns the scheduler's request
+        handle. BackpressureError (queue full) and SchedulerClosedError
+        (draining) propagate — the router turns those into spill /
+        re-dispatch decisions."""
+        return self.scheduler.submit(prompt_ids, **params)
+
+    # -- operational controls -----------------------------------------
+    def pause(self):
+        self.scheduler.pause()
+
+    def resume(self):
+        self.scheduler.resume()
+
+    def drain(self, timeout=None):
+        return self.scheduler.drain(timeout=timeout)
+
+    def shutdown(self, drain=True, timeout=None):
+        return self.scheduler.shutdown(drain=drain, timeout=timeout)
+
+    def kill(self, exc=None):
+        """Fault injection: every subsequent engine step raises, the
+        scheduler's `_fail_all` fails whatever is queued or running,
+        and the router fails those requests over to a healthy replica.
+        This is the chaos drill the failover tests run; a real crash
+        (OOM, device loss) takes the identical code path because the
+        pump already converts ANY step exception into failed
+        requests."""
+        err = exc if exc is not None else ReplicaKilledError(
+            f"replica {self.replica_id}: killed (fault injection)")
+
+        def _dead_step():
+            raise err
+        self.engine.step = _dead_step
+
+    def revive(self):
+        """Undo `kill()`: drop the injected step override so the class
+        method resumes — the 'replica restarted' half of a failover
+        drill (the scheduler's `_fail_all` already left the engine's
+        slots and pages clean)."""
+        self.engine.__dict__.pop("step", None)
+
+    def __repr__(self):
+        return f"Replica({self.replica_id!r})"
+
+
+def build_replicas(engine_factory, n, *, max_queue=64, prefix="r",
+                   idle_poll_s=0.02):
+    """N independent replicas from an engine factory. The factory is
+    called once per replica — each gets its own params reference but
+    its own KV pool, prefix cache, scheduler, and metrics registry
+    (`engine_factory(i) -> ServingEngine`)."""
+    return [Replica(f"{prefix}{i}", engine_factory(i),
+                    max_queue=max_queue, idle_poll_s=idle_poll_s)
+            for i in range(int(n))]

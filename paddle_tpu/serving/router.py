@@ -1,0 +1,650 @@
+"""Scale-out serving tier: prefix-affinity router over a replica pool.
+
+One pump thread owning one engine is the single-host ceiling; this
+module fans `/v1/completions` traffic across N independent replicas
+(`serving/replica.py` — engine + scheduler + metrics per replica) from
+any number of frontend threads:
+
+  * **Prefix affinity.** The dispatch key is the chained block hash of
+    the longest block-aligned prompt prefix — the SAME hash scheme the
+    replicas' prefix caches index by (`serving/kvcache.py`), so two
+    prompts that would share cached KV pages hash to the same key. The
+    key picks a replica on a consistent-hash ring (virtual nodes), so
+    a hot system prompt keeps landing on the replica that already
+    holds its pages, and adding/draining a replica only re-homes the
+    keys that map to it.
+  * **Least-loaded spill.** When the affinity target refuses admission
+    (`BackpressureError`) or is out of rotation, the request spills to
+    the least-loaded healthy replica instead of queueing behind the
+    hot spot. All replicas full → the BackpressureError propagates
+    (HTTP 429, client owns the retry).
+  * **Health / circuit breaker.** Per-replica consecutive-failure
+    counts drive a breaker: `ok → open` after `unhealthy_after`
+    consecutive failed requests (no new dispatches), `open →
+    half_open` after `probe_after_s` (ONE probe request), probe
+    success closes the breaker, probe failure re-opens it.
+  * **Failover.** A request that its replica failed before emitting
+    any output (queued-but-unstarted when the engine died) is
+    transparently re-dispatched to another replica — same request id,
+    same trace id, bounded by the set of remaining replicas. Outputs
+    are token-identical to an undisturbed run because generation is
+    deterministic given the request parameters.
+  * **Graceful drain.** `drain_replica()` flips readiness off (ring
+    exit + scheduler close), lets running work finish, then removes
+    the replica — the rolling-restart primitive.
+
+Everything is host-side stdlib; the router never touches an engine
+directly (TPL004: no engine/device calls under the router lock — the
+lock only guards routing state; replica submits happen outside it).
+`router.dispatch` / `router.failover` flight-recorder events carry
+each request's trace id across the dispatch hop.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+import time
+
+from ..observability import flight_recorder as _flight
+from .kvcache import _SEED, block_hash
+from .metrics import MetricsRegistry
+from .scheduler import (BackpressureError, SchedulerClosedError,
+                        SchedulerError)
+
+__all__ = ["Router", "RouterRequest", "prefix_key"]
+
+
+def prefix_key(tokens, page_size):
+    """Routing key for a prompt: the chained block hash
+    (`kvcache.block_hash`) of its longest block-aligned prefix, capped
+    one token short exactly like `PrefixCache.match` — equal keys mean
+    the replicas' caches would index the same page chain. Prompts with
+    no full block hash their raw tokens so identical short prompts
+    still co-locate. Returns (key, n_blocks)."""
+    ps = int(page_size)
+    toks = tuple(int(t) for t in tokens)
+    n_blocks = max(len(toks) - 1, 0) // ps if ps > 0 else 0
+    parent = _SEED
+    for b in range(n_blocks):
+        parent = block_hash(parent, toks[b * ps:(b + 1) * ps])
+    if n_blocks == 0:
+        parent = block_hash(parent, toks)
+    return parent, n_blocks
+
+
+class _HashRing:
+    """Consistent-hash ring with virtual nodes. Not thread-safe — the
+    router mutates it under its lock."""
+
+    def __init__(self, vnodes=64):
+        self.vnodes = int(vnodes)
+        self._points = []            # sorted [(point, rid)]
+
+    def add(self, rid):
+        for i in range(self.vnodes):
+            bisect.insort(self._points, (hash((rid, i)), rid))
+
+    def remove(self, rid):
+        self._points = [(p, r) for p, r in self._points if r != rid]
+
+    def lookup(self, key):
+        """Replica owning `key`: first point clockwise of it."""
+        pts = self._points
+        if not pts:
+            return None
+        i = bisect.bisect_left(pts, (key,))
+        return pts[i % len(pts)][1]
+
+
+class _ReplicaState:
+    """Router-side view of one replica: circuit-breaker state plus
+    dispatch accounting. Mutated only under the router lock."""
+
+    __slots__ = ("replica", "state", "failures", "opened_at",
+                 "probe_at", "dispatches", "failovers_in")
+
+    def __init__(self, replica):
+        self.replica = replica
+        self.state = "ok"            # ok | open | half_open | draining
+        self.failures = 0            # consecutive failed requests
+        self.opened_at = 0.0
+        self.probe_at = 0.0
+        self.dispatches = 0
+        self.failovers_in = 0        # requests failed over TO this one
+
+
+class RouterRequest:
+    """Caller-facing handle over whichever replica currently owns the
+    request. Duck-types the `ServingRequest` surface the HTTP server
+    consumes (`rid/req/state/error/output/trace_id`, `stream()`,
+    `result()`, `cancel()`); on a replica failure BEFORE any output it
+    re-dispatches to another replica transparently, so rolling
+    restarts and engine crashes never surface for queued work."""
+
+    def __init__(self, router, sr, replica_id, prompt_ids, params, key):
+        self._router = router
+        self._sr = sr                # current underlying ServingRequest
+        self.replica_id = replica_id
+        self._prompt = list(prompt_ids)
+        # resubmit with the identical parameters + ids: failover output
+        # must be what the original dispatch would have produced
+        self._params = dict(params, rid=sr.rid, trace_id=sr.trace_id)
+        self._key = key
+        self._tried = [replica_id]
+        self.failovers = 0
+        self._reported = False
+
+    # -- delegation to the current underlying request -----------------
+    @property
+    def rid(self):
+        return self._sr.rid
+
+    @property
+    def req(self):
+        return self._sr.req
+
+    @property
+    def state(self):
+        return self._sr.state
+
+    @property
+    def error(self):
+        return self._sr.error
+
+    @property
+    def output(self):
+        return self._sr.output
+
+    @property
+    def trace_id(self):
+        return self._sr.trace_id
+
+    @property
+    def priority(self):
+        return self._sr.priority
+
+    @property
+    def t_first_token(self):
+        return self._sr.t_first_token
+
+    def cancel(self):
+        return self._sr.cancel()
+
+    # -- failover machinery -------------------------------------------
+    def _report(self):
+        """Feed the terminal state into the router's health tracking
+        exactly once per underlying dispatch."""
+        if not self._reported:
+            self._reported = True
+            self._router._note_result(self.replica_id, self._sr.state)
+
+    def _failed_unstarted(self):
+        """Replica failed this request before it produced anything —
+        the safe-to-replay case (queued, or admitted but zero tokens
+        emitted)."""
+        return self._sr.state == "failed" and not self._sr.req.output
+
+    def _failover_or_raise(self, err):
+        self._report()
+        nxt = self._router._redispatch(self)
+        if nxt is None:
+            raise err
+        rid, sr = nxt
+        self._tried.append(rid)
+        self.replica_id = rid
+        self._sr = sr
+        self._reported = False
+        self.failovers += 1
+
+    # -- consumption ---------------------------------------------------
+    def stream(self, timeout=None):
+        """Yield token chunks; a pre-first-token replica death is
+        retried on another replica invisibly. Once a chunk has been
+        yielded the stream is never replayed (the caller already has
+        tokens) — a later failure raises."""
+        sent = 0
+        while True:
+            try:
+                for chunk in self._sr.stream(timeout=timeout):
+                    sent += 1
+                    yield chunk
+                self._report()
+                return
+            except Exception as e:  # noqa: BLE001 — terminal-state errors
+                if sent == 0 and self._failed_unstarted():
+                    self._failover_or_raise(e)
+                    continue
+                self._report()
+                raise
+
+    def result(self, timeout=None):
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            left = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.001)
+            try:
+                out = self._sr.result(timeout=left)
+                self._report()
+                return out
+            except TimeoutError:
+                raise
+            except Exception as e:  # noqa: BLE001 — terminal-state errors
+                if self._failed_unstarted():
+                    self._failover_or_raise(e)
+                    continue
+                self._report()
+                raise
+
+
+class Router:
+    """Replica pool + dispatcher. Duck-types the scheduler surface the
+    HTTP server mounts (`submit/stats/readiness/shutdown/
+    render_prometheus/metrics_snapshot`), so
+    `ServingServer(Router(...))` is the whole wiring.
+
+    The router lock guards ONLY routing state (ring, breaker states,
+    counters); replica submits and stats reads happen outside it, so a
+    slow replica never serializes dispatch to the others.
+    """
+
+    def __init__(self, replicas, *, policy="affinity", vnodes=64,
+                 unhealthy_after=2, probe_after_s=1.0, metrics=None):
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(
+                f"policy={policy!r}: use 'affinity' or 'round_robin'")
+        self._lock = threading.Lock()
+        self._replicas = {}          # rid -> _ReplicaState (ordered)
+        self._ring = _HashRing(vnodes)
+        self._policy = policy
+        self._rr = itertools.count()
+        self.unhealthy_after = int(unhealthy_after)
+        self.probe_after_s = float(probe_after_s)
+        self.page_size = None
+        self.registry = metrics if isinstance(metrics, MetricsRegistry) \
+            else MetricsRegistry()
+        r = self.registry
+        self.dispatches = r.counter(
+            "pt_router_dispatches", "Requests dispatched to a replica.")
+        self.affinity_hits = r.counter(
+            "pt_router_affinity_hits",
+            "Dispatches that landed on the prefix-affinity target.")
+        self.spills = r.counter(
+            "pt_router_spills",
+            "Dispatches diverted off the affinity target "
+            "(backpressure or health).")
+        self.probes = r.counter(
+            "pt_router_probes", "Half-open circuit-breaker probes.")
+        self.failovers = r.counter(
+            "pt_router_failovers",
+            "Requests re-dispatched after a replica failed them "
+            "before any output.")
+        self.rejects = r.counter(
+            "pt_router_rejects",
+            "Requests refused because every replica was full or out "
+            "of rotation.")
+        self.unhealthy_transitions = r.counter(
+            "pt_router_unhealthy_transitions",
+            "Circuit-breaker ok->open transitions.")
+        self.replicas_gauge = r.gauge(
+            "pt_router_replicas", "Registered replicas.")
+        self.ready_gauge = r.gauge(
+            "pt_router_replicas_ready", "Replicas accepting dispatches.")
+        for rep in replicas:
+            self.add_replica(rep)
+
+    # -- pool membership ----------------------------------------------
+    def add_replica(self, replica):
+        """Register a replica and give it ring ownership (rolling
+        restarts re-add here after drain_replica removed)."""
+        rid = replica.replica_id
+        ps = int(replica.page_size)
+        with self._lock:
+            if rid in self._replicas:
+                raise ValueError(f"router: duplicate replica id {rid!r}")
+            if self.page_size is None:
+                self.page_size = ps
+            elif ps != self.page_size:
+                raise ValueError(
+                    f"router: replica {rid!r} page_size={ps} != "
+                    f"{self.page_size} — affinity keys would diverge "
+                    "from the replicas' prefix caches")
+            self._replicas[rid] = _ReplicaState(replica)
+            self._ring.add(rid)
+            self.replicas_gauge.set(len(self._replicas))
+
+    def replica(self, rid):
+        with self._lock:
+            st = self._replicas.get(rid)
+        return None if st is None else st.replica
+
+    def affinity_target(self, prompt_ids):
+        """Replica id the consistent-hash ring names for this prompt's
+        prefix key, ignoring health — where the request WOULD go on a
+        healthy pool (observability + tests)."""
+        key, _ = prefix_key(prompt_ids, self.page_size or 1)
+        with self._lock:
+            return self._ring.lookup(key)
+
+    @property
+    def replica_ids(self):
+        with self._lock:
+            return list(self._replicas)
+
+    def drain_replica(self, rid, timeout=None, remove=True):
+        """Rolling-restart primitive: take `rid` out of rotation
+        (readiness flips false immediately), let in-flight and queued
+        work finish, then drop it from the pool. Returns True when the
+        replica's pump exited within `timeout`."""
+        with self._lock:
+            st = self._replicas.get(rid)
+            if st is None:
+                raise KeyError(f"router: no replica {rid!r}")
+            st.state = "draining"
+            self._ring.remove(rid)
+        _flight.record("router.drain", replica=rid)
+        ok = st.replica.shutdown(drain=True, timeout=timeout)
+        if remove:
+            with self._lock:
+                self._replicas.pop(rid, None)
+                self.replicas_gauge.set(len(self._replicas))
+        return ok
+
+    # -- dispatch ------------------------------------------------------
+    def submit(self, prompt_ids, *, priority="normal", ttl_s=None,
+               trace_id=None, rid=None, **params):
+        """Dispatch by prefix affinity with least-loaded spill; returns
+        a RouterRequest. Raises BackpressureError when every eligible
+        replica refused admission, SchedulerClosedError when none is in
+        rotation, ValueError for a request no engine could run (the
+        first candidate validates it)."""
+        key, n_blocks = prefix_key(prompt_ids, self.page_size or 1)
+        plan = self._plan(key)
+        kw = dict(params, priority=priority, ttl_s=ttl_s,
+                  trace_id=trace_id)
+        last_err = None
+        for target, kind in plan:
+            with self._lock:
+                st = self._replicas.get(target)
+            if st is None:           # removed between plan and dispatch
+                continue
+            try:
+                sr = st.replica.submit(prompt_ids, rid=rid, **kw)
+            except BackpressureError as e:
+                last_err = e
+                continue
+            except SchedulerClosedError as e:
+                last_err = e
+                continue
+            self._note_dispatch(target, kind, sr, n_blocks)
+            return RouterRequest(self, sr, target, prompt_ids, kw, key)
+        self.rejects.inc()
+        if last_err is not None:
+            raise last_err
+        raise SchedulerClosedError(
+            "router: no replica in rotation (all draining or removed)")
+
+    def _plan(self, key):
+        """Dispatch order: the affinity target first (consistent-hash
+        owner of the key; `round_robin` policy rotates instead), then
+        every other eligible replica by ascending load — the spill
+        order. Half-open probes ride the same plan with kind
+        'probe'."""
+        now = time.monotonic()
+        with self._lock:
+            if not self._replicas:
+                raise SchedulerClosedError("router: no replicas")
+            if self._policy == "affinity":
+                primary = self._ring.lookup(key)
+            else:
+                rids = [i for i, st in self._replicas.items()
+                        if st.state != "draining"]
+                primary = rids[next(self._rr) % len(rids)] if rids \
+                    else None
+            cands = [(i, st.replica, self._eligibility_locked(st, now))
+                     for i, st in self._replicas.items()]
+        plan = []
+        spill = []
+        for i, rep, elig in cands:
+            if elig is None:
+                continue
+            if i == primary:
+                kind = "probe" if elig == "probe" else (
+                    "affinity" if self._policy == "affinity" else "rr")
+                plan.append((i, kind))
+            else:
+                # load() is one scheduler-lock hop per replica; done
+                # OUTSIDE the router lock so dispatch never serializes
+                # on a slow replica
+                spill.append((rep.load(), i,
+                              "probe" if elig == "probe" else "spill"))
+        spill.sort(key=lambda t: t[0])
+        plan.extend((i, kind) for _, i, kind in spill)
+        return plan
+
+    def _eligibility_locked(self, st, now):
+        """None (skip), 'ok', or 'probe' (breaker half-open trial)."""
+        if st.state == "draining":
+            return None
+        if st.state == "ok":
+            return "ok"
+        if st.state == "half_open":
+            # one probe at a time; a probe that never reports back
+            # (abandoned handle) unblocks after another cooldown
+            if now - st.probe_at >= self.probe_after_s:
+                return "probe"
+            return None
+        # open: cooled down -> offer one probe
+        if now - st.opened_at >= self.probe_after_s:
+            return "probe"
+        return None
+
+    def _note_dispatch(self, rid, kind, sr, n_blocks):
+        with self._lock:
+            st = self._replicas.get(rid)
+            if st is not None:
+                st.dispatches += 1
+                if kind == "probe":
+                    st.state = "half_open"
+                    st.probe_at = time.monotonic()
+        self.dispatches.inc()
+        if kind == "affinity":
+            self.affinity_hits.inc()
+        elif kind == "probe":
+            self.probes.inc()
+        elif kind == "spill":
+            self.spills.inc()
+        # "rr" (round_robin primary) counts only as a dispatch
+        _flight.record("router.dispatch", rid=str(sr.rid),
+                       trace_id=sr.trace_id, replica=rid, route=kind,
+                       prefix_blocks=n_blocks)
+
+    # -- failover ------------------------------------------------------
+    def _redispatch(self, rr: RouterRequest):
+        """Re-dispatch a failed-before-output request to a replica it
+        has not tried. Returns (rid, ServingRequest) or None when no
+        replica can take it."""
+        tried = set(rr._tried)
+        try:
+            plan = self._plan(rr._key)
+        except SchedulerClosedError:
+            return None
+        for target, _kind in plan:
+            if target in tried:
+                continue
+            with self._lock:
+                st = self._replicas.get(target)
+            if st is None:
+                continue
+            try:
+                sr = st.replica.submit(rr._prompt, **rr._params)
+            except (BackpressureError, SchedulerClosedError):
+                continue
+            with self._lock:
+                st.failovers_in += 1
+            self.failovers.inc()
+            _flight.record("router.failover", rid=str(sr.rid),
+                           trace_id=sr.trace_id,
+                           from_replica=rr.replica_id, to_replica=target,
+                           attempt=rr.failovers + 1)
+            return target, sr
+        return None
+
+    # -- health tracking ----------------------------------------------
+    def _note_result(self, rid, state):
+        """Terminal state of one dispatched request — drives the
+        circuit breaker. Success closes, consecutive failures open,
+        probe outcomes resolve half-open."""
+        with self._lock:
+            st = self._replicas.get(rid)
+            if st is None:
+                return
+            if state == "done":
+                st.failures = 0
+                if st.state in ("open", "half_open"):
+                    st.state = "ok"
+                    _flight.record("router.recovered", replica=rid)
+            elif state == "failed":
+                st.failures += 1
+                if st.state == "half_open":
+                    st.state = "open"        # failed probe: re-open
+                    st.opened_at = time.monotonic()
+                elif st.state == "ok" and \
+                        st.failures >= self.unhealthy_after:
+                    st.state = "open"
+                    st.opened_at = time.monotonic()
+                    self.unhealthy_transitions.inc()
+                    _flight.record("router.unhealthy", replica=rid,
+                                   failures=st.failures)
+            # cancelled/expired say nothing about replica health
+
+    # -- scheduler-surface duck type ----------------------------------
+    def stats(self):
+        with self._lock:
+            items = [(rid, st.replica, st.state, st.failures,
+                      st.dispatches, st.failovers_in)
+                     for rid, st in self._replicas.items()]
+        reps, queued, inflight, active, n_ready = {}, 0, 0, 0, 0
+        n_closed = 0
+        for rid, rep, state, failures, dispatches, fo in items:
+            s = rep.stats()
+            ready = state == "ok" and s["ready"]
+            n_ready += ready
+            n_closed += s.get("closed", False)
+            queued += s["queued"]
+            inflight += s["inflight"]
+            active += s["active"]
+            reps[rid] = {
+                "health": state, "ready": ready,
+                "consecutive_failures": failures,
+                "dispatches": dispatches, "failovers_in": fo,
+                "queued": s["queued"], "inflight": s["inflight"],
+                "active": s["active"], "requests": s.get("requests"),
+            }
+        self.ready_gauge.set(n_ready)
+        # closed is LIVENESS (every pump gone), not readiness: a fully
+        # paused pool is alive (healthz "ok") but not ready (readyz 503)
+        return {"replicas": reps, "queued": queued,
+                "inflight": inflight, "active": active,
+                "replicas_ready": n_ready,
+                "closed": n_closed == len(items),
+                "router": {
+                    "dispatches": self.dispatches.value,
+                    "affinity_hits": self.affinity_hits.value,
+                    "spills": self.spills.value,
+                    "failovers": self.failovers.value,
+                    "unhealthy_transitions":
+                        self.unhealthy_transitions.value,
+                }}
+
+    def readiness(self):
+        """Router readiness: at least one replica in rotation and
+        accepting. Per-replica detail rides along so an external LB
+        (or a human) sees who is out and why."""
+        st = self.stats()
+        detail = {rid: ("ok" if r["ready"] else r["health"])
+                  for rid, r in st["replicas"].items()}
+        return st["replicas_ready"] > 0, detail
+
+    def pause(self):
+        for rid in self.replica_ids:
+            rep = self.replica(rid)
+            if rep is not None:
+                rep.pause()
+
+    def resume(self):
+        for rid in self.replica_ids:
+            rep = self.replica(rid)
+            if rep is not None:
+                rep.resume()
+
+    def drain(self, timeout=None):
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        ok = True
+        for rid in self.replica_ids:
+            rep = self.replica(rid)
+            if rep is None:
+                continue
+            left = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.0)
+            ok = rep.drain(timeout=left) and ok
+        return ok
+
+    def shutdown(self, drain=True, timeout=None):
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        ok = True
+        for rid in self.replica_ids:
+            rep = self.replica(rid)
+            if rep is None:
+                continue
+            left = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.0)
+            ok = rep.shutdown(drain=drain, timeout=left) and ok
+        return ok
+
+    # -- metrics aggregation ------------------------------------------
+    def render_prometheus(self):
+        """Router counters plus every replica's exposition with a
+        `replica="<id>"` label injected on each series (HELP/TYPE
+        comments are kept only for the router's own metrics — repeated
+        per-replica TYPE lines would be invalid exposition)."""
+        self.stats()                 # refresh ready gauge
+        parts = [self.registry.render_prometheus()]
+        with self._lock:
+            items = [(rid, st.replica) for rid, st in
+                     self._replicas.items()]
+        for rid, rep in items:
+            parts.append(_relabel(rep.registry.render_prometheus(), rid))
+        return "".join(parts)
+
+    def metrics_snapshot(self):
+        """JSON snapshot: router metrics flat (as the single-scheduler
+        server exposes its registry) plus one nested snapshot per
+        replica under "replicas"."""
+        snap = self.registry.snapshot()
+        with self._lock:
+            items = [(rid, st.replica) for rid, st in
+                     self._replicas.items()]
+        snap["replicas"] = {rid: rep.registry.snapshot()
+                            for rid, rep in items}
+        return snap
+
+
+def _relabel(text, rid):
+    """Inject replica="<rid>" into every series line of a Prometheus
+    exposition (comment lines dropped — see render_prometheus)."""
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, rest = line.partition(" ")
+        if "{" in name:
+            base, _, labels = name.partition("{")
+            name = f'{base}{{replica="{rid}",{labels}'
+        else:
+            name = f'{name}{{replica="{rid}"}}'
+        out.append(f"{name} {rest}")
+    return "\n".join(out) + "\n" if out else ""

@@ -141,6 +141,13 @@ class RequestScheduler:
         self._cond = threading.Condition()
         self._queues = {p: deque() for p in PRIORITIES}
         self._inflight = {}             # id(engine Request) -> handle
+        # monotonic request ledger: routers and external health checks
+        # need DELTAS ("did this replica finish anything since the last
+        # probe?"), which the point-in-time gauges cannot answer.
+        # Mutated only under self._cond; surfaced by stats()/healthz
+        # and mirrored to pt_serving_requests_{started,failed} counters
+        self._ledger = {"submitted": 0, "started": 0, "completed": 0,
+                        "failed": 0, "cancelled": 0, "expired": 0}
         self._fin_seen = len(engine.finished)
         self._rid = itertools.count()
         self._closed = False
@@ -199,6 +206,7 @@ class RequestScheduler:
             # unset)
             req._t_submit = time.perf_counter()
             self.metrics.accepted.inc()
+            self._ledger["submitted"] += 1
             self._queues[priority].append(sr)
             self._drained.clear()
             self.metrics.set_queue_depth(self._queued_locked())
@@ -263,11 +271,34 @@ class RequestScheduler:
                 "paused": self._paused,
                 "device_steps": self._engine.device_steps,
                 "preemptions": self._engine.preemptions,
+                # monotonic ledger — consumers diff it across probes
+                "requests": dict(self._ledger),
             }
             pc = getattr(self._engine, "prefix_cache", None)
             if pc is not None:
                 st["prefix_cache"] = pc.stats()
             return st
+
+    def readiness(self):
+        """(ready, reason): False while draining (shutdown began) or
+        paused — the /readyz signal. Liveness (/healthz) stays
+        independent: a draining replica is alive but must be out of
+        any load balancer's rotation before it stops."""
+        with self._cond:
+            if self._closed:
+                return False, "draining"
+            if self._paused:
+                return False, "paused"
+            return True, "ok"
+
+    def render_prometheus(self):
+        """Prometheus exposition of this scheduler's registry (the
+        server calls this on whatever it mounts — a Router aggregates
+        replica registries behind the same method)."""
+        return self.registry.render_prometheus()
+
+    def metrics_snapshot(self):
+        return self.registry.snapshot()
 
     # -- pump (single thread; sole owner of the engine) ----------------
     def _queued_locked(self):
@@ -325,6 +356,8 @@ class RequestScheduler:
                 break
             eng.submit(sr.req)
             sr.state = "running"
+            self._ledger["started"] += 1
+            self.metrics.on_start()
             sr.t_admitted = time.monotonic()
             _flight.record("sched.admit", rid=str(sr.rid),
                            trace_id=sr.trace_id, priority=sr.priority,
@@ -364,6 +397,11 @@ class RequestScheduler:
     def _finalize(self, sr, state):
         sr.state = state
         sr.t_done = time.monotonic()
+        self._ledger[{"done": "completed", "failed": "failed",
+                      "cancelled": "cancelled",
+                      "expired": "expired"}[state]] += 1
+        if state == "failed":
+            self.metrics.on_fail()
         if state == "expired":
             sr.error = DeadlineExceededError(
                 f"request {sr.rid}: deadline exceeded after "

@@ -9,6 +9,9 @@ stays single-threaded behind the scheduler's pump):
     token chunk; an `X-Request-Id` header (or generated id) becomes
     the request's trace id, echoed back and stamped on every span;
   * `GET /healthz` — liveness + queue/occupancy snapshot;
+  * `GET /readyz` — readiness: 503 while paused or draining, so the
+    router (or any external LB) takes the replica out of rotation
+    before shutdown; liveness above stays 200 throughout;
   * `GET /metrics` — Prometheus text exposition, serving registry +
     compile telemetry + device telemetry (`pt_mfu`, `pt_device_*`) +
     training health (`?format=json` returns the JSON snapshot);
@@ -38,6 +41,7 @@ from ..observability import device_telemetry as _devtel
 from ..observability import flight_recorder as _flight
 from ..observability import health as _health
 from ..observability import trace_context as _tc
+from .router import Router
 from .scheduler import (BackpressureError, RequestScheduler,
                         SchedulerClosedError)
 
@@ -80,9 +84,15 @@ class CompletionHandler(BaseHTTPRequestHandler):
             st = self.sched.stats()
             st["status"] = "draining" if st.pop("closed") else "ok"
             self._json(200, st)
+        elif path == "/readyz":
+            # readiness ≠ liveness: a paused or draining scheduler is
+            # alive (healthz 200) but must stop receiving traffic
+            ready, detail = self.sched.readiness()
+            self._json(200 if ready else 503,
+                       {"ready": ready, "detail": detail})
         elif path == "/metrics":
             if "format=json" in query:
-                snap = self.sched.registry.snapshot()
+                snap = self.sched.metrics_snapshot()
                 snap["pt_compile"] = _compile.snapshot()
                 snap["pt_device"] = _devtel.snapshot()
                 snap["pt_health"] = _health.snapshot()
@@ -90,8 +100,10 @@ class CompletionHandler(BaseHTTPRequestHandler):
             else:
                 # scrape-cadence device telemetry: render_prometheus
                 # polls the memory accountant (live-array walk) here,
-                # on the HTTP thread — never on the pump's step path
-                body = (self.sched.registry.render_prometheus()
+                # on the HTTP thread — never on the pump's step path.
+                # A mounted Router aggregates every replica's registry
+                # with replica="<id>" labels behind the same method
+                body = (self.sched.render_prometheus()
                         + _compile.render_prometheus()
                         + _devtel.render_prometheus()
                         + _health.render_prometheus()).encode()
@@ -226,13 +238,15 @@ class CompletionHandler(BaseHTTPRequestHandler):
 class ServingServer:
     """Own the scheduler + HTTP listener pair.
 
-    Accepts a ready-made RequestScheduler or a bare ServingEngine
-    (wrapped with `max_queue`). `port=0` binds an ephemeral port —
-    read it back from `.port` (how the tests run hermetically)."""
+    Accepts a ready-made RequestScheduler, a `Router` (scale-out mode:
+    the same HTTP surface fans across its replica pool, /metrics
+    aggregates per-replica series), or a bare ServingEngine (wrapped
+    with `max_queue`). `port=0` binds an ephemeral port — read it back
+    from `.port` (how the tests run hermetically)."""
 
     def __init__(self, engine_or_scheduler, host="127.0.0.1", port=8000,
                  max_queue=64):
-        if isinstance(engine_or_scheduler, RequestScheduler):
+        if isinstance(engine_or_scheduler, (RequestScheduler, Router)):
             self.scheduler = engine_or_scheduler
         else:
             self.scheduler = RequestScheduler(engine_or_scheduler,

@@ -26,6 +26,7 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     monkeypatch.setenv("PT_SERVE_SPEC", "4")
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
+    monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "ngram-repetitive"
     assert out["spec_accept_rate"] > 0, out
@@ -84,6 +85,7 @@ def test_prefix_bench_reuses_cached_pages(monkeypatch):
     bm = _load_bench_models()
     monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
+    monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.setenv("PT_SERVE_PREFIX", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "shared-prefix"
@@ -98,8 +100,43 @@ def test_plain_bench_unaffected(monkeypatch):
     monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
+    monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["decode_tokens_per_sec"] > 0
     assert "spec_decode" not in out
     assert "prefix_hit_rate" not in out
     _assert_metrics_snapshot(out)
+
+
+def test_router_bench_snapshot(monkeypatch):
+    """PT_SERVE_ROUTER=1: the scale-out artifact must carry the router
+    ledger (dispatches / affinity hit rate), the per-replica balance +
+    prefix-hit-rate fields, and both topologies' throughput. Group ->
+    replica placement is consistent-hash (randomized per process), so
+    assertions are distribution-agnostic."""
+    bm = _load_bench_models()
+    monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
+    monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
+    monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
+    monkeypatch.setenv("PT_SERVE_ROUTER", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "router-shared-prefix"
+    assert out["replicas"] == 2
+    assert out["router_dispatches"] == out["requests"] > 0
+    assert 0 < out["affinity_hit_rate"] <= 1
+    assert out["failovers"] == 0 and out["spills"] == 0
+    per = out["per_replica"]
+    assert set(per) == {"r0", "r1"}
+    assert sum(v["dispatches"] for v in per.values()) == \
+        out["router_dispatches"]
+    assert abs(sum(v["share"] for v in per.values()) - 1.0) < 1e-6
+    assert 0 <= out["replica_balance"] <= 1
+    # the shared-header workload engaged at least one replica's cache
+    assert max(v["prefix_hit_rate"] for v in per.values()) > 0
+    for v in per.values():
+        lg = v["requests"]
+        assert lg["completed"] == lg["submitted"] == v["dispatches"]
+        assert lg["failed"] == 0
+    assert out["aggregate_tokens_per_sec"] > 0
+    assert out["single_engine_tokens_per_sec"] > 0
+    assert out["single_engine_prefix_hit_rate"] >= 0

@@ -141,7 +141,8 @@ class TestServingNamespace:
         assert len(sv.__all__) == len(set(sv.__all__)), "dup in __all__"
         for name in sv.__all__:
             assert getattr(sv, name, None) is not None, name
-        for sub in (sv.scheduler, sv.metrics, sv.server, sv.client):
+        for sub in (sv.scheduler, sv.metrics, sv.server, sv.client,
+                    sv.replica, sv.router):
             assert sorted(sub.__all__) == sorted(set(sub.__all__))
             for name in sub.__all__:
                 assert hasattr(sub, name), f"{sub.__name__}.{name}"
