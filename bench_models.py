@@ -191,6 +191,11 @@ def bench_serving(on_tpu):
     # shared-system-prompt workload (serving/router.py)
     if (os.environ.get("PT_SERVE_ROUTER", "") or "0") not in ("", "0"):
         return _bench_serving_router(on_tpu, params, cfg, dtype)
+    # PT_SERVE_MULTITURN=1: multi-turn conversations returning after a
+    # cache-thrashing burst — the host-RAM KV tier (serving/kvtier.py)
+    # vs a tier-off baseline at token-identical outputs
+    if (os.environ.get("PT_SERVE_MULTITURN", "") or "0") not in ("", "0"):
+        return _bench_serving_multiturn(on_tpu, params, cfg, dtype)
 
     rng = _data_rng()
     if prefix_mode:
@@ -459,6 +464,106 @@ def _bench_serving_router(on_tpu, params, cfg, dtype):
     }
     router.shutdown(drain=True, timeout=60)
     return out
+
+
+def _bench_serving_multiturn(on_tpu, params, cfg, dtype):
+    """PT_SERVE_MULTITURN=1: the KV-cache tiering workload. N chat
+    conversations run a first turn, a burst of distinct prompts then
+    thrashes the device prefix cache (every conversation's parked
+    pages get evicted — and, with the tier on, spilled to host RAM),
+    and finally every conversation RETURNS with its history as the
+    prompt. With the tier the returning turn restores its prefix from
+    host memory and prefills only the new tokens; the baseline is the
+    IDENTICAL workload with the tier off (evictions discard), which
+    must produce token-identical outputs while re-prefilling whole
+    histories. The artifact carries the tier ledger (hit rate, spills,
+    tokens reused) and both sides' returning-phase prefill tokens —
+    the capacity the host tier buys, measured not claimed."""
+    from paddle_tpu.models.llama_serving import Request, ServingEngine
+
+    if on_tpu:
+        max_seqs, page, max_seq_len, num_pages = 4, 16, 512, 129
+        convs, burst, new_tok = 8, 16, 32
+        t1_len, b_len, t2_extra = 64, 128, 16
+        tier_bytes = 256 << 20
+    else:
+        max_seqs, page, max_seq_len, num_pages = 2, 8, 64, 11
+        convs, burst, new_tok = 3, 6, 6
+        t1_len, b_len, t2_extra = 12, 17, 4
+        tier_bytes = 8 << 20
+    rng = _data_rng()
+    # distinct leading token per prompt: conversations and burst
+    # traffic must never share a block-aligned prefix, or the burst
+    # would HIT the cache instead of thrashing it
+    t1_prompts = [[2 * i + 1] + list(map(int, rng.randint(
+        1, cfg.vocab_size, t1_len - 1))) for i in range(convs)]
+    burst_prompts = [[2 * (convs + j) + 1] + list(map(int, rng.randint(
+        1, cfg.vocab_size, b_len - 1))) for j in range(burst)]
+    extras = [list(map(int, rng.randint(1, cfg.vocab_size, t2_extra)))
+              for _ in range(convs)]
+
+    def run(hb, warm=True):
+        # warm each config's own compile set with a FULL replay: the
+        # returning turn's suffix-prefill bucket depends on how many
+        # tokens are cached, so only an identical trajectory warms the
+        # exact shapes the timed phase hits (a short warm pass would
+        # leave a fresh XLA compile inside the timed region)
+        nt = new_tok
+        if warm:
+            run(hb, warm=False)
+        eng = ServingEngine(params, cfg, max_seqs=max_seqs,
+                            max_seq_len=max_seq_len, page_size=page,
+                            num_pages=num_pages, dtype=dtype,
+                            prefix_cache=True, host_tier_bytes=hb,
+                            use_pallas=None if on_tpu else False)
+        outs = {}
+        for i, p in enumerate(t1_prompts):
+            eng.submit(Request(f"c{i}", p, max_new_tokens=nt))
+        for r in eng.run():
+            outs[r.rid] = list(r.output)
+        # the burst: one at a time, so parking pressure accumulates
+        # and the LRU actually churns through every parked page
+        for j, p in enumerate(burst_prompts):
+            eng.submit(Request(f"b{j}", p, max_new_tokens=nt))
+            eng.run()
+        eng.host_tier.flush(timeout=120)
+        pt0 = eng.prefill_tokens
+        t2 = [t1_prompts[i] + outs[f"c{i}"] + extras[i]
+              for i in range(convs)]
+        t0 = time.perf_counter()
+        for i, p in enumerate(t2):
+            eng.submit(Request(f"t2-{i}", p, max_new_tokens=nt))
+        done = eng.run()
+        dt = time.perf_counter() - t0
+        for r in done:
+            outs[r.rid] = list(r.output)
+        t2_tokens = sum(len(outs[f"t2-{i}"]) for i in range(convs))
+        return eng, outs, eng.prefill_tokens - pt0, t2_tokens, dt
+
+    beng, bouts, bprefill, btok, bdt = run(0)           # tier off
+    teng, touts, tprefill, ttok, tdt = run(tier_bytes)  # tier on
+    tier = teng.host_tier.stats()
+    return {
+        "workload": "multi-turn",
+        "conversations": convs, "burst_requests": burst,
+        "outputs_match": touts == bouts,
+        "tier_hit_rate": round(tier["hit_rate"], 3),
+        "tier_spills": tier["spills"],
+        "tier_drops": tier["drops"],
+        "tokens_reused": tier["tokens_reused"],
+        "tier_restores": tier["restores"],
+        "tier_host_bytes": tier["host_bytes"],
+        "tier_pages": tier["pages"],
+        # the headline: returning conversations' prefill compute with
+        # and without the tier, at equal (token-identical) outputs
+        "returning_prefill_tokens": tprefill,
+        "baseline_prefill_tokens": bprefill,
+        "prefill_tokens_saved": bprefill - tprefill,
+        "returning_tokens_per_sec": round(ttok / tdt, 1),
+        "baseline_returning_tokens_per_sec": round(btok / bdt, 1),
+        "prefix_evictions": int(teng.prefix_cache.evictions),
+        "loss": 0.0,
+    }
 
 
 def bench_serving_load(on_tpu):

@@ -52,6 +52,11 @@ def main():
     ap.add_argument("--prefix-cache", action="store_true",
                     help="share KV pages across prompts with a common "
                          "prefix; admissions prefill only their suffix")
+    ap.add_argument("--host-tier-mb", type=int, default=0,
+                    help="MB of host RAM for the KV spill tier: prefix-"
+                         "cache evictions demote pages to host memory "
+                         "instead of discarding them (implies "
+                         "--prefix-cache); 0 disables")
     ap.add_argument("--replicas", type=int, default=0,
                     help="N>1: router mode — N independent engine "
                          "replicas behind the prefix-affinity router "
@@ -69,7 +74,9 @@ def main():
             page_size=16,
             cache_dtype="int8" if args.cache == "int8" else None,
             spec_decode=args.spec,
-            prefix_cache=args.prefix_cache or args.replicas > 1)
+            prefix_cache=(args.prefix_cache or args.replicas > 1
+                          or args.host_tier_mb > 0),
+            host_tier_bytes=args.host_tier_mb << 20)
 
     if args.replicas > 1:
         from paddle_tpu.serving import Router, build_replicas

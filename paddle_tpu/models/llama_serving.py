@@ -31,9 +31,11 @@ from .._core.compat import shard_map
 from ..observability import flight_recorder as _flight
 from ..observability.compile_telemetry import track_jit
 from ..profiler import record_span
-# host-side page bookkeeping only (numpy/stdlib — serving.kvcache never
-# imports model/engine code, so this direction stays cycle-free)
+# host-side page bookkeeping only (numpy/stdlib — serving.kvcache and
+# serving.kvtier never import model/engine code, so this direction
+# stays cycle-free)
 from ..serving.kvcache import PagePool, PrefixCache
+from ..serving.kvtier import HostTier
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
 from ..ops.paged_attention import (paged_attention, paged_verify_attention,
@@ -509,14 +511,25 @@ class ServingEngine:
     pre-seeded to the cached token count and the suffix runs as one
     bucket-shaped verify_step chunk over the cached pages. Refcount-0
     pages that are still indexed park in an LRU that allocation
-    reclaims before the pool is declared empty."""
+    reclaims before the pool is declared empty.
+
+    `host_tier_bytes>0` (serving/kvtier.py; docs/serving.md § KV-cache
+    tiering) adds a bounded host-RAM tier under that LRU: evictions
+    demote their pages (async device->host copy off the pump thread,
+    int8-quantized with per-token fp32 scales unless
+    tier_quantize=False) instead of discarding them, admission lookups
+    fall through device -> host, and tier hits are restored into fresh
+    device pages so a returning multi-turn conversation prefills only
+    its genuinely new tokens. The preemption offload stash shares the
+    tier's bytes ledger regardless of the budget."""
 
     def __init__(self, params, config: LlamaConfig, max_seqs=4,
                  max_seq_len=512, page_size=16, dtype=jnp.float32,
                  use_pallas=None, interpret=False, num_pages=None,
                  cache_dtype=None, preempt_policy="offload",
                  spec_decode=0, spec_ngram=2, chunked_prefill=False,
-                 spec_sample=False, mesh=None, prefix_cache=False):
+                 spec_sample=False, mesh=None, prefix_cache=False,
+                 host_tier_bytes=0, tier_quantize=True):
         c = config
         # mesh with a 'tp' axis: tensor-parallel serving — weights get
         # megatron NamedShardings (llama_spmd.param_specs), the KV pool
@@ -655,8 +668,27 @@ class ServingEngine:
         # their suffix (serving/kvcache.py; docs/serving.md).
         self.prefix_cache = PrefixCache(page_size) if prefix_cache else None
         self.pool = PagePool(num_pages - 1, cache=self.prefix_cache)
+        # host-RAM KV tier (serving/kvtier.py; docs/serving.md
+        # § KV-cache tiering): one budgeted ledger for ALL
+        # host-resident KV. The preemption offload stash always lives
+        # here; with host_tier_bytes > 0 the prefix cache's LRU
+        # evictions additionally DEMOTE their pages into it (async
+        # device->host copy off the pump thread, int8-quantized with
+        # per-token scales unless tier_quantize=False) and admission
+        # lookups fall through device -> host, restoring hits into
+        # fresh device pages. Disabled spill — the default — keeps
+        # seed behavior exactly.
+        if host_tier_bytes and not prefix_cache:
+            raise ValueError(
+                f"host_tier_bytes={host_tier_bytes} needs "
+                "prefix_cache=True: only the prefix cache's evictions "
+                "feed the spill tier")
+        self.host_tier = HostTier(page_size, tier_bytes=host_tier_bytes,
+                                  quantize=tier_quantize)
         if self.prefix_cache is not None:
             self.prefix_cache.on_evict = self._note_prefix_evict
+            if self.host_tier.enabled:
+                self.prefix_cache.on_spill = self._spill_page
         self._index_suspend = False  # set while releasing failed slots
         self._seq_pages = {s: [] for s in range(max_seqs)}
         self._slots = [None] * max_seqs          # slot -> Request
@@ -722,7 +754,7 @@ class ServingEngine:
         req.cancelled = True
         if req in self._waiting:
             self._waiting.remove(req)
-            req._offload = None
+            self._drop_offload(req)
             self.finished.append(req)
             m = self.metrics
             if m is not None:
@@ -745,7 +777,7 @@ class ServingEngine:
             keep = []
             for r in self._waiting:
                 if r.cancelled:
-                    r._offload = None
+                    self._drop_offload(r)
                     self.finished.append(r)
                     if m is not None:
                         m.on_cancel("queued")
@@ -845,7 +877,7 @@ class ServingEngine:
                 # later candidate's allocation cannot evict it out
                 # from under this one; `need` then counts only the
                 # UNCACHED pages — cache-aware admission accounting
-                req._kv_match = self._cache_acquire(feed)
+                req._kv_match = self._cache_acquire(feed, req)
                 need = -(-feed_len // self.page_size) \
                     - len(req._kv_match[0])
                 if feed_len % self.page_size == 0:
@@ -1065,18 +1097,25 @@ class ServingEngine:
             pg = np.full((self.pages_per_seq,), self.num_pages - 1,
                          np.int32)
             pg[:n_pg] = self._seq_pages[s]
-            req._offload = {
-                "len": int(self.lengths[s]),
-                # actual page count, NOT ceil(len/page_size): a victim
-                # evicted right after its boundary growth already holds
-                # the next (still-empty) page
-                "pages": n_pg,
+            payload = {
                 "k": np.asarray(self.k_pool[:, :, pg])[:, :, :n_pg],
                 "v": np.asarray(self.v_pool[:, :, pg])[:, :, :n_pg],
                 "ks": None if self.k_scale is None else
                       np.asarray(self.k_scale[:, :, pg])[:, :, :n_pg],
                 "vs": None if self.v_scale is None else
                       np.asarray(self.v_scale[:, :, pg])[:, :, :n_pg],
+            }
+            # the KV itself parks in the host tier's PINNED stash —
+            # one host-RAM ledger with the spilled prefix pages (no
+            # second ad-hoc store); the request carries only shape
+            # metadata. Stored verbatim: a resume must be exact.
+            self.host_tier.stash_put(id(req), payload, n_pg)
+            req._offload = {
+                "len": int(self.lengths[s]),
+                # actual page count, NOT ceil(len/page_size): a victim
+                # evicted right after its boundary growth already holds
+                # the next (still-empty) page
+                "pages": n_pg,
             }
         req._resume = True
         req.slot = None
@@ -1101,26 +1140,8 @@ class ServingEngine:
         n_pages = o["pages"]
         self._seq_pages[slot] = []
         pages = self._alloc_pages(slot, n_pages)
-        # scatter at the fixed pages_per_seq width (tail -> trash page),
-        # mirroring the offload gather: one compile total, not one per
-        # restored page count
-        ppseq = self.pages_per_seq
-        pg = np.full((ppseq,), self.num_pages - 1, np.int32)
-        pg[:n_pages] = pages
-
-        def pad(a):
-            out = np.zeros(a.shape[:2] + (ppseq,) + a.shape[3:], a.dtype)
-            out[:, :, :n_pages] = a
-            return out
-        self.k_pool = self.k_pool.at[:, :, pg].set(
-            jnp.asarray(pad(o["k"]), self.k_pool.dtype))
-        self.v_pool = self.v_pool.at[:, :, pg].set(
-            jnp.asarray(pad(o["v"]), self.v_pool.dtype))
-        if self.cache_quant:
-            self.k_scale = self.k_scale.at[:, :, pg].set(
-                jnp.asarray(pad(o["ks"]), jnp.float32))
-            self.v_scale = self.v_scale.at[:, :, pg].set(
-                jnp.asarray(pad(o["vs"]), jnp.float32))
+        p = self.host_tier.stash_take(id(req))
+        self._scatter_host_kv(pages, p["k"], p["v"], p["ks"], p["vs"])
         self.lengths[slot] = S
         req._offload = None
         req._resume = False
@@ -1128,6 +1149,39 @@ class ServingEngine:
         req._admit_order = self._order
         self._order += 1
         self._slots[slot] = req
+
+    def _scatter_host_kv(self, pages, k, v, ks, vs):
+        """Scatter host-resident page KV (np, (L, KVH, n, page, D))
+        into device `pages` — the single swap-in path shared by
+        preemption restore and host-tier restore. Scatters at the
+        fixed pages_per_seq width (tail -> trash page), mirroring the
+        offload gather: one compile total, not one per page count."""
+        n = len(pages)
+        ppseq = self.pages_per_seq
+        pg = np.full((ppseq,), self.num_pages - 1, np.int32)
+        pg[:n] = pages
+
+        def pad(a):
+            out = np.zeros(a.shape[:2] + (ppseq,) + a.shape[3:], a.dtype)
+            out[:, :, :n] = a
+            return out
+        self.k_pool = self.k_pool.at[:, :, pg].set(
+            jnp.asarray(pad(k), self.k_pool.dtype))
+        self.v_pool = self.v_pool.at[:, :, pg].set(
+            jnp.asarray(pad(v), self.v_pool.dtype))
+        if self.cache_quant:
+            self.k_scale = self.k_scale.at[:, :, pg].set(
+                jnp.asarray(pad(ks), jnp.float32))
+            self.v_scale = self.v_scale.at[:, :, pg].set(
+                jnp.asarray(pad(vs), jnp.float32))
+
+    def _drop_offload(self, req):
+        """Forget a waiting request's host-stashed KV (cancel/failure
+        paths) — the tier ledger must not keep bytes for a request
+        that will never resume."""
+        if getattr(req, "_offload", None) is not None:
+            self.host_tier.stash_discard(id(req))
+        req._offload = None
 
     @staticmethod
     def _prefilling(req):
@@ -1398,18 +1452,85 @@ class ServingEngine:
         self.page_table[slot, :] = self.num_pages - 1
         self._slots[slot] = None
 
-    # -- prefix KV cache (serving/kvcache.py) -----------------------------
-    def _cache_acquire(self, feed):
+    # -- prefix KV cache (serving/kvcache.py + serving/kvtier.py) ---------
+    def _cache_acquire(self, feed, req=None):
         """Longest-prefix match for an admission candidate; matched
         pages are ref-counted immediately, so nothing later in this
-        admission wave can evict them. Returns (pages, cached_tokens)."""
+        admission wave can evict them. Lookup falls through device ->
+        host: where the device match ends, the host tier's index takes
+        over and hits are restored into fresh device pages. Returns
+        (pages, cached_tokens)."""
         pc = self.prefix_cache
         if pc is None:
             return [], 0
         pages, cached = pc.match(feed)
         if pages:
             self.pool.incref(pages)
+        if self.host_tier.enabled:
+            pages, cached = self._tier_restore(feed, pages, cached, req)
         return pages, cached
+
+    def _tier_restore(self, feed, pages, cached, req):
+        """Second lookup level: continue the prefix walk into the host
+        tier and swap hits back in through the preemption restore
+        machinery (`_scatter_host_kv`), re-indexing them in the device
+        cache so this request — and every later one — maps them like
+        ordinary cached pages. Restored pages arrive refcount-1 from
+        alloc, matching the incref the device match took on its own
+        pages, so `_cache_unacquire` treats both uniformly."""
+        tier = self.host_tier
+        blocks = tier.match(feed, cached)
+        room = min(self.pages_per_seq - len(pages),
+                   self.pool.available())
+        n = min(len(blocks), max(room, 0))
+        if n == 0:
+            tier.note_lookup(0)
+            return pages, cached
+        blocks = blocks[:n]
+        # alloc may evict — and spill — OTHER parked pages; this
+        # candidate's device-matched prefix is already increfed, so
+        # the restore can never cannibalize its own chain
+        new_pages = self.pool.alloc(n)
+        k = np.stack([b["k"] for b in blocks], axis=2)
+        v = np.stack([b["v"] for b in blocks], axis=2)
+        ks = vs = None
+        if blocks[0]["ks"] is not None:
+            ks = np.stack([b["ks"] for b in blocks], axis=2)
+            vs = np.stack([b["vs"] for b in blocks], axis=2)
+        if ks is not None and not self.cache_quant:
+            # int8-quantized tier over an fp pool: dequantize on host
+            # (same absmax/127 scheme as the engine's int8 cache) and
+            # scatter full-precision values
+            from ..serving.kvtier import _dequantize_host
+            k = _dequantize_host(k, ks)
+            v = _dequantize_host(v, vs)
+            ks = vs = None
+        self._scatter_host_kv(new_pages, k, v, ks, vs)
+        all_pages = pages + new_pages
+        new_cached = cached + n * self.page_size
+        self.prefix_cache.insert(feed, all_pages, new_cached)
+        tier.note_lookup(n)
+        _flight.record(
+            "kvtier.hit", rid=None if req is None else str(req.rid),
+            trace_id=None if req is None
+            else getattr(req, "_trace_id", None),
+            pages=n, tokens=n * self.page_size,
+            device_cached=cached)
+        return all_pages, new_cached
+
+    def _spill_page(self, page, parent, block, depth):
+        """Prefix-cache eviction hook: demote the page's KV to the
+        host tier instead of discarding it. Slicing the pools HERE
+        (pump thread) pins the page's current contents — jax arrays
+        are functional, so the slices stay valid while the allocator
+        re-issues the page and later steps overwrite it; the blocking
+        device->host fence runs on the tier's copy thread."""
+        self.host_tier.spill_async(
+            parent, block, depth,
+            self.k_pool[:, :, page], self.v_pool[:, :, page],
+            None if self.k_scale is None else self.k_scale[:, :, page],
+            None if self.v_scale is None else self.v_scale[:, :, page],
+            prequantized=self.cache_quant)
 
     def _cache_unacquire(self, req):
         """Admission did not take the candidate after all: drop its
@@ -1454,6 +1575,7 @@ class ServingEngine:
             pc.hits += 1
             pc.tokens_reused += cached
             _flight.record("kvcache.hit", rid=str(req.rid),
+                           trace_id=getattr(req, "_trace_id", None),
                            cached_tokens=cached, pages=len(match[0]))
         m = self.metrics
         if m is not None:

@@ -10,6 +10,11 @@ Layers (docs/serving.md has the architecture):
                   sharing a prompt prefix share physical KV pages and
                   prefill only their suffix (host-side numpy, no
                   device or model imports).
+  * `kvtier`    — bounded host-RAM KV tier under the prefix cache:
+                  LRU evictions demote pages to host memory
+                  (int8-quantized, async copies off the pump thread),
+                  lookups fall through device -> host, and the
+                  preemption offload stash shares the bytes ledger.
   * `scheduler` — thread-safe bounded request queue with priority
                   classes, deadlines/TTLs, cancellation, backpressure
                   (`BackpressureError`), and graceful drain.
@@ -31,10 +36,11 @@ the engine arrives as a constructor argument — so
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    client, kvcache, metrics, replica, router, scheduler, server,
+    client, kvcache, kvtier, metrics, replica, router, scheduler, server,
 )
 from .client import ServingClient, ServingHTTPError  # noqa: F401
 from .kvcache import PagePool, PrefixCache  # noqa: F401
+from .kvtier import HostTier  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, EngineMetrics, Gauge, Histogram, MetricsRegistry,
 )
@@ -49,10 +55,10 @@ from .scheduler import (  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
-    "client", "kvcache", "metrics", "replica", "router", "scheduler",
-    "server",
+    "client", "kvcache", "kvtier", "metrics", "replica", "router",
+    "scheduler", "server",
     "ServingClient", "ServingHTTPError",
-    "PagePool", "PrefixCache",
+    "PagePool", "PrefixCache", "HostTier",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineMetrics",
     "Replica", "ReplicaKilledError", "build_replicas",
     "Router", "RouterRequest", "prefix_key",

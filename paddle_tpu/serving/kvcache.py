@@ -61,7 +61,10 @@ class PrefixCache:
 
     def __init__(self, page_size):
         self.page_size = int(page_size)
-        self.entries = {}        # chained hash -> (page, parent, block)
+        # chained hash -> (page, parent, block, depth); depth is the
+        # 1-based block index, carried so the host tier's drop policy
+        # knows how deep a spilled page sits in its chain
+        self.entries = {}
         self._page_key = {}      # indexed page -> chained hash
         self._lru = OrderedDict()  # rc==0 indexed pages; oldest evicted first
         # rollups (the engine's metrics hook mirrors these to /metrics)
@@ -70,6 +73,11 @@ class PrefixCache:
         self.tokens_reused = 0
         self.evictions = 0
         self.on_evict = None     # callable(page), set by the engine
+        # spill hook (serving/kvtier.py): called with the evicted
+        # entry's identity BEFORE the page id is re-issued, so the
+        # engine can demote its KV to the host tier instead of
+        # discarding it. None = evictions discard (seed behavior).
+        self.on_spill = None     # callable(page, parent, block, depth)
 
     # -- radix walk ---------------------------------------------------
     def _blocks(self, tokens, limit):
@@ -111,7 +119,7 @@ class PrefixCache:
                 # one key per page: never re-index a page that is
                 # already serving a different chain position
                 if pg not in self._page_key:
-                    self.entries[h] = (pg, parent, block)
+                    self.entries[h] = (pg, parent, block, i + 1)
                     self._page_key[pg] = h
                     added += 1
             elif e[1] != parent or e[2] != block:
@@ -138,13 +146,19 @@ class PrefixCache:
     def evict_lru(self):
         """Reclaim the least-recently-parked page: its index entry is
         removed (descendant entries become unreachable and age out)
-        and the page id is returned to the allocator."""
+        and the page id is returned to the allocator. With a spill
+        hook wired, the entry's KV is demoted to the host tier first —
+        the hook runs BEFORE the page can be re-issued, while its
+        contents are still the indexed block's."""
         page, key = self._lru.popitem(last=False)
-        self.entries.pop(key, None)
+        e = self.entries.pop(key, None)
         del self._page_key[page]
         self.evictions += 1
         _flight.record("kvcache.evict", page=int(page),
                        cached_pages=len(self._lru))
+        spill = self.on_spill
+        if spill is not None and e is not None:
+            spill(int(page), e[1], e[2], e[3])
         cb = self.on_evict
         if cb is not None:
             cb(int(page))

@@ -1,0 +1,311 @@
+"""KV-cache tiering: a bounded host-RAM spill tier under the device
+prefix cache.
+
+The device-side `PrefixCache` (serving/kvcache.py) parks refcount-0
+pages in an LRU and *discards* them when allocation needs the page
+back — a multi-turn conversation that returns after a busy burst
+re-prefills its whole history from scratch. HBM capacity is the
+effective caching ceiling (the Gemma-on-TPU serving study's
+per-replica bottleneck); host RAM is 10-100x larger and one PCIe/DMA
+copy away. `HostTier` turns the discard into a demotion:
+
+  * **spill**: the prefix cache's eviction hook hands the page's KV
+    (sliced off the device pools — jax arrays are functional, so the
+    slice stays valid however the pool is rewritten afterwards) to a
+    background copy thread. The blocking device→host transfer runs
+    THERE, never on the engine's pump thread; the tier indexes the
+    landed page under the SAME chained block hash as the device
+    cache, so lookup falls through device → host.
+  * **restore**: admission's longest-prefix walk continues into the
+    tier where the device match ends; hits are scattered back into
+    fresh device pages through the engine's preemption
+    offload/restore machinery and the request prefills only the
+    still-cold suffix — token-identical to a cold run.
+  * **quantized storage**: tier pages are stored int8 with per-token
+    fp32 scales (the same absmax/127 scheme as the engine's
+    `cache_dtype="int8"` pool — `_quantize_host` mirrors
+    `ops.paged_attention.quantize_kv` bit-for-bit), stretching host
+    capacity ~4x over fp32. An int8 device pool spills its pages
+    verbatim (already quantized: the round trip is lossless).
+  * **one ledger**: the engine's preemption `preempt_policy="offload"`
+    stash lives here too (`stash_put`/`stash_take`), pinned outside
+    the drop policy, so ALL host-resident KV is accounted against one
+    `tier_bytes` budget instead of an ad-hoc per-request side store.
+
+Budget pressure drops the DEEPEST spilled block first (ties: oldest):
+dropping a leaf never orphans descendants, and surviving roots keep
+serving partial-prefix hits.
+
+Pure numpy/stdlib at module level — no jax import. The copy worker's
+`np.asarray` on a device array IS the explicit fence, and it runs on
+the tier's own thread (tpulint TPL001/TPL005 quiet by design: this
+module is not in the configured hot-function set and never traces).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..observability import flight_recorder as _flight
+from . import kvcache as _kvc
+
+__all__ = ["HostTier"]
+
+
+def _quantize_host(x):
+    """Host-side mirror of `ops.paged_attention.quantize_kv` (absmax/127
+    per-token over the head dim, floored scale): np.round is
+    half-to-even exactly like jnp.round, so an fp32 page quantized here
+    dequantizes to the same values the engine's int8 pool would."""
+    xf = np.asarray(x, np.float32)
+    scale = np.max(np.abs(xf), axis=-1, keepdims=True) / 127.0
+    scale = np.maximum(scale, 1e-8).astype(np.float32)
+    q = np.clip(np.round(xf / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def _dequantize_host(q, scale):
+    return q.astype(np.float32) * scale
+
+
+def _nbytes(payload):
+    return sum(int(a.nbytes) for a in payload.values() if a is not None)
+
+
+class HostTier:
+    """Bounded host-RAM KV tier: spilled prefix pages + the preemption
+    offload stash, one bytes ledger.
+
+    Thread model: the engine's pump thread calls `match`/`note_*`/
+    `stash_*`; the tier's own copy worker inserts landed spills. All
+    shared state (`_entries`, `_stash`, the ledger and rollups) is
+    guarded by `self._lock`; the blocking device→host copy runs
+    OUTSIDE the lock on the worker thread.
+    """
+
+    def __init__(self, page_size, tier_bytes=0, quantize=True):
+        self.page_size = int(page_size)
+        self.tier_bytes = int(tier_bytes)
+        if self.tier_bytes < 0:
+            raise ValueError(f"tier_bytes={tier_bytes}: want >= 0")
+        self.quantize = bool(quantize)
+        self._lock = threading.Lock()
+        # chained hash -> entry dict(parent, block, depth, payload,
+        # nbytes); iteration order is recency (move_to_end on touch)
+        self._entries = OrderedDict()
+        self._stash = {}             # key -> (payload, nbytes, pages)
+        self._bytes = 0              # spill entries + stash, together
+        # rollups (mirrored to /metrics by EngineMetrics.on_step)
+        self.lookups = 0
+        self.hits = 0
+        self.spills = 0
+        self.restores = 0            # pages restored host -> device
+        self.drops = 0
+        self.tokens_reused = 0
+        self._q = None
+        self._worker = None
+
+    @property
+    def enabled(self):
+        """Spill side on? (The stash works regardless: preemption
+        offload must not depend on the spill budget being set.)"""
+        return self.tier_bytes > 0
+
+    # -- spill (pump thread enqueues; worker thread copies) ------------
+    def spill_async(self, parent, block, depth, k, v, ks=None, vs=None,
+                    prequantized=False):
+        """Queue one evicted page for demotion. `k`/`v` are the page's
+        device slices (L, KVH, page, D) — functional jax arrays, so
+        they keep their contents while the allocator reuses the page;
+        the worker fences them to host (`np.asarray`), quantizes when
+        the pool wasn't already int8, and indexes the landed page."""
+        if not self.enabled:
+            return False
+        if self._worker is None:
+            self._start_worker()
+        self._q.put((parent, block, depth, k, v, ks, vs, prequantized))
+        return True
+
+    def _start_worker(self):
+        self._q = queue.Queue()
+        self._worker = threading.Thread(target=self._copy_loop,
+                                        name="pt-kvtier-copy",
+                                        daemon=True)
+        self._worker.start()
+
+    def _copy_loop(self):
+        while True:
+            item = self._q.get()
+            try:
+                self._land(*item)
+            except Exception as e:  # noqa: BLE001 — a failed spill is a miss
+                _flight.record("kvtier.error", error=repr(e))
+            finally:
+                self._q.task_done()
+
+    def _land(self, parent, block, depth, k, v, ks, vs, prequantized):
+        # the explicit fence: device -> host, off the pump thread
+        k = np.asarray(k)
+        v = np.asarray(v)
+        ks = None if ks is None else np.asarray(ks, np.float32)
+        vs = None if vs is None else np.asarray(vs, np.float32)
+        if self.quantize and not prequantized:
+            k, ks = _quantize_host(k)
+            v, vs = _quantize_host(v)
+        payload = {"k": k, "v": v, "ks": ks, "vs": vs}
+        nb = _nbytes(payload)
+        key = _kvc.block_hash(parent, block)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None:
+                # re-spill of a block we already hold (or a colliding
+                # foreign chain — either way the stored entry wins)
+                self._entries.move_to_end(key)
+                return
+            self._entries[key] = {"parent": parent, "block": block,
+                                  "depth": int(depth), "payload": payload,
+                                  "nbytes": nb}
+            self._bytes += nb
+            self.spills += 1
+            self._shrink_locked()
+            held, pages = self._bytes, len(self._entries)
+        _flight.record("kvtier.spill", depth=int(depth), bytes=nb,
+                       tier_bytes=held, tier_pages=pages)
+
+    def _shrink_locked(self):
+        """Drop spilled entries until the ledger fits `tier_bytes` —
+        deepest block first (ties: oldest), so a drop never orphans
+        descendants and surviving roots keep matching. The pinned
+        stash is never dropped (preemption correctness outranks the
+        budget); it still counts, so heavy preemption pressure shrinks
+        the spill side."""
+        while self._bytes > self.tier_bytes and self._entries:
+            victim, depth = None, -1
+            for key, e in self._entries.items():  # oldest-first scan
+                if e["depth"] > depth:
+                    victim, depth = key, e["depth"]
+            e = self._entries.pop(victim)
+            self._bytes -= e["nbytes"]
+            self.drops += 1
+
+    def flush(self, timeout=None):
+        """Block until every queued spill has landed (tests/bench; the
+        serving path never needs it — a still-in-flight page is simply
+        a miss). `timeout` bounds the wait in seconds."""
+        if self._q is None:
+            return True
+        if timeout is None:
+            self._q.join()
+            return True
+        deadline = threading.Event()
+        t = threading.Thread(target=lambda: (self._q.join(),
+                                             deadline.set()),
+                             daemon=True)
+        t.start()
+        return deadline.wait(timeout)
+
+    # -- lookup / restore accounting (pump thread) ---------------------
+    def match(self, tokens, skip_tokens):
+        """Continue the device cache's longest-prefix walk into the
+        tier: re-derive the chained hashes of blocks 0..skip-1 (the
+        device-matched prefix), then match tier entries block by block
+        with raw (parent, block) verification — a hash collision falls
+        through to a miss, never wrong KV. Capped one token short of
+        len(tokens), same as the device match. Returns the matched
+        entries' payloads in chain order."""
+        ps = self.page_size
+        limit = (len(tokens) - 1) // ps
+        skip = int(skip_tokens) // ps
+        parent = _kvc._SEED
+        out = []
+        with self._lock:
+            if not self._entries:
+                return out
+            for b in range(limit):
+                block = tuple(int(t) for t in tokens[b * ps:(b + 1) * ps])
+                h = _kvc.block_hash(parent, block)
+                if b >= skip:
+                    e = self._entries.get(h)
+                    if e is None or e["parent"] != parent \
+                            or e["block"] != block:
+                        break
+                    out.append(e["payload"])
+                    self._entries.move_to_end(h)
+                parent = h
+        return out
+
+    def note_lookup(self, restored_pages):
+        """Admission probed the tier; `restored_pages` pages actually
+        made it back to the device (0 = miss)."""
+        with self._lock:
+            self.lookups += 1
+            if restored_pages > 0:
+                self.hits += 1
+                self.restores += restored_pages
+                self.tokens_reused += restored_pages * self.page_size
+
+    # -- preemption offload stash (pinned; same ledger) ----------------
+    def stash_put(self, key, payload, pages):
+        """Park a preempted request's KV (verbatim — restore must be
+        exact) under the shared ledger. Pinned: never dropped; spilled
+        prefix pages make room instead."""
+        nb = _nbytes(payload)
+        with self._lock:
+            if key in self._stash:
+                raise RuntimeError(f"kvtier: stash key {key!r} already "
+                                   "held (double preemption?)")
+            self._stash[key] = (payload, nb, int(pages))
+            self._bytes += nb
+            if self.enabled:
+                self._shrink_locked()
+
+    def stash_take(self, key):
+        with self._lock:
+            payload, nb, _ = self._stash.pop(key)
+            self._bytes -= nb
+        return payload
+
+    def stash_discard(self, key):
+        with self._lock:
+            item = self._stash.pop(key, None)
+            if item is not None:
+                self._bytes -= item[1]
+
+    # -- introspection -------------------------------------------------
+    @property
+    def host_bytes(self):
+        with self._lock:
+            return self._bytes
+
+    @property
+    def pages(self):
+        """Host-resident KV pages: spilled prefix pages + stash pages."""
+        with self._lock:
+            return len(self._entries) + sum(p for _, _, p
+                                            in self._stash.values())
+
+    @property
+    def hit_rate(self):
+        with self._lock:
+            return self.hits / self.lookups if self.lookups else 0.0
+
+    def stats(self):
+        with self._lock:
+            stash_pages = sum(p for _, _, p in self._stash.values())
+            return {"enabled": self.enabled,
+                    "tier_bytes": self.tier_bytes,
+                    "host_bytes": self._bytes,
+                    "pages": len(self._entries) + stash_pages,
+                    "spilled_pages": len(self._entries),
+                    "stash_entries": len(self._stash),
+                    "stash_pages": stash_pages,
+                    "quantized": self.quantize,
+                    "lookups": self.lookups, "hits": self.hits,
+                    "hit_rate": (self.hits / self.lookups
+                                 if self.lookups else 0.0),
+                    "spills": self.spills, "restores": self.restores,
+                    "drops": self.drops,
+                    "tokens_reused": self.tokens_reused}

@@ -307,6 +307,31 @@ class EngineMetrics:
         self.prefix_cached_pages = r.gauge(
             "pt_prefix_cached_pages",
             "Reclaimable rc==0 pages parked in the prefix cache.")
+        # host-RAM KV tier (serving/kvtier.py): evicted prefix pages
+        # demoted to host memory + the preemption offload stash, one
+        # ledger. Counters mirror the tier's own rollups via on_step
+        # deltas (spills land on the tier's copy thread; the mirror
+        # runs on the pump, so every series stays single-writer).
+        self.tier_spills = r.counter(
+            "pt_prefix_tier_spills",
+            "Evicted prefix pages spilled to the host-RAM tier.")
+        self.tier_hits = r.counter(
+            "pt_prefix_tier_hits",
+            "Admissions that matched KV in the host tier.")
+        self.tier_restores = r.counter(
+            "pt_prefix_tier_restores",
+            "KV pages restored host->device from the tier.")
+        self.tier_drops = r.counter(
+            "pt_prefix_tier_drops",
+            "Host-tier pages dropped under the tier_bytes budget.")
+        self.tier_host_bytes = r.gauge(
+            "pt_tier_host_bytes",
+            "Host RAM held by the KV tier (spilled pages + preemption "
+            "stash).")
+        self.tier_pages = r.gauge(
+            "pt_tier_pages", "KV pages resident in the host tier.")
+        self._tier_seen = {"spills": 0, "hits": 0, "restores": 0,
+                           "drops": 0}
 
     # -- engine-facing hooks (called from the step()-driving thread) --
     def on_submit(self, engine):
@@ -328,6 +353,20 @@ class EngineMetrics:
         pc = getattr(engine, "prefix_cache", None)
         if pc is not None:
             self.prefix_cached_pages.set(pc.cached_pages)
+        tier = getattr(engine, "host_tier", None)
+        if tier is not None:
+            st = tier.stats()
+            self.tier_host_bytes.set(st["host_bytes"])
+            self.tier_pages.set(st["pages"])
+            seen = self._tier_seen
+            for name, counter in (("spills", self.tier_spills),
+                                  ("hits", self.tier_hits),
+                                  ("restores", self.tier_restores),
+                                  ("drops", self.tier_drops)):
+                delta = st[name] - seen[name]
+                if delta > 0:
+                    counter.inc(delta)
+                    seen[name] = st[name]
         if not self._external_queue:
             depth = len(engine._waiting)
             self.queue_depth.set(depth)

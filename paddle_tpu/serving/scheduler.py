@@ -197,6 +197,10 @@ class RequestScheduler:
                     "retry later")
             sr = ServingRequest(self, req, priority, deadline,
                                 trace_id=trace_id)
+            # stamp the engine-level request too: engine-side flight
+            # records (kvcache.hit / kvtier.hit) carry the same trace
+            # id as the scheduler's spans without importing anything
+            req._trace_id = sr.trace_id
             _flight.record("sched.submit", rid=str(sr.rid),
                            trace_id=sr.trace_id, priority=priority,
                            ttl_s=ttl_s, prompt_tokens=len(req.prompt),
@@ -277,6 +281,9 @@ class RequestScheduler:
             pc = getattr(self._engine, "prefix_cache", None)
             if pc is not None:
                 st["prefix_cache"] = pc.stats()
+            tier = getattr(self._engine, "host_tier", None)
+            if tier is not None:
+                st["kv_tier"] = tier.stats()
             return st
 
     def readiness(self):
@@ -507,6 +514,11 @@ class RequestScheduler:
                         eng._release(s)
             finally:
                 eng._index_suspend = False
+            # waiting requests may hold offloaded KV in the host
+            # tier's pinned stash — release it, or the tier ledger
+            # leaks bytes for requests that will never resume
+            for r in eng._waiting:
+                eng._drop_offload(r)
             eng._waiting.clear()
             for sr in list(self._inflight.values()):
                 sr.error = SchedulerError(

@@ -27,6 +27,7 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
+    monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "ngram-repetitive"
     assert out["spec_accept_rate"] > 0, out
@@ -86,6 +87,7 @@ def test_prefix_bench_reuses_cached_pages(monkeypatch):
     monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
+    monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.setenv("PT_SERVE_PREFIX", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "shared-prefix"
@@ -95,12 +97,37 @@ def test_prefix_bench_reuses_cached_pages(monkeypatch):
     _assert_metrics_snapshot(out)
 
 
+def test_multiturn_bench_hits_the_host_tier(monkeypatch):
+    """PT_SERVE_MULTITURN=1 (ISSUE 7 acceptance): returning
+    conversations must actually hit the host-RAM tier after the burst
+    evicted them — nonzero hit rate, spills, reused tokens — and show
+    STRICTLY fewer returning-phase prefill tokens than the tier-off
+    baseline at token-identical outputs."""
+    bm = _load_bench_models()
+    monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
+    monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
+    monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
+    monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
+    monkeypatch.setenv("PT_SERVE_MULTITURN", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "multi-turn"
+    assert out["outputs_match"] is True, out
+    assert out["tier_hit_rate"] > 0, out
+    assert out["tier_spills"] > 0 and out["tokens_reused"] > 0, out
+    assert out["returning_prefill_tokens"] < \
+        out["baseline_prefill_tokens"], out
+    assert out["tier_host_bytes"] > 0 and out["tier_pages"] > 0
+    assert out["returning_tokens_per_sec"] > 0
+    assert out["baseline_returning_tokens_per_sec"] > 0
+
+
 def test_plain_bench_unaffected(monkeypatch):
     bm = _load_bench_models()
     monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
+    monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["decode_tokens_per_sec"] > 0
     assert "spec_decode" not in out
@@ -118,6 +145,7 @@ def test_router_bench_snapshot(monkeypatch):
     monkeypatch.delenv("PT_SERVE_SPEC", raising=False)
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
+    monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.setenv("PT_SERVE_ROUTER", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "router-shared-prefix"

@@ -1,0 +1,453 @@
+"""KV-cache tiering (serving/kvtier.py): host-RAM spill tier for
+evicted prefix pages + int8-quantized tier storage — unit invariants
+plus engine/HTTP end-to-end.
+
+Invariants under test (ISSUE 7):
+  * spill-then-restore is token-identical to a cold engine across
+    plain/spec/chunked/int8/preemption modes (including the
+    int8-quantized tier over an fp32 pool);
+  * the pool conservation invariant survives tier restores, and the
+    tier's bytes ledger always equals what it holds;
+  * budget pressure drops the DEEPEST spilled block first — roots
+    survive to serve partial-prefix hits;
+  * a hash collision in the tier falls through to a miss, never wrong
+    KV; an in-flight (not yet landed) spill is a miss, never a hang;
+  * the preemption offload stash and the spill tier share ONE bytes
+    ledger (pinned stash entries are never dropped).
+"""
+import io
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models import llama_spmd as M
+from paddle_tpu.models.llama_serving import ServingEngine, Request
+from paddle_tpu.serving import kvcache as K
+from paddle_tpu.serving.kvtier import (HostTier, _dequantize_host,
+                                       _quantize_host)
+
+CFG = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                       ffn=64, seq=128)
+
+# turn-1 prompt of the acceptance scenario: 12 tokens -> with 6
+# generated, exactly 2 full pages (16 tokens) park at release
+TURN1 = list(range(1, 13))
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(CFG, seed=0, dtype=jnp.float32)
+
+
+def greedy_reference(params, prompt, n_new):
+    ids = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = M.forward(params, jnp.asarray([ids]), CFG, mesh=None,
+                           remat=False)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+def make_engine(params, **kw):
+    kw.setdefault("max_seqs", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("use_pallas", False)
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("num_pages", 11)
+    kw.setdefault("host_tier_bytes", 1 << 20)
+    return ServingEngine(params, CFG, **kw)
+
+
+def thrash(eng, n=5, seed=7, max_new=6):
+    """Churn the device cache: n distinct prompts (disjoint leading
+    token — no block-aligned prefix sharing with anything) run to
+    completion one at a time, so parking pressure accumulates until
+    the LRU evicts (and the tier absorbs) every earlier page."""
+    rng = np.random.RandomState(seed)
+    for i in range(n):
+        p = [40 + 2 * i] + list(map(int, rng.randint(1, 64, 16)))
+        eng.submit(Request(f"burst{i}", p, max_new_tokens=max_new))
+        eng.run()
+
+
+def assert_conserved(eng):
+    c = eng.pool.counts()
+    assert c["free"] + c["cached"] + c["live"] == eng.num_pages - 1, c
+
+
+def run_conversation(eng, rid, max_new=6):
+    eng.submit(Request(rid, TURN1, max_new_tokens=max_new))
+    done = eng.run()
+    return [r for r in done if r.rid == rid][-1].output
+
+
+class TestHostQuantization:
+    def test_host_quant_matches_the_engine_int8_path(self):
+        """The tier's host-side quantizer must be bit-identical to
+        `ops.paged_attention.quantize_kv` — the engine's int8 pool
+        path — so a quantized tier page dequantizes to exactly the
+        values an int8 cache would have served."""
+        from paddle_tpu.ops.paged_attention import (dequantize_kv,
+                                                    quantize_kv)
+        x = np.random.RandomState(0).randn(2, 2, 8, 4).astype(np.float32)
+        hq, hs = _quantize_host(x)
+        jq, js = quantize_kv(jnp.asarray(x))
+        np.testing.assert_array_equal(hq, np.asarray(jq))
+        np.testing.assert_array_equal(hs, np.asarray(js))
+        np.testing.assert_array_equal(
+            _dequantize_host(hq, hs), np.asarray(dequantize_kv(jq, js)))
+
+    def test_all_zero_page_quantizes_safely(self):
+        q, s = _quantize_host(np.zeros((1, 1, 4, 4), np.float32))
+        assert (q == 0).all() and (s > 0).all()
+
+
+def _chain(tokens, ps=2):
+    """(parent, block, depth) triples of a token chain, exactly as the
+    prefix cache would hash them."""
+    parent = K._SEED
+    out = []
+    for b in range(len(tokens) // ps):
+        block = tuple(tokens[b * ps:(b + 1) * ps])
+        out.append((parent, block, b + 1))
+        parent = K.block_hash(parent, block)
+    return out
+
+
+def _page(v, shape=(1, 1, 2, 2)):
+    return np.full(shape, float(v), np.float32)
+
+
+class TestHostTierUnit:
+    def test_spill_lands_and_matches_in_chain_order(self):
+        tier = HostTier(2, tier_bytes=1 << 20, quantize=False)
+        for i, (parent, block, depth) in enumerate(_chain([1, 2, 3, 4])):
+            tier.spill_async(parent, block, depth, _page(i), _page(10 + i))
+        assert tier.flush(timeout=10)
+        got = tier.match([1, 2, 3, 4, 9], 0)
+        assert [g["k"][0, 0, 0, 0] for g in got] == [0.0, 1.0]
+        # the device cache already covered block 0: tier serves only
+        # the continuation
+        got = tier.match([1, 2, 3, 4, 9], 2)
+        assert [g["k"][0, 0, 0, 0] for g in got] == [1.0]
+        assert tier.stats()["spills"] == 2
+
+    def test_match_capped_one_token_short(self):
+        tier = HostTier(2, tier_bytes=1 << 20, quantize=False)
+        for parent, block, depth in _chain([1, 2, 3, 4]):
+            tier.spill_async(parent, block, depth, _page(0), _page(0))
+        assert tier.flush(timeout=10)
+        # a 4-token lookup may use at most 1 block: the engine must
+        # always prefill >= 1 suffix token for next-token logits
+        assert len(tier.match([1, 2, 3, 4], 0)) == 1
+        assert len(tier.match([1, 2, 3, 4, 5], 0)) == 2
+
+    def test_collision_falls_through_to_miss(self, monkeypatch):
+        monkeypatch.setattr(K, "block_hash", lambda parent, block: 7)
+        tier = HostTier(2, tier_bytes=1 << 20, quantize=False)
+        tier.spill_async(K._SEED, (1, 2), 1, _page(1), _page(1))
+        assert tier.flush(timeout=10)
+        # same (constant) hash, different block: raw verification must
+        # refuse the entry — no reuse, never wrong KV
+        assert tier.match([3, 4, 9], 0) == []
+        assert len(tier.match([1, 2, 9], 0)) == 1
+
+    def test_budget_drops_deepest_block_first(self):
+        entry = 2 * _page(0).nbytes          # k + v, unquantized
+        tier = HostTier(2, tier_bytes=2 * entry, quantize=False)
+        for parent, block, depth in _chain([1, 2, 3, 4, 5, 6]):
+            tier.spill_async(parent, block, depth, _page(depth),
+                             _page(depth))
+            assert tier.flush(timeout=10)
+        st = tier.stats()
+        assert st["drops"] == 1 and st["spilled_pages"] == 2
+        assert st["host_bytes"] == 2 * entry
+        # the leaf (depth 3) dropped; the surviving root+mid still
+        # serve a partial-prefix hit
+        assert len(tier.match([1, 2, 3, 4, 5, 6, 9], 0)) == 2
+
+    def test_quantized_roundtrip_through_the_tier(self):
+        tier = HostTier(2, tier_bytes=1 << 20, quantize=True)
+        x = np.random.RandomState(1).randn(2, 2, 2, 4).astype(np.float32)
+        tier.spill_async(K._SEED, (1, 2), 1, x, x)
+        assert tier.flush(timeout=10)
+        (e,) = tier.match([1, 2, 9], 0)
+        assert e["k"].dtype == np.int8 and e["ks"].dtype == np.float32
+        np.testing.assert_allclose(_dequantize_host(e["k"], e["ks"]), x,
+                                   atol=np.abs(x).max() / 127.0)
+
+    def test_inflight_spill_is_a_miss_then_lands(self):
+        """Restore racing a not-yet-landed spill: the lookup misses
+        (correct, never blocks); once the copy lands, it hits."""
+        gate = threading.Event()
+        arr = _page(3)
+
+        class Slow:
+            def __array__(self, dtype=None, copy=None):
+                gate.wait(10)
+                return arr if dtype is None else arr.astype(dtype)
+
+        tier = HostTier(2, tier_bytes=1 << 20, quantize=False)
+        tier.spill_async(K._SEED, (1, 2), 1, Slow(), Slow())
+        assert tier.match([1, 2, 9], 0) == []   # still in flight
+        gate.set()
+        assert tier.flush(timeout=10)
+        assert len(tier.match([1, 2, 9], 0)) == 1
+
+    def test_stash_shares_the_ledger_and_is_pinned(self):
+        entry = 2 * _page(0).nbytes
+        tier = HostTier(2, tier_bytes=2 * entry, quantize=False)
+        for parent, block, depth in _chain([1, 2, 3, 4]):
+            tier.spill_async(parent, block, depth, _page(0), _page(0))
+        assert tier.flush(timeout=10)
+        assert tier.stats()["spilled_pages"] == 2
+        big = {"k": np.zeros((1, 1, 3, 2, 2), np.float32),
+               "v": np.zeros((1, 1, 3, 2, 2), np.float32),
+               "ks": None, "vs": None}
+        tier.stash_put("r0", big, pages=3)
+        st = tier.stats()
+        # the pinned stash pushed BOTH spill entries out, and survives
+        assert st["stash_entries"] == 1 and st["spilled_pages"] == 0
+        assert st["host_bytes"] == big["k"].nbytes + big["v"].nbytes
+        assert st["pages"] == 3
+        with pytest.raises(RuntimeError, match="already held"):
+            tier.stash_put("r0", big, pages=3)
+        assert tier.stash_take("r0")["k"] is big["k"]
+        assert tier.stats()["host_bytes"] == 0
+        tier.stash_discard("r0")                 # idempotent
+
+
+class TestEngineTierRestore:
+    def _returning_turn(self, params, eng, out1, n_new=6):
+        """Build turn 2, run it, and return (request, reference,
+        prefill-token delta)."""
+        t2 = TURN1 + out1 + [50, 51]
+        ref = greedy_reference(params, t2, n_new)
+        pt0 = eng.prefill_tokens
+        eng.submit(Request("t2", t2, max_new_tokens=n_new))
+        eng.run()
+        req = {r.rid: r for r in eng.finished}["t2"]
+        return req, ref, eng.prefill_tokens - pt0, len(t2)
+
+    @pytest.mark.parametrize("kw", [
+        {},
+        {"spec_decode": 4},
+        {"spec_decode": 4, "chunked_prefill": True},
+        {"cache_dtype": "int8"},
+        {"cache_dtype": "int8", "spec_decode": 4},
+    ], ids=["plain", "spec", "chunked", "int8", "int8-spec"])
+    def test_spill_then_restore_token_identical(self, params, kw):
+        """The ISSUE acceptance core: a conversation evicted to the
+        host tier by a burst restores on return and generates
+        token-identically to a cold engine — while prefilling strictly
+        fewer tokens than its prompt (the restored prefix never
+        touches the device's prefill path)."""
+        eng = make_engine(params, **kw)
+        out1 = run_conversation(eng, "t1")
+        thrash(eng)
+        assert eng.host_tier.flush(timeout=30)
+        assert eng.host_tier.stats()["spills"] > 0
+        req, ref, dprefill, t2_len = self._returning_turn(params, eng, out1)
+        assert req.output == ref
+        assert eng.host_tier.stats()["hits"] >= 1
+        assert req.cached_tokens > 0
+        assert dprefill < t2_len
+        assert dprefill == t2_len - req.cached_tokens
+        assert_conserved(eng)
+
+    def test_preemption_mode_keeps_exactness_and_one_ledger(self, params):
+        """Oversubscribed pool + tier: preemption offload stashes ride
+        the SAME tier ledger as spilled prefix pages, victims resume
+        exactly, and the stash drains back to zero entries."""
+        pa, pb = [1, 5, 9, 3], [2, 6, 4, 8]
+        ra, rb = (greedy_reference(params, p, 14) for p in (pa, pb))
+        eng = make_engine(params, max_seq_len=32, num_pages=6)
+        eng.submit(Request("a", pa, max_new_tokens=14))
+        eng.submit(Request("b", pb, max_new_tokens=14))
+        saw_stash = 0
+        steps = 0
+        while eng.step():
+            saw_stash = max(saw_stash,
+                            eng.host_tier.stats()["stash_entries"])
+            assert_conserved(eng)
+            steps += 1
+            assert steps < 400
+        out = {r.rid: r.output for r in eng.finished}
+        assert out["a"] == ra and out["b"] == rb
+        assert eng.preemptions > 0
+        assert saw_stash >= 1, "offload never reached the tier stash"
+        st = eng.host_tier.stats()
+        assert st["stash_entries"] == 0 and st["stash_pages"] == 0
+        # whatever bytes remain are spilled prefix pages, exactly
+        assert st["host_bytes"] == 0 or st["spilled_pages"] > 0
+
+    def test_tier_on_equals_tier_off(self, params):
+        """Token-identical outputs for the whole conversation+burst+
+        return workload with the tier on vs off (off = evictions
+        discard, returns re-prefill)."""
+        outs = {}
+        for hb in (0, 1 << 20):
+            eng = make_engine(params, host_tier_bytes=hb)
+            out1 = run_conversation(eng, "t1")
+            thrash(eng)
+            eng.host_tier.flush(timeout=30)
+            eng.submit(Request("t2", TURN1 + out1 + [50, 51],
+                               max_new_tokens=6))
+            eng.run()
+            outs[hb] = {r.rid: r.output for r in eng.finished}
+        assert outs[0] == outs[1 << 20]
+
+    def test_restore_races_admission_safely(self, params):
+        """Submitting the returning turn with spills still in flight
+        must stay correct: a pending spill is a miss (cold prefill),
+        never a hang or wrong KV."""
+        eng = make_engine(params)
+        out1 = run_conversation(eng, "t1")
+        thrash(eng)
+        # NO flush: the return may race the copy worker
+        req, ref, dprefill, t2_len = self._returning_turn(params, eng, out1)
+        assert req.output == ref
+        assert 0 < dprefill <= t2_len
+        assert_conserved(eng)
+
+    def test_budget_zero_is_seed_behavior(self, params):
+        """host_tier_bytes=0 (the default): evictions discard exactly
+        as before — no spill hook, no worker, no host bytes."""
+        eng = make_engine(params, host_tier_bytes=0)
+        assert eng.prefix_cache.on_spill is None
+        run_conversation(eng, "t1")
+        thrash(eng)
+        st = eng.host_tier.stats()
+        assert not st["enabled"]
+        assert st["spills"] == 0 and st["host_bytes"] == 0
+        assert eng.host_tier._worker is None
+        assert eng.prefix_cache.evictions > 0
+
+    def test_tier_requires_prefix_cache(self, params):
+        with pytest.raises(ValueError, match="prefix_cache"):
+            make_engine(params, prefix_cache=False)
+
+    def test_cancelled_waiting_victim_releases_its_stash(self, params):
+        """A preempted (offloaded) request cancelled while re-queued
+        must release its pinned stash — the ledger cannot leak bytes
+        for a request that will never resume."""
+        eng = make_engine(params, max_seq_len=32, num_pages=6)
+        eng.submit(Request("a", [1, 5, 9, 3], max_new_tokens=16))
+        eng.submit(Request("b", [2, 6, 4, 8], max_new_tokens=16))
+        victim = None
+        for _ in range(300):
+            eng.step()
+            waiting_offloaded = [r for r in eng._waiting
+                                 if getattr(r, "_offload", None)]
+            if waiting_offloaded:
+                victim = waiting_offloaded[0]
+                break
+        assert victim is not None, "no preemption reached the queue"
+        assert eng.host_tier.stats()["stash_entries"] == 1
+        eng.cancel(victim)
+        assert eng.host_tier.stats()["stash_entries"] == 0
+        eng.run()       # the survivor finishes cleanly
+        assert_conserved(eng)
+
+    def test_deep_chains_restore_multiple_pages(self, params):
+        """A 3-full-page history restores every full block the tier
+        holds (match capped one short of the prompt)."""
+        eng = make_engine(params)
+        p = list(range(1, 19))                       # 18 tokens
+        eng.submit(Request("t1", p, max_new_tokens=6))
+        eng.run()                                    # 24 tokens -> 3 pages
+        thrash(eng)
+        assert eng.host_tier.flush(timeout=30)
+        t2 = p + {r.rid: r for r in eng.finished}["t1"].output + [50]
+        ref = greedy_reference(params, t2, 4)
+        eng.submit(Request("t2", t2, max_new_tokens=4))
+        eng.run()
+        req = {r.rid: r for r in eng.finished}["t2"]
+        assert req.output == ref
+        assert req.cached_tokens >= 2 * eng.page_size
+        assert eng.host_tier.stats()["restores"] >= 2
+
+
+class TestTierHTTP:
+    def test_acceptance_e2e_returning_conversation(self, params):
+        """ISSUE acceptance over real HTTP: a returning conversation
+        hits the host tier after a burst evicted it — usage block
+        carries cached_tokens, /metrics shows pt_prefix_tier_* and
+        pt_tier_* series, healthz ships the tier ledger, and the
+        kvtier.spill / kvtier.hit flight records carry the request's
+        trace id."""
+        from paddle_tpu.observability import flight_recorder as _flight
+        from paddle_tpu.serving import ServingClient, ServingServer
+        eng = make_engine(params)
+        srv = ServingServer(eng, port=0).start()
+        try:
+            c = ServingClient(port=srv.port)
+            r1 = c.complete(TURN1, max_tokens=6)
+            assert r1["usage"]["cached_tokens"] == 0
+            rng = np.random.RandomState(9)
+            for i in range(5):
+                c.complete([40 + 2 * i] + list(map(int, rng.randint(
+                    1, 64, 16))), max_tokens=6)
+            assert eng.host_tier.flush(timeout=30)
+            t2 = TURN1 + r1["tokens"] + [50, 51]
+            r2 = c.complete(t2, max_tokens=6)
+            assert r2["usage"]["cached_tokens"] > 0
+            assert r2["usage"]["prompt_tokens"] == len(t2)
+            text = c.metrics_text()
+            vals = {}
+            for line in text.splitlines():
+                if line.startswith("pt_prefix_tier_") or \
+                        line.startswith("pt_tier_"):
+                    name, v = line.split()
+                    vals[name] = float(v)
+            assert vals["pt_prefix_tier_spills_total"] > 0, vals
+            assert vals["pt_prefix_tier_hits_total"] >= 1, vals
+            assert vals["pt_prefix_tier_restores_total"] >= 1, vals
+            assert vals["pt_tier_host_bytes"] > 0, vals
+            assert vals["pt_tier_pages"] > 0, vals
+            h = c.healthz()
+            assert h["kv_tier"]["hits"] >= 1
+            assert h["kv_tier"]["tokens_reused"] > 0
+            spills = _flight.RECORDER.events("kvtier.spill")
+            assert spills and spills[-1]["bytes"] > 0
+            # the hit record carries the SAME trace id the HTTP
+            # response echoed — request-scoped across the tier hop
+            hits = [e for e in _flight.RECORDER.events("kvtier.hit")
+                    if e.get("trace_id") == r2["trace_id"]]
+            assert hits and hits[-1]["pages"] >= 1
+        finally:
+            srv.stop(drain=True, timeout=30)
+
+
+class TestPtdumpTierRollup:
+    def test_flight_dump_humanizes_tier_traffic(self):
+        import importlib.util
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "ptdump", os.path.join(root, "tools", "ptdump.py"))
+        ptdump = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(ptdump)
+        doc = {"pid": 1, "dumped_at": 0.0, "reason": "test",
+               "capacity": 16, "dropped": 0,
+               "events": [
+                   {"kind": "kvtier.spill", "ts": 1.0, "seq": 1,
+                    "depth": 2, "bytes": 4096, "tier_bytes": 8192,
+                    "tier_pages": 2},
+                   {"kind": "kvtier.hit", "ts": 2.0, "seq": 2,
+                    "rid": "r1", "trace_id": "t", "pages": 2,
+                    "tokens": 16, "device_cached": 0},
+               ]}
+        out = io.StringIO()
+        ptdump.print_flight(doc, out=out)
+        text = out.getvalue()
+        assert "kv tier: 1 spills" in text
+        assert "1 hits (2 pages / 16 tokens restored)" in text
+        assert "4.0KiB demoted" in text

@@ -117,6 +117,23 @@ def print_flight(doc, tail=30, kind=None, out=sys.stdout):
         w(f"  cost {fn}: {_human_flops(e.get('flops'))}"
           f" {_human_bytes(e.get('bytes_accessed'))} accessed,"
           f" hbm {_human_bytes(hbm)}\n")
+    # KV tiering rollup: spill/hit traffic through the host-RAM tier
+    # (kvtier.spill carries the landed page's bytes + the ledger it
+    # left behind; kvtier.hit the pages restored to the device)
+    spills = [e for e in events if e.get("kind") == "kvtier.spill"]
+    thits = [e for e in events if e.get("kind") == "kvtier.hit"]
+    if spills or thits:
+        sp_bytes = sum(e.get("bytes") or 0 for e in spills)
+        re_pages = sum(e.get("pages") or 0 for e in thits)
+        re_tokens = sum(e.get("tokens") or 0 for e in thits)
+        w(f"  kv tier: {len(spills)} spills "
+          f"({_human_bytes(sp_bytes)} demoted), {len(thits)} hits "
+          f"({re_pages} pages / {re_tokens} tokens restored)")
+        if spills:
+            last = spills[-1]
+            w(f"; holding {_human_bytes(last.get('tier_bytes'))} "
+              f"in {last.get('tier_pages')} pages")
+        w("\n")
     health = [e for e in events if e.get("kind") == "health"]
     if health:
         bad = sum(e.get("count", 0) or 0 for e in health)
