@@ -196,6 +196,11 @@ def bench_serving(on_tpu):
     # vs a tier-off baseline at token-identical outputs
     if (os.environ.get("PT_SERVE_MULTITURN", "") or "0") not in ("", "0"):
         return _bench_serving_multiturn(on_tpu, params, cfg, dtype)
+    # PT_SERVE_PIPELINE=1: the double-buffered pump + device-side
+    # sampling vs the synchronous pump at equal config and
+    # token-identical outputs (serving/scheduler.py; ROADMAP item 4)
+    if (os.environ.get("PT_SERVE_PIPELINE", "") or "0") not in ("", "0"):
+        return _bench_serving_pipeline(on_tpu, params, cfg, dtype)
 
     rng = _data_rng()
     if prefix_mode:
@@ -333,6 +338,12 @@ def bench_serving(on_tpu):
                "device_steps": snap["pt_serving_device_steps"]["value"],
                "preemptions": snap["pt_serving_preemptions"]["value"],
                "page_allocs": snap["pt_serving_page_allocs"]["value"],
+               # host time between device-step launches (ISSUE 8):
+               # the sync-loop number the pipelined pump shrinks
+               "host_gap_p50_s":
+                   round(snap["pt_step_host_gap_seconds"]["p50"], 6),
+               "host_gap_count":
+                   snap["pt_step_host_gap_seconds"]["count"],
            },
            "loss": 0.0}
     if prefix_mode:
@@ -357,6 +368,94 @@ def bench_serving(on_tpu):
         out["plain_decode_tokens_per_sec"] = round(ptotal / pdt, 1)
         out["spec_speedup"] = round((total_new / dt) / (ptotal / pdt), 3)
     return out
+
+
+def _bench_serving_pipeline(on_tpu, params, cfg, dtype):
+    """PT_SERVE_PIPELINE=1: kill the per-step host round-trip. The same
+    workload — a mix of greedy and seeded-sampling requests — runs
+    through the RequestScheduler twice at equal engine config: once
+    with the synchronous pump (launch -> blocked read -> bookkeeping ->
+    launch) and once with the double-buffered pump (launch N+1 before
+    consuming N; sampling/stop conditions already evaluated on device).
+    The artifact carries `outputs_match` (token-identical is the
+    contract, greedy AND seeded sampling), the measured
+    pt_step_host_gap_seconds distribution for both pumps, and the
+    tok/s delta."""
+    from paddle_tpu.models.llama_serving import ServingEngine
+    from paddle_tpu.serving.metrics import MetricsRegistry
+    from paddle_tpu.serving.scheduler import RequestScheduler
+
+    if on_tpu:
+        max_seqs, new_tok, nreq = 8, 128, 16
+        max_seq_len, page = 1024, 16
+    else:
+        max_seqs, new_tok, nreq = 4, 32, 8
+        max_seq_len, page = 128, 8
+    rng = _data_rng()
+    reqs = []
+    for i in range(nreq):
+        prompt = list(map(int, rng.randint(
+            1, cfg.vocab_size, int(rng.randint(8, 48)) if on_tpu else 4)))
+        kw = {"max_new_tokens": new_tok}
+        if i % 3 == 2:   # every third request samples, seeded
+            kw.update(temperature=0.8, top_k=8, top_p=0.95, seed=100 + i)
+        reqs.append((prompt, kw))
+
+    def run_pump(pipeline, warm=True):
+        if warm:
+            # full-trajectory warmup (same pattern as the multiturn
+            # bench): admission-wave composition decides which varlen
+            # prefill buckets compile, so a scaled-down warm run leaves
+            # a first-wave compile inside the timed region — and the
+            # sync-vs-pipelined comparison must time both sides warm
+            run_pump(pipeline, warm=False)
+        eng = ServingEngine(params, cfg, max_seqs=max_seqs,
+                            max_seq_len=max_seq_len, page_size=page,
+                            dtype=dtype,
+                            use_pallas=None if on_tpu else False)
+        sched = RequestScheduler(eng, max_queue=nreq,
+                                 metrics=MetricsRegistry(),
+                                 pipeline=pipeline)
+        # submit under pause(): the pump sees the whole queue at once,
+        # so the admission-wave composition — and with it the varlen
+        # prefill bucket set — is identical for every run instead of a
+        # race against the submitting thread (a wave-size change is a
+        # fresh prefill bucket, i.e. an XLA compile inside the timing)
+        sched.pause()
+        t0 = time.perf_counter()
+        handles = [sched.submit(prompt, **kw) for prompt, kw in reqs]
+        sched.resume()
+        outs = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+        snap = sched.metrics_snapshot()
+        sched.shutdown(drain=True, timeout=60)
+        total = sum(len(o) for o in outs)
+        return outs, total / dt, snap
+
+    sync_outs, sync_tps, sync_snap = run_pump(False)
+    pipe_outs, pipe_tps, pipe_snap = run_pump(True)
+
+    def gap(snap):
+        h = snap["pt_step_host_gap_seconds"]
+        return {"p50_s": round(h["p50"], 6), "p99_s": round(h["p99"], 6),
+                "mean_s": round(h["sum"] / max(h["count"], 1), 6),
+                "count": h["count"]}
+    sync_gap, pipe_gap = gap(sync_snap), gap(pipe_snap)
+    return {
+        "workload": "pipelined-pump",
+        "outputs_match": sync_outs == pipe_outs,
+        "requests": nreq, "new_tokens": sum(len(o) for o in pipe_outs),
+        "batch": max_seqs,
+        "decode_tokens_per_sec": round(pipe_tps, 1),
+        "sync_decode_tokens_per_sec": round(sync_tps, 1),
+        "tok_s_delta": round(pipe_tps / max(sync_tps, 1e-9) - 1.0, 4),
+        "host_gap_sync": sync_gap,
+        "host_gap_pipelined": pipe_gap,
+        "host_gap_reduction": round(
+            1.0 - pipe_gap["mean_s"] / max(sync_gap["mean_s"], 1e-12), 4),
+        "pipeline_depth": pipe_snap["pt_pipeline_depth"]["value"],
+        "loss": 0.0,
+    }
 
 
 def _bench_serving_router(on_tpu, params, cfg, dtype):

@@ -45,6 +45,13 @@ class LintConfig:
     exclude: list = field(default_factory=list)
     # Per-rule severity overrides: {"TPL002": "info"}.
     severity: dict = field(default_factory=dict)
+    # The sanctioned async result reader(s) of the serving pump loop:
+    # the ONLY functions in a hot module allowed to call
+    # jax.device_get. TPL001 skips findings inside them AND flags any
+    # device_get in a hot module outside them — the pipelined pump's
+    # invariant ("one batched read, issued a step behind") is enforced
+    # by lint, not convention.
+    sanctioned_sync: list = field(default_factory=list)
 
     # ---- queries used by the rules -----------------------------------
     def is_hot_module(self, path):
@@ -61,6 +68,14 @@ class LintConfig:
 
     def is_bench_path(self, path):
         return _match(self.bench_paths, path)
+
+    def is_sanctioned_sync(self, qualname):
+        """qualname is 'func' or 'Class.method' — the async result
+        reader(s) allowed to device_get in the pump loop."""
+        leaf = qualname.rsplit(".", 1)[-1]
+        return any(fnmatch.fnmatch(qualname, pat)
+                   or fnmatch.fnmatch(leaf, pat)
+                   for pat in self.sanctioned_sync)
 
     def in_lock_scope(self, path):
         return _match(self.lock_scope, path)
@@ -85,9 +100,17 @@ class LintConfig:
                 "ServingEngine.step", "ServingEngine._spec_step",
                 "ServingEngine._prefill_step", "ServingEngine._admit",
                 "ServingEngine._seed_first_token",
+                # device-side sampler + pipelined step pair (ROADMAP
+                # item 4): these ARE the per-token hot loop now
+                "ServingEngine.step_launch", "ServingEngine.step_finish",
+                "ServingEngine.run_pipelined",
+                "ServingEngine._note_launch_gap",
                 # scheduler pump + publish run once per engine step
                 "RequestScheduler._pump", "RequestScheduler._publish",
                 "RequestScheduler._feed_locked",
+                "RequestScheduler._step_pipelined",
+                "RequestScheduler._finish_pending",
+                "RequestScheduler._drain_needed",
             ],
             bench_paths=[
                 "bench*.py", "tools/*.py", "tests/*.py", "examples/*.py",
@@ -97,6 +120,9 @@ class LintConfig:
             lock_scope=["paddle_tpu/serving/*.py"],
             exclude=[],
             severity={},
+            # the engine's batched reader is the one sanctioned
+            # device->host sync of the whole step loop
+            sanctioned_sync=["ServingEngine._fetch_results"],
         )
 
     @classmethod
@@ -107,14 +133,14 @@ class LintConfig:
             data = json.load(f)
         cfg = cls.default()
         for key in ("hot_modules", "hot_functions", "bench_paths",
-                    "lock_scope", "exclude"):
+                    "lock_scope", "exclude", "sanctioned_sync"):
             if key in data:
                 setattr(cfg, key, list(data[key]))
         if "severity" in data:
             cfg.severity.update(data["severity"])
         unknown = set(data) - {"hot_modules", "hot_functions",
                                "bench_paths", "lock_scope", "exclude",
-                               "severity"}
+                               "severity", "sanctioned_sync"}
         if unknown:
             raise ValueError(f"tpulint config: unknown keys {sorted(unknown)}")
         return cfg

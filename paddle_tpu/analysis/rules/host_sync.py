@@ -36,6 +36,7 @@ class HostSyncRule(Rule):
                  "serving step loop serialize the device pipeline")
 
     def check(self, ctx):
+        flagged = set()
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -43,11 +44,42 @@ class HostSyncRule(Rule):
             hot = None if traced else ctx.in_hot_function(node)
             if traced is None and hot is None:
                 continue
+            if hot is not None and self._sanctioned(ctx, hot):
+                continue  # the configured async result reader
             where = (f"jitted `{traced.name}`" if traced
                      else f"hot path `{ctx.qualname(hot)}`")
             msg = self._classify(ctx, node, traced is not None)
             if msg:
+                flagged.add(id(node))
                 yield self.finding(ctx, node, f"{msg} (in {where})")
+        # config check (sanctioned_sync): in a hot module the
+        # sanctioned async result reader is the ONLY place allowed to
+        # call jax.device_get — everywhere else, even outside the
+        # configured hot functions, a raw device_get is a second host
+        # sync the pipelined pump cannot overlap
+        if not ctx.config.sanctioned_sync or \
+                not ctx.config.is_hot_module(ctx.path):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or id(node) in flagged:
+                continue
+            if ctx.resolve(node.func) != "jax.device_get":
+                continue
+            fn = ctx.enclosing_function(node)
+            if fn is not None and self._sanctioned(ctx, fn):
+                continue
+            qn = ctx.qualname(fn) if fn is not None else "<module>"
+            yield self.finding(
+                ctx, node,
+                "jax.device_get() outside the sanctioned async result "
+                f"reader (in `{qn}`; config sanctioned_sync = "
+                f"{ctx.config.sanctioned_sync}) — route the transfer "
+                "through the one batched reader so the pump loop keeps "
+                "a single, overlappable host sync")
+
+    @staticmethod
+    def _sanctioned(ctx, fn):
+        return ctx.config.is_sanctioned_sync(ctx.qualname(fn))
 
     def _classify(self, ctx, call, in_traced):
         # method-style syncs: x.numpy() / x.item() / x.tolist()

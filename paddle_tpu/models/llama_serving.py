@@ -79,6 +79,99 @@ def _scatter_kv(kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant):
     return kp, vp, ksp, vsp, kl, vl, ksl, vsl
 
 
+def _sample_record(logits, lengths, active, sample):
+    """Device-side sampling + stop-condition evaluation, fused into the
+    step program (ROADMAP item 4 / MPK direction: the host reads a few
+    ints per slot instead of `[vocab]` rows, and the pipelined pump can
+    consume them one step behind).
+
+    Every sampling parameter is a TRACED per-slot array — temperature /
+    top_k / top_p changing between requests can never retrace:
+      temp (B,) f32      0 = greedy (device argmax);
+      top_k (B,) i32     0 = off, clamped to vocab;
+      top_p (B,) f32     1.0 = off (include-crossing-token convention,
+                         same as generation._sample_logits);
+      key (B, 2) u32     the request's base PRNG key; the step key is
+                         fold_in(key, lengths) — a pure function of
+                         (seed, position), so a preempted/restored
+                         request continues the identical trajectory and
+                         the sync and pipelined pumps are token-equal;
+      eos (B,) i32       -1 = no eos;
+      remaining (B,) i32 tokens of budget left including this one.
+
+    Returns (next_token (B,) i32, done (B,) bool, logprob (B,) f32) —
+    logprob is log p(token | context) under the RAW model distribution
+    (the `logprobs=True` convention), computed here so even logprobs
+    requests transfer one float, not a vocab row.
+    """
+    tok, lp = _filter_draw(logits.astype(jnp.float32), sample["temp"],
+                           sample["top_k"], sample["top_p"],
+                           sample["key"], lengths)
+    done = active & ((sample["remaining"] <= 1) |
+                     ((sample["eos"] >= 0) & (tok == sample["eos"])))
+    return tok, done, lp
+
+
+def _filter_draw(lg, temp, top_k, top_p, key, fold):
+    """Filtered categorical draw shared by the decode record and the
+    verify grid: lg (N, V) f32 logits; temp/top_k/top_p/fold (N,)
+    traced; key (N, 2) u32. Returns (token (N,) i32, raw-model logprob
+    at that token (N,) f32). temp == 0 rows take the argmax.
+
+    top_k/top_p are TRACED (a lax.top_k would need static k), so the
+    filter is ONE descending value sort + threshold arithmetic — no
+    argsort/unsort round trip, which matters because this graph is
+    inlined into every decode_step/verify_step compile. top_p keeps
+    the include-crossing-token convention measured on the top-k-
+    renormalized distribution (same as the host sampler's
+    filter-then-renormalize order): with Z = cumulative prob mass of
+    the top-k set, `cum - prob <= p * Z` over UNfiltered probs is
+    exactly `cum_f - prob_f <= p` over the filtered ones."""
+    V = lg.shape[-1]
+    greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+    sampled_on = temp > 0.0
+    # greedy rows run the sampler arithmetic too (masked out by the
+    # final where): a per-row branch would be value-dependent control
+    # flow. Guard the divide so temp=0 rows cannot overflow to inf.
+    lt = lg / jnp.where(sampled_on, jnp.maximum(temp, 1e-6), 1.0)[:, None]
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)
+    sv = -jnp.sort(-lt, axis=-1)                     # descending values
+    probs = jax.nn.softmax(sv, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    z = jnp.take_along_axis(cum, (k - 1)[:, None], axis=-1)
+    keep = (jnp.arange(V)[None, :] < k[:, None]) & \
+        (cum - probs <= top_p[:, None] * z)
+    nkeep = jnp.maximum(keep.sum(-1), 1)             # crossing token stays
+    thresh = jnp.take_along_axis(sv, (nkeep - 1)[:, None], axis=-1)
+    lt = jnp.where(lt < thresh, -1e30, lt)
+    step_key = jax.vmap(jax.random.fold_in)(key, fold)
+    drawn = jax.vmap(jax.random.categorical)(step_key, lt) \
+        .astype(jnp.int32)
+    tok = jnp.where(sampled_on, drawn, greedy)
+    lp = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
+                             tok[:, None], axis=-1)[:, 0]
+    return tok, lp
+
+
+def _sample_grid(logits, lengths, sample):
+    """Verify-chunk twin of `_sample_record`: logits (B, G, V), one
+    draw per chunk position. The emission following chunk token g sits
+    at cache position lengths+g+1 pre-advanced — exactly the fold the
+    plain decode path uses for that emission index, so an un-drafted
+    sampled request in a verify chunk draws the IDENTICAL token the
+    plain engine would (cross-mode seeded parity). Returns
+    (token (B, G) i32, logprob (B, G) f32)."""
+    B, G, V = logits.shape
+    lg = logits.astype(jnp.float32).reshape(B * G, V)
+    pos = (lengths[:, None] + jnp.arange(G)[None, :] + 1).reshape(-1)
+
+    def rep(a):
+        return jnp.repeat(a, G, axis=0)
+    tok, lp = _filter_draw(lg, rep(sample["temp"]), rep(sample["top_k"]),
+                           rep(sample["top_p"]), rep(sample["key"]), pos)
+    return tok.reshape(B, G), lp.reshape(B, G)
+
+
 def _attn_tp(fn, mesh, quant):
     """shard_map wrapper for the paged attention kernels under tensor
     parallelism: attention is embarrassingly parallel over heads, so
@@ -187,7 +280,8 @@ def prefill_varlen(params, input_ids, cu_seqlens, config: LlamaConfig,
                                     "interpret", "mesh"))
 def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
                 active, config: LlamaConfig, page_size, use_pallas=False,
-                interpret=False, k_scale=None, v_scale=None, mesh=None):
+                interpret=False, k_scale=None, v_scale=None, mesh=None,
+                sample=None, carry_tok=None, carry_mask=None):
     """One token for every slot.
 
     k_pool/v_pool: (L, KVH, P, page, D); tokens: (B,) current input token;
@@ -196,8 +290,19 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
     along: the new token's K/V is quantized in-graph and the attention
     kernel dequantizes on read.
     Returns (k_pool, v_pool, k_scale, v_scale, logits (B, V)).
+
+    `sample` (traced pytree, see `_sample_record`) moves sampling and
+    stop-condition evaluation INTO this program: the return gains a
+    compact (next_token, done, logprob) record and the host never
+    needs a logits row. `carry_tok`/`carry_mask` ((B,) i32 / bool,
+    both traced) let the pipelined pump feed slot s the PREVIOUS
+    step's device-resident next_token (mask true) instead of a host
+    value — the autoregressive dependency stays on device, so step
+    N+1 launches before the host has read step N.
     """
     c = config
+    if carry_tok is not None:
+        tokens = jnp.where(carry_mask, carry_tok, tokens)
     nh, nkv = c.num_attention_heads, c.num_key_value_heads
     hd = c.hidden_size // nh
     B = tokens.shape[0]
@@ -252,7 +357,10 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
         (params["layers"], jnp.arange(L)))
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
     logits = h[:, 0] @ params["lm_head"]
-    return k_pool, v_pool, k_scale, v_scale, logits
+    if sample is None:
+        return k_pool, v_pool, k_scale, v_scale, logits
+    rec = _sample_record(logits, lengths, active, sample)
+    return k_pool, v_pool, k_scale, v_scale, logits, rec
 
 
 @functools.partial(jax.jit,
@@ -261,7 +369,7 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
 def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
                 n_tok, active, config: LlamaConfig, page_size,
                 use_pallas=False, interpret=False,
-                k_scale=None, v_scale=None, mesh=None):
+                k_scale=None, v_scale=None, mesh=None, sample=None):
     """Speculative-decoding verify: G chunk tokens per slot in ONE
     forward — every matmul runs at (B, G, ...) so one weight read
     covers G tokens, which is where the speculative speedup comes from
@@ -344,7 +452,17 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
         (params["layers"], jnp.arange(L)))
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
     logits = h @ params["lm_head"]
-    return k_pool, v_pool, k_scale, v_scale, logits
+    if sample is None:
+        return k_pool, v_pool, k_scale, v_scale, logits
+    # device-side verify record (`sample` = the same traced pytree as
+    # decode_step's): per-position continuation tokens — argmax for
+    # greedy slots, the position-keyed categorical draw for sampled
+    # ones — and their raw-model logprobs. The host acceptance loop
+    # consumes (B, G) ints/floats, never a vocab row; only
+    # spec_sample's multi-token rejection sampling still pulls rows
+    # (its exactness guarantee needs the full filtered distribution).
+    rec = _sample_grid(logits, lengths, sample)
+    return k_pool, v_pool, k_scale, v_scale, logits, rec
 
 
 # compile telemetry: each entry point reports compiles/retraces (new
@@ -421,6 +539,33 @@ def prompt_lookup_draft(ctx, G, ngram=2):
 # ---------------------------------------------------------------------------
 # engine (host-side orchestration)
 # ---------------------------------------------------------------------------
+class PipelineStall(RuntimeError):
+    """`step_launch(carry=...)` needed a preemption victim while a step
+    was still in flight. The victim's pending next_token only exists on
+    device, so the caller must consume the in-flight ticket first
+    (`step_finish`), then relaunch with carry=None — the drained state
+    preempts exactly like the synchronous loop."""
+
+
+class StepTicket:
+    """One launched-but-unconsumed decode step: the device-resident
+    result record plus the host metadata needed to apply it one step
+    later. `reqs` maps slot -> the Request that occupied it at launch;
+    `step_finish` applies a slot's result only while that identity
+    still holds (a slot released/reused in between makes the in-flight
+    result a discarded zombie), and marks a finishing slot's entry None
+    in the NEXT ticket so its overrun token is never emitted."""
+
+    __slots__ = ("slots", "reqs", "next_tok", "done", "logprob")
+
+    def __init__(self, slots, reqs, next_tok, done, logprob):
+        self.slots = slots          # launched slot ids, ascending
+        self.reqs = reqs            # slot -> Request at launch time
+        self.next_tok = next_tok    # device (B,) i32
+        self.done = done            # device (B,) bool
+        self.logprob = logprob      # device (B,) f32
+
+
 class Request:
     """One generation request. Per-request sampling params (reference:
     PaddleNLP predictor SamplingParams): temperature=0 → greedy;
@@ -438,6 +583,19 @@ class Request:
         self.top_p = float(top_p)
         self.rng = np.random.RandomState(seed) if seed is not None or \
             temperature > 0 else None
+        # device-side sampling key state: the raw threefry key for
+        # jax.random.PRNGKey(seed) is [seed>>32, seed&0xffffffff] —
+        # built host-side (no device op at construction). The step
+        # program samples with fold_in(base_key, position), so the
+        # trajectory is a pure function of (seed, position): identical
+        # across sync/pipelined pumps and across preemption resume.
+        if self.temperature > 0:
+            sk = seed if seed is not None \
+                else int(np.random.randint(0, 2 ** 31 - 1))
+            self._base_key = np.array(
+                [(sk >> 32) & 0xFFFFFFFF, sk & 0xFFFFFFFF], np.uint32)
+        else:
+            self._base_key = None
         self.output = []
         self.slot = None
         self.next_token = None
@@ -512,6 +670,17 @@ class ServingEngine:
     bucket-shaped verify_step chunk over the cached pages. Refcount-0
     pages that are still indexed park in an LRU that allocation
     reclaims before the pool is declared empty.
+
+    Sampling and stop-condition evaluation run INSIDE the jitted step
+    (docs/serving.md § Pipelined step loop): `decode_step` takes every
+    sampling parameter as a traced per-slot array plus a per-slot PRNG
+    key (fold_in(seed_key, position)) and returns a compact
+    (next_token, done, logprob) record — the host transfer is a few
+    ints per slot, never a `[vocab]` row. `step_launch`/`step_finish`
+    split the step so a pipelined driver (the scheduler's
+    double-buffered pump, or `run_pipelined`) can consume step N's
+    record while step N+1 — fed step N's tokens directly from the
+    device record — is already running.
 
     `host_tier_bytes>0` (serving/kvtier.py; docs/serving.md § KV-cache
     tiering) adds a bounded host-RAM tier under that LRU: evictions
@@ -692,8 +861,20 @@ class ServingEngine:
         self._index_suspend = False  # set while releasing failed slots
         self._seq_pages = {s: [] for s in range(max_seqs)}
         self._slots = [None] * max_seqs          # slot -> Request
+        # occupied-slot set maintained by admit/release: the per-step
+        # page-growth and batch-building passes iterate THIS, not all
+        # max_seqs slots (a 256-slot engine at occupancy 3 was paying
+        # a 256-iteration host scan per step)
+        self._live = set()
         self._waiting = []
         self.finished = []
+        # step-loop launch telemetry: wall time between consecutive
+        # decode/verify dispatches (pt_step_host_gap_seconds) and how
+        # many launched steps the host has not yet consumed
+        # (pt_pipeline_depth: 1 under the double-buffered pump)
+        self._t_launch_end = None
+        self.last_host_gap_s = 0.0
+        self.pipeline_depth = 0
         if use_pallas is None:
             use_pallas = jax.default_backend() == "tpu"
         self._use_pallas = use_pallas
@@ -766,7 +947,8 @@ class ServingEngine:
         """Release slots (and drop queued entries) whose requests were
         cancelled since the last step."""
         m = self.metrics
-        for s, r in enumerate(self._slots):
+        for s in sorted(self._live):
+            r = self._slots[s]
             if r is not None and r.cancelled:
                 self.finished.append(r)
                 self._release(s)
@@ -814,6 +996,22 @@ class ServingEngine:
         if m is not None:
             m.on_step(self, n_active)
 
+    def _attach(self, slot, req):
+        """Single site that occupies a slot — keeps the live-slot set
+        in sync with `_slots` (release is the only other mutator)."""
+        self._slots[slot] = req
+        self._live.add(slot)
+
+    def _fetch_results(self, tree):
+        """The ONE sanctioned device->host read in the serving step
+        loop (tpulint config `sanctioned_sync`): everything the host
+        needs from a device step — the per-slot (next_token, done,
+        logprob) records, spec verify grids, sampling rows, admission
+        seed rows — rides ONE batched transfer. Under the pipelined
+        pump this read is issued one step behind the launch, so it
+        overlaps the next device step instead of stalling it."""
+        return jax.device_get(tree)
+
     @staticmethod
     def _feed_ids(req):
         """Tokens to prefill: the original prompt, plus — after a
@@ -851,13 +1049,11 @@ class ServingEngine:
                                   self.max_seq_len)
                 return max(0, -(-horizon // self.page_size)
                            - len(self._seq_pages[s]))
-            growth_need = sum(_reserve(s) for s in range(self.max_seqs)
-                              if self._slots[s] is not None)
+            growth_need = sum(_reserve(s) for s in sorted(self._live))
         else:
             growth_need = sum(
-                1 for s in range(self.max_seqs)
-                if self._slots[s] is not None
-                and int(self.lengths[s]) > 0
+                1 for s in self._live
+                if int(self.lengths[s]) > 0
                 and int(self.lengths[s]) % self.page_size == 0
                 and len(self._seq_pages[s]) * self.page_size
                 <= int(self.lengths[s]))
@@ -921,7 +1117,7 @@ class ServingEngine:
                 req.slot = slot
                 req._admit_order = self._order
                 self._order += 1
-                self._slots[slot] = req
+                self._attach(slot, req)
                 if match[0]:
                     # cached prefix: map the shared pages in and start
                     # the chunk feed at the first uncached token
@@ -965,11 +1161,12 @@ class ServingEngine:
         # 65 steps before this, drowning steady-state decode
         pg, off = self._packed_indices(k_all.shape[2])
         # every admitted request's first-token logits row comes over in
-        # one batched device_get — np.asarray(logits[i]) inside the loop
-        # was a blocking round trip per admission (tpulint TPL001)
+        # one batched read through the engine's sanctioned reader —
+        # np.asarray(logits[i]) inside the loop was a blocking round
+        # trip per admission (tpulint TPL001)
         seed_idx = [i for i, req in enumerate(reqs)
                     if not getattr(req, "_resume", False)]
-        seed_rows = dict(zip(seed_idx, jax.device_get(  # tpulint: disable=TPL001 -- one batched transfer per admission wave
+        seed_rows = dict(zip(seed_idx, self._fetch_results(
             logits[jnp.asarray(seed_idx, jnp.int32)]))) \
             if seed_idx else {}
         for i, (slot, req) in enumerate(zip(slots, reqs)):
@@ -978,7 +1175,7 @@ class ServingEngine:
             req.slot = slot
             req._admit_order = self._order
             self._order += 1
-            self._slots[slot] = req
+            self._attach(slot, req)
             # index BEFORE seeding: a max_new_tokens==1 request
             # finishes (and releases) inside _seed_first_token
             self._index_slot(slot, req)
@@ -1063,13 +1260,13 @@ class ServingEngine:
         req.slot = slot
         req._admit_order = self._order
         self._order += 1
-        self._slots[slot] = req
+        self._attach(slot, req)
         self._index_slot(slot, req)
         if getattr(req, "_resume", False):
             req._resume = False  # next_token survives from before eviction
         else:
-            self._seed_first_token(slot, req,
-                                   np.asarray(logits).reshape(-1))
+            self._seed_first_token(
+                slot, req, self._fetch_results(logits).reshape(-1))
 
     def _preempt_one(self, exclude):
         """Evict the most-recently admitted active request (never
@@ -1148,7 +1345,7 @@ class ServingEngine:
         req.slot = slot
         req._admit_order = self._order
         self._order += 1
-        self._slots[slot] = req
+        self._attach(slot, req)
 
     def _scatter_host_kv(self, pages, k, v, ks, vs):
         """Scatter host-resident page KV (np, (L, KVH, n, page, D))
@@ -1206,84 +1403,172 @@ class ServingEngine:
 
     # -- decode loop ------------------------------------------------------
     def step(self):
-        """One decode step for all active slots; returns #active."""
+        """One decode step for all active slots; returns #active.
+        Synchronous driver: launch + consume in one call. The pipelined
+        pump calls `step_launch`/`step_finish` itself so the consume of
+        step N overlaps the device executing step N+1."""
         self._sweep_cancelled()
         self._admit()
         if self.spec_decode > 1:
             return self._spec_step()
-        # page-growth pass with preemption: a slot about to cross a page
-        # boundary must get a page; when the (oversubscribed) pool is
-        # dry, evict the most recent admission rather than dying deep in
-        # the allocator
-        for s in range(self.max_seqs):
-            if self._slots[s] is None:
-                continue
+        t = self.step_launch(_admitted=True)
+        return 0 if t is None else self.step_finish(t)
+
+    def _note_launch_gap(self, depth):
+        """Host-gap + pipeline-depth telemetry, taken at the instant a
+        decode/verify program is about to dispatch: the wall time since
+        the previous dispatch RETURNED is exactly how long the device
+        had no step-loop program queued behind the running one."""
+        now = time.perf_counter()
+        m = self.metrics
+        if self._t_launch_end is not None:
+            self.last_host_gap_s = now - self._t_launch_end
+            if m is not None:
+                m.observe_host_gap(self.last_host_gap_s)
+        self.pipeline_depth = depth
+        if m is not None:
+            m.set_pipeline_depth(depth)
+
+    def step_launch(self, carry=None, _admitted=False):
+        """Admission + page growth + ONE decode_step dispatch, with NO
+        device read: returns a StepTicket whose result record is still
+        on device (None when nothing runs). `carry` is the previous,
+        still-unconsumed ticket — continuing slots take their input
+        token from its device record (`carry_mask` inside the step), so
+        the host launches step N+1 knowing nothing about step N.
+
+        A carried slot that will exhaust max_new_tokens in the
+        in-flight step is NOT launched (its finish is host-predictable);
+        an eos finish is not, so such a slot runs one discarded zombie
+        step and `step_finish` rolls its length back. Raises
+        PipelineStall instead of preempting while carrying — the
+        victim's pending token is still in flight."""
+        if not _admitted:
+            self._sweep_cancelled()
+            self._admit()
+        # page-growth pass with preemption, over OCCUPIED slots only: a
+        # slot about to cross a page boundary must get a page; when the
+        # (oversubscribed) pool is dry, evict the most recent admission
+        # rather than dying deep in the allocator
+        for s in sorted(self._live):
             cur = int(self.lengths[s])
             if cur % self.page_size == 0 and cur > 0 and \
                     len(self._seq_pages[s]) * self.page_size <= cur:
                 while not self.pool.can_alloc(1):
+                    if carry is not None:
+                        raise PipelineStall(
+                            "page growth needs a preemption victim "
+                            "with a step in flight")
                     if not self._preempt_one(exclude=s):
                         raise RuntimeError(
                             "serving: KV page pool exhausted with a "
                             "single active sequence — num_pages is too "
                             "small for max_seq_len")
                 self._alloc_pages(s, 1)
-        active_slots = [s for s, r in enumerate(self._slots) if r is not None]
-        if not active_slots:
-            return 0
-        tokens = np.zeros((self.max_seqs,), np.int64)
-        for s in active_slots:
+        if not self._live:
+            self._t_launch_end = None
+            return None
+        B = self.max_seqs
+        tokens = np.zeros((B,), np.int32)
+        carry_mask = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        eos = np.full((B,), -1, np.int32)
+        remaining = np.ones((B,), np.int32)
+        launch, reqs = [], {}
+        for s in sorted(self._live):
             req = self._slots[s]
-            tokens[s] = req.next_token
-        active = np.zeros((self.max_seqs,), bool)
-        active[active_slots] = True
+            carried = carry is not None and carry.reqs.get(s) is req
+            left = req.max_new_tokens - len(req.output) \
+                - (1 if carried else 0)
+            if left <= 0:
+                continue  # the in-flight step emits its last token
+            launch.append(s)
+            reqs[s] = req
+            if carried:
+                carry_mask[s] = True
+            else:
+                tokens[s] = req.next_token
+            temps[s] = req.temperature
+            top_ks[s] = req.top_k
+            top_ps[s] = req.top_p
+            if req._base_key is not None:
+                keys[s] = req._base_key
+            if req.eos_id is not None:
+                eos[s] = int(req.eos_id)
+            remaining[s] = left
+        if not launch:
+            return None  # every occupied slot is finishing in flight
+        active = np.zeros((B,), bool)
+        active[launch] = True
         self.lengths = np.where(active, self.lengths + 1, self.lengths)
+        sample = {"temp": jnp.asarray(temps),
+                  "top_k": jnp.asarray(top_ks),
+                  "top_p": jnp.asarray(top_ps),
+                  "key": jnp.asarray(keys),
+                  "eos": jnp.asarray(eos),
+                  "remaining": jnp.asarray(remaining)}
+        # always pass the carry operands (zeros when none): an arity
+        # flip between the first pipelined launch and the rest would be
+        # a second trace signature for no reason
+        c_tok = carry.next_tok if carry is not None \
+            else jnp.zeros((B,), jnp.int32)
+        self._note_launch_gap(1 if carry is not None else 0)
         # page_table/lengths go to the device as SNAPSHOTS (.copy(), a
         # few hundred bytes): jnp.asarray may zero-copy a numpy buffer
         # on CPU, and the host mutates both tables in place (release /
-        # admission) as soon as the logits land — while the same
+        # admission) as soon as the results land — while the same
         # step's K/V scatter thunks can still be reading them under
         # XLA's async thunk runtime. Observed as a rare (<1%)
         # final-token corruption under concurrent serving load.
         with record_span("serving.decode_step"):
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-             logits) = decode_step(
+             _logits, rec) = decode_step(
                 self.params, self.k_pool, self.v_pool,
                 jnp.asarray(self.page_table.copy()),
                 jnp.asarray(self.lengths.copy()),
                 jnp.asarray(tokens), jnp.asarray(active),
                 self.config, self.page_size, use_pallas=self._use_pallas,
                 interpret=self._interpret, k_scale=self.k_scale,
-                v_scale=self.v_scale, mesh=self._mesh)
-        # all-greedy fast path: argmax on device, transfer max_seqs ints;
-        # only sampling/logprobs requests pull their [vocab] row to host.
-        # ONE batched device_get for everything the host loop needs this
-        # step — the previous per-slot np.asarray calls were 1 + n_sampling
-        # blocking round trips per emitted token (tpulint TPL001).
-        need_rows = [s for s in active_slots
-                     if self._slots[s].temperature > 0.0
-                     or self._slots[s].want_logprobs]
-        greedy_nxt, row_vals = jax.device_get(  # tpulint: disable=TPL001 -- the single batched transfer the step loop needs
-            (jnp.argmax(logits, axis=-1),
-             logits[jnp.asarray(need_rows, jnp.int32)]
-             if need_rows else None))
-        rows = {} if row_vals is None else dict(zip(need_rows, row_vals))
-        for s in active_slots:
-            req = self._slots[s]
-            tok = req.pick(rows[s]) if req.temperature > 0.0 \
-                else int(greedy_nxt[s])
+                v_scale=self.v_scale, mesh=self._mesh,
+                sample=sample, carry_tok=c_tok,
+                carry_mask=jnp.asarray(carry_mask))
+        self._t_launch_end = time.perf_counter()
+        self.device_steps += 1
+        return StepTicket(launch, reqs, rec[0], rec[1], rec[2])
+
+    def step_finish(self, ticket, inflight=None):
+        """Consume a launched step: ONE batched transfer of a few ints
+        per slot (the device already sampled and evaluated the stop
+        conditions), then the host bookkeeping. `inflight` is the
+        ticket launched AFTER this one (pipelined pump): a slot that
+        finishes here already ran one step past its end in `inflight`,
+        so its entry there is zombied and its length rolled back —
+        release/indexing then see exactly the synchronous loop's
+        state."""
+        nxt, done, lp = self._fetch_results(
+            (ticket.next_tok, ticket.done, ticket.logprob))
+        for s in ticket.slots:
+            req = ticket.reqs.get(s)
+            if req is None or self._slots[s] is not req:
+                continue  # zombie: slot released/reused since launch
+            tok = int(nxt[s])
             req.output.append(tok)
             req.next_token = tok
             if req.want_logprobs:
-                req.note_logprob(tok, rows[s])
+                req.logprobs.append(float(lp[s]))
             self._note_emit(req, 1)
-            if req.done:
+            if bool(done[s]):
                 self.finished.append(req)
                 self._note_finish(req)
+                if inflight is not None and inflight.reqs.get(s) is req:
+                    inflight.reqs[s] = None
+                    self.lengths[s] -= 1
                 self._release(s)
-        self.device_steps += 1
-        self._note_step(len(active_slots))
-        return len(active_slots)
+        self._note_step(len(ticket.slots))
+        return len(ticket.slots)
 
     def _spec_step(self):
         """One speculative verify step: drafts up to G-1 tokens per
@@ -1292,9 +1577,9 @@ class ServingEngine:
         reproduces plain greedy decode (the model token at the first
         draft divergence is the token plain decode would have picked)."""
         G = self.spec_decode
-        active_slots = [s for s, r in enumerate(self._slots)
-                        if r is not None]
+        active_slots = sorted(self._live)
         if not active_slots:
+            self._t_launch_end = None
             return 0
         tokens = np.zeros((self.max_seqs, G), np.int64)
         n_tok = np.ones((self.max_seqs,), np.int32)
@@ -1338,16 +1623,33 @@ class ServingEngine:
                             "single active sequence — num_pages is too "
                             "small for max_seq_len")
                 self._alloc_pages(s, 1)
-        active_slots = [s for s, r in enumerate(self._slots)
-                        if r is not None]
+        active_slots = sorted(self._live)
         for s in range(self.max_seqs):
             if s not in active_slots:
                 active[s] = False
         if not active_slots:
             return 0
+        temps = np.zeros((self.max_seqs,), np.float32)
+        top_ks = np.zeros((self.max_seqs,), np.int32)
+        top_ps = np.ones((self.max_seqs,), np.float32)
+        keys = np.zeros((self.max_seqs, 2), np.uint32)
+        for s in active_slots:
+            req = self._slots[s]
+            if self._prefilling(req):
+                continue  # chunk feed: nothing sampled on device
+            temps[s] = req.temperature
+            top_ks[s] = req.top_k
+            top_ps[s] = req.top_p
+            if req._base_key is not None:
+                keys[s] = req._base_key
+        sample = {"temp": jnp.asarray(temps),
+                  "top_k": jnp.asarray(top_ks),
+                  "top_p": jnp.asarray(top_ps),
+                  "key": jnp.asarray(keys)}
+        self._note_launch_gap(0)
         with record_span("serving.verify_step"):
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-             logits) = verify_step(
+             logits, (grid_dev, lp_dev)) = verify_step(
                 self.params, self.k_pool, self.v_pool,
                 jnp.asarray(self.page_table.copy()),
                 jnp.asarray(self.lengths.copy()),
@@ -1355,26 +1657,29 @@ class ServingEngine:
                 jnp.asarray(active), self.config, self.page_size,
                 use_pallas=self._use_pallas, interpret=self._interpret,
                 k_scale=self.k_scale, v_scale=self.v_scale,
-                mesh=self._mesh)
+                mesh=self._mesh, sample=sample)
+        self._t_launch_end = time.perf_counter()
         self.device_steps += 1
-        # one rows dict for everyone who needs host rows: sampling
-        # requests AND logprobs requests (emission j's logprob comes
-        # from chunk row j); pure-greedy no-logprobs slots stay on the
-        # device-argmax fast path. All host pulls for this step — the
-        # argmax grid, the sampling/logprobs rows, and the final-chunk
-        # row that seeds a finishing prefill — ride ONE batched
-        # device_get instead of a blocking np.asarray per slot (TPL001).
+        # one rows dict for the SAMPLING requests only: rejection
+        # sampling (speculative_sample) needs the full filtered
+        # distribution, so those rows still come to host. Greedy slots
+        # — logprobs included — ride the device verify record: the
+        # argmax grid and its raw-model logprobs are (B, G) ints and
+        # floats, never a vocab row. Everything the host needs this
+        # step — grids, sampling rows, and the final-chunk row that
+        # seeds a finishing prefill — rides the engine's ONE sanctioned
+        # batched read (`_fetch_results`).
         need_rows = [s for s in active_slots
-                     if (self._slots[s].temperature > 0.0
-                         or self._slots[s].want_logprobs)
+                     if self._slots[s].temperature > 0.0
+                     and int(n_tok[s]) > 1
                      and not self._prefilling(self._slots[s])]
         seed_slots = [s for s in active_slots
                       if self._prefilling(self._slots[s])
                       and self._slots[s]._pf_cursor + int(n_tok[s])
                       >= len(self._slots[s]._pf_feed)
                       and self._slots[s]._pf_sample]
-        greedy_nxt, row_vals, seed_vals = jax.device_get(  # tpulint: disable=TPL001 -- the single batched transfer the verify loop needs
-            (jnp.argmax(logits, axis=-1),                 # (B, G)
+        grid, lp_grid, row_vals, seed_vals = self._fetch_results(
+            (grid_dev, lp_dev,                            # (B, G) each
              logits[jnp.asarray(need_rows, jnp.int32)]
              if need_rows else None,
              logits[jnp.asarray(seed_slots, jnp.int32),
@@ -1406,9 +1711,12 @@ class ServingEngine:
                                                 req.top_k, req.top_p),
                     tokens[s, 1:n], req.rng)
             elif req.temperature > 0.0:
-                outs, a = [req.pick(rows[0])], 0
+                # un-drafted sampled slot: the device already drew the
+                # token with the SAME (seed, position) key the plain
+                # decode path uses — cross-mode seeded parity for free
+                outs, a = [int(grid[s, 0])], 0
             else:
-                outs = [int(t) for t in greedy_nxt[s, :n]]
+                outs = [int(t) for t in grid[s, :n]]
                 # accept drafts while they match the model's own choices
                 a = 0
                 while a < n - 1 and tokens[s, a + 1] == outs[a]:
@@ -1420,7 +1728,12 @@ class ServingEngine:
                 req.output.append(tok)
                 req.next_token = tok
                 if req.want_logprobs:
-                    req.note_logprob(tok, rows[j])
+                    if rows is not None:
+                        req.note_logprob(tok, rows[j])
+                    else:
+                        # greedy: emitted token j IS the grid token at
+                        # j, whose raw-model logprob came on device
+                        req.logprobs.append(float(lp_grid[s, j]))
                 emitted += 1
                 if req.done:
                     break
@@ -1451,6 +1764,7 @@ class ServingEngine:
         # aliasing pages the pool may re-hand to other slots
         self.page_table[slot, :] = self.num_pages - 1
         self._slots[slot] = None
+        self._live.discard(slot)
 
     # -- prefix KV cache (serving/kvcache.py + serving/kvtier.py) ---------
     def _cache_acquire(self, feed, req=None):
@@ -1629,19 +1943,49 @@ class ServingEngine:
         req.slot = slot
         req._admit_order = self._order
         self._order += 1
-        self._slots[slot] = req
+        self._attach(slot, req)
         self._note_prefix_admit(req, match)
         self._index_slot(slot, req)
         if getattr(req, "_resume", False):
             req._resume = False  # next_token survives from before eviction
         else:
-            row = jax.device_get(logits[slot, n - 1])
+            row = self._fetch_results(logits[slot, n - 1])
             self._seed_first_token(slot, req, row)
 
     def run(self, max_steps=10000):
         steps = 0
-        while (any(r is not None for r in self._slots) or self._waiting) \
-                and steps < max_steps:
+        while (self._live or self._waiting) and steps < max_steps:
             self.step()
             steps += 1
+        return self.finished
+
+    def run_pipelined(self, max_steps=10000):
+        """Drive the engine with the depth-1 double-buffered loop (the
+        scheduler's pipelined pump uses the same step_launch /
+        step_finish pair): launch step N+1 before consuming step N, so
+        the host bookkeeping overlaps the in-flight device program.
+        Token-identical to `run()` — greedy and seeded sampling both,
+        because sampling happens inside the step keyed by (seed,
+        position). Spec-decode engines fall back to the synchronous
+        loop (drafting needs host-current context). Cancellation must
+        only be applied between consumed steps — drive cancels through
+        the scheduler, which drains the pipeline first."""
+        if self.spec_decode > 1:
+            return self.run(max_steps=max_steps)
+        pending = None
+        steps = 0
+        while steps < max_steps and (self._live or self._waiting
+                                     or pending is not None):
+            try:
+                ticket = self.step_launch(carry=pending)
+            except PipelineStall:
+                self.step_finish(pending)
+                pending = None
+                ticket = self.step_launch()
+            if pending is not None:
+                self.step_finish(pending, inflight=ticket)
+            pending = ticket
+            steps += 1
+        if pending is not None:
+            self.step_finish(pending)
         return self.finished

@@ -17,12 +17,19 @@ import bisect
 import threading
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "EngineMetrics", "DEFAULT_BUCKETS"]
+           "EngineMetrics", "DEFAULT_BUCKETS", "GAP_BUCKETS"]
 
 # latency buckets in seconds: sub-ms CPU decode steps up to multi-second
 # queued TTFTs all land in a populated bucket
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+# host-gap buckets: the time between device-step launches is tens of
+# microseconds under the pipelined pump and a full device step plus
+# bookkeeping under the synchronous one — finer left edge than the
+# latency buckets so the reduction is visible in the histogram
+GAP_BUCKETS = (2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+               0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 
 
 class _Metric:
@@ -247,6 +254,16 @@ class EngineMetrics:
         self.step_seconds = r.histogram(
             "pt_serving_step_seconds",
             "Wall time of one engine step (prefill+decode/verify).")
+        self.host_gap = r.histogram(
+            "pt_step_host_gap_seconds",
+            "Host wall time between consecutive device-step launches "
+            "(decode/verify dispatch to the next dispatch) — the gap "
+            "the device sits without a queued step program.",
+            buckets=GAP_BUCKETS)
+        self.pipeline_depth = r.gauge(
+            "pt_pipeline_depth",
+            "Device steps in flight beyond the one the host has "
+            "consumed (1 = double-buffered pump, 0 = synchronous).")
         self.queue_depth = r.gauge(
             "pt_serving_queue_depth", "Requests waiting for a slot.")
         self.queue_depth_peak = r.gauge(
@@ -374,6 +391,14 @@ class EngineMetrics:
 
     def observe_ttft(self, dt):
         self.ttft.observe(dt)
+
+    def observe_host_gap(self, dt):
+        """Engine hook: wall time from the previous decode/verify
+        dispatch returning to this one starting."""
+        self.host_gap.observe(dt)
+
+    def set_pipeline_depth(self, depth):
+        self.pipeline_depth.set(depth)
 
     def observe_tpot(self, dt):
         self.tpot.observe(dt)

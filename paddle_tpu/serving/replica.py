@@ -47,13 +47,14 @@ class Replica:
     """
 
     def __init__(self, replica_id, engine, *, max_queue=64,
-                 metrics=None, idle_poll_s=0.02):
+                 metrics=None, idle_poll_s=0.02, pipeline=None):
         self.replica_id = str(replica_id)
         self.engine = engine
         registry = metrics if metrics is not None else MetricsRegistry()
         self.scheduler = RequestScheduler(engine, max_queue=max_queue,
                                           metrics=registry,
-                                          idle_poll_s=idle_poll_s)
+                                          idle_poll_s=idle_poll_s,
+                                          pipeline=pipeline)
 
     # -- identity / introspection -------------------------------------
     @property
@@ -119,27 +120,34 @@ class Replica:
         err = exc if exc is not None else ReplicaKilledError(
             f"replica {self.replica_id}: killed (fault injection)")
 
-        def _dead_step():
+        def _dead_step(*args, **kwargs):
             raise err
+        # both pump entry points: the synchronous loop calls step(),
+        # the pipelined pump calls step_launch() — a kill must fire
+        # whichever one the scheduler drives (with a step in flight,
+        # the next launch raises and _fail_all drains the ticket)
         self.engine.step = _dead_step
+        self.engine.step_launch = _dead_step
 
     def revive(self):
-        """Undo `kill()`: drop the injected step override so the class
-        method resumes — the 'replica restarted' half of a failover
+        """Undo `kill()`: drop the injected step overrides so the class
+        methods resume — the 'replica restarted' half of a failover
         drill (the scheduler's `_fail_all` already left the engine's
         slots and pages clean)."""
         self.engine.__dict__.pop("step", None)
+        self.engine.__dict__.pop("step_launch", None)
 
     def __repr__(self):
         return f"Replica({self.replica_id!r})"
 
 
 def build_replicas(engine_factory, n, *, max_queue=64, prefix="r",
-                   idle_poll_s=0.02):
+                   idle_poll_s=0.02, pipeline=None):
     """N independent replicas from an engine factory. The factory is
     called once per replica — each gets its own params reference but
     its own KV pool, prefix cache, scheduler, and metrics registry
     (`engine_factory(i) -> ServingEngine`)."""
     return [Replica(f"{prefix}{i}", engine_factory(i),
-                    max_queue=max_queue, idle_poll_s=idle_poll_s)
+                    max_queue=max_queue, idle_poll_s=idle_poll_s,
+                    pipeline=pipeline)
             for i in range(int(n))]

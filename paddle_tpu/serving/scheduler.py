@@ -24,6 +24,7 @@ plus per-request chunk queues, all under one condition variable.
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -124,8 +125,26 @@ class RequestScheduler:
     """Thread-safe frontend over one ServingEngine (see module doc)."""
 
     def __init__(self, engine, max_queue=64, metrics=None,
-                 idle_poll_s=0.02, start=True):
+                 idle_poll_s=0.02, start=True, pipeline=None):
         self._engine = engine
+        # pipeline=True: double-buffered pump (docs/serving.md
+        # § Pipelined step loop) — launch device step N+1 before
+        # consuming step N's result record, so host bookkeeping and
+        # next-wave admission overlap the in-flight device program.
+        # Default comes from PT_SERVE_PIPELINE. Spec-decode engines
+        # stay synchronous (drafting needs host-current context);
+        # slow-path events (cancel/TTL/preempt/failure/shutdown) drain
+        # the one-step-deep pipeline before acting, so every mode is
+        # token-identical to the synchronous pump.
+        if pipeline is None:
+            pipeline = os.environ.get("PT_SERVE_PIPELINE", "0") \
+                not in ("", "0")
+        self._pipeline = bool(pipeline) and \
+            getattr(engine, "spec_decode", 0) <= 1
+        # the launched-but-unconsumed StepTicket; pump-thread only
+        # (written outside the lock by design — _expire_and_cancel
+        # just reads it to defer engine-side cancel application)
+        self._pending = None
         self.max_queue = int(max_queue)
         if self.max_queue < 1:
             raise ValueError(f"max_queue={max_queue}: want >= 1")
@@ -347,6 +366,11 @@ class RequestScheduler:
                                tokens=len(sr.req.output))
             if (expired or sr._cancel_requested) and \
                     not sr._cancel_applied:
+                # a step in flight: releasing the slot now would race
+                # its device results — the pump drains the pipeline
+                # first (next iteration re-enters with _pending None)
+                if self._pending is not None:
+                    continue
                 sr._cancel_applied = True
                 # pump thread owns the engine: safe to mutate its queue
                 self._engine.cancel(sr.req)
@@ -463,12 +487,64 @@ class RequestScheduler:
         return (any(r is not None for r in self._engine._slots)
                 or bool(self._engine._waiting))
 
+    def _drain_needed(self):
+        """True when the pipelined pump must catch the host up before
+        acting: shutdown began, or a cancel/TTL deadline wants to touch
+        a slot whose latest step is still in flight."""
+        with self._cond:
+            if self._closed:
+                return True
+            now = time.monotonic()
+            for sr in self._inflight.values():
+                if sr._cancel_requested or (
+                        sr.deadline is not None and now > sr.deadline):
+                    return True
+            return any(sr._cancel_requested
+                       for q in self._queues.values() for sr in q)
+
+    def _finish_pending(self, inflight=None):
+        """Consume the in-flight ticket (the sanctioned async read
+        lives in engine.step_finish); returns #active it applied."""
+        ticket, self._pending = self._pending, None
+        if ticket is None:
+            return 0
+        return self._engine.step_finish(ticket, inflight=inflight)
+
+    def _step_pipelined(self):
+        """One pipelined pump turn: launch step N+1 FIRST (its input
+        tokens come from step N's device record via the carry mask),
+        then consume step N — the host bookkeeping overlaps the device
+        executing N+1. Page-growth preemption raises PipelineStall
+        inside the launch (the victim's pending token is still on
+        device): drain, then relaunch against host-current state."""
+        from ..models.llama_serving import PipelineStall
+        eng = self._engine
+        try:
+            ticket = eng.step_launch(carry=self._pending)
+        except PipelineStall:
+            self._finish_pending()
+            ticket = eng.step_launch()
+        n_active = self._finish_pending(inflight=ticket)
+        self._pending = ticket
+        if ticket is not None:
+            n_active = max(n_active, len(ticket.slots))
+        return n_active
+
     def _pump(self):
         while True:
+            if self._pending is not None and self._drain_needed():
+                # slow path (cancel/TTL/shutdown): catch the host up so
+                # releases/cancels operate on consumed state only —
+                # the one-step-deep pipeline drains, never leaks
+                try:
+                    self._finish_pending()
+                except Exception as e:  # noqa: BLE001 — fail requests
+                    self._fail_all(e)
+                self._publish()
             with self._cond:
                 self._expire_and_cancel_locked()
                 self._feed_locked()
-                if not self._engine_has_work():
+                if not self._engine_has_work() and self._pending is None:
                     if self._closed and not self._queued_locked():
                         break
                     # park until a submission/cancel/shutdown pokes us
@@ -478,8 +554,12 @@ class RequestScheduler:
                     continue
             t0 = time.perf_counter()
             try:
-                n_active = self._engine.step()
+                if self._pipeline:
+                    n_active = self._step_pipelined()
+                else:
+                    n_active = self._engine.step()
             except Exception as e:  # noqa: BLE001 — fail requests
+                self._pending = None
                 self._fail_all(e)
                 continue
             dt = time.perf_counter() - t0
@@ -494,8 +574,15 @@ class RequestScheduler:
             self._log.event(
                 "serving.step", step_s=dt, active=n_active,
                 queue_depth=self.metrics.queue_depth.value,
-                device_steps=self._engine.device_steps)
+                device_steps=self._engine.device_steps,
+                host_gap_s=getattr(self._engine, "last_host_gap_s", 0.0),
+                pipeline_depth=getattr(self._engine, "pipeline_depth", 0))
             self._publish()
+        if self._pending is not None:
+            try:
+                self._finish_pending()
+            except Exception as e:  # noqa: BLE001
+                self._fail_all(e)
         self._publish()
 
     def _fail_all(self, exc):
@@ -504,6 +591,8 @@ class RequestScheduler:
         self._log.event("engine.error", level="error", error=repr(exc))
         with self._cond:
             eng = self._engine
+            # the failed/abandoned launch leaves the gap clock mid-step
+            eng._t_launch_end = None
             # a failed step may have advanced lengths past K/V that
             # never landed — releasing these slots must NOT index
             # their pages into the prefix cache

@@ -28,6 +28,7 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
+    monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "ngram-repetitive"
     assert out["spec_accept_rate"] > 0, out
@@ -52,6 +53,8 @@ def _assert_metrics_snapshot(out):
     assert m["device_steps"] > 0
     assert m["tpot_p50_s"] >= 0
     assert 0 <= m["batch_occupancy"] <= 1
+    # ISSUE 8: the step loop's host gap ships with every serving bench
+    assert m["host_gap_count"] > 0 and m["host_gap_p50_s"] > 0
     # device telemetry (PR 4): measured MFU from XLA-counted FLOPs over
     # the timed run, per-phase FLOPs attribution, and the HBM high-water
     assert 0 < out["mfu"] <= 1, out
@@ -88,6 +91,7 @@ def test_prefix_bench_reuses_cached_pages(monkeypatch):
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
+    monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.setenv("PT_SERVE_PREFIX", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "shared-prefix"
@@ -108,6 +112,7 @@ def test_multiturn_bench_hits_the_host_tier(monkeypatch):
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
+    monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.setenv("PT_SERVE_MULTITURN", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "multi-turn"
@@ -128,6 +133,7 @@ def test_plain_bench_unaffected(monkeypatch):
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
+    monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["decode_tokens_per_sec"] > 0
     assert "spec_decode" not in out
@@ -146,6 +152,7 @@ def test_router_bench_snapshot(monkeypatch):
     monkeypatch.delenv("PT_SERVE_CACHE", raising=False)
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
+    monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.setenv("PT_SERVE_ROUTER", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "router-shared-prefix"
@@ -168,3 +175,40 @@ def test_router_bench_snapshot(monkeypatch):
     assert out["aggregate_tokens_per_sec"] > 0
     assert out["single_engine_tokens_per_sec"] > 0
     assert out["single_engine_prefix_hit_rate"] >= 0
+
+
+def test_pipeline_bench_token_identical_and_faster_host(monkeypatch):
+    """PT_SERVE_PIPELINE=1 (ISSUE 8 acceptance): the double-buffered
+    pump must emit token-identical outputs vs the synchronous pump at
+    equal config, STRICTLY reduce the measured host gap between
+    device-step launches, and not reduce tok/s. The p50 comparison is
+    the robust one on a noisy CPU box: the sync pump's gap contains a
+    full blocking read of the device step, the pipelined pump's does
+    not."""
+    bm = _load_bench_models()
+    for env in ("PT_SERVE_SPEC", "PT_SERVE_CACHE", "PT_SERVE_PREFIX",
+                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN"):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv("PT_SERVE_PIPELINE", "1")
+    # wall-clock comparisons on a loaded CI box are noisy: the
+    # CORRECTNESS asserts (outputs_match, fields) must hold every run;
+    # the timing asserts must hold in at least one of two attempts
+    last = None
+    for attempt in range(2):
+        out = bm.bench_serving(on_tpu=False)
+        assert out["workload"] == "pipelined-pump"
+        assert out["outputs_match"] is True, out
+        assert out["pipeline_depth"] == 1
+        gap_s, gap_p = out["host_gap_sync"], out["host_gap_pipelined"]
+        assert gap_s["count"] > 0 and gap_p["count"] > 0
+        assert out["decode_tokens_per_sec"] > 0
+        timing_ok = (gap_p["p50_s"] < gap_s["p50_s"]
+                     and out["decode_tokens_per_sec"]
+                     >= 0.7 * out["sync_decode_tokens_per_sec"])
+        last = out
+        if timing_ok:
+            break
+    else:
+        raise AssertionError(
+            f"pipelined pump did not reduce the host gap in 2 "
+            f"attempts: {last}")

@@ -65,3 +65,60 @@ def test_cli_list_rules():
     for rid in ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005",
                 "TPL006"):
         assert rid in proc.stdout
+
+
+def test_pump_loop_single_sanctioned_device_get():
+    """ISSUE 8: the engine's batched reader (`ServingEngine.
+    _fetch_results`) must be the ONLY jax.device_get in the serving
+    step loop — every other host pull rides it, so the pipelined pump
+    has exactly one sync point to issue a step behind."""
+    import ast
+
+    readers = {}
+    for rel in ("paddle_tpu/models/llama_serving.py",
+                "paddle_tpu/serving/scheduler.py"):
+        src = open(os.path.join(REPO, rel), encoding="utf-8").read()
+        tree = ast.parse(src)
+
+        def scan(node, stack):
+            for child in ast.iter_child_nodes(node):
+                nstack = stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    nstack = stack + [child.name]
+                if isinstance(child, ast.Call) and \
+                        isinstance(child.func, ast.Attribute) and \
+                        child.func.attr == "device_get":
+                    readers.setdefault(".".join(stack) or "<module>",
+                                       0)
+                    readers[".".join(stack) or "<module>"] += 1
+                scan(child, nstack)
+        scan(tree, [])
+    assert set(readers) == {"ServingEngine._fetch_results"}, readers
+    assert readers["ServingEngine._fetch_results"] == 1
+
+
+def test_sanctioned_sync_config_check(tmp_path):
+    """The TPL001 config check: a raw jax.device_get anywhere in a hot
+    serving module — even outside the configured hot functions — is a
+    finding; the sanctioned async result reader is clean."""
+    hot_dir = tmp_path / "paddle_tpu" / "serving"
+    hot_dir.mkdir(parents=True)
+    bad = hot_dir / "rogue.py"
+    bad.write_text(
+        "import jax\n"
+        "def helper(x):\n"
+        "    return jax.device_get(x)\n")
+    proc = _run(str(bad))
+    assert proc.returncode == 1
+    assert "TPL001" in proc.stdout
+    assert "sanctioned" in proc.stdout
+    good = hot_dir / "reader.py"
+    good.write_text(
+        "import jax\n"
+        "class ServingEngine:\n"
+        "    def _fetch_results(self, tree):\n"
+        "        return jax.device_get(tree)\n")
+    proc = _run(str(good))
+    assert proc.returncode == 0, proc.stdout

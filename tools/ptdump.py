@@ -134,6 +134,22 @@ def print_flight(doc, tail=30, kind=None, out=sys.stdout):
             w(f"; holding {_human_bytes(last.get('tier_bytes'))} "
               f"in {last.get('tier_pages')} pages")
         w("\n")
+    # step-loop rollup: the rate-limited serving.step records carry the
+    # pump's wall time, the host gap between device-step launches, and
+    # the pipeline depth (1 = double-buffered pump) — enough to read
+    # "was the host on the critical path" straight off a flight dump
+    steps = [e for e in events if e.get("kind") == "serving.step"]
+    if steps:
+        n = len(steps)
+        tot = sum(e.get("step_s") or 0.0 for e in steps)
+        gaps = [e.get("host_gap_s") for e in steps
+                if e.get("host_gap_s") is not None]
+        depth = max((e.get("pipeline_depth") or 0) for e in steps)
+        w(f"  serving steps: {n} sampled, "
+          f"avg step {tot / n * 1e3:.2f}ms")
+        if gaps:
+            w(f", avg host gap {sum(gaps) / len(gaps) * 1e6:.0f}us")
+        w(f", pipeline depth {int(depth)}\n")
     health = [e for e in events if e.get("kind") == "health"]
     if health:
         bad = sum(e.get("count", 0) or 0 for e in health)
