@@ -201,6 +201,12 @@ def bench_serving(on_tpu):
     # token-identical outputs (serving/scheduler.py; ROADMAP item 4)
     if (os.environ.get("PT_SERVE_PIPELINE", "") or "0") not in ("", "0"):
         return _bench_serving_pipeline(on_tpu, params, cfg, dtype)
+    # PT_SERVE_CHAOS=1: crash-recovery drill — a seeded fault plan
+    # injects a device failure mid-run; survivors must be
+    # token-identical to an undisturbed baseline and the artifact
+    # reports goodput retained (serving/faults.py; docs/reliability.md)
+    if (os.environ.get("PT_SERVE_CHAOS", "") or "0") not in ("", "0"):
+        return _bench_serving_chaos(on_tpu, params, cfg, dtype)
 
     rng = _data_rng()
     if prefix_mode:
@@ -456,6 +462,110 @@ def _bench_serving_pipeline(on_tpu, params, cfg, dtype):
         "pipeline_depth": pipe_snap["pt_pipeline_depth"]["value"],
         "loss": 0.0,
     }
+
+
+def _bench_serving_chaos(on_tpu, params, cfg, dtype):
+    """PT_SERVE_CHAOS=1: the crash-recovery drill (ISSUE 9). The same
+    mixed greedy + seeded-sampling workload runs three times at equal
+    engine config: once undisturbed (the baseline), then under a
+    seeded `FaultPlan` that kills a device step mid-run — once with
+    the synchronous pump and once with the pipelined pump (a pending
+    step_finish ticket in flight at crash time). Warm restart must
+    requeue every victim and finish them token-identical to the
+    baseline; the artifact asserts `outputs_match`, carries the
+    restart/requeue ledger, and reports goodput retained (completed
+    tokens / baseline tokens — 1.0 when recovery loses nothing)."""
+    from paddle_tpu.models.llama_serving import ServingEngine
+    from paddle_tpu.serving import FaultPlan, MetricsRegistry, \
+        RequestScheduler
+
+    if on_tpu:
+        max_seqs, new_tok, nreq = 8, 64, 12
+        max_seq_len, page = 512, 16
+        fault_spec = "step_launch:raise@12"
+    else:
+        max_seqs, new_tok, nreq = 4, 16, 6
+        max_seq_len, page = 128, 8
+        fault_spec = "step_launch:raise@4"
+    rng = _data_rng()
+    reqs = []
+    for i in range(nreq):
+        prompt = list(map(int, rng.randint(
+            1, cfg.vocab_size, int(rng.randint(8, 32)) if on_tpu else 4)))
+        kw = {"max_new_tokens": new_tok}
+        if i % 3 == 2:   # every third request samples, seeded
+            kw.update(temperature=0.8, top_k=8, top_p=0.95, seed=200 + i)
+        reqs.append((prompt, kw))
+
+    def run_drill(spec, pipeline, warm=True):
+        if warm:
+            # full-trajectory warmup: the chaos-vs-baseline comparison
+            # must time both sides with identical compile caches (same
+            # reasoning as the pipeline bench)
+            run_drill(spec, pipeline, warm=False)
+        eng = ServingEngine(params, cfg, max_seqs=max_seqs,
+                            max_seq_len=max_seq_len, page_size=page,
+                            dtype=dtype, prefix_cache=True,
+                            use_pallas=None if on_tpu else False,
+                            faults=FaultPlan(spec) if spec else None)
+        sched = RequestScheduler(eng, max_queue=nreq,
+                                 metrics=MetricsRegistry(),
+                                 pipeline=pipeline)
+        # submit under pause(): deterministic admission waves (and so a
+        # deterministic Nth-device-step crash position) per run
+        sched.pause()
+        t0 = time.perf_counter()
+        handles = [sched.submit(prompt, **kw) for prompt, kw in reqs]
+        sched.resume()
+        outs, failed = [], 0
+        for h in handles:
+            try:
+                outs.append(h.result(timeout=600))
+            except Exception:  # noqa: BLE001 — drill counts casualties
+                outs.append(None)
+                failed += 1
+        dt = time.perf_counter() - t0
+        st = sched.stats()
+        snap = sched.metrics_snapshot()
+        sched.shutdown(drain=True, timeout=60)
+        return outs, failed, dt, st, snap
+
+    base_outs, base_failed, base_dt, _, _ = run_drill(None, False)
+    assert base_failed == 0, "baseline run must not fail"
+    base_tokens = sum(len(o) for o in base_outs)
+
+    out = {"workload": "chaos-recovery", "requests": nreq,
+           "batch": max_seqs, "fault_plan": fault_spec,
+           "baseline_tokens_per_sec": round(base_tokens / base_dt, 1),
+           "loss": 0.0}
+    for name, pipeline in (("sync", False), ("pipelined", True)):
+        outs, failed, dt, st, snap = run_drill(fault_spec, pipeline)
+        done_tokens = sum(len(o) for o in outs if o is not None)
+        led = st["requests"]
+        out[name] = {
+            "outputs_match": outs == base_outs,
+            "failed_requests": failed,
+            "restarts": int(snap["pt_engine_restarts"]["value"]),
+            "requeued": int(snap["pt_requests_requeued"]["value"]),
+            "quarantined": int(snap["pt_poison_quarantined"]["value"]),
+            "restart_p50_s": round(
+                snap["pt_engine_restart_seconds"]["p50"], 6),
+            "goodput_retained": round(done_tokens / max(base_tokens, 1),
+                                      4),
+            "tokens_per_sec": round(done_tokens / dt, 1),
+            "ledger_balanced": led["submitted"] == (
+                led["completed"] + led["failed"] + led["cancelled"]
+                + led["expired"] + st["queued"] + st["inflight"]),
+        }
+        # a transient fault must cost NOTHING: every survivor
+        # token-identical, zero failures, ledger conserved
+        assert out[name]["outputs_match"], (name, out[name])
+        assert out[name]["restarts"] >= 1 and out[name]["requeued"] >= 1
+        assert out[name]["ledger_balanced"], (name, out[name])
+    out["outputs_match"] = (out["sync"]["outputs_match"]
+                            and out["pipelined"]["outputs_match"])
+    out["decode_tokens_per_sec"] = out["pipelined"]["tokens_per_sec"]
+    return out
 
 
 def _bench_serving_router(on_tpu, params, cfg, dtype):

@@ -68,6 +68,12 @@ def main():
                          "replicas behind the prefix-affinity router "
                          "(health-aware failover, per-replica /metrics "
                          "labels); implies --prefix-cache per replica")
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="seeded fault plan for chaos drills, e.g. "
+                         "'step_launch:raise@4' (docs/reliability.md "
+                         "has the grammar); PT_FAULTS is the env "
+                         "spelling. Crashed steps warm-restart the "
+                         "engine and requeue unstreamed requests")
     args = ap.parse_args()
 
     cfg = LlamaConfig.tiny(vocab=512, hidden=128, layers=2, heads=8,
@@ -75,6 +81,7 @@ def main():
     params = M.init_params(cfg, seed=0)
 
     def make_engine(_i=0):
+        from paddle_tpu.serving import FaultPlan
         return ServingEngine(
             params, cfg, max_seqs=args.max_seqs, max_seq_len=256,
             page_size=16,
@@ -82,7 +89,8 @@ def main():
             spec_decode=args.spec,
             prefix_cache=(args.prefix_cache or args.replicas > 1
                           or args.host_tier_mb > 0),
-            host_tier_bytes=args.host_tier_mb << 20)
+            host_tier_bytes=args.host_tier_mb << 20,
+            faults=FaultPlan(args.faults) if args.faults else None)
 
     pipeline = True if args.pipeline else None  # None -> env default
     if args.replicas > 1:

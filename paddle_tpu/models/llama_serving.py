@@ -31,9 +31,10 @@ from .._core.compat import shard_map
 from ..observability import flight_recorder as _flight
 from ..observability.compile_telemetry import track_jit
 from ..profiler import record_span
-# host-side page bookkeeping only (numpy/stdlib — serving.kvcache and
-# serving.kvtier never import model/engine code, so this direction
-# stays cycle-free)
+# host-side page bookkeeping only (numpy/stdlib — serving.kvcache,
+# serving.kvtier and serving.faults never import model/engine code, so
+# this direction stays cycle-free)
+from ..serving.faults import FaultPlan
 from ..serving.kvcache import PagePool, PrefixCache
 from ..serving.kvtier import HostTier
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
@@ -698,7 +699,7 @@ class ServingEngine:
                  cache_dtype=None, preempt_policy="offload",
                  spec_decode=0, spec_ngram=2, chunked_prefill=False,
                  spec_sample=False, mesh=None, prefix_cache=False,
-                 host_tier_bytes=0, tier_quantize=True):
+                 host_tier_bytes=0, tier_quantize=True, faults=None):
         c = config
         # mesh with a 'tp' axis: tensor-parallel serving — weights get
         # megatron NamedShardings (llama_spmd.param_specs), the KV pool
@@ -854,6 +855,15 @@ class ServingEngine:
                 "feed the spill tier")
         self.host_tier = HostTier(page_size, tier_bytes=host_tier_bytes,
                                   quantize=tier_quantize)
+        # deterministic fault injection (serving/faults.py;
+        # docs/reliability.md): a seeded plan armed at the stack's real
+        # failure sites, via constructor or PT_FAULTS. None (the
+        # default when the env var is unset) costs nothing and
+        # preserves seed behavior exactly. `restarts` counts
+        # crash_reset() warm restarts — the scheduler's recovery path.
+        self.faults = faults if faults is not None else FaultPlan.from_env()
+        self.host_tier.faults = self.faults
+        self.restarts = 0
         if self.prefix_cache is not None:
             self.prefix_cache.on_evict = self._note_prefix_evict
             if self.host_tier.enabled:
@@ -1011,6 +1021,40 @@ class ServingEngine:
         pump this read is issued one step behind the launch, so it
         overlaps the next device step instead of stalling it."""
         return jax.device_get(tree)
+
+    def _fire(self, point, value=None, rids=None):
+        """Fault-injection hook (serving/faults.py): no-op unless a
+        FaultPlan is attached; an armed rule may raise, sleep, or
+        corrupt `value` here — at the stack's real failure site."""
+        f = self.faults
+        if f is None:
+            return value
+        return f.fire(point, value, rids=rids)
+
+    def crash_reset(self):
+        """The engine half of a warm restart, after a step exception:
+        release every slot exactly as a failure must (prefix indexing
+        SUSPENDED — a failed step's K/V may be partial; slots that were
+        mid-admission when the exception hit still hold pages but no
+        Request, so the sweep keys on either), drop engine-queued
+        work's host-stashed KV, and clear the launch telemetry clock.
+        What happens to the REQUESTS (requeue / quarantine / fail) is
+        the scheduler's decision — this only returns the engine to a
+        cleanly-empty, immediately servable state. Returns the requests
+        that were engine-queued at the crash."""
+        self.restarts += 1
+        self._t_launch_end = None
+        self._index_suspend = True
+        try:
+            for s in range(self.max_seqs):
+                if self._slots[s] is not None or self._seq_pages[s]:
+                    self._release(s)
+        finally:
+            self._index_suspend = False
+        for r in self._waiting:
+            self._drop_offload(r)
+        waiting, self._waiting = self._waiting, []
+        return waiting
 
     @staticmethod
     def _feed_ids(req):
@@ -1515,6 +1559,9 @@ class ServingEngine:
         # a second trace signature for no reason
         c_tok = carry.next_tok if carry is not None \
             else jnp.zeros((B,), jnp.int32)
+        # fault point: one hit per decode dispatch, with the launched
+        # request ids so rid-scoped rules can model a poison request
+        self._fire("step_launch", rids=[str(reqs[s].rid) for s in launch])
         self._note_launch_gap(1 if carry is not None else 0)
         # page_table/lengths go to the device as SNAPSHOTS (.copy(), a
         # few hundred bytes): jnp.asarray may zero-copy a numpy buffer
@@ -1548,6 +1595,9 @@ class ServingEngine:
         so its entry there is zombied and its length rolled back —
         release/indexing then see exactly the synchronous loop's
         state."""
+        self._fire("step_finish",
+                   rids=[str(r.rid) for r in ticket.reqs.values()
+                         if r is not None])
         nxt, done, lp = self._fetch_results(
             (ticket.next_tok, ticket.done, ticket.logprob))
         for s in ticket.slots:
@@ -1646,6 +1696,10 @@ class ServingEngine:
                   "top_k": jnp.asarray(top_ks),
                   "top_p": jnp.asarray(top_ps),
                   "key": jnp.asarray(keys)}
+        # same fault point as step_launch: one hit per device step,
+        # whichever dispatch the engine mode uses
+        self._fire("step_launch",
+                   rids=[str(self._slots[s].rid) for s in active_slots])
         self._note_launch_gap(0)
         with record_span("serving.verify_step"):
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
@@ -1678,6 +1732,8 @@ class ServingEngine:
                       and self._slots[s]._pf_cursor + int(n_tok[s])
                       >= len(self._slots[s]._pf_feed)
                       and self._slots[s]._pf_sample]
+        self._fire("step_finish",
+                   rids=[str(self._slots[s].rid) for s in active_slots])
         grid, lp_grid, row_vals, seed_vals = self._fetch_results(
             (grid_dev, lp_dev,                            # (B, G) each
              logits[jnp.asarray(need_rows, jnp.int32)]
@@ -1781,7 +1837,17 @@ class ServingEngine:
         if pages:
             self.pool.incref(pages)
         if self.host_tier.enabled:
-            pages, cached = self._tier_restore(feed, pages, cached, req)
+            try:
+                pages, cached = self._tier_restore(feed, pages, cached,
+                                                   req)
+            except BaseException:
+                # a failed restore must give back the device-matched
+                # refs NOW: the caller never sees them (req._kv_match
+                # is only assigned on return), so crash recovery could
+                # not find the leak
+                if pages:
+                    self.pool.decref(pages)
+                raise
         return pages, cached
 
     def _tier_restore(self, feed, pages, cached, req):
@@ -1801,25 +1867,35 @@ class ServingEngine:
             tier.note_lookup(0)
             return pages, cached
         blocks = blocks[:n]
+        # fault point BEFORE the alloc: a raise here leaks nothing (the
+        # device-matched incref is dropped by recovery's unacquire)
+        self._fire("tier_restore",
+                   rids=None if req is None else [str(req.rid)])
         # alloc may evict — and spill — OTHER parked pages; this
         # candidate's device-matched prefix is already increfed, so
         # the restore can never cannibalize its own chain
         new_pages = self.pool.alloc(n)
-        k = np.stack([b["k"] for b in blocks], axis=2)
-        v = np.stack([b["v"] for b in blocks], axis=2)
-        ks = vs = None
-        if blocks[0]["ks"] is not None:
-            ks = np.stack([b["ks"] for b in blocks], axis=2)
-            vs = np.stack([b["vs"] for b in blocks], axis=2)
-        if ks is not None and not self.cache_quant:
-            # int8-quantized tier over an fp pool: dequantize on host
-            # (same absmax/127 scheme as the engine's int8 cache) and
-            # scatter full-precision values
-            from ..serving.kvtier import _dequantize_host
-            k = _dequantize_host(k, ks)
-            v = _dequantize_host(v, vs)
+        try:
+            k = np.stack([b["k"] for b in blocks], axis=2)
+            v = np.stack([b["v"] for b in blocks], axis=2)
             ks = vs = None
-        self._scatter_host_kv(new_pages, k, v, ks, vs)
+            if blocks[0]["ks"] is not None:
+                ks = np.stack([b["ks"] for b in blocks], axis=2)
+                vs = np.stack([b["vs"] for b in blocks], axis=2)
+            if ks is not None and not self.cache_quant:
+                # int8-quantized tier over an fp pool: dequantize on
+                # host (same absmax/127 scheme as the engine's int8
+                # cache) and scatter full-precision values
+                from ..serving.kvtier import _dequantize_host
+                k = _dequantize_host(k, ks)
+                v = _dequantize_host(v, vs)
+                ks = vs = None
+            self._scatter_host_kv(new_pages, k, v, ks, vs)
+        except BaseException:
+            # scatter failed mid-restore: the fresh pages were never
+            # mapped or indexed — return them or they leak
+            self.pool.decref(new_pages)
+            raise
         all_pages = pages + new_pages
         new_cached = cached + n * self.page_size
         self.prefix_cache.insert(feed, all_pages, new_cached)
@@ -1928,6 +2004,7 @@ class ServingEngine:
         n_tok[slot] = n
         active = np.zeros((self.max_seqs,), bool)
         active[slot] = True
+        self._fire("suffix_prefill", rids=[str(req.rid)])
         with record_span("serving.prefill"):
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
              logits) = verify_step(

@@ -15,6 +15,10 @@ Layers (docs/serving.md has the architecture):
                   (int8-quantized, async copies off the pump thread),
                   lookups fall through device -> host, and the
                   preemption offload stash shares the bytes ledger.
+  * `faults`    — deterministic fault injection: a seeded `FaultPlan`
+                  (PT_FAULTS / constructor) armed at the stack's real
+                  failure sites, so chaos drills replay byte-for-byte
+                  (docs/reliability.md).
   * `scheduler` — thread-safe bounded request queue with priority
                   classes, deadlines/TTLs, cancellation, backpressure
                   (`BackpressureError`), and graceful drain.
@@ -36,9 +40,11 @@ the engine arrives as a constructor argument — so
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    client, kvcache, kvtier, metrics, replica, router, scheduler, server,
+    client, faults, kvcache, kvtier, metrics, replica, router, scheduler,
+    server,
 )
 from .client import ServingClient, ServingHTTPError  # noqa: F401
+from .faults import FaultPlan, InjectedFault  # noqa: F401
 from .kvcache import PagePool, PrefixCache  # noqa: F401
 from .kvtier import HostTier  # noqa: F401
 from .metrics import (  # noqa: F401
@@ -49,20 +55,23 @@ from .replica import (  # noqa: F401
 )
 from .router import Router, RouterRequest, prefix_key  # noqa: F401
 from .scheduler import (  # noqa: F401
-    BackpressureError, DeadlineExceededError, RequestScheduler,
-    SchedulerClosedError, SchedulerError, ServingRequest,
+    BackpressureError, CrashLoopError, DeadlineExceededError,
+    PoisonedRequestError, RequestScheduler, SchedulerClosedError,
+    SchedulerError, ServingRequest,
 )
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
-    "client", "kvcache", "kvtier", "metrics", "replica", "router",
-    "scheduler", "server",
+    "client", "faults", "kvcache", "kvtier", "metrics", "replica",
+    "router", "scheduler", "server",
     "ServingClient", "ServingHTTPError",
+    "FaultPlan", "InjectedFault",
     "PagePool", "PrefixCache", "HostTier",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineMetrics",
     "Replica", "ReplicaKilledError", "build_replicas",
     "Router", "RouterRequest", "prefix_key",
     "RequestScheduler", "ServingRequest", "SchedulerError",
     "BackpressureError", "DeadlineExceededError", "SchedulerClosedError",
+    "PoisonedRequestError", "CrashLoopError",
     "ServingServer",
 ]

@@ -67,8 +67,9 @@ class ServingClient:
         self.host = host
         self.port = port
         self.timeout = timeout
-        # opt-in bounded retry on 429 backpressure (never on 503
-        # shutdown or 4xx request errors — those don't heal by waiting)
+        # opt-in bounded retry on 429 backpressure and Retry-After-
+        # carrying 503s (crash-loop breaker); never on bare-503
+        # shutdown or 4xx request errors — those don't heal by waiting
         self.retries = int(retries)
         self.retry_cap_s = float(retry_cap_s)
         self._rng = _rng if _rng is not None else random.Random()
@@ -86,17 +87,22 @@ class ServingClient:
 
     def _with_retries(self, fn):
         """Run fn(); retry (at most `self.retries` extra times) on 429
-        backpressure — sleeping out the server's Retry-After, capped
-        and jittered to decorrelate a thundering herd — and on
-        connection refused/reset/disconnect with a short exponential
-        backoff (a replica restarting behind the router). Everything
-        else raises immediately."""
+        backpressure and on 503s that carry Retry-After (the crash-
+        loop breaker: the replica heals on revive, so a single-replica
+        deployment is retried instead of surfaced) — sleeping out the
+        server's hint, capped and jittered to decorrelate a thundering
+        herd — and on connection refused/reset/disconnect with a short
+        exponential backoff (a replica restarting behind the router).
+        A bare 503 (draining shutdown) and everything else raise
+        immediately — those don't heal by waiting."""
         attempt = 0
         while True:
             try:
                 return fn()
             except ServingHTTPError as e:
-                if e.status != 429 or attempt >= self.retries:
+                healing = e.status == 429 or (
+                    e.status == 503 and e.retry_after_s is not None)
+                if not healing or attempt >= self.retries:
                     raise
                 hint = e.retry_after_s if e.retry_after_s is not None \
                     else 1.0

@@ -104,9 +104,13 @@ class HostTier:
         self.spills = 0
         self.restores = 0            # pages restored host -> device
         self.drops = 0
+        self.copy_errors = 0         # spill copies that failed (page lost)
         self.tokens_reused = 0
         self._q = None
         self._worker = None
+        # optional serving.faults.FaultPlan — the engine attaches its
+        # own so `tier_spill` drills hit the real copy path
+        self.faults = None
 
     @property
     def enabled(self):
@@ -137,11 +141,18 @@ class HostTier:
         self._worker.start()
 
     def _copy_loop(self):
+        # one bad page must cost ONE page: an exception anywhere in the
+        # fence/quantize/index path drops that page (a future lookup is
+        # simply a miss), counts a copy error, leaves an evidence trail,
+        # and keeps the daemon alive for every later spill — a dying
+        # copy thread would silently turn the tier off
         while True:
             item = self._q.get()
             try:
                 self._land(*item)
             except Exception as e:  # noqa: BLE001 — a failed spill is a miss
+                with self._lock:
+                    self.copy_errors += 1
                 _flight.record("kvtier.error", error=repr(e))
             finally:
                 self._q.task_done()
@@ -150,6 +161,11 @@ class HostTier:
         # the explicit fence: device -> host, off the pump thread
         k = np.asarray(k)
         v = np.asarray(v)
+        if self.faults is not None:
+            # chaos drills for the copy path: raise -> the page is
+            # dropped and counted; corrupt -> a deterministic byte flip
+            # lands in the stored payload
+            k = self.faults.fire("tier_spill", k)
         ks = None if ks is None else np.asarray(ks, np.float32)
         vs = None if vs is None else np.asarray(vs, np.float32)
         if self.quantize and not prequantized:
@@ -308,4 +324,5 @@ class HostTier:
                                  if self.lookups else 0.0),
                     "spills": self.spills, "restores": self.restores,
                     "drops": self.drops,
+                    "copy_errors": self.copy_errors,
                     "tokens_reused": self.tokens_reused}

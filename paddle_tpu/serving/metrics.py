@@ -341,6 +341,10 @@ class EngineMetrics:
         self.tier_drops = r.counter(
             "pt_prefix_tier_drops",
             "Host-tier pages dropped under the tier_bytes budget.")
+        self.tier_copy_errors = r.counter(
+            "pt_prefix_tier_copy_errors",
+            "Spill copies that failed on the tier's copy thread (the "
+            "page is dropped, the thread survives).")
         self.tier_host_bytes = r.gauge(
             "pt_tier_host_bytes",
             "Host RAM held by the KV tier (spilled pages + preemption "
@@ -348,7 +352,25 @@ class EngineMetrics:
         self.tier_pages = r.gauge(
             "pt_tier_pages", "KV pages resident in the host tier.")
         self._tier_seen = {"spills": 0, "hits": 0, "restores": 0,
-                           "drops": 0}
+                           "drops": 0, "copy_errors": 0}
+        # crash recovery (serving/faults.py + scheduler warm restart):
+        # restart cadence, requeue volume, and poison quarantines —
+        # the numbers docs/reliability.md's runbook reads
+        self.engine_restarts = r.counter(
+            "pt_engine_restarts",
+            "Warm restarts after an engine step exception (device "
+            "state released, unstarted requests requeued).")
+        self.restart_seconds = r.histogram(
+            "pt_engine_restart_seconds",
+            "Wall time of one warm restart: device-state release "
+            "through requeue.")
+        self.requests_requeued = r.counter(
+            "pt_requests_requeued",
+            "Requests requeued by a warm restart instead of failed.")
+        self.poison_quarantined = r.counter(
+            "pt_poison_quarantined",
+            "Requests quarantined as poison after crashing K "
+            "consecutive admitted steps.")
 
     # -- engine-facing hooks (called from the step()-driving thread) --
     def on_submit(self, engine):
@@ -379,7 +401,8 @@ class EngineMetrics:
             for name, counter in (("spills", self.tier_spills),
                                   ("hits", self.tier_hits),
                                   ("restores", self.tier_restores),
-                                  ("drops", self.tier_drops)):
+                                  ("drops", self.tier_drops),
+                                  ("copy_errors", self.tier_copy_errors)):
                 delta = st[name] - seen[name]
                 if delta > 0:
                     counter.inc(delta)
@@ -449,6 +472,20 @@ class EngineMetrics:
         """A request was failed by an engine error (the router's
         failover trigger)."""
         self.failed.inc()
+
+    def on_restart(self, dt):
+        """One warm restart completed (device-state release through
+        requeue) in `dt` seconds."""
+        self.engine_restarts.inc()
+        self.restart_seconds.observe(dt)
+
+    def on_requeue(self, n):
+        """`n` requests were requeued instead of failed."""
+        self.requests_requeued.inc(n)
+
+    def on_poison(self):
+        """A request was quarantined as poison."""
+        self.poison_quarantined.inc()
 
     def on_expire(self):
         self.expired.inc()

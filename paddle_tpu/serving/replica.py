@@ -26,6 +26,7 @@ model code (the serving package stays cycle-free and cheap).
 """
 from __future__ import annotations
 
+from .faults import FaultPlan
 from .metrics import MetricsRegistry
 from .scheduler import RequestScheduler
 
@@ -34,8 +35,8 @@ __all__ = ["Replica", "ReplicaKilledError", "build_replicas"]
 
 class ReplicaKilledError(RuntimeError):
     """Injected engine failure (Replica.kill): every subsequent step
-    raises, so in-flight and queued requests fail and the router's
-    failover path takes over."""
+    raises, so the scheduler's crash recovery runs — requeues, then
+    quarantine/breaker — and the router's failover path takes over."""
 
 
 class Replica:
@@ -43,18 +44,21 @@ class Replica:
 
     `replica_id` is the stable identity used for consistent-hash ring
     placement, the `replica=` label on aggregated /metrics, and
-    flight-recorder events.
+    flight-recorder events. Extra keyword arguments (`poison_after`,
+    `max_restarts`, `restart_window_s`, ...) pass through to the
+    scheduler — per-replica recovery thresholds for chaos drills.
     """
 
     def __init__(self, replica_id, engine, *, max_queue=64,
-                 metrics=None, idle_poll_s=0.02, pipeline=None):
+                 metrics=None, idle_poll_s=0.02, pipeline=None,
+                 **sched_kw):
         self.replica_id = str(replica_id)
         self.engine = engine
         registry = metrics if metrics is not None else MetricsRegistry()
         self.scheduler = RequestScheduler(engine, max_queue=max_queue,
                                           metrics=registry,
                                           idle_poll_s=idle_poll_s,
-                                          pipeline=pipeline)
+                                          pipeline=pipeline, **sched_kw)
 
     # -- identity / introspection -------------------------------------
     @property
@@ -110,44 +114,47 @@ class Replica:
         return self.scheduler.shutdown(drain=drain, timeout=timeout)
 
     def kill(self, exc=None):
-        """Fault injection: every subsequent engine step raises, the
-        scheduler's `_fail_all` fails whatever is queued or running,
-        and the router fails those requests over to a healthy replica.
-        This is the chaos drill the failover tests run; a real crash
-        (OOM, device loss) takes the identical code path because the
-        pump already converts ANY step exception into failed
-        requests."""
+        """Fault injection: one FaultPlan rule among many — an
+        infinite `step_launch:raise` armed on the engine's plan, so
+        every device step (sync, pipelined, and spec dispatch all fire
+        the same point) raises and the scheduler's crash recovery
+        runs: requeues burn through the poison/breaker thresholds and
+        the router fails the requests over to a healthy replica. A
+        real crash (OOM, device loss) takes the identical code path
+        because the pump converts ANY step exception into a warm
+        restart."""
         err = exc if exc is not None else ReplicaKilledError(
             f"replica {self.replica_id}: killed (fault injection)")
-
-        def _dead_step(*args, **kwargs):
-            raise err
-        # both pump entry points: the synchronous loop calls step(),
-        # the pipelined pump calls step_launch() — a kill must fire
-        # whichever one the scheduler drives (with a step in flight,
-        # the next launch raises and _fail_all drains the ticket)
-        self.engine.step = _dead_step
-        self.engine.step_launch = _dead_step
+        plan = self.engine.faults
+        if plan is None:
+            plan = self.engine.faults = FaultPlan()
+        plan.add("step_launch", "raise", count=None, exc=err,
+                 label=f"kill:{self.replica_id}")
 
     def revive(self):
-        """Undo `kill()`: drop the injected step overrides so the class
-        methods resume — the 'replica restarted' half of a failover
-        drill (the scheduler's `_fail_all` already left the engine's
+        """Undo `kill()`: remove the kill rule and close the crash-
+        loop breaker — the 'replica restarted' half of a failover
+        drill (the scheduler's recovery already left the engine's
         slots and pages clean)."""
+        plan = self.engine.faults
+        if plan is not None:
+            plan.remove(f"kill:{self.replica_id}")
+        # tests may also have installed direct step overrides
         self.engine.__dict__.pop("step", None)
         self.engine.__dict__.pop("step_launch", None)
+        self.scheduler.reset_breaker()
 
     def __repr__(self):
         return f"Replica({self.replica_id!r})"
 
 
 def build_replicas(engine_factory, n, *, max_queue=64, prefix="r",
-                   idle_poll_s=0.02, pipeline=None):
+                   idle_poll_s=0.02, pipeline=None, **sched_kw):
     """N independent replicas from an engine factory. The factory is
     called once per replica — each gets its own params reference but
     its own KV pool, prefix cache, scheduler, and metrics registry
     (`engine_factory(i) -> ServingEngine`)."""
     return [Replica(f"{prefix}{i}", engine_factory(i),
                     max_queue=max_queue, idle_poll_s=idle_poll_s,
-                    pipeline=pipeline)
+                    pipeline=pipeline, **sched_kw)
             for i in range(int(n))]

@@ -180,10 +180,15 @@ class RouterRequest:
             self._router._note_result(self.replica_id, self._sr.state)
 
     def _failed_unstarted(self):
-        """Replica failed this request before it produced anything —
-        the safe-to-replay case (queued, or admitted but zero tokens
-        emitted)."""
-        return self._sr.state == "failed" and not self._sr.req.output
+        """Replica failed this request before the CONSUMER saw any
+        bytes — the safe-to-replay case. Generated-but-unconsumed
+        tokens (e.g. a warm restart's requeue cycles before the crash-
+        loop breaker gave up) don't block failover: generation is
+        deterministic for the given parameters, and a failed request
+        never publishes further chunks, so a re-dispatch is token-
+        identical to an undisturbed run."""
+        return self._sr.state == "failed" and \
+            not getattr(self._sr, "_streamed", False)
 
     def _failover_or_raise(self, err):
         self._report()
@@ -250,10 +255,14 @@ class Router:
     """
 
     def __init__(self, replicas, *, policy="affinity", vnodes=64,
-                 unhealthy_after=2, probe_after_s=1.0, metrics=None):
+                 unhealthy_after=2, probe_after_s=1.0, metrics=None,
+                 faults=None):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(
                 f"policy={policy!r}: use 'affinity' or 'round_robin'")
+        # optional serving.faults.FaultPlan: the `router_dispatch`
+        # point fires once per submit, before replica selection
+        self.faults = faults
         self._lock = threading.Lock()
         self._replicas = {}          # rid -> _ReplicaState (ordered)
         self._ring = _HashRing(vnodes)
@@ -359,6 +368,9 @@ class Router:
         replica refused admission, SchedulerClosedError when none is in
         rotation, ValueError for a request no engine could run (the
         first candidate validates it)."""
+        if self.faults is not None:
+            self.faults.fire("router_dispatch",
+                             rids=None if rid is None else [str(rid)])
         key, n_blocks = prefix_key(prompt_ids, self.page_size or 1)
         plan = self._plan(key)
         kw = dict(params, priority=priority, ttl_s=ttl_s,

@@ -15,7 +15,19 @@ can submit to:
     own FIFO is never allowed to stack, so a late high-priority
     arrival cannot be inverted by it);
   * graceful drain: `shutdown(drain=True)` stops admissions, lets
-    in-flight work finish, then parks the pump thread.
+    in-flight work finish, then parks the pump thread;
+  * crash recovery (docs/reliability.md): a pump exception warm-
+    restarts the engine instead of failing every request — device
+    state is released, requests that never streamed a byte are
+    REQUEUED (same rid/trace id/deadline/priority; generated-so-far
+    tokens replayed through the prefix-cache/suffix-prefill resume
+    path, token-identically), only mid-stream requests fail. A
+    request admitted across `poison_after` consecutive crashed steps
+    is quarantined as poison (fails alone, never requeued again), and
+    `max_restarts` restarts within `restart_window_s` trip a crash-
+    loop breaker: readiness flips false (/readyz 503, the router's
+    failover takes over) and admission refuses with CrashLoopError
+    until `reset_breaker()` (Replica.revive calls it).
 
 The engine itself is NOT thread-safe and is only ever touched by the
 pump thread; cross-thread communication is flag-based (cancel marks)
@@ -38,7 +50,8 @@ from .metrics import EngineMetrics, MetricsRegistry
 
 __all__ = ["RequestScheduler", "ServingRequest", "SchedulerError",
            "BackpressureError", "DeadlineExceededError",
-           "SchedulerClosedError", "PRIORITIES"]
+           "SchedulerClosedError", "PoisonedRequestError",
+           "CrashLoopError", "PRIORITIES"]
 
 PRIORITIES = ("high", "normal", "low")
 
@@ -62,6 +75,24 @@ class DeadlineExceededError(SchedulerError):
 
 class SchedulerClosedError(SchedulerError):
     """submit() after shutdown() began."""
+
+
+class PoisonedRequestError(SchedulerError):
+    """The request was quarantined: it sat in the admitted set for
+    `poison_after` consecutive crashed engine steps, so the scheduler
+    attributes the crash loop to it. It fails alone — client-visible
+    as a `poisoned` error — and is never requeued again."""
+
+
+class CrashLoopError(SchedulerClosedError):
+    """Admission refused: the crash-loop breaker is open
+    (`max_restarts` engine restarts within `restart_window_s`). HTTP
+    frontends map this to 503 with Retry-After; the router skips to
+    the next replica (it subclasses SchedulerClosedError)."""
+
+    def __init__(self, msg, retry_after_s=1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class ServingRequest:
@@ -89,6 +120,17 @@ class ServingRequest:
         self._cancel_requested = False
         self._cancel_applied = False
         self._expired = False
+        # crash-recovery state: `_streamed` flips when a consumer has
+        # SEEN a chunk (the point of no replay — published-but-unread
+        # chunks stay replayable because recovery is token-identical);
+        # `_crash_streak` counts consecutive crashed steps while
+        # admitted (quarantine attribution, reset by a proven step);
+        # `_requeues` is the request's lifetime warm-restart count
+        self._streamed = False
+        self._started = False
+        self._crash_streak = 0
+        self._requeues = 0
+        self._proof_mark = 0
         self._done = threading.Event()
 
     @property
@@ -110,6 +152,9 @@ class ServingRequest:
                 if self.error is not None:
                     raise self.error
                 return
+            # the consumer is about to see bytes: from here on a crash
+            # must fail this request, never silently replay it
+            self._streamed = True
             yield chunk
 
     def result(self, timeout=None):
@@ -125,7 +170,9 @@ class RequestScheduler:
     """Thread-safe frontend over one ServingEngine (see module doc)."""
 
     def __init__(self, engine, max_queue=64, metrics=None,
-                 idle_poll_s=0.02, start=True, pipeline=None):
+                 idle_poll_s=0.02, start=True, pipeline=None,
+                 poison_after=3, max_restarts=5, restart_window_s=10.0,
+                 breaker_retry_after_s=1.0):
         self._engine = engine
         # pipeline=True: double-buffered pump (docs/serving.md
         # § Pipelined step loop) — launch device step N+1 before
@@ -164,9 +211,36 @@ class RequestScheduler:
         # need DELTAS ("did this replica finish anything since the last
         # probe?"), which the point-in-time gauges cannot answer.
         # Mutated only under self._cond; surfaced by stats()/healthz
-        # and mirrored to pt_serving_requests_{started,failed} counters
+        # and mirrored to pt_serving_requests_{started,failed} counters.
+        # `requeued` counts warm-restart requeues ONCE each — the
+        # conservation invariant stays submitted == completed + failed
+        # + cancelled + expired + queued + inflight (a requeued request
+        # simply moves back into `queued`)
         self._ledger = {"submitted": 0, "started": 0, "completed": 0,
-                        "failed": 0, "cancelled": 0, "expired": 0}
+                        "failed": 0, "cancelled": 0, "expired": 0,
+                        "requeued": 0}
+        # crash recovery (docs/reliability.md). Quarantine: a request
+        # admitted across `poison_after` consecutive crashed steps is
+        # the attributed poison. Breaker: `max_restarts` restarts
+        # within `restart_window_s` seconds flip readiness false and
+        # refuse admission (CrashLoopError) until reset_breaker().
+        # Probation (`_suspects`/`_unproven`): requeued victims are
+        # re-admitted one at a time until each survives a step, so a
+        # poison request crashes ALONE and innocents never accumulate
+        # a streak.
+        self.poison_after = int(poison_after)
+        self.max_restarts = int(max_restarts)
+        self.restart_window_s = float(restart_window_s)
+        self.breaker_retry_after_s = float(breaker_retry_after_s)
+        if self.poison_after < 1:
+            raise ValueError(f"poison_after={poison_after}: want >= 1")
+        if self.max_restarts < 1:
+            raise ValueError(f"max_restarts={max_restarts}: want >= 1")
+        self._suspects = set()          # requeued, not yet proven
+        self._unproven = set()          # fed back, awaiting one step
+        self._restart_t = deque()       # restart times in the window
+        self._broken = False
+        self._quarantined = 0
         self._fin_seen = len(engine.finished)
         self._rid = itertools.count()
         self._closed = False
@@ -205,6 +279,16 @@ class RequestScheduler:
             if self._closed:
                 raise SchedulerClosedError(
                     "serving: scheduler is shutting down")
+            if self._broken:
+                self.metrics.on_reject()
+                _flight.record("sched.reject", rid=str(req.rid),
+                               trace_id=trace_id, priority=priority,
+                               reason="crash_loop")
+                raise CrashLoopError(
+                    "serving: crash-loop breaker open "
+                    f"({len(self._restart_t)} engine restarts within "
+                    f"{self.restart_window_s:g}s); replica needs "
+                    "intervention", retry_after_s=self.breaker_retry_after_s)
             depth = self._queued_locked()
             if depth >= self.max_queue:
                 self.metrics.on_reject()
@@ -296,6 +380,14 @@ class RequestScheduler:
                 "preemptions": self._engine.preemptions,
                 # monotonic ledger — consumers diff it across probes
                 "requests": dict(self._ledger),
+                # crash-recovery surface: restart cadence + breaker
+                "recovery": {
+                    "restarts": getattr(self._engine, "restarts", 0),
+                    "quarantined": self._quarantined,
+                    "breaker_open": self._broken,
+                    "recent_restarts": len(self._restart_t),
+                    "restart_window_s": self.restart_window_s,
+                },
             }
             pc = getattr(self._engine, "prefix_cache", None)
             if pc is not None:
@@ -313,9 +405,20 @@ class RequestScheduler:
         with self._cond:
             if self._closed:
                 return False, "draining"
+            if self._broken:
+                return False, "crash_loop"
             if self._paused:
                 return False, "paused"
             return True, "ok"
+
+    def reset_breaker(self):
+        """Close the crash-loop breaker and forget the restart window
+        — the 'operator fixed the fault' half of a recovery drill
+        (Replica.revive calls this after removing its kill rule)."""
+        with self._cond:
+            self._broken = False
+            self._restart_t.clear()
+            self._cond.notify_all()
 
     def render_prometheus(self):
         """Prometheus exposition of this scheduler's registry (the
@@ -378,6 +481,11 @@ class RequestScheduler:
     def _feed_locked(self):
         if self._paused:
             return
+        if self._unproven:
+            # probation: a requeued victim is in the engine and has not
+            # survived a step yet — feed nothing until it proves (or
+            # crashes alone, which is the attribution we want)
+            return
         eng = self._engine
         room = sum(1 for r in eng._slots if r is None) \
             - len(eng._waiting)
@@ -387,14 +495,26 @@ class RequestScheduler:
                 break
             eng.submit(sr.req)
             sr.state = "running"
-            self._ledger["started"] += 1
-            self.metrics.on_start()
+            if not sr._started:
+                # started counts DISTINCT requests that left the queue:
+                # a warm-restart requeue re-feeds, it does not re-start
+                sr._started = True
+                self._ledger["started"] += 1
+                self.metrics.on_start()
             sr.t_admitted = time.monotonic()
             _flight.record("sched.admit", rid=str(sr.rid),
                            trace_id=sr.trace_id, priority=sr.priority,
-                           queued_s=sr.t_admitted - sr.t_submit)
+                           queued_s=sr.t_admitted - sr.t_submit,
+                           requeues=sr._requeues or None)
             self._inflight[id(sr.req)] = sr
             room -= 1
+            if self._suspects:
+                # while any requeued victim awaits its proof, admission
+                # is one-at-a-time: proven requests keep running, the
+                # next candidate joins only after this one survives a
+                # step — so a poison request eventually crashes alone
+                self._unproven.add(sr)
+                break
 
     def _publish(self):
         """Push newly emitted tokens to each in-flight handle and
@@ -407,6 +527,15 @@ class RequestScheduler:
                         sr.t_first_token = time.monotonic()
                     sr.chunks.put(list(sr.req.output[sr._emitted:n]))
                     sr._emitted = n
+            if self._unproven:
+                # probation proof: output advanced past the requeue
+                # snapshot means the victim survived a step — its crash
+                # streak resets and the next suspect may be fed
+                for sr in list(self._unproven):
+                    if len(sr.req.output) > sr._proof_mark:
+                        self._unproven.discard(sr)
+                        self._suspects.discard(sr)
+                        sr._crash_streak = 0
             fin = self._engine.finished
             while self._fin_seen < len(fin):
                 req = fin[self._fin_seen]
@@ -428,6 +557,8 @@ class RequestScheduler:
     def _finalize(self, sr, state):
         sr.state = state
         sr.t_done = time.monotonic()
+        self._suspects.discard(sr)
+        self._unproven.discard(sr)
         self._ledger[{"done": "completed", "failed": "failed",
                       "cancelled": "cancelled",
                       "expired": "expired"}[state]] += 1
@@ -439,7 +570,11 @@ class RequestScheduler:
                 f"{sr.t_done - sr.t_submit:.3f}s "
                 f"({len(sr.req.output)} tokens emitted)")
         n = len(sr.req.output)
-        if n > sr._emitted:
+        if n > sr._emitted and state != "failed":
+            # a FAILED request publishes no further bytes: its partial
+            # output is untrusted, and "failed ⇒ the consumer saw only
+            # what it already saw" is what makes never-streamed
+            # failures safely replayable (router failover)
             sr.chunks.put(list(sr.req.output[sr._emitted:n]))
             sr._emitted = n
         sr.chunks.put(None)
@@ -539,7 +674,7 @@ class RequestScheduler:
                 try:
                     self._finish_pending()
                 except Exception as e:  # noqa: BLE001 — fail requests
-                    self._fail_all(e)
+                    self._recover(e)
                 self._publish()
             with self._cond:
                 self._expire_and_cancel_locked()
@@ -560,7 +695,7 @@ class RequestScheduler:
                     n_active = self._engine.step()
             except Exception as e:  # noqa: BLE001 — fail requests
                 self._pending = None
-                self._fail_all(e)
+                self._recover(e)
                 continue
             dt = time.perf_counter() - t0
             self.metrics.observe_step(dt)
@@ -582,35 +717,129 @@ class RequestScheduler:
             try:
                 self._finish_pending()
             except Exception as e:  # noqa: BLE001
-                self._fail_all(e)
+                self._recover(e)
         self._publish()
 
-    def _fail_all(self, exc):
-        """An engine step blew up: fail every in-flight request rather
-        than hanging their streams, and release the engine's state."""
+    def _recover(self, exc):
+        """An engine step blew up: warm-restart instead of failing
+        everyone (docs/reliability.md has the state machine).
+
+        Device state is released exactly as a failure must (the
+        engine's `crash_reset`: index-suspended slot release, stash
+        drop for engine-queued victims). Then each in-flight request is
+        classified, in order:
+
+          cancelled/expired  -> its normal terminal state;
+          quarantined        -> admitted across `poison_after`
+                                consecutive crashed steps: the
+                                attributed poison fails ALONE with a
+                                client-visible PoisonedRequestError
+                                and is never requeued again;
+          requeued           -> never streamed a byte: back to the
+                                FRONT of its priority queue with the
+                                same rid/trace id/deadline; generated-
+                                so-far tokens replay through the
+                                preemption-resume / prefix-cache
+                                suffix-prefill path, token-identically;
+          failed             -> mid-stream (the consumer has bytes), or
+                                the breaker/shutdown forbids requeue.
+
+        `max_restarts` restarts inside `restart_window_s` trip the
+        crash-loop breaker BEFORE classification: everything fails
+        fast (nothing streamed -> router failover stays token-
+        identical), readiness flips false, and admission refuses until
+        reset_breaker()."""
+        t0 = time.perf_counter()
         self._log.event("engine.error", level="error", error=repr(exc))
         with self._cond:
             eng = self._engine
-            # the failed/abandoned launch leaves the gap clock mid-step
-            eng._t_launch_end = None
-            # a failed step may have advanced lengths past K/V that
-            # never landed — releasing these slots must NOT index
-            # their pages into the prefix cache
-            eng._index_suspend = True
-            try:
-                for s in range(eng.max_seqs):
-                    if eng._slots[s] is not None:
-                        eng._release(s)
-            finally:
-                eng._index_suspend = False
-            # waiting requests may hold offloaded KV in the host
-            # tier's pinned stash — release it, or the tier ledger
-            # leaks bytes for requests that will never resume
-            for r in eng._waiting:
-                eng._drop_offload(r)
-            eng._waiting.clear()
+            # who was the engine actually working on? slot holders plus
+            # requests popped from its queue mid-admission (limbo) form
+            # the "admitted set" the poison streak attributes to;
+            # engine-queued requests were untouched by the crash
+            active_ids = {id(r) for r in eng._slots if r is not None}
+            waiting_ids = {id(r) for r in eng._waiting}
+            eng.crash_reset()
+            now = time.monotonic()
+            self._restart_t.append(now)
+            while self._restart_t and \
+                    now - self._restart_t[0] > self.restart_window_s:
+                self._restart_t.popleft()
+            if not self._broken and \
+                    len(self._restart_t) >= self.max_restarts:
+                self._broken = True
+                _flight.record("engine.breaker",
+                               restarts=len(self._restart_t),
+                               window_s=self.restart_window_s,
+                               error=repr(exc))
+                self._log.event("engine.breaker", level="error",
+                                restarts=len(self._restart_t),
+                                window_s=self.restart_window_s)
+            requeue_ok = not self._closed and not self._broken
+            requeued, failed, quarantined = [], [], []
             for sr in list(self._inflight.values()):
-                sr.error = SchedulerError(
-                    f"engine step failed: {exc!r}")
-                self._finalize(sr, "failed")
+                req = sr.req
+                # an admission candidate may still hold acquired prefix
+                # refs (crash mid-_admit): drop them or the pool leaks
+                eng._cache_unacquire(req)
+                if id(req) not in waiting_ids or id(req) in active_ids:
+                    sr._crash_streak += 1
+                if sr._cancel_requested:
+                    self.metrics.on_cancel("running")
+                    _flight.record("sched.cancel", rid=str(sr.rid),
+                                   trace_id=sr.trace_id, where="crash")
+                    self._finalize(sr, "cancelled")
+                elif sr._expired:
+                    self._finalize(sr, "expired")
+                elif sr._crash_streak >= self.poison_after:
+                    sr.error = PoisonedRequestError(
+                        f"request {sr.rid}: poisoned — in the admitted "
+                        f"set for {sr._crash_streak} consecutive failed "
+                        f"steps; quarantined (last error: {exc!r})")
+                    self._quarantined += 1
+                    self.metrics.on_poison()
+                    _flight.record("poison.quarantine", rid=str(sr.rid),
+                                   trace_id=sr.trace_id,
+                                   streak=sr._crash_streak,
+                                   error=repr(exc))
+                    quarantined.append(sr)
+                    self._finalize(sr, "failed")
+                elif requeue_ok and not sr._streamed:
+                    requeued.append(sr)
+                else:
+                    sr.error = SchedulerError(
+                        f"engine step failed: {exc!r}")
+                    failed.append(sr)
+                    self._finalize(sr, "failed")
             self._inflight.clear()
+            self._unproven.clear()
+            # requeue to the FRONT of each priority queue, preserving
+            # the original admission order; resume state rides the
+            # Request itself (the recompute-preemption machinery):
+            # prompt + generated-so-far re-prefill, pending next_token
+            # survives, nothing is re-sampled
+            for sr in reversed(requeued):
+                req = sr.req
+                req.slot = None
+                req._offload = None
+                req._resume = bool(req.output)
+                sr.state = "queued"
+                sr._cancel_applied = False
+                sr._requeues += 1
+                sr._proof_mark = len(req.output)
+                self._suspects.add(sr)
+                self._queues[sr.priority].appendleft(sr)
+            self._ledger["requeued"] += len(requeued)
+            if requeued:
+                self.metrics.on_requeue(len(requeued))
+            dt = time.perf_counter() - t0
+            self.metrics.on_restart(dt)
+            _flight.record(
+                "engine.restart", error=repr(exc), duration_s=dt,
+                requeued=len(requeued), failed=len(failed),
+                quarantined=len(quarantined), broken=self._broken,
+                restarts=eng.restarts,
+                trace_ids=[sr.trace_id for sr in
+                           requeued + quarantined + failed])
+            self.metrics.set_queue_depth(self._queued_locked())
+            self._cond.notify_all()

@@ -171,7 +171,12 @@ class CompletionHandler(BaseHTTPRequestHandler):
                                  str(max(int(e.retry_after_s), 1))),))
             return
         except SchedulerClosedError as e:
-            self._json(503, {"error": str(e)})
+            # a crash-loop breaker's 503 carries Retry-After (the
+            # replica heals on revive); a draining shutdown does not
+            ra = getattr(e, "retry_after_s", None)
+            self._json(503, {"error": str(e)},
+                       headers=() if ra is None else
+                       (("Retry-After", str(max(int(ra), 1))),))
             return
         except (TypeError, ValueError) as e:
             self._json(400, {"error": str(e)})

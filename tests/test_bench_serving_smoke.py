@@ -29,6 +29,7 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
+    monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "ngram-repetitive"
     assert out["spec_accept_rate"] > 0, out
@@ -92,6 +93,7 @@ def test_prefix_bench_reuses_cached_pages(monkeypatch):
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
+    monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
     monkeypatch.setenv("PT_SERVE_PREFIX", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "shared-prefix"
@@ -113,6 +115,7 @@ def test_multiturn_bench_hits_the_host_tier(monkeypatch):
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
+    monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
     monkeypatch.setenv("PT_SERVE_MULTITURN", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "multi-turn"
@@ -134,6 +137,7 @@ def test_plain_bench_unaffected(monkeypatch):
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
+    monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["decode_tokens_per_sec"] > 0
     assert "spec_decode" not in out
@@ -153,6 +157,7 @@ def test_router_bench_snapshot(monkeypatch):
     monkeypatch.delenv("PT_SERVE_PREFIX", raising=False)
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
+    monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
     monkeypatch.setenv("PT_SERVE_ROUTER", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "router-shared-prefix"
@@ -187,7 +192,8 @@ def test_pipeline_bench_token_identical_and_faster_host(monkeypatch):
     not."""
     bm = _load_bench_models()
     for env in ("PT_SERVE_SPEC", "PT_SERVE_CACHE", "PT_SERVE_PREFIX",
-                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN"):
+                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN",
+                "PT_SERVE_CHAOS"):
         monkeypatch.delenv(env, raising=False)
     monkeypatch.setenv("PT_SERVE_PIPELINE", "1")
     # wall-clock comparisons on a loaded CI box are noisy: the
@@ -212,3 +218,30 @@ def test_pipeline_bench_token_identical_and_faster_host(monkeypatch):
         raise AssertionError(
             f"pipelined pump did not reduce the host gap in 2 "
             f"attempts: {last}")
+
+
+def test_chaos_bench_recovers_token_identical(monkeypatch):
+    """PT_SERVE_CHAOS=1 (ISSUE 9 acceptance): a seeded fault plan
+    kills a device step mid-run under BOTH pumps; warm restart must
+    requeue the victims and finish them token-identical to the
+    undisturbed baseline with zero failed requests, full goodput, and
+    a balanced requeue ledger."""
+    bm = _load_bench_models()
+    for env in ("PT_SERVE_SPEC", "PT_SERVE_CACHE", "PT_SERVE_PREFIX",
+                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN",
+                "PT_SERVE_PIPELINE"):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv("PT_SERVE_CHAOS", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "chaos-recovery"
+    assert out["outputs_match"] is True, out
+    for pump in ("sync", "pipelined"):
+        d = out[pump]
+        assert d["outputs_match"] is True, (pump, d)
+        assert d["failed_requests"] == 0, (pump, d)
+        assert d["restarts"] >= 1 and d["requeued"] >= 1, (pump, d)
+        assert d["quarantined"] == 0, (pump, d)
+        assert d["goodput_retained"] == 1.0, (pump, d)
+        assert d["ledger_balanced"] is True, (pump, d)
+        assert d["tokens_per_sec"] > 0
+    assert out["baseline_tokens_per_sec"] > 0

@@ -134,6 +134,26 @@ def print_flight(doc, tail=30, kind=None, out=sys.stdout):
             w(f"; holding {_human_bytes(last.get('tier_bytes'))} "
               f"in {last.get('tier_pages')} pages")
         w("\n")
+    # crash-recovery rollup: engine.restart records carry what each
+    # warm restart did (requeued / failed / quarantined, and whether
+    # the crash-loop breaker tripped); poison.quarantine and
+    # fault.injected events tell the drill's story alongside
+    restarts = [e for e in events if e.get("kind") == "engine.restart"]
+    if restarts:
+        req = sum(e.get("requeued") or 0 for e in restarts)
+        fail = sum(e.get("failed") or 0 for e in restarts)
+        quar = sum(e.get("quarantined") or 0 for e in restarts)
+        inj = sum(1 for e in events if e.get("kind") == "fault.injected")
+        w(f"  engine restarts: {len(restarts)} "
+          f"({req} requeued, {fail} failed, {quar} quarantined")
+        if inj:
+            w(f", {inj} injected faults")
+        w(")")
+        if any(e.get("broken") for e in restarts):
+            last = [e for e in restarts if e.get("broken")][-1]
+            w(f"; crash-loop breaker OPEN "
+              f"(last error {last.get('error')})")
+        w("\n")
     # step-loop rollup: the rate-limited serving.step records carry the
     # pump's wall time, the host gap between device-step launches, and
     # the pipeline depth (1 = double-buffered pump) — enough to read
