@@ -207,6 +207,12 @@ def bench_serving(on_tpu):
     # reports goodput retained (serving/faults.py; docs/reliability.md)
     if (os.environ.get("PT_SERVE_CHAOS", "") or "0") not in ("", "0"):
         return _bench_serving_chaos(on_tpu, params, cfg, dtype)
+    # PT_SERVE_RAGGED=1: the unified ragged step vs the bucketed entry
+    # points at equal config and token-identical outputs — tracked
+    # compiles, pad tokens, measured MFU and tok/s for both sides
+    # (docs/serving.md § Unified ragged step)
+    if (os.environ.get("PT_SERVE_RAGGED", "") or "0") not in ("", "0"):
+        return _bench_serving_ragged(on_tpu, params, cfg, dtype)
 
     rng = _data_rng()
     if prefix_mode:
@@ -374,6 +380,91 @@ def bench_serving(on_tpu):
         out["plain_decode_tokens_per_sec"] = round(ptotal / pdt, 1)
         out["spec_speedup"] = round((total_new / dt) / (ptotal / pdt), 3)
     return out
+
+
+def _bench_serving_ragged(on_tpu, params, cfg, dtype):
+    """PT_SERVE_RAGGED=1: the unified ragged step vs the bucketed entry
+    points at equal config and TOKEN-IDENTICAL outputs. Shared-prefix
+    workload (the mix buckets handle worst): the first admission
+    prefills the whole prompt, later ones suffix-prefill behind a
+    prefix-cache hit, and decodes interleave throughout — the bucketed
+    side compiles one program per (entry point x bucket) that mix
+    visits, the ragged side compiles `unified_step` once and pays zero
+    pad tokens. The artifact carries tracked compiles (cold pass),
+    pad/ragged token counters, measured MFU and tok/s for both sides."""
+    from paddle_tpu.models.llama_serving import Request, ServingEngine
+    from paddle_tpu.observability import compile_telemetry as _ct
+    from paddle_tpu.observability import device_telemetry as _dt
+    from paddle_tpu.serving.metrics import EngineMetrics, MetricsRegistry
+
+    if on_tpu:
+        max_seqs, new_tok, nreq = 8, 64, 12
+        max_seq_len, page = 1024, 16
+    else:
+        max_seqs, new_tok, nreq = 2, 8, 4
+        max_seq_len, page = 64, 8
+    rng = _data_rng()
+    header = list(map(int, rng.randint(1, cfg.vocab_size, 3 * page)))
+    prompts = [header + list(map(int, rng.randint(
+        1, cfg.vocab_size, 16 if on_tpu else 4))) for _ in range(nreq)]
+
+    def run_once(ragged, nt):
+        eng = ServingEngine(params, cfg, max_seqs=max_seqs,
+                            max_seq_len=max_seq_len, page_size=page,
+                            dtype=dtype, prefix_cache=True, ragged=ragged,
+                            use_pallas=None if on_tpu else False)
+        reg = MetricsRegistry()
+        eng.metrics = EngineMetrics(reg)
+        for i, p in enumerate(prompts):
+            eng.submit(Request(f"r{i}", p, max_new_tokens=nt))
+        mark = _dt.COSTS.issued_totals()
+        t0 = time.perf_counter()
+        done = eng.run()
+        dt = time.perf_counter() - t0
+        d_flops = _dt.COSTS.issued_totals()["flops"] - mark["flops"]
+        return {"outs": {r.rid: r.output for r in done},
+                "new_tokens": sum(len(r.output) for r in done),
+                "tok_s": sum(len(r.output) for r in done) / dt,
+                "mfu": _dt.COSTS.mfu_over(d_flops, dt),
+                "pad_tokens": int(eng.pad_tokens),
+                "ragged_tokens": int(eng.ragged_tokens),
+                "device_steps": int(eng.device_steps),
+                "pad_total": reg.snapshot()["pt_pad_tokens"]["value"]}
+
+    def run_mode(ragged):
+        # cold pass (short generations, same admission mix) pays and
+        # COUNTS the mode's compiles; the timed pass runs warm
+        c0 = _ct.REGISTRY.totals()["compiles"]
+        run_once(ragged, min(new_tok, 2))
+        compiles = _ct.REGISTRY.totals()["compiles"] - c0
+        res = run_once(ragged, new_tok)
+        res["compiles"] = compiles
+        return res
+
+    bucketed = run_mode(False)
+    ragged = run_mode(True)
+    return {
+        "workload": "ragged-vs-bucketed (shared-prefix)",
+        "outputs_match": bucketed["outs"] == ragged["outs"],
+        "requests": nreq, "new_tokens": ragged["new_tokens"],
+        "batch": max_seqs,
+        "decode_tokens_per_sec": round(ragged["tok_s"], 1),
+        "step_time_s": round(1.0 / max(ragged["tok_s"], 1e-9), 5),
+        "bucketed_decode_tokens_per_sec": round(bucketed["tok_s"], 1),
+        "tok_s_delta": round(
+            ragged["tok_s"] / max(bucketed["tok_s"], 1e-9) - 1.0, 4),
+        "compiles": ragged["compiles"],
+        "bucketed_compiles": bucketed["compiles"],
+        "pad_tokens": ragged["pad_tokens"],
+        "bucketed_pad_tokens": bucketed["pad_tokens"],
+        "pt_pad_tokens_total": ragged["pad_total"],
+        "ragged_tokens": ragged["ragged_tokens"],
+        "device_steps": ragged["device_steps"],
+        "bucketed_device_steps": bucketed["device_steps"],
+        "pt_mfu": round(ragged["mfu"], 6),
+        "bucketed_pt_mfu": round(bucketed["mfu"], 6),
+        "loss": 0.0,
+    }
 
 
 def _bench_serving_pipeline(on_tpu, params, cfg, dtype):

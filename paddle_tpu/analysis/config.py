@@ -105,6 +105,11 @@ class LintConfig:
                 "ServingEngine.step_launch", "ServingEngine.step_finish",
                 "ServingEngine.run_pipelined",
                 "ServingEngine._note_launch_gap",
+                # unified ragged step: flat descriptor builder + its
+                # finish twin are the default per-wave hot loop
+                "ServingEngine._ragged_launch",
+                "ServingEngine._ragged_finish",
+                "ServingEngine._bucket_for",
                 # scheduler pump + publish run once per engine step
                 "RequestScheduler._pump", "RequestScheduler._publish",
                 "RequestScheduler._feed_locked",

@@ -1,0 +1,14 @@
+"""TPU pallas kernels that are engine-shaped rather than op-shaped.
+
+`paddle_tpu.ops` holds kernels with framework-level contracts (flash
+attention, paged decode/verify attention); this package holds kernels
+written against the serving engine's own data layout — currently the
+ragged paged-attention core behind `unified_step` (docs/serving.md
+§ Unified ragged step). CPU sessions import only the pure-jnp
+reference path; the pallas lowering is reached on TPU or under
+interpret mode in tests.
+"""
+from .ragged_paged_attention import (ragged_paged_attention,
+                                     ragged_paged_attention_reference)
+
+__all__ = ["ragged_paged_attention", "ragged_paged_attention_reference"]

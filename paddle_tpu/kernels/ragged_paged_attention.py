@@ -1,0 +1,297 @@
+"""Ragged paged attention: one kernel for an arbitrary prefill/decode mix.
+
+The serving engine's `unified_step` feeds a FLAT token buffer — every
+row is one token of some sequence, described by `(tok_slot, tok_pos)`
+instead of a (batch, seq) grid — so a single device program serves any
+mix of prefill chunks, prefix-cache suffix tails, spec-verify grids and
+single-token decodes ("Ragged Paged Attention", PAPERS.md; the
+split-fuse / fixed-token-budget direction). Row i attends over slot
+`tok_slot[i]`'s paged KV through the page table, causally limited to
+columns `< tok_pos[i] + 1` (its own position included — the row's K/V
+was scattered into the pages beforehand). Inactive buffer slack rows
+carry `tok_pos = -1`: every page is skipped for them, which is the
+attention early-exit that makes the fixed buffer cheap.
+
+Two implementations with ONE arithmetic contract, asserted BIT-identical
+on CPU in tests. Bit-exactness across two separately-compiled XLA
+programs does not come for free — three things make it hold:
+
+  * both run the SAME traced op sequence: `_page_update` below is the
+    single online-softmax page step, called from the pallas kernel body
+    and from the reference's page scan;
+  * the reference replays the kernel's exact operand SHAPES (q group
+    padded to the sublane minimum, m/l stats lane-replicated to
+    (group_pad, LANES) with `_fit_lanes` slicing) — XLA CPU picks
+    different vectorizations for different shapes and e.g. `exp` then
+    rounds differently;
+  * `lax.optimization_barrier` pins the contraction-sensitive spots
+    (the dots, the exps, each mul feeding an add) so neither compiled
+    loop body can FMA/fuse them into differently-rounded forms. The
+    barrier has no vmap batching rule, so the reference fans out over
+    (token, head) with `lax.map` rather than vmap.
+
+GQA: q is viewed (tokens, kv_heads, group, head_dim). int8 pools ride
+per-token fp32 scales dequantized inside `_page_update`.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..ops.flash_attention import _fit_lanes
+from ..ops.paged_attention import LANES, MIN_GROUP, NEG_INF, Z, _on_tpu
+
+__all__ = ["ragged_paged_attention", "ragged_paged_attention_reference"]
+
+_bar = jax.lax.optimization_barrier
+
+
+def _page_update(q, k, v, acc, m_prev, l_prev, limit, pi, scale,
+                 page_size, ks=None, vs=None):
+    """One online-softmax step over one KV page — THE arithmetic
+    contract shared by the pallas kernel and the jnp reference.
+
+    q/acc: (group_pad, d) f32; m_prev/l_prev: (group_pad, LANES) f32;
+    k/v: (page_size, d) f32; ks/vs: (page_size, 1) dequant scales when
+    the pool is int8; limit/pi: i32 scalars. Returns the updated
+    (acc, m, l). The optimization barriers keep XLA from contracting
+    the muls into the adds (or re-fusing the dots/exps) differently in
+    the two compiled programs — without them the kernel and reference
+    drift by 1 ULP on CPU.
+    """
+    if ks is not None:
+        k = k * ks
+        v = v * vs
+    s = _bar(jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)) * scale
+    cols = pi * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(cols < limit, s, NEG_INF)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = _bar(jnp.exp(s - _fit_lanes(m_new, s.shape[-1])))
+    alpha = _bar(jnp.exp(m_prev - m_new))
+    al, sp = _bar((alpha * l_prev, jnp.sum(p, axis=1, keepdims=True)))
+    l_new = al + sp
+    aa, pv = _bar((acc * _fit_lanes(alpha, acc.shape[-1]),
+                   jax.lax.dot_general(
+                       p, v, (((1,), (0,)), ((), ())),
+                       preferred_element_type=jnp.float32)))
+    return aa + pv, m_new, l_new
+
+
+# ---------------------------------------------------------------------------
+# Reference (pure jnp, CPU production path)
+# ---------------------------------------------------------------------------
+def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
+                                     tok_slot, tok_pos, sm_scale=None,
+                                     k_scale=None, v_scale=None):
+    """q: (T, QH, D); pages: (KVH, P, page, D); page_table:
+    (S, pages_per_seq); tok_slot/tok_pos: (T,) i32 (pos -1 = inactive
+    row → zeros out). Returns (T, QH, D).
+
+    This is NOT a dense-softmax shortcut: it replays `_page_update`
+    over page ordinals with the kernel's exact shapes (group padded,
+    lane-replicated stats), skipped pages carrying the previous stats
+    through unchanged, so CPU tests can assert the pallas kernel
+    bit-identical against it."""
+    t, qh, d = q.shape
+    kvh, _, page_size, _ = k_pages.shape
+    group = qh // kvh
+    gp = group + (-group) % MIN_GROUP
+    scale = np.float32(sm_scale if sm_scale is not None else d ** -0.5)
+    n_pages = page_table.shape[1]
+    quant = k_scale is not None
+
+    pages = page_table[tok_slot].astype(jnp.int32)       # (T, n_pages)
+    limit = (tok_pos + 1).astype(jnp.int32)              # (T,)
+    qg = q.reshape(t, kvh, group, d).astype(jnp.float32)
+    qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - group), (0, 0)))
+
+    def token_head(args):
+        qg_th, pages_t, limit_t, hi = args
+        k_h = k_pages[hi]
+        v_h = v_pages[hi]
+        sc = (k_scale[hi], v_scale[hi]) if quant else None
+
+        def body(carry, xs):
+            acc, m, l = carry
+            pg, pi = xs
+            k = k_h[pg].astype(jnp.float32)              # (page, d)
+            v = v_h[pg].astype(jnp.float32)
+            acc_new, m_new, l_new = _page_update(
+                qg_th, k, v, acc, m, l, limit_t, pi, scale, page_size,
+                *( (sc[0][pg], sc[1][pg]) if quant else () ))
+            # page skip: the kernel's @pl.when leaves the scratch
+            # UNTOUCHED on a masked page — carry the old bits through
+            take = pi * page_size < limit_t
+            return (jnp.where(take, acc_new, acc),
+                    jnp.where(take, m_new, m),
+                    jnp.where(take, l_new, l)), None
+
+        init = (jnp.zeros((gp, d), jnp.float32),
+                jnp.full((gp, LANES), NEG_INF, jnp.float32),
+                jnp.zeros((gp, LANES), jnp.float32))
+        (acc, m, l), _ = jax.lax.scan(
+            body, init, (pages_t, jnp.arange(n_pages, dtype=jnp.int32)))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        return acc / _fit_lanes(l_safe, acc.shape[-1])
+
+    ti_idx = jnp.repeat(jnp.arange(t), kvh)
+    hi_idx = jnp.tile(jnp.arange(kvh), t)
+    o = jax.lax.map(token_head, (qg.reshape(t * kvh, gp, d),
+                                 pages[ti_idx], limit[ti_idx], hi_idx))
+    o = o.reshape(t, kvh, gp, d)[:, :, :group]
+    return o.reshape(t, qh, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+def _ragged_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
+                   o_ref, acc_ref, m_ref, l_ref, *, scale, page_size,
+                   n_pages, ks_ref=None, vs_ref=None):
+    """Grid (T, KVH, pages_per_seq); tok_slot/tok_pos/page_table ride
+    scalar prefetch — the page BlockSpec index map resolves
+    `ptab[slot[ti], pi]` so each step DMAs exactly the one page this
+    row needs. ks_ref/vs_ref: per-token fp32 scale blocks when the
+    pool is int8 — dequantized inside `_page_update` so int8 is what
+    rides HBM→VMEM."""
+    del slot_ref, ptab_ref  # consumed by the index maps
+    ti = pl.program_id(0)
+    pi = pl.program_id(2)
+
+    @pl.when(pi == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    limit = pos_ref[ti] + 1  # -1 (inactive row) → 0: every page skips
+
+    @pl.when(pi * page_size < limit)
+    def _body():
+        sc = () if ks_ref is None else (ks_ref[0, 0], vs_ref[0, 0])
+        acc_new, m_new, l_new = _page_update(
+            q_ref[0, 0].astype(jnp.float32),
+            k_ref[0, 0].astype(jnp.float32),
+            v_ref[0, 0].astype(jnp.float32),
+            acc_ref[:], m_ref[:], l_ref[:], limit, pi, scale,
+            page_size, *sc)
+        acc_ref[:] = acc_new
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    @pl.when(pi == n_pages - 1)
+    def _fin():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] /
+                       _fit_lanes(l_safe, o_ref.shape[-1])).astype(o_ref.dtype)
+
+
+def _ragged_quant_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
+                         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
+                         **kw):
+    """Positional adapter: pallas passes the two scale inputs between
+    v and the output ref."""
+    _ragged_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
+                   o_ref, acc_ref, m_ref, l_ref, ks_ref=ks_ref,
+                   vs_ref=vs_ref, **kw)
+
+
+def _ragged_pallas(q4, k_pages, v_pages, page_table, tok_slot, tok_pos,
+                   scale, interpret, k_scale=None, v_scale=None):
+    t, kvh, group_pad, d = q4.shape
+    _, _, page_size, _ = k_pages.shape
+    n_pages = page_table.shape[1]
+    quant = k_scale is not None
+
+    # index maps receive grid indices first, then scalar-prefetch refs
+    page_spec = pl.BlockSpec((1, 1, page_size, d),
+                             lambda ti, hi, pi, slot, pos, ptab:
+                             (hi, ptab[slot[ti], pi], Z, Z))
+    in_specs = [
+        pl.BlockSpec((1, 1, group_pad, d),
+                     lambda ti, hi, pi, slot, pos, ptab: (ti, hi, Z, Z)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [tok_slot, tok_pos, page_table, q4, k_pages, v_pages]
+    if quant:
+        scale_spec = pl.BlockSpec((1, 1, page_size, 1),
+                                  lambda ti, hi, pi, slot, pos, ptab:
+                                  (hi, ptab[slot[ti], pi], Z, Z))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(t, kvh, n_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, group_pad, d),
+                               lambda ti, hi, pi, slot, pos, ptab:
+                               (ti, hi, Z, Z)),
+        scratch_shapes=[
+            pltpu.VMEM((group_pad, d), jnp.float32),
+            pltpu.VMEM((group_pad, LANES), jnp.float32),
+            pltpu.VMEM((group_pad, LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _ragged_quant_kernel if quant else _ragged_kernel,
+        scale=np.float32(scale), page_size=page_size, n_pages=n_pages)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, kvh, group_pad, d), q4.dtype),
+        interpret=interpret,
+    )(*operands)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, page_table, tok_slot,
+                           tok_pos, sm_scale=None, use_pallas=None,
+                           interpret=None, k_scale=None, v_scale=None):
+    """Ragged mixed prefill/decode attention over a paged KV cache.
+
+    q: (T, QH, D) — T flat token rows; k_pages/v_pages:
+    (KVH, num_pages, page_size, D); page_table: (S, pages_per_seq)
+    i32; tok_slot: (T,) i32 owning slot per row; tok_pos: (T,) i32
+    absolute position per row (-1 = inactive slack row → zero output).
+    Row i attends to slot tok_slot[i]'s cache columns < tok_pos[i]+1.
+
+    int8 cache: pass int8 pages plus k_scale/v_scale fp32 per-token
+    scales (KVH, num_pages, page_size, 1), dequantized inside the
+    kernel. Off-TPU (and not under interpret) the jnp reference runs —
+    same arithmetic, bit-identical.
+    """
+    t, qh, d = q.shape
+    kvh = k_pages.shape[0]
+    group = qh // kvh
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    if interpret is None:
+        interpret = False
+    if not use_pallas and not interpret:
+        return ragged_paged_attention_reference(
+            q, k_pages, v_pages, page_table, tok_slot, tok_pos, scale,
+            k_scale, v_scale)
+    q4 = q.reshape(t, kvh, group, d)
+    # q-rows block dim must be a multiple of the sublane tile (8)
+    pad = (-group) % MIN_GROUP
+    if pad:
+        q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    o = _ragged_pallas(q4, k_pages, v_pages,
+                       page_table.astype(jnp.int32),
+                       tok_slot.astype(jnp.int32),
+                       tok_pos.astype(jnp.int32), scale, interpret,
+                       k_scale=k_scale, v_scale=v_scale)
+    if pad:
+        o = o[:, :, :group]
+    return o.reshape(t, qh, d)

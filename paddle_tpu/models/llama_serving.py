@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 import time
 
 import numpy as np
@@ -28,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from .._core.compat import shard_map
 
+from ..kernels.ragged_paged_attention import ragged_paged_attention
+from ..observability import compile_telemetry as _compile
 from ..observability import flight_recorder as _flight
 from ..observability.compile_telemetry import track_jit
 from ..profiler import record_span
@@ -171,6 +174,32 @@ def _sample_grid(logits, lengths, sample):
     tok, lp = _filter_draw(lg, rep(sample["temp"]), rep(sample["top_k"]),
                            rep(sample["top_p"]), rep(sample["key"]), pos)
     return tok.reshape(B, G), lp.reshape(B, G)
+
+
+def _sample_flat(logits, tok_slot, tok_pos, row_on, sample):
+    """Flat-row twin of `_sample_record`/`_sample_grid` for the unified
+    ragged step: logits (T, V), one draw per buffer row. Per-slot
+    sampling params gather through `tok_slot`; the PRNG fold is
+    `tok_pos + 1` — exactly the (seed, position) key BOTH bucketed
+    paths use (decode folds on pre-advanced lengths = fed-token
+    position + 1; the verify grid folds on lengths + g + 1), so the
+    ragged engine draws the identical token stream for identical
+    logits, across sync and pipelined pumps. Spec engines evaluate
+    stop conditions on host (their sample pytree carries no
+    eos/remaining) — their rows return done=False. Returns
+    (next_token (T,) i32, done (T,) bool, logprob (T,) f32)."""
+
+    def g(a):
+        return a[tok_slot]
+    tok, lp = _filter_draw(logits.astype(jnp.float32), g(sample["temp"]),
+                           g(sample["top_k"]), g(sample["top_p"]),
+                           g(sample["key"]), tok_pos + 1)
+    if "remaining" in sample:
+        done = row_on & ((g(sample["remaining"]) <= 1) |
+                         ((g(sample["eos"]) >= 0) & (tok == g(sample["eos"]))))
+    else:
+        done = jnp.zeros_like(row_on)
+    return tok, done, lp
 
 
 def _attn_tp(fn, mesh, quant):
@@ -466,6 +495,95 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
     return k_pool, v_pool, k_scale, v_scale, logits, rec
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("config", "page_size", "use_pallas",
+                                    "interpret"))
+def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
+                 tok_pos, config: LlamaConfig, page_size,
+                 use_pallas=False, interpret=False, k_scale=None,
+                 v_scale=None, sample=None, carry_tok=None,
+                 carry_gather=None, carry_mask=None):
+    """ONE device program for an arbitrary prefill/decode mix (ROADMAP
+    item 1; "Ragged Paged Attention" + the MPK fewer-bigger-programs
+    direction): a FLAT token buffer replaces the (batch, seq) grids of
+    `prefill`/`prefill_varlen`/`decode_step`/`verify_step`, so prefill
+    chunks, prefix-cache suffix tails, spec-verify grids and
+    single-token decodes ride the same trace — the mix changing
+    between steps can never retrace, because every shape here is fixed
+    by the engine's static buffer size.
+
+    tokens: (T,) flat token ids; tok_slot: (T,) i32 owning slot;
+    tok_pos: (T,) i32 ABSOLUTE cache position per row, -1 for
+    inactive slack rows (their K/V lands on the trash page and the
+    ragged attention kernel early-exits every page for them).
+    page_table: (B, pages_per_seq) i32 snapshot. Rows must be causally
+    ordered per slot within the buffer only in the sense that their
+    positions are distinct — every row's K/V is scattered before
+    attention, and row i reads columns < tok_pos[i]+1 (exactly
+    verify_step's chunk contract, generalized).
+
+    `sample` (traced pytree, `_sample_flat`) keeps the PR 8 device-side
+    sampling contract: per-slot params gathered per row, PRNG fold =
+    tok_pos + 1. `carry_tok`/`carry_gather`/`carry_mask` feed a row the
+    PREVIOUS unified step's device-resident record
+    (`carry_tok[carry_gather[i]]`), so the pipelined pump launches wave
+    N+1 before the host has read wave N. Attention runs the pallas
+    ragged paged kernel on TPU and its bit-identical jnp reference on
+    CPU (paddle_tpu/kernels/ragged_paged_attention.py).
+
+    Returns (k_pool, v_pool, k_scale, v_scale, logits (T, V)[, rec]).
+    """
+    c = config
+    nh, nkv = c.num_attention_heads, c.num_key_value_heads
+    hd = c.hidden_size // nh
+    t = tokens.shape[0]
+    Pn = k_pool.shape[2]
+    quant = k_scale is not None
+    if carry_tok is not None:
+        tokens = jnp.where(carry_mask, carry_tok[carry_gather], tokens)
+    row_on = tok_pos >= 0
+    pos = jnp.maximum(tok_pos, 0)
+    cos, sin = rope_cos_sin(None, hd, base=c.rope_theta,
+                            position_ids=pos)            # (T, hd)
+    h = jnp.take(params["embed"], tokens, axis=0)        # (T, H)
+
+    page_ids = page_table[tok_slot, pos // page_size]
+    page_ids = jnp.where(row_on, page_ids, Pn - 1)       # trash page
+    off = pos % page_size
+
+    def layer(carry, xs):
+        h, kp, vp, ksp, vsp = carry
+        lp, li = xs
+        x = _rms(h, lp["ln1"], c.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(t, nh, hd)
+        k = (x @ lp["wk"]).reshape(t, nkv, hd)
+        v = (x @ lp["wv"]).reshape(t, nkv, hd)
+        q, k = apply_rotary_emb(q, k, cos[:, None], sin[:, None])
+        kt = k.swapaxes(0, 1)                            # (KVH, T, D)
+        vt = v.swapaxes(0, 1)
+        kp, vp, ksp, vsp, kl, vl, ksl, vsl = _scatter_kv(
+            kp, vp, ksp, vsp, li, page_ids, off, kt, vt, quant)
+        o = ragged_paged_attention(q, kl, vl, page_table, tok_slot,
+                                   tok_pos, use_pallas=use_pallas,
+                                   interpret=interpret,
+                                   k_scale=ksl, v_scale=vsl)  # (T, QH, D)
+        h = h + o.reshape(t, -1).astype(h.dtype) @ lp["wo"]
+        x = _rms(h, lp["ln2"], c.rms_norm_eps)
+        mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        return (h + mlp, kp, vp, ksp, vsp), None
+
+    L = k_pool.shape[0]
+    (h, k_pool, v_pool, k_scale, v_scale), _ = jax.lax.scan(
+        layer, (h, k_pool, v_pool, k_scale, v_scale),
+        (params["layers"], jnp.arange(L)))
+    h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    logits = h @ params["lm_head"]                       # (T, V)
+    if sample is None:
+        return k_pool, v_pool, k_scale, v_scale, logits
+    rec = _sample_flat(logits, tok_slot, tok_pos, row_on, sample)
+    return k_pool, v_pool, k_scale, v_scale, logits, rec
+
+
 # compile telemetry: each entry point reports compiles/retraces (new
 # arg-shape signature == a fresh XLA compile) to the observability
 # registry — `pt_compile_*` on /metrics, compile events in the flight
@@ -474,6 +592,7 @@ prefill = track_jit("serving.prefill")(prefill)
 prefill_varlen = track_jit("serving.prefill_varlen")(prefill_varlen)
 decode_step = track_jit("serving.decode_step")(decode_step)
 verify_step = track_jit("serving.verify_step")(verify_step)
+unified_step = track_jit("serving.unified_step")(unified_step)
 
 
 def speculative_sample(prob_rows, drafts, rng):
@@ -565,6 +684,29 @@ class StepTicket:
         self.next_tok = next_tok    # device (B,) i32
         self.done = done            # device (B,) bool
         self.logprob = logprob      # device (B,) f32
+
+
+class RaggedTicket:
+    """One launched-but-unconsumed `unified_step` wave. Same contract
+    as StepTicket (zombie checks, carry, eos length rollback) with the
+    record FLAT: `flat` maps a decode slot to its buffer row, `seeds`
+    lists (slot, req) whose prefill completed this wave — their
+    first-token logits rows ride `seed_rows` and are picked HOST-side
+    at finish (the PR 8 seeding convention)."""
+
+    __slots__ = ("reqs", "flat", "next_tok", "done", "logprob",
+                 "seeds", "seed_rows", "slots")
+
+    def __init__(self, reqs, flat, next_tok, done, logprob, seeds,
+                 seed_rows, slots):
+        self.reqs = reqs            # slot -> Request (decode rows only)
+        self.flat = flat            # slot -> flat buffer row index
+        self.next_tok = next_tok    # device (T,) i32
+        self.done = done            # device (T,) bool
+        self.logprob = logprob      # device (T,) f32
+        self.seeds = seeds          # [(slot, req)] completed prefills
+        self.seed_rows = seed_rows  # device (len(seeds), V) or None
+        self.slots = slots          # slots with any row this wave
 
 
 class Request:
@@ -699,7 +841,8 @@ class ServingEngine:
                  cache_dtype=None, preempt_policy="offload",
                  spec_decode=0, spec_ngram=2, chunked_prefill=False,
                  spec_sample=False, mesh=None, prefix_cache=False,
-                 host_tier_bytes=0, tier_quantize=True, faults=None):
+                 host_tier_bytes=0, tier_quantize=True, faults=None,
+                 ragged=None, ragged_tokens=None):
         c = config
         # mesh with a 'tp' axis: tensor-parallel serving — weights get
         # megatron NamedShardings (llama_spmd.param_specs), the KV pool
@@ -785,6 +928,42 @@ class ServingEngine:
         self.spec_drafted = 0    # draft tokens fed to verify
         self.spec_accepted = 0   # draft tokens accepted
         self.device_steps = 0    # decode/verify device calls
+        # unified ragged step (docs/serving.md § Unified ragged step):
+        # every device dispatch — admission prefills, prefix-cache
+        # suffix tails, spec-verify grids, single-token decodes — rides
+        # ONE jitted `unified_step` over a flat token buffer, so the
+        # prefill/decode mix changing between steps can never retrace
+        # and no token row is bucket padding. Default ON; the bucketed
+        # entry points remain as the PT_SERVE_RAGGED=0 fallback for one
+        # release. Tensor-parallel engines stay bucketed (the ragged
+        # pallas kernel has no shard_map wrapper yet).
+        if ragged is None:
+            ragged = os.environ.get("PT_SERVE_RAGGED", "1") \
+                not in ("", "0") and self._mesh is None
+        self.ragged = bool(ragged)
+        if self.ragged and self._mesh is not None:
+            raise ValueError(
+                "ragged=True does not run under tensor parallelism yet "
+                "— build the engine with ragged=False (or "
+                "PT_SERVE_RAGGED=0) to keep the bucketed entry points")
+        G_ = max(self.spec_decode, 1)
+        if ragged_tokens is None:
+            ragged_tokens = 1 << math.ceil(
+                math.log2(max(max_seqs * G_, 16)))
+        self.ragged_buf = int(ragged_tokens)
+        if self.ragged and self.ragged_buf < max_seqs * G_:
+            raise ValueError(
+                f"ragged_tokens={self.ragged_buf} cannot hold one "
+                f"row per slot ({max_seqs} slots x chunk width {G_}) — "
+                "a full wave would not fit the flat buffer")
+        # padding-waste telemetry (pt_pad_tokens_total /
+        # pt_ragged_tokens_total via EngineMetrics.on_step): pad counts
+        # power-of-two bucket padding rows dispatched by the bucketed
+        # prefill sites (`_bucket_for`); ragged counts REAL rows served
+        # through `unified_step` — buffer slack rows are skipped
+        # capacity (the kernel's early exit), not dispatched padding
+        self.pad_tokens = 0
+        self.ragged_tokens = 0
         # optional telemetry sink (paddle_tpu.serving.metrics
         # EngineMetrics duck type): the step loop reports TTFT/TPOT,
         # occupancy, page stats, and preemptions into it. None = free.
@@ -1065,6 +1244,20 @@ class ServingEngine:
             return list(req.prompt) + [int(t) for t in req.output[:-1]]
         return list(req.prompt)
 
+    def _bucket_for(self, n):
+        """The power-of-two padding bucket for an n-token bucketed
+        dispatch — ONE definition for the monolithic prefill, the
+        packed varlen prefill and the suffix-prefill chunk (they used
+        to recompute it independently). Reports the choice to compile
+        telemetry (`set_context(bucket=...)` rides the NEXT tracked
+        call's flight "compile" record, so a retrace storm names the
+        bucket that caused it) and counts the `b - n` padding rows into
+        `pt_pad_tokens_total` — the waste the ragged step eliminates."""
+        b = max(self.page_size, 1 << math.ceil(math.log2(max(n, 1))))
+        self.pad_tokens += b - n
+        _compile.set_context(bucket=b)
+        return b
+
     def _admit(self):
         """Admit all waiting requests that fit — ONE varlen prefill call
         for the whole ragged batch (no per-sequence dense fallback)."""
@@ -1077,8 +1270,8 @@ class ServingEngine:
         # immediate preemption victim (full prefill wasted). Plain
         # decode grows one page exactly at a boundary; a spec verify
         # chunk can need pages for up to G new positions at once.
-        if self.spec_decode > 1:
-            G = self.spec_decode
+        if self.spec_decode > 1 or self.ragged:
+            G = max(self.spec_decode, 1)
             def _reserve(s):
                 r = self._slots[s]
                 if self._prefilling(r):
@@ -1149,7 +1342,7 @@ class ServingEngine:
             req._kv_match = None
             if getattr(req, "_offload", None) is not None:
                 self._restore_into(slot, req)
-            elif self.chunked_prefill:
+            elif self.chunked_prefill or self.ragged:
                 req._pf_feed = self._feed_ids(req)
                 req._pf_cursor = 0
                 # seed the first token iff it was never seeded: a
@@ -1184,7 +1377,7 @@ class ServingEngine:
         lens = [len(f) for f in feeds]
         total = sum(lens)
         self.prefill_tokens += total
-        bucket = max(self.page_size, 1 << math.ceil(math.log2(max(total, 1))))
+        bucket = self._bucket_for(total)
         ids = np.zeros((bucket,), np.int64)
         cu = np.zeros((self.max_seqs + 1,), np.int32)
         off = 0
@@ -1292,8 +1485,7 @@ class ServingEngine:
         feed = self._feed_ids(req)
         S = len(feed)
         self.prefill_tokens += S
-        bucket = max(self.page_size,
-                     1 << math.ceil(math.log2(max(S, 1))))
+        bucket = self._bucket_for(S)
         ids = np.zeros((1, bucket), np.int64)
         ids[0, :S] = feed
         with record_span("serving.prefill"):
@@ -1487,6 +1679,8 @@ class ServingEngine:
         step and `step_finish` rolls its length back. Raises
         PipelineStall instead of preempting while carrying — the
         victim's pending token is still in flight."""
+        if self.ragged:
+            return self._ragged_launch(carry=carry, _admitted=_admitted)
         if not _admitted:
             self._sweep_cancelled()
             self._admit()
@@ -1595,6 +1789,8 @@ class ServingEngine:
         so its entry there is zombied and its length rolled back —
         release/indexing then see exactly the synchronous loop's
         state."""
+        if self.ragged:
+            return self._ragged_finish(ticket, inflight=inflight)
         self._fire("step_finish",
                    rids=[str(r.rid) for r in ticket.reqs.values()
                          if r is not None])
@@ -1611,6 +1807,222 @@ class ServingEngine:
                 req.logprobs.append(float(lp[s]))
             self._note_emit(req, 1)
             if bool(done[s]):
+                self.finished.append(req)
+                self._note_finish(req)
+                if inflight is not None and inflight.reqs.get(s) is req:
+                    inflight.reqs[s] = None
+                    self.lengths[s] -= 1
+                self._release(s)
+        self._note_step(len(ticket.slots))
+        return len(ticket.slots)
+
+    def _ragged_launch(self, carry=None, _admitted=False):
+        """Ragged twin of `step_launch`: ONE `unified_step` dispatch
+        serving every live slot — single-token decode rows AND
+        chunked-prefill feeds — as rows of a flat (slot, pos, token)
+        descriptor buffer. No padding buckets: the buffer holds exactly
+        the tokens fed (unused tail rows carry pos=-1 and the kernel
+        skips them), so the mix changing between waves never changes
+        the trace signature. State (lengths, prefill cursors) advances
+        AT LAUNCH so a pipelined launch N+1 plans against consistent
+        state; `step_finish`-side rollback (eos zombie) is identical to
+        the bucketed path. A slot whose prefill completed in the
+        in-flight wave is unseeded (next_token None) and sits out one
+        wave — its first token is picked host-side at finish, the PR 8
+        seeding convention, so outputs stay token-identical."""
+        if not _admitted:
+            self._sweep_cancelled()
+            self._admit()
+        # decode-boundary page growth, bucketed logic verbatim (mid-
+        # prefill slots grow against their own chunk below)
+        for s in sorted(self._live):
+            if self._prefilling(self._slots[s]):
+                continue
+            cur = int(self.lengths[s])
+            if cur % self.page_size == 0 and cur > 0 and \
+                    len(self._seq_pages[s]) * self.page_size <= cur:
+                while not self.pool.can_alloc(1):
+                    if carry is not None:
+                        raise PipelineStall(
+                            "page growth needs a preemption victim "
+                            "with a step in flight")
+                    if not self._preempt_one(exclude=s):
+                        raise RuntimeError(
+                            "serving: KV page pool exhausted with a "
+                            "single active sequence — num_pages is too "
+                            "small for max_seq_len")
+                self._alloc_pages(s, 1)
+        if not self._live:
+            self._t_launch_end = None
+            return None
+        # plan decode rows (no state mutation yet — preemption during
+        # the feed-growth pass below may still evict a planned slot)
+        decode_plan = []
+        for s in sorted(self._live):
+            req = self._slots[s]
+            if self._prefilling(req):
+                continue
+            if req.next_token is None:
+                continue  # seeding rides the in-flight wave's finish
+            carried = carry is not None and carry.reqs.get(s) is req
+            left = req.max_new_tokens - len(req.output) \
+                - (1 if carried else 0)
+            if left <= 0:
+                continue  # the in-flight step emits its last token
+            decode_plan.append((s, req, carried, left))
+        # plan prefill feeds into the remaining buffer rows, growing
+        # pages for every real chunk position now
+        room = self.ragged_buf - len(decode_plan)
+        prefill_plan = []
+        for s in sorted(self._live):
+            req = self._slots[s]
+            if not self._prefilling(req):
+                continue
+            n = min(len(req._pf_feed) - req._pf_cursor, room)
+            if n <= 0:
+                continue  # buffer full this wave; slot feeds next wave
+            need = -(-(int(self.lengths[s]) + n) // self.page_size)
+            while len(self._seq_pages[s]) < need:
+                while not self.pool.can_alloc(1):
+                    if carry is not None:
+                        raise PipelineStall(
+                            "prefill growth needs a preemption victim "
+                            "with a step in flight")
+                    if not self._preempt_one(exclude=s):
+                        raise RuntimeError(
+                            "serving: KV page pool exhausted with a "
+                            "single active sequence — num_pages is too "
+                            "small for max_seq_len")
+                self._alloc_pages(s, 1)
+            prefill_plan.append((s, req, n))
+            room -= n
+        # a preemption above may have evicted a planned slot
+        decode_plan = [p for p in decode_plan if self._slots[p[0]] is p[1]]
+        prefill_plan = [p for p in prefill_plan
+                        if self._slots[p[0]] is p[1]]
+        if not decode_plan and not prefill_plan:
+            return None  # every occupied slot is finishing/seeding
+        T = self.ragged_buf
+        B = self.max_seqs
+        tokens = np.zeros((T,), np.int32)
+        tok_slot = np.zeros((T,), np.int32)
+        tok_pos = np.full((T,), -1, np.int32)
+        carry_mask = np.zeros((T,), bool)
+        carry_gather = np.zeros((T,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        top_ps = np.ones((B,), np.float32)
+        keys = np.zeros((B, 2), np.uint32)
+        eos = np.full((B,), -1, np.int32)
+        remaining = np.ones((B,), np.int32)
+        flat, reqs = {}, {}
+        row = 0
+        for s, req, carried, left in decode_plan:
+            tok_slot[row] = s
+            tok_pos[row] = int(self.lengths[s])
+            if carried:
+                carry_mask[row] = True
+                carry_gather[row] = carry.flat[s]
+            else:
+                tokens[row] = req.next_token
+            temps[s] = req.temperature
+            top_ks[s] = req.top_k
+            top_ps[s] = req.top_p
+            if req._base_key is not None:
+                keys[s] = req._base_key
+            if req.eos_id is not None:
+                eos[s] = int(req.eos_id)
+            remaining[s] = left
+            self.lengths[s] += 1
+            flat[s] = row
+            reqs[s] = req
+            row += 1
+        seeds, seed_flat = [], []
+        for s, req, n in prefill_plan:
+            feed, cur = req._pf_feed, req._pf_cursor
+            base = int(self.lengths[s])
+            tokens[row:row + n] = feed[cur:cur + n]
+            tok_slot[row:row + n] = s
+            tok_pos[row:row + n] = base + np.arange(n, dtype=np.int32)
+            req._pf_cursor += n
+            self.lengths[s] += n
+            self.prefill_tokens += n
+            if req._pf_cursor >= len(feed):
+                # feed complete: index the slot's full pages NOW (the
+                # bucketed prefill paths index right after dispatch),
+                # so a live decoding slot's prefix is shareable by the
+                # very next admission
+                self._index_slot(s, req)
+                if req._pf_sample:
+                    # last chunk: its final row's logits seed the first
+                    # generated token host-side at finish
+                    seeds.append((s, req))
+                    seed_flat.append(row + n - 1)
+            row += n
+        self.ragged_tokens += row
+        sample = {"temp": jnp.asarray(temps),
+                  "top_k": jnp.asarray(top_ks),
+                  "top_p": jnp.asarray(top_ps),
+                  "key": jnp.asarray(keys),
+                  "eos": jnp.asarray(eos),
+                  "remaining": jnp.asarray(remaining)}
+        c_tok = carry.next_tok if carry is not None \
+            else jnp.zeros((T,), jnp.int32)
+        self._fire("step_launch",
+                   rids=[str(p[1].rid) for p in decode_plan] +
+                        [str(p[1].rid) for p in prefill_plan])
+        self._note_launch_gap(1 if carry is not None else 0)
+        with record_span("serving.unified_step"):
+            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+             logits, rec) = unified_step(
+                self.params, self.k_pool, self.v_pool,
+                jnp.asarray(self.page_table.copy()),
+                jnp.asarray(tokens), jnp.asarray(tok_slot),
+                jnp.asarray(tok_pos), self.config, self.page_size,
+                use_pallas=self._use_pallas, interpret=self._interpret,
+                k_scale=self.k_scale, v_scale=self.v_scale,
+                sample=sample, carry_tok=c_tok,
+                carry_gather=jnp.asarray(carry_gather),
+                carry_mask=jnp.asarray(carry_mask))
+        seed_rows = logits[jnp.asarray(seed_flat, jnp.int32)] \
+            if seeds else None
+        self._t_launch_end = time.perf_counter()
+        self.device_steps += 1
+        return RaggedTicket(reqs, flat, rec[0], rec[1], rec[2], seeds,
+                            seed_rows,
+                            sorted([p[0] for p in decode_plan] +
+                                   [p[0] for p in prefill_plan]))
+
+    def _ragged_finish(self, ticket, inflight=None):
+        """Ragged twin of `step_finish`: ONE batched transfer (decode
+        records + completed-prefill logits rows), then host bookkeeping.
+        Seeds land first — the bucketed path seeds at admission, before
+        any decode consume — then decode rows in slot order with the
+        identical zombie / eos-rollback contract."""
+        self._fire("step_finish",
+                   rids=[str(r.rid) for r in ticket.reqs.values()
+                         if r is not None] +
+                        [str(r.rid) for _, r in ticket.seeds])
+        nxt, done, lp, seed_rows = self._fetch_results(
+            (ticket.next_tok, ticket.done, ticket.logprob,
+             ticket.seed_rows))
+        if seed_rows is not None:
+            for (s, req), rowv in zip(ticket.seeds, seed_rows):
+                if self._slots[s] is not req:
+                    continue  # zombie: slot released/reused since launch
+                self._seed_first_token(s, req, rowv)
+        for s in sorted(ticket.flat):
+            req = ticket.reqs.get(s)
+            if req is None or self._slots[s] is not req:
+                continue  # zombie: slot released/reused since launch
+            i = ticket.flat[s]
+            tok = int(nxt[i])
+            req.output.append(tok)
+            req.next_token = tok
+            if req.want_logprobs:
+                req.logprobs.append(float(lp[i]))
+            self._note_emit(req, 1)
+            if bool(done[i]):
                 self.finished.append(req)
                 self._note_finish(req)
                 if inflight is not None and inflight.reqs.get(s) is req:
@@ -1696,24 +2108,6 @@ class ServingEngine:
                   "top_k": jnp.asarray(top_ks),
                   "top_p": jnp.asarray(top_ps),
                   "key": jnp.asarray(keys)}
-        # same fault point as step_launch: one hit per device step,
-        # whichever dispatch the engine mode uses
-        self._fire("step_launch",
-                   rids=[str(self._slots[s].rid) for s in active_slots])
-        self._note_launch_gap(0)
-        with record_span("serving.verify_step"):
-            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-             logits, (grid_dev, lp_dev)) = verify_step(
-                self.params, self.k_pool, self.v_pool,
-                jnp.asarray(self.page_table.copy()),
-                jnp.asarray(self.lengths.copy()),
-                jnp.asarray(tokens), jnp.asarray(n_tok),
-                jnp.asarray(active), self.config, self.page_size,
-                use_pallas=self._use_pallas, interpret=self._interpret,
-                k_scale=self.k_scale, v_scale=self.v_scale,
-                mesh=self._mesh, sample=sample)
-        self._t_launch_end = time.perf_counter()
-        self.device_steps += 1
         # one rows dict for the SAMPLING requests only: rejection
         # sampling (speculative_sample) needs the full filtered
         # distribution, so those rows still come to host. Greedy slots
@@ -1732,21 +2126,109 @@ class ServingEngine:
                       and self._slots[s]._pf_cursor + int(n_tok[s])
                       >= len(self._slots[s]._pf_feed)
                       and self._slots[s]._pf_sample]
-        self._fire("step_finish",
+        # same fault point as step_launch: one hit per device step,
+        # whichever dispatch the engine mode uses
+        self._fire("step_launch",
                    rids=[str(self._slots[s].rid) for s in active_slots])
-        grid, lp_grid, row_vals, seed_vals = self._fetch_results(
-            (grid_dev, lp_dev,                            # (B, G) each
-             logits[jnp.asarray(need_rows, jnp.int32)]
-             if need_rows else None,
-             logits[jnp.asarray(seed_slots, jnp.int32),
-                    jnp.asarray([int(n_tok[s]) - 1 for s in seed_slots],
-                                jnp.int32)]
-             if seed_slots else None))
-        rows_by_slot = {} if row_vals is None else \
-            {s: row_vals[i][:int(n_tok[s])]
-             for i, s in enumerate(need_rows)}
-        seed_rows = {} if seed_vals is None else \
-            dict(zip(seed_slots, seed_vals))
+        self._note_launch_gap(0)
+        if self.ragged:
+            # ragged dispatch: each slot's verify chunk occupies
+            # n_tok[s] consecutive rows of the flat buffer; row
+            # base[s]+g is sampled with fold lengths+g+1 — the bucketed
+            # grid's exact (seed, position) key — so the shared
+            # acceptance loop below sees token-identical grids
+            base = {}
+            row = 0
+            for s in active_slots:
+                base[s] = row
+                row += int(n_tok[s])
+            T = self.ragged_buf
+            ftok = np.zeros((T,), np.int32)
+            fslot = np.zeros((T,), np.int32)
+            fpos = np.full((T,), -1, np.int32)
+            for s in active_slots:
+                n = int(n_tok[s])
+                b = base[s]
+                ftok[b:b + n] = tokens[s, :n]
+                fslot[b:b + n] = s
+                fpos[b:b + n] = int(self.lengths[s]) + \
+                    np.arange(n, dtype=np.int32)
+            self.ragged_tokens += row
+            with record_span("serving.unified_step"):
+                (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                 logits, rec) = unified_step(
+                    self.params, self.k_pool, self.v_pool,
+                    jnp.asarray(self.page_table.copy()),
+                    jnp.asarray(ftok), jnp.asarray(fslot),
+                    jnp.asarray(fpos), self.config, self.page_size,
+                    use_pallas=self._use_pallas,
+                    interpret=self._interpret, k_scale=self.k_scale,
+                    v_scale=self.v_scale, sample=sample,
+                    carry_tok=jnp.zeros((T,), jnp.int32),
+                    carry_gather=jnp.zeros((T,), jnp.int32),
+                    carry_mask=jnp.zeros((T,), bool))
+            self._t_launch_end = time.perf_counter()
+            self.device_steps += 1
+            self._fire("step_finish",
+                       rids=[str(self._slots[s].rid)
+                             for s in active_slots])
+            need_idx = np.concatenate(
+                [np.arange(base[s], base[s] + int(n_tok[s]),
+                           dtype=np.int32) for s in need_rows]) \
+                if need_rows else None
+            seed_idx = [base[s] + int(n_tok[s]) - 1 for s in seed_slots]
+            tok_f, lp_f, row_f, seed_vals = self._fetch_results(
+                (rec[0], rec[2],                          # (T,) each
+                 logits[jnp.asarray(need_idx)]
+                 if need_rows else None,
+                 logits[jnp.asarray(seed_idx, jnp.int32)]
+                 if seed_slots else None))
+            grid = np.zeros((self.max_seqs, G), np.int64)
+            lp_grid = np.zeros((self.max_seqs, G), np.float32)
+            for s in active_slots:
+                n = int(n_tok[s])
+                grid[s, :n] = tok_f[base[s]:base[s] + n]
+                lp_grid[s, :n] = lp_f[base[s]:base[s] + n]
+            rows_by_slot = {}
+            if row_f is not None:
+                off = 0
+                for s in need_rows:
+                    n = int(n_tok[s])
+                    rows_by_slot[s] = row_f[off:off + n]
+                    off += n
+            seed_rows = {} if seed_vals is None else \
+                dict(zip(seed_slots, seed_vals))
+        else:
+            with record_span("serving.verify_step"):
+                (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                 logits, (grid_dev, lp_dev)) = verify_step(
+                    self.params, self.k_pool, self.v_pool,
+                    jnp.asarray(self.page_table.copy()),
+                    jnp.asarray(self.lengths.copy()),
+                    jnp.asarray(tokens), jnp.asarray(n_tok),
+                    jnp.asarray(active), self.config, self.page_size,
+                    use_pallas=self._use_pallas,
+                    interpret=self._interpret,
+                    k_scale=self.k_scale, v_scale=self.v_scale,
+                    mesh=self._mesh, sample=sample)
+            self._t_launch_end = time.perf_counter()
+            self.device_steps += 1
+            self._fire("step_finish",
+                       rids=[str(self._slots[s].rid)
+                             for s in active_slots])
+            grid, lp_grid, row_vals, seed_vals = self._fetch_results(
+                (grid_dev, lp_dev,                        # (B, G) each
+                 logits[jnp.asarray(need_rows, jnp.int32)]
+                 if need_rows else None,
+                 logits[jnp.asarray(seed_slots, jnp.int32),
+                        jnp.asarray([int(n_tok[s]) - 1
+                                     for s in seed_slots], jnp.int32)]
+                 if seed_slots else None))
+            rows_by_slot = {} if row_vals is None else \
+                {s: row_vals[i][:int(n_tok[s])]
+                 for i, s in enumerate(need_rows)}
+            seed_rows = {} if seed_vals is None else \
+                dict(zip(seed_slots, seed_vals))
         for s in active_slots:
             req = self._slots[s]
             n = int(n_tok[s])
@@ -1997,7 +2479,7 @@ class ServingEngine:
         # bucketed chunk width: one compile per bucket, not one per
         # distinct suffix length (same reasoning as the packed
         # prefill scatter above)
-        G = max(self.page_size, 1 << math.ceil(math.log2(max(n, 1))))
+        G = self._bucket_for(n)
         tokens = np.zeros((self.max_seqs, G), np.int64)
         tokens[slot, :n] = suffix
         n_tok = np.zeros((self.max_seqs,), np.int32)

@@ -32,7 +32,8 @@ import threading
 import time
 
 __all__ = ["CompileRegistry", "REGISTRY", "tracked", "track_jit",
-           "signature_of", "snapshot", "render_prometheus", "reset"]
+           "signature_of", "set_context", "snapshot",
+           "render_prometheus", "reset"]
 
 DEFAULT_WARN_AFTER = int(os.environ.get("PADDLE_TPU_RETRACE_WARN", "8"))
 
@@ -86,10 +87,20 @@ class CompileRegistry:
     def __init__(self, warn_after=DEFAULT_WARN_AFTER, warn_hook=None):
         self._lock = threading.Lock()
         self._fns = {}
+        self._context = None
         self.warn_after = warn_after
         # warn_hook(name, stats_dict) — default: structured log event +
         # flight-recorder entry (set at call time so tests can swap it)
         self.warn_hook = warn_hook
+
+    def set_context(self, **tags):
+        """One-shot annotation consumed by the NEXT reported call: when
+        that call turns out to be a compile, the tags ride its flight
+        "compile" record. The bucketed serving entry points tag the
+        power-of-two bucket they chose (`bucket=...`) so a retrace
+        storm names the bucket that caused it."""
+        with self._lock:
+            self._context = tags or None
 
     # -- reporting -----------------------------------------------------
     def note_call(self, name, signature, elapsed_s=None):
@@ -101,6 +112,7 @@ class CompileRegistry:
                 st = self._fns[name] = _FnStats(name)
             st.calls += 1
             st.last_signature = signature
+            context, self._context = self._context, None
             compiled = signature not in st.signatures
             st.signatures[signature] = st.signatures.get(signature, 0) + 1
             if compiled:
@@ -119,7 +131,8 @@ class CompileRegistry:
         _fr.record("compile", fn=name, retrace=retrace,
                    n_compiles=snap["compiles"],
                    elapsed_s=elapsed_s,
-                   signature=list(signature)[:8])
+                   signature=list(signature)[:8],
+                   **(context or {}))
         if warn:
             self._warn(name, snap)
         return True
@@ -236,6 +249,12 @@ def tracked(name=None, registry=None):
 
 # jit entry points read better as: prefill = track_jit("serving.prefill")(prefill)
 track_jit = tracked
+
+
+def set_context(**tags):
+    """Tag the global registry's next reported call (see
+    CompileRegistry.set_context)."""
+    REGISTRY.set_context(**tags)
 
 
 def snapshot():

@@ -280,6 +280,19 @@ class EngineMetrics:
             "Allocatable KV pages (excludes the trash page).")
         self.prefill_tokens = r.gauge(
             "pt_serving_prefill_tokens", "Cumulative prefilled tokens.")
+        # ragged vs bucketed dispatch accounting (ISSUE 11): how many
+        # token rows were pure bucket padding vs real tokens served by
+        # the unified ragged step — the padding waste the ragged entry
+        # point exists to eliminate. Mirrored from engine ints via
+        # on_step deltas (single-writer: the pump thread).
+        self.pad_tokens = r.counter(
+            "pt_pad_tokens",
+            "Token rows dispatched as power-of-two bucket padding by "
+            "the bucketed entry points (0 in ragged mode).")
+        self.ragged_tokens = r.counter(
+            "pt_ragged_tokens",
+            "Real token rows served through the unified ragged step.")
+        self._tok_seen = {"pad_tokens": 0, "ragged_tokens": 0}
         self.steps = r.counter(
             "pt_serving_device_steps", "Decode/verify device calls.")
         self.tokens = r.counter(
@@ -389,6 +402,14 @@ class EngineMetrics:
         self.pages_free.set(len(engine._free))
         self.pages_total.set(engine.num_pages - 1)
         self.prefill_tokens.set(engine.prefill_tokens)
+        seen = self._tok_seen
+        for attr, counter in (("pad_tokens", self.pad_tokens),
+                              ("ragged_tokens", self.ragged_tokens)):
+            cur = getattr(engine, attr, 0)
+            delta = cur - seen[attr]
+            if delta > 0:
+                counter.inc(delta)
+                seen[attr] = cur
         pc = getattr(engine, "prefix_cache", None)
         if pc is not None:
             self.prefix_cached_pages.set(pc.cached_pages)

@@ -651,7 +651,11 @@ class RequestScheduler:
         then consume step N — the host bookkeeping overlaps the device
         executing N+1. Page-growth preemption raises PipelineStall
         inside the launch (the victim's pending token is still on
-        device): drain, then relaunch against host-current state."""
+        device): drain, then relaunch against host-current state.
+        Tickets are opaque here: a ragged engine hands back
+        RaggedTickets (every wave is ONE `unified_step` dispatch,
+        prefill and decode mixed), a bucketed one StepTickets — the
+        pump logic is identical for both."""
         from ..models.llama_serving import PipelineStall
         eng = self._engine
         try:

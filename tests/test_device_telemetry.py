@@ -376,9 +376,13 @@ class TestServingDeviceTelemetry:
             assert _metric_value(text, "pt_device_live_bytes") > 0
             assert _metric_value(text, "pt_device_live_peak_bytes") > 0
             assert _metric_value(text, "pt_train_nonfinite_total") >= 0
-            # per-entry-point cost rows for the engine's jit fns
-            assert 'pt_fn_flops{fn="serving.decode_step"}' in text
-            assert 'pt_fn_hbm_bytes{fn="serving.decode_step"}' in text
+            # per-entry-point cost rows for the engine's jit fns —
+            # ragged engines (the default) run everything through
+            # unified_step, bucketed ones through decode_step
+            fn = "serving.unified_step" if eng.ragged \
+                else "serving.decode_step"
+            assert f'pt_fn_flops{{fn="{fn}"}}' in text
+            assert f'pt_fn_hbm_bytes{{fn="{fn}"}}' in text
             # JSON snapshot carries both halves
             conn.request("GET", "/metrics?format=json")
             snap = json.loads(conn.getresponse().read())
@@ -388,7 +392,7 @@ class TestServingDeviceTelemetry:
             assert snap["pt_device"]["memory"]["live_bytes"] > 0
             assert "nonfinite_steps" in snap["pt_health"]
             fns = snap["pt_device"]["cost"]["functions"]
-            assert fns["serving.decode_step"]["flops"] > 0
+            assert fns[fn]["flops"] > 0
             conn.close()
 
 

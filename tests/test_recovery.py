@@ -448,7 +448,10 @@ class TestOtherFaultPoints:
         """A crash inside the prefix-cache suffix prefill (mid-
         admission: pages mapped, slot not yet attached) must release
         everything and recover."""
-        eng = _engine(params,
+        # bucketed machinery under test: the ragged engine admits via
+        # the chunked feed and never enters the suffix-prefill entry
+        # point (its fault drill lives in test_ragged_step.py)
+        eng = _engine(params, ragged=False,
                       faults=FaultPlan("suffix_prefill:raise@2"))
         sched = RequestScheduler(eng, max_queue=8,
                                  metrics=MetricsRegistry())

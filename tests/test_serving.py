@@ -115,8 +115,11 @@ class TestServing:
 
         S.prefill_varlen, S.prefill = spy_v, spy_s
         try:
+            # bucketed-machinery test: the varlen prefill entry point
+            # only runs with the ragged step off
             eng = ServingEngine(params, CFG, max_seqs=4, max_seq_len=64,
-                                page_size=8, use_pallas=False)
+                                page_size=8, use_pallas=False,
+                                ragged=False)
             for i, p in enumerate(prompts):
                 eng.submit(Request(f"r{i}", p, max_new_tokens=4))
             done = eng.run()
@@ -497,8 +500,10 @@ class TestSpeculativeDecoding:
         the same logits trajectory and pool state as 3 decode_steps."""
         from paddle_tpu.models.llama_serving import (decode_step,
                                                      verify_step)
+        # bucketed-machinery test: drives verify_step/decode_step
+        # directly and needs _admit's seed-at-admission behavior
         eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
-                            page_size=8, use_pallas=False)
+                            page_size=8, use_pallas=False, ragged=False)
         eng.submit(Request("a", [1, 5, 9, 3], max_new_tokens=8))
         eng._admit()
         chunk = [int(eng._slots[0].next_token), 7, 2]

@@ -198,12 +198,16 @@ class TestDeviceSampler:
         from paddle_tpu.observability.compile_telemetry import REGISTRY
         eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
                             page_size=8, use_pallas=False)
+        # same contract for both step entry points: ragged engines
+        # dispatch serving.unified_step, bucketed ones decode_step
+        fn = "serving.unified_step" if eng.ragged \
+            else "serving.decode_step"
         eng.submit(Request("a", [1, 2, 3], max_new_tokens=4,
                            temperature=0.7, top_k=5, seed=1))
         eng.run()
         snap = REGISTRY.snapshot()
         fns = snap.get("functions", snap)
-        before = fns["serving.decode_step"]["compiles"]
+        before = fns[fn]["compiles"]
         for i, kw in enumerate((
                 {"temperature": 1.3, "top_k": 50, "top_p": 0.5,
                  "seed": 9},
@@ -215,7 +219,7 @@ class TestDeviceSampler:
             eng.run()
         snap = REGISTRY.snapshot()
         fns = snap.get("functions", snap)
-        assert fns["serving.decode_step"]["compiles"] == before
+        assert fns[fn]["compiles"] == before
 
     def test_greedy_record_matches_legacy_logits(self, params):
         """decode_step's record must agree with its own logits output:

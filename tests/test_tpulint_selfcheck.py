@@ -99,6 +99,22 @@ def test_pump_loop_single_sanctioned_device_get():
     assert readers["ServingEngine._fetch_results"] == 1
 
 
+def test_ragged_step_functions_in_hot_set():
+    """ISSUE 11: the unified ragged step's builder/finish pair (and the
+    shared `_bucket_for` bucket helper) are the per-wave hot loop now —
+    they must sit in the default TPL001 hot set, and the single
+    sanctioned sync must still be the engine's batched reader (the
+    ragged paths fetch THROUGH it, never beside it)."""
+    from paddle_tpu.analysis.config import LintConfig
+
+    cfg = LintConfig.default()
+    for fn in ("ServingEngine._ragged_launch",
+               "ServingEngine._ragged_finish",
+               "ServingEngine._bucket_for"):
+        assert fn in cfg.hot_functions, fn
+    assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
+
+
 def test_sanctioned_sync_config_check(tmp_path):
     """The TPL001 config check: a raw jax.device_get anywhere in a hot
     serving module — even outside the configured hot functions — is a
