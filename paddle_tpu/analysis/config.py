@@ -110,6 +110,11 @@ class LintConfig:
                 "ServingEngine._ragged_launch",
                 "ServingEngine._ragged_finish",
                 "ServingEngine._bucket_for",
+                # lean epilogue (ISSUE 12): the spec rejection
+                # sampler's lazy distribution-row pull runs inside the
+                # acceptance loop — sync discipline applies (its one
+                # read rides _fetch_results)
+                "ServingEngine._spec_row_dist",
                 # scheduler pump + publish run once per engine step
                 "RequestScheduler._pump", "RequestScheduler._publish",
                 "RequestScheduler._feed_locked",

@@ -32,6 +32,16 @@ programs does not come for free — three things make it hold:
 
 GQA: q is viewed (tokens, kv_heads, group, head_dim). int8 pools ride
 per-token fp32 scales dequantized inside `_page_update`.
+
+Tile shape is a STATIC parameter (`block_q` q-rows per block x
+`block_pages` KV pages per grid step, both sublane-legal), defaulting
+to the seed shape (GQA group padded to the sublane minimum x 1 page).
+Every legal config runs the identical `_page_update` call sequence over
+the same page ordinals with the same operand shapes, so the jnp
+reference stays the bit-identity oracle for all of them — what changes
+is only how the pallas grid batches DMA and compute. The per-TPU-
+generation winner is found offline by tools/tune_ragged.py and loaded
+through paddle_tpu/_tuning_defaults.load_ragged_tile.
 """
 from __future__ import annotations
 
@@ -89,7 +99,8 @@ def _page_update(q, k, v, acc, m_prev, l_prev, limit, pi, scale,
 # ---------------------------------------------------------------------------
 def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
                                      tok_slot, tok_pos, sm_scale=None,
-                                     k_scale=None, v_scale=None):
+                                     k_scale=None, v_scale=None,
+                                     block_q=None):
     """q: (T, QH, D); pages: (KVH, P, page, D); page_table:
     (S, pages_per_seq); tok_slot/tok_pos: (T,) i32 (pos -1 = inactive
     row → zeros out). Returns (T, QH, D).
@@ -98,11 +109,15 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
     over page ordinals with the kernel's exact shapes (group padded,
     lane-replicated stats), skipped pages carrying the previous stats
     through unchanged, so CPU tests can assert the pallas kernel
-    bit-identical against it."""
+    bit-identical against it. `block_q` is the kernel's q-row block
+    (the q group's sublane padding) — the reference must replay the
+    same padded shape to stay the bit-identity oracle for a non-default
+    tile. `block_pages` has no reference twin: it only re-batches the
+    grid, the `_page_update` ordinal sequence is unchanged."""
     t, qh, d = q.shape
     kvh, _, page_size, _ = k_pages.shape
     group = qh // kvh
-    gp = group + (-group) % MIN_GROUP
+    gp = _resolve_block_q(block_q, group)
     scale = np.float32(sm_scale if sm_scale is not None else d ** -0.5)
     n_pages = page_table.shape[1]
     quant = k_scale is not None
@@ -152,18 +167,41 @@ def ragged_paged_attention_reference(q, k_pages, v_pages, page_table,
 # ---------------------------------------------------------------------------
 # Pallas kernel
 # ---------------------------------------------------------------------------
-def _ragged_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
-                   o_ref, acc_ref, m_ref, l_ref, *, scale, page_size,
-                   n_pages, ks_ref=None, vs_ref=None):
-    """Grid (T, KVH, pages_per_seq); tok_slot/tok_pos/page_table ride
-    scalar prefetch — the page BlockSpec index map resolves
-    `ptab[slot[ti], pi]` so each step DMAs exactly the one page this
-    row needs. ks_ref/vs_ref: per-token fp32 scale blocks when the
-    pool is int8 — dequantized inside `_page_update` so int8 is what
-    rides HBM→VMEM."""
+def _resolve_block_q(block_q, group):
+    """Validated q-row block: None/0 derive the seed shape (group
+    padded to the sublane minimum); an explicit value must cover the
+    group and stay sublane-aligned or the block is not DMA-legal."""
+    gp_min = group + (-group) % MIN_GROUP
+    if not block_q:
+        return gp_min
+    block_q = int(block_q)
+    if block_q % MIN_GROUP or block_q < group:
+        raise ValueError(
+            f"block_q={block_q}: must be a multiple of the sublane "
+            f"tile ({MIN_GROUP}) and >= the GQA group ({group})")
+    return block_q
+
+
+def _ragged_kernel(slot_ref, pos_ref, ptab_ref, *refs, scale, page_size,
+                   n_pages, block_pages, quant):
+    """Grid (T, KVH, ceil(pages_per_seq / block_pages));
+    tok_slot/tok_pos/page_table ride scalar prefetch — each of the
+    `block_pages` per-step page operands has its own BlockSpec index
+    map resolving `ptab[slot[ti], pi*block_pages + j]`, so one grid
+    step DMAs a strip of `block_pages` pages and the unrolled body
+    consumes them in ordinal order (the exact `_page_update` sequence
+    of the one-page kernel — bit-identity is tile-invariant). Scale
+    refs ride interleaved per page when the pool is int8, dequantized
+    inside `_page_update` so int8 is what rides HBM→VMEM."""
     del slot_ref, ptab_ref  # consumed by the index maps
+    per = 4 if quant else 2
+    q_ref = refs[0]
+    page_refs = refs[1:1 + per * block_pages]
+    o_ref = refs[1 + per * block_pages]
+    acc_ref, m_ref, l_ref = refs[2 + per * block_pages:]
     ti = pl.program_id(0)
     pi = pl.program_id(2)
+    grid_pages = -(-n_pages // block_pages)
 
     @pl.when(pi == 0)
     def _init():
@@ -173,20 +211,32 @@ def _ragged_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
 
     limit = pos_ref[ti] + 1  # -1 (inactive row) → 0: every page skips
 
-    @pl.when(pi * page_size < limit)
-    def _body():
-        sc = () if ks_ref is None else (ks_ref[0, 0], vs_ref[0, 0])
-        acc_new, m_new, l_new = _page_update(
-            q_ref[0, 0].astype(jnp.float32),
-            k_ref[0, 0].astype(jnp.float32),
-            v_ref[0, 0].astype(jnp.float32),
-            acc_ref[:], m_ref[:], l_ref[:], limit, pi, scale,
-            page_size, *sc)
-        acc_ref[:] = acc_new
-        m_ref[:] = m_new
-        l_ref[:] = l_new
+    for j in range(block_pages):
+        # ordinal*page_size < limit also masks the clamped
+        # past-the-end ordinals of the last grid step: limit <=
+        # n_pages*page_size always, so ordinal >= n_pages fails it —
+        # the same predicate the reference's `take` carry uses.
+        ordinal = pi * block_pages + j
+        k_ref = page_refs[per * j]
+        v_ref = page_refs[per * j + 1]
+        sc_refs = page_refs[per * j + 2:per * j + 4] if quant else None
 
-    @pl.when(pi == n_pages - 1)
+        @pl.when(ordinal * page_size < limit)
+        def _body(k_ref=k_ref, v_ref=v_ref, sc_refs=sc_refs,
+                  ordinal=ordinal):
+            sc = () if sc_refs is None else (sc_refs[0][0, 0],
+                                             sc_refs[1][0, 0])
+            acc_new, m_new, l_new = _page_update(
+                q_ref[0, 0].astype(jnp.float32),
+                k_ref[0, 0].astype(jnp.float32),
+                v_ref[0, 0].astype(jnp.float32),
+                acc_ref[:], m_ref[:], l_ref[:], limit, ordinal, scale,
+                page_size, *sc)
+            acc_ref[:] = acc_new
+            m_ref[:] = m_new
+            l_ref[:] = l_new
+
+    @pl.when(pi == grid_pages - 1)
     def _fin():
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -194,43 +244,40 @@ def _ragged_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
                        _fit_lanes(l_safe, o_ref.shape[-1])).astype(o_ref.dtype)
 
 
-def _ragged_quant_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
-                         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref,
-                         **kw):
-    """Positional adapter: pallas passes the two scale inputs between
-    v and the output ref."""
-    _ragged_kernel(slot_ref, pos_ref, ptab_ref, q_ref, k_ref, v_ref,
-                   o_ref, acc_ref, m_ref, l_ref, ks_ref=ks_ref,
-                   vs_ref=vs_ref, **kw)
-
-
 def _ragged_pallas(q4, k_pages, v_pages, page_table, tok_slot, tok_pos,
-                   scale, interpret, k_scale=None, v_scale=None):
+                   scale, interpret, k_scale=None, v_scale=None,
+                   block_pages=1):
     t, kvh, group_pad, d = q4.shape
     _, _, page_size, _ = k_pages.shape
     n_pages = page_table.shape[1]
     quant = k_scale is not None
+    grid_pages = -(-n_pages // block_pages)
 
-    # index maps receive grid indices first, then scalar-prefetch refs
-    page_spec = pl.BlockSpec((1, 1, page_size, d),
-                             lambda ti, hi, pi, slot, pos, ptab:
-                             (hi, ptab[slot[ti], pi], Z, Z))
+    # index maps receive grid indices first, then scalar-prefetch refs.
+    # Per-j maps pick page ordinal pi*block_pages + j, clamped on the
+    # ragged last strip (the kernel body masks those ordinals out).
+    def _page_map(j):
+        def m(ti, hi, pi, slot, pos, ptab):
+            o = jnp.minimum(pi * block_pages + j, n_pages - 1)
+            return (hi, ptab[slot[ti], o], Z, Z)
+        return m
+
     in_specs = [
         pl.BlockSpec((1, 1, group_pad, d),
                      lambda ti, hi, pi, slot, pos, ptab: (ti, hi, Z, Z)),
-        page_spec,
-        page_spec,
     ]
-    operands = [tok_slot, tok_pos, page_table, q4, k_pages, v_pages]
-    if quant:
-        scale_spec = pl.BlockSpec((1, 1, page_size, 1),
-                                  lambda ti, hi, pi, slot, pos, ptab:
-                                  (hi, ptab[slot[ti], pi], Z, Z))
-        in_specs += [scale_spec, scale_spec]
-        operands += [k_scale, v_scale]
+    operands = [tok_slot, tok_pos, page_table, q4]
+    for j in range(block_pages):
+        page_spec = pl.BlockSpec((1, 1, page_size, d), _page_map(j))
+        in_specs += [page_spec, page_spec]
+        operands += [k_pages, v_pages]
+        if quant:
+            scale_spec = pl.BlockSpec((1, 1, page_size, 1), _page_map(j))
+            in_specs += [scale_spec, scale_spec]
+            operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(t, kvh, n_pages),
+        grid=(t, kvh, grid_pages),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group_pad, d),
                                lambda ti, hi, pi, slot, pos, ptab:
@@ -242,8 +289,8 @@ def _ragged_pallas(q4, k_pages, v_pages, page_table, tok_slot, tok_pos,
         ],
     )
     kernel = functools.partial(
-        _ragged_quant_kernel if quant else _ragged_kernel,
-        scale=np.float32(scale), page_size=page_size, n_pages=n_pages)
+        _ragged_kernel, scale=np.float32(scale), page_size=page_size,
+        n_pages=n_pages, block_pages=block_pages, quant=quant)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -254,7 +301,8 @@ def _ragged_pallas(q4, k_pages, v_pages, page_table, tok_slot, tok_pos,
 
 def ragged_paged_attention(q, k_pages, v_pages, page_table, tok_slot,
                            tok_pos, sm_scale=None, use_pallas=None,
-                           interpret=None, k_scale=None, v_scale=None):
+                           interpret=None, k_scale=None, v_scale=None,
+                           block_q=None, block_pages=None):
     """Ragged mixed prefill/decode attention over a paged KV cache.
 
     q: (T, QH, D) — T flat token rows; k_pages/v_pages:
@@ -267,6 +315,14 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, tok_slot,
     scales (KVH, num_pages, page_size, 1), dequantized inside the
     kernel. Off-TPU (and not under interpret) the jnp reference runs —
     same arithmetic, bit-identical.
+
+    `block_q`/`block_pages` pick the STATIC kernel tile (q rows per
+    block x KV pages per grid step); None/0 keep the seed defaults
+    (sublane-padded group x 1). Any legal tile computes the same
+    `_page_update` sequence — outputs stay bit-identical to the
+    reference at the matching `block_q` — so the choice is purely a
+    DMA/occupancy trade tuned per TPU generation (tools/tune_ragged.py,
+    docs/tuning.md § Kernel autotune).
     """
     t, qh, d = q.shape
     kvh = k_pages.shape[0]
@@ -274,6 +330,12 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, tok_slot,
     scale = sm_scale if sm_scale is not None else d ** -0.5
     if (k_scale is None) != (v_scale is None):
         raise ValueError("k_scale and v_scale must be given together")
+    gp = _resolve_block_q(block_q, group)
+    n_pages = page_table.shape[1]
+    bp = int(block_pages or 1)
+    if bp < 1:
+        raise ValueError(f"block_pages={block_pages}: want >= 1")
+    bp = min(bp, n_pages)
     if use_pallas is None:
         use_pallas = _on_tpu()
     if interpret is None:
@@ -281,17 +343,16 @@ def ragged_paged_attention(q, k_pages, v_pages, page_table, tok_slot,
     if not use_pallas and not interpret:
         return ragged_paged_attention_reference(
             q, k_pages, v_pages, page_table, tok_slot, tok_pos, scale,
-            k_scale, v_scale)
+            k_scale, v_scale, block_q=gp)
     q4 = q.reshape(t, kvh, group, d)
-    # q-rows block dim must be a multiple of the sublane tile (8)
-    pad = (-group) % MIN_GROUP
+    pad = gp - group
     if pad:
         q4 = jnp.pad(q4, ((0, 0), (0, 0), (0, pad), (0, 0)))
     o = _ragged_pallas(q4, k_pages, v_pages,
                        page_table.astype(jnp.int32),
                        tok_slot.astype(jnp.int32),
                        tok_pos.astype(jnp.int32), scale, interpret,
-                       k_scale=k_scale, v_scale=v_scale)
+                       k_scale=k_scale, v_scale=v_scale, block_pages=bp)
     if pad:
         o = o[:, :, :group]
     return o.reshape(t, qh, d)

@@ -29,8 +29,10 @@ import jax
 import jax.numpy as jnp
 from .._core.compat import shard_map
 
+from .. import _tuning_defaults as _tuning
 from ..kernels.ragged_paged_attention import ragged_paged_attention
 from ..observability import compile_telemetry as _compile
+from ..observability.device_telemetry import device_generation
 from ..observability import flight_recorder as _flight
 from ..observability.compile_telemetry import track_jit
 from ..profiler import record_span
@@ -48,6 +50,33 @@ from ..ops.varlen_attention import (flash_attention_varlen,
                                     seg_ids_from_cu_seqlens)
 from .generation import filtered_probs_np
 from .llama import LlamaConfig
+
+_compile_cache_wired = False
+
+
+def _wire_compile_cache():
+    """Enable jax's persistent compilation cache once per process when
+    PT_COMPILE_CACHE=<dir> is set (docs/reliability.md § restart
+    runbook): a warm restart or rolling drain replays its compiles from
+    disk instead of re-lowering every serving trace. Thresholds are
+    zeroed so even small serving programs persist. Best-effort — an
+    old jax or a read-only dir must never block engine construction."""
+    global _compile_cache_wired
+    if _compile_cache_wired:
+        return
+    _compile_cache_wired = True
+    cache_dir = os.environ.get("PT_COMPILE_CACHE", "")
+    if not cache_dir:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          -1)
+    except Exception:
+        return
+    _compile.REGISTRY.note_persistent_cache(cache_dir)
 
 
 def _rms(x, w, eps):
@@ -131,12 +160,30 @@ def _filter_draw(lg, temp, top_k, top_p, key, fold):
     filter-then-renormalize order): with Z = cumulative prob mass of
     the top-k set, `cum - prob <= p * Z` over UNfiltered probs is
     exactly `cum_f - prob_f <= p` over the filtered ones."""
-    V = lg.shape[-1]
     greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
     sampled_on = temp > 0.0
+    lt = _filtered_logits(lg, temp, top_k, top_p)
+    step_key = jax.vmap(jax.random.fold_in)(key, fold)
+    drawn = jax.vmap(jax.random.categorical)(step_key, lt) \
+        .astype(jnp.int32)
+    tok = jnp.where(sampled_on, drawn, greedy)
+    lp = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
+                             tok[:, None], axis=-1)[:, 0]
+    return tok, lp
+
+
+def _filtered_logits(lg, temp, top_k, top_p):
+    """The temperature/top_k/top_p filter HALF of `_filter_draw`:
+    lg (N, V) f32 → filtered temperature-scaled logits (kept tokens
+    untouched, dropped ones -1e30). ONE definition shared by the
+    device draw and the spec-decode candidate-probability path, so the
+    distribution a rejection sampler accepts against is exactly the
+    distribution the device sampler draws from."""
+    V = lg.shape[-1]
+    sampled_on = temp > 0.0
     # greedy rows run the sampler arithmetic too (masked out by the
-    # final where): a per-row branch would be value-dependent control
-    # flow. Guard the divide so temp=0 rows cannot overflow to inf.
+    # caller's final where): a per-row branch would be value-dependent
+    # control flow. Guard the divide so temp=0 rows cannot overflow.
     lt = lg / jnp.where(sampled_on, jnp.maximum(temp, 1e-6), 1.0)[:, None]
     k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)
     sv = -jnp.sort(-lt, axis=-1)                     # descending values
@@ -147,14 +194,18 @@ def _filter_draw(lg, temp, top_k, top_p, key, fold):
         (cum - probs <= top_p[:, None] * z)
     nkeep = jnp.maximum(keep.sum(-1), 1)             # crossing token stays
     thresh = jnp.take_along_axis(sv, (nkeep - 1)[:, None], axis=-1)
-    lt = jnp.where(lt < thresh, -1e30, lt)
-    step_key = jax.vmap(jax.random.fold_in)(key, fold)
-    drawn = jax.vmap(jax.random.categorical)(step_key, lt) \
-        .astype(jnp.int32)
-    tok = jnp.where(sampled_on, drawn, greedy)
-    lp = jnp.take_along_axis(jax.nn.log_softmax(lg, axis=-1),
-                             tok[:, None], axis=-1)[:, 0]
-    return tok, lp
+    return jnp.where(lt < thresh, -1e30, lt)
+
+
+@jax.jit
+def _spec_dist_rows(lg, temp, top_k, top_p):
+    """Filtered sampling DISTRIBUTION rows for the spec-decode
+    rejection sampler: lg (N, V) f32 raw logits → (N, V) f32 softmax
+    over `_filtered_logits`. Fixed caller shapes (one row at a time on
+    the lazy rejection path) keep this at one compile."""
+    return jax.nn.softmax(
+        _filtered_logits(lg.astype(jnp.float32), temp, top_k, top_p),
+        axis=-1)
 
 
 def _sample_grid(logits, lengths, sample):
@@ -200,6 +251,22 @@ def _sample_flat(logits, tok_slot, tok_pos, row_on, sample):
     else:
         done = jnp.zeros_like(row_on)
     return tok, done, lp
+
+
+def _cand_probs(logits, tok_slot, sample, cand):
+    """Per-row filtered-distribution probability of a CANDIDATE token
+    (the spec-decode draft that follows the row): logits (R, V), cand
+    (R,) i32 → (R,) f32. Shares `_filtered_logits` with the device
+    draw, so the probability the rejection sampler accepts a draft
+    with is computed under exactly the distribution the device would
+    sample from — and the host fetches R floats instead of R vocab
+    rows (XLA CSEs the filter against `_sample_flat`'s)."""
+    def g(a):
+        return a[tok_slot]
+    lt = _filtered_logits(logits.astype(jnp.float32), g(sample["temp"]),
+                          g(sample["top_k"]), g(sample["top_p"]))
+    dist = jax.nn.softmax(lt, axis=-1)
+    return jnp.take_along_axis(dist, cand[:, None], axis=-1)[:, 0]
 
 
 def _attn_tp(fn, mesh, quant):
@@ -399,7 +466,8 @@ def decode_step(params, k_pool, v_pool, page_table, lengths, tokens,
 def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
                 n_tok, active, config: LlamaConfig, page_size,
                 use_pallas=False, interpret=False,
-                k_scale=None, v_scale=None, mesh=None, sample=None):
+                k_scale=None, v_scale=None, mesh=None, sample=None,
+                need_rows=None, cand_tok=None):
     """Speculative-decoding verify: G chunk tokens per slot in ONE
     forward — every matmul runs at (B, G, ...) so one weight read
     covers G tokens, which is where the speculative speedup comes from
@@ -481,6 +549,15 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
         layer, (h, k_pool, v_pool, k_scale, v_scale),
         (params["layers"], jnp.arange(L)))
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
+    if need_rows is not None:
+        # lean epilogue (suffix-prefill path): gather the needed flat
+        # (B*G)-space rows before the unembed matmul — a bucket-G
+        # chunk pays len(need_rows) rows of lm_head FLOPs, not B*G.
+        # Callers pass sample=None here (the seed token is picked
+        # host-side at finish, the PR 8 convention).
+        hf = h.reshape(B * G, -1)[jnp.maximum(need_rows, 0)]
+        logits = hf @ params["lm_head"]              # (M, V)
+        return k_pool, v_pool, k_scale, v_scale, logits
     logits = h @ params["lm_head"]
     if sample is None:
         return k_pool, v_pool, k_scale, v_scale, logits
@@ -488,21 +565,29 @@ def verify_step(params, k_pool, v_pool, page_table, lengths, tokens,
     # decode_step's): per-position continuation tokens — argmax for
     # greedy slots, the position-keyed categorical draw for sampled
     # ones — and their raw-model logprobs. The host acceptance loop
-    # consumes (B, G) ints/floats, never a vocab row; only
-    # spec_sample's multi-token rejection sampling still pulls rows
-    # (its exactness guarantee needs the full filtered distribution).
+    # consumes (B, G) ints/floats, never a vocab row; spec_sample's
+    # rejection sampler rides `cand_tok` candidate probabilities
+    # (computed under the device filter) and pulls a distribution row
+    # only on divergence.
     rec = _sample_grid(logits, lengths, sample)
+    if cand_tok is not None:
+        slot_of = jnp.repeat(jnp.arange(B, dtype=jnp.int32), G)
+        cand_p = _cand_probs(logits.reshape(B * G, -1), slot_of,
+                             sample, cand_tok.reshape(-1))
+        rec = rec + (cand_p.reshape(B, G),)
     return k_pool, v_pool, k_scale, v_scale, logits, rec
 
 
 @functools.partial(jax.jit,
                    static_argnames=("config", "page_size", "use_pallas",
-                                    "interpret"))
+                                    "interpret", "block_q",
+                                    "block_pages"))
 def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
                  tok_pos, config: LlamaConfig, page_size,
                  use_pallas=False, interpret=False, k_scale=None,
                  v_scale=None, sample=None, carry_tok=None,
-                 carry_gather=None, carry_mask=None):
+                 carry_gather=None, carry_mask=None, need_rows=None,
+                 cand_tok=None, block_q=None, block_pages=None):
     """ONE device program for an arbitrary prefill/decode mix (ROADMAP
     item 1; "Ragged Paged Attention" + the MPK fewer-bigger-programs
     direction): a FLAT token buffer replaces the (batch, seq) grids of
@@ -529,9 +614,27 @@ def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
     (`carry_tok[carry_gather[i]]`), so the pipelined pump launches wave
     N+1 before the host has read wave N. Attention runs the pallas
     ragged paged kernel on TPU and its bit-identical jnp reference on
-    CPU (paddle_tpu/kernels/ragged_paged_attention.py).
+    CPU (paddle_tpu/kernels/ragged_paged_attention.py);
+    `block_q`/`block_pages` (static) pick its tile — the engine
+    resolves them ONCE at construction, so a tuned tile never retraces
+    the serving trace.
 
-    Returns (k_pool, v_pool, k_scale, v_scale, logits (T, V)[, rec]).
+    `need_rows` ((N,) i32, -1 = inactive) is the LEAN epilogue (docs/
+    serving.md § Lean epilogue): the final-norm hidden states gather
+    down to exactly those buffer rows BEFORE the lm_head matmul, so a
+    64-token prefill chunk pays one row of unembed FLOPs and the
+    (T, vocab) buffer is never materialized. Sampling rides the sparse
+    rows with the row's own (tok_slot, tok_pos) — the PRNG fold does
+    not move, so tokens and logprobs are bit-identical to the full
+    epilogue; the returned logits and rec are N-row (the caller
+    indexes them in need-row space). `cand_tok` (same leading shape as
+    the epilogue rows) appends per-row filtered-distribution
+    probabilities of a candidate token to the record — the spec-decode
+    rejection sampler's accept tests then ride the compact record
+    instead of pulling vocab rows (docs/serving.md § Speculative
+    decoding).
+
+    Returns (k_pool, v_pool, k_scale, v_scale, logits (T|N, V)[, rec]).
     """
     c = config
     nh, nkv = c.num_attention_heads, c.num_key_value_heads
@@ -566,7 +669,9 @@ def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
         o = ragged_paged_attention(q, kl, vl, page_table, tok_slot,
                                    tok_pos, use_pallas=use_pallas,
                                    interpret=interpret,
-                                   k_scale=ksl, v_scale=vsl)  # (T, QH, D)
+                                   k_scale=ksl, v_scale=vsl,
+                                   block_q=block_q,
+                                   block_pages=block_pages)  # (T, QH, D)
         h = h + o.reshape(t, -1).astype(h.dtype) @ lp["wo"]
         x = _rms(h, lp["ln2"], c.rms_norm_eps)
         mlp = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
@@ -577,10 +682,22 @@ def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
         layer, (h, k_pool, v_pool, k_scale, v_scale),
         (params["layers"], jnp.arange(L)))
     h = _rms(h, params["final_norm"], c.rms_norm_eps)
-    logits = h @ params["lm_head"]                       # (T, V)
+    if need_rows is not None:
+        # lean epilogue: gather the needed rows FIRST — the unembed
+        # matmul and everything downstream run at (N, ...), and the
+        # (T, vocab) buffer never exists in this program
+        idx = jnp.maximum(need_rows, 0)
+        need_on = need_rows >= 0
+        h = h[idx]
+        tok_slot = tok_slot[idx]
+        tok_pos = tok_pos[idx]
+        row_on = need_on & (tok_pos >= 0)
+    logits = h @ params["lm_head"]                       # (T|N, V)
     if sample is None:
         return k_pool, v_pool, k_scale, v_scale, logits
     rec = _sample_flat(logits, tok_slot, tok_pos, row_on, sample)
+    if cand_tok is not None:
+        rec = rec + (_cand_probs(logits, tok_slot, sample, cand_tok),)
     return k_pool, v_pool, k_scale, v_scale, logits, rec
 
 
@@ -595,7 +712,7 @@ verify_step = track_jit("serving.verify_step")(verify_step)
 unified_step = track_jit("serving.unified_step")(unified_step)
 
 
-def speculative_sample(prob_rows, drafts, rng):
+def speculative_sample(prob_rows, drafts, rng, cand_probs=None):
     """Rejection-sampled acceptance for a deterministic draft sequence
     (reference parity: speculative sampling, Leviathan et al. / the
     reference's speculative-decoding sampling path).
@@ -608,6 +725,13 @@ def speculative_sample(prob_rows, drafts, rng):
     O(V log V) host sort at vocab 32k+. drafts: (n-1,) proposed tokens
     d_1..d_{n-1} (chunk tokens 1..n-1); rng: the request's
     np.random.RandomState.
+
+    cand_probs (optional, (n-1,) floats): precomputed p_g(d_{g+1}) —
+    the engine ships these as part of the device step record
+    (`_cand_probs`), so the accept tests consume a float per draft and
+    a row is materialized ONLY on divergence or for the final draw.
+    The rng consumption order is identical with or without them: one
+    rand() per accept test, one choice() per divergence/final draw.
 
     Accept d_{g+1} with probability p_g(d_{g+1}) (the draft proposal is
     a point mass, so min(1, p/q) = p(d)); on rejection sample from the
@@ -622,11 +746,19 @@ def speculative_sample(prob_rows, drafts, rng):
     out = []
     n = len(drafts) + 1
     for g in range(n - 1):
-        p = row(g)
         d = int(drafts[g])
-        if rng.rand() < p[d]:
+        p_d = float(cand_probs[g]) if cand_probs is not None \
+            else None
+        if p_d is None:
+            p = row(g)
+            p_d = p[d]
+        else:
+            p = None                # materialized only on rejection
+        if rng.rand() < p_d:
             out.append(d)           # accepted: token IS the draft
             continue
+        if p is None:
+            p = row(g)
         resid = p.copy()
         resid[d] = 0.0
         tot = resid.sum()
@@ -842,8 +974,10 @@ class ServingEngine:
                  spec_decode=0, spec_ngram=2, chunked_prefill=False,
                  spec_sample=False, mesh=None, prefix_cache=False,
                  host_tier_bytes=0, tier_quantize=True, faults=None,
-                 ragged=None, ragged_tokens=None):
+                 ragged=None, ragged_tokens=None, lean=None,
+                 block_q=None, block_pages=None):
         c = config
+        _wire_compile_cache()
         # mesh with a 'tp' axis: tensor-parallel serving — weights get
         # megatron NamedShardings (llama_spmd.param_specs), the KV pool
         # shards over its KV-head axis, the paged kernels run per-rank
@@ -964,6 +1098,39 @@ class ServingEngine:
         # capacity (the kernel's early exit), not dispatched padding
         self.pad_tokens = 0
         self.ragged_tokens = 0
+        # lean row-sparse lm_head epilogue (docs/serving.md § Lean
+        # epilogue): every unified/verify dispatch passes a `need_rows`
+        # descriptor and the (T, vocab) logits buffer is never
+        # materialized — only the rows a wave actually samples, seeds,
+        # or rejection-tests pay unembed FLOPs. Token- and logprob-
+        # identical to the full epilogue; default ON (PT_SERVE_LEAN=0
+        # or lean=False restores full logits for A/B baselines).
+        if lean is None:
+            lean = os.environ.get("PT_SERVE_LEAN", "1") not in ("", "0")
+        self.lean = bool(lean)
+        # the lean need-row buffer: a wave needs at most one sampled
+        # row per decoding slot (x chunk width G under spec) plus one
+        # seed row per prefilling slot — and a slot is never both, so
+        # max_seqs * G bounds it. Fixed shape => zero retrace as the
+        # mix changes.
+        self.need_buf = max_seqs * G_
+        # pt_logit_rows_total / pt_logit_rows_skipped_total telemetry:
+        # unembed rows actually computed vs rows the lean epilogue
+        # avoided (full engines skip nothing)
+        self.logit_rows = 0
+        self.logit_rows_skipped = 0
+        # ragged kernel tile (docs/tuning.md § Serving kernel
+        # autotune): constructor args win, else the per-TPU-generation
+        # winner persisted by tools/tune_ragged.py, else the seed
+        # shape. Resolved ONCE here — a static jit arg, so the tile
+        # never retraces the serving trace mid-flight.
+        tq, tp_ = _tuning.load_ragged_tile(device_generation())
+        if block_q is None:
+            block_q = tq
+        if block_pages is None:
+            block_pages = tp_
+        self._block_q = int(block_q) or None
+        self._block_pages = int(block_pages) or None
         # optional telemetry sink (paddle_tpu.serving.metrics
         # EngineMetrics duck type): the step loop reports TTFT/TPOT,
         # occupancy, page stats, and preemptions into it. None = free.
@@ -1201,6 +1368,24 @@ class ServingEngine:
         overlaps the next device step instead of stalling it."""
         return jax.device_get(tree)
 
+    def _spec_row_dist(self, logits, idx, req):
+        """Materialize ONE filtered sampling distribution row for the
+        spec rejection sampler's divergence/final draws (docs/serving.md
+        § Speculative decoding). The filter (`_spec_dist_rows`) runs on
+        device over a fixed (1, V) shape — one compile for the whole
+        serve — and the row crosses via the sanctioned `_fetch_results`
+        read. The common accepted-draft case never calls this: accept
+        tests ride the step record's candidate probabilities.
+        Renormalized in float64 so np.random.choice's sum-to-1 check
+        passes on a float32 softmax row."""
+        row = _spec_dist_rows(
+            logits[jnp.asarray(idx, jnp.int32)][None],
+            jnp.full((1,), req.temperature, jnp.float32),
+            jnp.full((1,), req.top_k, jnp.int32),
+            jnp.full((1,), req.top_p, jnp.float32))
+        p = self._fetch_results(row)[0].astype(np.float64)
+        return p / p.sum()
+
     def _fire(self, point, value=None, rids=None):
         """Fault-injection hook (serving/faults.py): no-op unless a
         FaultPlan is attached; an armed rule may raise, sleep, or
@@ -1386,6 +1571,9 @@ class ServingEngine:
             off += lens[i]
             cu[i + 1] = off
         cu[take + 1:] = off  # unused tail: zero-length segments
+        # `prefill_varlen`'s epilogue is already row-sparse (one final
+        # row per packed segment)
+        self.logit_rows += self.max_seqs
         with record_span("serving.prefill"):
             logits, k_all, v_all = prefill_varlen(
                 self.params, jnp.asarray(ids), jnp.asarray(cu),
@@ -1488,6 +1676,8 @@ class ServingEngine:
         bucket = self._bucket_for(S)
         ids = np.zeros((1, bucket), np.int64)
         ids[0, :S] = feed
+        # `prefill`'s epilogue is already row-sparse (one final row)
+        self.logit_rows += 1
         with record_span("serving.prefill"):
             logits, k_all, v_all = prefill(
                 self.params, jnp.asarray(ids), jnp.asarray(S), c,
@@ -1757,6 +1947,8 @@ class ServingEngine:
         # request ids so rid-scoped rules can model a poison request
         self._fire("step_launch", rids=[str(reqs[s].rid) for s in launch])
         self._note_launch_gap(1 if carry is not None else 0)
+        # bucketed decode is one row per slot already — no rows to skip
+        self.logit_rows += B
         # page_table/lengths go to the device as SNAPSHOTS (.copy(), a
         # few hundred bytes): jnp.asarray may zero-copy a numpy buffer
         # on CPU, and the host mutates both tables in place (release /
@@ -1966,8 +2158,24 @@ class ServingEngine:
                   "key": jnp.asarray(keys),
                   "eos": jnp.asarray(eos),
                   "remaining": jnp.asarray(remaining)}
+        need_rows = None
+        n_decode = len(decode_plan)
+        if self.lean:
+            # need-row descriptor: decode rows sit at buffer rows
+            # 0..n_decode-1 (so flat[s] doubles as the need index) and
+            # completed-prefill seed rows follow; -1 pads the fixed
+            # shape, so the mix changing never retraces
+            need = np.full((self.need_buf,), -1, np.int32)
+            need[:n_decode] = np.arange(n_decode, dtype=np.int32)
+            need[n_decode:n_decode + len(seed_flat)] = seed_flat
+            need_rows = jnp.asarray(need)
+            self.logit_rows += self.need_buf
+            self.logit_rows_skipped += T - self.need_buf
+        else:
+            self.logit_rows += T
         c_tok = carry.next_tok if carry is not None \
-            else jnp.zeros((T,), jnp.int32)
+            else jnp.zeros((self.need_buf if self.lean else T,),
+                           jnp.int32)
         self._fire("step_launch",
                    rids=[str(p[1].rid) for p in decode_plan] +
                         [str(p[1].rid) for p in prefill_plan])
@@ -1983,9 +2191,18 @@ class ServingEngine:
                 k_scale=self.k_scale, v_scale=self.v_scale,
                 sample=sample, carry_tok=c_tok,
                 carry_gather=jnp.asarray(carry_gather),
-                carry_mask=jnp.asarray(carry_mask))
-        seed_rows = logits[jnp.asarray(seed_flat, jnp.int32)] \
-            if seeds else None
+                carry_mask=jnp.asarray(carry_mask),
+                need_rows=need_rows, block_q=self._block_q,
+                block_pages=self._block_pages)
+        if not seeds:
+            seed_rows = None
+        elif need_rows is not None:
+            # lean: seed rows were gathered into need positions
+            # n_decode.. — the (T, vocab) buffer never existed
+            seed_rows = logits[jnp.arange(
+                n_decode, n_decode + len(seeds), dtype=jnp.int32)]
+        else:
+            seed_rows = logits[jnp.asarray(seed_flat, jnp.int32)]
         self._t_launch_end = time.perf_counter()
         self.device_steps += 1
         return RaggedTicket(reqs, flat, rec[0], rec[1], rec[2], seeds,
@@ -2154,6 +2371,28 @@ class ServingEngine:
                 fpos[b:b + n] = int(self.lengths[s]) + \
                     np.arange(n, dtype=np.int32)
             self.ragged_tokens += row
+            need_desc = cand = None
+            if self.lean:
+                # lean epilogue: the wave's rows ARE the needed rows
+                # (every chunk position feeds the verify record), so
+                # the descriptor is the identity over the packed rows
+                # — the unembed runs at need_buf rows, never T. cand
+                # carries each row's FOLLOWING draft token so the
+                # rejection sampler's accept tests ride the record.
+                need = np.full((self.need_buf,), -1, np.int32)
+                need[:row] = np.arange(row, dtype=np.int32)
+                need_desc = jnp.asarray(need)
+                cand_np = np.zeros((self.need_buf,), np.int32)
+                for s in active_slots:
+                    n = int(n_tok[s])
+                    if n > 1:
+                        cand_np[base[s]:base[s] + n - 1] = tokens[s, 1:n]
+                cand = jnp.asarray(cand_np)
+                self.logit_rows += self.need_buf
+                self.logit_rows_skipped += T - self.need_buf
+            else:
+                self.logit_rows += T
+            c_shape = self.need_buf if self.lean else T
             with record_span("serving.unified_step"):
                 (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
                  logits, rec) = unified_step(
@@ -2164,9 +2403,12 @@ class ServingEngine:
                     use_pallas=self._use_pallas,
                     interpret=self._interpret, k_scale=self.k_scale,
                     v_scale=self.v_scale, sample=sample,
-                    carry_tok=jnp.zeros((T,), jnp.int32),
+                    carry_tok=jnp.zeros((c_shape,), jnp.int32),
                     carry_gather=jnp.zeros((T,), jnp.int32),
-                    carry_mask=jnp.zeros((T,), bool))
+                    carry_mask=jnp.zeros((T,), bool),
+                    need_rows=need_desc, cand_tok=cand,
+                    block_q=self._block_q,
+                    block_pages=self._block_pages)
             self._t_launch_end = time.perf_counter()
             self.device_steps += 1
             self._fire("step_finish",
@@ -2175,12 +2417,17 @@ class ServingEngine:
             need_idx = np.concatenate(
                 [np.arange(base[s], base[s] + int(n_tok[s]),
                            dtype=np.int32) for s in need_rows]) \
-                if need_rows else None
+                if need_rows and not self.lean else None
             seed_idx = [base[s] + int(n_tok[s]) - 1 for s in seed_slots]
-            tok_f, lp_f, row_f, seed_vals = self._fetch_results(
-                (rec[0], rec[2],                          # (T,) each
+            # lean narrowing: the sampling slots' pull is rec[3]'s
+            # candidate probabilities (a float per draft) instead of
+            # vocab rows; divergence/final rows come lazily through
+            # `_spec_row_dist`
+            tok_f, lp_f, row_f, cand_f, seed_vals = self._fetch_results(
+                (rec[0], rec[2],                          # (T|N,) each
                  logits[jnp.asarray(need_idx)]
-                 if need_rows else None,
+                 if need_idx is not None else None,
+                 rec[3] if self.lean else None,
                  logits[jnp.asarray(seed_idx, jnp.int32)]
                  if seed_slots else None))
             grid = np.zeros((self.max_seqs, G), np.int64)
@@ -2189,19 +2436,36 @@ class ServingEngine:
                 n = int(n_tok[s])
                 grid[s, :n] = tok_f[base[s]:base[s] + n]
                 lp_grid[s, :n] = lp_f[base[s]:base[s] + n]
-            rows_by_slot = {}
+            rows_by_slot, cand_by_slot = {}, {}
             if row_f is not None:
                 off = 0
                 for s in need_rows:
                     n = int(n_tok[s])
                     rows_by_slot[s] = row_f[off:off + n]
                     off += n
+            if cand_f is not None:
+                for s in need_rows:
+                    n = int(n_tok[s])
+                    cand_by_slot[s] = cand_f[base[s]:base[s] + n - 1]
+            flat_logits = logits
+            row_of = {s: base[s] for s in active_slots}
             seed_rows = {} if seed_vals is None else \
                 dict(zip(seed_slots, seed_vals))
         else:
+            cand = None
+            if self.lean and need_rows:
+                # bucketed narrowing: the verify grid's record carries
+                # candidate probabilities, so sampling slots pull
+                # (B, G) floats instead of (n, V) vocab rows
+                cand_np = np.zeros((self.max_seqs, G), np.int32)
+                for s in need_rows:
+                    n = int(n_tok[s])
+                    cand_np[s, :n - 1] = tokens[s, 1:n]
+                cand = jnp.asarray(cand_np)
+            self.logit_rows += self.max_seqs * G
             with record_span("serving.verify_step"):
                 (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-                 logits, (grid_dev, lp_dev)) = verify_step(
+                 logits, rec) = verify_step(
                     self.params, self.k_pool, self.v_pool,
                     jnp.asarray(self.page_table.copy()),
                     jnp.asarray(self.lengths.copy()),
@@ -2210,23 +2474,31 @@ class ServingEngine:
                     use_pallas=self._use_pallas,
                     interpret=self._interpret,
                     k_scale=self.k_scale, v_scale=self.v_scale,
-                    mesh=self._mesh, sample=sample)
+                    mesh=self._mesh, sample=sample, cand_tok=cand)
+            grid_dev, lp_dev = rec[0], rec[1]
             self._t_launch_end = time.perf_counter()
             self.device_steps += 1
             self._fire("step_finish",
                        rids=[str(self._slots[s].rid)
                              for s in active_slots])
-            grid, lp_grid, row_vals, seed_vals = self._fetch_results(
-                (grid_dev, lp_dev,                        # (B, G) each
-                 logits[jnp.asarray(need_rows, jnp.int32)]
-                 if need_rows else None,
-                 logits[jnp.asarray(seed_slots, jnp.int32),
-                        jnp.asarray([int(n_tok[s]) - 1
-                                     for s in seed_slots], jnp.int32)]
-                 if seed_slots else None))
+            grid, lp_grid, row_vals, cand_vals, seed_vals = \
+                self._fetch_results(
+                    (grid_dev, lp_dev,                    # (B, G) each
+                     logits[jnp.asarray(need_rows, jnp.int32)]
+                     if need_rows and cand is None else None,
+                     rec[2] if cand is not None else None,
+                     logits[jnp.asarray(seed_slots, jnp.int32),
+                            jnp.asarray([int(n_tok[s]) - 1
+                                         for s in seed_slots], jnp.int32)]
+                     if seed_slots else None))
             rows_by_slot = {} if row_vals is None else \
                 {s: row_vals[i][:int(n_tok[s])]
                  for i, s in enumerate(need_rows)}
+            cand_by_slot = {} if cand_vals is None else \
+                {s: cand_vals[s, :int(n_tok[s]) - 1] for s in need_rows}
+            V = logits.shape[-1]
+            flat_logits = logits.reshape(-1, V)
+            row_of = {s: s * G for s in active_slots}
             seed_rows = {} if seed_vals is None else \
                 dict(zip(seed_slots, seed_vals))
         for s in active_slots:
@@ -2243,11 +2515,23 @@ class ServingEngine:
             rows = rows_by_slot.get(s)
             if req.temperature > 0.0 and n > 1:
                 # speculative sampling: distributionally exact; rows
-                # filter lazily (rejection at g touches g+1 rows only)
-                outs, a = speculative_sample(
-                    lambda g: filtered_probs_np(rows[g], req.temperature,
-                                                req.top_k, req.top_p),
-                    tokens[s, 1:n], req.rng)
+                # filter lazily (rejection at g touches g+1 rows only).
+                # Lean engines accept against the record's candidate
+                # probabilities and materialize a distribution row
+                # (device-filtered, `_spec_row_dist`) only on
+                # divergence or the final draw.
+                if s in cand_by_slot:
+                    outs, a = speculative_sample(
+                        lambda g: self._spec_row_dist(
+                            flat_logits, row_of[s] + g, req),
+                        tokens[s, 1:n], req.rng,
+                        cand_probs=cand_by_slot[s])
+                else:
+                    outs, a = speculative_sample(
+                        lambda g: filtered_probs_np(
+                            rows[g], req.temperature,
+                            req.top_k, req.top_p),
+                        tokens[s, 1:n], req.rng)
             elif req.temperature > 0.0:
                 # un-drafted sampled slot: the device already drew the
                 # token with the SAME (seed, position) key the plain
@@ -2268,6 +2552,12 @@ class ServingEngine:
                 if req.want_logprobs:
                     if rows is not None:
                         req.note_logprob(tok, rows[j])
+                    elif s in cand_by_slot:
+                        # lean sampled slot: pull THIS emission's raw
+                        # row (logprobs opt-in pays per-token, the
+                        # default path stays narrow)
+                        req.note_logprob(tok, self._fetch_results(
+                            flat_logits[row_of[s] + j]))
                     else:
                         # greedy: emitted token j IS the grid token at
                         # j, whose raw-model logprob came on device
@@ -2487,6 +2777,15 @@ class ServingEngine:
         active = np.zeros((self.max_seqs,), bool)
         active[slot] = True
         self._fire("suffix_prefill", rids=[str(req.rid)])
+        need = None
+        if self.lean:
+            # lean epilogue: only the chunk's final row seeds the first
+            # generated token — one row of unembed FLOPs, not B*G
+            need = jnp.asarray([slot * G + n - 1], jnp.int32)
+            self.logit_rows += 1
+            self.logit_rows_skipped += self.max_seqs * G - 1
+        else:
+            self.logit_rows += self.max_seqs * G
         with record_span("serving.prefill"):
             (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
              logits) = verify_step(
@@ -2497,7 +2796,7 @@ class ServingEngine:
                 jnp.asarray(active), self.config, self.page_size,
                 use_pallas=self._use_pallas, interpret=self._interpret,
                 k_scale=self.k_scale, v_scale=self.v_scale,
-                mesh=self._mesh)
+                mesh=self._mesh, need_rows=need)
         self.lengths[slot] = cached + n
         req.slot = slot
         req._admit_order = self._order
@@ -2508,7 +2807,8 @@ class ServingEngine:
         if getattr(req, "_resume", False):
             req._resume = False  # next_token survives from before eviction
         else:
-            row = self._fetch_results(logits[slot, n - 1])
+            row = self._fetch_results(
+                logits[0] if need is not None else logits[slot, n - 1])
             self._seed_first_token(slot, req, row)
 
     def run(self, max_steps=10000):

@@ -37,6 +37,12 @@ __all__ = ["CompileRegistry", "REGISTRY", "tracked", "track_jit",
 
 DEFAULT_WARN_AFTER = int(os.environ.get("PADDLE_TPU_RETRACE_WARN", "8"))
 
+# first-call wall time below which a compile is attributed to the
+# persistent XLA compilation cache (PT_COMPILE_CACHE): a real
+# trace+lower+compile of a serving program takes 100s of ms even for
+# toy models, a disk cache hit is a deserialize
+CACHE_HIT_S = float(os.environ.get("PT_COMPILE_CACHE_HIT_S", "0.05"))
+
 
 def signature_of(args, kwargs=None):
     """Hashable arg-shape signature: arrays (anything with
@@ -92,6 +98,20 @@ class CompileRegistry:
         # warn_hook(name, stats_dict) — default: structured log event +
         # flight-recorder entry (set at call time so tests can swap it)
         self.warn_hook = warn_hook
+        # persistent XLA compilation cache (PT_COMPILE_CACHE): set via
+        # note_persistent_cache() when the serving engine wires
+        # jax_compilation_cache_dir. While set, compiles whose
+        # first-call time beats CACHE_HIT_S are tagged cache hits —
+        # the restart-runbook signal that a warm restart skipped its
+        # recompiles (docs/reliability.md).
+        self.persistent_cache_dir = None
+        self.cache_hits = 0
+
+    def note_persistent_cache(self, cache_dir):
+        """Record that jax's persistent compilation cache is active at
+        `cache_dir` — enables cache-hit attribution in note_call."""
+        with self._lock:
+            self.persistent_cache_dir = str(cache_dir)
 
     def set_context(self, **tags):
         """One-shot annotation consumed by the NEXT reported call: when
@@ -119,6 +139,11 @@ class CompileRegistry:
                 st.compiles += 1
                 if elapsed_s is not None:
                     st.compile_seconds += elapsed_s
+                cache_hit = (self.persistent_cache_dir is not None
+                             and elapsed_s is not None
+                             and elapsed_s < CACHE_HIT_S)
+                if cache_hit:
+                    self.cache_hits += 1
                 retrace = st.compiles > 1
                 warn = (not st.warned and
                         st.compiles >= self.warn_after)
@@ -130,7 +155,7 @@ class CompileRegistry:
         from . import flight_recorder as _fr
         _fr.record("compile", fn=name, retrace=retrace,
                    n_compiles=snap["compiles"],
-                   elapsed_s=elapsed_s,
+                   elapsed_s=elapsed_s, cache_hit=cache_hit,
                    signature=list(signature)[:8],
                    **(context or {}))
         if warn:
@@ -195,6 +220,7 @@ class CompileRegistry:
                 "compile_seconds": sum(s.compile_seconds
                                        for s in self._fns.values()),
                 "functions": len(self._fns),
+                "cache_hits": self.cache_hits,
             }
 
     def snapshot(self):
@@ -216,6 +242,10 @@ class CompileRegistry:
             "compiling (first-call elapsed).",
             "# TYPE pt_compile_seconds_total counter",
             f"pt_compile_seconds_total {t['compile_seconds']:.6f}",
+            "# HELP pt_compile_cache_hits_total compiles served from "
+            "the persistent XLA compilation cache (PT_COMPILE_CACHE).",
+            "# TYPE pt_compile_cache_hits_total counter",
+            f"pt_compile_cache_hits_total {t['cache_hits']}",
         ]
         with self._lock:
             stats = sorted(self._fns.values(), key=lambda s: s.name)
@@ -237,6 +267,7 @@ class CompileRegistry:
     def reset(self):
         with self._lock:
             self._fns.clear()
+            self.cache_hits = 0
 
 
 REGISTRY = CompileRegistry()

@@ -292,7 +292,19 @@ class EngineMetrics:
         self.ragged_tokens = r.counter(
             "pt_ragged_tokens",
             "Real token rows served through the unified ragged step.")
-        self._tok_seen = {"pad_tokens": 0, "ragged_tokens": 0}
+        # lean epilogue accounting (ISSUE 12): unembed (lm_head) rows
+        # actually computed vs rows the row-sparse epilogue skipped —
+        # the (T, vocab) FLOPs/bytes that never ran. Same delta-mirror
+        # pattern as the pad counters.
+        self.logit_rows = r.counter(
+            "pt_logit_rows",
+            "lm_head logit rows computed by serving device programs.")
+        self.logit_rows_skipped = r.counter(
+            "pt_logit_rows_skipped",
+            "Logit rows the lean row-sparse epilogue skipped (0 with "
+            "PT_SERVE_LEAN=0).")
+        self._tok_seen = {"pad_tokens": 0, "ragged_tokens": 0,
+                          "logit_rows": 0, "logit_rows_skipped": 0}
         self.steps = r.counter(
             "pt_serving_device_steps", "Decode/verify device calls.")
         self.tokens = r.counter(
@@ -404,7 +416,10 @@ class EngineMetrics:
         self.prefill_tokens.set(engine.prefill_tokens)
         seen = self._tok_seen
         for attr, counter in (("pad_tokens", self.pad_tokens),
-                              ("ragged_tokens", self.ragged_tokens)):
+                              ("ragged_tokens", self.ragged_tokens),
+                              ("logit_rows", self.logit_rows),
+                              ("logit_rows_skipped",
+                               self.logit_rows_skipped)):
             cur = getattr(engine, attr, 0)
             delta = cur - seen[attr]
             if delta > 0:

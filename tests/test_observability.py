@@ -80,6 +80,79 @@ class TestCompileTelemetry:
         assert len(evs) == 2
         assert evs[0]["retrace"] is False and evs[1]["retrace"] is True
 
+    def test_persistent_cache_hit_tagging(self):
+        """ISSUE 12: with the persistent XLA cache wired, a 'compile'
+        that returns faster than CACHE_HIT_S was served from disk —
+        tagged on the flight record and counted in
+        pt_compile_cache_hits_total. Without the cache, never tagged."""
+        flight_recorder.RECORDER.clear()
+        reg = compile_telemetry.CompileRegistry(warn_after=100)
+        fast = compile_telemetry.CACHE_HIT_S / 10
+        # cache not wired: even an instant compile is NOT a hit
+        reg.note_call("unit.cc", ("a",), elapsed_s=fast)
+        assert reg.totals()["cache_hits"] == 0
+        reg.note_persistent_cache("/tmp/xla-cache")
+        # wired: fast compile == disk hit; slow compile == real lower
+        reg.note_call("unit.cc", ("b",), elapsed_s=fast)
+        reg.note_call("unit.cc", ("c",),
+                      elapsed_s=compile_telemetry.CACHE_HIT_S * 10)
+        # a non-compile repeat call never counts
+        reg.note_call("unit.cc", ("b",), elapsed_s=fast)
+        assert reg.totals()["cache_hits"] == 1
+        assert "pt_compile_cache_hits_total 1" in reg.render_prometheus()
+        evs = [e for e in flight_recorder.RECORDER.events(kind="compile")
+               if e["fn"] == "unit.cc"]
+        assert [e["cache_hit"] for e in evs] == [False, True, False]
+        reg.reset()
+        assert reg.totals()["cache_hits"] == 0
+
+    def test_pt_compile_cache_env_wires_jax_and_registry(
+            self, tmp_path, monkeypatch):
+        """PT_COMPILE_CACHE=<dir> at engine construction points jax's
+        persistent compilation cache there (thresholds zeroed so small
+        serving programs persist) and arms the registry's cache-hit
+        attribution — once per process (docs/reliability.md § restart
+        runbook)."""
+        from paddle_tpu.models import llama_serving as S
+        saved = {k: getattr(jax.config, k) for k in
+                 ("jax_compilation_cache_dir",
+                  "jax_persistent_cache_min_compile_time_secs",
+                  "jax_persistent_cache_min_entry_size_bytes")}
+        saved_reg = compile_telemetry.REGISTRY.persistent_cache_dir
+        try:
+            monkeypatch.setattr(S, "_compile_cache_wired", False)
+            monkeypatch.setenv("PT_COMPILE_CACHE", str(tmp_path))
+            S._wire_compile_cache()
+            assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+            assert jax.config\
+                .jax_persistent_cache_min_compile_time_secs == 0.0
+            assert compile_telemetry.REGISTRY.persistent_cache_dir == \
+                str(tmp_path)
+            # do-once: a later engine (env gone) must not un-wire it
+            monkeypatch.delenv("PT_COMPILE_CACHE")
+            S._wire_compile_cache()
+            assert compile_telemetry.REGISTRY.persistent_cache_dir == \
+                str(tmp_path)
+        finally:
+            for k, v in saved.items():
+                jax.config.update(k, v)
+            compile_telemetry.REGISTRY.persistent_cache_dir = saved_reg
+
+    def test_unset_env_leaves_cache_cold(self, monkeypatch):
+        from paddle_tpu.models import llama_serving as S
+        saved = jax.config.jax_compilation_cache_dir
+        saved_reg = compile_telemetry.REGISTRY.persistent_cache_dir
+        try:
+            monkeypatch.setattr(S, "_compile_cache_wired", False)
+            compile_telemetry.REGISTRY.persistent_cache_dir = None
+            monkeypatch.delenv("PT_COMPILE_CACHE", raising=False)
+            S._wire_compile_cache()
+            assert jax.config.jax_compilation_cache_dir == saved
+            assert compile_telemetry.REGISTRY.persistent_cache_dir is None
+        finally:
+            jax.config.update("jax_compilation_cache_dir", saved)
+            compile_telemetry.REGISTRY.persistent_cache_dir = saved_reg
+
 
 # ---------------------------------------------------------------------------
 # trace context

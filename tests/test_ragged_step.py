@@ -120,6 +120,60 @@ class TestKernelBitEquivalence:
 
 
 # ---------------------------------------------------------------------------
+# Tunable kernel tiling (ISSUE 12): a tile choice never changes a bit
+# ---------------------------------------------------------------------------
+class TestKernelTiling:
+    """`block_q` x `block_pages` is a STATIC tuning knob: every legal
+    tile must be BIT-identical to the seed tile, fp32 and int8 — the
+    autotuner (tools/tune_ragged.py) may pick any of them and the
+    sampled token stream must not notice."""
+    # the test problem's GQA group (4q/2kv -> 2) pads to the sublane
+    # minimum 8; PAGES_PER_SEQ=4 bounds block_pages
+    TILES = [(8, 2), (16, 1), (16, 4)]
+
+    @pytest.mark.parametrize("quant", [False, True], ids=["fp32", "int8"])
+    def test_every_legal_tile_bit_identical(self, quant):
+        prob = TestKernelBitEquivalence()
+        q, k, v, ptab, slot, pos, ks, vs = prob._problem(quant=quant)
+        kw = {}
+        if quant:
+            kw = {"k_scale": jnp.asarray(ks), "v_scale": jnp.asarray(vs)}
+        base = np.asarray(ragged_paged_attention(
+            q, k, v, ptab, slot, pos, use_pallas=True, interpret=True,
+            **kw))
+        for bq, bp in self.TILES:
+            out = np.asarray(ragged_paged_attention(
+                q, k, v, ptab, slot, pos, use_pallas=True, interpret=True,
+                block_q=bq, block_pages=bp, **kw))
+            assert np.array_equal(base, out), \
+                f"tile (block_q={bq}, block_pages={bp}) diverged"
+
+    def test_reference_honors_block_q_too(self):
+        """use_pallas=False with a tuned block_q: the reference blocks
+        its q rows the same way, so a CPU engine constructed on a tile
+        file stays exact."""
+        prob = TestKernelBitEquivalence()
+        q, k, v, ptab, slot, pos, _, _ = prob._problem()
+        base = np.asarray(ragged_paged_attention(
+            q, k, v, ptab, slot, pos, use_pallas=False))
+        out = np.asarray(ragged_paged_attention(
+            q, k, v, ptab, slot, pos, use_pallas=False, block_q=16))
+        assert np.array_equal(base, out)
+
+    def test_illegal_tiles_rejected_loudly(self):
+        prob = TestKernelBitEquivalence()
+        q, k, v, ptab, slot, pos, _, _ = prob._problem()
+        with pytest.raises(ValueError, match="block_q"):
+            ragged_paged_attention(q, k, v, ptab, slot, pos,
+                                   use_pallas=True, interpret=True,
+                                   block_q=6)   # not sublane-aligned
+        with pytest.raises(ValueError, match="block_pages"):
+            ragged_paged_attention(q, k, v, ptab, slot, pos,
+                                   use_pallas=True, interpret=True,
+                                   block_pages=-1)
+
+
+# ---------------------------------------------------------------------------
 # Token identity: ragged == bucketed, every mode, both pumps
 # ---------------------------------------------------------------------------
 def _submit_mixed(eng, max_new=8):
@@ -311,3 +365,135 @@ class TestFaultDrill:
         assert st["recovery"]["restarts"] >= 1
         assert st["requests"]["failed"] == 0
         assert st["requests"]["completed"] == self.N
+
+
+# ---------------------------------------------------------------------------
+# Lean row-sparse lm_head epilogue (ISSUE 12)
+# ---------------------------------------------------------------------------
+class TestLeanEpilogue:
+    """lean=True (the default) vs lean=False at equal config: tokens
+    AND logprobs identical, the step program strictly cheaper, the
+    skipped unembed rows booked in pt_logit_rows(_skipped)."""
+
+    def _run(self, params, lean, kw, pipelined, spec_workload):
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False, ragged=True,
+                            lean=lean, **kw)
+        if spec_workload:
+            # spec modes draft off n-gram repeats; the lean engine
+            # consumes device candidate probs in the rejection sampler
+            # (a documented sampling-trajectory change, docs/serving.md
+            # § Speculative row narrowing), so the lean-vs-full
+            # identity contract is asserted on the greedy verify path
+            eng.submit(Request("g0", [1, 5, 1, 5, 1, 5], max_new_tokens=8))
+            eng.submit(Request("g1", [9, 9, 9, 2], max_new_tokens=8,
+                               logprobs=True))
+            eng.submit(Request("g2", [2, 4, 2, 4, 2], max_new_tokens=8,
+                               logprobs=True))
+        else:
+            _submit_mixed(eng)
+        done = eng.run_pipelined() if pipelined else eng.run()
+        return eng, _outputs(done)
+
+    @pytest.mark.parametrize("mode,pipelined", _PARAMS)
+    def test_lean_equals_full(self, params, mode, pipelined):
+        kw = MODES[mode]
+        spec_workload = bool(kw.get("spec_decode"))
+        outs = []
+        for lean in (False, True):
+            eng, out = self._run(params, lean, kw, pipelined,
+                                 spec_workload)
+            if lean:
+                assert eng.logit_rows_skipped > 0
+            else:
+                assert eng.logit_rows_skipped == 0
+            outs.append(out)
+        for rid, (toks, lps) in outs[0].items():
+            l_toks, l_lps = outs[1][rid]
+            assert toks == l_toks, f"mode {mode} rid {rid} diverged"
+            assert lps == l_lps, f"mode {mode} rid {rid} logprobs"
+
+    def test_lean_under_preemption(self, params):
+        outs = []
+        for lean in (False, True):
+            eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=32,
+                                page_size=8, num_pages=6,
+                                use_pallas=False, ragged=True, lean=lean)
+            eng.submit(Request("s", [3, 7, 2, 9], max_new_tokens=20,
+                               temperature=0.8, top_k=8, seed=123))
+            eng.submit(Request("g", [1, 4, 6, 2], max_new_tokens=20))
+            done = eng.run(max_steps=500)
+            assert eng.preemptions > 0
+            outs.append({r.rid: r.output for r in done})
+        assert outs[0] == outs[1]
+
+    def test_step_program_strictly_cheaper(self, params):
+        """The whole point, asserted at the XLA cost-analysis layer:
+        the lean `unified_step` issues FEWER flops AND touches fewer
+        bytes than the full one on the same workload — the (T, vocab)
+        unembed buffer is gone, not merely masked."""
+        from paddle_tpu.observability import device_telemetry as _dt
+
+        def step_cost(lean):
+            eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                                page_size=8, use_pallas=False,
+                                ragged=True, lean=lean)
+            zero = {"flops": 0.0, "bytes": 0.0}
+            mark = _dt.COSTS.issued_totals()["per_fn"].get(
+                "serving.unified_step", zero)
+            _submit_mixed(eng)
+            eng.run()
+            now = _dt.COSTS.issued_totals()["per_fn"][
+                "serving.unified_step"]
+            return (now["flops"] - mark["flops"],
+                    now["bytes"] - mark["bytes"])
+
+        full, lean = step_cost(False), step_cost(True)
+        assert 0 < lean[0] < full[0], (lean, full)
+        assert 0 < lean[1] < full[1], (lean, full)
+
+    def test_row_ledger_reaches_metrics(self, params):
+        """pt_logit_rows / pt_logit_rows_skipped mirror the engine's
+        counters through EngineMetrics and render with the counter
+        `_total` suffix."""
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False, ragged=True)
+        assert eng.lean   # PT_SERVE_LEAN defaults ON
+        reg = MetricsRegistry()
+        sched = RequestScheduler(eng, max_queue=8, metrics=reg)
+        hs = [sched.submit([1 + i, 5, 9], rid=f"r{i}",
+                           max_new_tokens=5) for i in range(3)]
+        for h in hs:
+            h.result(timeout=60)
+        sched.shutdown(drain=True, timeout=30)
+        snap = reg.snapshot()
+        assert eng.logit_rows > 0
+        assert eng.logit_rows_skipped > 0
+        assert snap["pt_logit_rows"]["value"] == eng.logit_rows
+        assert snap["pt_logit_rows_skipped"]["value"] == \
+            eng.logit_rows_skipped
+        text = reg.render_prometheus()
+        assert "pt_logit_rows_total" in text
+        assert "pt_logit_rows_skipped_total" in text
+
+    def test_need_rows_zero_retrace(self, params):
+        """The need descriptor is a fixed-shape (max_seqs * G,) operand:
+        waves with wildly different needed-row counts reuse ONE
+        `serving.unified_step` trace."""
+        from paddle_tpu.observability.compile_telemetry import REGISTRY
+        eng = ServingEngine(params, CFG, max_seqs=2, max_seq_len=64,
+                            page_size=8, use_pallas=False, ragged=True,
+                            lean=True)
+        eng.submit(Request("warm", [1, 2, 3], max_new_tokens=2))
+        eng.run()
+        before = REGISTRY.snapshot()["serving.unified_step"]["compiles"]
+        assert before >= 1
+        # one long prefill (1 needed row), then a full decode batch
+        # (max_seqs needed rows), then staggered admissions
+        eng.submit(Request("a", list(range(1, 20)), max_new_tokens=6))
+        eng.run()
+        eng.submit(Request("b", [5], max_new_tokens=9))
+        eng.submit(Request("c", [8, 8, 8], max_new_tokens=4))
+        eng.run()
+        after = REGISTRY.snapshot()["serving.unified_step"]["compiles"]
+        assert after == before, "need_rows churn retraced unified_step"

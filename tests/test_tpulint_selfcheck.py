@@ -115,6 +115,18 @@ def test_ragged_step_functions_in_hot_set():
     assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
 
 
+def test_lean_epilogue_functions_in_hot_set():
+    """ISSUE 12: the lean epilogue's lazy spec-row pull runs inside the
+    acceptance loop — it belongs in the TPL001 hot set, and the single
+    sanctioned sync is STILL the batched reader alone (the lazy pull is
+    one more call through it, not beside it)."""
+    from paddle_tpu.analysis.config import LintConfig
+
+    cfg = LintConfig.default()
+    assert "ServingEngine._spec_row_dist" in cfg.hot_functions
+    assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
+
+
 def test_sanctioned_sync_config_check(tmp_path):
     """The TPL001 config check: a raw jax.device_get anywhere in a hot
     serving module — even outside the configured hot functions — is a
