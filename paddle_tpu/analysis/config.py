@@ -115,6 +115,13 @@ class LintConfig:
                 # acceptance loop — sync discipline applies (its one
                 # read rides _fetch_results)
                 "ServingEngine._spec_row_dist",
+                # disaggregated handoff (ISSUE 13): harvest runs once
+                # per step; export/import move KV pages through the
+                # kvtier copy thread's explicit fences — their device
+                # transfers must never look like a stray sync
+                "ServingEngine._harvest_handoffs",
+                "ServingEngine._export_handoff",
+                "ServingEngine._import_handoff",
                 # scheduler pump + publish run once per engine step
                 "RequestScheduler._pump", "RequestScheduler._publish",
                 "RequestScheduler._feed_locked",

@@ -40,8 +40,9 @@ from ..profiler import record_span
 # serving.kvtier and serving.faults never import model/engine code, so
 # this direction stays cycle-free)
 from ..serving.faults import FaultPlan
+from ..serving.handoff import KVHandoff
 from ..serving.kvcache import PagePool, PrefixCache
-from ..serving.kvtier import HostTier
+from ..serving.kvtier import HostTier, _dequantize_host, _quantize_host
 from ..ops.rope import rope_cos_sin, apply_rotary_emb
 from ..ops.flash_attention import flash_attention_bhsd
 from ..ops.paged_attention import (paged_attention, paged_verify_attention,
@@ -587,7 +588,8 @@ def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
                  use_pallas=False, interpret=False, k_scale=None,
                  v_scale=None, sample=None, carry_tok=None,
                  carry_gather=None, carry_mask=None, need_rows=None,
-                 cand_tok=None, block_q=None, block_pages=None):
+                 cand_tok=None, block_q=None, block_pages=None,
+                 tok_buf=None, buf_write=None):
     """ONE device program for an arbitrary prefill/decode mix (ROADMAP
     item 1; "Ragged Paged Attention" + the MPK fewer-bigger-programs
     direction): a FLAT token buffer replaces the (batch, seq) grids of
@@ -634,7 +636,11 @@ def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
     instead of pulling vocab rows (docs/serving.md § Speculative
     decoding).
 
-    Returns (k_pool, v_pool, k_scale, v_scale, logits (T|N, V)[, rec]).
+    Returns (k_pool, v_pool, k_scale, v_scale, logits (T|N, V)[, rec]
+    [, tok_buf]). `tok_buf` ((B, max_seq_len+1) i32 device ring) makes
+    token values device-resident: rows gather their embedding input
+    from it and decode rows (`buf_write`) scatter their sampled token
+    back — the in-jit twin of the carry operands, which it replaces.
     """
     c = config
     nh, nkv = c.num_attention_heads, c.num_key_value_heads
@@ -646,6 +652,17 @@ def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
         tokens = jnp.where(carry_mask, carry_tok[carry_gather], tokens)
     row_on = tok_pos >= 0
     pos = jnp.maximum(tok_pos, 0)
+    if tok_buf is not None:
+        # in-jit token source (docs/serving.md § Device token buffer):
+        # column p of a slot's ring row holds the token CONSUMED at
+        # cache position p, so the host ships only (slot, pos)
+        # descriptors — token values (and the embedding gather below)
+        # never leave the device. Subsumes the pipelined carry: wave
+        # N's own scatter (bottom of this program) is device-ordered
+        # before wave N+1's gather. Inactive rows read column 0 of
+        # slot 0 — their K/V lands on the trash page and sampling
+        # masks them, so the garbage value is never observed.
+        tokens = tok_buf[tok_slot, pos]
     cos, sin = rope_cos_sin(None, hd, base=c.rope_theta,
                             position_ids=pos)            # (T, hd)
     h = jnp.take(params["embed"], tokens, axis=0)        # (T, H)
@@ -698,6 +715,20 @@ def unified_step(params, k_pool, v_pool, page_table, tokens, tok_slot,
     rec = _sample_flat(logits, tok_slot, tok_pos, row_on, sample)
     if cand_tok is not None:
         rec = rec + (_cand_probs(logits, tok_slot, sample, cand_tok),)
+    if tok_buf is not None:
+        # scatter this wave's sampled tokens back into the ring: the
+        # token sampled at position p is the one position p+1 consumes.
+        # `buf_write` marks the decode rows (seed rows stay host-picked,
+        # the PR 8 convention — the host pokes them at finish); masked
+        # rows park on an out-of-bounds slot and drop.
+        B = tok_buf.shape[0]
+        wslot = jnp.where(buf_write & row_on, tok_slot, B)
+        # tok_pos/tok_slot are already in epilogue space here (the lean
+        # gather above re-indexed them), matching rec's rows
+        pos_w = jnp.maximum(tok_pos, 0)
+        tok_buf = tok_buf.at[wslot, pos_w + 1].set(
+            rec[0].astype(jnp.int32), mode="drop")
+        return k_pool, v_pool, k_scale, v_scale, logits, rec, tok_buf
     return k_pool, v_pool, k_scale, v_scale, logits, rec
 
 
@@ -710,6 +741,23 @@ prefill_varlen = track_jit("serving.prefill_varlen")(prefill_varlen)
 decode_step = track_jit("serving.decode_step")(decode_step)
 verify_step = track_jit("serving.verify_step")(verify_step)
 unified_step = track_jit("serving.unified_step")(unified_step)
+
+
+# device token-ring setters (satellite of ROADMAP item 1): the two
+# host-side writers of the buffer `unified_step` gathers embeddings
+# from. Fixed shapes — one compile each for the life of the engine.
+@jax.jit
+def _tokbuf_stage(tok_buf, row_vals, slot):
+    """Replace one slot's whole consumed-token row (admission, restore,
+    handoff import — anywhere the sequence's history (re)enters)."""
+    return tok_buf.at[slot].set(row_vals)
+
+
+@jax.jit
+def _tokbuf_poke(tok_buf, slot, pos, tok):
+    """Write one consumed-token cell — the host-picked first token
+    (PR 8 seeding convention keeps that draw host-side)."""
+    return tok_buf.at[slot, pos].set(tok)
 
 
 def speculative_sample(prob_rows, drafts, rng, cand_probs=None):
@@ -975,7 +1023,7 @@ class ServingEngine:
                  spec_sample=False, mesh=None, prefix_cache=False,
                  host_tier_bytes=0, tier_quantize=True, faults=None,
                  ragged=None, ragged_tokens=None, lean=None,
-                 block_q=None, block_pages=None):
+                 block_q=None, block_pages=None, tokbuf=None):
         c = config
         _wire_compile_cache()
         # mesh with a 'tp' axis: tensor-parallel serving — weights get
@@ -1131,6 +1179,23 @@ class ServingEngine:
             block_pages = tp_
         self._block_q = int(block_q) or None
         self._block_pages = int(block_pages) or None
+        # device-resident token ring (ROADMAP item-1 last follow-on):
+        # (max_seqs, max_seq_len+1) i32 where column p holds the token
+        # a slot CONSUMES at cache position p. `unified_step` gathers
+        # its embedding input from it (host ships only slot/pos
+        # descriptors) and scatters each wave's sampled tokens back
+        # in-jit, replacing the pipelined-carry operands. Host writes
+        # ride two fixed-shape jitted setters (`_tokbuf_stage` at
+        # admission/restore/import, `_tokbuf_poke` for host-picked
+        # seeds) — zero retrace. Ragged plain-decode engines only: the
+        # spec verify chunk keeps host-fed token values.
+        # PT_SERVE_TOKBUF=0 (or tokbuf=False) restores the host token
+        # path for A/B baselines.
+        if tokbuf is None:
+            tokbuf = os.environ.get("PT_SERVE_TOKBUF", "1") \
+                not in ("", "0")
+        self.tok_buf = jnp.zeros((max_seqs, max_seq_len + 1), jnp.int32) \
+            if tokbuf and self.ragged and self.spec_decode <= 1 else None
         # optional telemetry sink (paddle_tpu.serving.metrics
         # EngineMetrics duck type): the step loop reports TTFT/TPOT,
         # occupancy, page stats, and preemptions into it. None = free.
@@ -1210,6 +1275,22 @@ class ServingEngine:
         self.faults = faults if faults is not None else FaultPlan.from_env()
         self.host_tier.faults = self.faults
         self.restarts = 0
+        # disaggregated prefill/decode handoff (serving/handoff.py;
+        # docs/serving.md § Disaggregated prefill/decode): a request
+        # submitted with `_handoff_export` set finishes with its KV
+        # pages exported as a KVHandoff instead of decoding here.
+        # Counters mirror to pt_handoff_* via EngineMetrics.on_step;
+        # `_handoff_times` is drained into the pt_handoff_seconds
+        # histogram there (both on the pump thread — single-writer).
+        # `_handoff_pending` is a fast-path guard for the per-launch
+        # harvest scan: 0 (the role="both" default) costs one int
+        # compare per step and constructs nothing.
+        self.handoff_exports = 0
+        self.handoff_imports = 0
+        self.handoff_bytes = 0
+        self.handoff_failures = 0
+        self._handoff_times = []
+        self._handoff_pending = 0
         if self.prefix_cache is not None:
             self.prefix_cache.on_evict = self._note_prefix_evict
             if self.host_tier.enabled:
@@ -1275,6 +1356,8 @@ class ServingEngine:
         self.validate(req)
         if req._t_submit is None:
             req._t_submit = time.perf_counter()
+        if getattr(req, "_handoff_export", False):
+            self._handoff_pending += 1
         self._waiting.append(req)
         m = self.metrics
         if m is not None:
@@ -1292,6 +1375,7 @@ class ServingEngine:
         if req in self._waiting:
             self._waiting.remove(req)
             self._drop_offload(req)
+            self._clear_handoff_flag(req)
             self.finished.append(req)
             m = self.metrics
             if m is not None:
@@ -1316,6 +1400,7 @@ class ServingEngine:
             for r in self._waiting:
                 if r.cancelled:
                     self._drop_offload(r)
+                    self._clear_handoff_flag(r)
                     self.finished.append(r)
                     if m is not None:
                         m.on_cancel("queued")
@@ -1357,6 +1442,20 @@ class ServingEngine:
         in sync with `_slots` (release is the only other mutator)."""
         self._slots[slot] = req
         self._live.add(slot)
+
+    def _stage_tokbuf(self, slot, req):
+        """(Re)write one slot's device token-ring row: everything the
+        sequence has consumed or holds pending — prompt + output (the
+        pending next_token is always output's tail) — zero-padded to
+        the fixed row shape. One call per (re)admission; no-op when
+        the engine runs the host token path."""
+        if self.tok_buf is None:
+            return
+        vals = np.zeros((self.max_seq_len + 1,), np.int32)
+        toks = list(req.prompt) + [int(t) for t in req.output]
+        n = min(len(toks), self.max_seq_len + 1)
+        vals[:n] = toks[:n]
+        self.tok_buf = _tokbuf_stage(self.tok_buf, vals, np.int32(slot))
 
     def _fetch_results(self, tree):
         """The ONE sanctioned device->host read in the serving step
@@ -1418,6 +1517,9 @@ class ServingEngine:
         for r in self._waiting:
             self._drop_offload(r)
         waiting, self._waiting = self._waiting, []
+        # requeued requests keep their export flags; re-submission
+        # re-counts them, so the pending counter restarts from zero
+        self._handoff_pending = 0
         return waiting
 
     @staticmethod
@@ -1483,11 +1585,19 @@ class ServingEngine:
         take = 0
         for req in self._waiting[:len(free_slots)]:
             ofl = getattr(req, "_offload", None)
+            hin = getattr(req, "_kv_import", None)
             if ofl is not None:
                 need = ofl["pages"]
                 if ofl["len"] % self.page_size == 0 and \
                         need * self.page_size <= ofl["len"]:
                     need += 1  # boundary growth this same step
+            elif hin is not None:
+                # a handoff import scatters its shipped pages like a
+                # restore — no prefix probe (the payload IS the prefix)
+                need = hin.pages
+                if hin.length % self.page_size == 0 and \
+                        need * self.page_size <= hin.length:
+                    need += 1
             else:
                 feed = self._feed_ids(req)
                 feed_len = max(len(feed), 1)
@@ -1527,6 +1637,9 @@ class ServingEngine:
             req._kv_match = None
             if getattr(req, "_offload", None) is not None:
                 self._restore_into(slot, req)
+            elif getattr(req, "_kv_import", None) is not None and \
+                    self._import_handoff(slot, req):
+                pass  # scattered + attached; failure fell through below
             elif self.chunked_prefill or self.ragged:
                 req._pf_feed = self._feed_ids(req)
                 req._pf_cursor = 0
@@ -1540,6 +1653,7 @@ class ServingEngine:
                 req._admit_order = self._order
                 self._order += 1
                 self._attach(slot, req)
+                self._stage_tokbuf(slot, req)
                 if match[0]:
                     # cached prefix: map the shared pages in and start
                     # the chunk feed at the first uncached token
@@ -1747,7 +1861,13 @@ class ServingEngine:
             "engine.preempt", rid=str(req.rid),
             policy=self.preempt_policy, slot=s,
             tokens=len(req.output), pages=len(self._seq_pages[s]))
+        flagged = getattr(req, "_handoff_export", False)
         self._release(s)
+        if flagged:
+            # a preempted export candidate stays one: re-arm the flag
+            # _release just consumed so the re-admission still hands off
+            req._handoff_export = True
+            self._handoff_pending += 1
         self.preemptions += 1
         m = self.metrics
         if m is not None:
@@ -1772,6 +1892,7 @@ class ServingEngine:
         req._admit_order = self._order
         self._order += 1
         self._attach(slot, req)
+        self._stage_tokbuf(slot, req)
 
     def _scatter_host_kv(self, pages, k, v, ks, vs):
         """Scatter host-resident page KV (np, (L, KVH, n, page, D))
@@ -1826,6 +1947,153 @@ class ServingEngine:
             self.finished.append(req)
             self._note_finish(req)
             self._release(slot)
+        elif self.tok_buf is not None:
+            # the host-picked seed is the token position `lengths`
+            # consumes next — poke it into the device token ring
+            self.tok_buf = _tokbuf_poke(
+                self.tok_buf, np.int32(slot),
+                np.int32(int(self.lengths[slot])), np.int32(tok))
+
+    # -- disaggregated prefill/decode handoff -----------------------------
+    def _clear_handoff_flag(self, req):
+        """Consume a request's export flag, keeping the fast-path
+        pending counter honest. Safe to call on unflagged requests."""
+        if getattr(req, "_handoff_export", False):
+            req._handoff_export = False
+            self._handoff_pending = max(0, self._handoff_pending - 1)
+
+    def _harvest_handoffs(self):
+        """Export-and-finish every live slot flagged for handoff whose
+        prompt is fully prefilled and seeded. Runs at the top of each
+        launch, BEFORE decode planning: a slot only becomes eligible
+        the launch after its seeding finish, and that previous launch
+        skipped it (next_token was still None), so no in-flight wave
+        touches the slot — its KV is exactly prompt-complete and
+        `lengths` was never advanced past the prompt."""
+        if self._handoff_pending <= 0:
+            return
+        if self.host_tier is None:
+            # no tier, no export path: flagged requests simply decode
+            # locally to completion (flags clear at release)
+            return
+        for s in sorted(self._live):
+            req = self._slots[s]
+            if req is None or not getattr(req, "_handoff_export", False):
+                continue
+            if req.next_token is None or self._prefilling(req):
+                continue  # prefill (or its seeding fetch) still pending
+            self._export_handoff(s, req)
+        # mirror immediately: if that was the last live slot the engine
+        # idles, and no later on_step would carry the export deltas
+        # (counters + duration) onto /metrics
+        m = self.metrics
+        if m is not None:
+            m.on_handoff(self)
+
+    def _export_handoff(self, s, req):
+        """Ship slot `s`'s KV pages out as a KVHandoff and finish the
+        request here with state "handoff" (the decode replica owns the
+        rest of its life). The gather/fence/quantize runs on the tier's
+        copy thread (`HostTier.export_pages`) — same explicit-fence
+        discipline as a spill, nothing syncs the pump thread's device
+        queue beyond the blocking wait itself. On ANY failure the slot
+        is left exactly as it was and the request simply keeps decoding
+        locally — degradation, never a drop."""
+        t0 = time.perf_counter()
+        self._clear_handoff_flag(req)
+        n_pg = len(self._seq_pages[s])
+        # fixed-width gather like _preempt_one: tail reads trash page,
+        # sliced off host-side — one XLA gather shape for all exports
+        pg = np.full((self.pages_per_seq,), self.num_pages - 1, np.int32)
+        pg[:n_pg] = self._seq_pages[s]
+        try:
+            p = self.host_tier.export_pages(
+                self.k_pool[:, :, pg], self.v_pool[:, :, pg],
+                None if self.k_scale is None else self.k_scale[:, :, pg],
+                None if self.v_scale is None else self.v_scale[:, :, pg],
+                prequantized=self.cache_quant, rids=[str(req.rid)])
+        except Exception as e:
+            self.handoff_failures += 1
+            _flight.record("handoff.fail", rid=str(req.rid),
+                           trace_id=getattr(req, "_trace_id", None),
+                           where="export", error=repr(e))
+            return  # slot untouched -> local decode from here on
+        h = KVHandoff(
+            rid=req.rid, prompt=req.prompt, output=req.output,
+            next_token=int(req.next_token), length=int(self.lengths[s]),
+            pages=n_pg,
+            k=p["k"][:, :, :n_pg], v=p["v"][:, :, :n_pg],
+            ks=None if p["ks"] is None else p["ks"][:, :, :n_pg],
+            vs=None if p["vs"] is None else p["vs"][:, :, :n_pg],
+            quantized=p["ks"] is not None,
+            trace_id=getattr(req, "_trace_id", None),
+            logprobs=req.logprobs, cached_tokens=req.cached_tokens)
+        req._handoff_done = h
+        self.handoff_exports += 1
+        self.handoff_bytes += h.nbytes
+        self._handoff_times.append(time.perf_counter() - t0)
+        _flight.record("handoff.export", rid=str(req.rid),
+                       trace_id=h.trace_id, pages=n_pg, bytes=h.nbytes,
+                       tokens=h.length)
+        # finish WITHOUT _note_finish: the decode replica completes the
+        # request; this replica's ledger records it as a handoff.
+        self.finished.append(req)
+        self._release(s)  # indexes the prefix first -> source keeps cache
+        req.slot = None
+
+    def _import_handoff(self, slot, req):
+        """Decode-side scatter of a KVHandoff into fresh pages (the
+        preemption swap-in path, `_scatter_host_kv`), adapting the wire
+        encoding to this pool's dtype host-side. Returns True on
+        success; on ANY failure the fresh pages are returned to the
+        pool (crash_reset-grade release discipline) and the caller
+        falls back to the recompute-resume prefill path — token-
+        identical replay, never a dropped request."""
+        h = req._kv_import
+        t0 = time.perf_counter()
+        self._seq_pages[slot] = []
+        try:
+            # fault point BEFORE the alloc: a raise here leaks nothing
+            self._fire("handoff_import", rids=[str(req.rid)])
+            pages = self._alloc_pages(slot, h.pages)
+            try:
+                k, v, ks, vs = h.k, h.v, h.ks, h.vs
+                if ks is not None and not self.cache_quant:
+                    k, v = _dequantize_host(k, ks), _dequantize_host(v, vs)
+                    ks = vs = None
+                elif ks is None and self.cache_quant:
+                    k, ks = _quantize_host(k)
+                    v, vs = _quantize_host(v)
+                self._scatter_host_kv(pages, k, v, ks, vs)
+            except BaseException:
+                self.pool.decref(pages)
+                self._seq_pages[slot] = []
+                self.page_table[slot, :] = self.num_pages - 1
+                raise
+        except Exception as e:
+            self.handoff_failures += 1
+            _flight.record("handoff.fail", rid=str(req.rid),
+                           trace_id=h.trace_id, where="import",
+                           error=repr(e))
+            req._kv_import = None
+            req._resume = True  # recompute path: prompt + output[:-1]
+            return False
+        self.lengths[slot] = h.length
+        req._kv_import = None
+        req._resume = False
+        req.slot = slot
+        req._admit_order = self._order
+        self._order += 1
+        self._attach(slot, req)
+        self._stage_tokbuf(slot, req)
+        self._index_slot(slot, req)
+        self.handoff_imports += 1
+        self.handoff_bytes += h.nbytes
+        self._handoff_times.append(time.perf_counter() - t0)
+        _flight.record("handoff.import", rid=str(req.rid),
+                       trace_id=h.trace_id, pages=h.pages, bytes=h.nbytes,
+                       tokens=h.length)
+        return True
 
     # -- decode loop ------------------------------------------------------
     def step(self):
@@ -1834,6 +2102,7 @@ class ServingEngine:
         pump calls `step_launch`/`step_finish` itself so the consume of
         step N overlaps the device executing step N+1."""
         self._sweep_cancelled()
+        self._harvest_handoffs()
         self._admit()
         if self.spec_decode > 1:
             return self._spec_step()
@@ -1873,6 +2142,7 @@ class ServingEngine:
             return self._ragged_launch(carry=carry, _admitted=_admitted)
         if not _admitted:
             self._sweep_cancelled()
+            self._harvest_handoffs()
             self._admit()
         # page-growth pass with preemption, over OCCUPIED slots only: a
         # slot about to cross a page boundary must get a page; when the
@@ -2024,6 +2294,7 @@ class ServingEngine:
         seeding convention, so outputs stay token-identical."""
         if not _admitted:
             self._sweep_cancelled()
+            self._harvest_handoffs()
             self._admit()
         # decode-boundary page growth, bucketed logic verbatim (mid-
         # prefill slots grow against their own chunk below)
@@ -2112,11 +2383,16 @@ class ServingEngine:
         for s, req, carried, left in decode_plan:
             tok_slot[row] = s
             tok_pos[row] = int(self.lengths[s])
-            if carried:
-                carry_mask[row] = True
-                carry_gather[row] = carry.flat[s]
-            else:
-                tokens[row] = req.next_token
+            if self.tok_buf is None:
+                if carried:
+                    carry_mask[row] = True
+                    carry_gather[row] = carry.flat[s]
+                else:
+                    tokens[row] = req.next_token
+            # tokbuf engines ship NO token values: the row's token is
+            # device-resident (staged at admission, scattered by the
+            # previous wave, or poked at seeding) — which also subsumes
+            # the pipelined carry gather
             temps[s] = req.temperature
             top_ks[s] = req.top_k
             top_ps[s] = req.top_p
@@ -2133,7 +2409,8 @@ class ServingEngine:
         for s, req, n in prefill_plan:
             feed, cur = req._pf_feed, req._pf_cursor
             base = int(self.lengths[s])
-            tokens[row:row + n] = feed[cur:cur + n]
+            if self.tok_buf is None:
+                tokens[row:row + n] = feed[cur:cur + n]
             tok_slot[row:row + n] = s
             tok_pos[row:row + n] = base + np.arange(n, dtype=np.int32)
             req._pf_cursor += n
@@ -2181,19 +2458,41 @@ class ServingEngine:
                         [str(p[1].rid) for p in prefill_plan])
         self._note_launch_gap(1 if carry is not None else 0)
         with record_span("serving.unified_step"):
-            (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
-             logits, rec) = unified_step(
-                self.params, self.k_pool, self.v_pool,
-                jnp.asarray(self.page_table.copy()),
-                jnp.asarray(tokens), jnp.asarray(tok_slot),
-                jnp.asarray(tok_pos), self.config, self.page_size,
-                use_pallas=self._use_pallas, interpret=self._interpret,
-                k_scale=self.k_scale, v_scale=self.v_scale,
-                sample=sample, carry_tok=c_tok,
-                carry_gather=jnp.asarray(carry_gather),
-                carry_mask=jnp.asarray(carry_mask),
-                need_rows=need_rows, block_q=self._block_q,
-                block_pages=self._block_pages)
+            if self.tok_buf is not None:
+                # device token ring: no carry operands (the ring's
+                # in-jit scatter/gather IS the carry) — decode rows
+                # write their sampled token for the next wave to read
+                bw = np.zeros((self.need_buf if self.lean else T,),
+                              bool)
+                bw[:n_decode] = True
+                (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                 logits, rec, self.tok_buf) = unified_step(
+                    self.params, self.k_pool, self.v_pool,
+                    jnp.asarray(self.page_table.copy()),
+                    jnp.asarray(tokens), jnp.asarray(tok_slot),
+                    jnp.asarray(tok_pos), self.config, self.page_size,
+                    use_pallas=self._use_pallas,
+                    interpret=self._interpret,
+                    k_scale=self.k_scale, v_scale=self.v_scale,
+                    sample=sample, need_rows=need_rows,
+                    block_q=self._block_q,
+                    block_pages=self._block_pages,
+                    tok_buf=self.tok_buf, buf_write=jnp.asarray(bw))
+            else:
+                (self.k_pool, self.v_pool, self.k_scale, self.v_scale,
+                 logits, rec) = unified_step(
+                    self.params, self.k_pool, self.v_pool,
+                    jnp.asarray(self.page_table.copy()),
+                    jnp.asarray(tokens), jnp.asarray(tok_slot),
+                    jnp.asarray(tok_pos), self.config, self.page_size,
+                    use_pallas=self._use_pallas,
+                    interpret=self._interpret,
+                    k_scale=self.k_scale, v_scale=self.v_scale,
+                    sample=sample, carry_tok=c_tok,
+                    carry_gather=jnp.asarray(carry_gather),
+                    carry_mask=jnp.asarray(carry_mask),
+                    need_rows=need_rows, block_q=self._block_q,
+                    block_pages=self._block_pages)
         if not seeds:
             seed_rows = None
         elif need_rows is not None:
@@ -2579,6 +2878,7 @@ class ServingEngine:
     def _release(self, slot):
         req = self._slots[slot]
         if req is not None:
+            self._clear_handoff_flag(req)
             # a finished/cancelled/preempted slot's KV is valid up to
             # `lengths` — index its full pages so later admissions
             # sharing the prefix skip their prefill

@@ -15,6 +15,11 @@ Layers (docs/serving.md has the architecture):
                   (int8-quantized, async copies off the pump thread),
                   lookups fall through device -> host, and the
                   preemption offload stash shares the bytes ledger.
+  * `handoff`   — KV-page handoff payloads (`KVHandoff`) for
+                  disaggregated prefill/decode serving: a prefill-role
+                  replica exports a prefilled request's pages, the
+                  router migrates it to a decode-role replica
+                  (plain numpy + ints, transport-agnostic).
   * `faults`    — deterministic fault injection: a seeded `FaultPlan`
                   (PT_FAULTS / constructor) armed at the stack's real
                   failure sites, so chaos drills replay byte-for-byte
@@ -40,11 +45,12 @@ the engine arrives as a constructor argument — so
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    client, faults, kvcache, kvtier, metrics, replica, router, scheduler,
-    server,
+    client, faults, handoff, kvcache, kvtier, metrics, replica, router,
+    scheduler, server,
 )
 from .client import ServingClient, ServingHTTPError  # noqa: F401
 from .faults import FaultPlan, InjectedFault  # noqa: F401
+from .handoff import KVHandoff  # noqa: F401
 from .kvcache import PagePool, PrefixCache  # noqa: F401
 from .kvtier import HostTier  # noqa: F401
 from .metrics import (  # noqa: F401
@@ -62,10 +68,10 @@ from .scheduler import (  # noqa: F401
 from .server import ServingServer  # noqa: F401
 
 __all__ = [
-    "client", "faults", "kvcache", "kvtier", "metrics", "replica",
-    "router", "scheduler", "server",
+    "client", "faults", "handoff", "kvcache", "kvtier", "metrics",
+    "replica", "router", "scheduler", "server",
     "ServingClient", "ServingHTTPError",
-    "FaultPlan", "InjectedFault",
+    "FaultPlan", "InjectedFault", "KVHandoff",
     "PagePool", "PrefixCache", "HostTier",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "EngineMetrics",
     "Replica", "ReplicaKilledError", "build_replicas",

@@ -20,6 +20,10 @@ input would actually surface:
   tier_restore        the tier's host->device restore scatter
                       (`ServingEngine._tier_restore`)
   router_dispatch     `Router.submit`, before replica selection
+  handoff_export      the disaggregated-serving KV page export
+                      (`HostTier.export_pages`, on the copy thread)
+  handoff_import      the decode replica's KV handoff import scatter
+                      (`ServingEngine._import_handoff`, before alloc)
   ==================  ====================================================
 
 Each rule arms one point with an action — ``raise`` (an
@@ -57,7 +61,8 @@ from ..observability import flight_recorder as _flight
 __all__ = ["FaultPlan", "InjectedFault", "POINTS", "ACTIONS"]
 
 POINTS = ("step_launch", "step_finish", "suffix_prefill", "tier_spill",
-          "tier_restore", "router_dispatch")
+          "tier_restore", "router_dispatch", "handoff_export",
+          "handoff_import")
 ACTIONS = ("raise", "delay", "corrupt")
 
 

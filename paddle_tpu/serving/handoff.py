@@ -1,0 +1,74 @@
+"""KV-page handoff payloads for disaggregated prefill/decode serving.
+
+A prefill-role replica finishes a request's prompt (and seeds its first
+token), then exports the request's KV pages as a `KVHandoff` — plain
+numpy bytes + metadata, produced by the kvtier copy thread's explicit
+device->host fence (`HostTier.export_pages`). The router hands the
+payload to a decode-role replica, whose scheduler re-submits the
+request with ``kv_import=payload``; the engine scatters the pages back
+through the preemption swap-in path (`_scatter_host_kv`) and
+generation continues token-identically — the device sampler's PRNG is
+a pure function of (seed, position), so the trajectory survives the
+migration bit-exactly.
+
+The payload is deliberately transport-agnostic: arrays and ints only,
+no engine or jax object references, so the in-process handoff the
+Router performs today can be backed by the rpc/collective layer for
+multi-host pools without changing either engine's import/export code.
+
+Page encoding follows the exporting tier's setting: ``quantized=True``
+payloads carry int8 pages + per-token fp32 scales (the kvtier wire
+format — lossless over an int8 pool, ~4x smaller over an fp pool);
+``quantized=False`` carries the pool dtype verbatim. The importer
+dequantizes (or re-quantizes) host-side to match its own pool.
+
+Pure stdlib + numpy — importable from tests, benches and ops tooling
+without pulling in jax or model code.
+"""
+from __future__ import annotations
+
+__all__ = ["KVHandoff"]
+
+
+class KVHandoff:
+    """One request's exported KV state, mid-generation.
+
+    k/v: (L, KVH, pages, page_size, D) numpy; ks/vs: matching
+    (..., 1) fp32 per-token scales or None. `length` is the cache
+    length the pages are valid to (== len(prompt) + len(output) - 1:
+    everything decided except the pending `next_token`, which rides as
+    metadata exactly like a preemption resume)."""
+
+    __slots__ = ("rid", "trace_id", "prompt", "output", "next_token",
+                 "length", "pages", "k", "v", "ks", "vs", "quantized",
+                 "logprobs", "cached_tokens")
+
+    def __init__(self, rid, prompt, output, next_token, length, pages,
+                 k, v, ks=None, vs=None, quantized=False, trace_id=None,
+                 logprobs=None, cached_tokens=0):
+        self.rid = rid
+        self.trace_id = trace_id
+        self.prompt = list(prompt)
+        self.output = list(output)
+        self.next_token = int(next_token)
+        self.length = int(length)
+        self.pages = int(pages)
+        self.k = k
+        self.v = v
+        self.ks = ks
+        self.vs = vs
+        self.quantized = bool(quantized)
+        self.logprobs = None if logprobs is None else list(logprobs)
+        self.cached_tokens = int(cached_tokens)
+
+    @property
+    def nbytes(self):
+        """Wire size of the KV payload (metadata excluded) — what a
+        multi-host backing would actually ship."""
+        return sum(a.nbytes for a in (self.k, self.v, self.ks, self.vs)
+                   if a is not None)
+
+    def __repr__(self):
+        return (f"KVHandoff(rid={self.rid!r}, length={self.length}, "
+                f"pages={self.pages}, quantized={self.quantized}, "
+                f"nbytes={self.nbytes})")

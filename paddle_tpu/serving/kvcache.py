@@ -263,3 +263,20 @@ class PagePool:
                 "cached": self.cache.cached_pages
                 if self.cache is not None else 0,
                 "live": int((self.refcount > 0).sum())}
+
+    def conserved(self, drained=False):
+        """True when every page is accounted for (free + cached + live
+        == num_pages). With `drained=True` additionally no page may
+        still be live — after a full drain a lingering refcount is a
+        leak (it satisfies conservation but is never reclaimable).
+
+        The invariant is strictly PER POOL: a disaggregated KV handoff
+        (serving/handoff.py) copies page bytes out and releases them
+        here, then the importing engine allocates from its OWN pool —
+        pages never migrate between ledgers, so both sides must stay
+        conserved through every export/import/failure path."""
+        c = self.counts()
+        ok = c["free"] + c["cached"] + c["live"] == self.num_pages
+        if drained:
+            ok = ok and c["live"] == 0
+        return ok

@@ -55,6 +55,30 @@ from . import kvcache as _kvc
 __all__ = ["HostTier"]
 
 
+class _ExportJob:
+    """One disaggregated-serving KV export riding the tier's copy
+    thread: the caller (engine pump) blocks on `done` while the
+    explicit device->host fence runs on the worker — same thread
+    discipline as a spill, but the result (and any failure) belongs to
+    the WAITING caller, not the copy-error rollup: a failed export
+    must degrade that one request to local decode, not silently count
+    as a dropped page."""
+
+    __slots__ = ("k", "v", "ks", "vs", "prequantized", "rids",
+                 "payload", "error", "done")
+
+    def __init__(self, k, v, ks, vs, prequantized, rids):
+        self.k = k
+        self.v = v
+        self.ks = ks
+        self.vs = vs
+        self.prequantized = prequantized
+        self.rids = rids
+        self.payload = None
+        self.error = None
+        self.done = threading.Event()
+
+
 def _quantize_host(x):
     """Host-side mirror of `ops.paged_attention.quantize_kv` (absmax/127
     per-token over the head dim, floored scale): np.round is
@@ -148,6 +172,18 @@ class HostTier:
         # copy thread would silently turn the tier off
         while True:
             item = self._q.get()
+            if isinstance(item, _ExportJob):
+                # handoff export: errors propagate to the blocked
+                # caller (who degrades to local decode); the page-loss
+                # accounting above does not apply
+                try:
+                    item.payload = self._export(item)
+                except BaseException as e:  # noqa: BLE001 — caller's to raise
+                    item.error = e
+                finally:
+                    item.done.set()
+                    self._q.task_done()
+                continue
             try:
                 self._land(*item)
             except Exception as e:  # noqa: BLE001 — a failed spill is a miss
@@ -190,6 +226,53 @@ class HostTier:
             held, pages = self._bytes, len(self._entries)
         _flight.record("kvtier.spill", depth=int(depth), bytes=nb,
                        tier_bytes=held, tier_pages=pages)
+
+    # -- disaggregated handoff export (pump thread waits; worker
+    # thread fences) ---------------------------------------------------
+    def export_pages(self, k, v, ks=None, vs=None, prequantized=False,
+                     rids=None, timeout=30.0):
+        """Fence a request's KV page slices to host for a prefill ->
+        decode handoff (docs/serving.md § Disaggregated prefill/
+        decode). `k`/`v` are functional device slices
+        (L, KVH, pages, page, D) — valid snapshots however the pools
+        are rewritten afterwards; the blocking np.asarray fence runs on
+        the tier's copy thread, exactly like a spill. Encoding follows
+        `self.quantize` (int8 + per-token scales unless the pool was
+        already int8 — `prequantized=True` ships it verbatim).
+
+        Synchronous from the caller's view: returns the host payload
+        dict {k, v, ks, vs}, or raises whatever the copy path raised
+        (including an armed `handoff_export` fault) — the engine
+        degrades that request to local decode and releases nothing it
+        did not build."""
+        if self._worker is None:
+            self._start_worker()
+        job = _ExportJob(k, v, ks, vs, prequantized, rids)
+        self._q.put(job)
+        if not job.done.wait(timeout):
+            raise TimeoutError(
+                f"kvtier: handoff export did not land within {timeout}s")
+        if job.error is not None:
+            raise job.error
+        return job.payload
+
+    def _export(self, job):
+        """Worker half of `export_pages`: explicit fence + encode.
+        Nothing is indexed or ledgered — the payload belongs to the
+        destination replica, not this tier."""
+        k = np.asarray(job.k)
+        if self.faults is not None:
+            # chaos drills for the export path: raise -> the engine
+            # keeps the request for local decode; corrupt -> a byte
+            # flip lands in the shipped payload
+            k = self.faults.fire("handoff_export", k, rids=job.rids)
+        v = np.asarray(job.v)
+        ks = None if job.ks is None else np.asarray(job.ks, np.float32)
+        vs = None if job.vs is None else np.asarray(job.vs, np.float32)
+        if self.quantize and not job.prequantized:
+            k, ks = _quantize_host(k)
+            v, vs = _quantize_host(v)
+        return {"k": k, "v": v, "ks": ks, "vs": vs}
 
     def _shrink_locked(self):
         """Drop spilled entries until the ledger fits `tier_bytes` —

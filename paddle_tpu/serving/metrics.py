@@ -378,6 +378,34 @@ class EngineMetrics:
             "pt_tier_pages", "KV pages resident in the host tier.")
         self._tier_seen = {"spills": 0, "hits": 0, "restores": 0,
                            "drops": 0, "copy_errors": 0}
+        # disaggregated prefill/decode (docs/serving.md § Disaggregated
+        # prefill/decode): KV handoff traffic between role-specialized
+        # replicas. Mirrored from engine ints via on_step deltas like
+        # the tier counters (export runs on the pump thread, import on
+        # the destination's pump — each replica's registry is private,
+        # so every series stays single-writer); the router's /metrics
+        # relabelling exposes them per replica for free.
+        self.handoff_exports = r.counter(
+            "pt_handoff_exports",
+            "Requests whose KV pages were exported for a "
+            "prefill->decode handoff.")
+        self.handoff_imports = r.counter(
+            "pt_handoff_imports",
+            "Requests continued from an imported KV handoff payload.")
+        self.handoff_bytes = r.counter(
+            "pt_handoff_bytes",
+            "KV payload bytes moved by handoffs (counted on both the "
+            "export and import side).")
+        self.handoff_failures = r.counter(
+            "pt_handoff_failures",
+            "Handoff exports/imports that failed and degraded to "
+            "local decode / recompute-resume.")
+        self.handoff_seconds = r.histogram(
+            "pt_handoff_seconds",
+            "Wall time of one handoff export or import (fence + "
+            "encode/scatter, per side).")
+        self._handoff_seen = {"handoff_exports": 0, "handoff_imports": 0,
+                              "handoff_bytes": 0, "handoff_failures": 0}
         # crash recovery (serving/faults.py + scheduler warm restart):
         # restart cadence, requeue volume, and poison quarantines —
         # the numbers docs/reliability.md's runbook reads
@@ -407,6 +435,30 @@ class EngineMetrics:
             self.queue_depth.set(depth)
             self.queue_depth_peak.set_to_max(depth)
 
+    def on_handoff(self, engine):
+        """Mirror the engine's handoff counters. Runs inside on_step
+        AND directly from the harvest/import sites: a prefill replica
+        can go idle the moment its last request migrates away, with no
+        further step to carry the delta onto /metrics."""
+        seen = self._handoff_seen
+        for attr, counter in (("handoff_exports", self.handoff_exports),
+                              ("handoff_imports", self.handoff_imports),
+                              ("handoff_bytes", self.handoff_bytes),
+                              ("handoff_failures",
+                               self.handoff_failures)):
+            cur = getattr(engine, attr, 0)
+            delta = cur - seen[attr]
+            if delta > 0:
+                counter.inc(delta)
+                seen[attr] = cur
+        # per-handoff durations drain on the pump thread (the same
+        # thread that appends them), so a plain list is race-free
+        times = getattr(engine, "_handoff_times", None)
+        if times:
+            for dt in times:
+                self.handoff_seconds.observe(dt)
+            del times[:]
+
     def on_step(self, engine, n_active):
         self.steps.inc()
         self.batch_occupancy.set(n_active / max(engine.max_seqs, 1))
@@ -425,6 +477,7 @@ class EngineMetrics:
             if delta > 0:
                 counter.inc(delta)
                 seen[attr] = cur
+        self.on_handoff(engine)
         pc = getattr(engine, "prefix_cache", None)
         if pc is not None:
             self.prefix_cached_pages.set(pc.cached_pages)

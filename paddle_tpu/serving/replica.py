@@ -47,12 +47,27 @@ class Replica:
     flight-recorder events. Extra keyword arguments (`poison_after`,
     `max_restarts`, `restart_window_s`, ...) pass through to the
     scheduler — per-replica recovery thresholds for chaos drills.
+
+    `role` specializes the replica for disaggregated serving
+    (docs/serving.md § Disaggregated prefill/decode): "prefill"
+    replicas take new requests and hand their KV off once the prompt
+    is prefilled; "decode" replicas only continue imported requests;
+    "both" — the default — serves end-to-end exactly as before (no
+    handoff machinery runs, zero cost). The role is advisory identity
+    the ROUTER enforces at dispatch; the engine itself stays
+    role-agnostic.
     """
+
+    ROLES = ("prefill", "decode", "both")
 
     def __init__(self, replica_id, engine, *, max_queue=64,
                  metrics=None, idle_poll_s=0.02, pipeline=None,
-                 **sched_kw):
+                 role="both", **sched_kw):
         self.replica_id = str(replica_id)
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role={role!r}: want one of {self.ROLES}")
+        self.role = role
         self.engine = engine
         registry = metrics if metrics is not None else MetricsRegistry()
         self.scheduler = RequestScheduler(engine, max_queue=max_queue,
@@ -79,8 +94,17 @@ class Replica:
     def stats(self):
         st = self.scheduler.stats()
         st["replica_id"] = self.replica_id
+        st["role"] = self.role
         st["ready"] = self.ready()
         return st
+
+    def prefill_eligible(self):
+        """May take NEW requests (fresh prompts to prefill)."""
+        return self.role in ("prefill", "both")
+
+    def decode_eligible(self):
+        """May continue an imported (or locally prefilled) decode."""
+        return self.role in ("decode", "both")
 
     def load(self):
         """Queued + in-flight requests — the least-loaded spill order
@@ -149,12 +173,17 @@ class Replica:
 
 
 def build_replicas(engine_factory, n, *, max_queue=64, prefix="r",
-                   idle_poll_s=0.02, pipeline=None, **sched_kw):
+                   idle_poll_s=0.02, pipeline=None, roles=None,
+                   **sched_kw):
     """N independent replicas from an engine factory. The factory is
     called once per replica — each gets its own params reference but
     its own KV pool, prefix cache, scheduler, and metrics registry
-    (`engine_factory(i) -> ServingEngine`)."""
+    (`engine_factory(i) -> ServingEngine`). `roles` is an optional
+    per-replica role list (short lists pad with "both") for a
+    disaggregated prefill/decode topology."""
+    roles = list(roles or [])
+    roles += ["both"] * (int(n) - len(roles))
     return [Replica(f"{prefix}{i}", engine_factory(i),
                     max_queue=max_queue, idle_poll_s=idle_poll_s,
-                    pipeline=pipeline, **sched_kw)
+                    pipeline=pipeline, role=roles[i], **sched_kw)
             for i in range(int(n))]

@@ -31,7 +31,19 @@ any number of frontend threads:
     deterministic given the request parameters.
   * **Graceful drain.** `drain_replica()` flips readiness off (ring
     exit + scheduler close), lets running work finish, then removes
-    the replica — the rolling-restart primitive.
+    the replica — the rolling-restart primitive. Draining is refused
+    when it would leave a non-empty pool with no prefill-eligible
+    replica (queued requests would strand behind decode-only
+    replicas); draining the very last replica stays allowed.
+  * **Disaggregated prefill/decode.** Replicas carry a `role`
+    (`replica.py`): only prefill-eligible ones ("prefill"/"both") own
+    ring points and take new requests. A dispatch to a pure prefill
+    replica arms `kv_export` whenever a decode-eligible replica is in
+    rotation; when the request terminates in state "handoff" the
+    consumer-side handle migrates it — `_migrate` re-submits the
+    KVHandoff payload to the least-loaded decode replica ("decode"
+    first, then "both", then the source itself as the never-dropped
+    fallback) and the handle swaps underneath the caller invisibly.
 
 Everything is host-side stdlib; the router never touches an engine
 directly (TPL004: no engine/device calls under the router lock — the
@@ -202,18 +214,35 @@ class RouterRequest:
         self._reported = False
         self.failovers += 1
 
+    def _continue_handoff(self):
+        """The current replica finished its PREFILL half (terminal
+        state "handoff", KVHandoff payload attached): migrate the
+        request to a decode replica and swap the underlying handle.
+        The decode submit presets the already-published output, so
+        streaming resumes exactly where the prefill replica stopped."""
+        self._report()               # handoff == success for health
+        rid, sr = self._router._migrate(self)
+        self._tried.append(rid)
+        self.replica_id = rid
+        self._sr = sr
+        self._reported = False
+
     # -- consumption ---------------------------------------------------
     def stream(self, timeout=None):
         """Yield token chunks; a pre-first-token replica death is
-        retried on another replica invisibly. Once a chunk has been
-        yielded the stream is never replayed (the caller already has
-        tokens) — a later failure raises."""
+        retried on another replica invisibly, and a prefill->decode
+        handoff continues on the decode replica mid-stream. Once a
+        chunk has been yielded the stream is never replayed (the
+        caller already has tokens) — a later failure raises."""
         sent = 0
         while True:
             try:
                 for chunk in self._sr.stream(timeout=timeout):
                     sent += 1
                     yield chunk
+                if self._sr.state == "handoff":
+                    self._continue_handoff()
+                    continue
                 self._report()
                 return
             except Exception as e:  # noqa: BLE001 — terminal-state errors
@@ -231,8 +260,6 @@ class RouterRequest:
                 else max(deadline - time.monotonic(), 0.001)
             try:
                 out = self._sr.result(timeout=left)
-                self._report()
-                return out
             except TimeoutError:
                 raise
             except Exception as e:  # noqa: BLE001 — terminal-state errors
@@ -241,6 +268,11 @@ class RouterRequest:
                     continue
                 self._report()
                 raise
+            if self._sr.state == "handoff":
+                self._continue_handoff()
+                continue
+            self._report()
+            return out
 
 
 class Router:
@@ -296,6 +328,9 @@ class Router:
         self.unhealthy_transitions = r.counter(
             "pt_router_unhealthy_transitions",
             "Circuit-breaker ok->open transitions.")
+        self.handoffs = r.counter(
+            "pt_router_handoffs",
+            "Requests migrated prefill->decode after a KV export.")
         self.replicas_gauge = r.gauge(
             "pt_router_replicas", "Registered replicas.")
         self.ready_gauge = r.gauge(
@@ -306,7 +341,9 @@ class Router:
     # -- pool membership ----------------------------------------------
     def add_replica(self, replica):
         """Register a replica and give it ring ownership (rolling
-        restarts re-add here after drain_replica removed)."""
+        restarts re-add here after drain_replica removed). Decode-only
+        replicas never own ring points — new prompts can't start
+        there; they receive work only via `_migrate`."""
         rid = replica.replica_id
         ps = int(replica.page_size)
         with self._lock:
@@ -320,7 +357,8 @@ class Router:
                     f"{self.page_size} — affinity keys would diverge "
                     "from the replicas' prefix caches")
             self._replicas[rid] = _ReplicaState(replica)
-            self._ring.add(rid)
+            if replica.prefill_eligible():
+                self._ring.add(rid)
             self.replicas_gauge.set(len(self._replicas))
 
     def replica(self, rid):
@@ -345,11 +383,27 @@ class Router:
         """Rolling-restart primitive: take `rid` out of rotation
         (readiness flips false immediately), let in-flight and queued
         work finish, then drop it from the pool. Returns True when the
-        replica's pump exited within `timeout`."""
+        replica's pump exited within `timeout`.
+
+        Refused (ValueError) when the replicas left behind form a
+        NON-EMPTY pool with no prefill-eligible member — new requests
+        would strand behind decode-only replicas that can never start
+        them. Draining the very last replica stays allowed: an empty
+        pool rejects crisply with SchedulerClosedError instead of
+        silently queueing."""
         with self._lock:
             st = self._replicas.get(rid)
             if st is None:
                 raise KeyError(f"router: no replica {rid!r}")
+            rest = [s for r, s in self._replicas.items()
+                    if r != rid and s.state != "draining"]
+            if rest and not any(s.replica.prefill_eligible()
+                                for s in rest):
+                raise ValueError(
+                    f"router: draining {rid!r} would leave no "
+                    "prefill-eligible replica in rotation — new "
+                    "requests would strand; drain a decode replica "
+                    "first or add a 'prefill'/'both' replica")
             st.state = "draining"
             self._ring.remove(rid)
         _flight.record("router.drain", replica=rid)
@@ -382,7 +436,13 @@ class Router:
             if st is None:           # removed between plan and dispatch
                 continue
             try:
-                sr = st.replica.submit(prompt_ids, rid=rid, **kw)
+                # kv_export is decided PER DISPATCH (never stored in
+                # the replay params): a failover or topology change
+                # must re-decide against the replica that actually
+                # takes the request
+                sr = st.replica.submit(
+                    prompt_ids, rid=rid,
+                    kv_export=self._kv_export_for(target), **kw)
             except BackpressureError as e:
                 last_err = e
                 continue
@@ -411,11 +471,15 @@ class Router:
                 primary = self._ring.lookup(key)
             else:
                 rids = [i for i, st in self._replicas.items()
-                        if st.state != "draining"]
+                        if st.state != "draining"
+                        and st.replica.prefill_eligible()]
                 primary = rids[next(self._rr) % len(rids)] if rids \
                     else None
+            # decode-only replicas never take NEW requests — they are
+            # fed exclusively through _migrate (KV handoff import)
             cands = [(i, st.replica, self._eligibility_locked(st, now))
-                     for i, st in self._replicas.items()]
+                     for i, st in self._replicas.items()
+                     if st.replica.prefill_eligible()]
         plan = []
         spill = []
         for i, rep, elig in cands:
@@ -472,6 +536,64 @@ class Router:
                        trace_id=sr.trace_id, replica=rid, route=kind,
                        prefix_blocks=n_blocks)
 
+    # -- disaggregated prefill/decode ---------------------------------
+    def _kv_export_for(self, rid):
+        """True when a dispatch to `rid` should arm KV handoff: the
+        target is a PURE prefill replica and a decode-eligible replica
+        is in rotation somewhere to receive the pages. "both" targets
+        never export — they decode locally (today's behavior,
+        handoff machinery stays cold)."""
+        with self._lock:
+            st = self._replicas.get(rid)
+            if st is None or st.replica.role != "prefill":
+                return False
+            return any(o.state != "draining"
+                       and o.replica.decode_eligible()
+                       for r2, o in self._replicas.items() if r2 != rid)
+
+    def _migrate(self, rr: RouterRequest):
+        """Continue a handoff-terminal request on a decode replica.
+        Target order: pure "decode" replicas by ascending load, then
+        "both" replicas by ascending load, then the SOURCE replica
+        itself — it just released the pages, so re-importing there is
+        the never-dropped fallback (the request decodes locally, just
+        without the disaggregation win). Returns (rid, ServingRequest);
+        raises the last admission error only when every candidate
+        including the source refused."""
+        h = rr._sr.handoff
+        src = rr.replica_id
+        with self._lock:
+            items = [(r, st.replica) for r, st in self._replicas.items()
+                     if r != src and st.state != "draining"
+                     and st.replica.decode_eligible()]
+        # load() hops each replica's scheduler lock — outside ours
+        ranked = sorted(((rep.role != "decode", rep.load(), r, rep)
+                         for r, rep in items), key=lambda t: t[:2])
+        cands = [(r, rep) for _, _, r, rep in ranked]
+        src_rep = self.replica(src)
+        if src_rep is not None:
+            cands.append((src, src_rep))
+        last_err = None
+        for target, rep in cands:
+            try:
+                sr = rep.submit(rr._prompt, kv_import=h, **rr._params)
+            except (BackpressureError, SchedulerClosedError) as e:
+                last_err = e
+                continue
+            with self._lock:
+                st = self._replicas.get(target)
+                if st is not None:
+                    st.dispatches += 1
+            self.handoffs.inc()
+            _flight.record("router.handoff", rid=str(sr.rid),
+                           trace_id=sr.trace_id, from_replica=src,
+                           to_replica=target, bytes=h.nbytes,
+                           pages=h.pages)
+            return target, sr
+        raise last_err if last_err is not None else \
+            SchedulerClosedError(
+                f"router: no replica could continue handoff {rr.rid}")
+
     # -- failover ------------------------------------------------------
     def _redispatch(self, rr: RouterRequest):
         """Re-dispatch a failed-before-output request to a replica it
@@ -490,7 +612,9 @@ class Router:
             if st is None:
                 continue
             try:
-                sr = st.replica.submit(rr._prompt, **rr._params)
+                sr = st.replica.submit(
+                    rr._prompt, kv_export=self._kv_export_for(target),
+                    **rr._params)
             except (BackpressureError, SchedulerClosedError):
                 continue
             with self._lock:
@@ -512,7 +636,9 @@ class Router:
             st = self._replicas.get(rid)
             if st is None:
                 return
-            if state == "done":
+            if state in ("done", "handoff"):
+                # a handoff is the prefill replica SUCCEEDING at its
+                # half of the request — it closes breakers like "done"
                 st.failures = 0
                 if st.state in ("open", "half_open"):
                     st.state = "ok"
@@ -549,6 +675,7 @@ class Router:
             active += s["active"]
             reps[rid] = {
                 "health": state, "ready": ready,
+                "role": s.get("role", "both"),
                 "consecutive_failures": failures,
                 "dispatches": dispatches, "failovers_in": fo,
                 "queued": s["queued"], "inflight": s["inflight"],
@@ -566,6 +693,7 @@ class Router:
                     "affinity_hits": self.affinity_hits.value,
                     "spills": self.spills.value,
                     "failovers": self.failovers.value,
+                    "handoffs": self.handoffs.value,
                     "unhealthy_transitions":
                         self.unhealthy_transitions.value,
                 }}

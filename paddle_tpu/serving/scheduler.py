@@ -98,7 +98,9 @@ class CrashLoopError(SchedulerClosedError):
 class ServingRequest:
     """Handle a submitter holds: stream tokens as they are emitted, or
     block for the full result. Terminal states: "done", "cancelled",
-    "expired", "failed"."""
+    "expired", "failed", "handoff" (prefill complete, KV exported — the
+    `handoff` attribute carries the KVHandoff payload and a decode
+    replica owns the rest of the request's life)."""
 
     def __init__(self, sched, req, priority, deadline, trace_id=None):
         self._sched = sched
@@ -131,6 +133,9 @@ class ServingRequest:
         self._crash_streak = 0
         self._requeues = 0
         self._proof_mark = 0
+        # disaggregated serving: the KVHandoff payload when this
+        # request terminates with state "handoff" (router migration)
+        self.handoff = None
         self._done = threading.Event()
 
     @property
@@ -218,7 +223,7 @@ class RequestScheduler:
         # simply moves back into `queued`)
         self._ledger = {"submitted": 0, "started": 0, "completed": 0,
                         "failed": 0, "cancelled": 0, "expired": 0,
-                        "requeued": 0}
+                        "requeued": 0, "handoff": 0}
         # crash recovery (docs/reliability.md). Quarantine: a request
         # admitted across `poison_after` consecutive crashed steps is
         # the attributed poison. Breaker: `max_restarts` restarts
@@ -257,10 +262,19 @@ class RequestScheduler:
     def submit(self, prompt_ids, *, rid=None, max_new_tokens=64,
                eos_id=None, temperature=0.0, top_k=0, top_p=1.0,
                seed=None, logprobs=False, priority="normal",
-               ttl_s=None, trace_id=None):
+               ttl_s=None, trace_id=None, kv_export=False,
+               kv_import=None):
         """Admit-or-refuse NOW: raises BackpressureError on a full
         queue, SchedulerClosedError during shutdown, ValueError for a
-        request the engine could never run. Returns a ServingRequest."""
+        request the engine could never run. Returns a ServingRequest.
+
+        Disaggregated serving (docs/serving.md § Disaggregated
+        prefill/decode): `kv_export=True` marks the request for KV
+        handoff — it terminates with state "handoff" (payload on
+        `sr.handoff`) once its prompt is prefilled and seeded;
+        `kv_import=<KVHandoff>` resumes an exported request here — its
+        generated-so-far output is pre-seeded and only NEW tokens
+        stream from this handle."""
         if priority not in PRIORITIES:
             raise ValueError(
                 f"priority={priority!r}: want one of {PRIORITIES}")
@@ -273,6 +287,17 @@ class RequestScheduler:
                       eos_id=eos_id, temperature=temperature,
                       top_k=top_k, top_p=top_p, seed=seed,
                       logprobs=logprobs)
+        if kv_import is not None:
+            # resume mid-generation: everything the prefill replica
+            # decided rides in; the pending next_token is output's tail
+            req.output = [int(t) for t in kv_import.output]
+            req.next_token = int(kv_import.next_token)
+            req.cached_tokens = int(kv_import.cached_tokens)
+            if logprobs and kv_import.logprobs is not None:
+                req.logprobs = list(kv_import.logprobs)
+            req._kv_import = kv_import
+        if kv_export:
+            req._handoff_export = True
         self._engine.validate(req)      # never-fits -> ValueError, now
         deadline = None if ttl_s is None else time.monotonic() + ttl_s
         with self._cond:
@@ -300,6 +325,10 @@ class RequestScheduler:
                     "retry later")
             sr = ServingRequest(self, req, priority, deadline,
                                 trace_id=trace_id)
+            if kv_import is not None:
+                # imported tokens were already streamed by the prefill
+                # replica's handle — this one emits only NEW tokens
+                sr._emitted = len(req.output)
             # stamp the engine-level request too: engine-side flight
             # records (kvcache.hit / kvtier.hit) carry the same trace
             # id as the scheduler's spans without importing anything
@@ -547,6 +576,12 @@ class RequestScheduler:
                     self._finalize(sr, "expired")
                 elif req.cancelled:
                     self._finalize(sr, "cancelled")
+                elif getattr(req, "_handoff_done", None) is not None:
+                    # prefill complete, KV exported: hand the payload
+                    # to whoever holds the handle (the router's
+                    # migration path re-submits it on a decode replica)
+                    sr.handoff = req._handoff_done
+                    self._finalize(sr, "handoff")
                 else:
                     self._finalize(sr, "done")
             self.metrics.set_queue_depth(self._queued_locked())
@@ -560,8 +595,8 @@ class RequestScheduler:
         self._suspects.discard(sr)
         self._unproven.discard(sr)
         self._ledger[{"done": "completed", "failed": "failed",
-                      "cancelled": "cancelled",
-                      "expired": "expired"}[state]] += 1
+                      "cancelled": "cancelled", "expired": "expired",
+                      "handoff": "handoff"}[state]] += 1
         if state == "failed":
             self.metrics.on_fail()
         if state == "expired":

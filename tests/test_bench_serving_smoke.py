@@ -30,6 +30,7 @@ def test_spec_bench_workload_engages_speculation(monkeypatch):
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
+    monkeypatch.delenv("PT_SERVE_DISAGG", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "ngram-repetitive"
     assert out["spec_accept_rate"] > 0, out
@@ -99,6 +100,7 @@ def test_prefix_bench_reuses_cached_pages(monkeypatch):
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
+    monkeypatch.delenv("PT_SERVE_DISAGG", raising=False)
     monkeypatch.setenv("PT_SERVE_PREFIX", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "shared-prefix"
@@ -121,6 +123,7 @@ def test_multiturn_bench_hits_the_host_tier(monkeypatch):
     monkeypatch.delenv("PT_SERVE_ROUTER", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
+    monkeypatch.delenv("PT_SERVE_DISAGG", raising=False)
     monkeypatch.setenv("PT_SERVE_MULTITURN", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "multi-turn"
@@ -143,6 +146,7 @@ def test_plain_bench_unaffected(monkeypatch):
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
+    monkeypatch.delenv("PT_SERVE_DISAGG", raising=False)
     out = bm.bench_serving(on_tpu=False)
     assert out["decode_tokens_per_sec"] > 0
     assert "spec_decode" not in out
@@ -163,6 +167,7 @@ def test_router_bench_snapshot(monkeypatch):
     monkeypatch.delenv("PT_SERVE_MULTITURN", raising=False)
     monkeypatch.delenv("PT_SERVE_PIPELINE", raising=False)
     monkeypatch.delenv("PT_SERVE_CHAOS", raising=False)
+    monkeypatch.delenv("PT_SERVE_DISAGG", raising=False)
     monkeypatch.setenv("PT_SERVE_ROUTER", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "router-shared-prefix"
@@ -265,6 +270,7 @@ def test_chaos_bench_recovers_token_identical(monkeypatch):
                 "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN",
                 "PT_SERVE_PIPELINE"):
         monkeypatch.delenv(env, raising=False)
+    monkeypatch.delenv("PT_SERVE_DISAGG", raising=False)
     monkeypatch.setenv("PT_SERVE_CHAOS", "1")
     out = bm.bench_serving(on_tpu=False)
     assert out["workload"] == "chaos-recovery"
@@ -278,4 +284,42 @@ def test_chaos_bench_recovers_token_identical(monkeypatch):
         assert d["goodput_retained"] == 1.0, (pump, d)
         assert d["ledger_balanced"] is True, (pump, d)
         assert d["tokens_per_sec"] > 0
+    assert out["baseline_tokens_per_sec"] > 0
+
+
+def test_disagg_bench_migrates_and_matches(monkeypatch):
+    """PT_SERVE_DISAGG=1 (ISSUE 13 acceptance): the 1 prefill + 1
+    decode topology must actually migrate every eligible request
+    (exports > 0, router handoffs counted), produce token-identical
+    outputs vs the 2x "both" baseline, degrade nothing
+    (handoff_failures == 0, ledgers balanced including the "handoff"
+    terminal state), and ship decode-TPOT percentiles for both
+    topologies so the capture chain can gate the tail on chip."""
+    bm = _load_bench_models()
+    for env in ("PT_SERVE_SPEC", "PT_SERVE_CACHE", "PT_SERVE_PREFIX",
+                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN",
+                "PT_SERVE_PIPELINE", "PT_SERVE_CHAOS"):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv("PT_SERVE_DISAGG", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "disagg-mixed"
+    assert out["outputs_match"] is True, out
+    assert out["handoff_exports"] > 0, out
+    assert out["handoff_imports"] == out["handoff_exports"], out
+    assert out["handoff_bytes"] > 0, out
+    assert out["handoff_failures"] == 0, out
+    assert out["router_handoffs"] == out["handoff_exports"], out
+    # prefill side closes its requests as "handoff", decode completes
+    led = out["ledgers"]
+    pre = next(v for k, v in led.items() if k.startswith("prefill:"))
+    dec = next(v for k, v in led.items() if k.startswith("decode:"))
+    assert pre["handoff"] == out["handoff_exports"], led
+    assert pre["failed"] == 0 and dec["failed"] == 0, led
+    assert dec["completed"] == dec["submitted"], led
+    # decode-TPOT ships for both sides (the on-chip gate's input)
+    assert out["decode_tpot"]["count"] > 0
+    assert out["baseline_decode_tpot"]["count"] > 0
+    assert out["decode_tpot"]["p99_s"] > 0
+    assert set(out["per_role_mfu"]) == {"prefill", "decode"}
+    assert out["disagg_tokens_per_sec"] > 0
     assert out["baseline_tokens_per_sec"] > 0

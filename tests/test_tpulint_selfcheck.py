@@ -127,6 +127,24 @@ def test_lean_epilogue_functions_in_hot_set():
     assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
 
 
+def test_handoff_functions_in_hot_set():
+    """ISSUE 13: the disaggregated handoff paths (harvest once per
+    step, export/import moving KV pages through the kvtier copy
+    thread's explicit fences) sit in the TPL001 hot set so a stray
+    device pull can never hide in them — and the single sanctioned
+    sync is STILL the batched reader alone (handoff copies are
+    explicit-fence transfers on the tier thread, never a pump-thread
+    device_get)."""
+    from paddle_tpu.analysis.config import LintConfig
+
+    cfg = LintConfig.default()
+    for fn in ("ServingEngine._harvest_handoffs",
+               "ServingEngine._export_handoff",
+               "ServingEngine._import_handoff"):
+        assert fn in cfg.hot_functions, fn
+    assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
+
+
 def test_sanctioned_sync_config_check(tmp_path):
     """The TPL001 config check: a raw jax.device_get anywhere in a hot
     serving module — even outside the configured hot functions — is a

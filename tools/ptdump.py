@@ -134,6 +134,26 @@ def print_flight(doc, tail=30, kind=None, out=sys.stdout):
             w(f"; holding {_human_bytes(last.get('tier_bytes'))} "
               f"in {last.get('tier_pages')} pages")
         w("\n")
+    # disaggregated handoff rollup: handoff.export/import carry each
+    # migrated request's KV payload size; handoff.fail the degraded
+    # ones (export fail -> local decode, import fail -> recompute)
+    hexp = [e for e in events if e.get("kind") == "handoff.export"]
+    himp = [e for e in events if e.get("kind") == "handoff.import"]
+    hfail = [e for e in events if e.get("kind") == "handoff.fail"]
+    if hexp or himp or hfail:
+        ex_bytes = sum(e.get("bytes") or 0 for e in hexp)
+        im_pages = sum(e.get("pages") or 0 for e in himp)
+        w(f"  kv handoff: {len(hexp)} exports "
+          f"({_human_bytes(ex_bytes)} shipped), {len(himp)} imports "
+          f"({im_pages} pages landed)")
+        if hfail:
+            wh = {}
+            for e in hfail:
+                wh[e.get("where", "?")] = wh.get(e.get("where", "?"),
+                                                 0) + 1
+            w(f", {len(hfail)} degraded "
+              f"({', '.join(f'{k}:{v}' for k, v in sorted(wh.items()))})")
+        w("\n")
     # crash-recovery rollup: engine.restart records carry what each
     # warm restart did (requeued / failed / quarantined, and whether
     # the crash-loop breaker tripped); poison.quarantine and
