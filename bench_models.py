@@ -225,6 +225,13 @@ def bench_serving(on_tpu):
     # (docs/serving.md § Lean epilogue)
     if (os.environ.get("PT_SERVE_LEAN", "") or "0") not in ("", "0"):
         return _bench_serving_lean(on_tpu, params, cfg, dtype)
+    # PT_SERVE_SLO=1: the SLO/goodput accounting plane — a mixed
+    # interactive + batch workload measured through the per-request
+    # timeline ledger: goodput ratio, attained/violated by class,
+    # violations attributed to phases, per-phase latency percentiles
+    # (docs/observability.md § Request timelines & SLO accounting)
+    if (os.environ.get("PT_SERVE_SLO", "") or "0") not in ("", "0"):
+        return _bench_serving_slo(on_tpu, params, cfg, dtype)
 
     rng = _data_rng()
     if prefix_mode:
@@ -1034,6 +1041,111 @@ def _bench_serving_disagg(on_tpu, params, cfg, dtype):
         "per_role_mfu": role_mfu,
         "measured_mfu": round(_dt.COSTS.mfu_over(dflops, ddt), 6),
         "ledgers": ledgers,
+        "loss": 0.0,
+    }
+
+
+def _bench_serving_slo(on_tpu, params, cfg, dtype):
+    """PT_SERVE_SLO=1: goodput accounting over a mixed interactive +
+    batch workload on ONE engine (the contention the SLO plane exists
+    to attribute): chatty short prompts tagged `slo="interactive"`
+    interleaved with long-prompt `slo="batch"` requests. The artifact
+    reads everything off the per-request timeline ledger — goodput
+    tokens vs total, attained/violated counts by class, violations
+    attributed to their dominant phase, and per-phase latency
+    percentiles — the same series /metrics exposes in production."""
+    from paddle_tpu.models.llama_serving import ServingEngine
+    from paddle_tpu.serving import RequestScheduler
+
+    if on_tpu:
+        max_seqs, page, max_seq_len = 8, 16, 1024
+        n_inter, n_batch, chat_len, long_len = 8, 4, 12, 384
+        inter_new, batch_new = 48, 12
+    else:
+        max_seqs, page, max_seq_len = 2, 8, 64
+        n_inter, n_batch, chat_len, long_len = 3, 2, 4, 24
+        inter_new, batch_new = 8, 4
+    rng = _data_rng()
+    inter_p = [list(map(int, rng.randint(1, cfg.vocab_size, chat_len)))
+               for _ in range(n_inter)]
+    batch_p = [list(map(int, rng.randint(1, cfg.vocab_size, long_len)))
+               for _ in range(n_batch)]
+    # interleave so batch prefill pressure lands while interactive
+    # decodes are in flight — the interference SLO attribution is for
+    work = []
+    for i in range(max(n_inter, n_batch)):
+        if i < n_inter:
+            work.append((inter_p[i], inter_new, "interactive"))
+        if i < n_batch:
+            work.append((batch_p[i], batch_new, "batch"))
+
+    engine = ServingEngine(params, cfg, max_seqs=max_seqs,
+                           max_seq_len=max_seq_len, page_size=page,
+                           dtype=dtype, prefix_cache=True,
+                           use_pallas=None if on_tpu else False)
+    sched = RequestScheduler(engine, max_queue=len(work) + 1)
+    # warm pass (no SLO class): compile outside the timed window
+    sched.submit(inter_p[0], max_new_tokens=2).result(timeout=600)
+    mark = sched.metrics_snapshot()
+
+    t0 = time.perf_counter()
+    handles = [sched.submit(p, max_new_tokens=nt, slo=slo)
+               for p, nt, slo in work]
+    outs = [h.result(timeout=600) for h in handles]
+    dt = time.perf_counter() - t0
+    snap = sched.metrics_snapshot()
+    sched.shutdown(drain=True, timeout=60)
+
+    def ctr(s, key):
+        m = s.get(key)
+        return int(m["value"]) if m else 0
+
+    def d_ctr(key):
+        return ctr(snap, key) - ctr(mark, key)
+
+    attained, violated_by_phase = {}, {}
+    for key in snap:
+        if key.startswith("pt_slo_attained{"):
+            cls = key.split('slo="', 1)[1].rstrip('"}')
+            n = d_ctr(key)
+            if n:
+                attained[cls] = n
+        elif key.startswith("pt_slo_violated{"):
+            ph = key.split('phase="', 1)[1].rstrip('"}')
+            n = d_ctr(key)
+            if n:
+                violated_by_phase[ph] = n
+    n_attained = sum(attained.values())
+    n_violated = sum(violated_by_phase.values())
+    total = d_ctr("pt_tokens")
+    goodput = d_ctr("pt_goodput_tokens")
+    phase_latency = {}
+    for ph in ("queued", "prefill", "decode", "preempted", "handoff"):
+        h = snap.get(f"pt_phase_{ph}_seconds") or {}
+        h0 = mark.get(f"pt_phase_{ph}_seconds") or {}
+        phase_latency[ph] = {
+            # count deltas the warm pass out; the percentiles come off
+            # the whole histogram (one warm sample is bench noise)
+            "count": int(h.get("count", 0)) - int(h0.get("count", 0)),
+            "p50_s": round(float(h.get("p50", 0.0) or 0.0), 6),
+            "p99_s": round(float(h.get("p99", 0.0) or 0.0), 6)}
+
+    assert n_attained + n_violated == len(work), (attained,
+                                                  violated_by_phase)
+    assert total == sum(len(o) for o in outs), (total, outs)
+    return {
+        "workload": "slo-goodput",
+        "requests": len(work),
+        "interactive": n_inter, "batch": n_batch,
+        "total_tokens": total,
+        "goodput_tokens": goodput,
+        "goodput_ratio": round(goodput / total, 6) if total else 0.0,
+        "slo_attained": attained,
+        "slo_violated": n_violated,
+        "violations_by_phase": violated_by_phase,
+        "phase_latency": phase_latency,
+        "step_anomalies": d_ctr("pt_step_anomalies"),
+        "tokens_per_sec": round(total / dt, 1) if dt else 0.0,
         "loss": 0.0,
     }
 

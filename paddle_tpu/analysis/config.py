@@ -128,6 +128,16 @@ class LintConfig:
                 "RequestScheduler._step_pipelined",
                 "RequestScheduler._finish_pending",
                 "RequestScheduler._drain_needed",
+                # timeline/SLO plane (ISSUE 14): host-clock-only by
+                # contract — marks stamp on the pump and engine loops,
+                # finalize judges SLOs, the sentinel's note() runs per
+                # step. None of these may ever touch the device.
+                "Timeline.mark", "Timeline.count",
+                "Timeline.segments", "Timeline.phases",
+                "StepAnomalySentinel.note",
+                "RequestScheduler._finalize",
+                "RequestScheduler._account_slo",
+                "RequestScheduler._timeline_entry",
             ],
             bench_paths=[
                 "bench*.py", "tools/*.py", "tests/*.py", "examples/*.py",

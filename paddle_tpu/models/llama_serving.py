@@ -960,6 +960,25 @@ class Request:
                  self.output[-1] == self.eos_id))
 
 
+def _tl_mark(req, name):
+    """Stamp an exceptional transition (preempted/resumed, spill/
+    restore, handoff_export/import) on the request's timeline ledger.
+    The scheduler attaches `req._timeline` (serving/timeline.py); bare
+    engines and PT_SERVE_TIMELINE=0 leave it absent and this is a
+    no-op. Host clock only — the timeline plane must never add device
+    traffic to the step loop."""
+    tl = getattr(req, "_timeline", None)
+    if tl is not None:
+        tl.mark(name)
+
+
+def _tl_count(req, phase, n=1):
+    """Bump the request's per-phase step counter (same ledger)."""
+    tl = getattr(req, "_timeline", None)
+    if tl is not None:
+        tl.count(phase, n)
+
+
 class ServingEngine:
     """Continuous-batching decode loop over the paged cache.
 
@@ -1415,6 +1434,7 @@ class ServingEngine:
         m = self.metrics
         if m is None or n <= 0:
             return
+        _tl_count(req, "decode")
         now = time.perf_counter()
         if req._t_first is None:
             req._t_first = now
@@ -1633,6 +1653,10 @@ class ServingEngine:
         # prompt G tokens per verify step so decoders never stall)
         reqs, slots = [], []
         for slot, req in zip(all_slots, all_reqs):
+            if getattr(req, "_resume", False):
+                # swap-in / recompute-resume / crash-recovery re-admit:
+                # one timeline mark regardless of which path below runs
+                _tl_mark(req, "resumed")
             match = getattr(req, "_kv_match", None) or ([], 0)
             req._kv_match = None
             if getattr(req, "_offload", None) is not None:
@@ -1673,6 +1697,8 @@ class ServingEngine:
             self._prefill_into(slots[0], reqs[0])
             return
         feeds = [self._feed_ids(r) for r in reqs]
+        for r in reqs:
+            _tl_count(r, "prefill")
         lens = [len(f) for f in feeds]
         total = sum(lens)
         self.prefill_tokens += total
@@ -1787,6 +1813,7 @@ class ServingEngine:
         feed = self._feed_ids(req)
         S = len(feed)
         self.prefill_tokens += S
+        _tl_count(req, "prefill")
         bucket = self._bucket_for(S)
         ids = np.zeros((1, bucket), np.int64)
         ids[0, :S] = feed
@@ -1847,6 +1874,7 @@ class ServingEngine:
             # second ad-hoc store); the request carries only shape
             # metadata. Stored verbatim: a resume must be exact.
             self.host_tier.stash_put(id(req), payload, n_pg)
+            _tl_mark(req, "spill")
             req._offload = {
                 "len": int(self.lengths[s]),
                 # actual page count, NOT ceil(len/page_size): a victim
@@ -1856,6 +1884,7 @@ class ServingEngine:
             }
         req._resume = True
         req.slot = None
+        _tl_mark(req, "preempted")
         self._waiting.insert(0, req)
         _flight.record(
             "engine.preempt", rid=str(req.rid),
@@ -2018,6 +2047,8 @@ class ServingEngine:
                            trace_id=getattr(req, "_trace_id", None),
                            where="export", error=repr(e))
             return  # slot untouched -> local decode from here on
+        _tl_mark(req, "handoff_export")
+        tl = getattr(req, "_timeline", None)
         h = KVHandoff(
             rid=req.rid, prompt=req.prompt, output=req.output,
             next_token=int(req.next_token), length=int(self.lengths[s]),
@@ -2027,7 +2058,8 @@ class ServingEngine:
             vs=None if p["vs"] is None else p["vs"][:, :, :n_pg],
             quantized=p["ks"] is not None,
             trace_id=getattr(req, "_trace_id", None),
-            logprobs=req.logprobs, cached_tokens=req.cached_tokens)
+            logprobs=req.logprobs, cached_tokens=req.cached_tokens,
+            timeline=None if tl is None else tl.to_dict())
         req._handoff_done = h
         self.handoff_exports += 1
         self.handoff_bytes += h.nbytes
@@ -2079,6 +2111,7 @@ class ServingEngine:
             req._resume = True  # recompute path: prompt + output[:-1]
             return False
         self.lengths[slot] = h.length
+        _tl_mark(req, "handoff_import")
         req._kv_import = None
         req._resume = False
         req.slot = slot
@@ -2414,6 +2447,7 @@ class ServingEngine:
             tok_slot[row:row + n] = s
             tok_pos[row:row + n] = base + np.arange(n, dtype=np.int32)
             req._pf_cursor += n
+            _tl_count(req, "prefill")
             self.lengths[s] += n
             self.prefill_tokens += n
             if req._pf_cursor >= len(feed):
@@ -2807,6 +2841,7 @@ class ServingEngine:
                 # chunk fed; emit nothing until the prompt is complete,
                 # then the final position's logits seed generation
                 req._pf_cursor += n
+                _tl_count(req, "prefill")
                 self.lengths[s] += n
                 if req._pf_cursor >= len(req._pf_feed) and req._pf_sample:
                     self._seed_first_token(s, req, seed_rows[s])
@@ -2972,6 +3007,8 @@ class ServingEngine:
         new_cached = cached + n * self.page_size
         self.prefix_cache.insert(feed, all_pages, new_cached)
         tier.note_lookup(n)
+        if req is not None:
+            _tl_mark(req, "restore")
         _flight.record(
             "kvtier.hit", rid=None if req is None else str(req.rid),
             trace_id=None if req is None
@@ -3062,6 +3099,7 @@ class ServingEngine:
         suffix = feed[cached:]
         n = len(suffix)
         self.prefill_tokens += n
+        _tl_count(req, "prefill")
         self._map_prefix(slot, match)
         total = -(-len(feed) // self.page_size)
         if total > len(pages):

@@ -24,6 +24,10 @@ Layers (docs/serving.md has the architecture):
                   (PT_FAULTS / constructor) armed at the stack's real
                   failure sites, so chaos drills replay byte-for-byte
                   (docs/reliability.md).
+  * `timeline`  — per-request phase timelines (host-clock marks that
+                  survive preemption, crash requeue, and cross-replica
+                  migration), SLO classes + violation attribution, and
+                  the step-time anomaly sentinel.
   * `scheduler` — thread-safe bounded request queue with priority
                   classes, deadlines/TTLs, cancellation, backpressure
                   (`BackpressureError`), and graceful drain.
@@ -46,7 +50,7 @@ from __future__ import annotations
 
 from . import (  # noqa: F401
     client, faults, handoff, kvcache, kvtier, metrics, replica, router,
-    scheduler, server,
+    scheduler, server, timeline,
 )
 from .client import ServingClient, ServingHTTPError  # noqa: F401
 from .faults import FaultPlan, InjectedFault  # noqa: F401
@@ -66,10 +70,15 @@ from .scheduler import (  # noqa: F401
     SchedulerError, ServingRequest,
 )
 from .server import ServingServer  # noqa: F401
+from .timeline import (  # noqa: F401
+    StepAnomalySentinel, Timeline, judge_slo, resolve_slo, slo_targets,
+)
 
 __all__ = [
     "client", "faults", "handoff", "kvcache", "kvtier", "metrics",
-    "replica", "router", "scheduler", "server",
+    "replica", "router", "scheduler", "server", "timeline",
+    "Timeline", "StepAnomalySentinel",
+    "resolve_slo", "slo_targets", "judge_slo",
     "ServingClient", "ServingHTTPError",
     "FaultPlan", "InjectedFault", "KVHandoff",
     "PagePool", "PrefixCache", "HostTier",

@@ -144,6 +144,12 @@ class ServingClient:
         """JSON snapshot of the server's metrics registry."""
         return self._json_call("GET", "/metrics?format=json")
 
+    def debug_requests(self, last=50):
+        """Recent terminal requests with their stitched timelines
+        (/debug/requests?last=N); behind a router each entry carries
+        its `replica` tag."""
+        return self._json_call("GET", f"/debug/requests?last={int(last)}")
+
     def metrics_text(self):
         """Prometheus text exposition."""
         conn, resp = self._request("GET", "/metrics")

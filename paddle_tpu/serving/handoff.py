@@ -41,11 +41,11 @@ class KVHandoff:
 
     __slots__ = ("rid", "trace_id", "prompt", "output", "next_token",
                  "length", "pages", "k", "v", "ks", "vs", "quantized",
-                 "logprobs", "cached_tokens")
+                 "logprobs", "cached_tokens", "timeline")
 
     def __init__(self, rid, prompt, output, next_token, length, pages,
                  k, v, ks=None, vs=None, quantized=False, trace_id=None,
-                 logprobs=None, cached_tokens=0):
+                 logprobs=None, cached_tokens=0, timeline=None):
         self.rid = rid
         self.trace_id = trace_id
         self.prompt = list(prompt)
@@ -60,6 +60,10 @@ class KVHandoff:
         self.quantized = bool(quantized)
         self.logprobs = None if logprobs is None else list(logprobs)
         self.cached_tokens = int(cached_tokens)
+        # Timeline.to_dict() of the exporting side (or None): plain
+        # lists/floats so the payload stays transport-agnostic; the
+        # importing scheduler stitches it into the resumed request.
+        self.timeline = timeline
 
     @property
     def nbytes(self):

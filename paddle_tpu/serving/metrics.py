@@ -32,12 +32,23 @@ GAP_BUCKETS = (2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
                0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 
 
+def _label_suffix(labels):
+    """`{k="v",...}` suffix in sorted-key order ('' when unlabeled).
+    Keys sort so the same label set always renders one series name."""
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{labels[k]}"'
+                          for k in sorted(labels)) + "}"
+
+
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name, help="", lock=None):
+    def __init__(self, name, help="", lock=None, labels=None):
         self.name = name
         self.help = help
+        self.labels = dict(labels) if labels else {}
+        self._suffix = _label_suffix(self.labels)
         self._lock = lock or threading.Lock()
 
 
@@ -45,8 +56,8 @@ class Counter(_Metric):
     """Monotonic count (Prometheus counter)."""
     kind = "counter"
 
-    def __init__(self, name, help="", lock=None):
-        super().__init__(name, help, lock)
+    def __init__(self, name, help="", lock=None, labels=None):
+        super().__init__(name, help, lock, labels)
         self._v = 0.0
 
     def inc(self, n=1.0):
@@ -61,7 +72,7 @@ class Counter(_Metric):
             return self._v
 
     def _render(self, out):
-        out.append(f"{self.name}_total {_fmt(self._v)}")
+        out.append(f"{self.name}_total{self._suffix} {_fmt(self._v)}")
 
     def _snap(self):
         return {"type": "counter", "value": self._v}
@@ -71,8 +82,8 @@ class Gauge(_Metric):
     """Point-in-time value (Prometheus gauge)."""
     kind = "gauge"
 
-    def __init__(self, name, help="", lock=None):
-        super().__init__(name, help, lock)
+    def __init__(self, name, help="", lock=None, labels=None):
+        super().__init__(name, help, lock, labels)
         self._v = 0.0
 
     def set(self, v):
@@ -98,7 +109,7 @@ class Gauge(_Metric):
             return self._v
 
     def _render(self, out):
-        out.append(f"{self.name} {_fmt(self._v)}")
+        out.append(f"{self.name}{self._suffix} {_fmt(self._v)}")
 
     def _snap(self):
         return {"type": "gauge", "value": self._v}
@@ -110,8 +121,9 @@ class Histogram(_Metric):
     landing bucket, which is exact enough for dashboards and tests."""
     kind = "histogram"
 
-    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS, lock=None):
-        super().__init__(name, help, lock)
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS, lock=None,
+                 labels=None):
+        super().__init__(name, help, lock, labels)
         self._bounds = tuple(sorted(float(b) for b in buckets))
         self._counts = [0] * (len(self._bounds) + 1)  # last = +Inf
         self._sum = 0.0
@@ -135,23 +147,36 @@ class Histogram(_Metric):
             return self._sum
 
     def percentile(self, q):
-        """Interpolated q-th percentile (q in [0, 100]); 0.0 when empty."""
+        """Interpolated q-th percentile (q in [0, 100]); 0.0 when empty.
+        A percentile landing in the overflow (+Inf) bucket returns the
+        largest finite bucket edge — a LOWER bound, never `inf` (the
+        snapshot flags it; see `percentile_overflow`)."""
+        return self.percentile_overflow(q)[0]
+
+    def percentile_overflow(self, q):
+        """(value, in_overflow): `in_overflow` is True when the
+        percentile fell in the +Inf bucket, making `value` (the largest
+        finite bucket edge) a lower bound on the true percentile."""
         with self._lock:
             if self._count == 0:
-                return 0.0
+                return 0.0, False
             target = self._count * q / 100.0
             seen = 0
             lo = 0.0
             for i, n in enumerate(self._counts):
-                hi = self._bounds[i] if i < len(self._bounds) \
-                    else (self._bounds[-1] if self._bounds else lo)
+                if i == len(self._bounds):
+                    # overflow bucket: its finite edge is the previous
+                    # bucket's upper bound — return it, flagged
+                    return (self._bounds[-1] if self._bounds else lo), \
+                        True
+                hi = self._bounds[i]
                 if seen + n >= target:
                     if n == 0:
-                        return hi
-                    return lo + (hi - lo) * (target - seen) / n
+                        return hi, False
+                    return lo + (hi - lo) * (target - seen) / n, False
                 seen += n
                 lo = hi
-            return lo
+            return lo, False
 
     def _render(self, out):
         cum = 0
@@ -169,10 +194,16 @@ class Histogram(_Metric):
             cum += self._counts[i]
             buckets[_fmt(b)] = cum
         buckets["+Inf"] = cum + self._counts[-1]
-        return {"type": "histogram", "count": self._count,
-                "sum": self._sum, "p50": self.percentile(50),
-                "p90": self.percentile(90), "p99": self.percentile(99),
-                "buckets": buckets}
+        snap = {"type": "histogram", "count": self._count,
+                "sum": self._sum, "buckets": buckets}
+        for label, q in (("p50", 50), ("p90", 90), ("p99", 99)):
+            v, overflow = self.percentile_overflow(q)
+            snap[label] = v
+            if overflow:
+                # the true percentile is past the largest finite edge;
+                # the reported value is a lower bound
+                snap[f"{label}_lower_bound"] = True
+        return snap
 
 
 def _fmt(v):
@@ -184,51 +215,67 @@ _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
 
 class MetricsRegistry:
-    """Thread-safe get-or-create registry of named metrics."""
+    """Thread-safe get-or-create registry of named metrics.
+
+    Counters and gauges optionally carry a small static label set
+    (e.g. ``labels={"phase": "decode"}``); each distinct (name, label
+    set) is its own series, keyed by the rendered ``name{k="v"}``
+    string, and exposition emits one HELP/TYPE header per base name."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics = {}
 
-    def _get(self, cls, name, help, **kw):
+    def _get(self, cls, name, help, labels=None, **kw):
+        key = name + _label_suffix(labels)
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._metrics.get(key)
             if m is None:
-                m = cls(name, help, lock=threading.Lock(), **kw)
-                self._metrics[name] = m
+                m = cls(name, help, lock=threading.Lock(),
+                        labels=labels, **kw)
+                self._metrics[key] = m
             elif not isinstance(m, cls):
                 raise ValueError(
-                    f"metric {name!r} already registered as {m.kind}, "
+                    f"metric {key!r} already registered as {m.kind}, "
                     f"requested {cls.kind}")
             return m
 
-    def counter(self, name, help=""):
-        return self._get(Counter, name, help)
+    def counter(self, name, help="", labels=None):
+        return self._get(Counter, name, help, labels=labels)
 
-    def gauge(self, name, help=""):
-        return self._get(Gauge, name, help)
+    def gauge(self, name, help="", labels=None):
+        return self._get(Gauge, name, help, labels=labels)
 
     def histogram(self, name, help="", buckets=DEFAULT_BUCKETS):
+        # histograms stay unlabeled: bucket series already carry an
+        # le= label and nothing in the stack needs labeled ones yet
         return self._get(Histogram, name, help, buckets=buckets)
 
     def render_prometheus(self):
         """Prometheus text exposition format 0.0.4."""
         with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+            metrics = sorted(self._metrics.values(),
+                             key=lambda m: (m.name, m._suffix))
         out = []
+        prev = None
         for m in metrics:
-            if m.help:
-                out.append(f"# HELP {m.name} {m.help}")
-            out.append(f"# TYPE {m.name} {m.kind}")
+            if m.name != prev:
+                # one HELP/TYPE header per base name, shared by every
+                # labeled series of that name
+                if m.help:
+                    out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} {m.kind}")
+                prev = m.name
             with m._lock:
                 m._render(out)
         return "\n".join(out) + "\n"
 
     def snapshot(self):
-        """JSON-serializable dict of every metric's current state."""
+        """JSON-serializable dict of every metric's current state,
+        keyed by name (plus the label suffix for labeled series)."""
         with self._lock:
-            metrics = list(self._metrics.values())
-        return {m.name: m._snap() for m in metrics}
+            metrics = list(self._metrics.items())
+        return {key: m._snap() for key, m in metrics}
 
 
 class EngineMetrics:
@@ -424,6 +471,30 @@ class EngineMetrics:
             "pt_poison_quarantined",
             "Requests quarantined as poison after crashing K "
             "consecutive admitted steps.")
+        # SLO / goodput plane (serving/timeline.py): judged per
+        # completed request in the scheduler's finalize path from the
+        # request's stitched timeline. Goodput is the Gemma-serving /
+        # MPMD objective: tokens delivered INSIDE the latency target.
+        self.total_tokens = r.counter(
+            "pt_tokens",
+            "Output tokens of completed requests (goodput denominator).")
+        self.goodput_tokens = r.counter(
+            "pt_goodput_tokens",
+            "Output tokens of completed requests that met their SLO "
+            "(requests with no SLO class count as delivered).")
+        self.step_anomalies = r.counter(
+            "pt_step_anomalies",
+            "Serving steps flagged as stalls by the EWMA+MAD anomaly "
+            "sentinel (each leaves an anomaly.step_stall flight record).")
+        self.phase_seconds = {
+            ph: r.histogram(
+                f"pt_phase_{ph}_seconds",
+                f"Wall seconds completed requests spent in the "
+                f"'{ph}' phase of their timeline.")
+            for ph in ("queued", "prefill", "decode", "preempted",
+                       "handoff")}
+        self._slo_attained = {}     # class -> labeled counter
+        self._slo_violated = {}     # phase -> labeled counter
 
     # -- engine-facing hooks (called from the step()-driving thread) --
     def on_submit(self, engine):
@@ -578,6 +649,47 @@ class EngineMetrics:
 
     def on_expire(self):
         self.expired.inc()
+
+    def observe_phases(self, phases):
+        """One completed request's phase -> seconds breakdown."""
+        for ph, dt in phases.items():
+            h = self.phase_seconds.get(ph)
+            if h is not None:
+                h.observe(dt)
+
+    def on_request_tokens(self, n):
+        """Output tokens of one completed request (goodput
+        denominator; `on_goodput` adds the numerator)."""
+        self.total_tokens.inc(n)
+
+    def on_goodput(self, n):
+        """`n` tokens were delivered inside their latency objective
+        (or carried no objective)."""
+        self.goodput_tokens.inc(n)
+
+    def on_slo_attained(self, slo):
+        c = self._slo_attained.get(slo)
+        if c is None:
+            c = self.registry.counter(
+                "pt_slo_attained",
+                "Completed requests that met their SLO class targets.",
+                labels={"slo": slo})
+            self._slo_attained[slo] = c
+        c.inc()
+
+    def on_slo_violated(self, phase):
+        c = self._slo_violated.get(phase)
+        if c is None:
+            c = self.registry.counter(
+                "pt_slo_violated",
+                "Completed requests that missed their SLO, attributed "
+                "to the dominant phase of the violated budget.",
+                labels={"phase": phase})
+            self._slo_violated[phase] = c
+        c.inc()
+
+    def on_step_anomaly(self, n=1):
+        self.step_anomalies.inc(n)
 
     def set_queue_depth(self, depth):
         self.queue_depth.set(depth)

@@ -113,6 +113,12 @@ class Replica:
         st = self.scheduler.stats()
         return st["queued"] + st["inflight"] + st["active"]
 
+    def recent_requests(self, n=50):
+        """Recent terminal requests with their stitched timelines —
+        plain JSON-shaped data, so a multi-host replica can ship it
+        over the rpc layer unchanged (/debug/requests aggregation)."""
+        return self.scheduler.recent_requests(n)
+
     def ready(self):
         return self.scheduler.readiness()[0]
 

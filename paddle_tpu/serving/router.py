@@ -180,6 +180,24 @@ class RouterRequest:
     def t_first_token(self):
         return self._sr.t_first_token
 
+    @property
+    def timeline(self):
+        """The CURRENT owner's timeline — after a migration this is
+        the decode replica's, i.e. the full stitched ledger."""
+        return self._sr.timeline
+
+    @property
+    def slo(self):
+        return self._sr.slo
+
+    @property
+    def slo_attained(self):
+        return self._sr.slo_attained
+
+    @property
+    def violated_phase(self):
+        return self._sr.violated_phase
+
     def cancel(self):
         return self._sr.cancel()
 
@@ -335,6 +353,10 @@ class Router:
             "pt_router_replicas", "Registered replicas.")
         self.ready_gauge = r.gauge(
             "pt_router_replicas_ready", "Replicas accepting dispatches.")
+        # per-replica exposition cost (satellite of the timeline plane):
+        # one labeled gauge per replica so a slow scrape names its
+        # culprit; created lazily as replicas join
+        self._scrape_gauges = {}
         for rep in replicas:
             self.add_replica(rep)
 
@@ -746,31 +768,94 @@ class Router:
         return ok
 
     # -- metrics aggregation ------------------------------------------
+    def _scrape_gauge(self, rid):
+        g = self._scrape_gauges.get(rid)
+        if g is None:
+            g = self.registry.gauge(
+                "pt_router_scrape_seconds",
+                "Wall time of the last /metrics scrape of this "
+                "replica's registry (a slow replica's exposition cost, "
+                "made visible).", labels={"replica": rid})
+            self._scrape_gauges[rid] = g
+        return g
+
+    @staticmethod
+    def _scrape_replica(rep):
+        """One replica's exposition. Goes through the scheduler when
+        there is one so the scrape-side work that must never run on a
+        pump (anomaly-sentinel analysis) happens here."""
+        sched = getattr(rep, "scheduler", None)
+        if sched is not None and hasattr(sched, "render_prometheus"):
+            return sched.render_prometheus()
+        return rep.registry.render_prometheus()
+
     def render_prometheus(self):
         """Router counters plus every replica's exposition with a
         `replica="<id>"` label injected on each series (HELP/TYPE
         comments are kept only for the router's own metrics — repeated
-        per-replica TYPE lines would be invalid exposition)."""
+        per-replica TYPE lines would be invalid exposition).
+
+        Lock discipline (TPL004, same as dispatch): the membership
+        snapshot is taken under the router lock, but every replica
+        scrape — registry render, relabel, sentinel scan — runs
+        OUTSIDE it, so one replica's slow exposition can never stall
+        submits. Each replica's scrape wall time lands in its
+        `pt_router_scrape_seconds{replica=}` gauge."""
         self.stats()                 # refresh ready gauge
-        parts = [self.registry.render_prometheus()]
         with self._lock:
             items = [(rid, st.replica) for rid, st in
                      self._replicas.items()]
+        parts = []
         for rid, rep in items:
-            parts.append(_relabel(rep.registry.render_prometheus(), rid))
-        return "".join(parts)
+            t0 = time.perf_counter()
+            text = _relabel(self._scrape_replica(rep), rid)
+            self._scrape_gauge(rid).set(time.perf_counter() - t0)
+            parts.append(text)
+        # the router's own registry renders LAST so the scrape gauges
+        # it just set are current in the same exposition
+        return "".join([self.registry.render_prometheus()] + parts)
 
     def metrics_snapshot(self):
         """JSON snapshot: router metrics flat (as the single-scheduler
         server exposes its registry) plus one nested snapshot per
         replica under "replicas"."""
-        snap = self.registry.snapshot()
         with self._lock:
             items = [(rid, st.replica) for rid, st in
                      self._replicas.items()]
-        snap["replicas"] = {rid: rep.registry.snapshot()
-                            for rid, rep in items}
+        reps = {}
+        for rid, rep in items:
+            t0 = time.perf_counter()
+            sched = getattr(rep, "scheduler", None)
+            if sched is not None and hasattr(sched, "metrics_snapshot"):
+                reps[rid] = sched.metrics_snapshot()
+            else:
+                reps[rid] = rep.registry.snapshot()
+            self._scrape_gauge(rid).set(time.perf_counter() - t0)
+        snap = self.registry.snapshot()
+        snap["replicas"] = reps
         return snap
+
+    def recent_requests(self, n=50):
+        """Aggregate /debug/requests across the pool: each replica's
+        recent terminal requests tagged with `replica=<id>`, merged in
+        end-time order (newest last), trimmed to `n`. A migrated
+        request appears once per replica that finalized it — the
+        decode-side entry carries the full stitched timeline."""
+        with self._lock:
+            items = [(rid, st.replica) for rid, st in
+                     self._replicas.items()]
+        merged = []
+        for rid, rep in items:
+            sched = getattr(rep, "scheduler", None)
+            if sched is None or not hasattr(sched, "recent_requests"):
+                continue
+            for entry in sched.recent_requests(n):
+                e = dict(entry)
+                e["replica"] = rid
+                merged.append(e)
+        # entries without a timeline sort stably at the front
+        merged.sort(key=lambda e: (e.get("marks") or [[None, 0.0]])[-1][1])
+        return merged[-int(n):] if n else merged
 
 
 def _relabel(text, rid):

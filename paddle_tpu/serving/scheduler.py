@@ -47,6 +47,8 @@ from ..observability import flight_recorder as _flight
 from ..observability import trace_context as _tc
 from ..observability.logging import get_logger
 from .metrics import EngineMetrics, MetricsRegistry
+from .timeline import StepAnomalySentinel, Timeline, judge_slo, \
+    resolve_slo
 
 __all__ = ["RequestScheduler", "ServingRequest", "SchedulerError",
            "BackpressureError", "DeadlineExceededError",
@@ -136,6 +138,13 @@ class ServingRequest:
         # disaggregated serving: the KVHandoff payload when this
         # request terminates with state "handoff" (router migration)
         self.handoff = None
+        # timeline plane (serving/timeline.py): the stitched phase
+        # ledger (None when PT_SERVE_TIMELINE=0), the SLO class, and
+        # the finalize-time verdict
+        self.timeline = None
+        self.slo = None
+        self.slo_attained = None
+        self.violated_phase = None
         self._done = threading.Event()
 
     @property
@@ -247,6 +256,17 @@ class RequestScheduler:
         self._broken = False
         self._quarantined = 0
         self._fin_seen = len(engine.finished)
+        # timeline + SLO plane (serving/timeline.py). PT_SERVE_TIMELINE=0
+        # disables it entirely — every request's `timeline` stays None,
+        # every mark site is a no-op, and token outputs are untouched
+        # either way (the plane is host-clock bookkeeping only).
+        self._timeline_on = os.environ.get(
+            "PT_SERVE_TIMELINE", "1") not in ("", "0")
+        # step-time anomaly sentinel: the pump appends samples, ALL
+        # analysis runs in _scan_anomalies on the scrape thread
+        self._sentinel = StepAnomalySentinel()
+        # completed-request ring for /debug/requests
+        self._recent = deque(maxlen=256)
         self._rid = itertools.count()
         self._closed = False
         self._paused = False
@@ -263,10 +283,15 @@ class RequestScheduler:
                eos_id=None, temperature=0.0, top_k=0, top_p=1.0,
                seed=None, logprobs=False, priority="normal",
                ttl_s=None, trace_id=None, kv_export=False,
-               kv_import=None):
+               kv_import=None, slo=None):
         """Admit-or-refuse NOW: raises BackpressureError on a full
         queue, SchedulerClosedError during shutdown, ValueError for a
         request the engine could never run. Returns a ServingRequest.
+
+        `slo` names the request's latency objective class
+        ("interactive" / "batch"; None defaults from priority — see
+        serving/timeline.py): finalize judges ttft/tpot against the
+        class targets and books goodput.
 
         Disaggregated serving (docs/serving.md § Disaggregated
         prefill/decode): `kv_export=True` marks the request for KV
@@ -274,12 +299,14 @@ class RequestScheduler:
         `sr.handoff`) once its prompt is prefilled and seeded;
         `kv_import=<KVHandoff>` resumes an exported request here — its
         generated-so-far output is pre-seeded and only NEW tokens
-        stream from this handle."""
+        stream from this handle (the payload's timeline, when present,
+        is stitched into the resumed request)."""
         if priority not in PRIORITIES:
             raise ValueError(
                 f"priority={priority!r}: want one of {PRIORITIES}")
         if ttl_s is not None and ttl_s <= 0:
             raise ValueError(f"ttl_s={ttl_s}: want > 0 or None")
+        slo = resolve_slo(slo, priority)    # ValueError on a bad class
         from ..models.llama_serving import Request
         req = Request(rid if rid is not None
                       else f"sr{next(self._rid)}",
@@ -325,6 +352,24 @@ class RequestScheduler:
                     "retry later")
             sr = ServingRequest(self, req, priority, deadline,
                                 trace_id=trace_id)
+            sr.slo = slo
+            if self._timeline_on:
+                tl = None
+                if kv_import is not None:
+                    # stitch: continue the exporting side's ledger so
+                    # the migrated request keeps ONE timeline
+                    tl = Timeline.from_dict(
+                        getattr(kv_import, "timeline", None))
+                if tl is None:
+                    tl = Timeline()
+                    tl.mark("submit")
+                if kv_import is not None:
+                    tl.mark("migrate")
+                sr.timeline = tl
+                # the engine stamps exceptional transitions (preempt /
+                # spill / handoff) straight onto the request's ledger —
+                # duck-typed, no model-code import of this module
+                req._timeline = tl
             if kv_import is not None:
                 # imported tokens were already streamed by the prefill
                 # replica's handle — this one emits only NEW tokens
@@ -453,10 +498,20 @@ class RequestScheduler:
         """Prometheus exposition of this scheduler's registry (the
         server calls this on whatever it mounts — a Router aggregates
         replica registries behind the same method)."""
+        self._scan_anomalies()
         return self.registry.render_prometheus()
 
     def metrics_snapshot(self):
+        self._scan_anomalies()
         return self.registry.snapshot()
+
+    def _scan_anomalies(self):
+        """Drain the sentinel's step samples and publish any stalls —
+        runs on whatever thread scrapes /metrics, NEVER the pump."""
+        for a in self._sentinel.scan():
+            self.metrics.on_step_anomaly()
+            _flight.record("anomaly.step_stall", **a)
+            self._log.event("anomaly.step_stall", level="warning", **a)
 
     # -- pump (single thread; sole owner of the engine) ----------------
     def _queued_locked(self):
@@ -531,6 +586,8 @@ class RequestScheduler:
                 self._ledger["started"] += 1
                 self.metrics.on_start()
             sr.t_admitted = time.monotonic()
+            if sr.timeline is not None:
+                sr.timeline.mark("admit", t=sr.t_admitted)
             _flight.record("sched.admit", rid=str(sr.rid),
                            trace_id=sr.trace_id, priority=sr.priority,
                            queued_s=sr.t_admitted - sr.t_submit,
@@ -554,6 +611,13 @@ class RequestScheduler:
                 if n > sr._emitted:
                     if sr.t_first_token is None:
                         sr.t_first_token = time.monotonic()
+                        # guard: a migrated request's first token was
+                        # marked on the prefill replica and rode the
+                        # handoff payload in
+                        if sr.timeline is not None and \
+                                not sr.timeline.has("first_token"):
+                            sr.timeline.mark("first_token",
+                                             t=sr.t_first_token)
                     sr.chunks.put(list(sr.req.output[sr._emitted:n]))
                     sr._emitted = n
             if self._unproven:
@@ -592,6 +656,8 @@ class RequestScheduler:
     def _finalize(self, sr, state):
         sr.state = state
         sr.t_done = time.monotonic()
+        if sr.timeline is not None:
+            sr.timeline.mark("end", t=sr.t_done)
         self._suspects.discard(sr)
         self._unproven.discard(sr)
         self._ledger[{"done": "completed", "failed": "failed",
@@ -613,8 +679,61 @@ class RequestScheduler:
             sr.chunks.put(list(sr.req.output[sr._emitted:n]))
             sr._emitted = n
         sr.chunks.put(None)
+        self._account_slo(sr, state)
         self._emit_request_spans(sr, state)
+        self._recent.append(self._timeline_entry(sr, state))
         sr._done.set()
+
+    def _account_slo(self, sr, state):
+        """Book the finished request against the SLO/goodput plane:
+        phase histograms, the goodput/total token counters, and the
+        attained/violated verdict (violations attributed to the
+        dominant phase of the missed budget). Only state "done" counts
+        — a "handoff" terminal is mid-life (the decode replica books
+        it), and failures/cancels deliver nothing."""
+        tl = sr.timeline
+        if tl is None or state != "done":
+            return
+        phases = tl.phases()
+        self.metrics.observe_phases(phases)
+        tokens = len(sr.req.output)
+        self.metrics.on_request_tokens(tokens)
+        if sr.slo is None:
+            # no objective: delivered tokens are goodput by definition
+            self.metrics.on_goodput(tokens)
+            return
+        attained, phase = judge_slo(sr.slo, tl.ttft(),
+                                    tl.tpot(tokens), phases)
+        sr.slo_attained = attained
+        sr.violated_phase = phase
+        if attained:
+            self.metrics.on_slo_attained(sr.slo)
+            self.metrics.on_goodput(tokens)
+        else:
+            self.metrics.on_slo_violated(phase)
+
+    def _timeline_entry(self, sr, state):
+        """JSON-shaped record for the /debug/requests ring."""
+        entry = {"rid": str(sr.rid), "trace_id": sr.trace_id,
+                 "state": state, "priority": sr.priority,
+                 "slo": sr.slo, "tokens": len(sr.req.output),
+                 "requeues": sr._requeues}
+        tl = sr.timeline
+        if tl is not None:
+            entry.update(
+                e2e_s=tl.elapsed(), ttft_s=tl.ttft(),
+                phases=tl.phases(), steps=dict(tl.steps),
+                marks=[[m, t] for m, t in tl.marks],
+                slo_attained=sr.slo_attained,
+                violated_phase=sr.violated_phase)
+        return entry
+
+    def recent_requests(self, n=50):
+        """Most recent terminal requests (newest last), each with its
+        stitched timeline — the /debug/requests payload."""
+        with self._cond:
+            items = list(self._recent)
+        return items[-int(n):] if n else items
 
     def _emit_request_spans(self, sr, state):
         """Reconstruct the request's phase timeline — queued → prefill
@@ -631,6 +750,25 @@ class RequestScheduler:
         attrs = {"rid": str(sr.rid), "state": state,
                  "priority": sr.priority,
                  "tokens": len(sr.req.output)}
+        tl = sr.timeline
+        if tl is not None and tl.marks:
+            # the stitched ledger is authoritative: one child span per
+            # phase segment, exceptional transitions included, all
+            # sharing the request's trace id
+            for ph, a, b in tl.segments():
+                _tc.record_span_event(
+                    f"request.{ph}", b - a, trace_id=sr.trace_id,
+                    t_end=wall(b), args=attrs)
+            _flight.record(
+                "request.done", rid=str(sr.rid), trace_id=sr.trace_id,
+                state=state, tokens=len(sr.req.output),
+                slo=sr.slo, slo_attained=sr.slo_attained,
+                violated_phase=sr.violated_phase,
+                requeues=sr._requeues or None,
+                phases={k: round(v, 6)
+                        for k, v in tl.phases().items()},
+                ttft_s=tl.ttft(), e2e_s=tl.elapsed())
+            return
         q_end = sr.t_admitted if sr.t_admitted is not None else t_end
         _tc.record_span_event(
             "request.queued", q_end - sr.t_submit,
@@ -738,6 +876,17 @@ class RequestScheduler:
                 continue
             dt = time.perf_counter() - t0
             self.metrics.observe_step(dt)
+            if self._timeline_on:
+                # anomaly sentinel sample: one deque append tagged with
+                # the step's phase mix — no math, no locks, no device
+                # traffic on the pump (analysis runs on scrape)
+                npf = nact = 0
+                for r in self._engine._slots:
+                    if r is not None:
+                        nact += 1
+                        if self._engine._prefilling(r):
+                            npf += 1
+                self._sentinel.note(dt, npf, nact - npf)
             # MFU: the tracked prefill/decode/verify calls this step
             # issued a known number of XLA-counted FLOPs; dividing by
             # the (synced) step wall time sets the pt_mfu gauge. Pure
@@ -865,6 +1014,8 @@ class RequestScheduler:
                 sr.state = "queued"
                 sr._cancel_applied = False
                 sr._requeues += 1
+                if sr.timeline is not None:
+                    sr.timeline.mark("requeued")
                 sr._proof_mark = len(req.output)
                 self._suspects.add(sr)
                 self._queues[sr.priority].appendleft(sr)

@@ -32,6 +32,7 @@ can drive a real server end-to-end without a chip.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -120,6 +121,17 @@ class CompletionHandler(BaseHTTPRequestHandler):
             self._json(200, snap)
         elif path == "/debug/trace":
             self._json(200, _chrome.from_flight_recorder())
+        elif path == "/debug/requests":
+            # recent terminal requests with their stitched timelines;
+            # a mounted Router aggregates across replicas (each entry
+            # tagged replica="<id>") behind the same duck-typed method
+            last = 50
+            for part in query.split("&"):
+                k, _, v = part.partition("=")
+                if k == "last" and v.isdigit():
+                    last = int(v)
+            self._json(200,
+                       {"requests": self.sched.recent_requests(last)})
         elif path == "/debug/stacks":
             body = _flight.thread_stacks().encode()
             self.send_response(200)
@@ -163,6 +175,7 @@ class CompletionHandler(BaseHTTPRequestHandler):
                     seed=body.get("seed"),
                     logprobs=bool(body.get("logprobs", False)),
                     priority=body.get("priority", "normal"),
+                    slo=body.get("slo"),
                     ttl_s=body.get("ttl_s"),
                     trace_id=trace_id)
         except BackpressureError as e:
@@ -199,6 +212,19 @@ class CompletionHandler(BaseHTTPRequestHandler):
                        int(getattr(sr.req, "cached_tokens", 0) or 0)}}
         if sr.req.logprobs is not None:
             out["logprobs"] = sr.req.logprobs
+        if os.environ.get("PT_SERVE_TIMING", "") not in ("", "0"):
+            tl = getattr(sr, "timeline", None)
+            if tl is not None and tl.marks:
+                out["timing"] = {
+                    "e2e_s": round(tl.elapsed(), 6),
+                    "ttft_s": (None if tl.ttft() is None
+                               else round(tl.ttft(), 6)),
+                    "phases": {k: round(v, 6)
+                               for k, v in tl.phases().items()},
+                    "slo": getattr(sr, "slo", None),
+                    "slo_attained": getattr(sr, "slo_attained", None),
+                    "violated_phase": getattr(sr, "violated_phase",
+                                              None)}
         return out
 
     def _blocking(self, sr):

@@ -287,6 +287,39 @@ def test_chaos_bench_recovers_token_identical(monkeypatch):
     assert out["baseline_tokens_per_sec"] > 0
 
 
+def test_slo_bench_accounts_every_request(monkeypatch):
+    """PT_SERVE_SLO=1 (ISSUE 14): the goodput artifact must account
+    every request exactly once (attained + violated == requests),
+    reconcile goodput against total tokens, and ship per-phase latency
+    percentiles off the stitched timelines."""
+    bm = _load_bench_models()
+    for env in ("PT_SERVE_SPEC", "PT_SERVE_CACHE", "PT_SERVE_PREFIX",
+                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN",
+                "PT_SERVE_PIPELINE", "PT_SERVE_CHAOS",
+                "PT_SERVE_DISAGG", "PT_SERVE_RAGGED", "PT_SERVE_LEAN"):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv("PT_SERVE_SLO", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "slo-goodput"
+    assert out["requests"] == out["interactive"] + out["batch"] > 0
+    n_att = sum(out["slo_attained"].values())
+    assert n_att + out["slo_violated"] == out["requests"], out
+    assert sum(out["violations_by_phase"].values()) == \
+        out["slo_violated"], out
+    assert 0 < out["goodput_tokens"] <= out["total_tokens"] \
+        or out["slo_violated"] == out["requests"], out
+    assert out["goodput_ratio"] == (
+        0.0 if not out["total_tokens"] else
+        round(out["goodput_tokens"] / out["total_tokens"], 6))
+    pl = out["phase_latency"]
+    assert set(pl) == {"queued", "prefill", "decode", "preempted",
+                       "handoff"}
+    # every request spent measurable time queued and decoding
+    assert pl["decode"]["count"] == out["requests"]
+    assert pl["decode"]["p50_s"] <= pl["decode"]["p99_s"]
+    assert out["tokens_per_sec"] > 0
+
+
 def test_disagg_bench_migrates_and_matches(monkeypatch):
     """PT_SERVE_DISAGG=1 (ISSUE 13 acceptance): the 1 prefill + 1
     decode topology must actually migrate every eligible request

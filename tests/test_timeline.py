@@ -1,0 +1,595 @@
+"""Request timelines + SLO/goodput plane (ISSUE 14). Acceptance
+asserted here:
+
+  * every request carries an append-only host-clock timeline whose
+    phase intervals TILE its life (phases sum to e2e exactly — the
+    "within 5%" wire check is really a stitching check);
+  * the timeline survives crash requeue (PT_FAULTS) and cross-replica
+    migration (disagg KVHandoff): one contiguous, monotonic ledger
+    with the `requeued` / `handoff_export → migrate` segments present;
+  * SLO classes (interactive/batch, defaulting from priority) judge
+    at finalize: `pt_slo_{attained,violated}_total` with the violation
+    attributed to its dominant phase, goodput vs total tokens;
+  * the step-time anomaly sentinel (EWMA + MAD, fed on the pump,
+    analyzed on the scrape thread) flags an injected step stall;
+  * satellite 1: Histogram percentiles landing in the +Inf bucket
+    return the largest finite edge (flagged lower bound), never inf;
+  * satellite 2: router /metrics scrapes replicas OUTSIDE the router
+    lock and times each into pt_router_scrape_seconds{replica=};
+  * the whole plane is observational: token outputs are identical
+    with PT_SERVE_TIMELINE=0, and disabling it nulls the timelines.
+"""
+import threading
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models import llama_spmd as M
+from paddle_tpu.models.llama_serving import ServingEngine
+from paddle_tpu.serving import (FaultPlan, MetricsRegistry,
+                                RequestScheduler, Router, ServingClient,
+                                ServingServer, StepAnomalySentinel,
+                                Timeline, build_replicas, judge_slo,
+                                resolve_slo, slo_targets)
+
+CFG = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                       ffn=64, seq=128)
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(CFG, seed=0, dtype=jnp.float32)
+
+
+def _engine(params, faults=None, **kw):
+    kw.setdefault("max_seqs", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("use_pallas", False)
+    kw.setdefault("prefix_cache", True)
+    return ServingEngine(params, CFG, faults=faults, **kw)
+
+
+def assert_tiled(tl, tol=0.05):
+    """The stitched ledger's core invariant: monotonic stamps, phases
+    summing to end-to-end (exactly by construction; 5% is the wire
+    acceptance tolerance)."""
+    stamps = [t for _, t in tl.marks]
+    assert stamps == sorted(stamps), tl.marks
+    total = sum(tl.phases().values())
+    assert total == pytest.approx(tl.elapsed(), rel=tol, abs=1e-6), \
+        (tl.phases(), tl.elapsed())
+
+
+# ---------------------------------------------------------------------------
+# Timeline unit: phase attribution tiles the request's life
+# ---------------------------------------------------------------------------
+class TestTimelineUnit:
+    def test_phases_tile_preempted_life(self):
+        tl = Timeline()
+        for name, t in [("submit", 0.0), ("admit", 1.0),
+                        ("first_token", 3.0), ("preempted", 4.0),
+                        ("resumed", 5.0), ("end", 7.0)]:
+            tl.mark(name, t=t)
+        assert tl.phases() == {"queued": 1.0, "prefill": 2.0,
+                               "decode": 3.0, "preempted": 1.0}
+        assert sum(tl.phases().values()) == tl.elapsed() == 7.0
+        assert tl.ttft() == 3.0
+        assert tl.tpot(tokens=5) == pytest.approx(1.0)
+        # decode segments merge across the annotation-only end mark
+        assert tl.segments() == [("queued", 0.0, 1.0),
+                                 ("prefill", 1.0, 3.0),
+                                 ("decode", 3.0, 4.0),
+                                 ("preempted", 4.0, 5.0),
+                                 ("decode", 5.0, 7.0)]
+
+    def test_resume_before_first_token_is_prefill(self):
+        tl = Timeline()
+        for name, t in [("submit", 0.0), ("admit", 1.0),
+                        ("preempted", 2.0), ("resumed", 3.0),
+                        ("first_token", 4.0), ("end", 5.0)]:
+            tl.mark(name, t=t)
+        assert tl.phases() == {"queued": 1.0, "prefill": 2.0,
+                               "preempted": 1.0, "decode": 1.0}
+
+    def test_migration_marks_open_the_right_phases(self):
+        # export side: submit/admit/first_token/handoff_export, then
+        # the decode side stitches migrate -> admit -> end on top
+        tl = Timeline()
+        for name, t in [("submit", 0.0), ("admit", 1.0),
+                        ("first_token", 2.0), ("handoff_export", 3.0)]:
+            tl.mark(name, t=t)
+        tl2 = Timeline.from_dict(tl.to_dict())
+        for name, t in [("migrate", 4.0), ("admit", 5.0),
+                        ("handoff_import", 5.5), ("end", 7.0)]:
+            tl2.mark(name, t=t)
+        assert tl2.phases() == {"queued": 2.0, "prefill": 1.0,
+                                "handoff": 1.0, "decode": 3.0}
+        assert sum(tl2.phases().values()) == tl2.elapsed() == 7.0
+        # the original is untouched (from_dict copies)
+        assert len(tl.marks) == 4
+
+    def test_roundtrip_and_steps(self):
+        tl = Timeline()
+        tl.mark("submit", t=1.5)
+        tl.count("prefill", 3)
+        tl.count("decode")
+        tl.count("decode")
+        d = tl.to_dict()
+        back = Timeline.from_dict(d)
+        assert back.marks == [("submit", 1.5)]
+        assert back.steps == {"prefill": 3, "decode": 2}
+        assert Timeline.from_dict(None) is None
+        assert Timeline.from_dict({}) is None
+
+    def test_spill_restore_are_annotations(self):
+        tl = Timeline()
+        for name, t in [("submit", 0.0), ("admit", 1.0),
+                        ("first_token", 2.0), ("spill", 2.5),
+                        ("restore", 3.0), ("end", 4.0)]:
+            tl.mark(name, t=t)
+        # annotations never open a phase: decode runs 2.0 -> 4.0
+        assert tl.phases() == {"queued": 1.0, "prefill": 1.0,
+                               "decode": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# SLO resolution + judgement
+# ---------------------------------------------------------------------------
+class TestSloUnit:
+    def test_resolve_explicit_wins_and_priority_defaults(self):
+        assert resolve_slo("batch", "high") == "batch"
+        assert resolve_slo(None, "high") == "interactive"
+        assert resolve_slo(None, "low") == "batch"
+        assert resolve_slo(None, "normal") is None
+        with pytest.raises(ValueError):
+            resolve_slo("platinum", "normal")
+
+    def test_targets_env_override(self, monkeypatch):
+        monkeypatch.setenv("PT_SLO_INTERACTIVE_TTFT_S", "0.25")
+        assert slo_targets("interactive") == (0.25, 0.1)
+        monkeypatch.delenv("PT_SLO_INTERACTIVE_TTFT_S")
+        assert slo_targets("interactive") == (1.0, 0.1)
+
+    def test_judge_attained(self):
+        ok, ph = judge_slo("interactive", 0.5, 0.05,
+                           {"queued": 0.1, "prefill": 0.4})
+        assert ok is True and ph is None
+
+    def test_ttft_miss_blames_dominant_pre_token_phase(self):
+        ok, ph = judge_slo("interactive", 5.0, 0.05,
+                           {"queued": 4.0, "prefill": 0.9,
+                            "decode": 0.1})
+        assert ok is False and ph == "queued"
+        ok, ph = judge_slo("interactive", 5.0, 0.05,
+                           {"queued": 0.2, "handoff": 4.0,
+                            "decode": 9.0})
+        assert ok is False and ph == "handoff"
+
+    def test_tpot_miss_blames_dominant_post_token_phase(self):
+        ok, ph = judge_slo("interactive", 0.5, 2.0,
+                           {"queued": 0.1, "prefill": 0.3,
+                            "decode": 8.0, "preempted": 1.0})
+        assert ok is False and ph == "decode"
+
+    def test_worse_overshoot_picks_the_budget(self):
+        # ttft 2x over, tpot 30x over -> tpot budget judges, decode
+        # pool wins even though prefill is the biggest phase overall
+        ok, ph = judge_slo("interactive", 2.0, 3.0,
+                           {"prefill": 10.0, "decode": 1.0,
+                            "queued": 0.5})
+        assert ok is False and ph == "decode"
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: histogram percentiles in the overflow bucket
+# ---------------------------------------------------------------------------
+class TestHistogramOverflow:
+    def test_overflow_percentile_is_finite_lower_bound(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t_seconds")   # default buckets end at 30s
+        for v in (100.0, 200.0, 300.0):
+            h.observe(v)
+        p99, over = h.percentile_overflow(99)
+        assert p99 == 30.0 and over is True
+        assert h.percentile(50) == 30.0
+        snap = h._snap()
+        assert snap["p99"] == 30.0
+        assert snap["p99_lower_bound"] is True
+        assert snap["p50_lower_bound"] is True
+
+    def test_in_range_percentiles_unflagged(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("u_seconds")
+        for v in (0.01, 0.02, 0.03, 0.04):
+            h.observe(v)
+        v, over = h.percentile_overflow(50)
+        assert over is False and 0.0 < v < 30.0
+        snap = h._snap()
+        assert "p50_lower_bound" not in snap
+        assert "p99_lower_bound" not in snap
+
+    def test_mixed_tail_in_overflow(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("v_seconds")
+        for _ in range(99):
+            h.observe(0.01)
+        h.observe(1000.0)
+        assert h.percentile(50) < 0.1
+        p99, over = h.percentile_overflow(100)
+        assert p99 == 30.0 and over is True
+
+
+# ---------------------------------------------------------------------------
+# Anomaly sentinel unit
+# ---------------------------------------------------------------------------
+class TestSentinelUnit:
+    def test_spike_fires_after_warmup_and_is_excluded(self):
+        s = StepAnomalySentinel(warmup=20, k=8.0, floor_s=0.05)
+        for _ in range(25):
+            s.note(0.01, 1, 1)
+        assert s.scan() == []
+        s.note(1.0, 0, 2)
+        out = s.scan()
+        assert len(out) == 1
+        a = out[0]
+        assert a["step_s"] == 1.0 and a["decode_slots"] == 2
+        assert a["threshold_s"] < 0.1
+        # the flagged stall must NOT widen the band for the next one
+        s.note(1.0)
+        out2 = s.scan()
+        assert len(out2) == 1 and out2[0]["mean_s"] < 0.05
+
+    def test_small_wobble_under_floor_never_fires(self):
+        s = StepAnomalySentinel(warmup=10, floor_s=0.05)
+        for _ in range(30):
+            s.note(0.01)
+        s.note(0.04)          # +30ms wobble: under the 50ms floor
+        assert s.scan() == []
+
+    def test_warmup_suppresses_early_judgement(self):
+        s = StepAnomalySentinel(warmup=20)
+        s.note(0.01)
+        s.note(5.0)           # would be a stall, but baseline too young
+        assert s.scan() == []
+
+
+# ---------------------------------------------------------------------------
+# Scheduler integration: the live plane
+# ---------------------------------------------------------------------------
+class TestSchedulerTimeline:
+    def test_lifecycle_slo_and_goodput(self, params):
+        sched = RequestScheduler(_engine(params), max_queue=8,
+                                 metrics=MetricsRegistry())
+        try:
+            hi = sched.submit([1, 2, 3, 4], max_new_tokens=6,
+                              slo="interactive")
+            lo = sched.submit([5, 6, 7, 8, 9], max_new_tokens=4,
+                              priority="low")
+            o1, o2 = hi.result(timeout=120), lo.result(timeout=120)
+            assert hi.slo == "interactive" and lo.slo == "batch"
+            for h in (hi, lo):
+                tl = h.timeline
+                assert tl.has("submit") and tl.has("admit") \
+                    and tl.has("first_token") and tl.has("end")
+                assert_tiled(tl)
+                assert tl.steps.get("prefill", 0) >= 1
+                assert tl.steps.get("decode", 0) >= 1
+                assert h.slo_attained in (True, False)
+            snap = sched.metrics_snapshot()
+            total = snap["pt_tokens"]["value"]
+            good = snap["pt_goodput_tokens"]["value"]
+            assert total == len(o1) + len(o2) == 10
+            assert 0 <= good <= total
+            n_jud = sum(m["value"] for k, m in snap.items()
+                        if k.startswith(("pt_slo_attained{",
+                                         "pt_slo_violated{")))
+            assert n_jud == 2
+            # per-phase latency histograms observed each request once
+            assert snap["pt_phase_decode_seconds"]["count"] == 2
+            # the recent-requests ring carries the same ledger
+            rec = sched.recent_requests(10)
+            assert {e["rid"] for e in rec} == {hi.rid, lo.rid}
+            for e in rec:
+                assert e["state"] == "done" and e["phases"]
+                assert sum(e["phases"].values()) == pytest.approx(
+                    e["e2e_s"], rel=0.05, abs=1e-6)
+        finally:
+            sched.shutdown(drain=False, timeout=30)
+
+    def test_forced_violation_attributes_a_phase(self, params,
+                                                 monkeypatch):
+        monkeypatch.setenv("PT_SLO_INTERACTIVE_TTFT_S", "1e-9")
+        sched = RequestScheduler(_engine(params), max_queue=8,
+                                 metrics=MetricsRegistry())
+        try:
+            h = sched.submit([1, 2, 3], max_new_tokens=4,
+                             slo="interactive")
+            h.result(timeout=120)
+            assert h.slo_attained is False
+            assert h.violated_phase in ("queued", "prefill",
+                                        "handoff", "preempted")
+            snap = sched.metrics_snapshot()
+            key = ('pt_slo_violated{phase="%s"}' % h.violated_phase)
+            assert snap[key]["value"] == 1
+            # a violated request's tokens are NOT goodput
+            assert snap["pt_goodput_tokens"]["value"] == 0
+            assert snap["pt_tokens"]["value"] == 4
+        finally:
+            sched.shutdown(drain=False, timeout=30)
+
+    def test_plane_off_is_token_identical_and_null(self, params,
+                                                   monkeypatch):
+        prompts = [[1, 2, 3, 4], [5, 6, 7], [2, 4, 6, 8, 10]]
+
+        def run():
+            sched = RequestScheduler(_engine(params), max_queue=8,
+                                     metrics=MetricsRegistry())
+            try:
+                hs = [sched.submit(p, max_new_tokens=5)
+                      for p in prompts]
+                return [h.result(timeout=120) for h in hs], hs
+            finally:
+                sched.shutdown(drain=False, timeout=30)
+
+        on_outs, on_hs = run()
+        monkeypatch.setenv("PT_SERVE_TIMELINE", "0")
+        off_outs, off_hs = run()
+        assert on_outs == off_outs
+        assert all(h.timeline is not None for h in on_hs)
+        assert all(h.timeline is None for h in off_hs)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3a: stitching across crash requeue
+# ---------------------------------------------------------------------------
+class TestRequeueStitch:
+    def test_requeued_request_has_one_contiguous_timeline(self, params):
+        sched = RequestScheduler(
+            _engine(params, faults=FaultPlan("step_launch:raise@2")),
+            max_queue=8, metrics=MetricsRegistry())
+        try:
+            sched.pause()
+            hs = [sched.submit([1 + i, 5, 9, 3], max_new_tokens=6)
+                  for i in range(3)]
+            sched.resume()
+            outs = [h.result(timeout=120) for h in hs]
+            assert all(len(o) == 6 for o in outs)
+            requeued = [h for h in hs if h.timeline.has("requeued")]
+            assert requeued, "fault at step 2 requeued nobody"
+            for h in requeued:
+                tl = h.timeline
+                assert_tiled(tl)
+                assert tl.has("first_token") and tl.has("end")
+                # requeue reopens the queued phase mid-life
+                assert tl.phases().get("queued", 0.0) > 0.0
+            # untouched requests stitched nothing extra
+            rec = {e["rid"]: e for e in sched.recent_requests(10)}
+            for h in hs:
+                assert rec[h.rid]["requeues"] == h._requeues
+        finally:
+            sched.shutdown(drain=False, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3b: stitching across disagg migration
+# ---------------------------------------------------------------------------
+class TestMigrationStitch:
+    def test_migrated_request_has_one_stitched_timeline(self, params):
+        reps = build_replicas(lambda i: _engine(params), 2,
+                              roles=["prefill", "decode"], max_queue=8)
+        router = Router(reps)
+        try:
+            hs = [router.submit([1 + i, 5, 9, 3, 7], max_new_tokens=6,
+                                slo="interactive") for i in range(2)]
+            outs = [h.result(timeout=120) for h in hs]
+            assert all(len(o) == 6 for o in outs)
+            assert reps[0].engine.handoff_exports >= 2
+            for h in hs:
+                tl = h.timeline     # the decode-side (owning) ledger
+                for m in ("submit", "handoff_export", "migrate",
+                          "first_token", "end"):
+                    assert tl.has(m), (m, tl.marks)
+                assert_tiled(tl)
+                assert tl.phases().get("handoff", 0.0) > 0.0
+                # prefill steps stamped on the EXPORTING side survive
+                assert tl.steps.get("prefill", 0) >= 1
+            # the decode replica's ring owns the terminal entries; the
+            # prefill side closed its half as state="handoff"
+            dec = {e["rid"] for e in reps[1].recent_requests(10)
+                   if e["state"] == "done"}
+            pre = {e["rid"]: e for e in reps[0].recent_requests(10)}
+            for h in hs:
+                assert h._sr.rid in dec
+                assert pre[h.rid]["state"] == "handoff"
+        finally:
+            router.shutdown(drain=False, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: router scrape discipline + timing gauges
+# ---------------------------------------------------------------------------
+class TestRouterScrape:
+    def test_scrape_gauges_and_aggregated_slo_series(self, params):
+        reps = build_replicas(lambda i: _engine(params), 2,
+                              max_queue=8)
+        router = Router(reps)
+        try:
+            hs = [router.submit([1 + i, 5, 9], max_new_tokens=4,
+                                slo="batch") for i in range(2)]
+            for h in hs:
+                h.result(timeout=120)
+            text = router.render_prometheus()
+            for rid in router.replica_ids:
+                assert f'pt_router_scrape_seconds{{replica="{rid}"}}' \
+                    in text
+            assert 'pt_slo_attained_total{' in text
+            assert 'pt_goodput_tokens_total{' in text
+            # aggregation rewrote each replica's series with its tag
+            assert 'slo="batch"' in text and 'replica="' in text
+            rec = router.recent_requests(10)
+            assert len(rec) == 2
+            assert {e["replica"] for e in rec} <= \
+                set(router.replica_ids)
+            stamps = [e["marks"][-1][1] for e in rec]
+            assert stamps == sorted(stamps)
+        finally:
+            router.shutdown(drain=False, timeout=30)
+
+    def test_slow_replica_scrape_does_not_hold_router_lock(self,
+                                                           params):
+        reps = build_replicas(lambda i: _engine(params), 2,
+                              max_queue=8)
+        router = Router(reps)
+        try:
+            slow = reps[0].scheduler
+            orig = slow.render_prometheus
+            entered = threading.Event()
+
+            def crawl():
+                entered.set()
+                time.sleep(0.5)
+                return orig()
+            slow.render_prometheus = crawl
+            t = threading.Thread(target=router.render_prometheus)
+            t.start()
+            assert entered.wait(5)
+            t0 = time.perf_counter()
+            with router._lock:      # TPL004: scrape happens outside
+                pass
+            waited = time.perf_counter() - t0
+            t.join(10)
+            assert waited < 0.25, \
+                f"router lock held through a {waited:.2f}s scrape"
+        finally:
+            router.shutdown(drain=False, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Anomaly sentinel on a live engine: injected step stall
+# ---------------------------------------------------------------------------
+class TestAnomalyLive:
+    def test_injected_delay_fires_sentinel(self, params):
+        from paddle_tpu.observability import flight_recorder as _flight
+        sched = RequestScheduler(
+            _engine(params, faults=FaultPlan(
+                "step_launch:delay@30:delay=0.5")),
+            max_queue=4, metrics=MetricsRegistry())
+        try:
+            h = sched.submit([1, 2, 3, 4], max_new_tokens=45)
+            out = h.result(timeout=180)
+            assert len(out) == 45
+            snap = sched.metrics_snapshot()   # scan runs on scrape
+            assert snap["pt_step_anomalies"]["value"] >= 1, snap.get(
+                "pt_step_anomalies")
+            evs = _flight.snapshot()["events"]
+            stalls = [e for e in evs
+                      if e.get("kind") == "anomaly.step_stall"]
+            assert stalls
+            a = stalls[-1]
+            assert a["step_s"] > a["threshold_s"] > a["mean_s"] > 0
+        finally:
+            sched.shutdown(drain=False, timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance e2e: mixed SLO workload over real HTTP, disagg + crash
+# ---------------------------------------------------------------------------
+class TestTimelineHTTP:
+    def test_acceptance_slo_plane_over_http(self, params, monkeypatch):
+        # interactive TTFT target is impossible -> every interactive
+        # request violates (attributed to a named phase); batch attains
+        monkeypatch.setenv("PT_SLO_INTERACTIVE_TTFT_S", "1e-9")
+        # batch must deterministically ATTAIN even on a crawling CI box
+        monkeypatch.setenv("PT_SLO_BATCH_TTFT_S", "600")
+        monkeypatch.setenv("PT_SLO_BATCH_TPOT_S", "600")
+        monkeypatch.setenv("PT_SERVE_TIMING", "1")
+        # one injected crash: the decode replica's FIRST device step
+        # raises; recovery requeues the migrated victims and finishes
+        reps = build_replicas(
+            lambda i: _engine(params, max_seqs=4,
+                              faults=FaultPlan("step_launch:raise@1")
+                              if i == 1 else None),
+            2, roles=["prefill", "decode"], max_queue=16)
+        router = Router(reps)
+        srv = ServingServer(router, port=0).start()
+        try:
+            cl = ServingClient(port=srv.port, retries=4)
+            results = {}
+
+            def call(i, slo):
+                results[i] = cl.complete(
+                    [1 + i, 5, 9, 3], max_tokens=6, slo=slo)
+            threads = [threading.Thread(
+                target=call, args=(i, "interactive" if i % 2 else
+                                   "batch")) for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(results) == 6
+            for i, r in results.items():
+                assert r["state"] == "done" and len(r["tokens"]) == 6
+                tm = r["timing"]    # PT_SERVE_TIMING=1 opt-in block
+                assert tm["slo"] in ("interactive", "batch")
+                assert sum(tm["phases"].values()) == pytest.approx(
+                    tm["e2e_s"], rel=0.05, abs=1e-6)
+                if tm["slo"] == "interactive":
+                    assert tm["slo_attained"] is False
+                    assert tm["violated_phase"] in (
+                        "queued", "prefill", "handoff", "preempted")
+            # /debug/requests: every completed request, stitched
+            dbg = cl.debug_requests(last=50)["requests"]
+            done = {e["rid"]: e for e in dbg if e["state"] == "done"}
+            assert len(done) == 6
+            for e in done.values():
+                assert e["replica"] in router.replica_ids
+                assert sum(e["phases"].values()) == pytest.approx(
+                    e["e2e_s"], rel=0.05, abs=1e-6)
+            # /metrics: goodput + SLO counters aggregated with labels
+            text = cl.metrics_text()
+            att = [ln for ln in text.splitlines()
+                   if ln.startswith("pt_slo_attained_total{")]
+            vio = [ln for ln in text.splitlines()
+                   if ln.startswith("pt_slo_violated_total{")]
+            assert att and sum(
+                float(ln.rsplit(" ", 1)[1]) for ln in att) >= 3
+            assert vio and sum(
+                float(ln.rsplit(" ", 1)[1]) for ln in vio) >= 3
+            assert any('phase="' in ln for ln in vio)
+            good = [ln for ln in text.splitlines()
+                    if ln.startswith("pt_goodput_tokens_total{")]
+            assert good and sum(
+                float(ln.rsplit(" ", 1)[1]) for ln in good) > 0
+            assert 'pt_router_scrape_seconds{replica="' in text
+        finally:
+            srv.stop(drain=False, timeout=30)
+
+    def test_bad_slo_is_a_400(self, params):
+        sched = RequestScheduler(_engine(params), max_queue=4,
+                                 metrics=MetricsRegistry())
+        srv = ServingServer(sched, port=0).start()
+        try:
+            from paddle_tpu.serving import ServingHTTPError
+            cl = ServingClient(port=srv.port)
+            with pytest.raises(ServingHTTPError) as ei:
+                cl.complete([1, 2, 3], max_tokens=2, slo="platinum")
+            assert ei.value.status == 400
+            assert "slo" in str(ei.value)
+        finally:
+            srv.stop(drain=False, timeout=30)
+
+    def test_timing_block_absent_by_default(self, params, monkeypatch):
+        monkeypatch.delenv("PT_SERVE_TIMING", raising=False)
+        sched = RequestScheduler(_engine(params), max_queue=4,
+                                 metrics=MetricsRegistry())
+        srv = ServingServer(sched, port=0).start()
+        try:
+            cl = ServingClient(port=srv.port)
+            r = cl.complete([1, 2, 3], max_tokens=2)
+            assert "timing" not in r
+        finally:
+            srv.stop(drain=False, timeout=30)

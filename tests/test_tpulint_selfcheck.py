@@ -145,6 +145,28 @@ def test_handoff_functions_in_hot_set():
     assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
 
 
+def test_timeline_functions_in_hot_set():
+    """ISSUE 14: the timeline/SLO plane is host-clock-only by contract
+    — marks stamp on the pump and engine loops, finalize judges SLOs,
+    the sentinel's note() runs once per step. All of it sits in the
+    TPL001 hot set so a device pull can never sneak into the
+    observability plane, and the single sanctioned sync is STILL the
+    batched reader alone (the plane added zero device reads)."""
+    from paddle_tpu.analysis.config import LintConfig
+
+    cfg = LintConfig.default()
+    for fn in ("Timeline.mark", "Timeline.count",
+               "Timeline.segments", "Timeline.phases",
+               "StepAnomalySentinel.note",
+               "RequestScheduler._finalize",
+               "RequestScheduler._account_slo",
+               "RequestScheduler._timeline_entry"):
+        assert fn in cfg.hot_functions, fn
+    assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
+    # timeline.py lives in serving/ -> covered by the hot-module glob
+    assert cfg.is_hot_module("paddle_tpu/serving/timeline.py")
+
+
 def test_sanctioned_sync_config_check(tmp_path):
     """The TPL001 config check: a raw jax.device_get anywhere in a hot
     serving module — even outside the configured hot functions — is a

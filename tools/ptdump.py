@@ -190,6 +190,48 @@ def print_flight(doc, tail=30, kind=None, out=sys.stdout):
         if gaps:
             w(f", avg host gap {sum(gaps) / len(gaps) * 1e6:.0f}us")
         w(f", pipeline depth {int(depth)}\n")
+    # request-timeline rollup: request.done records with a `phases`
+    # breakdown (the stitched per-request ledger) — per-phase p50/p99,
+    # the SLO violations attributed to each phase, and the slowest
+    # requests end-to-end with where their time went
+    dones = [e for e in events
+             if e.get("kind") == "request.done" and e.get("phases")]
+    if dones:
+        w(f"  request timelines: {len(dones)} completed\n")
+        by_phase = {}
+        for e in dones:
+            for ph, s in (e.get("phases") or {}).items():
+                by_phase.setdefault(ph, []).append(float(s))
+        w(f"    {'phase':<12}{'n':>6}{'p50_ms':>10}{'p99_ms':>10}\n")
+        for ph, xs in sorted(by_phase.items()):
+            xs.sort()
+            p50 = xs[int(0.50 * (len(xs) - 1))]
+            p99 = xs[int(0.99 * (len(xs) - 1))]
+            w(f"    {ph:<12}{len(xs):>6}{p50 * 1e3:>10.2f}"
+              f"{p99 * 1e3:>10.2f}\n")
+        viols = {}
+        for e in dones:
+            if e.get("slo_attained") is False:
+                ph = e.get("violated_phase") or "?"
+                viols[ph] = viols.get(ph, 0) + 1
+        if viols:
+            w("    slo violations by phase: "
+              + ", ".join(f"{k}={n}" for k, n in sorted(viols.items()))
+              + "\n")
+        slow = sorted(dones, key=lambda e: -(e.get("e2e_s") or 0.0))[:5]
+        w("    slowest:\n")
+        for e in slow:
+            br = " ".join(f"{k}={v * 1e3:.1f}ms" for k, v in
+                          sorted((e.get("phases") or {}).items()) if v)
+            w(f"      {e.get('rid')}: {(e.get('e2e_s') or 0) * 1e3:.1f}ms"
+              f" ({e.get('tokens')} tok) {br}\n")
+    anoms = [e for e in events if e.get("kind") == "anomaly.step_stall"]
+    if anoms:
+        last = anoms[-1]
+        w(f"  step anomalies: {len(anoms)} flagged; last "
+          f"{(last.get('step_s') or 0) * 1e3:.1f}ms vs baseline "
+          f"{(last.get('mean_s') or 0) * 1e3:.1f}ms "
+          f"(threshold {(last.get('threshold_s') or 0) * 1e3:.1f}ms)\n")
     health = [e for e in events if e.get("kind") == "health"]
     if health:
         bad = sum(e.get("count", 0) or 0 for e in health)
