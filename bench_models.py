@@ -232,6 +232,13 @@ def bench_serving(on_tpu):
     # (docs/observability.md § Request timelines & SLO accounting)
     if (os.environ.get("PT_SERVE_SLO", "") or "0") not in ("", "0"):
         return _bench_serving_slo(on_tpu, params, cfg, dtype)
+    # PT_SERVE_PULSE=1 (bench mode): the telemetry pulse plane smoke —
+    # the sampler's per-tick self-cost stays bounded against a live
+    # registry, and a forced-stall drill (seeded FaultPlan delay) lands
+    # as a step-time spike in the rings plus EXACTLY ONE rate-limited
+    # capture bundle (docs/observability.md § Pulse & capture bundles)
+    if (os.environ.get("PT_SERVE_PULSE", "") or "0") not in ("", "0"):
+        return _bench_serving_pulse(on_tpu, params, cfg, dtype)
 
     rng = _data_rng()
     if prefix_mode:
@@ -1145,6 +1152,126 @@ def _bench_serving_slo(on_tpu, params, cfg, dtype):
         "violations_by_phase": violated_by_phase,
         "phase_latency": phase_latency,
         "step_anomalies": d_ctr("pt_step_anomalies"),
+        "tokens_per_sec": round(total / dt, 1) if dt else 0.0,
+        "loss": 0.0,
+    }
+
+
+def _bench_serving_pulse(on_tpu, params, cfg, dtype):
+    """PT_SERVE_PULSE=1 (bench mode): the telemetry pulse plane smoke
+    (ISSUE 15). One pipelined-pump engine runs a decode workload under
+    a seeded `FaultPlan` that delays a single device-step launch well
+    past the anomaly sentinel's band; the pulse plane (sampling at a
+    tight bench interval) must show the stall as a spike in the
+    step-time ring and write EXACTLY ONE capture bundle (the min-
+    interval rate limit swallows any repeat triggers). The artifact
+    also times the sampler's full tick — scan + registry snapshot +
+    ring folds + trigger check — against the live registry, the cost
+    every scrape and pulse-thread pass pays; it must stay bounded."""
+    import statistics
+    import tempfile
+    from paddle_tpu.models.llama_serving import ServingEngine
+    from paddle_tpu.serving import FaultPlan, MetricsRegistry, \
+        RequestScheduler
+
+    if on_tpu:
+        max_seqs, new_tok, nreq = 8, 64, 8
+        max_seq_len, page = 512, 16
+        fault_spec = "step_launch:delay@40:delay=0.5"
+    else:
+        max_seqs, new_tok, nreq = 4, 48, 4
+        max_seq_len, page = 128, 8
+        fault_spec = "step_launch:delay@30:delay=0.5"
+    rng = _data_rng()
+    prompts = [list(map(int, rng.randint(
+        1, cfg.vocab_size, 16 if on_tpu else 4))) for _ in range(nreq)]
+
+    def make(faults=None):
+        eng = ServingEngine(params, cfg, max_seqs=max_seqs,
+                            max_seq_len=max_seq_len, page_size=page,
+                            dtype=dtype, prefix_cache=True,
+                            use_pallas=None if on_tpu else False,
+                            faults=FaultPlan(faults) if faults else None)
+        return RequestScheduler(eng, max_queue=nreq + 1,
+                                metrics=MetricsRegistry(),
+                                pipeline=True)
+
+    cap_dir = tempfile.mkdtemp(prefix="pt_pulse_bench_")
+    knobs = {"PT_PULSE_INTERVAL_S": "0.05", "PT_CAPTURE_DIR": cap_dir,
+             "PT_CAPTURE_MIN_S": "600", "PT_CAPTURE_MAX": "8"}
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    try:
+        # warm the compile caches first: the drill's early steps must
+        # be real decode steps, not XLA compiles, so the sentinel's
+        # baseline has settled before the injected stall lands
+        warm = make()
+        warm.submit(prompts[0], max_new_tokens=2).result(timeout=600)
+        warm.shutdown(drain=True, timeout=60)
+
+        sched = make(fault_spec)
+        plane = sched._pulse
+        assert plane is not None and plane.thread_alive, \
+            "pulse plane must be live in bench mode"
+        t0 = time.perf_counter()
+        handles = [sched.submit(p, max_new_tokens=new_tok)
+                   for p in prompts]
+        outs = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+        # deterministic final pass: drain the sentinel, judge triggers,
+        # land the bundle before any assert reads the plane's state
+        plane.tick()
+        # sampler self-cost: K full ticks against the now-populated
+        # registry (the per-scrape overhead the plane adds)
+        costs = []
+        for _ in range(20):
+            c0 = time.perf_counter()
+            plane.tick()
+            costs.append(time.perf_counter() - c0)
+        payload = sched.pulse()
+        scrape_self = sched.metrics_snapshot().get(
+            "pt_scrape_self_seconds") or {}
+        sched.shutdown(drain=True, timeout=60)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    series = payload["signals"].get("pt_serving_step_seconds:p99") or []
+    vals = [v for _, v in series if v]
+    med = statistics.median(vals) if vals else 0.0
+    spike = round(max(vals) / med, 2) if med > 0 else 0.0
+    tick_mean = statistics.mean(costs)
+    bundles = sorted(d for d in os.listdir(cap_dir)
+                     if d.startswith("bundle-"))
+    total = sum(len(o) for o in outs)
+
+    assert payload["enabled"], payload
+    assert payload["triggers"]["step_stall"] >= 1, payload["triggers"]
+    assert len(bundles) == 1, bundles   # rate limit: one, not a storm
+    with open(os.path.join(cap_dir, bundles[0], "meta.json")) as f:
+        meta = json.load(f)
+    assert meta["trigger"] == "step_stall", meta
+    # bounded: a full tick over this registry is sub-millisecond work;
+    # 25ms leaves slack for a loaded CI box while still catching a
+    # device sync (a TPU round trip alone would blow through it)
+    assert tick_mean < 0.025, f"pulse tick mean {tick_mean:.4f}s"
+    return {
+        "workload": "pulse-plane",
+        "requests": nreq, "batch": max_seqs,
+        "fault_plan": fault_spec,
+        "signals": len(payload["signals"]),
+        "step_p99_spike_x": spike,
+        "stall_triggers": payload["triggers"]["step_stall"],
+        "bundles_written": len(bundles),
+        "bundle_trigger": meta["trigger"],
+        "bundle_trace_ids": len(meta.get("trace_ids") or []),
+        "tick_mean_ms": round(tick_mean * 1e3, 3),
+        "tick_p99_ms": round(sorted(costs)[-1] * 1e3, 3),
+        "scrape_self_ms": round(
+            float(scrape_self.get("value", 0.0)) * 1e3, 3),
         "tokens_per_sec": round(total / dt, 1) if dt else 0.0,
         "loss": 0.0,
     }

@@ -94,6 +94,10 @@ class LintConfig:
             hot_modules=[
                 "paddle_tpu/serving/*.py",
                 "paddle_tpu/models/llama_serving.py",
+                # the pulse plane samples on a daemon thread riding the
+                # scrape cadence — a device pull there would serialize
+                # against the pump's dispatch stream just the same
+                "paddle_tpu/observability/pulse.py",
             ],
             hot_functions=[
                 # ServingEngine per-token loop + its helpers
@@ -138,6 +142,16 @@ class LintConfig:
                 "RequestScheduler._finalize",
                 "RequestScheduler._account_slo",
                 "RequestScheduler._timeline_entry",
+                # pulse plane (ISSUE 15): sampler + bundle writer run
+                # on the pulse/scrape threads against host-side
+                # snapshots only — zero device syncs by lint, so the
+                # observability plane can never stall the pump
+                "PulseSampler.sample",
+                "PulsePlane.tick",
+                "PulsePlane._check_triggers",
+                "PulsePlane._write_bundle",
+                "RequestScheduler._pulse_snapshot",
+                "RequestScheduler._book_depth_locked",
             ],
             bench_paths=[
                 "bench*.py", "tools/*.py", "tests/*.py", "examples/*.py",

@@ -22,6 +22,12 @@ The static half of "why was this step slow" is tpulint
     entry point (FLOPs, bytes, HBM sizes), per-step MFU + roofline
     gauges against a per-generation peak table, and a device-memory
     accountant (`pt_mfu`, `pt_device_*` on `/metrics`).
+  * `pulse`            — telemetry pulse plane: bounded ring-buffer
+    time-series derived generically from metrics snapshots (counter
+    rates, gauge samples, windowed histogram percentiles), the
+    `/debug/pulse` payload + `tools/ptop.py` dashboard feed, and
+    anomaly-triggered capture bundles (`PT_CAPTURE_DIR`) rendered by
+    `tools/ptdump.py bundle`.
   * `health`           — jit-safe training-health monitoring: fused
     loss/grad finite checks + grad-norm/update-ratio computed inside
     traced step functions (one batched transfer per step), GradScaler
@@ -36,7 +42,7 @@ from __future__ import annotations
 
 from . import (  # noqa: F401
     chrome_trace, compile_telemetry, device_telemetry, flight_recorder,
-    health, trace_context,
+    health, pulse, trace_context,
 )
 from . import logging as logging  # noqa: F401,PLC0414 — stdlib-shadowing by design
 from .chrome_trace import chrome_trace_doc  # noqa: F401
@@ -51,13 +57,15 @@ from .health import (  # noqa: F401
     HEALTH, TrainingHealthMonitor, health_stats, nan_blame,
 )
 from .logging import StructuredLogger, get_logger  # noqa: F401
+from .pulse import PulsePlane, PulseRing, PulseSampler  # noqa: F401
 from .trace_context import (  # noqa: F401
     Span, bind, current_trace_id, new_trace_id, span,
 )
 
 __all__ = [
     "chrome_trace", "compile_telemetry", "device_telemetry",
-    "flight_recorder", "health", "trace_context", "logging",
+    "flight_recorder", "health", "pulse", "trace_context", "logging",
+    "PulsePlane", "PulseRing", "PulseSampler",
     "CompileRegistry", "tracked", "track_jit", "signature_of",
     "CostRegistry", "COSTS", "MemoryAccountant", "ACCOUNTANT",
     "device_peaks",

@@ -150,6 +150,18 @@ class ServingClient:
         its `replica` tag."""
         return self._json_call("GET", f"/debug/requests?last={int(last)}")
 
+    def debug_pulse(self, window=None, signals=None):
+        """The pulse plane's ring time-series (/debug/pulse): windowed
+        to the last `window` seconds, filtered to signal-name prefixes
+        in `signals`; behind a router one payload per replica."""
+        q = []
+        if window is not None:
+            q.append(f"window={int(window)}")
+        if signals:
+            q.append("signals=" + ",".join(signals))
+        return self._json_call(
+            "GET", "/debug/pulse" + ("?" + "&".join(q) if q else ""))
+
     def metrics_text(self):
         """Prometheus text exposition."""
         conn, resp = self._request("GET", "/metrics")

@@ -14,7 +14,9 @@ the engine's only serving-package import is the host-side
 from __future__ import annotations
 
 import bisect
+import os
 import threading
+import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
            "EngineMetrics", "DEFAULT_BUCKETS", "GAP_BUCKETS"]
@@ -32,12 +34,21 @@ GAP_BUCKETS = (2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
                0.01, 0.025, 0.05, 0.1, 0.25, 1.0)
 
 
+def _escape_label_value(v):
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition line is
+    invalid (and everything after it unparseable)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _label_suffix(labels):
     """`{k="v",...}` suffix in sorted-key order ('' when unlabeled).
-    Keys sort so the same label set always renders one series name."""
+    Keys sort so the same label set always renders one series name;
+    values are escaped per the Prometheus text-format spec."""
     if not labels:
         return ""
-    return "{" + ",".join(f'{k}="{labels[k]}"'
+    return "{" + ",".join(f'{k}="{_escape_label_value(labels[k])}"'
                           for k in sorted(labels)) + "}"
 
 
@@ -209,6 +220,31 @@ class Histogram(_Metric):
 def _fmt(v):
     f = float(v)
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+_IMPORT_WALL_TIME = time.time()
+
+
+def _process_start_time():
+    """Unix timestamp the process started at (the standard
+    `process_start_time_seconds` convention): /proc starttime ticks
+    since boot plus the boot time, falling back to this module's
+    import wall time where /proc is unavailable."""
+    try:
+        with open("/proc/self/stat") as f:
+            stat = f.read()
+        # field 22 (starttime, clock ticks since boot) counted after
+        # the parenthesized comm — comm may contain spaces, so split
+        # after the LAST ')'
+        ticks = float(stat.rpartition(")")[2].split()[19])
+        hz = float(os.sysconf("SC_CLK_TCK"))
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("btime "):
+                    return float(line.split()[1]) + ticks / hz
+    except (OSError, ValueError, IndexError):
+        pass
+    return _IMPORT_WALL_TIME
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
@@ -482,6 +518,26 @@ class EngineMetrics:
             "pt_goodput_tokens",
             "Output tokens of completed requests that met their SLO "
             "(requests with no SLO class count as delivered).")
+        # pulse plane (observability/pulse.py) + process identity:
+        # start time per the Prometheus convention, the self-cost of
+        # one scrape/sample pass (the pulse plane's overhead is itself
+        # observable), running-slot mix and per-priority queue depth —
+        # the labeled series the pulse rings read trends from
+        self.process_start_time = r.gauge(
+            "pt_process_start_time_seconds",
+            "Unix time the serving process started.")
+        self.process_start_time.set(_process_start_time())
+        self.scrape_self = r.gauge(
+            "pt_scrape_self_seconds",
+            "Wall time of the last metrics scrape / pulse sample pass "
+            "(anomaly scan + snapshot + ring derivation).")
+        self._slot_mix = {
+            kind: r.gauge(
+                "pt_serving_slots",
+                "Occupied engine slots by phase of the request "
+                "holding them.", labels={"kind": kind})
+            for kind in ("prefill", "decode")}
+        self._queue_priority = {}       # priority -> labeled gauge
         self.step_anomalies = r.counter(
             "pt_step_anomalies",
             "Serving steps flagged as stalls by the EWMA+MAD anomaly "
@@ -694,3 +750,25 @@ class EngineMetrics:
     def set_queue_depth(self, depth):
         self.queue_depth.set(depth)
         self.queue_depth_peak.set_to_max(depth)
+
+    def set_queue_depths(self, by_priority):
+        """Per-priority queue depths (labeled gauges) alongside the
+        total `set_queue_depth` already books."""
+        for priority, depth in by_priority.items():
+            g = self._queue_priority.get(priority)
+            if g is None:
+                g = self.registry.gauge(
+                    "pt_serving_queue_depth_priority",
+                    "Requests waiting for a slot, by priority class.",
+                    labels={"priority": priority})
+                self._queue_priority[priority] = g
+            g.set(depth)
+
+    def set_slot_mix(self, prefill, decode):
+        """Running-slot mix sampled by the pump each step."""
+        self._slot_mix["prefill"].set(prefill)
+        self._slot_mix["decode"].set(decode)
+
+    def observe_scrape_self(self, dt):
+        """Self-cost of one scrape/sample pass (scrape-thread side)."""
+        self.scrape_self.set(dt)

@@ -857,6 +857,24 @@ class Router:
         merged.sort(key=lambda e: (e.get("marks") or [[None, 0.0]])[-1][1])
         return merged[-int(n):] if n else merged
 
+    def pulse(self, window=None, signals=None):
+        """Aggregate /debug/pulse across the pool: one payload per
+        replica under `replicas` (the `replica=` tag of the pulse
+        plane), behind the same duck-typed method the single-scheduler
+        server mounts. Same TPL004 discipline as the scrapes: the
+        membership snapshot is taken under the router lock, every
+        replica's (possibly sampling) pulse call runs OUTSIDE it."""
+        with self._lock:
+            items = [(rid, st.replica) for rid, st in
+                     self._replicas.items()]
+        reps = {}
+        for rid, rep in items:
+            sched = getattr(rep, "scheduler", None)
+            if sched is not None and hasattr(sched, "pulse"):
+                reps[rid] = sched.pulse(window=window, signals=signals)
+        return {"enabled": any(p.get("enabled") for p in reps.values()),
+                "replicas": reps}
+
 
 def _relabel(text, rid):
     """Inject replica="<rid>" into every series line of a Prometheus

@@ -267,6 +267,21 @@ class RequestScheduler:
         self._sentinel = StepAnomalySentinel()
         # completed-request ring for /debug/requests
         self._recent = deque(maxlen=256)
+        # pulse plane (observability/pulse.py): ring-buffer time-series
+        # over this registry + anomaly-triggered capture bundles. Its
+        # daemon thread ticks at PT_PULSE_INTERVAL_S; scrapes also
+        # sample opportunistically. PT_SERVE_PULSE=0 -> no plane object,
+        # no thread, token-identical serving either way (the plane only
+        # ever reads host-side snapshots).
+        self._pulse = None
+        if os.environ.get("PT_SERVE_PULSE", "1") not in ("", "0"):
+            from ..observability.pulse import PulsePlane
+            self._pulse = PulsePlane(
+                self._pulse_snapshot,
+                scan_fn=self._scan_anomalies,
+                info_fn=self._pulse_info,
+                recent_fn=self.recent_requests,
+                self_cost_fn=self.metrics.observe_scrape_self)
         self._rid = itertools.count()
         self._closed = False
         self._paused = False
@@ -390,7 +405,7 @@ class RequestScheduler:
             self._ledger["submitted"] += 1
             self._queues[priority].append(sr)
             self._drained.clear()
-            self.metrics.set_queue_depth(self._queued_locked())
+            self._book_depth_locked()
             self._cond.notify_all()
         return sr
 
@@ -438,6 +453,8 @@ class RequestScheduler:
             self._cond.notify_all()
         if self._thread.is_alive():
             self._thread.join(timeout=timeout)
+        if self._pulse is not None:
+            self._pulse.stop()
         return not self._thread.is_alive()
 
     def stats(self):
@@ -498,12 +515,68 @@ class RequestScheduler:
         """Prometheus exposition of this scheduler's registry (the
         server calls this on whatever it mounts — a Router aggregates
         replica registries behind the same method)."""
+        t0 = time.perf_counter()
         self._scan_anomalies()
-        return self.registry.render_prometheus()
+        if self._pulse is not None:
+            # ride the scrape cadence: sample only if an interval has
+            # passed (the plane's own thread fills scrape-free gaps)
+            self._pulse.maybe_sample(scanned=True)
+        text = self.registry.render_prometheus()
+        self.metrics.observe_scrape_self(time.perf_counter() - t0)
+        return text
 
     def metrics_snapshot(self):
+        t0 = time.perf_counter()
         self._scan_anomalies()
-        return self.registry.snapshot()
+        if self._pulse is not None:
+            self._pulse.maybe_sample(scanned=True)
+        snap = self.registry.snapshot()
+        self.metrics.observe_scrape_self(time.perf_counter() - t0)
+        return snap
+
+    # -- pulse plane (observability/pulse.py) -------------------------
+    def pulse(self, window=None, signals=None):
+        """The /debug/pulse payload: windowed ring time-series derived
+        from this registry (the Router aggregates per-replica payloads
+        behind the same duck-typed method). `{"enabled": False}` when
+        PT_SERVE_PULSE=0."""
+        if self._pulse is None:
+            return {"enabled": False}
+        self._pulse.maybe_sample()
+        return self._pulse.payload(window=window, signals=signals)
+
+    def _pulse_snapshot(self):
+        """Registry snapshot plus the device-telemetry MFU gauges
+        (pt_mfu lives outside the serving registry) — the sampler's
+        input. Host-side dict reads only."""
+        snap = self.registry.snapshot()
+        costs = _devtel.COSTS
+        snap["pt_mfu"] = {"type": "gauge",
+                          "value": float(costs.last_mfu)}
+        snap["pt_mfu_peak"] = {"type": "gauge",
+                               "value": float(costs.peak_mfu)}
+        return snap
+
+    def _pulse_info(self):
+        """Trigger-time context a capture bundle embeds: breaker
+        state, restart count, and the trace ids in flight (queued +
+        running + the most recent terminals — the triggering request
+        is one of these whichever side of finalize the trigger lands
+        on)."""
+        with self._cond:
+            trace_ids = [sr.trace_id for sr in self._inflight.values()]
+            trace_ids += [sr.trace_id for q in self._queues.values()
+                          for sr in q]
+            trace_ids += [e.get("trace_id")
+                          for e in list(self._recent)[-8:]]
+            return {
+                "breaker_open": self._broken,
+                "restarts": getattr(self._engine, "restarts", 0),
+                "queued": self._queued_locked(),
+                "inflight": len(self._inflight),
+                "trace_ids": [t for t in dict.fromkeys(trace_ids)
+                              if t is not None],
+            }
 
     def _scan_anomalies(self):
         """Drain the sentinel's step samples and publish any stalls —
@@ -516,6 +589,12 @@ class RequestScheduler:
     # -- pump (single thread; sole owner of the engine) ----------------
     def _queued_locked(self):
         return sum(len(q) for q in self._queues.values())
+
+    def _book_depth_locked(self):
+        """Total + per-priority queue-depth gauges in one pass."""
+        self.metrics.set_queue_depth(self._queued_locked())
+        self.metrics.set_queue_depths(
+            {p: len(self._queues[p]) for p in PRIORITIES})
 
     def _pop_next_locked(self):
         for p in PRIORITIES:
@@ -648,7 +727,7 @@ class RequestScheduler:
                     self._finalize(sr, "handoff")
                 else:
                     self._finalize(sr, "done")
-            self.metrics.set_queue_depth(self._queued_locked())
+            self._book_depth_locked()
             if not self._queued_locked() and not self._inflight:
                 self._drained.set()
                 self._cond.notify_all()
@@ -876,16 +955,20 @@ class RequestScheduler:
                 continue
             dt = time.perf_counter() - t0
             self.metrics.observe_step(dt)
+            # slot-mix sample: host-side slot walk, no device traffic —
+            # feeds the pt_serving_slots{kind=} gauges (pulse plane)
+            # and tags the sentinel sample with the step's phase mix
+            npf = nact = 0
+            for r in self._engine._slots:
+                if r is not None:
+                    nact += 1
+                    if self._engine._prefilling(r):
+                        npf += 1
+            self.metrics.set_slot_mix(npf, nact - npf)
             if self._timeline_on:
-                # anomaly sentinel sample: one deque append tagged with
-                # the step's phase mix — no math, no locks, no device
-                # traffic on the pump (analysis runs on scrape)
-                npf = nact = 0
-                for r in self._engine._slots:
-                    if r is not None:
-                        nact += 1
-                        if self._engine._prefilling(r):
-                            npf += 1
+                # anomaly sentinel sample: one deque append — no math,
+                # no locks, no device traffic on the pump (analysis
+                # runs on scrape)
                 self._sentinel.note(dt, npf, nact - npf)
             # MFU: the tracked prefill/decode/verify calls this step
             # issued a known number of XLA-counted FLOPs; dividing by
@@ -1031,5 +1114,5 @@ class RequestScheduler:
                 restarts=eng.restarts,
                 trace_ids=[sr.trace_id for sr in
                            requeued + quarantined + failed])
-            self.metrics.set_queue_depth(self._queued_locked())
+            self._book_depth_locked()
             self._cond.notify_all()

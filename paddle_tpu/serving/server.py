@@ -19,8 +19,16 @@ stays single-threaded behind the scheduler's pump):
     recorder ring (`?dump=1` also writes it to disk);
   * `GET /debug/trace` — chrome://tracing JSON of recent spans, one
     named row per request id;
+  * `GET /debug/pulse` — the telemetry pulse plane's ring time-series
+    (`?window=` seconds, `?signals=` name-prefix filter); `?stream=1`
+    switches to a Server-Sent-Events live feed (one payload per
+    sample interval, `?count=N` to stop after N events) — the feed
+    `tools/ptop.py` renders;
   * `GET /debug/stacks` — every live thread's Python stack (who is
     holding the pump / a lock right now).
+
+Malformed numeric query values (`last=`/`window=`/`dump=`/...) are a
+400, never a handler-thread traceback.
 
 Backpressure maps to HTTP: a full queue is 429 with Retry-After,
 shutdown is 503, a request the engine can never run is 400, a
@@ -34,6 +42,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..observability import chrome_trace as _chrome
@@ -47,6 +56,10 @@ from .scheduler import (BackpressureError, RequestScheduler,
                         SchedulerClosedError)
 
 __all__ = ["ServingServer", "CompletionHandler"]
+
+
+class _BadQuery(ValueError):
+    """A malformed /debug/* query value — mapped to HTTP 400."""
 
 
 class CompletionHandler(BaseHTTPRequestHandler):
@@ -78,8 +91,37 @@ class CompletionHandler(BaseHTTPRequestHandler):
         self._chunk(b"data: " + json.dumps(obj).encode() + b"\n\n")
         self.wfile.flush()
 
+    @staticmethod
+    def _query_params(query):
+        params = {}
+        for part in query.split("&"):
+            if part:
+                k, _, v = part.partition("=")
+                params[k] = v
+        return params
+
+    @staticmethod
+    def _query_int(params, key, default=None):
+        """Integer query value or `default`; a non-integer value is a
+        _BadQuery (HTTP 400), never a handler-thread ValueError."""
+        v = params.get(key)
+        if v is None or v == "":
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            raise _BadQuery(
+                f"query parameter {key}={v!r}: want an integer") \
+                from None
+
     # -- routes -------------------------------------------------------
     def do_GET(self):
+        try:
+            self._route_get()
+        except _BadQuery as e:
+            self._json(400, {"error": f"bad request: {e}"})
+
+    def _route_get(self):
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             st = self.sched.stats()
@@ -116,7 +158,7 @@ class CompletionHandler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
         elif path == "/debug/flightrecorder":
             snap = _flight.snapshot()
-            if "dump=1" in query:
+            if self._query_int(self._query_params(query), "dump", 0):
                 snap["path"] = _flight.dump(reason="/debug/flightrecorder")
             self._json(200, snap)
         elif path == "/debug/trace":
@@ -125,13 +167,24 @@ class CompletionHandler(BaseHTTPRequestHandler):
             # recent terminal requests with their stitched timelines;
             # a mounted Router aggregates across replicas (each entry
             # tagged replica="<id>") behind the same duck-typed method
-            last = 50
-            for part in query.split("&"):
-                k, _, v = part.partition("=")
-                if k == "last" and v.isdigit():
-                    last = int(v)
+            last = self._query_int(self._query_params(query), "last", 50)
             self._json(200,
                        {"requests": self.sched.recent_requests(last)})
+        elif path == "/debug/pulse":
+            # pulse plane: windowed ring time-series (JSON), or an SSE
+            # live feed with ?stream=1 (one payload per interval);
+            # a mounted Router nests per-replica payloads
+            params = self._query_params(query)
+            window = self._query_int(params, "window")
+            signals = [s for s in
+                       (params.get("signals") or "").split(",") if s] \
+                or None
+            if self._query_int(params, "stream", 0):
+                self._pulse_stream(window, signals,
+                                   self._query_int(params, "count"))
+            else:
+                self._json(200, self.sched.pulse(window=window,
+                                                 signals=signals))
         elif path == "/debug/stacks":
             body = _flight.thread_stacks().encode()
             self.send_response(200)
@@ -141,6 +194,33 @@ class CompletionHandler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         else:
             self._json(404, {"error": f"no route {path!r}"})
+
+    def _pulse_stream(self, window, signals, count):
+        """SSE live feed of the pulse plane: one full windowed payload
+        per sample interval (`ptop --stream` replaces its frame with
+        each event). `count=N` closes after N events — how tests and
+        one-shot captures bound the stream."""
+        sched = self.sched
+        plane = getattr(sched, "_pulse", None)
+        interval = plane.interval_s if plane is not None else float(
+            os.environ.get("PT_PULSE_INTERVAL_S", "1.0") or 1.0)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        sent = 0
+        try:
+            while True:
+                self._event(sched.pulse(window=window, signals=signals))
+                sent += 1
+                if count is not None and sent >= count:
+                    break
+                time.sleep(interval)
+            self._chunk(b"")        # terminating zero-length chunk
+        except (BrokenPipeError, ConnectionResetError):
+            # dashboard went away: stop streaming to it
+            self.close_connection = True
 
     def do_POST(self):
         if self.path.partition("?")[0] != "/v1/completions":

@@ -320,6 +320,33 @@ def test_slo_bench_accounts_every_request(monkeypatch):
     assert out["tokens_per_sec"] > 0
 
 
+def test_pulse_bench_bounds_overhead_and_lands_one_bundle(monkeypatch):
+    """PT_SERVE_PULSE=1 (ISSUE 15): the pulse-plane smoke must show
+    the forced stall as a step-time spike in the rings, fire the
+    step_stall trigger, land EXACTLY ONE capture bundle (the
+    min-interval rate limit, not a bundle storm), tag it with the
+    in-flight trace ids, and keep the sampler's per-tick self-cost
+    bounded (the artifact's own assert backs the number shipped)."""
+    bm = _load_bench_models()
+    for env in ("PT_SERVE_SPEC", "PT_SERVE_CACHE", "PT_SERVE_PREFIX",
+                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN",
+                "PT_SERVE_PIPELINE", "PT_SERVE_CHAOS",
+                "PT_SERVE_DISAGG", "PT_SERVE_RAGGED", "PT_SERVE_LEAN",
+                "PT_SERVE_SLO"):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv("PT_SERVE_PULSE", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "pulse-plane"
+    assert out["signals"] > 20, out          # the rings actually fill
+    assert out["step_p99_spike_x"] > 3, out  # the stall is visible
+    assert out["stall_triggers"] >= 1, out
+    assert out["bundles_written"] == 1, out
+    assert out["bundle_trigger"] == "step_stall"
+    assert out["bundle_trace_ids"] > 0, out
+    assert out["tick_mean_ms"] < 25, out
+    assert out["tokens_per_sec"] > 0
+
+
 def test_disagg_bench_migrates_and_matches(monkeypatch):
     """PT_SERVE_DISAGG=1 (ISSUE 13 acceptance): the 1 prefill + 1
     decode topology must actually migrate every eligible request

@@ -167,6 +167,27 @@ def test_timeline_functions_in_hot_set():
     assert cfg.is_hot_module("paddle_tpu/serving/timeline.py")
 
 
+def test_pulse_functions_in_hot_set():
+    """ISSUE 15: the pulse plane's sampler and bundle writer run on
+    the pulse/scrape threads against host-side registry snapshots —
+    they sit in the TPL001 hot set (module AND function level) so a
+    stray device pull can never hide in the observability plane, and
+    the single sanctioned sync is STILL the batched reader alone (the
+    plane added zero device reads)."""
+    from paddle_tpu.analysis.config import LintConfig
+
+    cfg = LintConfig.default()
+    for fn in ("PulseSampler.sample",
+               "PulsePlane.tick",
+               "PulsePlane._check_triggers",
+               "PulsePlane._write_bundle",
+               "RequestScheduler._pulse_snapshot",
+               "RequestScheduler._book_depth_locked"):
+        assert fn in cfg.hot_functions, fn
+    assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
+    assert cfg.is_hot_module("paddle_tpu/observability/pulse.py")
+
+
 def test_sanctioned_sync_config_check(tmp_path):
     """The TPL001 config check: a raw jax.device_get anywhere in a hot
     serving module — even outside the configured hot functions — is a

@@ -9,17 +9,23 @@ got:
     counts, compile telemetry rollup, and the tail of the ring;
   * a chrome-tracing export (`/debug/trace`, `Profiler.export`, or an
     `export_chrome_tracing` handler file): prints per-span aggregates
-    and per-trace (request) timelines.
+    and per-trace (request) timelines;
+  * a pulse capture bundle (the directory the pulse plane writes on a
+    stall/restart/breaker/SLO-burst trigger): stitches meta, the
+    triggering pulse window, the recent-request ring, and the flight
+    dump into one post-mortem narrative.
 
 Pure stdlib — runs anywhere, no jax needed.
 
   python tools/ptdump.py /tmp/pt_flightrecorder-1234.json
   python tools/ptdump.py trace.json --tail 50 --kind compile
+  python tools/ptdump.py bundle /tmp/pt_captures/bundle-...-step_stall-1234
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -250,6 +256,76 @@ def print_flight(doc, tail=30, kind=None, out=sys.stdout):
 
 
 # ---------------------------------------------------------------------------
+# pulse capture bundles
+# ---------------------------------------------------------------------------
+def _load_json(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def print_bundle(path, tail=30, kind=None, out=sys.stdout):
+    """Post-mortem narrative for one capture bundle directory: what
+    fired, which requests were in flight, what the pulse rings saw
+    around the trigger, then the flight-recorder tail."""
+    w = out.write
+    meta = _load_json(os.path.join(path, "meta.json")) or {}
+    pulse = _load_json(os.path.join(path, "pulse.json")) or {}
+    flight = _load_json(os.path.join(path, "flight.json"))
+    reqs = _load_json(os.path.join(path, "requests.json")) or {}
+    if isinstance(reqs, dict):
+        reqs = reqs.get("requests") or []
+    config = _load_json(os.path.join(path, "config.json")) or {}
+    w(f"capture bundle — {os.path.basename(os.path.abspath(path))}\n")
+    w(f"  trigger: {meta.get('trigger', '?')} "
+      f"at {_fmt_ts(meta.get('at', 0))} (pid {meta.get('pid')})\n")
+    tids = meta.get("trace_ids") or []
+    if tids:
+        w(f"  in-flight traces: {', '.join(str(t) for t in tids)}\n")
+    info = meta.get("info") or {}
+    if info:
+        w("  scheduler: " + " ".join(
+            f"{k}={info[k]}" for k in sorted(info)
+            if k != "trace_ids") + "\n")
+    totals = {k: n for k, n in
+              (meta.get("triggers_total") or {}).items() if n}
+    if totals:
+        w("  triggers so far: " + ", ".join(
+            f"{k}={n}" for k, n in sorted(totals.items())) + "\n")
+    sigs = pulse.get("signals") or {}
+    if sigs:
+        w(f"  pulse window: {len(sigs)} signals; notable:\n")
+        notable = [n for n in sorted(sigs)
+                   if ("step_seconds" in n or "anomal" in n
+                       or "restart" in n or "violated" in n
+                       or "queue_depth" in n or n == "goodput_ratio")]
+        for name in notable[:12]:
+            series = sigs[name] or []
+            if not series:
+                continue
+            vals = [v for _, v in series]
+            w(f"    {name:<44} last={vals[-1]:.6g} "
+              f"min={min(vals):.6g} max={max(vals):.6g} "
+              f"n={len(vals)}\n")
+    if reqs:
+        w(f"  recent requests ({len(reqs)} in ring, newest last):\n")
+        for r in reqs[-min(8, len(reqs)):]:
+            mark = " <- triggering" if r.get("trace_id") in tids else ""
+            w(f"    {r.get('rid', '?')} trace={r.get('trace_id')} "
+              f"state={r.get('state', r.get('status', '?'))}{mark}\n")
+    argv = (config.get("env") or {}).get("argv") or config.get("argv")
+    if argv:
+        w(f"  process: {' '.join(map(str, argv))}\n")
+    if flight:
+        w("\n")
+        print_flight(flight, tail=tail, kind=kind, out=out)
+    else:
+        w("  (no flight.json in bundle)\n")
+
+
+# ---------------------------------------------------------------------------
 # chrome traces
 # ---------------------------------------------------------------------------
 def print_chrome(doc, tail=30, out=sys.stdout):
@@ -287,15 +363,24 @@ def print_chrome(doc, tail=30, out=sys.stdout):
 
 
 def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `ptdump bundle <dir>` — the subcommand word is optional sugar;
+    # a bare directory path dispatches to the bundle printer too
+    if argv and argv[0] == "bundle":
+        argv = argv[1:]
     ap = argparse.ArgumentParser(
         prog="ptdump", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("path", help="flight-recorder dump or chrome trace")
+    ap.add_argument("path", help="flight-recorder dump, chrome trace, "
+                                 "or capture-bundle directory")
     ap.add_argument("--tail", type=int, default=30,
                     help="events/spans to show (default 30)")
     ap.add_argument("--kind", default=None,
                     help="flight dumps: only this event kind")
     args = ap.parse_args(argv)
+    if os.path.isdir(args.path):
+        print_bundle(args.path, tail=args.tail, kind=args.kind)
+        return 0
     with open(args.path) as f:
         doc = json.load(f)
     if "traceEvents" in doc:

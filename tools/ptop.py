@@ -1,0 +1,221 @@
+#!/usr/bin/env python3
+"""ptop — terminal dashboard over the serving pulse plane.
+
+Renders `GET /debug/pulse` (docs/observability.md § Pulse & capture
+bundles) as one sparkline row per signal — counter rates, gauge
+samples, windowed histogram percentiles — with per-replica columns
+when a Router is mounted, and stall/violation signals highlighted the
+moment they go non-zero. Three modes:
+
+  python tools/ptop.py http://HOST:PORT              # poll + redraw
+  python tools/ptop.py http://HOST:PORT --stream     # SSE live feed
+  python tools/ptop.py --file pulse.json --once      # recorded payload
+
+Pure stdlib — runs anywhere, no jax needed. `--once` renders a single
+frame and exits (how tests drive it deterministically).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+BARS = "▁▂▃▄▅▆▇█"
+
+# signals that should scream when non-zero: stalls, SLO violations,
+# restarts/breaker, requeues, failures
+_HOT = ("anomal", "violated", "restart", "breaker", "requeue",
+        "fail", "poison", "reject")
+
+_RED = "\x1b[31m"
+_BOLD = "\x1b[1m"
+_DIM = "\x1b[2m"
+_RESET = "\x1b[0m"
+
+
+def sparkline(values, width=24):
+    """Unicode sparkline of the LAST `width` values, min-max
+    normalized (flat series render as a low bar)."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return " " * width
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return (BARS[0] * len(vals)).rjust(width)
+    idx = [min(int((v - lo) / span * (len(BARS) - 1) + 0.5),
+               len(BARS) - 1) for v in vals]
+    return "".join(BARS[i] for i in idx).rjust(width)
+
+
+def _fmt_value(name, v):
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if "_seconds" in name or name.endswith((":p50", ":p99")):
+        return f"{v * 1e3:.2f}ms"
+    if name.endswith(":rate"):
+        return f"{v:.2f}/s"
+    if v == int(v) and abs(v) < 1e9:
+        return str(int(v))
+    return f"{v:.4g}"
+
+
+def _is_hot(name, series):
+    return any(tok in name for tok in _HOT) and \
+        any(v > 0 for _, v in series)
+
+
+def _paint(text, code, color):
+    return f"{code}{text}{_RESET}" if color else text
+
+
+def render(payload, out=sys.stdout, width=24, color=False):
+    """One frame. Accepts the flat single-scheduler payload or the
+    router's `{"replicas": {rid: payload}}` aggregate — the latter
+    renders per-replica columns for every signal."""
+    w = out.write
+    if not payload.get("enabled", False):
+        w("pulse plane disabled (PT_SERVE_PULSE=0 or no data)\n")
+        return
+    reps = payload.get("replicas")
+    if reps is None:
+        reps = {"": payload}
+    cols = sorted(reps)
+    header = f"ptop — {time.strftime('%H:%M:%S')}"
+    first = next(iter(reps.values()), {})
+    if first.get("interval_s"):
+        header += f"  interval {first['interval_s']:g}s"
+    trig = {}
+    bundles = []
+    for p in reps.values():
+        for k, n in (p.get("triggers") or {}).items():
+            trig[k] = trig.get(k, 0) + n
+        bundles.extend(p.get("bundles") or [])
+    fired = {k: n for k, n in trig.items() if n}
+    if fired:
+        header += "  triggers " + ",".join(
+            f"{k}={n}" for k, n in sorted(fired.items()))
+    if bundles:
+        header += f"  bundles {len(bundles)}"
+    w(_paint(header, _BOLD + (_RED if fired else ""), color) + "\n")
+    if len(cols) > 1:
+        cell = width + 12
+        w(" " * 44 + "".join(
+            _paint(f"{c:>{cell}}", _DIM, color) for c in cols) + "\n")
+    names = sorted({n for p in reps.values()
+                    for n in (p.get("signals") or {})})
+    for name in names:
+        cells = []
+        hot = False
+        for c in cols:
+            series = (reps[c].get("signals") or {}).get(name) or []
+            hot = hot or _is_hot(name, series)
+            if not series:
+                cells.append(" " * (width + 12))
+                continue
+            spark = sparkline([v for _, v in series], width)
+            last = _fmt_value(name, series[-1][1])
+            cells.append(f"{spark} {last:>11}")
+        line = f"{name[:43]:<44}" + "".join(cells)
+        w(_paint(line, _RED, color and hot) + "\n")
+    if bundles:
+        w(_paint("capture bundles:", _BOLD, color) + "\n")
+        for b in bundles[-4:]:
+            w(f"  {b}\n")
+
+
+def fetch(url, window=None, signals=None, timeout=10.0):
+    q = []
+    if window is not None:
+        q.append(f"window={int(window)}")
+    if signals:
+        q.append("signals=" + ",".join(signals))
+    full = url.rstrip("/") + "/debug/pulse" + \
+        ("?" + "&".join(q) if q else "")
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def stream(url, window=None, signals=None, count=None, timeout=60.0):
+    """Yield payloads from the SSE feed (?stream=1)."""
+    q = ["stream=1"]
+    if window is not None:
+        q.append(f"window={int(window)}")
+    if signals:
+        q.append("signals=" + ",".join(signals))
+    if count is not None:
+        q.append(f"count={int(count)}")
+    full = url.rstrip("/") + "/debug/pulse?" + "&".join(q)
+    with urllib.request.urlopen(full, timeout=timeout) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                yield json.loads(line[len("data: "):])
+
+
+def main(argv=None, out=None):
+    ap = argparse.ArgumentParser(
+        prog="ptop", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("url", nargs="?", default="http://127.0.0.1:8000",
+                    help="serving server base URL")
+    ap.add_argument("--file", default=None,
+                    help="render a recorded /debug/pulse JSON payload")
+    ap.add_argument("--window", type=int, default=None,
+                    help="seconds of history to request")
+    ap.add_argument("--signals", default=None,
+                    help="comma-separated signal-name prefixes")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval seconds (default 2)")
+    ap.add_argument("--count", type=int, default=None,
+                    help="frames to render before exiting")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--stream", action="store_true",
+                    help="consume the SSE live feed instead of polling")
+    ap.add_argument("--width", type=int, default=24,
+                    help="sparkline width (default 24)")
+    ap.add_argument("--no-color", action="store_true")
+    args = ap.parse_args(argv)
+    out = out or sys.stdout
+    color = not args.no_color and getattr(out, "isatty", lambda: False)()
+    signals = [s for s in (args.signals or "").split(",") if s] or None
+    clear = getattr(out, "isatty", lambda: False)() and not args.once
+
+    def show(payload):
+        if clear:
+            out.write("\x1b[2J\x1b[H")
+        render(payload, out=out, width=args.width, color=color)
+        out.flush()
+
+    if args.file:
+        with open(args.file) as f:
+            show(json.load(f))
+        return 0
+    if args.stream:
+        n = 0
+        for payload in stream(args.url, window=args.window,
+                              signals=signals, count=args.count):
+            show(payload)
+            n += 1
+            if args.once or (args.count is not None and n >= args.count):
+                break
+        return 0
+    frames = 0
+    while True:
+        show(fetch(args.url, window=args.window, signals=signals))
+        frames += 1
+        if args.once or (args.count is not None and frames >= args.count):
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except KeyboardInterrupt:
+        sys.exit(130)
