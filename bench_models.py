@@ -197,6 +197,13 @@ def bench_serving(on_tpu):
     # (docs/serving.md § Disaggregated prefill/decode)
     if (os.environ.get("PT_SERVE_DISAGG", "") or "0") not in ("", "0"):
         return _bench_serving_disagg(on_tpu, params, cfg, dtype)
+    # PT_SERVE_FLEET=1: multi-host fleet plane — 1 prefill + 1 decode
+    # worker spawned as SUBPROCESSES on loopback behind the unchanged
+    # router, vs the in-process router on the same seeded workload;
+    # token identity asserted and handoff bytes/sec measured over the
+    # real socket (serving/fleet.py; docs/serving.md § Fleet plane)
+    if (os.environ.get("PT_SERVE_FLEET", "") or "0") not in ("", "0"):
+        return _bench_serving_fleet(on_tpu, params, cfg, dtype)
     # PT_SERVE_MULTITURN=1: multi-turn conversations returning after a
     # cache-thrashing burst — the host-RAM KV tier (serving/kvtier.py)
     # vs a tier-off baseline at token-identical outputs
@@ -1050,6 +1057,180 @@ def _bench_serving_disagg(on_tpu, params, cfg, dtype):
         "ledgers": ledgers,
         "loss": 0.0,
     }
+
+
+def _bench_serving_fleet(on_tpu, params, cfg, dtype):
+    """PT_SERVE_FLEET=1: the multi-host fleet plane. One prefill + one
+    decode FleetWorker spawned as real SUBPROCESSES on loopback
+    (serving/fleet.py) behind the unchanged Router — RemoteReplica
+    satisfies the Replica duck type, so the router code is byte-for-
+    byte the single-host router — vs the in-process router at equal
+    capacity on the identical seeded mixed workload. Every request
+    prefills in one process and decodes in the other, so its KV pages
+    cross a real socket; outputs must be token-identical to the
+    in-process run, and the artifact reports handoff wire bytes/sec as
+    counted by the framing layer (pt_fleet_handoff_wire_bytes), not
+    estimated.
+
+    The workers always run the tiny float32 engine on CPU: two child
+    processes cannot share the parent's chip, and this bench measures
+    the transport plane, not the matmuls. On a TPU host the in-process
+    baseline runs on-chip, so token identity is asserted only when the
+    parent is CPU too (the comparison is always reported)."""
+    import socket
+
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama_spmd as M
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models.llama_serving import ServingEngine
+    from paddle_tpu.serving import (FleetPlane, Router, build_replicas,
+                                    fleet)
+
+    if on_tpu:
+        cfg = LlamaConfig.tiny(vocab=256, hidden=64, layers=2, heads=4,
+                               kv_heads=2, ffn=128)
+        dtype = jnp.float32
+        params = M.init_params(cfg, seed=0, dtype=dtype)
+    per_seqs, page, max_seq_len = 2, 8, 64
+    n_long, n_chat, long_len, chat_len = 3, 3, 24, 4
+    long_new, chat_new = 4, 10
+    tier_bytes = 8 << 20
+    rng = _data_rng()
+    long_p = [list(map(int, rng.randint(1, cfg.vocab_size, long_len)))
+              for _ in range(n_long)]
+    chat_p = [list(map(int, rng.randint(1, cfg.vocab_size, chat_len)))
+              for _ in range(n_chat)]
+    work = []
+    for i in range(max(n_long, n_chat)):
+        if i < n_long:
+            work.append((long_p[i], long_new))
+        if i < n_chat:
+            work.append((chat_p[i], chat_new))
+
+    # -- in-process baseline: same topology, same process --------------
+    def factory(i):
+        return ServingEngine(params, cfg, max_seqs=per_seqs,
+                             max_seq_len=max_seq_len, page_size=page,
+                             dtype=dtype, prefix_cache=True,
+                             host_tier_bytes=tier_bytes,
+                             use_pallas=False)
+
+    def run_baseline(warm=True):
+        if warm:
+            run_baseline(warm=False)   # compile cache warm, same shapes
+        router = Router(build_replicas(factory, 2,
+                                       roles=["prefill", "decode"],
+                                       max_queue=len(work)))
+        t0 = time.perf_counter()
+        handles = [router.submit(p, max_new_tokens=nt if warm else 2)
+                   for p, nt in work]
+        outs = [h.result(timeout=600) for h in handles]
+        dt = time.perf_counter() - t0
+        router.shutdown(drain=True, timeout=60)
+        return outs, dt
+
+    bouts, bdt = run_baseline()
+
+    # -- fleet: the same two roles, each in its own process ------------
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    endpoint = f"127.0.0.1:{port}"
+    spec = {"master": endpoint, "world_size": 3, "seed": 0,
+            "model": vars(cfg), "dtype": "float32",
+            "engine": {"max_seqs": per_seqs, "max_seq_len": max_seq_len,
+                       "page_size": page, "use_pallas": False,
+                       "prefix_cache": True,
+                       "host_tier_bytes": tier_bytes},
+            "replica": {"max_queue": len(work)}}
+    procs = [
+        fleet.spawn_worker(dict(spec, name="p0", rank=1, role="prefill",
+                                host="hostA"),
+                           env={"JAX_PLATFORMS": "cpu"}),
+        fleet.spawn_worker(dict(spec, name="d0", rank=2, role="decode",
+                                host="hostB"),
+                           env={"JAX_PLATFORMS": "cpu"}),
+    ]
+    plane = router = None
+    try:
+        plane = FleetPlane(endpoint, ["p0", "d0"])
+        router = Router(plane.replicas)
+        # warm pass: the children compile their fixed shapes once; the
+        # workers persist, so the timed pass reuses the same processes
+        for h in [router.submit(p, max_new_tokens=2) for p, _ in work]:
+            h.result(timeout=600)
+        t0 = time.perf_counter()
+        handles = [router.submit(p, max_new_tokens=nt)
+                   for p, nt in work]
+        fouts = [h.result(timeout=600) for h in handles]
+        fdt = time.perf_counter() - t0
+
+        reps = [router.replica(rid) for rid in router.replica_ids]
+        ledgers = {}
+        for rep in reps:
+            st = rep.stats()
+            led = st["requests"]
+            ledgers[f"{rep.role}:{rep.replica_id}"] = dict(led)
+            assert led["submitted"] == (
+                led["completed"] + led["failed"] + led["cancelled"]
+                + led["expired"] + led["handoff"] + st["queued"]
+                + st["inflight"]), (rep.replica_id, st)
+
+        # worker-side counters cross the control plane like everything
+        # else; the prefill worker's framing layer counted the handoff
+        # payload bytes it actually put on the bulk socket
+        pre = next(r for r in reps if r.role == "prefill")
+        snap = pre.scheduler.metrics_snapshot()
+
+        def _val(key):
+            return int((snap.get(key) or {}).get("value", 0))
+
+        serves = _val("pt_fleet_handoff_serves")
+        wire_bytes = _val("pt_fleet_handoff_wire_bytes")
+        eng_bytes = _val("pt_handoff_bytes")
+        assert serves >= len(work), snap.get("pt_fleet_handoff_serves")
+        assert wire_bytes > 0, "no handoff bytes crossed the socket"
+
+        outputs_match = fouts == bouts
+        if not on_tpu:
+            assert outputs_match, \
+                "fleet outputs diverge from the in-process router"
+        migrations = int(router.handoffs.value)
+
+        ok = router.shutdown(drain=True, timeout=60)
+        codes = [p.wait(timeout=30) for p in procs]
+        router = None
+        return {
+            "workload": "fleet-mixed",
+            "requests": len(work),
+            "workers": {"p0": "hostA", "d0": "hostB"},
+            "outputs_match": outputs_match,
+            "handoff_serves": serves,
+            "handoff_wire_bytes": wire_bytes,
+            "handoff_wire_bytes_per_sec": round(wire_bytes / fdt, 1),
+            "handoff_engine_bytes": eng_bytes,
+            "router_handoffs": migrations,
+            "fleet_tokens_per_sec": round(
+                sum(len(o) for o in fouts) / fdt, 1),
+            "baseline_tokens_per_sec": round(
+                sum(len(o) for o in bouts) / bdt, 1),
+            "worker_exit_codes": codes,
+            "clean_shutdown": bool(ok) and codes == [0, 0],
+            "ledgers": ledgers,
+            "step_time_s": round(
+                fdt / max(sum(len(o) for o in fouts), 1), 5),
+            "loss": 0.0,
+        }
+    finally:
+        if router is not None:
+            router.shutdown(drain=False, timeout=5)
+        if plane is not None:
+            plane.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
 
 
 def _bench_serving_slo(on_tpu, params, cfg, dtype):

@@ -152,6 +152,16 @@ class LintConfig:
                 "PulsePlane._write_bundle",
                 "RequestScheduler._pulse_snapshot",
                 "RequestScheduler._book_depth_locked",
+                # fleet plane (ISSUE 16): the bulk-channel serving
+                # threads stream tokens and ship exported (host-side
+                # numpy) KV pages per request, and the spill/fetch pair
+                # runs on the kvtier path — all must stay pure
+                # host+socket code with zero device pulls
+                "FleetWorker._serve_stream",
+                "FleetWorker._serve_handoff",
+                "FleetPages._spill_loop",
+                "FleetPages.fetch_missing",
+                "RemoteRequest._read_loop",
             ],
             bench_paths=[
                 "bench*.py", "tools/*.py", "tests/*.py", "examples/*.py",

@@ -45,6 +45,7 @@ __all__ = [
     "get_worker_info",
     "get_all_worker_infos",
     "get_current_worker_info",
+    "RpcFrameError",
 ]
 
 WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
@@ -52,6 +53,31 @@ WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
 _DEFAULT_RPC_TIMEOUT = -1
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 1 << 30
+
+
+class RpcFrameError(ConnectionError):
+    """A frame over the `_MAX_FRAME` cap, refused on send (before any
+    bytes hit the wire — the peer never sees a half-frame) or on recv
+    (before allocating the body — a corrupt length prefix fails here,
+    not in a giant allocation). Subclasses ConnectionError so existing
+    socket-error handling keeps treating it as a dead wire."""
+
+
+def _resolve_default_timeout(timeout):
+    """The reference hardcodes -1 (wait forever) as rpc_sync's default;
+    PT_RPC_TIMEOUT_S overrides that default so a hung peer fails in
+    bounded time fleet-wide. An EXPLICIT timeout argument always wins —
+    only the sentinel consults the env."""
+    if timeout is _DEFAULT_RPC_TIMEOUT or timeout == _DEFAULT_RPC_TIMEOUT:
+        env = os.environ.get("PT_RPC_TIMEOUT_S", "").strip()
+        if env:
+            try:
+                return float(env)
+            except ValueError:
+                raise ValueError(
+                    f"PT_RPC_TIMEOUT_S={env!r}: want seconds "
+                    "(float)") from None
+    return timeout
 
 
 def _routable_ip():
@@ -82,13 +108,21 @@ def _recv_exact(sock, n):
 
 
 def _send_frame(sock, payload: bytes):
+    if len(payload) > _MAX_FRAME:
+        raise RpcFrameError(
+            f"rpc wire: refusing to send frame of {len(payload)}B — "
+            f"exceeds the {_MAX_FRAME}B cap (ship bulk data over a "
+            "dedicated channel, e.g. serving/wire.py)")
     sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def _recv_frame(sock) -> bytes:
     (n,) = _LEN.unpack(_recv_exact(sock, 4))
     if n > _MAX_FRAME:
-        raise ConnectionError(f"rpc wire: frame {n}B exceeds cap")
+        raise RpcFrameError(
+            f"rpc wire: inbound frame header claims {n}B — exceeds "
+            f"the {_MAX_FRAME}B cap (corrupt stream or oversized "
+            "sender)")
     return _recv_exact(sock, n)
 
 
@@ -462,12 +496,17 @@ def _require_agent():
 
 
 def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
-    """Blocking call of fn(*args, **kwargs) on worker `to` (rpc.py:160)."""
+    """Blocking call of fn(*args, **kwargs) on worker `to` (rpc.py:160).
+    The default timeout is wait-forever (-1) unless PT_RPC_TIMEOUT_S
+    sets a fleet-wide bound; an explicit `timeout` always wins."""
+    timeout = _resolve_default_timeout(timeout)
     return _require_agent().invoke(to, fn, args, kwargs, timeout).wait()
 
 
 def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
-    """Non-blocking variant returning a future with .wait() (rpc.py:206)."""
+    """Non-blocking variant returning a future with .wait() (rpc.py:206).
+    Same PT_RPC_TIMEOUT_S default resolution as rpc_sync."""
+    timeout = _resolve_default_timeout(timeout)
     return _require_agent().invoke(to, fn, args, kwargs, timeout)
 
 

@@ -37,6 +37,15 @@ Layers (docs/serving.md has the architecture):
                   dispatch across N replicas, least-loaded spill,
                   circuit-breaker health, pre-output failover, and
                   graceful per-replica drain.
+  * `wire`      — length-framed socket framing for the fleet bulk
+                  channel: JSON control frames + raw numpy arrays,
+                  never pickle.
+  * `fleet`     — multi-host plane over `distributed/rpc.py`:
+                  `FleetWorker` processes serve replicas remotely,
+                  `RemoteReplica` proxies satisfy the `Replica`
+                  duck-type for an unchanged `Router`, KV handoffs
+                  and spilled prefix pages move host-to-host over a
+                  bulk channel (one global prefix cache).
   * `server`    — stdlib ThreadingHTTPServer frontend: streaming
                   `/v1/completions`, `/healthz`, `/readyz`,
                   `/metrics`; mounts a scheduler OR a router.
@@ -49,11 +58,15 @@ the engine arrives as a constructor argument — so
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    client, faults, handoff, kvcache, kvtier, metrics, replica, router,
-    scheduler, server, timeline,
+    client, faults, fleet, handoff, kvcache, kvtier, metrics, replica,
+    router, scheduler, server, timeline, wire,
 )
 from .client import ServingClient, ServingHTTPError  # noqa: F401
 from .faults import FaultPlan, InjectedFault  # noqa: F401
+from .fleet import (  # noqa: F401
+    FleetPages, FleetPlane, FleetWorker, RemoteHandoffRef, RemoteReplica,
+    RemoteRequest, connect_fleet, spawn_worker,
+)
 from .handoff import KVHandoff  # noqa: F401
 from .kvcache import PagePool, PrefixCache  # noqa: F401
 from .kvtier import HostTier  # noqa: F401
@@ -73,10 +86,12 @@ from .server import ServingServer  # noqa: F401
 from .timeline import (  # noqa: F401
     StepAnomalySentinel, Timeline, judge_slo, resolve_slo, slo_targets,
 )
+from .wire import WireError  # noqa: F401
 
 __all__ = [
-    "client", "faults", "handoff", "kvcache", "kvtier", "metrics",
-    "replica", "router", "scheduler", "server", "timeline",
+    "client", "faults", "fleet", "handoff", "kvcache", "kvtier",
+    "metrics", "replica", "router", "scheduler", "server", "timeline",
+    "wire",
     "Timeline", "StepAnomalySentinel",
     "resolve_slo", "slo_targets", "judge_slo",
     "ServingClient", "ServingHTTPError",
@@ -89,4 +104,7 @@ __all__ = [
     "BackpressureError", "DeadlineExceededError", "SchedulerClosedError",
     "PoisonedRequestError", "CrashLoopError",
     "ServingServer",
+    "WireError", "FleetWorker", "FleetPages", "FleetPlane",
+    "RemoteReplica", "RemoteRequest", "RemoteHandoffRef",
+    "connect_fleet", "spawn_worker",
 ]

@@ -135,6 +135,16 @@ class HostTier:
         # optional serving.faults.FaultPlan — the engine attaches its
         # own so `tier_spill` drills hit the real copy path
         self.faults = None
+        # fleet hooks (serving/fleet.py — multi-host prefix cache).
+        # `on_drop(entries)` receives budget-evicted entries AFTER the
+        # lock is released (it enqueues spills to the owning peer; a
+        # network call under self._lock would stall the pump — TPL004
+        # discipline). `fetch_missing(parent, block_idx, tokens)` runs
+        # at the end of a short `match`, also outside the lock, and
+        # returns extra chain-order payloads fetched from peers. Both
+        # None by default: single-host behavior is byte-identical.
+        self.on_drop = None
+        self.fetch_missing = None
 
     @property
     def enabled(self):
@@ -222,10 +232,11 @@ class HostTier:
                                   "nbytes": nb}
             self._bytes += nb
             self.spills += 1
-            self._shrink_locked()
+            dropped = self._shrink_locked()
             held, pages = self._bytes, len(self._entries)
         _flight.record("kvtier.spill", depth=int(depth), bytes=nb,
                        tier_bytes=held, tier_pages=pages)
+        self._notify_drops(dropped)
 
     # -- disaggregated handoff export (pump thread waits; worker
     # thread fences) ---------------------------------------------------
@@ -280,7 +291,10 @@ class HostTier:
         descendants and surviving roots keep matching. The pinned
         stash is never dropped (preemption correctness outranks the
         budget); it still counts, so heavy preemption pressure shrinks
-        the spill side."""
+        the spill side. Returns the dropped (key, entry) pairs so the
+        caller can hand them to the fleet `on_drop` hook OUTSIDE the
+        lock."""
+        dropped = []
         while self._bytes > self.tier_bytes and self._entries:
             victim, depth = None, -1
             for key, e in self._entries.items():  # oldest-first scan
@@ -289,6 +303,20 @@ class HostTier:
             e = self._entries.pop(victim)
             self._bytes -= e["nbytes"]
             self.drops += 1
+            dropped.append((victim, e))
+        return dropped
+
+    def _notify_drops(self, dropped):
+        """Feed budget-evicted entries to the fleet hook, lock already
+        released. Fleet-originated entries (a peer spilled them here)
+        never re-spill — without the flag two budget-pressured hosts
+        would ping-pong the same page forever."""
+        hook = self.on_drop
+        if hook is None or not dropped:
+            return
+        local = [(k, e) for k, e in dropped if not e.get("fleet")]
+        if local:
+            hook(local)
 
     def flush(self, timeout=None):
         """Block until every queued spill has landed (tests/bench; the
@@ -306,6 +334,48 @@ class HostTier:
         t.start()
         return deadline.wait(timeout)
 
+    # -- fleet page exchange (serving/fleet.py) ------------------------
+    def insert(self, parent, block, depth, payload, fleet=False):
+        """Index a host-resident page payload directly (no device
+        fence): the landing half of a fleet page transfer — a peer
+        shipped the page it owns, or a fetch-on-miss just pulled it.
+        `fleet=True` marks the entry peer-originated so budget
+        pressure drops it without re-spilling it back (`_notify_drops`
+        skips the flag). Returns False when the tier is off or the
+        key is already held."""
+        if not self.enabled:
+            return False
+        block = tuple(int(t) for t in block)
+        nb = _nbytes(payload)
+        key = _kvc.block_hash(parent, block)
+        dropped = []
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return False
+            e = {"parent": parent, "block": block, "depth": int(depth),
+                 "payload": payload, "nbytes": nb}
+            if fleet:
+                e["fleet"] = True
+            self._entries[key] = e
+            self._bytes += nb
+            dropped = self._shrink_locked()
+        self._notify_drops(dropped)
+        return True
+
+    def peek(self, key):
+        """One spilled entry by chained hash — what a peer's
+        fetch-on-miss asks this tier for over the fleet bulk channel.
+        Touches recency; returns {parent, block, depth, payload} or
+        None."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            self._entries.move_to_end(key)
+            return {"parent": e["parent"], "block": e["block"],
+                    "depth": e["depth"], "payload": e["payload"]}
+
     # -- lookup / restore accounting (pump thread) ---------------------
     def match(self, tokens, skip_tokens):
         """Continue the device cache's longest-prefix walk into the
@@ -314,14 +384,20 @@ class HostTier:
         with raw (parent, block) verification — a hash collision falls
         through to a miss, never wrong KV. Capped one token short of
         len(tokens), same as the device match. Returns the matched
-        entries' payloads in chain order."""
+        entries' payloads in chain order.
+
+        With a fleet `fetch_missing` hook attached, a walk that ends
+        short of the cap continues through the hook (lock released —
+        the fetch is a network round trip): whatever chain-order
+        payloads the owning peer returns extend the match."""
         ps = self.page_size
         limit = (len(tokens) - 1) // ps
         skip = int(skip_tokens) // ps
         parent = _kvc._SEED
         out = []
+        b = 0
         with self._lock:
-            if not self._entries:
+            if not self._entries and self.fetch_missing is None:
                 return out
             for b in range(limit):
                 block = tuple(int(t) for t in tokens[b * ps:(b + 1) * ps])
@@ -334,6 +410,11 @@ class HostTier:
                     out.append(e["payload"])
                     self._entries.move_to_end(h)
                 parent = h
+            else:
+                b = limit
+        hook = self.fetch_missing
+        if hook is not None and skip <= b < limit:
+            out.extend(hook(parent, b, tokens))
         return out
 
     def note_lookup(self, restored_pages):
@@ -352,6 +433,7 @@ class HostTier:
         exact) under the shared ledger. Pinned: never dropped; spilled
         prefix pages make room instead."""
         nb = _nbytes(payload)
+        dropped = []
         with self._lock:
             if key in self._stash:
                 raise RuntimeError(f"kvtier: stash key {key!r} already "
@@ -359,7 +441,8 @@ class HostTier:
             self._stash[key] = (payload, nb, int(pages))
             self._bytes += nb
             if self.enabled:
-                self._shrink_locked()
+                dropped = self._shrink_locked()
+        self._notify_drops(dropped)
 
     def stash_take(self, key):
         with self._lock:

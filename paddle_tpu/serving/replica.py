@@ -60,6 +60,11 @@ class Replica:
 
     ROLES = ("prefill", "decode", "both")
 
+    # fleet-mode host tag (serving/fleet.py sets it on the worker):
+    # None on a plain in-process replica, so single-host metrics and
+    # /debug payloads stay byte-identical
+    host = None
+
     def __init__(self, replica_id, engine, *, max_queue=64,
                  metrics=None, idle_poll_s=0.02, pipeline=None,
                  role="both", **sched_kw):
@@ -96,6 +101,8 @@ class Replica:
         st["replica_id"] = self.replica_id
         st["role"] = self.role
         st["ready"] = self.ready()
+        if self.host is not None:
+            st["host"] = self.host
         return st
 
     def prefill_eligible(self):

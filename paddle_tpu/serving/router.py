@@ -703,6 +703,11 @@ class Router:
                 "queued": s["queued"], "inflight": s["inflight"],
                 "active": s["active"], "requests": s.get("requests"),
             }
+            # fleet mode: the worker's host tag rides every per-replica
+            # payload; absent on in-process replicas (byte-identical)
+            host = s.get("host") or getattr(rep, "host", None)
+            if host is not None:
+                reps[rid]["host"] = host
         self.ready_gauge.set(n_ready)
         # closed is LIVENESS (every pump gone), not readiness: a fully
         # paused pool is alive (healthz "ok") but not ready (readyz 503)
@@ -768,14 +773,17 @@ class Router:
         return ok
 
     # -- metrics aggregation ------------------------------------------
-    def _scrape_gauge(self, rid):
+    def _scrape_gauge(self, rid, host=None):
         g = self._scrape_gauges.get(rid)
         if g is None:
+            labels = {"replica": rid}
+            if host is not None:
+                labels["host"] = host
             g = self.registry.gauge(
                 "pt_router_scrape_seconds",
                 "Wall time of the last /metrics scrape of this "
                 "replica's registry (a slow replica's exposition cost, "
-                "made visible).", labels={"replica": rid})
+                "made visible).", labels=labels)
             self._scrape_gauges[rid] = g
         return g
 
@@ -808,8 +816,9 @@ class Router:
         parts = []
         for rid, rep in items:
             t0 = time.perf_counter()
-            text = _relabel(self._scrape_replica(rep), rid)
-            self._scrape_gauge(rid).set(time.perf_counter() - t0)
+            host = getattr(rep, "host", None)
+            text = _relabel(self._scrape_replica(rep), rid, host=host)
+            self._scrape_gauge(rid, host).set(time.perf_counter() - t0)
             parts.append(text)
         # the router's own registry renders LAST so the scrape gauges
         # it just set are current in the same exposition
@@ -830,7 +839,10 @@ class Router:
                 reps[rid] = sched.metrics_snapshot()
             else:
                 reps[rid] = rep.registry.snapshot()
-            self._scrape_gauge(rid).set(time.perf_counter() - t0)
+            host = getattr(rep, "host", None)
+            if host is not None and isinstance(reps[rid], dict):
+                reps[rid]["host"] = host
+            self._scrape_gauge(rid, host).set(time.perf_counter() - t0)
         snap = self.registry.snapshot()
         snap["replicas"] = reps
         return snap
@@ -849,9 +861,12 @@ class Router:
             sched = getattr(rep, "scheduler", None)
             if sched is None or not hasattr(sched, "recent_requests"):
                 continue
+            host = getattr(rep, "host", None)
             for entry in sched.recent_requests(n):
                 e = dict(entry)
                 e["replica"] = rid
+                if host is not None:
+                    e["host"] = host
                 merged.append(e)
         # entries without a timeline sort stably at the front
         merged.sort(key=lambda e: (e.get("marks") or [[None, 0.0]])[-1][1])
@@ -871,14 +886,22 @@ class Router:
         for rid, rep in items:
             sched = getattr(rep, "scheduler", None)
             if sched is not None and hasattr(sched, "pulse"):
-                reps[rid] = sched.pulse(window=window, signals=signals)
+                payload = sched.pulse(window=window, signals=signals)
+                host = getattr(rep, "host", None)
+                if host is not None and isinstance(payload, dict):
+                    payload["host"] = host
+                reps[rid] = payload
         return {"enabled": any(p.get("enabled") for p in reps.values()),
                 "replicas": reps}
 
 
-def _relabel(text, rid):
-    """Inject replica="<rid>" into every series line of a Prometheus
-    exposition (comment lines dropped — see render_prometheus)."""
+def _relabel(text, rid, host=None):
+    """Inject replica="<rid>" — plus host="<host>" in fleet mode —
+    into every series line of a Prometheus exposition (comment lines
+    dropped — see render_prometheus)."""
+    tag = f'replica="{rid}"'
+    if host is not None:
+        tag += f',host="{host}"'
     out = []
     for line in text.splitlines():
         if not line or line.startswith("#"):
@@ -886,8 +909,8 @@ def _relabel(text, rid):
         name, _, rest = line.partition(" ")
         if "{" in name:
             base, _, labels = name.partition("{")
-            name = f'{base}{{replica="{rid}",{labels}'
+            name = f"{base}{{{tag},{labels}"
         else:
-            name = f'{name}{{replica="{rid}"}}'
+            name = f"{name}{{{tag}}}"
         out.append(f"{name} {rest}")
     return "\n".join(out) + "\n" if out else ""

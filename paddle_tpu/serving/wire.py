@@ -1,0 +1,183 @@
+"""Length-framed socket framing for the fleet plane's bulk channel.
+
+The fleet control plane (`serving/fleet.py`) rides `distributed/rpc.py`
+— small pickled frames between named workers. KV page payloads must
+NOT: a handoff is tens of megabytes of numpy, and pickling it would
+buffer a second copy, tie the bulk path to the pickle trust boundary,
+and hide the wire size from accounting. This module is the bulk wire
+format instead:
+
+  * **JSON control frames** — `send_json`/`recv_json`: a 4-byte `<I`
+    length prefix + UTF-8 JSON. Everything structured (ops, metadata,
+    terminal request states) rides these; nothing on the bulk channel
+    is ever unpickled.
+  * **Raw byte frames** — `send_bytes`/`recv_bytes`: an 8-byte `<Q`
+    length prefix + the payload, sent in 1 MiB memoryview slices so a
+    multi-GB page set never materializes a second contiguous copy on
+    the send side.
+  * **Arrays** — `send_array`/`recv_array`: a JSON header
+    `{dtype, shape}` (or `{none: true}`) followed by the raw bytes of
+    a C-contiguous numpy array. int8 pages and fp32 scales round-trip
+    bit-exactly — the token-identity guarantee of an in-process
+    handoff survives the socket.
+  * **KV handoffs** — `send_handoff`/`recv_handoff`: the
+    `KVHandoff`'s scalar/list metadata as one JSON frame, then its
+    k/v/ks/vs arrays. `recv_handoff` returns a real `KVHandoff`, so
+    the importing replica's scheduler/engine code is unchanged.
+
+Errors surface as `WireError` (a `ConnectionError` subclass: existing
+socket-error handling keeps catching it). Oversize frames are refused
+on BOTH ends — a corrupt length prefix fails in one clear exception
+instead of a multi-gigabyte allocation.
+
+Pure stdlib + numpy; no jax, no pickle, no serving imports beyond the
+payload class.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from .handoff import KVHandoff
+
+__all__ = ["WireError", "send_json", "recv_json", "send_bytes",
+           "recv_bytes", "send_array", "recv_array", "send_handoff",
+           "recv_handoff", "MAX_JSON_FRAME", "MAX_BULK_FRAME"]
+
+_JLEN = struct.Struct("<I")
+_BLEN = struct.Struct("<Q")
+_CHUNK = 1 << 20
+
+# control frames are metadata — anything bigger is a protocol bug
+MAX_JSON_FRAME = 64 << 20
+# bulk frames carry KV pages; cap matches the rpc layer's _MAX_FRAME
+MAX_BULK_FRAME = 1 << 30
+
+
+class WireError(ConnectionError):
+    """Framing violation on the fleet bulk channel (oversize frame,
+    truncated stream, malformed header)."""
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise WireError("fleet wire: peer closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def send_json(sock, obj):
+    payload = json.dumps(obj).encode()
+    if len(payload) > MAX_JSON_FRAME:
+        raise WireError(
+            f"fleet wire: json frame {len(payload)}B exceeds "
+            f"{MAX_JSON_FRAME}B cap")
+    sock.sendall(_JLEN.pack(len(payload)) + payload)
+
+
+def recv_json(sock):
+    (n,) = _JLEN.unpack(_recv_exact(sock, _JLEN.size))
+    if n > MAX_JSON_FRAME:
+        raise WireError(
+            f"fleet wire: json frame {n}B exceeds {MAX_JSON_FRAME}B cap")
+    try:
+        return json.loads(_recv_exact(sock, n).decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"fleet wire: malformed json frame: {e}") from e
+
+
+def send_bytes(sock, data):
+    """One bulk frame: 8-byte length + payload, chunked so the kernel
+    paces a large page set without a second contiguous copy."""
+    # cast to a flat byte view: an N-D memoryview's len() counts its
+    # FIRST dimension, not bytes
+    view = memoryview(data).cast("B")
+    if len(view) > MAX_BULK_FRAME:
+        raise WireError(
+            f"fleet wire: bulk frame {len(view)}B exceeds "
+            f"{MAX_BULK_FRAME}B cap")
+    sock.sendall(_BLEN.pack(len(view)))
+    for off in range(0, len(view), _CHUNK):
+        sock.sendall(view[off:off + _CHUNK])
+
+
+def recv_bytes(sock):
+    (n,) = _BLEN.unpack(_recv_exact(sock, _BLEN.size))
+    if n > MAX_BULK_FRAME:
+        raise WireError(
+            f"fleet wire: bulk frame {n}B exceeds {MAX_BULK_FRAME}B cap")
+    return _recv_exact(sock, n)
+
+
+def send_array(sock, arr):
+    """One optional array: JSON header {dtype, shape} + raw bytes
+    (C-order). `None` ships as {"none": true} with no body."""
+    if arr is None:
+        send_json(sock, {"none": True})
+        return 0
+    a = np.ascontiguousarray(arr)
+    send_json(sock, {"dtype": a.dtype.str, "shape": list(a.shape)})
+    send_bytes(sock, a.data)
+    return int(a.nbytes)
+
+
+def recv_array(sock):
+    head = recv_json(sock)
+    if head.get("none"):
+        return None
+    try:
+        dtype = np.dtype(head["dtype"])
+        shape = tuple(int(d) for d in head["shape"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"fleet wire: bad array header {head!r}") from e
+    raw = recv_bytes(sock)
+    want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if len(raw) != want:
+        raise WireError(
+            f"fleet wire: array body {len(raw)}B != header {want}B")
+    return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
+
+
+def send_handoff(sock, h):
+    """Ship one KVHandoff: metadata JSON frame, then k/v/ks/vs.
+    Returns the payload bytes actually framed (the
+    pt_handoff_bytes_total measurement for a socket-backed handoff)."""
+    send_json(sock, {
+        "rid": str(h.rid), "trace_id": h.trace_id,
+        "prompt": [int(t) for t in h.prompt],
+        "output": [int(t) for t in h.output],
+        "next_token": int(h.next_token), "length": int(h.length),
+        "pages": int(h.pages), "quantized": bool(h.quantized),
+        "logprobs": h.logprobs, "cached_tokens": int(h.cached_tokens),
+        "timeline": h.timeline,
+    })
+    n = 0
+    for a in (h.k, h.v, h.ks, h.vs):
+        n += send_array(sock, a)
+    return n
+
+
+def recv_handoff(sock):
+    meta = recv_json(sock)
+    k = recv_array(sock)
+    v = recv_array(sock)
+    ks = recv_array(sock)
+    vs = recv_array(sock)
+    try:
+        return KVHandoff(
+            meta["rid"], meta["prompt"], meta["output"],
+            meta["next_token"], meta["length"], meta["pages"], k, v,
+            ks=ks, vs=vs, quantized=meta["quantized"],
+            trace_id=meta.get("trace_id"),
+            logprobs=meta.get("logprobs"),
+            cached_tokens=meta.get("cached_tokens", 0),
+            timeline=meta.get("timeline"))
+    except (KeyError, TypeError, ValueError) as e:
+        raise WireError(f"fleet wire: bad handoff metadata: {e}") from e

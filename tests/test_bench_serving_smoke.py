@@ -8,6 +8,8 @@ import importlib.util
 import os
 import sys
 
+import pytest
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -382,4 +384,38 @@ def test_disagg_bench_migrates_and_matches(monkeypatch):
     assert out["decode_tpot"]["p99_s"] > 0
     assert set(out["per_role_mfu"]) == {"prefill", "decode"}
     assert out["disagg_tokens_per_sec"] > 0
+    assert out["baseline_tokens_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_fleet_bench_crosses_the_socket_and_matches(monkeypatch):
+    """PT_SERVE_FLEET=1 (ISSUE 16 acceptance): the 1 prefill + 1
+    decode SUBPROCESS topology must produce token-identical outputs vs
+    the in-process router, count real handoff payload bytes on the
+    bulk socket (not estimates), balance every worker's ledger across
+    the wire, and shut the workers down with exit code 0. Slow-marked:
+    the in-tier-1 subprocess drill lives in tests/test_fleet.py; this
+    guards the driver-visible artifact shape."""
+    bm = _load_bench_models()
+    for env in ("PT_SERVE_SPEC", "PT_SERVE_CACHE", "PT_SERVE_PREFIX",
+                "PT_SERVE_ROUTER", "PT_SERVE_MULTITURN",
+                "PT_SERVE_PIPELINE", "PT_SERVE_CHAOS",
+                "PT_SERVE_DISAGG"):
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setenv("PT_SERVE_FLEET", "1")
+    out = bm.bench_serving(on_tpu=False)
+    assert out["workload"] == "fleet-mixed"
+    assert out["outputs_match"] is True, out
+    assert out["handoff_serves"] >= out["requests"], out
+    assert out["handoff_wire_bytes"] > 0, out
+    assert out["handoff_wire_bytes_per_sec"] > 0, out
+    assert out["router_handoffs"] > 0, out
+    assert out["clean_shutdown"] is True, out
+    assert out["worker_exit_codes"] == [0, 0], out
+    led = out["ledgers"]
+    pre = next(v for k, v in led.items() if k.startswith("prefill:"))
+    dec = next(v for k, v in led.items() if k.startswith("decode:"))
+    assert pre["failed"] == 0 and dec["failed"] == 0, led
+    assert pre["handoff"] > 0, led
+    assert out["fleet_tokens_per_sec"] > 0
     assert out["baseline_tokens_per_sec"] > 0

@@ -203,6 +203,63 @@ class TestModuleApi:
             rpc.shutdown()
 
 
+class TestFrameCapAndTimeout:
+    """Oversize frames fail crisply on BOTH ends (RpcFrameError), and
+    rpc_sync's wait-forever default becomes bounded fleet-wide via
+    PT_RPC_TIMEOUT_S — an explicit timeout argument always wins."""
+
+    def test_send_oversize_refused_before_any_bytes(self, monkeypatch):
+        monkeypatch.setattr(rpc, "_MAX_FRAME", 1024)
+        a, b = socket.socketpair()
+        with a, b:
+            with pytest.raises(rpc.RpcFrameError, match="refusing"):
+                rpc._send_frame(a, b"x" * 2048)
+            # nothing hit the wire: the peer never sees a half-frame
+            b.setblocking(False)
+            with pytest.raises(BlockingIOError):
+                b.recv(1)
+
+    def test_recv_oversize_header_refused_before_alloc(self,
+                                                       monkeypatch):
+        monkeypatch.setattr(rpc, "_MAX_FRAME", 1024)
+        a, b = socket.socketpair()
+        with a, b:
+            a.sendall(rpc._LEN.pack(4096))
+            with pytest.raises(rpc.RpcFrameError, match="claims"):
+                rpc._recv_frame(b)
+
+    def test_frame_error_is_connection_error_and_exported(self):
+        assert issubclass(rpc.RpcFrameError, ConnectionError)
+        assert "RpcFrameError" in rpc.__all__
+
+    def test_env_default_timeout_resolution(self, monkeypatch):
+        monkeypatch.delenv("PT_RPC_TIMEOUT_S", raising=False)
+        assert rpc._resolve_default_timeout(-1) == -1
+        monkeypatch.setenv("PT_RPC_TIMEOUT_S", "2.5")
+        assert rpc._resolve_default_timeout(-1) == 2.5
+        # explicit timeouts never consult the env
+        assert rpc._resolve_default_timeout(7.0) == 7.0
+        assert rpc._resolve_default_timeout(None) is None
+        monkeypatch.setenv("PT_RPC_TIMEOUT_S", "soon")
+        with pytest.raises(ValueError, match="PT_RPC_TIMEOUT_S"):
+            rpc._resolve_default_timeout(-1)
+
+    def test_rpc_sync_default_timeout_from_env(self, monkeypatch):
+        rpc.init_rpc("solo", rank=0, world_size=1,
+                     master_endpoint=f"127.0.0.1:{_free_port()}")
+        try:
+            monkeypatch.setenv("PT_RPC_TIMEOUT_S", "0.3")
+            with pytest.raises(TimeoutError):
+                rpc.rpc_sync("solo", _sleep_then, args=(1, 3.0))
+            # explicit timeout beats the env default
+            assert rpc.rpc_sync("solo", _sleep_then, args=(2, 0.05),
+                                timeout=10.0) == 2
+            monkeypatch.delenv("PT_RPC_TIMEOUT_S")
+            assert rpc.rpc_sync("solo", _sleep_then, args=(3, 0.05)) == 3
+        finally:
+            rpc.shutdown()
+
+
 def test_two_process_rpc():
     """The real thing: two processes, rendezvous at the master, calls in
     both directions, remote exception propagation, clean shutdown.

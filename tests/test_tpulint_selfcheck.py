@@ -188,6 +188,26 @@ def test_pulse_functions_in_hot_set():
     assert cfg.is_hot_module("paddle_tpu/observability/pulse.py")
 
 
+def test_fleet_functions_in_hot_set():
+    """ISSUE 16: the fleet plane's bulk-channel threads (token stream
+    serving, KV handoff shipping, page spill/fetch, the proxy's stream
+    reader) are pure host+socket code riding the serving request path
+    — they sit in the TPL001 hot set so a stray device pull can never
+    hide in the transport, and the plane added zero sanctioned syncs."""
+    from paddle_tpu.analysis.config import LintConfig
+
+    cfg = LintConfig.default()
+    for fn in ("FleetWorker._serve_stream",
+               "FleetWorker._serve_handoff",
+               "FleetPages._spill_loop",
+               "FleetPages.fetch_missing",
+               "RemoteRequest._read_loop"):
+        assert fn in cfg.hot_functions, fn
+    assert cfg.sanctioned_sync == ["ServingEngine._fetch_results"]
+    assert cfg.is_hot_module("paddle_tpu/serving/fleet.py")
+    assert cfg.is_hot_module("paddle_tpu/serving/wire.py")
+
+
 def test_sanctioned_sync_config_check(tmp_path):
     """The TPL001 config check: a raw jax.device_get anywhere in a hot
     serving module — even outside the configured hot functions — is a

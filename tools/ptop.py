@@ -104,8 +104,13 @@ def render(payload, out=sys.stdout, width=24, color=False):
     w(_paint(header, _BOLD + (_RED if fired else ""), color) + "\n")
     if len(cols) > 1:
         cell = width + 12
+        # fleet mode tags each replica payload with its host — show
+        # `rid@host` so per-host aggregation is readable at a glance
+        heads = [f"{c}@{reps[c]['host']}" if reps[c].get("host") else c
+                 for c in cols]
         w(" " * 44 + "".join(
-            _paint(f"{c:>{cell}}", _DIM, color) for c in cols) + "\n")
+            _paint(f"{h[-cell:]:>{cell}}", _DIM, color)
+            for h in heads) + "\n")
     names = sorted({n for p in reps.values()
                     for n in (p.get("signals") or {})})
     for name in names:
