@@ -26,6 +26,16 @@ Rules (see docs/static_analysis.md for bad/good examples):
   TPL005  eager block_until_ready        (warning)
   TPL006  mutable default / import-time device allocation (error)
 
+Cross-file rules (the tpuracer pass — a whole-program index of thread
+entries, per-class locks, acquisition order, and attribute ownership
+is built first, then each finding lands at its single witness line):
+
+  TPL007  lock-order inversion across files            (error)
+  TPL008  multi-thread shared write, no common lock    (error)
+  TPL009  blocking socket/rpc/queue call under a lock  (error)
+  TPL010  env knob read but not declared in _env.py    (error)
+  TPL011  pt_* metric booked/documented drift          (warning)
+
 Suppress a reviewed finding inline with a justification:
 
     x = np.asarray(lengths)  # tpulint: disable=TPL001 -- host-side table
@@ -37,7 +47,8 @@ from __future__ import annotations
 
 from .engine import Finding, Rule, Severity, all_rules, get_rule, register
 from .config import LintConfig, DEFAULT_CONFIG
-from .runner import lint_file, lint_paths, lint_source
+from .project import ProjectIndex
+from .runner import analyze_paths, lint_file, lint_paths, lint_source
 from .reporting import render_json, render_text
 
 # importing .rules registers every built-in rule with the engine
@@ -45,7 +56,7 @@ from . import rules as _rules  # noqa: F401  (registration side effect)
 
 __all__ = [
     "Finding", "Rule", "Severity", "LintConfig", "DEFAULT_CONFIG",
-    "all_rules", "get_rule", "register",
-    "lint_file", "lint_paths", "lint_source",
+    "ProjectIndex", "all_rules", "get_rule", "register",
+    "analyze_paths", "lint_file", "lint_paths", "lint_source",
     "render_json", "render_text",
 ]

@@ -7,12 +7,14 @@ so CI boxes without an accelerator stack can run it.
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 
 from .config import LintConfig
 from .engine import all_rules, get_rule
 from .reporting import render_json, render_text
-from .runner import lint_paths
+from .runner import analyze_paths
 
 # rule registration side effect
 from . import rules as _rules  # noqa: F401
@@ -41,12 +43,49 @@ def _validate(ids):
             f"known: {sorted(known)}")
 
 
+def _changed_since(ref):
+    """Absolute paths of files changed since `ref` (diff + untracked),
+    for the --changed fast mode. Raises ValueError on git trouble."""
+    def _git(*args):
+        proc = subprocess.run(["git", *args], capture_output=True,
+                              text=True, timeout=30)
+        if proc.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        return proc.stdout
+
+    top = _git("rev-parse", "--show-toplevel").strip()
+    names = _git("diff", "--name-only", "--diff-filter=d",
+                 ref).splitlines()
+    names += _git("ls-files", "--others",
+                  "--exclude-standard").splitlines()
+    return {os.path.abspath(os.path.join(top, n))
+            for n in names if n.strip()}
+
+
+def _threads_text(project):
+    rows = project.thread_report()
+    out = ["thread entries (threading.Thread registrations):"]
+    if not rows:
+        out.append("  (none found in the scanned files)")
+    width_name = max([len(r[0]) for r in rows], default=4) + 2
+    width_entry = max([len(r[1]) for r in rows], default=5) + 2
+    for hint, entry, where in rows:
+        out.append(f"  {hint:<{width_name}}{entry:<{width_entry}}{where}")
+    out.append("")
+    out.append("plus the <caller> pseudo-entry: any external thread "
+               "reaching the public API methods.")
+    return "\n".join(out)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="tpulint",
         description="TPU-hostility static analysis for paddle_tpu "
                     "(host syncs, retrace hazards, untraced RNG, lock "
-                    "discipline, import-time device work)")
+                    "discipline, import-time device work, cross-file "
+                    "lock order / thread ownership / registry drift)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories to lint")
     ap.add_argument("--format", choices=("text", "json"), default="text")
@@ -57,6 +96,13 @@ def main(argv=None):
     ap.add_argument("--config", metavar="FILE.json",
                     help="JSON overlay for hot modules / bench paths / "
                          "lock scope / severities")
+    ap.add_argument("--changed", metavar="GIT_REF",
+                    help="report findings only for files changed since "
+                         "this git ref (the project index still covers "
+                         "every scanned file) — fast pre-commit mode")
+    ap.add_argument("--threads", action="store_true",
+                    help="print the inferred thread-entry inventory "
+                         "from the project index and exit")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="include suppressed findings in text output")
     ap.add_argument("--list-rules", action="store_true")
@@ -75,11 +121,19 @@ def main(argv=None):
         config = LintConfig.from_json(args.config) if args.config \
             else LintConfig.default()
         rules = _select_rules(args.rules, args.disable)
+        changed = _changed_since(args.changed) if args.changed else None
     except (OSError, ValueError) as e:
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
 
-    findings, nfiles = lint_paths(args.paths, config=config, rules=rules)
+    findings, nfiles, project = analyze_paths(args.paths, config=config,
+                                              rules=rules)
+    if args.threads:
+        print(_threads_text(project))
+        return 0
+    if changed is not None:
+        findings = [f for f in findings
+                    if os.path.abspath(f.path) in changed]
     if args.format == "json":
         print(render_json(findings, nfiles))
     else:

@@ -52,6 +52,21 @@ class LintConfig:
     # invariant ("one batched read, issued a step behind") is enforced
     # by lint, not convention.
     sanctioned_sync: list = field(default_factory=list)
+    # Packages whose classes/threads enter the whole-program project
+    # index (TPL007 lock order, TPL008 ownership, TPL009 blocking).
+    concurrency_scope: list = field(default_factory=list)
+    # Dotted-name fnmatch patterns of calls that block on the network
+    # or a queue — TPL009 flags them under a held lock.
+    blocking_calls: list = field(default_factory=list)
+    # Lock attr-name globs that exist to serialize one IO channel
+    # (socket write mutexes); TPL009 ignores them by design.
+    io_locks: list = field(default_factory=list)
+    # Packages migrated to the paddle_tpu._env accessors: TPL010 bans
+    # raw os.environ reads of declared knobs there.
+    env_migrated: list = field(default_factory=list)
+    # Glob patterns (relative to the invocation cwd) of the markdown
+    # files holding the pt_* metric tables TPL011 cross-checks.
+    metrics_docs: list = field(default_factory=list)
 
     # ---- queries used by the rules -----------------------------------
     def is_hot_module(self, path):
@@ -79,6 +94,12 @@ class LintConfig:
 
     def in_lock_scope(self, path):
         return _match(self.lock_scope, path)
+
+    def in_concurrency_scope(self, path):
+        return _match(self.concurrency_scope, path)
+
+    def in_env_migrated(self, path):
+        return _match(self.env_migrated, path)
 
     def is_excluded(self, path):
         return _match(self.exclude, path)
@@ -174,6 +195,29 @@ class LintConfig:
             # the engine's batched reader is the one sanctioned
             # device->host sync of the whole step loop
             sanctioned_sync=["ServingEngine._fetch_results"],
+            # the thread-heavy planes: serving runtime + the
+            # observability daemons that scrape it
+            concurrency_scope=[
+                "paddle_tpu/serving/*.py",
+                "paddle_tpu/observability/*.py",
+            ],
+            blocking_calls=[
+                # raw socket ops (wire.py and friends)
+                "*.sendall", "*.recv", "*.recv_into", "*.accept",
+                "*.connect", "*.create_connection",
+                # rpc layer round trips
+                "rpc_sync", "*.rpc_sync",
+                "*.store.get", "*.store.set", "*.store.wait",
+                "*.all_worker_infos",
+                # stdlib network fetches
+                "*.urlopen",
+            ],
+            io_locks=["*_wlock", "*_send_lock", "*_io_lock"],
+            env_migrated=[
+                "paddle_tpu/serving/*.py",
+                "paddle_tpu/observability/*.py",
+            ],
+            metrics_docs=["docs/*.md"],
         )
 
     @classmethod
@@ -183,15 +227,16 @@ class LintConfig:
         with open(path, "r", encoding="utf-8") as f:
             data = json.load(f)
         cfg = cls.default()
-        for key in ("hot_modules", "hot_functions", "bench_paths",
-                    "lock_scope", "exclude", "sanctioned_sync"):
+        list_keys = ("hot_modules", "hot_functions", "bench_paths",
+                     "lock_scope", "exclude", "sanctioned_sync",
+                     "concurrency_scope", "blocking_calls", "io_locks",
+                     "env_migrated", "metrics_docs")
+        for key in list_keys:
             if key in data:
                 setattr(cfg, key, list(data[key]))
         if "severity" in data:
             cfg.severity.update(data["severity"])
-        unknown = set(data) - {"hot_modules", "hot_functions",
-                               "bench_paths", "lock_scope", "exclude",
-                               "severity", "sanctioned_sync"}
+        unknown = set(data) - set(list_keys) - {"severity"}
         if unknown:
             raise ValueError(f"tpulint config: unknown keys {sorted(unknown)}")
         return cfg

@@ -60,6 +60,10 @@ class FileContext:
         self.lines = source.splitlines()
         self.config = config
         self.tree = ast.parse(source, filename=path)
+        # the whole-program index (analysis.project.ProjectIndex); the
+        # runner attaches it after every file has parsed, so cross-file
+        # rules see the full picture while per-file rules ignore it
+        self.project = None
         self._link_parents()
         self.import_aliases = self._scan_imports()
         self.traced_functions = self._find_traced_functions()
@@ -98,10 +102,15 @@ class FileContext:
                 for a in node.names:
                     aliases[a.asname or a.name.split(".")[0]] = \
                         a.name if a.asname else a.name.split(".")[0]
-            elif isinstance(node, ast.ImportFrom) and node.module:
+            elif isinstance(node, ast.ImportFrom):
                 for a in node.names:
-                    aliases[a.asname or a.name] = \
-                        f"{node.module}.{a.name}"
+                    if node.module:
+                        aliases[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+                    elif node.level:
+                        # `from . import wire as _wire` — the sibling
+                        # module itself is the canonical root
+                        aliases[a.asname or a.name] = a.name
         return aliases
 
     def resolve(self, node):

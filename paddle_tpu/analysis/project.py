@@ -1,0 +1,802 @@
+"""Whole-program project index — the cross-file phase of tpulint.
+
+Per-file rules (TPL001–TPL006) see one AST at a time; the concurrency
+and contract rules introduced with the tpuracer pass (TPL007–TPL011)
+need the *project*: which functions run on which threads, which locks
+exist per class, in what order code acquires them, who writes each
+shared attribute, which env knobs are declared, which metrics are
+booked and documented. `ProjectIndex.build(contexts, config)` derives
+all of it in one pass over the already-parsed `FileContext`s, and the
+rules then filter the index's findings down to the file they are
+checking (a cross-file finding is emitted only by the file holding its
+witness line, so every finding appears exactly once and inline
+suppressions keep working).
+
+The index is deliberately conservative where static analysis runs out
+of road: attribute types come only from `self.x = ClassName(...)`
+assignments, call targets resolve only through `self.m()` /
+`self.attr.m()` / same-file bare calls, and anything unresolvable
+simply contributes nothing (no guessed findings).
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import glob
+import os
+import re
+
+from .context import dotted_name
+
+
+# metric names inside a backtick in a docs table row: full names,
+# optional {a,b} alternation groups, optional * wildcards
+_DOC_TOKEN_RE = re.compile(r"`(pt_[a-z0-9_{},*]+)`")
+# exposition-style literal: the metric name followed by a space or a
+# label brace *inside the same string* ("pt_mfu {v}" f-strings etc.)
+_EXPO_RE = re.compile(r"^(pt_[a-z0-9_]+)[ {]")
+_PT_NAME_RE = re.compile(r"^pt_[a-z0-9_]+$")
+
+_ENV_ACCESSORS = {"env_raw", "env_str", "env_int", "env_float",
+                  "env_bool"}
+_METRIC_KINDS = {"counter", "gauge", "histogram"}
+_LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+CALLER_ENTRY = "<caller>"
+
+
+def pretty_key(key):
+    """Human form of a method-table key: class methods are already
+    'Class.m'; module functions turn 'dir/wire.py::send_msg' into
+    'wire.send_msg'."""
+    if "::" not in key:
+        return key
+    path, _, name = key.partition("::")
+    mod = os.path.basename(path)
+    if mod.endswith(".py"):
+        mod = mod[:-3]
+    return f"{mod}.{name}"
+
+
+def _self_attr(node):
+    """'attr' when node is `self.attr`, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def env_knob_name(name):
+    """True when `name` is a paddle_tpu-owned env knob (the namespaces
+    TPL010 governs)."""
+    return name.startswith("PT_") or name.startswith("PADDLE_TPU_")
+
+
+class ThreadEntry:
+    """One inferred thread entry point: a `threading.Thread(target=…)`
+    registration, or the shared `<caller>` pseudo-entry standing for
+    every external thread that can call public API methods."""
+
+    def __init__(self, entry_id, target_key, name_hint, path, line):
+        self.entry_id = entry_id      # human id, e.g. 'Sched._pump'
+        self.target_key = target_key  # method-table key or None
+        self.name_hint = name_hint    # thread name= kwarg, best effort
+        self.path = path
+        self.line = line
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"ThreadEntry({self.entry_id!r}, name={self.name_hint!r})"
+
+
+class WriteSite:
+    def __init__(self, cls_name, attr, locks, node, path, method):
+        self.cls_name = cls_name
+        self.attr = attr
+        self.locks = frozenset(locks)  # lock ids held at the write
+        self.node = node
+        self.path = path
+        self.method = method           # method-table key
+
+
+class CallSite:
+    def __init__(self, dotted, target_key, locks, node, path,
+                 blocking_desc=None):
+        self.dotted = dotted           # raw dotted text, '' if exotic
+        self.target_key = target_key   # resolved method key or None
+        self.locks = frozenset(locks)
+        self.node = node
+        self.path = path
+        self.blocking_desc = blocking_desc  # str when the call blocks
+
+
+class AcqEdge:
+    """Lock-order edge: `dst` acquired while `src` is held."""
+
+    def __init__(self, src, dst, node, path, detail):
+        self.src = src
+        self.dst = dst
+        self.node = node
+        self.path = path
+        self.detail = detail
+
+
+class MethodSummary:
+    def __init__(self, key, cls_name, name, path, node):
+        self.key = key
+        self.cls_name = cls_name       # None for module functions
+        self.name = name
+        self.path = path
+        self.node = node
+        self.writes = []               # [WriteSite]
+        self.reads = []                # [(attr, locks)]
+        self.calls = []                # [CallSite]
+        self.direct_acquires = set()   # lock ids acquired lexically
+        self.acq_edges = []            # [AcqEdge] direct nestings
+
+
+class ClassIndex:
+    def __init__(self, name, path, node):
+        self.name = name
+        self.path = path
+        self.node = node
+        self.locks = set()             # self attrs holding Lock/Cond
+        self.attr_types = {}           # attr -> leaf class/type name
+        self.methods = {}              # method name -> MethodSummary
+
+    def lock_id(self, attr):
+        return f"{self.name}.{attr}"
+
+
+class ProjectIndex:
+    """Cross-file facts + the lazily computed cross-file analyses."""
+
+    def __init__(self, config):
+        self.config = config
+        self.classes = {}              # class name -> ClassIndex
+        self.methods = {}              # method key -> MethodSummary
+        self.thread_entries = []       # [ThreadEntry] (real threads)
+        self.env_declared = set()      # exact knob names
+        self.env_patterns = []         # knob names containing '*'
+        self.env_registry_paths = []   # the scanned _env.py files
+        self.metric_bookings = []      # [(name, node, path)] registry calls
+        self.metric_tokens = set()     # permissive: any pt_* literal
+        self.metric_token_patterns = set()  # f-string bookings, '*'-holed
+        self.metrics_registry_path = None  # file defining MetricsRegistry
+        self.docs_names = None         # {name: docfile} | None (no docs)
+        self.docs_patterns = []        # [(fnmatch pat, docfile)]
+        self._mod_funcs = {}           # (module, func) -> method key
+        self._cycles = None
+        self._races = None
+        self._blocking = None
+        self._trans_acquires = None
+
+    # ------------------------------------------------------------ build
+    @classmethod
+    def build(cls, contexts, config):
+        idx = cls(config)
+        scoped = [c for c in contexts
+                  if config.in_concurrency_scope(c.path)]
+        for ctx in contexts:
+            idx._scan_contracts(ctx)
+        for ctx in scoped:
+            idx._scan_classes(ctx)
+        # attr types need the full class table, so resolve them (and
+        # everything depending on call resolution) in a second pass
+        for ctx in scoped:
+            idx._scan_bodies(ctx)
+        idx._load_docs()
+        return idx
+
+    # ---- pass 0: env + metrics contracts (all files) -----------------
+    def _scan_contracts(self, ctx):
+        if os.path.basename(ctx.path) == "_env.py":
+            self.env_registry_paths.append(ctx.path)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id == "declare" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    name = node.args[0].value
+                    if "*" in name:
+                        self.env_patterns.append(name)
+                    else:
+                        self.env_declared.add(name)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name == "MetricsRegistry":
+                self.metrics_registry_path = ctx.path
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _METRIC_KINDS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value.startswith("pt_"):
+                    name = arg.value
+                    self.metric_bookings.append((name, node, ctx.path))
+                    self.metric_tokens.add(name)
+                elif isinstance(arg, ast.JoinedStr):
+                    # dynamic name, e.g. f"pt_phase_{ph}_seconds":
+                    # remember the shape so documented rows expanding
+                    # to it don't read as ghosts
+                    pat = _const_prefix(arg)
+                    if pat and pat.startswith("pt_"):
+                        self.metric_token_patterns.add(pat)
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                self._note_metric_token(node.value)
+
+    def _note_metric_token(self, s):
+        if _PT_NAME_RE.match(s):
+            self.metric_tokens.add(s)
+        else:
+            m = _EXPO_RE.match(s)
+            if m:
+                self.metric_tokens.add(m.group(1))
+
+    def _load_docs(self):
+        files = sorted({f for pat in self.config.metrics_docs
+                        for f in glob.glob(pat)})
+        if not files:
+            return
+        self.docs_names = {}
+        for path in files:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for line in text.splitlines():
+                if not line.lstrip().startswith("|"):
+                    continue
+                for tok in _DOC_TOKEN_RE.findall(line):
+                    for name in _expand_braces(tok):
+                        if "*" in name:
+                            self.docs_patterns.append((name, path))
+                        else:
+                            self.docs_names[name] = path
+
+    # ---- metric name matching (the _total render tolerance) ----------
+    @staticmethod
+    def _names_equal(a, b):
+        return a == b or a + "_total" == b or a == b + "_total"
+
+    def metric_documented(self, booked):
+        if self.docs_names is None:
+            return True
+        for doc in self.docs_names:
+            if self._names_equal(booked, doc):
+                return True
+        return any(fnmatch.fnmatch(booked, pat)
+                   or fnmatch.fnmatch(booked + "_total", pat)
+                   for pat, _ in self.docs_patterns)
+
+    def undocumented_bookings(self):
+        return [(name, node, path)
+                for name, node, path in self.metric_bookings
+                if not self.metric_documented(name)]
+
+    def unbooked_documented(self):
+        """Doc-table names with no trace in code — only meaningful when
+        the scan actually includes the metrics registry."""
+        if self.docs_names is None or self.metrics_registry_path is None:
+            return []
+        out = []
+        for doc, docfile in sorted(self.docs_names.items()):
+            if any(self._names_equal(doc, tok)
+                   for tok in self.metric_tokens):
+                continue
+            if any(fnmatch.fnmatch(doc, pat)
+                   for pat in self.metric_token_patterns):
+                continue
+            out.append((doc, docfile))
+        return out
+
+    # ---- env registry queries ----------------------------------------
+    def env_is_declared(self, name):
+        if name in self.env_declared:
+            return True
+        return any(fnmatch.fnmatch(name, pat)
+                   for pat in self.env_patterns)
+
+    @property
+    def has_env_registry(self):
+        return bool(self.env_registry_paths)
+
+    # ---- pass 1: class skeletons (scoped files) ----------------------
+    def _scan_classes(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            ci = self.classes.setdefault(
+                node.name, ClassIndex(node.name, ctx.path, node))
+            for m in node.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                key = f"{node.name}.{m.name}"
+                ms = MethodSummary(key, node.name, m.name, ctx.path, m)
+                ci.methods[m.name] = ms
+                self.methods[key] = ms
+                for sub in ast.walk(m):
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.Call):
+                        leaf = ctx.resolve(sub.value.func) \
+                            .rsplit(".", 1)[-1]
+                        for t in sub.targets:
+                            attr = _self_attr(t)
+                            if attr is None:
+                                continue
+                            if leaf in _LOCK_TYPES:
+                                ci.locks.add(attr)
+                            elif leaf:
+                                ci.attr_types.setdefault(attr, leaf)
+        # module-level functions get summaries too (thread targets and
+        # call-graph hops go through them: wire.send_msg etc.)
+        mod = os.path.basename(ctx.path)[:-3]
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{ctx.path}::{node.name}"
+                ms = MethodSummary(key, None, node.name, ctx.path, node)
+                self.methods[key] = ms
+                # imported cross-file calls resolve through the leading
+                # module name ('wire.send_msg' / `from .wire import
+                # send_msg`); last definition wins on collisions
+                self._mod_funcs[(mod, node.name)] = key
+
+    # ---- pass 2: bodies (needs full class/attr-type table) -----------
+    def _scan_bodies(self, ctx):
+        # class methods
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and \
+                    node.name in self.classes:
+                ci = self.classes[node.name]
+                if ci.path != ctx.path:
+                    continue  # duplicate class name in another file
+                for m in node.body:
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) and \
+                            m.name in ci.methods:
+                        self._scan_method(ctx, ci, ci.methods[m.name])
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{ctx.path}::{node.name}"
+                if key in self.methods:
+                    self._scan_method(ctx, None, self.methods[key])
+
+    def _scan_method(self, ctx, ci, ms):
+        m = ms.node
+        for node in ast.walk(m):
+            held = self._locks_held(ctx, node, ci, m)
+            if isinstance(node, (ast.Assign, ast.AugAssign)) and \
+                    ci is not None:
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None or attr in ci.locks:
+                        continue
+                    ms.writes.append(WriteSite(
+                        ci.name, attr, held, node, ctx.path, ms.key))
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and ci is not None:
+                attr = _self_attr(node)
+                if attr and attr not in ci.locks:
+                    ms.reads.append((attr, frozenset(held)))
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    lock = self._with_lock(ctx, item, ci)
+                    if lock is None:
+                        continue
+                    ms.direct_acquires.add(lock)
+                    for h in held:
+                        if h != lock:
+                            ms.acq_edges.append(AcqEdge(
+                                h, lock, node, ctx.path,
+                                f"`with` in `{ms.key}`"))
+            elif isinstance(node, ast.Call):
+                self._scan_call(ctx, ci, ms, node, held)
+
+    def _scan_call(self, ctx, ci, ms, node, held):
+        dotted = dotted_name(node.func)
+        resolved = ctx.resolve(node.func)
+        target_key = self._resolve_call(ctx, ci, dotted, resolved)
+        blocking = self._blocking_desc(ctx, ci, node, dotted)
+        ms.calls.append(CallSite(dotted, target_key, held, node,
+                                 ctx.path, blocking))
+        # thread entry registration
+        if resolved in ("threading.Thread", "Thread"):
+            self._note_thread(ctx, ci, node)
+
+    def _note_thread(self, ctx, ci, node):
+        target = name_hint = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            elif kw.arg == "name":
+                name_hint = _const_prefix(kw.value)
+        if target is None:
+            return
+        entry_id, key = self._entry_for(ctx, ci, target)
+        self.thread_entries.append(ThreadEntry(
+            entry_id, key, name_hint or "", ctx.path, node.lineno))
+
+    def _entry_for(self, ctx, ci, target):
+        """(human id, method key or None) for a Thread target expr."""
+        attr = _self_attr(target)
+        if attr is not None and ci is not None:
+            if attr in ci.methods:
+                return f"{ci.name}.{attr}", f"{ci.name}.{attr}"
+            return f"{ci.name}.{attr}", None
+        if isinstance(target, ast.Name):
+            key = f"{ctx.path}::{target.id}"
+            return target.id, key if key in self.methods else None
+        dotted = dotted_name(target)
+        if dotted.startswith("self.") and ci is not None:
+            # self.attr.method — type the attr if we can
+            parts = dotted.split(".")
+            if len(parts) == 3:
+                tcls = ci.attr_types.get(parts[1])
+                if tcls in self.classes and \
+                        parts[2] in self.classes[tcls].methods:
+                    key = f"{tcls}.{parts[2]}"
+                    return key, key
+        return dotted or "<unresolved>", None
+
+    # ---- lock / call helpers -----------------------------------------
+    def _locks_held(self, ctx, node, ci, method):
+        """Lock ids held lexically at `node` within `method`; methods
+        named *_locked document "caller holds the lock" and count as
+        holding every lock of their class."""
+        if ci is None:
+            return frozenset()
+        held = set()
+        if method.name.endswith("_locked"):
+            held |= {ci.lock_id(a) for a in ci.locks}
+        for p in ctx.parents(node):
+            if p is method:
+                break
+            if isinstance(p, ast.With):
+                for item in p.items:
+                    lock = self._with_lock(ctx, item, ci)
+                    if lock is not None:
+                        held.add(lock)
+        return frozenset(held)
+
+    def _with_lock(self, ctx, item, ci):
+        """Lock id when a `with` item acquires a class lock."""
+        if ci is None:
+            return None
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            expr = expr.func
+            if isinstance(expr, ast.Attribute):
+                expr = expr.value     # self._cv.acquire_timeout(...)
+        attr = _self_attr(expr)
+        if attr is not None and attr in ci.locks:
+            return ci.lock_id(attr)
+        return None
+
+    def _resolve_call(self, ctx, ci, dotted, resolved=""):
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if parts[0] == "self" and ci is not None:
+            if len(parts) == 2 and parts[1] in ci.methods:
+                return f"{ci.name}.{parts[1]}"
+            if len(parts) == 3:
+                tcls = ci.attr_types.get(parts[1])
+                if tcls in self.classes and \
+                        parts[2] in self.classes[tcls].methods:
+                    return f"{tcls}.{parts[2]}"
+            return None
+        if len(parts) == 1:
+            key = f"{ctx.path}::{parts[0]}"
+            if key in self.methods:
+                return key
+        # imported module function: `send_msg` resolving to
+        # 'wire.send_msg', or a direct `wire.send_msg(...)` call
+        rparts = (resolved or dotted).split(".")
+        if len(rparts) >= 2:
+            return self._mod_funcs.get((rparts[-2], rparts[-1]))
+        return None
+
+    def _blocking_desc(self, ctx, ci, node, dotted):
+        """Why this call can block (str), else None. Driven by the
+        `blocking_calls` config patterns plus a queue.get special case
+        (only a get with no timeout parks the thread forever)."""
+        cand = dotted or ""
+        resolved = ctx.resolve(node.func)
+        for pat in self.config.blocking_calls:
+            if (cand and fnmatch.fnmatch(cand, pat)) or \
+                    (resolved and fnmatch.fnmatch(resolved, pat)):
+                return cand or resolved
+        # self._q.get() on a queue.Queue-typed attr, no timeout
+        parts = cand.split(".")
+        if len(parts) == 3 and parts[0] == "self" and \
+                parts[2] == "get" and ci is not None:
+            tleaf = ci.attr_types.get(parts[1], "")
+            if tleaf.endswith("Queue"):
+                has_timeout = len(node.args) >= 2 or any(
+                    kw.arg == "timeout" for kw in node.keywords)
+                if not has_timeout:
+                    return f"{cand} (queue get, no timeout)"
+        return None
+
+    # ============================================================ lazy
+    # ---- transitive lock acquisition (fixpoint over the call graph)
+    def _transitive_acquires(self):
+        if self._trans_acquires is not None:
+            return self._trans_acquires
+        acq = {k: set(m.direct_acquires) for k, m in self.methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, m in self.methods.items():
+                for call in m.calls:
+                    if call.target_key and call.target_key in acq:
+                        extra = acq[call.target_key] - acq[k]
+                        if extra:
+                            acq[k] |= extra
+                            changed = True
+        self._trans_acquires = acq
+        return acq
+
+    def lock_order_edges(self):
+        """All acquisition-order edges, direct and through calls."""
+        acq = self._transitive_acquires()
+        edges = []
+        for m in self.methods.values():
+            edges.extend(m.acq_edges)
+            for call in m.calls:
+                if not call.locks or not call.target_key:
+                    continue
+                for dst in acq.get(call.target_key, ()):
+                    for src in call.locks:
+                        if src != dst:
+                            edges.append(AcqEdge(
+                                src, dst, call.node, call.path,
+                                f"call into "
+                                f"`{pretty_key(call.target_key)}` "
+                                f"from `{pretty_key(m.key)}`"))
+        return edges
+
+    def lock_cycles(self):
+        """Cycles in the lock-order graph; one record per SCC:
+        (ordered lock-id cycle, witness AcqEdge)."""
+        if self._cycles is not None:
+            return self._cycles
+        adj = {}
+        for e in self.lock_order_edges():
+            adj.setdefault(e.src, {})
+            # keep the earliest witness per (src, dst)
+            cur = adj[e.src].get(e.dst)
+            if cur is None or (e.path, e.node.lineno) < \
+                    (cur.path, cur.node.lineno):
+                adj[e.src][e.dst] = e
+        self._cycles = []
+        for scc in _sccs({s: set(d) for s, d in adj.items()}):
+            if len(scc) < 2:
+                continue
+            inside = [adj[s][d] for s in scc for d in adj.get(s, {})
+                      if d in scc]
+            witness = min(inside, key=lambda e: (e.path, e.node.lineno))
+            self._cycles.append((sorted(scc), witness))
+        return self._cycles
+
+    # ---- thread reachability + shared-attribute ownership -----------
+    def entry_points(self):
+        """[(entry_id, [start method keys])] — real thread entries plus
+        the `<caller>` pseudo-entry for public API methods."""
+        entries = {}
+        for te in self.thread_entries:
+            if te.target_key:
+                entries.setdefault(te.entry_id, set()).add(te.target_key)
+        public = {ms.key for ms in self.methods.values()
+                  if ms.cls_name is not None
+                  and not ms.name.startswith("_")}
+        if public:
+            entries[CALLER_ENTRY] = public
+        return sorted((eid, sorted(keys))
+                      for eid, keys in entries.items())
+
+    def reachable(self, start_keys):
+        seen = set(start_keys)
+        stack = list(start_keys)
+        while stack:
+            k = stack.pop()
+            m = self.methods.get(k)
+            if m is None:
+                continue
+            for call in m.calls:
+                t = call.target_key
+                if t and t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        return seen
+
+    def ownership_map(self):
+        """{(class, attr): {entry_id: [WriteSite]}} over every entry —
+        the attribute ownership map TPL008 judges."""
+        owners = {}
+        for eid, starts in self.entry_points():
+            reach = self.reachable(starts)
+            for k in reach:
+                m = self.methods.get(k)
+                if m is None:
+                    continue
+                if m.name in ("__init__", "__post_init__"):
+                    continue  # construction happens-before the threads
+                for w in m.writes:
+                    owners.setdefault((w.cls_name, w.attr), {}) \
+                        .setdefault(eid, []).append(w)
+        return owners
+
+    def shared_attr_races(self):
+        """TPL008 substance: [(class, attr, entry ids, witness
+        WriteSite)] for multi-writer attrs with no common lock."""
+        if self._races is not None:
+            return self._races
+        self._races = []
+        for (cls_name, attr), by_entry in sorted(
+                self.ownership_map().items()):
+            if len(by_entry) < 2:
+                continue  # single-writer (delta-mirror) — fine
+            sites = [w for sites in by_entry.values() for w in sites]
+            common = frozenset.intersection(
+                *[w.locks for w in sites])
+            if common:
+                continue
+            witness = min(sites, key=lambda w: (len(w.locks), w.path,
+                                                w.node.lineno))
+            self._races.append((cls_name, attr,
+                                sorted(by_entry), witness))
+        return self._races
+
+    # ---- blocking-while-locked (TPL009) ------------------------------
+    def _transitive_blocking(self):
+        """method key -> one witness blocking desc reachable from it."""
+        blk = {}
+        for k, m in self.methods.items():
+            for call in m.calls:
+                if call.blocking_desc:
+                    blk.setdefault(k, call.blocking_desc)
+        changed = True
+        while changed:
+            changed = False
+            for k, m in self.methods.items():
+                if k in blk:
+                    continue
+                for call in m.calls:
+                    t = call.target_key
+                    if t and t in blk:
+                        blk[k] = f"{blk[t]} via `{pretty_key(t)}`"
+                        changed = True
+                        break
+        return blk
+
+    def blocking_under_lock(self):
+        """TPL009 substance: [(desc, locks, CallSite, via)] — blocking
+        calls made while holding a non-IO lock, directly or through a
+        resolvable callee."""
+        if self._blocking is not None:
+            return self._blocking
+        trans = self._transitive_blocking()
+        out = []
+        for m in self.methods.values():
+            for call in m.calls:
+                locks = self._state_locks(call.locks)
+                if not locks:
+                    continue
+                if call.blocking_desc:
+                    out.append((call.blocking_desc, locks, call, None))
+                elif call.target_key and call.target_key in trans:
+                    out.append((trans[call.target_key], locks, call,
+                                call.target_key))
+        self._blocking = out
+        return out
+
+    def _state_locks(self, locks):
+        """Drop IO-ownership locks (config `io_locks` name globs): a
+        mutex whose *purpose* is serializing one socket legitimately
+        spans its sends."""
+        kept = []
+        for lid in locks:
+            attr = lid.rsplit(".", 1)[-1]
+            if not any(fnmatch.fnmatch(attr, pat)
+                       for pat in self.config.io_locks):
+                kept.append(lid)
+        return sorted(kept)
+
+    # ---- reporting ---------------------------------------------------
+    def thread_report(self):
+        """Rows for the CLI --threads inventory: (thread name hint,
+        entry, path:line)."""
+        rows = []
+        for te in sorted(self.thread_entries,
+                         key=lambda t: (t.path, t.line)):
+            rows.append((te.name_hint or "-", te.entry_id,
+                         f"{te.path}:{te.line}"))
+        return rows
+
+
+def _const_prefix(node):
+    """Best-effort constant text of a str expr ('pt-fleet-*' for
+    f-strings with formatted tails)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.append(v.value)
+            else:
+                out.append("*")
+        return "".join(out)
+    return None
+
+
+def _expand_braces(tok):
+    """'pt_a_{x,y}_total' -> ['pt_a_x_total', 'pt_a_y_total']."""
+    m = re.search(r"\{([^{}]*,[^{}]*)\}", tok)
+    if not m:
+        yield tok
+        return
+    head, tail = tok[:m.start()], tok[m.end():]
+    for alt in m.group(1).split(","):
+        yield from _expand_braces(head + alt + tail)
+
+
+def _sccs(adj):
+    """Tarjan strongly-connected components of {node: {succ}}."""
+    nodes = set(adj) | {d for ds in adj.values() for d in ds}
+    index = {}
+    low = {}
+    onstack = set()
+    stack = []
+    out = []
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan (the lock graph is tiny, but recursion
+        # limits are not worth risking in a linter)
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                out.append(scc)
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    return out

@@ -5,3 +5,5 @@ from . import retrace      # TPL002           # noqa: F401
 from . import rng          # TPL003           # noqa: F401
 from . import locks        # TPL004           # noqa: F401
 from . import imports      # TPL006           # noqa: F401
+from . import concurrency  # TPL007-TPL009    # noqa: F401
+from . import contracts    # TPL010, TPL011   # noqa: F401

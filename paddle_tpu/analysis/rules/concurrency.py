@@ -1,0 +1,114 @@
+"""TPL007/TPL008/TPL009 — cross-file concurrency rules.
+
+These consume the whole-program `ProjectIndex` (thread entries, lock
+inventory, acquisition-order graph, attribute ownership) rather than
+the single file's AST; each finding is emitted only by the file that
+holds its witness line, so a project-wide hazard is reported exactly
+once and an inline suppression at the witness keeps working.
+
+  TPL007  lock-order inversion: the acquisition graph (built from
+          lexically nested `with self.lock:` blocks and calls made
+          while holding a lock) has a cycle — two threads taking the
+          same pair of locks in opposite orders deadlock under load.
+  TPL008  shared attribute with multiple writing threads and no
+          common lock. Thread entries are `threading.Thread(target=…)`
+          registrations plus a `<caller>` pseudo-entry for public API
+          methods. Single-writer attrs (the delta-mirror pattern) and
+          `__init__` writes are exempt; `*_locked` methods count as
+          holding every class lock.
+  TPL009  blocking call (socket send/recv/accept, rpc_sync, store
+          round-trips, queue.get with no timeout — the config
+          `blocking_calls` patterns) while holding a lock: every other
+          thread needing that lock stalls for a network round trip.
+          Locks named like IO mutexes (config `io_locks`, e.g.
+          `*_wlock`) are exempt — serializing one socket is what they
+          are *for*.
+"""
+from __future__ import annotations
+
+from ..engine import Rule, Severity, register
+from ..project import pretty_key
+
+
+def _project(ctx):
+    proj = getattr(ctx, "project", None)
+    if proj is None or not ctx.config.in_concurrency_scope(ctx.path):
+        return None
+    return proj
+
+
+@register
+class LockOrderRule(Rule):
+    id = "TPL007"
+    name = "lock-order-inversion"
+    severity = Severity.ERROR
+    rationale = ("a cycle in the cross-file lock acquisition graph "
+                 "means two threads can take the same locks in "
+                 "opposite orders and deadlock under load")
+
+    def check(self, ctx):
+        proj = _project(ctx)
+        if proj is None:
+            return
+        for cycle, witness in proj.lock_cycles():
+            if witness.path != ctx.path:
+                continue
+            order = " -> ".join(cycle + [cycle[0]])
+            yield self.finding(
+                ctx, witness.node,
+                f"lock-order inversion: {order} — acquired here via "
+                f"{witness.detail}; another path takes them in the "
+                "opposite order, so two threads can deadlock. Pick one "
+                "global order (or drop to a single lock)")
+
+
+@register
+class SharedWriteRule(Rule):
+    id = "TPL008"
+    name = "unlocked-shared-write"
+    severity = Severity.ERROR
+    rationale = ("an attribute written by two or more thread entry "
+                 "points with no common lock is a data race the "
+                 "moment scheduling changes")
+
+    def check(self, ctx):
+        proj = _project(ctx)
+        if proj is None:
+            return
+        for cls_name, attr, entries, witness in \
+                proj.shared_attr_races():
+            if witness.path != ctx.path:
+                continue
+            yield self.finding(
+                ctx, witness.node,
+                f"`self.{attr}` ({cls_name}) is written from "
+                f"{len(entries)} thread entries "
+                f"({', '.join(entries)}) with no common lock — "
+                "guard every write with one lock, or make a single "
+                "thread the owner and mirror deltas to it")
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "TPL009"
+    name = "blocking-call-under-lock"
+    severity = Severity.ERROR
+    rationale = ("a socket/rpc/queue wait while holding a lock turns "
+                 "one slow peer into a stall of every thread that "
+                 "needs the lock")
+
+    def check(self, ctx):
+        proj = _project(ctx)
+        if proj is None:
+            return
+        for desc, locks, call, via in proj.blocking_under_lock():
+            if call.path != ctx.path:
+                continue
+            how = (f"calls `{pretty_key(via)}` which blocks on "
+                   f"`{desc}`") if via else f"blocks on `{desc}`"
+            yield self.finding(
+                ctx, call.node,
+                f"{how} while holding {', '.join(locks)} — do the "
+                "I/O outside the lock and publish the result under "
+                "it (or rename the lock `*_wlock` if it exists only "
+                "to serialize this channel)")

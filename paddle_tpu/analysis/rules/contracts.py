@@ -1,0 +1,126 @@
+"""TPL010/TPL011 — registry-drift contract rules.
+
+The tree's two by-convention contracts, made checkable:
+
+  TPL010  every `PT_*` / `PADDLE_TPU_*` env knob the code reads must
+          be declared in `paddle_tpu/_env.py` (name, default, doc) —
+          and inside the migrated packages (config `env_migrated`)
+          reads must go through the `_env` accessors, not raw
+          `os.environ`, so defaults and parsing live in exactly one
+          place.
+  TPL011  every `pt_*` metric booked on the MetricsRegistry must
+          appear in the docs tables (config `metrics_docs`), and every
+          documented name must still exist in code — dashboards keep
+          working, docs never advertise ghosts. Counter exposition
+          appends `_total`, so names match with `_total` tolerance.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+from ..engine import Rule, Severity, register
+from ..project import env_knob_name, _ENV_ACCESSORS
+
+
+def _read_env_name(ctx, node):
+    """(knob name, direct) when `node` reads an env var by literal
+    name: os.environ.get/[]/in, os.getenv, or an _env accessor."""
+    if isinstance(node, ast.Call):
+        target = ctx.resolve(node.func)
+        leaf = target.rsplit(".", 1)[-1]
+        if target in ("os.environ.get", "os.getenv") or \
+                target.endswith(".os.environ.get"):
+            direct = True
+        elif leaf in _ENV_ACCESSORS:
+            direct = False
+        else:
+            return None
+        if node.args and isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            return node.args[0].value, direct
+        return None
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.ctx, ast.Load) and \
+            ctx.resolve(node.value) == "os.environ" and \
+            isinstance(node.slice, ast.Constant) and \
+            isinstance(node.slice.value, str):
+        return node.slice.value, True
+    if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+            isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+            ctx.resolve(node.comparators[0]) == "os.environ" and \
+            isinstance(node.left, ast.Constant) and \
+            isinstance(node.left.value, str):
+        return node.left.value, True
+    return None
+
+
+@register
+class EnvRegistryRule(Rule):
+    id = "TPL010"
+    name = "env-registry-drift"
+    severity = Severity.ERROR
+    rationale = ("an env knob read outside the central _env registry "
+                 "has no declared default or doc — ops can't discover "
+                 "it and two readers drift on parsing")
+
+    def check(self, ctx):
+        proj = getattr(ctx, "project", None)
+        if proj is None or os.path.basename(ctx.path) == "_env.py":
+            return
+        migrated = ctx.config.in_env_migrated(ctx.path)
+        for node in ast.walk(ctx.tree):
+            hit = _read_env_name(ctx, node)
+            if hit is None:
+                continue
+            name, direct = hit
+            if not env_knob_name(name):
+                continue
+            if not proj.env_is_declared(name):
+                yield self.finding(
+                    ctx, node,
+                    f"env knob `{name}` is read here but not declared "
+                    "in paddle_tpu/_env.py — add a declare(...) entry "
+                    "(default + one-line doc) so docs/env.md stays "
+                    "complete")
+            elif direct and migrated:
+                yield self.finding(
+                    ctx, node,
+                    f"raw os.environ read of declared knob `{name}` — "
+                    "this package is migrated to the registry; use "
+                    "paddle_tpu._env.env_str/env_int/env_float/"
+                    "env_bool so parsing and defaults stay in one "
+                    "place")
+
+
+@register
+class MetricsContractRule(Rule):
+    id = "TPL011"
+    name = "metrics-contract-drift"
+    severity = Severity.WARNING
+    rationale = ("a metric booked but not documented is invisible to "
+                 "dashboards; one documented but gone breaks them "
+                 "silently")
+
+    def check(self, ctx):
+        proj = getattr(ctx, "project", None)
+        if proj is None or proj.docs_names is None:
+            return
+        for name, node, path in proj.undocumented_bookings():
+            if path != ctx.path:
+                continue
+            yield self.finding(
+                ctx, node,
+                f"metric `{name}` is booked here but absent from the "
+                "docs tables "
+                f"({', '.join(sorted(ctx.config.metrics_docs))}) — "
+                "add a row (counters render with a `_total` suffix)")
+        # the ghost direction anchors at the registry definition so it
+        # is reported exactly once per scan
+        if ctx.path == proj.metrics_registry_path:
+            for doc, docfile in proj.unbooked_documented():
+                yield self.finding(
+                    ctx, ctx.tree,
+                    f"metric `{doc}` is documented in {docfile} but "
+                    "never booked or rendered anywhere in the scanned "
+                    "tree — delete the row or restore the metric")

@@ -1,4 +1,16 @@
-"""Drive the rules over files/trees and fold in suppressions."""
+"""Drive the rules over files/trees and fold in suppressions.
+
+Two phases since the tpuracer pass: every file is parsed first and the
+whole-program `ProjectIndex` (thread entries, lock graph, attribute
+ownership, env/metric contracts) is built over all of them; only then
+do the rules run per file, with `ctx.project` carrying the index so
+the cross-file rules (TPL007–TPL011) can judge the full picture while
+emitting each finding at its single witness line.
+
+A path that does not exist, cannot be read, or fails to parse is a
+hard TPL000 finding — never a silent skip — so the CI gate exits 1 the
+moment its input list rots.
+"""
 from __future__ import annotations
 
 import os
@@ -7,25 +19,37 @@ from .config import DEFAULT_CONFIG
 from .context import FileContext
 from .engine import (Finding, Severity, all_rules, apply_suppressions,
                      Suppressions)
+from .project import ProjectIndex
 
 
-def lint_source(source, path="<string>", config=None, rules=None):
+def _hard_finding(path, message, line=1, col=0):
+    return Finding(rule="TPL000", severity=Severity.ERROR, path=path,
+                   line=line, col=col, message=message)
+
+
+def _check_file(ctx, config, rules):
+    findings = []
+    for rule in rules:
+        findings.extend(rule.check(ctx))
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return apply_suppressions(findings, Suppressions.scan(ctx.lines))
+
+
+def lint_source(source, path="<string>", config=None, rules=None,
+                project=None):
     """Lint one source string. Returns all findings, with suppressed
-    ones marked (filter on `f.suppressed` for the gate)."""
+    ones marked (filter on `f.suppressed` for the gate). Cross-file
+    rules see a single-file project index unless one is passed in."""
     config = config or DEFAULT_CONFIG
     try:
         ctx = FileContext(path, source, config)
     except SyntaxError as e:
-        return [Finding(rule="TPL000", severity=Severity.ERROR, path=path,
-                        line=e.lineno or 1, col=(e.offset or 1) - 1,
-                        message=f"syntax error: {e.msg}")]
-    selected = rules if rules is not None else all_rules()
-    findings = []
-    for rule in selected:
-        findings.extend(rule.check(ctx))
-    findings.sort(key=lambda f: (f.line, f.col, f.rule))
-    return apply_suppressions(findings,
-                              Suppressions.scan(ctx.lines))
+        return [_hard_finding(path, f"syntax error: {e.msg}",
+                              line=e.lineno or 1, col=(e.offset or 1) - 1)]
+    ctx.project = project if project is not None \
+        else ProjectIndex.build([ctx], config)
+    return _check_file(ctx, config,
+                       rules if rules is not None else all_rules())
 
 
 def lint_file(path, config=None, rules=None):
@@ -52,11 +76,46 @@ def iter_python_files(paths, config=None):
                     yield full
 
 
+def analyze_paths(paths, config=None, rules=None):
+    """Full two-phase run. Returns (findings, files_scanned, project);
+    the project index covers every parseable file, even when a rule
+    subset was selected."""
+    config = config or DEFAULT_CONFIG
+    findings = []
+    files = []
+    for p in paths:
+        if not os.path.exists(p):
+            findings.append(_hard_finding(
+                p, "path does not exist — fix the lint invocation "
+                   "(a gate that silently skips inputs is no gate)"))
+            continue
+        files.extend(iter_python_files([p], config))
+    contexts = {}
+    for path in files:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(_hard_finding(
+                path, f"cannot read file: {e}"))
+            continue
+        try:
+            contexts[path] = FileContext(path, source, config)
+        except SyntaxError as e:
+            findings.append(_hard_finding(
+                path, f"syntax error: {e.msg}",
+                line=e.lineno or 1, col=(e.offset or 1) - 1))
+    project = ProjectIndex.build(list(contexts.values()), config)
+    selected = rules if rules is not None else all_rules()
+    for path in sorted(contexts):
+        ctx = contexts[path]
+        ctx.project = project
+        findings.extend(_check_file(ctx, config, selected))
+    return findings, len(files), project
+
+
 def lint_paths(paths, config=None, rules=None):
     """Lint files/directories. Returns (findings, files_scanned)."""
-    config = config or DEFAULT_CONFIG
-    findings, nfiles = [], 0
-    for path in iter_python_files(paths, config):
-        nfiles += 1
-        findings.extend(lint_file(path, config=config, rules=rules))
+    findings, nfiles, _ = analyze_paths(paths, config=config,
+                                        rules=rules)
     return findings, nfiles

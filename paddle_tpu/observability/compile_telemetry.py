@@ -31,17 +31,19 @@ import os
 import threading
 import time
 
+from .._env import env_float, env_int
+
 __all__ = ["CompileRegistry", "REGISTRY", "tracked", "track_jit",
            "signature_of", "set_context", "snapshot",
            "render_prometheus", "reset"]
 
-DEFAULT_WARN_AFTER = int(os.environ.get("PADDLE_TPU_RETRACE_WARN", "8"))
+DEFAULT_WARN_AFTER = env_int("PADDLE_TPU_RETRACE_WARN")
 
 # first-call wall time below which a compile is attributed to the
 # persistent XLA compilation cache (PT_COMPILE_CACHE): a real
 # trace+lower+compile of a serving program takes 100s of ms even for
 # toy models, a disk cache hit is a deserialize
-CACHE_HIT_S = float(os.environ.get("PT_COMPILE_CACHE_HIT_S", "0.05"))
+CACHE_HIT_S = env_float("PT_COMPILE_CACHE_HIT_S")
 
 
 def signature_of(args, kwargs=None):

@@ -38,6 +38,8 @@ the rest of `paddle_tpu.observability`.
 from __future__ import annotations
 
 import os
+
+from .._env import env_float, env_str
 import threading
 import time
 
@@ -59,7 +61,7 @@ PEAK_SPECS = {
     "cpu": (1e12, 1e11),
 }
 
-_COST_ENABLED = os.environ.get("PADDLE_TPU_DEVICE_COST", "1") != "0"
+_COST_ENABLED = env_str("PADDLE_TPU_DEVICE_COST") != "0"
 
 
 def device_generation():
@@ -75,8 +77,8 @@ def device_generation():
         return "cpu"
     if dev.platform != "tpu":
         return "cpu"
-    gen = (os.environ.get("PADDLE_TPU_GEN")
-           or os.environ.get("PALLAS_AXON_TPU_GEN"))
+    gen = (env_str("PADDLE_TPU_GEN") or
+           os.environ.get("PALLAS_AXON_TPU_GEN"))
     if gen in PEAK_SPECS:
         return gen
     kind = getattr(dev, "device_kind", "").lower()
@@ -95,8 +97,8 @@ def device_peaks():
     PADDLE_TPU_PEAK_FLOPS / PADDLE_TPU_PEAK_BW override numerically
     (e.g. a future generation missing from the table)."""
     flops, bw = PEAK_SPECS[device_generation()]
-    flops = float(os.environ.get("PADDLE_TPU_PEAK_FLOPS", flops))
-    bw = float(os.environ.get("PADDLE_TPU_PEAK_BW", bw))
+    flops = env_float("PADDLE_TPU_PEAK_FLOPS", flops)
+    bw = env_float("PADDLE_TPU_PEAK_BW", bw)
     return flops, bw
 
 
@@ -145,7 +147,7 @@ def _analysis_of(fn, args, kwargs):
     lowered = fn.lower(*sargs, **skwargs)
     mem = {"argument_bytes": _aval_bytes((sargs, skwargs)),
            "output_bytes": 0, "temp_bytes": 0, "generated_code_bytes": 0}
-    if os.environ.get("PADDLE_TPU_DEVICE_COST") == "full":
+    if env_str("PADDLE_TPU_DEVICE_COST") == "full":
         compiled = lowered.compile()
         cost = compiled.cost_analysis()
         m = compiled.memory_analysis()

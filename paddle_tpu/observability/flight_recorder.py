@@ -23,10 +23,12 @@ import signal
 import threading
 import time
 
+from .._env import env_int, env_str
+
 __all__ = ["FlightRecorder", "RECORDER", "record", "snapshot", "dump",
            "install", "thread_stacks"]
 
-DEFAULT_CAPACITY = int(os.environ.get("PADDLE_TPU_FLIGHT_EVENTS", "4096"))
+DEFAULT_CAPACITY = env_int("PADDLE_TPU_FLIGHT_EVENTS")
 
 
 class FlightRecorder:
@@ -37,7 +39,7 @@ class FlightRecorder:
         self._seq = 0
         self._dropped = 0
         if enabled is None:
-            enabled = os.environ.get("PADDLE_TPU_FLIGHT", "1") != "0"
+            enabled = env_str("PADDLE_TPU_FLIGHT", "1") != "0"
         self.enabled = enabled
         self._installed = False
         self._prev_sigterm = None
@@ -87,7 +89,7 @@ class FlightRecorder:
     def dump(self, path=None, reason="on_demand"):
         """Write the snapshot as JSON; returns the path written."""
         if path is None:
-            d = os.environ.get("PADDLE_TPU_FLIGHT_DIR", "/tmp")
+            d = env_str("PADDLE_TPU_FLIGHT_DIR")
             path = os.path.join(
                 d, f"pt_flightrecorder-{os.getpid()}.json")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

@@ -19,6 +19,8 @@ import sys
 import threading
 import time
 
+from .._env import env_str
+
 __all__ = ["StructuredLogger", "RateLimiter", "get_logger"]
 
 
@@ -47,10 +49,10 @@ class RateLimiter:
 
 
 def _default_stream():
-    path = os.environ.get("PADDLE_TPU_LOG_FILE")
+    path = env_str("PADDLE_TPU_LOG_FILE")
     if path:
         return open(path, "a", buffering=1)
-    if os.environ.get("PADDLE_TPU_LOG", "0") == "1":
+    if env_str("PADDLE_TPU_LOG", "0") == "1":
         return sys.stderr
     return None
 

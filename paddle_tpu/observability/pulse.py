@@ -50,6 +50,7 @@ import time
 from collections import deque
 
 from . import flight_recorder as _flight
+from .._env import env_float, env_int, env_str
 from .logging import get_logger
 
 __all__ = ["PulseRing", "PulseSampler", "PulsePlane", "TRIGGERS"]
@@ -127,7 +128,7 @@ class PulseSampler:
 
     def __init__(self, depth=None):
         if depth is None:
-            depth = int(os.environ.get("PT_PULSE_DEPTH", "240") or 240)
+            depth = env_int("PT_PULSE_DEPTH")
         self.depth = max(int(depth), 2)
         self._lock = threading.Lock()
         self._rings = {}                # signal name -> PulseRing
@@ -251,8 +252,7 @@ class PulsePlane:
                  capture_min_s=None, slo_burst=None, start_thread=True,
                  name="pt-pulse"):
         if interval_s is None:
-            interval_s = float(
-                os.environ.get("PT_PULSE_INTERVAL_S", "1.0") or 1.0)
+            interval_s = env_float("PT_PULSE_INTERVAL_S")
         self.interval_s = max(float(interval_s), 0.01)
         self._snapshot_fn = snapshot_fn
         self._scan_fn = scan_fn
@@ -261,17 +261,15 @@ class PulsePlane:
         self._self_cost_fn = self_cost_fn
         self.sampler = PulseSampler(depth=depth)
         if capture_dir is None:
-            capture_dir = os.environ.get("PT_CAPTURE_DIR") or None
+            capture_dir = env_str("PT_CAPTURE_DIR") or None
         self.capture_dir = capture_dir
         self.capture_max = int(capture_max if capture_max is not None
-                               else os.environ.get("PT_CAPTURE_MAX", "8")
-                               or 8)
+                               else env_int("PT_CAPTURE_MAX"))
         self.capture_min_s = float(
             capture_min_s if capture_min_s is not None
-            else os.environ.get("PT_CAPTURE_MIN_S", "30") or 30)
+            else env_float("PT_CAPTURE_MIN_S"))
         self.slo_burst = int(slo_burst if slo_burst is not None
-                             else os.environ.get("PT_PULSE_SLO_BURST",
-                                                 "3") or 3)
+                             else env_int("PT_PULSE_SLO_BURST"))
         self._log = get_logger("pulse")
         self._lock = threading.Lock()   # sample dedup + trigger state
         self._last_sample_t = 0.0
@@ -336,6 +334,9 @@ class PulsePlane:
     def payload(self, window=None, signals=None):
         """The /debug/pulse JSON body."""
         now = time.time()
+        with self._lock:
+            triggers = dict(self.triggers)
+            bundles = list(self.bundles)
         return {
             "enabled": True,
             "now": now,
@@ -343,8 +344,8 @@ class PulsePlane:
             "depth": self.sampler.depth,
             "signals": self.sampler.series(window=window,
                                            signals=signals, now=now),
-            "triggers": dict(self.triggers),
-            "bundles": list(self.bundles),
+            "triggers": triggers,
+            "bundles": bundles,
         }
 
     # -- triggers + capture bundles -----------------------------------
@@ -364,29 +365,34 @@ class PulsePlane:
         info = self._info_fn() if self._info_fn is not None else {}
         breaker = bool(info.get("breaker_open"))
         counts = self._trigger_counts(snap)
+        fired = []
+        # the whole delta pass runs under the lock: tick() races itself
+        # (pulse daemon vs. opportunistic scrape threads), and an
+        # unlocked `triggers[trig] += 1` read-modify-write loses fires
+        # exactly when two triggers coincide — the moment they matter.
+        # Only the capture (file I/O) runs outside.
         with self._lock:
             prev = self._trig_prev
             self._trig_prev = counts
             breaker_prev, self._breaker_prev = self._breaker_prev, breaker
-        if prev is None:
-            return                      # first pass: baseline only
-        fired = []
-        slo_delta = 0.0
-        for key, cur in counts.items():
-            delta = cur - prev.get(key, 0.0)
-            if delta <= 0:
-                continue
-            base = key.partition("{")[0]
-            if base == "pt_slo_violated":
-                slo_delta += delta
-            else:
-                fired.append(_TRIGGER_COUNTERS[base])
-        if slo_delta >= self.slo_burst:
-            fired.append("slo_burst")
-        if breaker and not breaker_prev:
-            fired.append("breaker_open")
-        for trig in fired:
-            self.triggers[trig] += 1
+            if prev is None:
+                return                  # first pass: baseline only
+            slo_delta = 0.0
+            for key, cur in counts.items():
+                delta = cur - prev.get(key, 0.0)
+                if delta <= 0:
+                    continue
+                base = key.partition("{")[0]
+                if base == "pt_slo_violated":
+                    slo_delta += delta
+                else:
+                    fired.append(_TRIGGER_COUNTERS[base])
+            if slo_delta >= self.slo_burst:
+                fired.append("slo_burst")
+            if breaker and not breaker_prev:
+                fired.append("breaker_open")
+            for trig in fired:
+                self.triggers[trig] += 1
         if fired:
             self._capture(fired[0], info, snap)
 
@@ -414,7 +420,10 @@ class PulsePlane:
         scrape) thread — never the pump; the only cost to the serving
         path is the registry locks the snapshot already took."""
         stamp = time.strftime("%Y%m%d-%H%M%S")
-        name = f"bundle-{stamp}-{self._bundle_seq:03d}-{trigger}" \
+        with self._lock:
+            seq = self._bundle_seq
+            triggers_total = dict(self.triggers)
+        name = f"bundle-{stamp}-{seq:03d}-{trigger}" \
                f"-{os.getpid()}"
         path = os.path.join(self.capture_dir, name)
         os.makedirs(path, exist_ok=True)
@@ -422,7 +431,7 @@ class PulsePlane:
         meta = {
             "trigger": trigger, "at": time.time(), "pid": os.getpid(),
             "trace_ids": trace_ids,
-            "triggers_total": dict(self.triggers),
+            "triggers_total": triggers_total,
             "info": {k: v for k, v in info.items() if k != "trace_ids"},
         }
         pulse_doc = self.payload()

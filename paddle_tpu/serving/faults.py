@@ -52,10 +52,10 @@ or router.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 
+from .._env import env_str
 from ..observability import flight_recorder as _flight
 
 __all__ = ["FaultPlan", "InjectedFault", "POINTS", "ACTIONS"]
@@ -132,7 +132,7 @@ class FaultPlan:
         """Plan from ``PT_FAULTS`` (None when unset/empty — the
         disabled default costs nothing and preserves seed behavior
         exactly)."""
-        spec = (env if env is not None else os.environ).get("PT_FAULTS")
+        spec = env_str("PT_FAULTS", env=env)
         return cls(spec) if spec else None
 
     # -- construction --------------------------------------------------

@@ -70,6 +70,7 @@ import threading
 import time
 from collections import OrderedDict
 
+from .._env import env_float, env_int, env_str
 from ..distributed import rpc as _rpc
 from ..observability import flight_recorder as _flight
 from . import wire as _wire
@@ -87,16 +88,6 @@ __all__ = ["FleetWorker", "FleetPages", "FleetPlane", "RemoteReplica",
 
 # rank 0 of the fleet's rpc world is always the router process
 ROUTER_NAME = "router"
-
-
-def _env_f(name, default):
-    v = os.environ.get(name, "").strip()
-    return float(v) if v else float(default)
-
-
-def _env_i(name, default):
-    v = os.environ.get(name, "").strip()
-    return int(v) if v else int(default)
 
 
 # ---------------------------------------------------------------------------
@@ -197,7 +188,7 @@ def _fetch_handoff(addr, rid, timeout=None):
     """Pull one exported KVHandoff from a worker's bulk endpoint —
     the host-to-host half of a decode migration."""
     timeout = timeout if timeout is not None \
-        else _env_f("PT_FLEET_CALL_TIMEOUT_S", 30.0)
+        else env_float("PT_FLEET_CALL_TIMEOUT_S")
     with _bulk_connect(addr, timeout) as s:
         _wire.send_json(s, {"op": "handoff", "rid": str(rid)})
         head = _wire.recv_json(s)
@@ -212,7 +203,7 @@ def _push_handoff(addr, h, timeout=None):
     local-replica -> remote-replica migration direction). Returns the
     payload bytes framed."""
     timeout = timeout if timeout is not None \
-        else _env_f("PT_FLEET_CALL_TIMEOUT_S", 30.0)
+        else env_float("PT_FLEET_CALL_TIMEOUT_S")
     with _bulk_connect(addr, timeout) as s:
         _wire.send_json(s, {"op": "handoff_put"})
         n = _wire.send_handoff(s, h)
@@ -271,6 +262,10 @@ class RemoteHandoffRef:
     def resolve(self):
         with self._rlock:
             if self._payload is None:
+                # _rlock's entire job is making concurrent resolvers
+                # wait for the ONE bulk fetch instead of issuing N;
+                # nothing else is ever guarded by it
+                # tpulint: disable-next-line=TPL009 -- fetch-once dedupe: waiting on the in-flight fetch IS the lock's purpose
                 self._payload = _fetch_handoff(self.addr, self.rid)
             return self._payload
 
@@ -323,7 +318,7 @@ class FleetPages:
         self._points = None          # built lazily: sorted [(pt, rid)]
         self._peers = {}             # replica_id -> meta dict
         self._ring_lock = threading.Lock()
-        self._q = queue.Queue(maxsize=_env_i("PT_FLEET_SPILL_QUEUE", 128))
+        self._q = queue.Queue(maxsize=env_int("PT_FLEET_SPILL_QUEUE"))
         self._stop = threading.Event()
         self._thread = None
         r = worker.replica.registry
@@ -357,25 +352,32 @@ class FleetPages:
         with self._ring_lock:
             if self._points is not None:
                 return self._points, dict(self._peers)
-            agent = self.worker.agent
-            peers = {}
-            for info in agent.all_worker_infos():
-                if info.rank == 0:
-                    continue         # the router owns no pages
-                meta = self.worker.store.get(f"fleet/meta/{info.name}")
-                peers[meta["replica_id"]] = meta
-            pts = []
-            for rid, meta in peers.items():
-                # ring membership mirrors the router's: only replicas
-                # that take NEW prompts own prefix keys
-                if meta["role"] not in ("prefill", "both"):
-                    continue
-                for i in range(64):
-                    pts.append((_ring_point(f"{rid}|{i}"), rid))
-            pts.sort()
-            self._points = pts
-            self._peers = peers
-            return pts, dict(peers)
+        # Build OUTSIDE the lock: membership is a store/rpc round trip
+        # per peer, and holding _ring_lock across the network would
+        # stall the spill loop and every owner_of() caller on one slow
+        # peer. Racing builders each fetch an equivalent snapshot; the
+        # first to publish wins and the rest discard theirs.
+        agent = self.worker.agent
+        peers = {}
+        for info in agent.all_worker_infos():
+            if info.rank == 0:
+                continue             # the router owns no pages
+            meta = self.worker.store.get(f"fleet/meta/{info.name}")
+            peers[meta["replica_id"]] = meta
+        pts = []
+        for rid, meta in peers.items():
+            # ring membership mirrors the router's: only replicas
+            # that take NEW prompts own prefix keys
+            if meta["role"] not in ("prefill", "both"):
+                continue
+            for i in range(64):
+                pts.append((_ring_point(f"{rid}|{i}"), rid))
+        pts.sort()
+        with self._ring_lock:
+            if self._points is None:
+                self._points = pts
+                self._peers = peers
+            return self._points, dict(self._peers)
 
     def owner_of(self, key):
         pts, _ = self._ensure_ring()
@@ -401,7 +403,7 @@ class FleetPages:
                 self.spill_drops.inc()
 
     def _spill_loop(self):
-        timeout = _env_f("PT_FLEET_FETCH_TIMEOUT_S", 1.0) * 5
+        timeout = env_float("PT_FLEET_FETCH_TIMEOUT_S") * 5
         while not self._stop.is_set():
             try:
                 key, e = self._q.get(timeout=0.2)
@@ -437,8 +439,8 @@ class FleetPages:
         Returns chain-order payloads (possibly empty)."""
         ps = self.tier.page_size
         limit = (len(tokens) - 1) // ps
-        budget = _env_i("PT_FLEET_FETCH_MAX", 8)
-        timeout = _env_f("PT_FLEET_FETCH_TIMEOUT_S", 1.0)
+        budget = env_int("PT_FLEET_FETCH_MAX")
+        timeout = env_float("PT_FLEET_FETCH_TIMEOUT_S")
         out = []
         b = int(block_idx)
         while b < limit and len(out) < budget:
@@ -547,7 +549,7 @@ class FleetWorker:
         _WORKERS[self.name] = self
 
         # bulk channel first: its advertised endpoint rides the meta
-        bind = bulk_bind or os.environ.get("PT_RPC_BIND", "127.0.0.1")
+        bind = bulk_bind or env_str("PT_RPC_BIND")
         self._bulk_srv = socket.create_server((bind, 0))
         self._bulk_srv.settimeout(0.2)
         ip, port = self._bulk_srv.getsockname()[:2]
@@ -593,7 +595,7 @@ class FleetWorker:
 
     # -- heartbeat -----------------------------------------------------
     def _heartbeat(self):
-        interval = _env_f("PT_FLEET_HB_S", 0.5)
+        interval = env_float("PT_FLEET_HB_S")
         seq = 0
         while not self._hb_stop.wait(0 if seq == 0 else interval):
             try:
@@ -869,7 +871,7 @@ class RemoteRequest:
         try:
             s = socket.create_connection(
                 self._replica.bulk_addr,
-                timeout=_env_f("PT_FLEET_CALL_TIMEOUT_S", 30.0))
+                timeout=env_float("PT_FLEET_CALL_TIMEOUT_S"))
             # streaming can idle arbitrarily long behind a deep queue;
             # liveness belongs to the heartbeat monitor, which closes
             # this socket when the worker is declared dead
@@ -1053,8 +1055,8 @@ class RemoteReplica:
         self._dead_reason = None
         self._live = {}
         self._live_lock = threading.Lock()
-        self._retries = _env_i("PT_FLEET_RETRIES", 2)
-        self._timeout = _env_f("PT_FLEET_CALL_TIMEOUT_S", 30.0)
+        self._retries = env_int("PT_FLEET_RETRIES")
+        self._timeout = env_float("PT_FLEET_CALL_TIMEOUT_S")
         self._last_stats = {
             "replica_id": self.replica_id, "role": self.role,
             "ready": False, "closed": False, "paused": False,
@@ -1268,7 +1270,7 @@ class FleetPlane:
         self.workers_alive.set(len(self.replicas))
         self._hb_timeout = float(
             hb_timeout_s if hb_timeout_s is not None
-            else _env_f("PT_FLEET_HB_MISS_S", 3.0))
+            else env_float("PT_FLEET_HB_MISS_S"))
         self._stop = threading.Event()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True,
@@ -1290,7 +1292,7 @@ class FleetPlane:
             return st._data.get(f"fleet/hb/{name}")
 
     def _monitor_loop(self):
-        interval = _env_f("PT_FLEET_HB_S", 0.5)
+        interval = env_float("PT_FLEET_HB_S")
         seen = {}                    # worker -> (seq, t_last_change)
         while not self._stop.wait(interval):
             now = time.monotonic()

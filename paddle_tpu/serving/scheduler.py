@@ -36,12 +36,12 @@ plus per-request chunk queues, all under one condition variable.
 from __future__ import annotations
 
 import itertools
-import os
 import queue
 import threading
 import time
 from collections import deque
 
+from .._env import env_bool
 from ..observability import device_telemetry as _devtel
 from ..observability import flight_recorder as _flight
 from ..observability import trace_context as _tc
@@ -198,8 +198,7 @@ class RequestScheduler:
         # the one-step-deep pipeline before acting, so every mode is
         # token-identical to the synchronous pump.
         if pipeline is None:
-            pipeline = os.environ.get("PT_SERVE_PIPELINE", "0") \
-                not in ("", "0")
+            pipeline = env_bool("PT_SERVE_PIPELINE")
         self._pipeline = bool(pipeline) and \
             getattr(engine, "spec_decode", 0) <= 1
         # the launched-but-unconsumed StepTicket; pump-thread only
@@ -260,8 +259,7 @@ class RequestScheduler:
         # disables it entirely — every request's `timeline` stays None,
         # every mark site is a no-op, and token outputs are untouched
         # either way (the plane is host-clock bookkeeping only).
-        self._timeline_on = os.environ.get(
-            "PT_SERVE_TIMELINE", "1") not in ("", "0")
+        self._timeline_on = env_bool("PT_SERVE_TIMELINE")
         # step-time anomaly sentinel: the pump appends samples, ALL
         # analysis runs in _scan_anomalies on the scrape thread
         self._sentinel = StepAnomalySentinel()
@@ -274,7 +272,7 @@ class RequestScheduler:
         # no thread, token-identical serving either way (the plane only
         # ever reads host-side snapshots).
         self._pulse = None
-        if os.environ.get("PT_SERVE_PULSE", "1") not in ("", "0"):
+        if env_bool("PT_SERVE_PULSE"):
             from ..observability.pulse import PulsePlane
             self._pulse = PulsePlane(
                 self._pulse_snapshot,

@@ -40,11 +40,11 @@ can drive a real server end-to-end without a chip.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .._env import env_bool, env_float
 from ..observability import chrome_trace as _chrome
 from ..observability import compile_telemetry as _compile
 from ..observability import device_telemetry as _devtel
@@ -202,8 +202,8 @@ class CompletionHandler(BaseHTTPRequestHandler):
         one-shot captures bound the stream."""
         sched = self.sched
         plane = getattr(sched, "_pulse", None)
-        interval = plane.interval_s if plane is not None else float(
-            os.environ.get("PT_PULSE_INTERVAL_S", "1.0") or 1.0)
+        interval = plane.interval_s if plane is not None \
+            else env_float("PT_PULSE_INTERVAL_S")
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -292,7 +292,7 @@ class CompletionHandler(BaseHTTPRequestHandler):
                        int(getattr(sr.req, "cached_tokens", 0) or 0)}}
         if sr.req.logprobs is not None:
             out["logprobs"] = sr.req.logprobs
-        if os.environ.get("PT_SERVE_TIMING", "") not in ("", "0"):
+        if env_bool("PT_SERVE_TIMING"):
             tl = getattr(sr, "timeline", None)
             if tl is not None and tl.marks:
                 out["timing"] = {

@@ -36,9 +36,10 @@ with a lock-free deque append, drained ONLY on the scrape thread.
 """
 from __future__ import annotations
 
-import os
 from collections import deque
 from time import monotonic as _mono
+
+from .._env import env_float
 
 __all__ = ["Timeline", "StepAnomalySentinel", "SLO_CLASSES",
            "resolve_slo", "slo_targets", "judge_slo", "PHASES"]
@@ -72,8 +73,8 @@ def slo_targets(slo):
     """(ttft_s, tpot_s) targets for a class, env-overridable."""
     d_ttft, d_tpot = _SLO_DEFAULTS[slo]
     up = slo.upper()
-    return (float(os.environ.get(f"PT_SLO_{up}_TTFT_S", d_ttft)),
-            float(os.environ.get(f"PT_SLO_{up}_TPOT_S", d_tpot)))
+    return (env_float(f"PT_SLO_{up}_TTFT_S", d_ttft),
+            env_float(f"PT_SLO_{up}_TPOT_S", d_tpot))
 
 
 def judge_slo(slo, ttft_s, tpot_s, phases):
@@ -234,8 +235,7 @@ class StepAnomalySentinel:
                  maxlen=512):
         self.warmup = int(warmup)
         self.k = float(k)
-        self.floor_s = float(os.environ.get("PT_ANOMALY_FLOOR_S",
-                                            floor_s))
+        self.floor_s = env_float("PT_ANOMALY_FLOOR_S", floor_s)
         self.alpha = float(alpha)
         self._buf = deque(maxlen=int(maxlen))
         self._mean = None

@@ -540,6 +540,96 @@ class TestFleetPages:
         assert tier.peek(key) is None
         assert wa.pages.spill_pages.value == 0
 
+    def _bare_pages(self):
+        """A FleetPages shell with only the ring machinery: enough to
+        drive _ensure_ring without sockets or engines."""
+        pages = fleet.FleetPages.__new__(fleet.FleetPages)
+        pages._ring_lock = threading.Lock()
+        pages._points = None
+        pages._peers = {}
+        return pages
+
+    class _Info:
+        def __init__(self, rank, name):
+            self.rank, self.name = rank, name
+
+    def test_ring_membership_fetch_runs_outside_ring_lock(self):
+        """Regression (found by tpuracer's TPL009 pass): _ensure_ring
+        used to hold _ring_lock across the per-peer store/rpc round
+        trips, so one slow peer stalled the spill loop and every
+        owner_of() caller. Pin the fix: the agent/store I/O must see
+        the lock released; only the publish happens under it."""
+        pages = self._bare_pages()
+        io_lock_states = []
+
+        class Agent:
+            def all_worker_infos(_):
+                io_lock_states.append(pages._ring_lock.locked())
+                return [TestFleetPages._Info(0, "router"),
+                        TestFleetPages._Info(1, "w1"),
+                        TestFleetPages._Info(2, "w2")]
+
+        class Store:
+            def get(_, key):
+                io_lock_states.append(pages._ring_lock.locked())
+                rid = "fr" + key.rsplit("/w", 1)[-1]
+                return {"replica_id": rid, "role": "prefill"}
+
+        class Worker:
+            agent = Agent()
+            store = Store()
+
+        pages.worker = Worker()
+        pts, peers = pages._ensure_ring()
+        assert io_lock_states == [False, False, False]
+        assert set(peers) == {"fr1", "fr2"}
+        assert len(pts) == 128 and pts == sorted(pts)
+        # second call is served from the published ring: no more I/O
+        pts2, peers2 = pages._ensure_ring()
+        assert pts2 is pts and peers2 == peers
+        assert len(io_lock_states) == 3
+
+    def test_racing_ring_builders_both_complete(self):
+        """Two threads build the ring at once: each fetches its own
+        snapshot outside the lock, the first publish wins, both return
+        the identical ring. (With the membership fetch under the lock
+        the second builder could never reach the barrier.)"""
+        pages = self._bare_pages()
+        barrier = threading.Barrier(2, timeout=5)
+
+        class Agent:
+            def all_worker_infos(_):
+                barrier.wait()     # both builders in flight at once
+                return [TestFleetPages._Info(1, "w1")]
+
+        class Store:
+            def get(_, key):
+                return {"replica_id": "fr1", "role": "both"}
+
+        class Worker:
+            agent = Agent()
+            store = Store()
+
+        pages.worker = Worker()
+        results, errors = [], []
+
+        def build():
+            try:
+                results.append(pages._ensure_ring())
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=build) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+        assert len(results) == 2
+        assert results[0][0] == results[1][0]
+        assert results[0][1] == results[1][1] == pages._peers
+        assert pages._points is results[0][0] is results[1][0]
+
     def test_owner_miss_is_clean(self, make_fleet):
         fl = make_fleet(("prefill", "prefill"),
                         host_tier_bytes=10_000)

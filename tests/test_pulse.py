@@ -258,6 +258,35 @@ class TestPlaneTriggersAndBundles:
         plane.tick()                 # still True: no re-trigger
         assert plane.triggers["breaker_open"] == 1
 
+    def test_trigger_accounting_runs_under_the_plane_lock(self):
+        """Regression (found by tpuracer's TPL008 pass): the counter-
+        delta pass and the `triggers[trig] += 1` read-modify-write used
+        to run OUTSIDE self._lock, so the pulse daemon racing an
+        opportunistic scrape tick could lose fires. Pin the fix: every
+        trigger-dict write happens with the lock held."""
+        plane = PulsePlane(lambda: {}, interval_s=3600.0,
+                           start_thread=False)
+        locked_writes = []
+
+        class Guarded(dict):
+            def __setitem__(self, key, value):
+                locked_writes.append(plane._lock.locked())
+                super().__setitem__(key, value)
+
+        plane.triggers = Guarded(plane.triggers)
+        plane._check_triggers({"pt_step_anomalies": _ctr(0)})  # baseline
+        plane._check_triggers({"pt_step_anomalies": _ctr(2)})
+        assert plane.triggers["step_stall"] == 1
+        assert locked_writes == [True]
+
+    def test_payload_triggers_are_a_snapshot(self, tmp_path):
+        plane = _mk_plane(tmp_path, [{}])
+        doc = plane.payload()
+        doc["triggers"]["step_stall"] = 99
+        doc["bundles"].append("bogus")
+        assert plane.triggers["step_stall"] == 0
+        assert plane.bundles == []
+
     def test_no_capture_dir_means_no_bundles(self, tmp_path):
         snaps = [{"pt_engine_restarts": _ctr(0)},
                  {"pt_engine_restarts": _ctr(1)}]

@@ -29,9 +29,10 @@ LOCKED.lock_scope = ["locked_mod.py"]
 
 
 # ---------------------------------------------------------------- registry
-def test_six_rules_registered():
+def test_eleven_rules_registered():
     assert [r.id for r in all_rules()] == [
-        "TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006"]
+        "TPL001", "TPL002", "TPL003", "TPL004", "TPL005", "TPL006",
+        "TPL007", "TPL008", "TPL009", "TPL010", "TPL011"]
 
 
 # ---------------------------------------------------------------- TPL001

@@ -63,8 +63,38 @@ def test_cli_list_rules():
     proc = _run("--list-rules")
     assert proc.returncode == 0
     for rid in ("TPL001", "TPL002", "TPL003", "TPL004", "TPL005",
-                "TPL006"):
+                "TPL006", "TPL007", "TPL008", "TPL009", "TPL010",
+                "TPL011"):
         assert rid in proc.stdout
+
+
+def test_env_docs_in_sync():
+    """Satellite of the tpuracer pass: docs/env.md is generated from
+    the paddle_tpu/_env.py knob registry; a knob added without
+    regenerating the table fails here with a one-command fix."""
+    gen = os.path.join(REPO, "tools", "gen_env_docs.py")
+    proc = subprocess.run([sys.executable, gen, "--check"], cwd=REPO,
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_env_docs_check_detects_drift(tmp_path):
+    """--check must actually bite: a tampered docs/env.md fails."""
+    gen = os.path.join(REPO, "tools", "gen_env_docs.py")
+    doc = os.path.join(REPO, "docs", "env.md")
+    with open(doc, "r", encoding="utf-8") as f:
+        original = f.read()
+    try:
+        with open(doc, "a", encoding="utf-8") as f:
+            f.write("\n| `PT_BOGUS_ROW` | `1` | int | tampered |\n")
+        proc = subprocess.run([sys.executable, gen, "--check"],
+                              cwd=REPO, capture_output=True, text=True,
+                              timeout=60)
+        assert proc.returncode == 1
+        assert "out of sync" in proc.stderr
+    finally:
+        with open(doc, "w", encoding="utf-8") as f:
+            f.write(original)
 
 
 def test_pump_loop_single_sanctioned_device_get():

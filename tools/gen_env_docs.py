@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Generate docs/env.md from the paddle_tpu._env knob registry.
+
+Pure stdlib: loads paddle_tpu/_env.py as a standalone module (no jax,
+no paddle_tpu package import) so doc generation runs on any box.
+
+Usage:
+    python tools/gen_env_docs.py            # rewrite docs/env.md
+    python tools/gen_env_docs.py --check    # exit 1 when out of sync
+
+The tier-1 selfcheck runs --check, so a knob added to _env.py without
+regenerating the table fails CI with a one-command fix.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV_PY = os.path.join(REPO, "paddle_tpu", "_env.py")
+DOC = os.path.join(REPO, "docs", "env.md")
+
+_SECTION_TITLES = {
+    "serving": "Serving runtime",
+    "slo": "SLO classes",
+    "pulse": "Pulse / anomaly capture",
+    "fleet": "Fleet plane",
+    "observability": "Observability",
+    "kernels": "Kernels",
+    "distributed": "Distributed / RPC",
+    "io": "Data / checkpoint IO",
+    "general": "General",
+}
+
+
+def _load_env_module():
+    spec = importlib.util.spec_from_file_location("_pt_env_docgen", ENV_PY)
+    module = importlib.util.module_from_spec(spec)
+    # dataclasses resolve cls.__module__ through sys.modules during
+    # class creation — the module MUST be registered before exec.
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _default_cell(knob):
+    if knob.default is None:
+        return "_(unset)_"
+    if knob.default == "":
+        return '`""`'
+    return f"`{knob.default}`"
+
+
+def render():
+    env = _load_env_module()
+    by_section = {}
+    for k in env.knobs():
+        by_section.setdefault(k.section, []).append(k)
+
+    out = [
+        "# Environment knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand.",
+        "     Regenerate with: python tools/gen_env_docs.py -->",
+        "",
+        "Every `PT_*` / `PADDLE_TPU_*` environment variable the tree",
+        "reads is declared in `paddle_tpu/_env.py` with a default and a",
+        "one-line doc; tpulint rule TPL010 rejects undeclared reads, and",
+        "the tier-1 selfcheck fails when this table drifts from the",
+        "registry. Names ending in `*` are patterns: a family of knobs",
+        "(for example one per SLO class) sharing one parser and doc.",
+        "",
+    ]
+    for section in sorted(by_section):
+        title = _SECTION_TITLES.get(section, section.title())
+        out.append(f"## {title}")
+        out.append("")
+        out.append("| Name | Default | Kind | What it does |")
+        out.append("|---|---|---|---|")
+        for k in by_section[section]:
+            out.append(f"| `{k.name}` | {_default_cell(k)} "
+                       f"| {k.kind} | {k.doc} |")
+        out.append("")
+    return "\n".join(out).rstrip() + "\n"
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    check = "--check" in argv
+    text = render()
+    current = ""
+    if os.path.exists(DOC):
+        with open(DOC, "r", encoding="utf-8") as f:
+            current = f.read()
+    if check:
+        if current != text:
+            sys.stderr.write(
+                "docs/env.md is out of sync with paddle_tpu/_env.py — "
+                "run: python tools/gen_env_docs.py\n")
+            return 1
+        return 0
+    if current != text:
+        with open(DOC, "w", encoding="utf-8") as f:
+            f.write(text)
+        print(f"wrote {os.path.relpath(DOC, REPO)}")
+    else:
+        print("docs/env.md already in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
