@@ -250,6 +250,24 @@ declare("PT_FLEET_FETCH_MAX", 8,
 declare("PT_FLEET_SPILL_QUEUE", 128,
         "Bound of the evicted-page spill queue (full queue drops, "
         "never blocks).", kind="int", section="fleet")
+declare("PT_FLEET_CLOCK_ALPHA", 0.2,
+        "EWMA smoothing factor for per-worker clock-offset "
+        "estimation (0 < alpha <= 1; higher tracks faster).",
+        kind="float", section="fleet")
+declare("PT_FLEET_OBS_POLL_S", 1.0,
+        "Router-side fleet observability poll interval in seconds "
+        "(worker trigger totals + clock samples).",
+        kind="float", section="fleet")
+declare("PT_FLEET_CAPTURE_DIR", "",
+        "Directory for fleet capture bundles pulled by rank 0 on a "
+        "worker pulse trigger (empty disables).",
+        kind="str", section="fleet")
+declare("PT_FLEET_CAPTURE_MAX", 8,
+        "Maximum fleet capture bundles written per router process.",
+        kind="int", section="fleet")
+declare("PT_FLEET_CAPTURE_MIN_S", 30.0,
+        "Minimum seconds between fleet capture bundles (rate limit).",
+        kind="float", section="fleet")
 
 # -- observability -----------------------------------------------------
 declare("PADDLE_TPU_FLIGHT", True,

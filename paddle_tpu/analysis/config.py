@@ -119,6 +119,9 @@ class LintConfig:
                 # scrape cadence — a device pull there would serialize
                 # against the pump's dispatch stream just the same
                 "paddle_tpu/observability/pulse.py",
+                # fleet observability runs on router/worker daemon
+                # threads between rpc round trips — same rule
+                "paddle_tpu/observability/fleet_obs.py",
             ],
             hot_functions=[
                 # ServingEngine per-token loop + its helpers
@@ -183,6 +186,13 @@ class LintConfig:
                 "FleetPages._spill_loop",
                 "FleetPages.fetch_missing",
                 "RemoteRequest._read_loop",
+                # fleet observability: the obs poll loop + the pull
+                # paths it drives run per tick on the router, and the
+                # estimator update runs per rpc reply
+                "ClockSkewEstimator.sample",
+                "FleetWorker.obs_snapshot",
+                "FleetPlane._obs_loop",
+                "FleetPlane.obs_sections",
             ],
             bench_paths=[
                 "bench*.py", "tools/*.py", "tests/*.py", "examples/*.py",

@@ -24,6 +24,15 @@ Like the reference ("Users must use this API in a secure network
 environment", rpc.py docstrings) the wire is pickle over a trusted
 network — see docs/distributed.md's trusted-network note; the same
 assumption covers the PS tier.
+
+Fleet observability rides this wire for free (docs/observability.md):
+request frames carry an optional trailing meta dict with the caller's
+`trace_id`/`span_id` (the handler executes under that trace context,
+so remote flight records and spans join the originating request's
+trace), and reply frames carry the server's receive/send wall stamps
+`(t1, t2)` — one NTP-style clock sample per round trip, delivered to
+`RpcAgent.on_clock_sample`. Both extensions are length-tolerant: old
+3-tuple requests and 2-tuple replies still interoperate.
 """
 from __future__ import annotations
 
@@ -124,6 +133,36 @@ def _recv_frame(sock) -> bytes:
             f"the {_MAX_FRAME}B cap (corrupt stream or oversized "
             "sender)")
     return _recv_exact(sock, n)
+
+
+_tc = None
+
+
+def _trace_mod():
+    """The observability trace-context module, imported lazily so
+    `import paddle_tpu.distributed` stays stdlib-cheap; the package is
+    stdlib-only at import time, so this can never drag jax in."""
+    global _tc
+    if _tc is None:
+        try:
+            from ..observability import trace_context
+        except Exception:
+            trace_context = False
+        _tc = trace_context
+    return _tc or None
+
+
+def _trace_meta():
+    """The calling thread's trace context as an rpc meta dict (or
+    None). Must run on the CALLER's thread — contextvars do not cross
+    the agent's outbound pool."""
+    tc = _trace_mod()
+    if tc is None:
+        return None
+    tid = tc.current_trace_id()
+    if tid is None:
+        return None
+    return {"trace_id": tid, "span_id": tc.current_span_id()}
 
 
 # ---------------------------------------------------------------------------
@@ -285,6 +324,10 @@ class RpcAgent:
         self.world_size = world_size
         self._store = store
         self._barrier_count = 0
+        # clock-sample hook: called as (peer, t_send, t_remote, t_recv,
+        # hold_s) after every reply carrying server stamps — the fleet
+        # plane points this at its ClockSkewEstimator
+        self.on_clock_sample = None
         self._pool = ThreadPoolExecutor(
             max_workers=int(os.environ.get("PT_RPC_THREADS", "8")),
             thread_name_prefix=f"pt-rpc-{name}")
@@ -365,35 +408,62 @@ class RpcAgent:
                             f"rpc: agent {self.name!r} not ready within "
                             "900s; refusing inbound call"))))
                     return
-                fn, args, kwargs = pickle.loads(_recv_frame(conn))
+                req = pickle.loads(_recv_frame(conn))
+                fn, args, kwargs = req[0], req[1], req[2]
+                meta = req[3] if len(req) > 3 else None
+                t1 = time.time()   # server receipt (NTP-style sample)
+                tc = _trace_mod() if meta and meta.get("trace_id") \
+                    else None
                 try:
-                    out = ("ok", fn(*args, **kwargs))
+                    if tc is not None:
+                        with tc.bind(meta["trace_id"],
+                                     parent_span=meta.get("span_id")):
+                            value = fn(*args, **kwargs)
+                    else:
+                        value = fn(*args, **kwargs)
+                    out = ("ok", value, t1, time.time())
                 except Exception as e:  # noqa: BLE001 — ships to caller
                     e._rpc_remote_traceback = traceback.format_exc()
-                    out = ("exc", e)
+                    out = ("exc", e, t1, time.time())
                 try:
                     payload = pickle.dumps(out)
                 except Exception as e:  # unpicklable result/exception
                     payload = pickle.dumps(
                         ("exc", RuntimeError(
-                            f"rpc: result not picklable: {e}")))
+                            f"rpc: result not picklable: {e}"),
+                         t1, time.time()))
                 _send_frame(conn, payload)
         except (ConnectionError, OSError, pickle.UnpicklingError):
             pass  # caller vanished or garbage frame; nothing to answer
 
     # -- outbound -----------------------------------------------------
-    def _call(self, to, fn, args, kwargs, timeout):
+    def _note_clock(self, to, t_send, t1, t2, t_recv):
+        cb = self.on_clock_sample
+        if cb is None:
+            return
+        try:
+            cb(to, t_send, (float(t1) + float(t2)) / 2.0, t_recv,
+               max(float(t2) - float(t1), 0.0))
+        except Exception:
+            pass  # a broken estimator must never fail the call itself
+
+    def _call(self, to, fn, args, kwargs, timeout, meta=None):
         info = self._infos.get(to)
         if info is None:
             raise ValueError(f"rpc: unknown worker {to!r}; known: "
                              f"{sorted(self._infos)}")
-        payload = pickle.dumps((fn, args or (), kwargs or {}))
+        payload = pickle.dumps((fn, args or (), kwargs or {}, meta))
+        t_send = time.time()
         with socket.create_connection((info.ip, info.port),
                                       timeout=timeout) as s:
             if timeout is not None:
                 s.settimeout(timeout)
             _send_frame(s, payload)
-            status, value = pickle.loads(_recv_frame(s))
+            rep = pickle.loads(_recv_frame(s))
+        t_recv = time.time()
+        status, value = rep[0], rep[1]
+        if len(rep) > 3:   # reply carries server stamps (t1, t2)
+            self._note_clock(to, t_send, rep[2], rep[3], t_recv)
         if status == "exc":
             remote_tb = getattr(value, "_rpc_remote_traceback", None)
             if remote_tb:
@@ -405,10 +475,15 @@ class RpcAgent:
     def invoke(self, to, fn, args, kwargs, timeout):
         fut = FutureWrapper()
         eff = None if timeout is None or timeout <= 0 else timeout
+        # Trace context rides contextvars, which do NOT cross the
+        # _caller pool boundary — capture it here, on the caller's
+        # thread, and ship it inside the frame.
+        meta = _trace_meta()
 
         def run():
             try:
-                fut._finish(result=self._call(to, fn, args, kwargs, eff))
+                fut._finish(result=self._call(to, fn, args, kwargs, eff,
+                                              meta))
             except BaseException as e:  # noqa: BLE001 — raises at wait()
                 fut._finish(exc=e)
 

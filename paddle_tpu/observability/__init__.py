@@ -28,6 +28,11 @@ The static half of "why was this step slow" is tpulint
     `/debug/pulse` payload + `tools/ptop.py` dashboard feed, and
     anomaly-triggered capture bundles (`PT_CAPTURE_DIR`) rendered by
     `tools/ptdump.py bundle`.
+  * `fleet_obs`        — fleet observability primitives: NTP-style
+    clock-skew estimation per worker, cross-host span stitching into
+    one skew-corrected chrome trace, merged flight-ring dumps, and
+    fleet-wide capture bundles (rank 0 pulls every worker's evidence
+    into one dir on a pulse trigger).
   * `health`           — jit-safe training-health monitoring: fused
     loss/grad finite checks + grad-norm/update-ratio computed inside
     traced step functions (one batched transfer per step), GradScaler
@@ -41,8 +46,8 @@ anywhere — including the serving stack's innermost loops.
 from __future__ import annotations
 
 from . import (  # noqa: F401
-    chrome_trace, compile_telemetry, device_telemetry, flight_recorder,
-    health, pulse, trace_context,
+    chrome_trace, compile_telemetry, device_telemetry, fleet_obs,
+    flight_recorder, health, pulse, trace_context,
 )
 from . import logging as logging  # noqa: F401,PLC0414 — stdlib-shadowing by design
 from .chrome_trace import chrome_trace_doc  # noqa: F401
@@ -64,7 +69,8 @@ from .trace_context import (  # noqa: F401
 
 __all__ = [
     "chrome_trace", "compile_telemetry", "device_telemetry",
-    "flight_recorder", "health", "pulse", "trace_context", "logging",
+    "fleet_obs", "flight_recorder", "health", "pulse", "trace_context",
+    "logging",
     "PulsePlane", "PulseRing", "PulseSampler",
     "CompileRegistry", "tracked", "track_jit", "signature_of",
     "CostRegistry", "COSTS", "MemoryAccountant", "ACCOUNTANT",

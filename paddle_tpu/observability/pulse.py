@@ -348,6 +348,17 @@ class PulsePlane:
             "bundles": bundles,
         }
 
+    def trigger_state(self):
+        """Light cross-host poll target: cumulative trigger fires,
+        local bundle paths, and the trace ids in flight — no rings, no
+        registry snapshot. The fleet plane diffs `triggers` between
+        polls to fire ONE fleet-wide capture per incident."""
+        info = self._info_fn() if self._info_fn is not None else {}
+        with self._lock:
+            return {"triggers": dict(self.triggers),
+                    "bundles": list(self.bundles),
+                    "trace_ids": list(info.get("trace_ids") or [])}
+
     # -- triggers + capture bundles -----------------------------------
     def _trigger_counts(self, snap):
         counts = {}

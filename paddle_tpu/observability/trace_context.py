@@ -50,17 +50,28 @@ def current_span_id():
 class bind:
     """Bind a trace id for the dynamic extent of a with-block (or via
     explicit .attach()/.detach() when the extent is not lexical, e.g.
-    around one request's share of a pump iteration)."""
+    around one request's share of a pump iteration).
 
-    def __init__(self, trace_id):
+    `parent_span` seats an inbound parent span id too, so spans opened
+    inside the extent nest under a REMOTE caller's span — this is how
+    a cross-host rpc hop keeps one parent/child chain."""
+
+    def __init__(self, trace_id, parent_span=None):
         self.trace_id = trace_id
+        self.parent_span = parent_span
         self._token = None
+        self._span_token = None
 
     def attach(self):
         self._token = _trace_id.set(self.trace_id)
+        if self.parent_span is not None:
+            self._span_token = _span_id.set(self.parent_span)
         return self
 
     def detach(self):
+        if self._span_token is not None:
+            _span_id.reset(self._span_token)
+            self._span_token = None
         if self._token is not None:
             _trace_id.reset(self._token)
             self._token = None

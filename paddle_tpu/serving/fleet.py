@@ -72,7 +72,9 @@ from collections import OrderedDict
 
 from .._env import env_float, env_int, env_str
 from ..distributed import rpc as _rpc
+from ..observability import fleet_obs as _fobs
 from ..observability import flight_recorder as _flight
+from ..observability import trace_context as _tc
 from . import wire as _wire
 from .kvcache import block_hash as _block_hash
 from .metrics import MetricsRegistry
@@ -174,6 +176,14 @@ def _rpc_pulse(name, window, signals):
     return {"enabled": False}
 
 
+def _rpc_obs_snapshot(name, window):
+    return _worker(name).obs_snapshot(window)
+
+
+def _rpc_obs_triggers(name):
+    return _worker(name).obs_triggers()
+
+
 # ---------------------------------------------------------------------------
 # bulk-channel clients (stdlib socket + serving/wire framing)
 
@@ -184,64 +194,94 @@ def _bulk_connect(addr, timeout):
     return s
 
 
-def _fetch_handoff(addr, rid, timeout=None):
+def _fetch_handoff(addr, rid, timeout=None, acct=None):
     """Pull one exported KVHandoff from a worker's bulk endpoint —
     the host-to-host half of a decode migration."""
     timeout = timeout if timeout is not None \
         else env_float("PT_FLEET_CALL_TIMEOUT_S")
+    acct = acct if acct is not None else _wire.WireAccount()
+    t0 = time.perf_counter()
     with _bulk_connect(addr, timeout) as s:
-        _wire.send_json(s, {"op": "handoff", "rid": str(rid)})
-        head = _wire.recv_json(s)
+        _wire.send_json(s, {"op": "handoff", "rid": str(rid)}, acct=acct)
+        head = _wire.recv_json(s, acct=acct)
         if not head.get("ok"):
             raise _wire.WireError(
                 f"fleet: worker holds no handoff for rid {rid!r}")
-        return _wire.recv_handoff(s)
+        h = _wire.recv_handoff(s, acct=acct)
+    _tc.record_span_event(
+        "wire.handoff_fetch", time.perf_counter() - t0,
+        args={"rid": str(rid), "bytes": acct.rx_bytes + acct.tx_bytes,
+              "frames": acct.frames})
+    return h
 
 
-def _push_handoff(addr, h, timeout=None):
+def _push_handoff(addr, h, timeout=None, acct=None):
     """Push a locally-held KVHandoff to a worker's bulk endpoint (the
     local-replica -> remote-replica migration direction). Returns the
     payload bytes framed."""
     timeout = timeout if timeout is not None \
         else env_float("PT_FLEET_CALL_TIMEOUT_S")
+    acct = acct if acct is not None else _wire.WireAccount()
+    t0 = time.perf_counter()
     with _bulk_connect(addr, timeout) as s:
-        _wire.send_json(s, {"op": "handoff_put"})
-        n = _wire.send_handoff(s, h)
-        ack = _wire.recv_json(s)
+        _wire.send_json(s, {"op": "handoff_put"}, acct=acct)
+        n = _wire.send_handoff(s, h, acct=acct)
+        ack = _wire.recv_json(s, acct=acct)
         if not ack.get("ok"):
             raise _wire.WireError("fleet: handoff_put refused")
-        return n
+    _tc.record_span_event(
+        "wire.handoff_push", time.perf_counter() - t0,
+        args={"rid": str(getattr(h, "rid", "")),
+              "bytes": acct.rx_bytes + acct.tx_bytes,
+              "frames": acct.frames})
+    return n
 
 
-def _fetch_page(addr, key, timeout):
+def _fetch_page(addr, key, timeout, acct=None):
     """Fetch one spilled prefix page by chained hash from its owner.
     Returns {parent, block, depth, payload} or None on a clean miss."""
+    acct = acct if acct is not None else _wire.WireAccount()
+    t0 = time.perf_counter()
     with _bulk_connect(addr, timeout) as s:
-        _wire.send_json(s, {"op": "page_get", "key": int(key)})
-        head = _wire.recv_json(s)
+        _wire.send_json(s, {"op": "page_get", "key": int(key)},
+                        acct=acct)
+        head = _wire.recv_json(s, acct=acct)
         if not head.get("ok"):
             return None
-        payload = {"k": _wire.recv_array(s), "v": _wire.recv_array(s),
-                   "ks": _wire.recv_array(s), "vs": _wire.recv_array(s)}
+        payload = {"k": _wire.recv_array(s, acct=acct),
+                   "v": _wire.recv_array(s, acct=acct),
+                   "ks": _wire.recv_array(s, acct=acct),
+                   "vs": _wire.recv_array(s, acct=acct)}
+        _tc.record_span_event(
+            "wire.page_fetch", time.perf_counter() - t0,
+            args={"bytes": acct.rx_bytes + acct.tx_bytes,
+                  "frames": acct.frames})
         return {"parent": int(head["parent"]),
                 "block": tuple(int(t) for t in head["block"]),
                 "depth": int(head["depth"]), "payload": payload}
 
 
-def _push_page(addr, parent, block, depth, payload, timeout):
+def _push_page(addr, parent, block, depth, payload, timeout,
+               acct=None):
     """Ship one evicted prefix page to its owning peer. Returns bytes
     framed."""
+    acct = acct if acct is not None else _wire.WireAccount()
+    t0 = time.perf_counter()
     with _bulk_connect(addr, timeout) as s:
         _wire.send_json(s, {"op": "page_put", "parent": int(parent),
                             "block": [int(t) for t in block],
-                            "depth": int(depth)})
+                            "depth": int(depth)}, acct=acct)
         n = 0
         for part in ("k", "v", "ks", "vs"):
-            n += _wire.send_array(s, payload.get(part))
-        ack = _wire.recv_json(s)
+            n += _wire.send_array(s, payload.get(part), acct=acct)
+        ack = _wire.recv_json(s, acct=acct)
         if not ack.get("ok"):
             raise _wire.WireError("fleet: page_put refused")
-        return n
+    _tc.record_span_event(
+        "wire.page_spill", time.perf_counter() - t0,
+        args={"bytes": acct.rx_bytes + acct.tx_bytes,
+              "frames": acct.frames})
+    return n
 
 
 class RemoteHandoffRef:
@@ -421,7 +461,8 @@ class FleetPages:
                     continue
                 n = _push_page((meta["bulk_ip"], meta["bulk_port"]),
                                e["parent"], e["block"], e["depth"],
-                               e["payload"], timeout)
+                               e["payload"], timeout,
+                               acct=self.worker.wire_acct("bulk"))
                 self.spill_pages.inc()
                 self.spill_bytes.inc(n)
                 _flight.record("fleet.spill", owner=owner, bytes=n,
@@ -455,7 +496,8 @@ class FleetPages:
                 break
             try:
                 entry = _fetch_page((meta["bulk_ip"], meta["bulk_port"]),
-                                    key, timeout)
+                                    key, timeout,
+                                    acct=self.worker.wire_acct("bulk"))
             except Exception:  # noqa: BLE001 — peer down == miss
                 self.fetch_misses.inc()
                 break
@@ -476,28 +518,40 @@ class FleetPages:
     # -- serve side (bulk handler) -------------------------------------
     def serve_page(self, conn, key):
         e = self.tier.peek(int(key))
+        acct = self.worker.wire_acct("bulk")
         if e is None:
-            _wire.send_json(conn, {"ok": False})
+            _wire.send_json(conn, {"ok": False}, acct=acct)
             return
+        t0 = time.perf_counter()
         _wire.send_json(conn, {"ok": True, "parent": int(e["parent"]),
                                "block": [int(t) for t in e["block"]],
-                               "depth": int(e["depth"])})
+                               "depth": int(e["depth"])}, acct=acct)
         for part in ("k", "v", "ks", "vs"):
-            _wire.send_array(conn, e["payload"].get(part))
+            _wire.send_array(conn, e["payload"].get(part), acct=acct)
         self.page_serves.inc()
+        _tc.record_span_event(
+            "wire.page_serve", time.perf_counter() - t0,
+            args={"bytes": acct.tx_bytes, "frames": acct.frames,
+                  "worker": self.worker.name})
 
     def land_page(self, conn, head):
-        payload = {"k": _wire.recv_array(conn),
-                   "v": _wire.recv_array(conn),
-                   "ks": _wire.recv_array(conn),
-                   "vs": _wire.recv_array(conn)}
+        acct = self.worker.wire_acct("bulk")
+        t0 = time.perf_counter()
+        payload = {"k": _wire.recv_array(conn, acct=acct),
+                   "v": _wire.recv_array(conn, acct=acct),
+                   "ks": _wire.recv_array(conn, acct=acct),
+                   "vs": _wire.recv_array(conn, acct=acct)}
         ok = self.tier.insert(
             int(head["parent"]),
             tuple(int(t) for t in head["block"]),
             int(head["depth"]), payload, fleet=True)
         if ok:
             self.recv_pages.inc()
-        _wire.send_json(conn, {"ok": bool(ok)})
+        _wire.send_json(conn, {"ok": bool(ok)}, acct=acct)
+        _tc.record_span_event(
+            "wire.page_land", time.perf_counter() - t0,
+            args={"bytes": acct.rx_bytes, "frames": acct.frames,
+                  "worker": self.worker.name})
 
     def stop(self):
         self._stop.set()
@@ -546,7 +600,12 @@ class FleetWorker:
             "pt_fleet_handoff_wire_bytes",
             "KV handoff payload bytes actually framed onto the bulk "
             "socket.")
+        self._wire_counters = {}     # chan -> (tx, rx, frames)
         _WORKERS[self.name] = self
+        # every worker leaves evidence: the flight ring dumps on
+        # SIGTERM/fault, and the router's fleet capture pulls the same
+        # ring over rpc (install() is idempotent + thread-safe)
+        _flight.install()
 
         # bulk channel first: its advertised endpoint rides the meta
         bind = bulk_bind or env_str("PT_RPC_BIND")
@@ -593,6 +652,30 @@ class FleetWorker:
         _flight.record("fleet.worker_up", worker=self.name,
                        replica=replica.replica_id, host=self.host)
 
+    # -- wire accounting -----------------------------------------------
+    def wire_acct(self, chan):
+        """A fresh per-transfer `WireAccount` bound to this worker's
+        per-channel wire counters: the local tallies feed span byte
+        counts, the bound counters feed the symmetric
+        pt_wire_{tx,rx}_bytes / pt_wire_frames series the router
+        surfaces per replica@host."""
+        c = self._wire_counters.get(chan)
+        if c is None:
+            r = self.replica.registry
+            c = (r.counter("pt_wire_tx_bytes",
+                           "Bytes framed onto fleet sockets (header + "
+                           "payload).", labels={"chan": chan}),
+                 r.counter("pt_wire_rx_bytes",
+                           "Bytes received off fleet sockets (header + "
+                           "payload).", labels={"chan": chan}),
+                 r.counter("pt_wire_frames",
+                           "Frames moved over fleet sockets, both "
+                           "directions.", labels={"chan": chan}))
+            # benign race: the registry dedups by (name, labels), so
+            # two threads landing here cache the same counter objects
+            self._wire_counters[chan] = c
+        return _wire.WireAccount(tx=c[0], rx=c[1], frames=c[2])
+
     # -- heartbeat -----------------------------------------------------
     def _heartbeat(self):
         interval = env_float("PT_FLEET_HB_S")
@@ -629,17 +712,27 @@ class FleetWorker:
     def _bulk_handle(self, conn):
         try:
             with conn:
-                head = _wire.recv_json(conn)
+                head = _wire.recv_json(conn,
+                                       acct=self.wire_acct("control"))
                 op = head.get("op")
                 if op == "stream":
                     self._serve_stream(conn, str(head.get("rid")))
                 elif op == "handoff":
                     self._serve_handoff(conn, str(head.get("rid")))
                 elif op == "handoff_put":
-                    h = _wire.recv_handoff(conn)
+                    acct = self.wire_acct("bulk")
+                    t0 = time.perf_counter()
+                    h = _wire.recv_handoff(conn, acct=acct)
                     with self._req_lock:
                         self._kv_imports[str(h.rid)] = h
-                    _wire.send_json(conn, {"ok": True})
+                    _wire.send_json(conn, {"ok": True}, acct=acct)
+                    _tc.record_span_event(
+                        "wire.handoff_land",
+                        time.perf_counter() - t0,
+                        args={"rid": str(h.rid),
+                              "bytes": acct.rx_bytes,
+                              "frames": acct.frames,
+                              "worker": self.name})
                 elif op == "page_put" and self.pages is not None:
                     self.pages.land_page(conn, head)
                 elif op == "page_get" and self.pages is not None:
@@ -665,11 +758,14 @@ class FleetWorker:
                                    "output": []})
             return
         self.stream_serves.inc()
+        acct = self.wire_acct("stream")
+        t0 = time.perf_counter()
         err = None
         try:
             for chunk in sr.stream():
                 _wire.send_json(conn, {"t": "chunk",
-                                       "toks": [int(t) for t in chunk]})
+                                       "toks": [int(t) for t in chunk]},
+                                acct=acct)
         except Exception as e:  # noqa: BLE001 — shipped as the terminal error
             err = {"type": type(e).__name__, "msg": str(e)}
         h = sr.handoff
@@ -692,7 +788,15 @@ class FleetWorker:
                     self._handoffs.popitem(last=False)
         with self._req_lock:
             self._requests.pop(rid, None)
-        _wire.send_json(conn, frame)
+        _wire.send_json(conn, frame, acct=acct)
+        # worker half of the stream: same span name as the router's
+        # reader half, so the stitched fleet trace shows the transfer
+        # from both ends of the socket
+        _tc.record_span_event(
+            "wire.stream", time.perf_counter() - t0,
+            trace_id=sr.trace_id,
+            args={"rid": rid, "bytes": acct.tx_bytes,
+                  "frames": acct.frames, "worker": self.name})
 
     def _serve_handoff(self, conn, rid):
         with self._req_lock:
@@ -700,9 +804,10 @@ class FleetWorker:
         if h is None:
             _wire.send_json(conn, {"ok": False})
             return
+        acct = self.wire_acct("bulk")
         t0 = time.perf_counter()
-        _wire.send_json(conn, {"ok": True})
-        n = _wire.send_handoff(conn, h)
+        _wire.send_json(conn, {"ok": True}, acct=acct)
+        n = _wire.send_handoff(conn, h, acct=acct)
         dt = time.perf_counter() - t0
         self.handoff_serves.inc()
         self.handoff_wire_bytes.inc(n)
@@ -712,11 +817,25 @@ class FleetWorker:
         self.replica.registry.histogram(
             "pt_handoff_seconds",
             "Handoff export/transfer wall time.").observe(dt)
+        _tc.record_span_event(
+            "wire.handoff", dt,
+            args={"rid": rid, "bytes": acct.tx_bytes,
+                  "frames": acct.frames, "worker": self.name})
         _flight.record("fleet.handoff_serve", worker=self.name,
                        rid=rid, bytes=n, seconds=round(dt, 6))
 
     # -- rpc-facing handlers -------------------------------------------
     def handle_submit(self, prompt_ids, params):
+        # the rpc layer binds the inbound trace meta around dispatch;
+        # re-bind from params too so the in-process harness path (no
+        # rpc hop) keeps the same worker-side trace identity
+        tid = (params or {}).get("trace_id")
+        if tid and _tc.current_trace_id() != tid:
+            with _tc.bind(tid):
+                return self._handle_submit(prompt_ids, params)
+        return self._handle_submit(prompt_ids, params)
+
+    def _handle_submit(self, prompt_ids, params):
         params = dict(params)
         ref = params.pop("kv_import_ref", None)
         token = params.pop("kv_import_token", None)
@@ -730,7 +849,8 @@ class FleetWorker:
         elif ref is not None:
             try:
                 kv_import = _fetch_handoff(tuple(ref["addr"]),
-                                           ref["rid"])
+                                           ref["rid"],
+                                           acct=self.wire_acct("bulk"))
             except (ConnectionError, OSError, TimeoutError) as e:
                 # source worker gone or payload expired: refuse this
                 # candidate crisply so _migrate tries the next one
@@ -749,6 +869,38 @@ class FleetWorker:
         with self._req_lock:
             sr = self._requests.get(str(rid))
         return sr.cancel() if sr is not None else False
+
+    # -- fleet observability -------------------------------------------
+    def obs_snapshot(self, window=None):
+        """One rpc: everything the router needs to merge this worker
+        into a fleet trace, flight dump, or capture bundle. Spans ride
+        the flight snapshot (kind == "span" events)."""
+        sched = self.replica.scheduler
+        if hasattr(sched, "pulse"):
+            pulse = sched.pulse(window=window)
+        else:
+            pulse = {"enabled": False}
+        return {
+            "name": self.name,
+            "replica_id": self.replica.replica_id,
+            "host": self.host,
+            "role": self.replica.role,
+            "t_wall": time.time(),
+            "flight": _flight.snapshot(reason="fleet.obs"),
+            "pulse": pulse,
+            "requests": self.replica.recent_requests(64),
+        }
+
+    def obs_triggers(self):
+        """Light poll target for the plane's obs loop: cumulative
+        pulse-trigger totals plus the trace ids in flight. The rpc
+        round trips that carry this also feed the router's clock-skew
+        estimator — polling IS the sampling cadence."""
+        plane = getattr(self.replica.scheduler, "_pulse", None)
+        if plane is None:
+            return {"triggers": {}, "bundles": [], "trace_ids": []}
+        plane.maybe_sample()
+        return plane.trigger_state()
 
     # -- lifecycle -----------------------------------------------------
     def serve_forever(self):
@@ -868,6 +1020,8 @@ class RemoteRequest:
 
     # -- reader ---------------------------------------------------------
     def _read_loop(self):
+        acct = self._replica.wire_acct("stream")
+        t0 = time.perf_counter()
         try:
             s = socket.create_connection(
                 self._replica.bulk_addr,
@@ -877,9 +1031,10 @@ class RemoteRequest:
             # this socket when the worker is declared dead
             s.settimeout(None)
             self._sock = s
-            _wire.send_json(s, {"op": "stream", "rid": str(self.rid)})
+            _wire.send_json(s, {"op": "stream", "rid": str(self.rid)},
+                            acct=acct)
             while True:
-                fr = _wire.recv_json(s)
+                fr = _wire.recv_json(s, acct=acct)
                 t = fr.get("t")
                 if t == "chunk":
                     toks = [int(x) for x in fr.get("toks") or []]
@@ -889,6 +1044,15 @@ class RemoteRequest:
                     self.chunks.put(toks)
                 elif t == "end":
                     self._finish(fr)
+                    # router half of the stream transfer (the worker
+                    # records its half under the same span name)
+                    _tc.record_span_event(
+                        "wire.stream", time.perf_counter() - t0,
+                        trace_id=self.trace_id,
+                        args={"rid": str(self.rid),
+                              "bytes": acct.rx_bytes,
+                              "frames": acct.frames,
+                              "worker": self._replica._worker})
                     return
                 else:
                     raise _wire.WireError(
@@ -920,7 +1084,14 @@ class RemoteRequest:
                 self.handoff = RemoteHandoffRef(
                     self._replica.bulk_addr, str(self.rid),
                     nbytes=h.get("nbytes", 0), pages=h.get("pages", 0))
-            self.error = _rebuild_error(fr.get("error"))
+            err = fr.get("error")
+            if err is not None:
+                # worker-side failure context survives the frame: the
+                # NEXT sever on this replica names it (a crash usually
+                # errors one request before it kills the transport)
+                self._replica.last_error = (
+                    f"{err.get('type', 'Error')}: {err.get('msg', '')}")
+            self.error = _rebuild_error(err)
             self.state = fr.get("state", "failed")
             self._done.set()
             self.chunks.put(None)
@@ -928,22 +1099,32 @@ class RemoteRequest:
 
     def _transport_dead(self, reason):
         """The wire to the worker died before a terminal frame: fail
-        the request like an engine crash. Never-streamed handles then
-        ride the router's existing failover (token-identical replay);
-        mid-stream ones surface the error."""
+        the request like an engine crash, carrying the trace id and
+        the worker's last known error so the router-side exception
+        names WHAT died over there, not just that the socket closed.
+        Never-streamed handles then ride the router's existing
+        failover (token-identical replay); mid-stream ones surface
+        the error."""
+        last = self._replica.last_error
         with self._term_lock:
             if self._done.is_set():
                 return
-            self.error = SchedulerError(
-                f"fleet: worker {self._replica._worker!r} lost "
-                f"mid-request: {reason}")
+            msg = (f"fleet: worker {self._replica._worker!r} lost "
+                   f"mid-request: {reason} [trace {self.trace_id}]")
+            if last:
+                msg += f"; last worker error: {last}"
+            err = SchedulerError(msg)
+            err.trace_id = self.trace_id
+            err.worker_error = last
+            self.error = err
             self.state = "failed"
             self._done.set()
             self.chunks.put(None)
         self._replica._forget(self.rid)
-        _flight.record("fleet.request_lost", rid=str(self.rid),
+        _flight.record("fleet.sever", rid=str(self.rid),
                        worker=self._replica._worker,
-                       streamed=self._streamed)
+                       trace_id=self.trace_id, reason=str(reason),
+                       worker_error=last, streamed=self._streamed)
 
     def _sever(self, reason):
         """Heartbeat monitor path: close the stream socket so the
@@ -1053,8 +1234,16 @@ class RemoteReplica:
         self.bulk_addr = (meta["bulk_ip"], int(meta["bulk_port"]))
         self._dead = threading.Event()
         self._dead_reason = None
+        # last worker-side error string seen on this replica's wire
+        # (terminal stream frames); attached to sever exceptions.
+        # Plain attribute: single writer per frame, torn reads benign
+        self.last_error = None
         self._live = {}
         self._live_lock = threading.Lock()
+        # wire accounting: counters live on the fleet plane's registry
+        # (installed by FleetPlane); bare local tallies until then
+        self._wire_registry = None
+        self._wire_counters = {}
         self._retries = env_int("PT_FLEET_RETRIES")
         self._timeout = env_float("PT_FLEET_CALL_TIMEOUT_S")
         self._last_stats = {
@@ -1068,6 +1257,27 @@ class RemoteReplica:
         }
         self.scheduler = _RemoteScheduler(self)
         self.registry = self.scheduler
+
+    def wire_acct(self, chan):
+        """Router-side mirror of `FleetWorker.wire_acct`: a fresh
+        account bound to pt_wire_* counters on the plane registry, or
+        tallies-only when no plane installed one (in-process tests)."""
+        c = self._wire_counters.get(chan)
+        if c is None:
+            r = self._wire_registry
+            if r is None:
+                return _wire.WireAccount()
+            c = (r.counter("pt_wire_tx_bytes",
+                           "Bytes framed onto fleet sockets (header + "
+                           "payload).", labels={"chan": chan}),
+                 r.counter("pt_wire_rx_bytes",
+                           "Bytes received off fleet sockets (header + "
+                           "payload).", labels={"chan": chan}),
+                 r.counter("pt_wire_frames",
+                           "Frames moved over fleet sockets, both "
+                           "directions.", labels={"chan": chan}))
+            self._wire_counters[chan] = c
+        return _wire.WireAccount(tx=c[0], rx=c[1], frames=c[2])
 
     # -- rpc plumbing ---------------------------------------------------
     def _call(self, fn, args=(), timeout=None, retries=0):
@@ -1171,14 +1381,21 @@ class RemoteReplica:
                 # source): push it over the bulk channel, then submit
                 # by token
                 try:
-                    _push_handoff(self.bulk_addr, kv_import)
+                    _push_handoff(self.bulk_addr, kv_import,
+                                  acct=self.wire_acct("bulk"))
                 except (ConnectionError, OSError, TimeoutError) as e:
                     raise SchedulerClosedError(
                         f"fleet: handoff push to {self._worker!r} "
                         f"failed: {e}") from e
                 params["kv_import_token"] = str(kv_import.rid)
         try:
-            spec = self._call(_rpc_submit, (prompt_ids, params))
+            # a router-side span per dispatch: the rpc ships its trace
+            # meta, so the worker's spans nest under this one in the
+            # stitched fleet trace
+            with _tc.span("fleet.submit",
+                          args={"worker": self._worker,
+                                "replica": self.replica_id}):
+                spec = self._call(_rpc_submit, (prompt_ids, params))
         except (ConnectionError, OSError, TimeoutError) as e:
             raise SchedulerClosedError(
                 f"fleet: worker {self._worker!r} unreachable: "
@@ -1241,7 +1458,7 @@ class FleetPlane:
     needed."""
 
     def __init__(self, master_endpoint, workers, *, metrics=None,
-                 hb_timeout_s=None):
+                 hb_timeout_s=None, capture_dir=None):
         workers = list(workers)
         host, port = str(master_endpoint).rsplit(":", 1)
         self.master_endpoint = f"{host}:{int(port)}"
@@ -1276,6 +1493,33 @@ class FleetPlane:
             target=self._monitor_loop, daemon=True,
             name="pt-fleet-monitor")
         self._monitor.start()
+
+        # -- fleet observability ---------------------------------------
+        # clock-skew estimation rides every rpc reply; the obs loop
+        # polls worker trigger totals and fires fleet capture bundles
+        self.clock = _fobs.ClockSkewEstimator()
+        self._clock_gauges = {}      # worker -> (offset_g, unc_g)
+        self._agent.on_clock_sample = self._on_clock_sample
+        for rep in self.replicas:
+            rep._wire_registry = self.registry
+        self.capture_dir = capture_dir if capture_dir is not None \
+            else (env_str("PT_FLEET_CAPTURE_DIR") or None)
+        self.capture_max = env_int("PT_FLEET_CAPTURE_MAX")
+        self.capture_min_s = env_float("PT_FLEET_CAPTURE_MIN_S")
+        self.fleet_bundles = []
+        self.fleet_captures = self.registry.counter(
+            "pt_fleet_capture_bundles",
+            "Fleet-wide capture bundles written on a worker pulse "
+            "trigger.")
+        self._bundle_lock = threading.Lock()
+        self._bundle_last_t = 0.0
+        self._trig_seen = {}         # worker -> last trigger totals
+        self._obs_interval = env_float("PT_FLEET_OBS_POLL_S")
+        # separate thread from _monitor_loop on purpose: an rpc stall
+        # polling one worker must not delay heartbeat liveness checks
+        self._obs_thread = threading.Thread(
+            target=self._obs_loop, daemon=True, name="pt-fleet-obs")
+        self._obs_thread.start()
 
     def replica(self, name_or_rid):
         for rep in self.replicas:
@@ -1313,6 +1557,148 @@ class FleetPlane:
                 else:
                     n_alive += 1
             self.workers_alive.set(n_alive)
+
+    # -- fleet observability --------------------------------------------
+    def _on_clock_sample(self, peer, t_send, t_remote, t_recv,
+                         hold_s=0.0):
+        """RpcAgent hook: one NTP-style sample per rpc reply. Feeds
+        the EWMA estimator and the per-host offset gauges."""
+        off, unc = self.clock.sample(peer, t_send, t_remote, t_recv,
+                                     hold_s)
+        g = self._clock_gauges.get(peer)
+        if g is None:
+            rep = self.replica(peer)
+            host = (rep.host if rep is not None else None) or peer
+            g = (self.registry.gauge(
+                     "pt_fleet_clock_offset_seconds",
+                     "EWMA-smoothed clock offset of a worker host "
+                     "relative to the router (positive = worker clock "
+                     "ahead).", labels={"host": host}),
+                 self.registry.gauge(
+                     "pt_fleet_clock_uncertainty_seconds",
+                     "Half-RTT uncertainty bound on the worker "
+                     "clock-offset estimate.", labels={"host": host}))
+            # benign race: registry dedups by (name, labels)
+            self._clock_gauges[peer] = g
+        g[0].set(off)
+        g[1].set(unc)
+
+    def _obs_loop(self):
+        """Poll each alive worker's pulse-trigger totals (one light
+        rpc per worker per tick — the same round trips keep the clock
+        estimator fed) and pull ONE fleet capture bundle when any
+        worker reports a new trigger fire."""
+        while not self._stop.wait(self._obs_interval):
+            fired = None
+            trace_ids = []
+            for rep in self.replicas:
+                if rep._dead.is_set():
+                    continue
+                try:
+                    st = rep._call(_rpc_obs_triggers,
+                                   timeout=self._obs_interval * 2)
+                except (ConnectionError, OSError, TimeoutError):
+                    continue
+                cur = st.get("triggers") or {}
+                prev = self._trig_seen.get(rep._worker)
+                self._trig_seen[rep._worker] = cur
+                if prev is None:
+                    continue         # first poll: baseline only
+                for trig in sorted(cur):
+                    if float(cur[trig]) > float(prev.get(trig, 0)):
+                        if fired is None:
+                            fired = (trig, rep._worker)
+                        break
+                trace_ids.extend(st.get("trace_ids") or [])
+            if fired is not None:
+                try:
+                    self._fleet_capture(fired[0], fired[1], trace_ids)
+                except Exception as e:  # noqa: BLE001 — capture is best-effort
+                    _flight.record("fleet.capture_error",
+                                   trigger=fired[0], error=repr(e))
+
+    def _fleet_capture(self, trigger, worker, trace_ids):
+        """Rank 0's incident response: pull every worker's flight dump
+        + pulse window + request ring into ONE bundle dir with
+        per-host subdirs. Rate-limited; returns the path or None."""
+        if self.capture_dir is None:
+            return None
+        now = time.monotonic()
+        with self._bundle_lock:
+            if len(self.fleet_bundles) >= self.capture_max:
+                return None
+            if self.fleet_bundles \
+                    and now - self._bundle_last_t < self.capture_min_s:
+                return None
+            self._bundle_last_t = now
+            seq = len(self.fleet_bundles)
+            # reserve the slot before the (slow, networked) pull so a
+            # second trigger in the same window rate-limits against it
+            self.fleet_bundles.append(None)
+        sections = self.obs_sections()
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        name = f"fleet-{stamp}-{seq:03d}-{trigger}-{os.getpid()}"
+        meta = {"trigger": trigger, "worker": worker,
+                "at": time.time(), "pid": os.getpid(),
+                "trace_ids": list(dict.fromkeys(trace_ids)),
+                "clock": self.clock.snapshot()}
+        path = _fobs.write_fleet_bundle(self.capture_dir, name, meta,
+                                        sections)
+        with self._bundle_lock:
+            self.fleet_bundles[seq] = path
+        self.fleet_captures.inc()
+        _flight.record("fleet.bundle", trigger=trigger, worker=worker,
+                       path=path, trace_ids=meta["trace_ids"] or None)
+        return path
+
+    def obs_sections(self, window=None):
+        """One section per fleet process: the router's own flight ring
+        plus every alive worker's obs snapshot pulled over rpc (all
+        network round trips happen OUTSIDE any lock). Each worker
+        section carries the clock offset used to rebase it."""
+        sections = [{
+            "label": ROUTER_NAME,
+            "host": socket.gethostname(),
+            "replica_id": None,
+            "offset_s": 0.0, "uncertainty_s": 0.0,
+            "flight": _flight.snapshot(reason="fleet.obs"),
+            "pulse": {"enabled": False},
+            "requests": [],
+        }]
+        for rep in self.replicas:
+            if rep._dead.is_set():
+                continue
+            try:
+                snap = rep._call(_rpc_obs_snapshot, (window,))
+            except (ConnectionError, OSError, TimeoutError):
+                continue             # a dead worker is just absent
+            snap["label"] = (f"{snap.get('replica_id')}"
+                             f"@{snap.get('host')}")
+            snap["offset_s"] = self.clock.offset(rep._worker)
+            snap["uncertainty_s"] = self.clock.uncertainty(rep._worker)
+            sections.append(snap)
+        return sections
+
+    def fleet_trace(self):
+        """GET /debug/fleet/trace: one merged chrome-trace document,
+        one process row per replica@host (plus the router), remote
+        timestamps rebased onto the router clock, cross-process flow
+        arrows per trace id."""
+        sections = []
+        for sec in self.obs_sections():
+            spans = [e for e in
+                     ((sec.get("flight") or {}).get("events") or [])
+                     if e.get("kind") == "span"]
+            sections.append({"label": sec["label"],
+                             "offset_s": sec.get("offset_s", 0.0),
+                             "spans": spans})
+        return _fobs.stitch_fleet_trace(sections)
+
+    def fleet_flightrecorder(self):
+        """GET /debug/fleet/flightrecorder: every process's flight
+        ring in one document — per-host sections plus one merged
+        stream on the skew-corrected fleet clock."""
+        return _fobs.merge_flight_sections(self.obs_sections())
 
     # -- lifecycle ------------------------------------------------------
     def shutdown_workers(self, drain=True, timeout=None):
@@ -1397,6 +1783,15 @@ def run_worker(spec):
                          world_size=int(spec["world_size"]),
                          host=spec.get("host"))
     worker.serve_forever()
+    # leave a breadcrumb: crashes dump via the install()ed handlers,
+    # clean exits dump here — either way the worker's flight ring
+    # survives the process and its path is on stderr
+    try:
+        path = _flight.dump(reason="fleet.worker_exit")
+        print(f"fleet: worker {spec['name']} flight dump: {path}",
+              file=sys.stderr, flush=True)
+    except Exception:  # noqa: BLE001 — exit breadcrumb is best-effort
+        pass
     return 0
 
 

@@ -306,13 +306,17 @@ class Router:
 
     def __init__(self, replicas, *, policy="affinity", vnodes=64,
                  unhealthy_after=2, probe_after_s=1.0, metrics=None,
-                 faults=None):
+                 faults=None, fleet=None):
         if policy not in ("affinity", "round_robin"):
             raise ValueError(
                 f"policy={policy!r}: use 'affinity' or 'round_robin'")
         # optional serving.faults.FaultPlan: the `router_dispatch`
         # point fires once per submit, before replica selection
         self.faults = faults
+        # optional serving.fleet.FleetPlane: attaching it lights up
+        # the /debug/fleet/* endpoints (cross-host stitched trace,
+        # merged flight rings) on the server mounting this router
+        self.fleet = fleet
         self._lock = threading.Lock()
         self._replicas = {}          # rid -> _ReplicaState (ordered)
         self._ring = _HashRing(vnodes)
@@ -893,6 +897,22 @@ class Router:
                 reps[rid] = payload
         return {"enabled": any(p.get("enabled") for p in reps.values()),
                 "replicas": reps}
+
+    # -- fleet observability (delegated to the attached plane) ---------
+    def fleet_trace(self):
+        """Merged, skew-corrected chrome trace across every fleet
+        process — None when no FleetPlane is attached (the server maps
+        that to 404)."""
+        if self.fleet is None:
+            return None
+        return self.fleet.fleet_trace()
+
+    def fleet_flightrecorder(self):
+        """Merged flight-ring dump across the fleet — None when no
+        FleetPlane is attached."""
+        if self.fleet is None:
+            return None
+        return self.fleet.fleet_flightrecorder()
 
 
 def _relabel(text, rid, host=None):

@@ -24,6 +24,13 @@ stays single-threaded behind the scheduler's pump):
     switches to a Server-Sent-Events live feed (one payload per
     sample interval, `?count=N` to stop after N events) — the feed
     `tools/ptop.py` renders;
+  * `GET /debug/fleet/trace` — fleet mode: ONE chrome trace merging
+    router + every worker process, remote timestamps rebased by the
+    per-worker clock-offset estimate, flow arrows stitching each
+    request's spans across processes (404 without a FleetPlane);
+  * `GET /debug/fleet/flightrecorder` — fleet mode: every process's
+    flight ring in one document, per-host sections plus one merged
+    skew-corrected stream (404 without a FleetPlane);
   * `GET /debug/stacks` — every live thread's Python stack (who is
     holding the pump / a lock right now).
 
@@ -185,6 +192,24 @@ class CompletionHandler(BaseHTTPRequestHandler):
             else:
                 self._json(200, self.sched.pulse(window=window,
                                                  signals=signals))
+        elif path == "/debug/fleet/trace":
+            # fleet mode only: one merged, skew-corrected chrome trace
+            # across router + every worker process. Duck-typed off the
+            # mounted scheduler (a Router with a FleetPlane attached);
+            # anything else is a 404, same as an unknown route
+            fn = getattr(self.sched, "fleet_trace", None)
+            doc = fn() if fn is not None else None
+            if doc is None:
+                self._json(404, {"error": "no fleet plane attached"})
+            else:
+                self._json(200, doc)
+        elif path == "/debug/fleet/flightrecorder":
+            fn = getattr(self.sched, "fleet_flightrecorder", None)
+            doc = fn() if fn is not None else None
+            if doc is None:
+                self._json(404, {"error": "no fleet plane attached"})
+            else:
+                self._json(200, doc)
         elif path == "/debug/stacks":
             body = _flight.thread_stacks().encode()
             self.send_response(200)

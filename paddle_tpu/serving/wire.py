@@ -42,9 +42,10 @@ import numpy as np
 
 from .handoff import KVHandoff
 
-__all__ = ["WireError", "send_json", "recv_json", "send_bytes",
-           "recv_bytes", "send_array", "recv_array", "send_handoff",
-           "recv_handoff", "MAX_JSON_FRAME", "MAX_BULK_FRAME"]
+__all__ = ["WireError", "WireAccount", "send_json", "recv_json",
+           "send_bytes", "recv_bytes", "send_array", "recv_array",
+           "send_handoff", "recv_handoff", "MAX_JSON_FRAME",
+           "MAX_BULK_FRAME"]
 
 _JLEN = struct.Struct("<I")
 _BLEN = struct.Struct("<Q")
@@ -61,6 +62,48 @@ class WireError(ConnectionError):
     truncated stream, malformed header)."""
 
 
+class WireAccount:
+    """Per-channel byte/frame accounting at the framing layer.
+
+    Every send/recv below takes an optional `acct`; each framed unit
+    (length prefix + payload) books its ACTUAL wire bytes, so the
+    `pt_wire_{tx,rx}_bytes` / `pt_wire_frames` series measure the
+    socket, not the payload a caller thinks it sent. Local integer
+    tallies (`tx_bytes`/`rx_bytes`/`frames`) always accumulate — a
+    per-request account reads them for span byte counts — and any
+    bound counters (duck-typed `.inc(n)`, e.g. a MetricsRegistry
+    counter labeled `{chan=...}`) tick alongside. An account is fed
+    from one framing call at a time; share only the bound counters
+    (which lock internally), not the account object, across threads.
+    """
+
+    __slots__ = ("tx_bytes", "rx_bytes", "frames", "_tx", "_rx", "_fr")
+
+    def __init__(self, tx=None, rx=None, frames=None):
+        self.tx_bytes = 0
+        self.rx_bytes = 0
+        self.frames = 0
+        self._tx = tx
+        self._rx = rx
+        self._fr = frames
+
+    def sent(self, n):
+        self.tx_bytes += n
+        self.frames += 1
+        if self._tx is not None:
+            self._tx.inc(n)
+        if self._fr is not None:
+            self._fr.inc()
+
+    def received(self, n):
+        self.rx_bytes += n
+        self.frames += 1
+        if self._rx is not None:
+            self._rx.inc(n)
+        if self._fr is not None:
+            self._fr.inc()
+
+
 def _recv_exact(sock, n):
     buf = bytearray(n)
     view = memoryview(buf)
@@ -73,29 +116,38 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def send_json(sock, obj):
+def send_json(sock, obj, acct=None):
+    """One JSON control frame. Returns the framed wire bytes."""
     payload = json.dumps(obj).encode()
     if len(payload) > MAX_JSON_FRAME:
         raise WireError(
             f"fleet wire: json frame {len(payload)}B exceeds "
             f"{MAX_JSON_FRAME}B cap")
     sock.sendall(_JLEN.pack(len(payload)) + payload)
+    n = _JLEN.size + len(payload)
+    if acct is not None:
+        acct.sent(n)
+    return n
 
 
-def recv_json(sock):
+def recv_json(sock, acct=None):
     (n,) = _JLEN.unpack(_recv_exact(sock, _JLEN.size))
     if n > MAX_JSON_FRAME:
         raise WireError(
             f"fleet wire: json frame {n}B exceeds {MAX_JSON_FRAME}B cap")
     try:
-        return json.loads(_recv_exact(sock, n).decode())
+        obj = json.loads(_recv_exact(sock, n).decode())
     except (ValueError, UnicodeDecodeError) as e:
         raise WireError(f"fleet wire: malformed json frame: {e}") from e
+    if acct is not None:
+        acct.received(_JLEN.size + n)
+    return obj
 
 
-def send_bytes(sock, data):
+def send_bytes(sock, data, acct=None):
     """One bulk frame: 8-byte length + payload, chunked so the kernel
-    paces a large page set without a second contiguous copy."""
+    paces a large page set without a second contiguous copy. Returns
+    the framed wire bytes."""
     # cast to a flat byte view: an N-D memoryview's len() counts its
     # FIRST dimension, not bytes
     view = memoryview(data).cast("B")
@@ -106,30 +158,38 @@ def send_bytes(sock, data):
     sock.sendall(_BLEN.pack(len(view)))
     for off in range(0, len(view), _CHUNK):
         sock.sendall(view[off:off + _CHUNK])
+    n = _BLEN.size + len(view)
+    if acct is not None:
+        acct.sent(n)
+    return n
 
 
-def recv_bytes(sock):
+def recv_bytes(sock, acct=None):
     (n,) = _BLEN.unpack(_recv_exact(sock, _BLEN.size))
     if n > MAX_BULK_FRAME:
         raise WireError(
             f"fleet wire: bulk frame {n}B exceeds {MAX_BULK_FRAME}B cap")
-    return _recv_exact(sock, n)
+    raw = _recv_exact(sock, n)
+    if acct is not None:
+        acct.received(_BLEN.size + n)
+    return raw
 
 
-def send_array(sock, arr):
+def send_array(sock, arr, acct=None):
     """One optional array: JSON header {dtype, shape} + raw bytes
     (C-order). `None` ships as {"none": true} with no body."""
     if arr is None:
-        send_json(sock, {"none": True})
+        send_json(sock, {"none": True}, acct=acct)
         return 0
     a = np.ascontiguousarray(arr)
-    send_json(sock, {"dtype": a.dtype.str, "shape": list(a.shape)})
-    send_bytes(sock, a.data)
+    send_json(sock, {"dtype": a.dtype.str, "shape": list(a.shape)},
+              acct=acct)
+    send_bytes(sock, a.data, acct=acct)
     return int(a.nbytes)
 
 
-def recv_array(sock):
-    head = recv_json(sock)
+def recv_array(sock, acct=None):
+    head = recv_json(sock, acct=acct)
     if head.get("none"):
         return None
     try:
@@ -137,7 +197,7 @@ def recv_array(sock):
         shape = tuple(int(d) for d in head["shape"])
     except (KeyError, TypeError, ValueError) as e:
         raise WireError(f"fleet wire: bad array header {head!r}") from e
-    raw = recv_bytes(sock)
+    raw = recv_bytes(sock, acct=acct)
     want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
     if len(raw) != want:
         raise WireError(
@@ -145,7 +205,7 @@ def recv_array(sock):
     return np.frombuffer(raw, dtype=dtype).reshape(shape).copy()
 
 
-def send_handoff(sock, h):
+def send_handoff(sock, h, acct=None):
     """Ship one KVHandoff: metadata JSON frame, then k/v/ks/vs.
     Returns the payload bytes actually framed (the
     pt_handoff_bytes_total measurement for a socket-backed handoff)."""
@@ -157,19 +217,19 @@ def send_handoff(sock, h):
         "pages": int(h.pages), "quantized": bool(h.quantized),
         "logprobs": h.logprobs, "cached_tokens": int(h.cached_tokens),
         "timeline": h.timeline,
-    })
+    }, acct=acct)
     n = 0
     for a in (h.k, h.v, h.ks, h.vs):
-        n += send_array(sock, a)
+        n += send_array(sock, a, acct=acct)
     return n
 
 
-def recv_handoff(sock):
-    meta = recv_json(sock)
-    k = recv_array(sock)
-    v = recv_array(sock)
-    ks = recv_array(sock)
-    vs = recv_array(sock)
+def recv_handoff(sock, acct=None):
+    meta = recv_json(sock, acct=acct)
+    k = recv_array(sock, acct=acct)
+    v = recv_array(sock, acct=acct)
+    ks = recv_array(sock, acct=acct)
+    vs = recv_array(sock, acct=acct)
     try:
         return KVHandoff(
             meta["rid"], meta["prompt"], meta["output"],

@@ -1,0 +1,701 @@
+"""Fleet observability plane (observability/fleet_obs.py + the fleet
+wiring in serving/fleet.py, distributed/rpc.py, serving/wire.py).
+
+Covers: NTP-style clock-skew estimation (injected skew recovered,
+EWMA smoothing, uncertainty net of server hold), cross-host trace
+stitching (skew-corrected monotone ordering, per-process rows, flow
+arrows per trace id), merged flight-ring sections, fleet capture
+bundles + the ptdump cross-host narrative, wire-level byte/frame
+accounting at the framing layer, rpc trace-context propagation and
+clock samples, severed-connection error context (trace id + last
+worker error), and the full 3-process drill: prefill -> decode across
+spawned workers with ONE trace id visible in /debug/fleet/trace from
+all three processes, then an injected worker crash firing exactly ONE
+fleet capture bundle that `ptdump bundle` renders.
+"""
+import importlib.util
+import io
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.distributed import rpc as _rpc
+from paddle_tpu.models import llama_spmd as M
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models.llama_serving import ServingEngine
+from paddle_tpu.observability import fleet_obs
+from paddle_tpu.observability import flight_recorder as _flight
+from paddle_tpu.observability import trace_context as tc
+from paddle_tpu.observability.pulse import PulsePlane
+from paddle_tpu.serving import (FleetPlane, FleetWorker, Replica, Router,
+                                ServingServer, fleet, wire)
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = LlamaConfig.tiny(vocab=64, hidden=32, layers=2, heads=4, kv_heads=2,
+                       ffn=64, seq=128)
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(CFG, seed=0, dtype=jnp.float32)
+
+
+def greedy_reference(params, prompt, n_new):
+    ids = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = M.forward(params, jnp.asarray([ids]), CFG, mesh=None,
+                           remat=False)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+def header(seed, blocks=2):
+    return [(seed * 31 + i) % 60 + 1 for i in range(blocks * PAGE)]
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def sockpair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# clock-skew estimation
+# ---------------------------------------------------------------------------
+
+
+class TestClockSkewEstimator:
+    def test_injected_skew_recovered(self):
+        """A peer whose wall clock runs 1.9s ahead, sampled over
+        symmetric round trips with jitter: the smoothed offset
+        converges to the injected skew and rebase() maps the remote
+        stamps back onto the local timeline."""
+        est = fleet_obs.ClockSkewEstimator(alpha=0.2)
+        skew = 1.9
+        for i in range(40):
+            t_send = 100.0 + i
+            rtt = 0.05 + 0.01 * (i % 3)          # jittered round trip
+            t_recv = t_send + rtt
+            t_remote = (t_send + t_recv) / 2 + skew
+            est.sample("w0", t_send, t_remote, t_recv)
+        assert est.offset("w0") == pytest.approx(skew, abs=1e-6)
+        # a remote stamp lands where the local clock says it happened
+        assert est.rebase("w0", 200.0 + skew) == pytest.approx(200.0,
+                                                               abs=1e-6)
+
+    def test_ewma_smoothing_resists_one_congested_trip(self):
+        est = fleet_obs.ClockSkewEstimator(alpha=0.2)
+        est.sample("w0", 0.0, 1.0, 0.0)          # seed: offset 1.0
+        # one congested exchange with an asymmetric path (raw 2.0)
+        est.sample("w0", 10.0, 12.05, 10.1)
+        assert est.offset("w0") == pytest.approx(1.0 + 0.2 * 1.0)
+
+    def test_uncertainty_is_half_rtt_net_of_hold(self):
+        est = fleet_obs.ClockSkewEstimator(alpha=0.5)
+        est.sample("w0", 0.0, 0.1, 0.2, hold_s=0.15)
+        assert est.uncertainty("w0") == pytest.approx(0.025)
+        # hold longer than the rtt clamps to zero, never negative
+        est2 = fleet_obs.ClockSkewEstimator(alpha=0.5)
+        est2.sample("w1", 0.0, 0.1, 0.2, hold_s=5.0)
+        assert est2.uncertainty("w1") == 0.0
+
+    def test_unsampled_peer_is_identity(self):
+        est = fleet_obs.ClockSkewEstimator(alpha=0.2)
+        assert est.offset("ghost") == 0.0
+        assert est.uncertainty("ghost") == 0.0
+        assert est.rebase("ghost", 123.5) == 123.5
+
+    def test_snapshot_counts_samples(self):
+        est = fleet_obs.ClockSkewEstimator(alpha=0.2)
+        for _ in range(3):
+            est.sample("w0", 0.0, 0.5, 0.1)
+        snap = est.snapshot()
+        assert snap["w0"]["samples"] == 3
+        assert set(snap["w0"]) == {"offset_s", "uncertainty_s",
+                                   "samples"}
+
+
+# ---------------------------------------------------------------------------
+# trace stitching + flight merging (pure)
+# ---------------------------------------------------------------------------
+
+
+def _span(name, t_start, dur=0.01, trace_id=None, **args):
+    d = {"name": name, "t_start": t_start, "dur_s": dur,
+         "trace_id": trace_id, "span_id": f"sp-{name}", "args": args}
+    return d
+
+
+class TestStitchFleetTrace:
+    def test_skew_corrected_monotone_ordering(self):
+        """Worker clock 5s ahead: its spans carry wall stamps that
+        LOOK later than the router's even though they happened in
+        between. Stitching rebases them, so the trace orders the hops
+        submit -> worker -> reply."""
+        tid = "tr-stitch-1"
+        sections = [
+            {"label": "router", "offset_s": 0.0, "spans": [
+                _span("fleet.submit", 100.00, trace_id=tid),
+                _span("wire.stream", 100.30, trace_id=tid)]},
+            {"label": "r0@hostA", "offset_s": 5.0, "spans": [
+                _span("request.prefill", 105.10, trace_id=tid),
+                _span("wire.stream", 105.20, trace_id=tid)]},
+        ]
+        doc = fleet_obs.stitch_fleet_trace(sections)
+        assert doc["fleet"]["sections"] == ["router", "r0@hostA"]
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        by_name = {(e["args"]["section"], e["name"]): e for e in evs}
+        # worker timestamps rebased onto the router clock (micros)
+        assert by_name[("r0@hostA", "request.prefill")]["ts"] \
+            == pytest.approx(100.10 * 1e6)
+        order = sorted(evs, key=lambda e: e["ts"])
+        assert [e["name"] for e in order] == \
+            ["fleet.submit", "request.prefill", "wire.stream",
+             "wire.stream"]
+
+    def test_process_rows_and_trace_threads(self):
+        sections = [
+            {"label": "router", "offset_s": 0.0, "spans": [
+                _span("a", 1.0, trace_id="t1"),
+                _span("b", 2.0, trace_id=None)]},
+            {"label": "r0@h", "offset_s": 0.0, "spans": [
+                _span("c", 1.5, trace_id="t1")]},
+        ]
+        doc = fleet_obs.stitch_fleet_trace(sections)
+        metas = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+        pnames = {m["pid"]: m["args"]["name"] for m in metas
+                  if m["name"] == "process_name"}
+        assert pnames == {0: "router", 1: "r0@h"}
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        # untraced spans pin to thread row 0; traced ones get a named
+        # per-trace row inside their process
+        assert next(e for e in evs if e["name"] == "b")["tid"] == 0
+        assert next(e for e in evs if e["name"] == "a")["tid"] == 1
+        tnames = [m for m in metas if m["name"] == "thread_name"
+                  and m["args"]["name"] == "trace t1"]
+        assert len(tnames) == 2      # one row per process for t1
+
+    def test_flow_arrows_chain_one_trace_across_processes(self):
+        tid = "tr-flow-1"
+        sections = [
+            {"label": "router", "offset_s": 0.0, "spans": [
+                _span("a", 10.0, trace_id=tid)]},
+            {"label": "r0@h", "offset_s": 2.0, "spans": [
+                _span("b", 12.1, trace_id=tid),      # really 10.1
+                _span("lonely", 12.2, trace_id="tr-one-span")]},
+        ]
+        doc = fleet_obs.stitch_fleet_trace(sections)
+        flows = [e for e in doc["traceEvents"] if e.get("cat") == "fleet"]
+        fid = fleet_obs._flow_id(tid)
+        assert [e["ph"] for e in flows] == ["s", "f"]
+        assert all(e["id"] == fid for e in flows)
+        # the chain starts at the skew-CORRECTED earliest span and
+        # stays monotone
+        assert flows[0]["pid"] == 0
+        assert flows[0]["ts"] <= flows[1]["ts"]
+        # a trace seen in only one span gets no arrows
+
+
+class TestMergeFlightSections:
+    def test_merged_stream_on_the_fleet_clock(self):
+        sections = [
+            {"label": "router", "offset_s": 0.0, "uncertainty_s": 0.0,
+             "flight": {"pid": 1, "dropped": 0, "events": [
+                 {"ts": 100.2, "kind": "router.dispatch"}]}},
+            {"label": "r0@h", "offset_s": 5.0, "uncertainty_s": 0.01,
+             "flight": {"pid": 2, "dropped": 3, "events": [
+                 {"ts": 105.1, "kind": "fleet.worker_up"}]}},
+        ]
+        doc = fleet_obs.merge_flight_sections(sections)
+        assert doc["fleet"] is True
+        assert set(doc["sections"]) == {"router", "r0@h"}
+        assert doc["sections"]["r0@h"]["dropped"] == 3
+        # rebased: the worker event (wall 105.1, clock +5s) happened
+        # BEFORE the router's 100.2
+        assert [e["source"] for e in doc["events"]] == ["r0@h", "router"]
+        assert doc["events"][0]["ts_fleet"] == pytest.approx(100.1)
+
+
+# ---------------------------------------------------------------------------
+# fleet capture bundles + the ptdump narrative (pure + tmp dir)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetBundle:
+    def _write(self, root):
+        meta = {"trigger": "engine_restart", "worker": "w0",
+                "at": time.time(), "pid": os.getpid(),
+                "trace_ids": ["tr-bundle-7"],
+                "clock": {"w0": {"offset_s": 0.002,
+                                 "uncertainty_s": 0.0005, "samples": 9}}}
+        sections = [
+            {"label": "router", "offset_s": 0.0, "uncertainty_s": 0.0,
+             "host": "h0", "replica_id": None,
+             "flight": {"pid": 1, "events": [
+                 {"ts": 1.0, "kind": "router.dispatch", "seq": 1}]},
+             "pulse": {"enabled": False}, "requests": []},
+            {"label": "r0@hostA", "offset_s": 0.002,
+             "uncertainty_s": 0.0005, "host": "hostA",
+             "replica_id": "r0",
+             "flight": {"pid": 2, "events": [
+                 {"ts": 1.1, "kind": "fleet.worker_up", "seq": 1}]},
+             "pulse": {"enabled": True},
+             "requests": [{"rid": "q-1", "trace_id": "tr-bundle-7",
+                           "state": "failed"}]},
+        ]
+        return fleet_obs.write_fleet_bundle(str(root), "fleet-test",
+                                            meta, sections)
+
+    def test_bundle_layout_and_meta(self, tmp_path):
+        path = self._write(tmp_path)
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        assert meta["fleet"] is True
+        assert [s["label"] for s in meta["sections"]] == \
+            ["router", "r0@hostA"]
+        for label in ("router", "r0@hostA"):
+            for fname in ("flight.json", "pulse.json", "requests.json"):
+                assert os.path.exists(os.path.join(path, label, fname))
+        flight = json.load(
+            open(os.path.join(path, "r0@hostA", "flight.json")))
+        assert flight["events"][0]["kind"] == "fleet.worker_up"
+
+    def test_hostile_labels_are_sanitized(self, tmp_path):
+        path = fleet_obs.write_fleet_bundle(
+            str(tmp_path), "b", {"trigger": "t"},
+            [{"label": "../evil label", "flight": {}, "pulse": {},
+              "requests": []}])
+        meta = json.load(open(os.path.join(path, "meta.json")))
+        label = meta["sections"][0]["label"]
+        assert "/" not in label and " " not in label
+        assert os.path.isdir(os.path.join(path, label))
+
+    def test_ptdump_renders_cross_host_narrative(self, tmp_path):
+        path = self._write(tmp_path)
+        ptdump = _load_tool("ptdump")
+        out = io.StringIO()
+        ptdump.print_bundle(path, out=out)
+        text = out.getvalue()
+        assert "fleet capture bundle" in text
+        assert "engine_restart" in text
+        assert "tr-bundle-7" in text             # triggering trace named
+        assert "r0@hostA" in text and "=== router ===" in text
+        assert "offset=+2.000ms" in text         # the clock table
+        assert "<- triggering" in text           # ring row marked
+
+
+# ---------------------------------------------------------------------------
+# wire accounting at the framing layer
+# ---------------------------------------------------------------------------
+
+
+class _Ctr:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class TestWireAccounting:
+    def test_framed_bytes_symmetric_across_the_socket(self):
+        a, b = sockpair()
+        tx, rx = wire.WireAccount(), wire.WireAccount()
+        with a, b:
+            n1 = wire.send_json(a, {"op": "x", "pad": "y" * 100},
+                                acct=tx)
+            wire.recv_json(b, acct=rx)
+            n2 = wire.send_bytes(a, b"z" * 4096, acct=tx)
+            wire.recv_bytes(b, acct=rx)
+        # what one side framed is exactly what the other side read
+        assert tx.tx_bytes == rx.rx_bytes == n1 + n2
+        assert tx.frames == rx.frames == 2
+        assert tx.rx_bytes == 0 and rx.tx_bytes == 0
+        # returned sizes are WIRE sizes: payload + length prefix
+        assert n1 > 100 and n2 > 4096
+
+    def test_bound_counters_tick_alongside_tallies(self):
+        a, b = sockpair()
+        ctx, cfr = _Ctr(), _Ctr()
+        acct = wire.WireAccount(tx=ctx, frames=cfr)
+        with a, b:
+            n = wire.send_json(a, {"k": 1}, acct=acct)
+            wire.recv_json(b)
+        assert ctx.value == acct.tx_bytes == n
+        assert cfr.value == acct.frames == 1
+
+
+# ---------------------------------------------------------------------------
+# rpc plumbing: trace meta crosses the wire, clock samples ride replies
+# ---------------------------------------------------------------------------
+
+
+def _remote_trace_probe():
+    # executes on the REMOTE agent: under the inbound trace context
+    return {"trace_id": tc.current_trace_id(),
+            "parent_span": tc.current_span_id()}
+
+
+class TestRpcObservability:
+    @pytest.fixture()
+    def agents(self):
+        port = free_port()
+        store = _rpc._TCPStore("127.0.0.1", port, True)
+        built = {}
+
+        def build():
+            built["b"] = _rpc.RpcAgent("beta", 1, 2, store)
+
+        t = threading.Thread(target=build, daemon=True)
+        t.start()
+        a = _rpc.RpcAgent("alpha", 0, 2, store)
+        t.join(timeout=30)
+        yield a, built["b"]
+        a.stop()
+        built["b"].stop()
+        store.stop()
+
+    def test_trace_context_propagates_to_the_remote_handler(self, agents):
+        a, _ = agents
+        with tc.bind("tr-rpc-77"):
+            with tc.span("caller.op") as sp:
+                got = a.invoke("beta", _remote_trace_probe, (), {},
+                               30.0).wait(30.0)
+                assert got["trace_id"] == "tr-rpc-77"
+                # the remote span seat is the CALLER's span id, so
+                # remote spans nest under this hop
+                assert got["parent_span"] == sp.span_id
+        # outside any trace the frame carries no meta: remote sees none
+        got = a.invoke("beta", _remote_trace_probe, (), {},
+                       30.0).wait(30.0)
+        assert got["trace_id"] is None
+
+    def test_clock_samples_delivered_per_reply(self, agents):
+        a, _ = agents
+        samples = []
+        a.on_clock_sample = \
+            lambda *s: samples.append(s)
+        a.invoke("beta", _remote_trace_probe, (), {}, 30.0).wait(30.0)
+        assert samples
+        peer, t_send, t_remote, t_recv, hold = samples[-1]
+        assert peer == "beta"
+        assert t_send <= t_recv and hold >= 0.0
+        # same process, same clock: the implied offset is ~zero
+        est = fleet_obs.ClockSkewEstimator(alpha=1.0)
+        off, _unc = est.sample(peer, t_send, t_remote, t_recv, hold)
+        assert abs(off) < 1.0
+
+
+# ---------------------------------------------------------------------------
+# pulse trigger_state: the light cross-host poll target
+# ---------------------------------------------------------------------------
+
+
+class TestPulseTriggerState:
+    def test_trigger_state_shape_and_counting(self):
+        restarts = {"v": 0.0}
+
+        def snap():
+            return {"pt_engine_restarts": {"type": "counter",
+                                           "value": restarts["v"]}}
+
+        plane = PulsePlane(snap, interval_s=0.01, start_thread=False,
+                           capture_dir=None,
+                           info_fn=lambda: {"trace_ids": ["tr-p-1"]})
+        plane.tick()                             # baseline
+        st = plane.trigger_state()
+        assert st == {"triggers": {"step_stall": 0, "engine_restart": 0,
+                                   "breaker_open": 0, "slo_burst": 0},
+                      "bundles": [], "trace_ids": ["tr-p-1"]}
+        restarts["v"] = 1.0
+        plane.tick()
+        st = plane.trigger_state()
+        assert st["triggers"]["engine_restart"] == 1
+        assert st["trace_ids"] == ["tr-p-1"]
+
+
+# ---------------------------------------------------------------------------
+# in-process fleet: wire counters on the plane registry, clock gauges,
+# obs sections, sever error context
+# ---------------------------------------------------------------------------
+
+
+class _OneWorkerFleet:
+    def __init__(self, params, **plane_kw):
+        port = free_port()
+        endpoint = f"127.0.0.1:{port}"
+        holder = {}
+
+        def build():
+            engine = ServingEngine(params, CFG, max_seqs=2,
+                                   max_seq_len=64, page_size=PAGE,
+                                   use_pallas=False, prefix_cache=True)
+            rep = Replica("fo0", engine, max_queue=16, role="both")
+            holder["w"] = FleetWorker("w0", rep,
+                                      master_endpoint=endpoint,
+                                      rank=1, world_size=2,
+                                      host="hostF")
+
+        t = threading.Thread(target=build, daemon=True)
+        t.start()
+        self.plane = FleetPlane(endpoint, ["w0"], **plane_kw)
+        t.join(timeout=60)
+        self.worker = holder["w"]
+        self.rep = self.plane.replicas[0]
+
+    def close(self):
+        try:
+            self.worker.replica.shutdown(drain=False, timeout=10)
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+        self.worker.close()
+        self.plane.close()
+
+
+@pytest.fixture()
+def one_worker(params):
+    fl = _OneWorkerFleet(params)
+    yield fl
+    fl.close()
+
+
+class TestFleetWiring:
+    def test_wire_counters_clock_gauges_and_sections(self, one_worker):
+        fl = one_worker
+        rr = fl.rep.submit(header(4) + [7], max_new_tokens=3)
+        assert rr.result(timeout=60)
+        # stream bytes were booked symmetrically: router rx on the
+        # plane registry, worker tx on the replica registry — both
+        # under chan="stream" at the framing layer
+        psnap = fl.plane.registry.snapshot()
+        rx = psnap['pt_wire_rx_bytes{chan="stream"}']
+        assert rx["type"] == "counter" and rx["value"] > 0
+        wsnap = fl.worker.replica.registry.snapshot()
+        assert wsnap['pt_wire_tx_bytes{chan="stream"}']["value"] > 0
+        assert wsnap['pt_wire_frames{chan="stream"}']["value"] >= 2
+        # the rpc traffic behind that submit fed the clock estimator
+        # and its per-host gauges
+        deadline = time.monotonic() + 10
+        while not fl.plane.clock.snapshot() \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        snap = fl.plane.clock.snapshot()
+        assert snap["w0"]["samples"] >= 1
+        assert abs(snap["w0"]["offset_s"]) < 1.0   # same host clock
+        psnap = fl.plane.registry.snapshot()
+        assert 'pt_fleet_clock_offset_seconds{host="hostF"}' in psnap
+        assert 'pt_fleet_clock_uncertainty_seconds{host="hostF"}' \
+            in psnap
+        # obs sections: the router row plus one per alive worker,
+        # labeled replica@host, carrying its clock offset
+        sections = fl.plane.obs_sections()
+        assert [s["label"] for s in sections] == ["router", "fo0@hostF"]
+        assert sections[1]["offset_s"] == fl.plane.clock.offset("w0")
+        assert sections[1]["flight"]["events"]
+        doc = fl.plane.fleet_trace()
+        assert doc["fleet"]["sections"] == ["router", "fo0@hostF"]
+        fr = fl.plane.fleet_flightrecorder()
+        assert set(fr["sections"]) == {"router", "fo0@hostF"}
+
+    def test_sever_names_trace_and_last_worker_error(self, one_worker):
+        fl = one_worker
+        fl.rep.pause()
+        rr = fl.rep.submit(header(5) + [9], max_new_tokens=3)
+        assert rr.trace_id
+        # a worker-side failure preceded the transport loss: the
+        # rebuilt exception must carry it across the sever
+        fl.rep.last_error = "ValueError: boom on the worker"
+        fl.rep._mark_dead("obs sever drill")
+        with pytest.raises(Exception) as ei:
+            rr.result(timeout=30)
+        err = ei.value
+        assert rr.state == "failed"
+        assert f"[trace {rr.trace_id}]" in str(err)
+        assert "last worker error: ValueError: boom on the worker" \
+            in str(err)
+        assert err.trace_id == rr.trace_id
+        assert err.worker_error == "ValueError: boom on the worker"
+        sev = [e for e in _flight.snapshot()["events"]
+               if e.get("kind") == "fleet.sever"
+               and e.get("trace_id") == rr.trace_id]
+        assert sev
+        assert sev[-1]["worker_error"] == \
+            "ValueError: boom on the worker"
+        assert sev[-1]["worker"] == "w0"
+
+
+# ---------------------------------------------------------------------------
+# static-analysis contract: the new surfaces stay in the hot set
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_obs_surfaces_in_tpulint_hot_set():
+    from paddle_tpu.analysis.config import LintConfig
+    cfg = LintConfig.default()
+    assert "paddle_tpu/observability/fleet_obs.py" in cfg.hot_modules
+    for fn in ("ClockSkewEstimator.sample", "FleetWorker.obs_snapshot",
+               "FleetPlane._obs_loop", "FleetPlane.obs_sections"):
+        assert fn in cfg.hot_functions, fn
+
+
+# ---------------------------------------------------------------------------
+# 3 processes, one story: stitched trace + fleet capture bundle
+# ---------------------------------------------------------------------------
+
+
+class TestFleetObsSubprocess:
+    def _get(self, port, path):
+        conn = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=60)
+        return conn.status, json.loads(conn.read().decode())
+
+    def test_cross_host_trace_and_single_capture_bundle(
+            self, params, tmp_path, monkeypatch):
+        monkeypatch.setenv("PT_FLEET_OBS_POLL_S", "0.25")
+        cap_dir = tmp_path / "fleetcaps"
+        cap_dir.mkdir()
+        port = free_port()
+        endpoint = f"127.0.0.1:{port}"
+        spec = {"master": endpoint, "world_size": 3, "seed": 0,
+                "model": vars(CFG), "dtype": "float32",
+                "engine": {"max_seqs": 2, "max_seq_len": 64,
+                           "page_size": PAGE, "use_pallas": False,
+                           "prefix_cache": True,
+                           "host_tier_bytes": 8 << 20}}
+        env = {"JAX_PLATFORMS": "cpu", "PT_PULSE_INTERVAL_S": "0.1"}
+        procs = [
+            fleet.spawn_worker(dict(spec, name="p0", rank=1,
+                                    role="prefill", host="hostA"),
+                               env=env),
+            fleet.spawn_worker(dict(spec, name="d0", rank=2,
+                                    role="decode", host="hostB"),
+                               env=env),
+        ]
+        plane = router = srv = None
+        try:
+            plane = FleetPlane(endpoint, ["p0", "d0"],
+                               capture_dir=str(cap_dir))
+            router = Router(plane.replicas, fleet=plane)
+            srv = ServingServer(router, port=0).start()
+
+            # ---- one request, one trace id, three processes --------
+            tid = "tr-fleetobs-e2e"
+            prompt = header(9) + [11]
+            rr = router.submit(prompt, max_new_tokens=4, trace_id=tid)
+            out = rr.result(timeout=300)
+            assert out == greedy_reference(params, prompt, 4)
+            assert rr.replica_id == "d0"     # migrated prefill->decode
+
+            st, doc = self._get(srv.port, "/debug/fleet/trace")
+            assert st == 200
+            labels = doc["fleet"]["sections"]
+            assert labels[0] == "router"
+            assert set(labels) == {"router", "p0@hostA", "d0@hostB"}
+            metas = [e for e in doc["traceEvents"]
+                     if e.get("ph") == "M"
+                     and e["name"] == "process_name"]
+            pid_label = {m["pid"]: m["args"]["name"] for m in metas}
+            spans = [e for e in doc["traceEvents"]
+                     if e.get("ph") == "X"
+                     and e.get("args", {}).get("trace_id") == tid]
+            seen = {pid_label[e["pid"]] for e in spans}
+            # THE acceptance bar: one trace id, spans from all three
+            # processes in one stitched document
+            assert seen == {"router", "p0@hostA", "d0@hostB"}
+            # skew-corrected ordering is monotone along the flow chain
+            fid = fleet_obs._flow_id(tid)
+            flow_ts = [e["ts"] for e in doc["traceEvents"]
+                       if e.get("cat") == "fleet" and e["id"] == fid]
+            assert len(flow_ts) >= 3
+            assert flow_ts == sorted(flow_ts)
+
+            st, fr = self._get(srv.port, "/debug/fleet/flightrecorder")
+            assert st == 200 and fr["fleet"] is True
+            assert set(fr["sections"]) == \
+                {"router", "p0@hostA", "d0@hostB"}
+            ts = [e["ts_fleet"] for e in fr["events"]]
+            assert ts == sorted(ts)
+
+            # ---- injected worker crash -> exactly ONE bundle -------
+            crash_tid = "tr-fleetobs-crash"
+            p0 = plane.replica("p0")
+            p0.kill()            # every step on p0 now raises
+            rr2 = router.submit(header(13) + [5], max_new_tokens=3,
+                                trace_id=crash_tid)
+            deadline = time.monotonic() + 60
+            while not [b for b in plane.fleet_bundles if b] \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+            p0.revive()
+            try:
+                rr2.result(timeout=120)
+            except Exception:  # noqa: BLE001 — crash drill may fail it
+                pass
+            bundles = [b for b in plane.fleet_bundles if b]
+            assert len(bundles) == 1
+            time.sleep(1.0)      # further triggers stay rate-limited
+            assert len([b for b in plane.fleet_bundles if b]) == 1
+            assert plane.fleet_captures.value == 1
+
+            path = bundles[0]
+            meta = json.load(open(os.path.join(path, "meta.json")))
+            assert meta["fleet"] is True
+            assert meta["trigger"] == "engine_restart"
+            assert meta["worker"] == "p0"
+            assert crash_tid in meta["trace_ids"]
+            sec_labels = [s["label"] for s in meta["sections"]]
+            assert sec_labels[0] == "router"
+            assert "p0@hostA" in sec_labels and "d0@hostB" in sec_labels
+            for label in sec_labels:
+                flight = json.load(open(
+                    os.path.join(path, label, "flight.json")))
+                assert flight.get("events"), label
+
+            ptdump = _load_tool("ptdump")
+            buf = io.StringIO()
+            ptdump.print_bundle(path, out=buf)
+            text = buf.getvalue()
+            assert "fleet capture bundle" in text
+            assert "engine_restart" in text
+            assert crash_tid in text         # triggering trace named
+            assert "p0@hostA" in text and "d0@hostB" in text
+
+            assert router.shutdown(drain=True, timeout=60)
+            for p in procs:
+                assert p.wait(timeout=30) == 0
+        finally:
+            if srv is not None:
+                srv.stop(drain=False, timeout=5)
+            if router is not None:
+                router.shutdown(drain=False, timeout=5)
+            if plane is not None:
+                plane.close()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)

@@ -13,7 +13,11 @@ got:
   * a pulse capture bundle (the directory the pulse plane writes on a
     stall/restart/breaker/SLO-burst trigger): stitches meta, the
     triggering pulse window, the recent-request ring, and the flight
-    dump into one post-mortem narrative.
+    dump into one post-mortem narrative;
+  * a FLEET capture bundle (per-host subdirectories written by rank 0
+    on a worker trigger): the same narrative across every process —
+    trigger, triggering trace ids, clock offsets, then each
+    replica@host section's requests and flight tail.
 
 Pure stdlib — runs anywhere, no jax needed.
 
@@ -266,12 +270,65 @@ def _load_json(path):
         return None
 
 
+def print_fleet_bundle(path, tail=30, kind=None, out=sys.stdout):
+    """Cross-host post-mortem narrative for one FLEET capture bundle
+    (rank 0 pulled every worker's evidence on a pulse trigger): the
+    trigger + triggering trace ids, the clock offsets used to line the
+    hosts up, then one section per process — router first, each
+    replica@host after — with its request ring and flight tail."""
+    w = out.write
+    meta = _load_json(os.path.join(path, "meta.json")) or {}
+    w(f"fleet capture bundle — "
+      f"{os.path.basename(os.path.abspath(path))}\n")
+    w(f"  trigger: {meta.get('trigger', '?')} "
+      f"(reported by {meta.get('worker', '?')}) "
+      f"at {_fmt_ts(meta.get('at', 0))} "
+      f"(router pid {meta.get('pid')})\n")
+    tids = meta.get("trace_ids") or []
+    if tids:
+        w(f"  triggering traces: {', '.join(str(t) for t in tids)}\n")
+    sections = meta.get("sections") or []
+    if sections:
+        w(f"  fleet clock ({len(sections)} processes, offset = how "
+          f"far that clock runs ahead of the router's):\n")
+        for s in sections:
+            w(f"    {s.get('label', '?'):<28} "
+              f"offset={float(s.get('offset_s') or 0) * 1e3:+.3f}ms "
+              f"(±{float(s.get('uncertainty_s') or 0) * 1e3:.3f}ms)\n")
+    for s in sections:
+        label = s.get("label", "?")
+        sub = os.path.join(path, label)
+        reqs = _load_json(os.path.join(sub, "requests.json")) or {}
+        if isinstance(reqs, dict):
+            reqs = reqs.get("requests") or []
+        flight = _load_json(os.path.join(sub, "flight.json"))
+        w(f"\n=== {label} ===\n")
+        if reqs:
+            w(f"  recent requests ({len(reqs)} in ring, newest "
+              f"last):\n")
+            for r in reqs[-min(6, len(reqs)):]:
+                mark = " <- triggering" \
+                    if r.get("trace_id") in tids else ""
+                w(f"    {r.get('rid', '?')} "
+                  f"trace={r.get('trace_id')} "
+                  f"state={r.get('state', r.get('status', '?'))}"
+                  f"{mark}\n")
+        if flight:
+            print_flight(flight, tail=tail, kind=kind, out=out)
+        else:
+            w("  (no flight.json in section)\n")
+
+
 def print_bundle(path, tail=30, kind=None, out=sys.stdout):
     """Post-mortem narrative for one capture bundle directory: what
     fired, which requests were in flight, what the pulse rings saw
-    around the trigger, then the flight-recorder tail."""
+    around the trigger, then the flight-recorder tail. Fleet bundles
+    (per-host subdirectories) dispatch to the cross-host printer."""
     w = out.write
     meta = _load_json(os.path.join(path, "meta.json")) or {}
+    if meta.get("fleet"):
+        print_fleet_bundle(path, tail=tail, kind=kind, out=out)
+        return
     pulse = _load_json(os.path.join(path, "pulse.json")) or {}
     flight = _load_json(os.path.join(path, "flight.json"))
     reqs = _load_json(os.path.join(path, "requests.json")) or {}

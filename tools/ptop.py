@@ -50,6 +50,13 @@ def sparkline(values, width=24):
     return "".join(BARS[i] for i in idx).rjust(width)
 
 
+def _human_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+
+
 def _fmt_value(name, v):
     try:
         v = float(v)
@@ -57,6 +64,11 @@ def _fmt_value(name, v):
         return str(v)
     if "_seconds" in name or name.endswith((":p50", ":p99")):
         return f"{v * 1e3:.2f}ms"
+    # byte counters (pt_wire_{tx,rx}_bytes, spill/handoff bytes) read
+    # better humanized — as a rate when the pulse plane derived one
+    if "_bytes" in name:
+        h = _human_bytes(v)
+        return f"{h}/s" if name.endswith(":rate") else h
     if name.endswith(":rate"):
         return f"{v:.2f}/s"
     if v == int(v) and abs(v) < 1e9:
